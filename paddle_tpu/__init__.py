@@ -1,0 +1,98 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capabilities
+of PaddlePaddle Fluid (reference @ /root/reference, see SURVEY.md).
+
+The public surface mirrors `paddle.fluid` (API.spec parity, SURVEY Appendix
+B): Program/Executor/layers/optimizer/io/..., but the implementation is
+JAX/XLA-first — programs lower to single jitted XLA computations, parallelism
+is jax.sharding over device meshes, kernels are JAX/Pallas.
+
+Typical use (identical shape to fluid):
+
+    import paddle_tpu as fluid
+    x = fluid.layers.data(name="x", shape=[13])
+    y = fluid.layers.data(name="y", shape=[1])
+    pred = fluid.layers.fc(input=x, size=1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(0.01).minimize(loss)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(fluid.default_startup_program())
+    exe.run(feed={...}, fetch_list=[loss])
+"""
+
+from . import ops  # registers the op corpus
+from . import framework
+from .framework import (
+    Program,
+    Variable,
+    Parameter,
+    default_main_program,
+    default_startup_program,
+    program_guard,
+    name_scope,
+    in_dygraph_mode,
+    CPUPlace,
+    TPUPlace,
+    CUDAPlace,
+    CUDAPinnedPlace,
+)
+from .core.scope import Scope, global_scope, scope_guard
+from .executor import Executor
+from .compiler import CompiledProgram, ExecutionStrategy, BuildStrategy
+from .backward import append_backward, gradients
+from .param_attr import ParamAttr, WeightNormParamAttr
+from . import layers
+from . import initializer
+from . import optimizer
+from . import regularizer
+from . import clip
+from . import unique_name
+from . import io
+from .io import save_inference_model, load_inference_model  # noqa: F401
+from . import metrics
+from . import nets
+from . import profiler
+from . import reader
+from . import dygraph
+from .dygraph.base import enable_dygraph, disable_dygraph  # noqa: F401
+from . import parallel
+from .parallel import ParallelExecutor  # noqa: F401
+from .initializer import Constant, Uniform, Normal, Xavier, MSRA  # noqa
+from .data_feeder import DataFeeder  # noqa: F401
+from .core.tensor import LoDTensor, LoDTensorArray  # noqa: F401
+
+
+def cuda_places(device_ids=None):
+    """Alias: accelerator places (parity: framework.py cuda_places)."""
+    import jax
+
+    n = len(jax.devices())
+    ids = device_ids if device_ids is not None else range(n)
+    return [TPUPlace(i) for i in ids]
+
+
+tpu_places = cuda_places
+
+
+def cpu_places(device_count=None):
+    import os
+
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_pinned_places(device_count=None):
+    return [CUDAPinnedPlace() for _ in range(device_count or 1)]
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=False):
+    """No-op under XLA: buffer reuse/inplace is done by the compiler
+    (parity shim for fluid.memory_optimize)."""
+    return None
+
+
+def release_memory(input_program, skip_opt_set=None):
+    return None
+
+
+__version__ = "0.1.0"

@@ -1,0 +1,223 @@
+"""Static autodiff: append_backward (parity: python/paddle/fluid/backward.py:394
++ the C++ GradOpDescMaker machinery, framework/grad_op_desc_maker.h).
+
+Walks the block's op list in reverse from the loss, appending one `<type>_grad`
+op per differentiable forward op. Grad ops are *generic*: they carry a
+reference to their forward op and are lowered via `jax.vjp` of the forward
+kernel (core/lowering.py:_execute_grad_op) — per-op grad kernels are never
+hand-written. When a var feeds several ops, its gradient contributions are
+accumulated (Fluid inserts `sum` ops; here accumulation is tagged on the grad
+op and fused by XLA).
+"""
+
+from . import framework
+from .framework import grad_var_name
+from .ops import registry
+
+__all__ = ["append_backward", "gradients"]
+
+
+def _collect_need_grad(block, params, no_grad_set, extra_leaves=()):
+    """Forward pass: which vars lie on a differentiable path from trainables
+    (or from `extra_leaves` — arbitrary vars the caller wants grads for)."""
+    need = set()
+    for p in params:
+        if p.name not in no_grad_set:
+            need.add(p.name)
+    for name in extra_leaves:
+        if name not in no_grad_set:
+            need.add(name)
+    for op in block.ops:
+        if not registry.has(op.type):
+            continue
+        opdef = registry.get(op.type)
+        if not opdef.differentiable:
+            continue
+        hit = False
+        for slot, vs in op.inputs.items():
+            if slot in opdef.nondiff_inputs:
+                continue
+            if any(v.name in need for v in vs):
+                hit = True
+                break
+        if hit:
+            for vs in op.outputs.values():
+                for v in vs:
+                    if not v.stop_gradient and v.name not in no_grad_set:
+                        need.add(v.name)
+    return need
+
+
+def _create_grad_var(block, primal, gname):
+    if block.has_var(gname):
+        return block.var(gname)
+    return block.create_var(
+        name=gname,
+        shape=primal.shape,
+        dtype=primal.dtype,
+        stop_gradient=True,
+    )
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None, _extra_leaves=(),
+                    _target_gradients=None):
+    """Append grad ops computing d loss / d param for every trainable param.
+
+    Returns list of (param Variable, grad Variable).
+    """
+    program = loss.block.program
+    block = program.global_block()
+    no_grad_set = set(no_grad_set or ())
+
+    if parameter_list:
+        params = []
+        for p in parameter_list:
+            name = p if isinstance(p, str) else p.name
+            params.append(block.var(name))
+    else:
+        params = [p for p in block.all_parameters() if p.trainable]
+
+    need_grad = _collect_need_grad(block, params, no_grad_set, _extra_leaves)
+
+    # locate the op producing the loss
+    loss_idx = None
+    for i in reversed(range(len(block.ops))):
+        if loss.name in block.ops[i].output_names():
+            loss_idx = i
+            break
+    if loss_idx is None:
+        raise ValueError("loss var %r is not produced by any op" % loss.name)
+
+    program._appending_grad_times += 1
+
+    # seed gradient: d loss / d loss = 1 (or the caller-supplied cotangent)
+    loss_grad_name = grad_var_name(loss.name)
+    loss_grad = _create_grad_var(block, loss, loss_grad_name)
+    if _target_gradients is not None:
+        block.append_op(
+            type="assign",
+            inputs={"X": [_target_gradients]},
+            outputs={"Out": [loss_grad]},
+            attrs={"__op_role__": "backward"},
+        )
+    else:
+        block.append_op(
+            type="fill_constant",
+            outputs={"Out": [loss_grad]},
+            attrs={
+                "shape": list(loss.shape or (1,)),
+                "value": 1.0,
+                "dtype": loss.dtype,
+                "__op_role__": "backward",
+            },
+        )
+
+    grad_map = {loss.name: loss_grad_name}  # primal name -> grad var name
+
+    fwd_ops = list(block.ops[: loss_idx + 1])
+    for op in reversed(fwd_ops):
+        if not registry.has(op.type):
+            continue
+        opdef = registry.get(op.type)
+        if not opdef.differentiable:
+            continue
+        # upstream grads available for any output?
+        gout_map = {}
+        any_gout = False
+        for slot, vs in op.outputs.items():
+            names = []
+            for v in vs:
+                g = grad_map.get(v.name)
+                names.append(g)
+                if g is not None:
+                    any_gout = True
+            gout_map[slot] = names
+        if not any_gout:
+            continue
+        # inputs that require grads
+        gin_map = {}
+        accumulate = {}
+        grad_out_vars = []
+        any_gin = False
+        for slot, vs in op.inputs.items():
+            if slot in opdef.nondiff_inputs:
+                gin_map[slot] = [None] * len(vs)
+                continue
+            names = []
+            for v in vs:
+                if v.name not in need_grad or v.name in no_grad_set:
+                    names.append(None)
+                    continue
+                gname = grad_var_name(v.name)
+                gv = _create_grad_var(block, v, gname)
+                if v.name in grad_map:
+                    # a later consumer already produced this grad: accumulate
+                    accumulate[gname] = True
+                else:
+                    grad_map[v.name] = gname
+                names.append(gname)
+                grad_out_vars.append(gv)
+                any_gin = True
+            gin_map[slot] = names
+        if not any_gin:
+            continue
+
+        grad_inputs = dict(op.inputs)
+        gout_vars = {}
+        for slot, vs in op.outputs.items():
+            gvs = [block.var(g) for g in gout_map[slot] if g is not None]
+            if gvs:
+                gout_vars[slot + "@GRAD"] = gvs
+        grad_inputs = {**grad_inputs, **gout_vars}
+
+        block.append_op(
+            type=op.type + "_grad",
+            inputs=grad_inputs,
+            outputs={"InputGrads": grad_out_vars},
+            attrs={
+                "__fwd_op__": op,
+                "__grad_out_map__": gout_map,
+                "__grad_in_map__": gin_map,
+                "__accumulate__": accumulate,
+                "__op_role__": "backward",
+            },
+        )
+
+    params_and_grads = []
+    for p in params:
+        gname = grad_map.get(p.name)
+        if gname is None:
+            continue
+        g = block.var(gname)
+        params_and_grads.append((p, g))
+    program.param_grad_map.update(
+        {p.name: g.name for p, g in params_and_grads}
+    )
+    return params_and_grads
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Compute grads of targets wrt arbitrary inputs — data vars and
+    activations included, not only parameters (parity: fluid.gradients /
+    backward.py calc_gradient)."""
+    if not isinstance(targets, (list, tuple)):
+        targets = [targets]
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    if target_gradients is not None and not isinstance(
+            target_gradients, (list, tuple)):
+        target_gradients = [target_gradients]
+    leaves = tuple(v.name for v in inputs)
+    for i, loss in enumerate(targets):
+        tg = None
+        if target_gradients is not None and i < len(target_gradients):
+            tg = target_gradients[i]
+        append_backward(loss, parameter_list=None, no_grad_set=no_grad_set,
+                        _extra_leaves=leaves, _target_gradients=tg)
+    block = targets[0].block
+    outs = []
+    for v in inputs:
+        gname = grad_var_name(v.name)
+        outs.append(block.var(gname) if block.has_var(gname) else None)
+    return outs

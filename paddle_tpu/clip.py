@@ -1,0 +1,130 @@
+"""Gradient clipping (parity: python/paddle/fluid/clip.py —
+ErrorClipByValue, GradientClipByValue, GradientClipByNorm,
+GradientClipByGlobalNorm, set_gradient_clip)."""
+
+from . import layers
+from .layer_helper import LayerHelper
+
+__all__ = [
+    "ErrorClipByValue",
+    "GradientClipByValue",
+    "GradientClipByNorm",
+    "GradientClipByGlobalNorm",
+    "set_gradient_clip",
+    "append_gradient_clip_ops",
+]
+
+
+class BaseErrorClipAttr:
+    pass
+
+
+class ErrorClipByValue(BaseErrorClipAttr):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = min if min is not None else -max
+
+
+class BaseGradientClipAttr:
+    def _process_context(self, context, param, grad):
+        pass
+
+    def _create_operators(self, param, grad):
+        raise NotImplementedError
+
+
+class NullGradientClipAttr(BaseGradientClipAttr):
+    def _create_operators(self, param, grad):
+        return param, grad
+
+
+class GradientClipByValue(BaseGradientClipAttr):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def _create_operators(self, param, grad):
+        new_grad = layers.clip(x=grad, min=self.min, max=self.max)
+        return param, new_grad
+
+
+class GradientClipByNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def _create_operators(self, param, grad):
+        new_grad = layers.clip_by_norm(x=grad, max_norm=self.clip_norm)
+        return param, new_grad
+
+
+class GradientClipByGlobalNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = clip_norm
+        self.group_name = group_name
+
+    def _process_context(self, context, param, grad):
+        if self.group_name not in context:
+            context[self.group_name] = []
+            context[self.group_name + "_clip_value"] = self.clip_norm
+        sq = layers.reduce_sum(layers.square(grad))
+        context[self.group_name].append(sq)
+        self.context = context
+
+    def _create_operators(self, param, grad):
+        group_scale_name = self.group_name + "_scale"
+        if group_scale_name not in self.context:
+            group_norm = layers.sums(self.context[self.group_name])
+            group_norm = layers.sqrt(group_norm)
+            clip_var = layers.fill_constant(
+                shape=[1], dtype=grad.dtype,
+                value=self.context[self.group_name + "_clip_value"])
+            scale = layers.elementwise_div(
+                x=clip_var,
+                y=layers.elementwise_max(x=clip_var, y=group_norm))
+            self.context[group_scale_name] = scale
+        new_grad = layers.elementwise_mul(
+            x=grad, y=self.context[group_scale_name])
+        return param, new_grad
+
+
+_gradient_clip_attr = [None]
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    from . import framework
+
+    if param_list is None:
+        _gradient_clip_attr[0] = clip
+        return
+    program = program or framework.default_main_program()
+    for p in param_list:
+        name = p if isinstance(p, str) else p.name
+        program.global_block().var(name).gradient_clip_attr = clip
+
+
+def append_gradient_clip_ops(param_grads):
+    context = {}
+    any_clip = False
+    for p, g in param_grads:
+        if g is None:
+            continue
+        clip_attr = getattr(p, "gradient_clip_attr", None) or \
+            _gradient_clip_attr[0]
+        if clip_attr is None:
+            continue
+        any_clip = True
+        clip_attr._process_context(context, p, g)
+    if not any_clip:
+        return param_grads
+    out = []
+    for p, g in param_grads:
+        if g is None:
+            out.append((p, g))
+            continue
+        clip_attr = getattr(p, "gradient_clip_attr", None) or \
+            _gradient_clip_attr[0]
+        if clip_attr is None:
+            out.append((p, g))
+            continue
+        out.append(clip_attr._create_operators(p, g))
+    return out

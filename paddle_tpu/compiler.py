@@ -1,0 +1,212 @@
+"""CompiledProgram (parity: python/paddle/fluid/compiler.py:49 /
+ParallelExecutor C++ runtime C10-C14).
+
+TPU-native: `with_data_parallel` does NOT build per-device op-handle graphs
+with inserted NCCL collectives. It lowers the SAME single program onto a
+`jax.sharding.Mesh` whose leading axis is the data axis: feeds get
+batch-sharded NamedShardings, params are replicated, and XLA's sharding
+propagation inserts the gradient all-reduce over ICI (SURVEY §2.3
+TPU-native-equivalent note). Loss scaling (ScaleLossGradOpHandle parity)
+falls out of mean-reduction semantics — each replica computes the mean over
+its shard and gradients are averaged by psum/num_replicas via propagation.
+"""
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import framework
+from .core.lowering import LoweringContext, execute_block
+from .framework import dtype_to_np
+
+__all__ = ["CompiledProgram", "ExecutionStrategy", "BuildStrategy"]
+
+
+class ExecutionStrategy:
+    """Knob parity (pybind ExecutionStrategy). Most knobs are no-ops under
+    XLA (thread pools, iteration scopes); kept for source compatibility."""
+
+    def __init__(self):
+        self.num_threads = 0
+        self.num_iteration_per_drop_scope = 1
+        self.num_iteration_per_run = 1
+        self.use_thread_barrier = False
+
+
+class BuildStrategy:
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = \
+            BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        self.debug_graphviz_path = ""
+        self.enable_sequential_execution = False
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_broadcast_ops = False
+        self.memory_optimize = True
+        self.enable_inplace = True
+        self.sync_batch_norm = False
+        self.num_trainers = 1
+        self.trainer_id = 0
+
+
+class CompiledProgram:
+    def __init__(self, program_or_graph, build_strategy=None):
+        self._program = program_or_graph
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._is_data_parallel = False
+        self._loss_name = None
+        self._places = None
+        self._exec_strategy = None
+        self._share_vars_from = None
+        self._compiled_steps = {}
+        self._mesh = None
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        self._is_data_parallel = True
+        self._loss_name = loss_name
+        if build_strategy is not None:
+            self._build_strategy = build_strategy
+        self._exec_strategy = exec_strategy or ExecutionStrategy()
+        self._share_vars_from = share_vars_from
+        self._places = places
+        return self
+
+    def with_inference_optimize(self, config):
+        return self
+
+    # ------------------------------------------------------------------
+    def _get_mesh(self):
+        if self._mesh is None:
+            devs = np.array(jax.devices())
+            self._mesh = Mesh(devs, axis_names=("dp",))
+        return self._mesh
+
+    def _run(self, executor, feed, fetch_list, scope, return_numpy):
+        from .core.scope import global_scope
+        from .executor import _CompiledStep, _feed_signature
+
+        if not self._is_data_parallel:
+            return executor.run(self._program, feed=feed,
+                                fetch_list=fetch_list, scope=scope,
+                                return_numpy=return_numpy)
+        feed = dict(feed or {})
+        scope = scope if scope is not None else global_scope()
+        fetch_names = [
+            v.name if isinstance(v, framework.Variable) else str(v)
+            for v in (fetch_list or [])
+        ]
+        key = (self._program.version, _feed_signature(feed),
+               tuple(fetch_names))
+        step = self._compiled_steps.get(key)
+        if step is None:
+            step = _DataParallelStep(self._program, feed.keys(), fetch_names,
+                                     self._get_mesh(),
+                                     self._build_strategy)
+            self._compiled_steps[key] = step
+        fetches = step.run(scope, feed)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return fetches
+
+
+class _DataParallelStep:
+    """One jitted SPMD step over the data mesh."""
+
+    def __init__(self, program, feed_names, fetch_names, mesh, build_strategy):
+        self.program = program
+        self.feed_names = list(feed_names)
+        self.fetch_names = list(fetch_names)
+        self.mesh = mesh
+        block = program.global_block()
+        self.block = block
+
+        produced = set()
+        state_in = []
+        state_out = set()
+        for op in block.ops:
+            for name in op.input_names():
+                v = block._find_var_recursive(name)
+                if v is not None and v.persistable and name not in produced \
+                        and name not in state_in:
+                    state_in.append(name)
+            for name in op.output_names():
+                produced.add(name)
+                v = block._find_var_recursive(name)
+                if v is not None and v.persistable:
+                    state_out.add(name)
+        for name in self.fetch_names:
+            v = block._find_var_recursive(name)
+            if v is not None and v.persistable and name not in produced \
+                    and name not in state_in:
+                state_in.append(name)
+        self.state_out = sorted(state_out)
+        self.mut_names = [n for n in state_in if n in state_out]
+        self.const_names = [n for n in state_in if n not in state_out]
+        self._seed = program.random_seed or 0
+
+        repl = NamedSharding(mesh, P())
+        batch = NamedSharding(mesh, P("dp"))
+        self._repl = repl
+        self._batch = batch
+
+        def step(mut_state, const_state, feeds, step_counter):
+            base_key = jax.random.fold_in(
+                jax.random.PRNGKey(self._seed), step_counter)
+            ctx = LoweringContext(base_key=base_key, mesh=mesh)
+            env = {}
+            env.update(const_state)
+            env.update(mut_state)
+            env.update(feeds)
+            execute_block(block, env, ctx)
+            fetches = [env[n] for n in self.fetch_names]
+            new_state = {n: env[n] for n in self.state_out if n in env}
+            return fetches, new_state
+
+        # params/state replicated; feeds sharded on batch dim. XLA sharding
+        # propagation turns the param-grad reductions into ICI all-reduces.
+        self._jitted = jax.jit(
+            step,
+            donate_argnums=(0,),
+            in_shardings=(repl, repl, batch, None),
+            out_shardings=(repl, repl),
+        )
+
+    def run(self, scope, feed):
+        mut = {}
+        const = {}
+        for names, store in ((self.mut_names, mut), (self.const_names, const)):
+            for name in names:
+                val = scope.get(name)
+                if val is None:
+                    raise RuntimeError(
+                        "persistable var %r is not initialized — run the "
+                        "startup program first" % name)
+                store[name] = val
+        feeds = {}
+        for name in self.feed_names:
+            v = self.block._find_var_recursive(name)
+            arr = np.asarray(feed[name])
+            if v is not None and v.shape is not None:
+                want = dtype_to_np(v.dtype)
+                if arr.dtype != want:
+                    arr = arr.astype(want)
+            feeds[name] = arr
+        ctr = np.uint32(scope.get("__step_counter__", 0) or 0)
+        fetches, new_state = self._jitted(mut, const, feeds, ctr)
+        for name, val in new_state.items():
+            scope.set(name, val)
+        scope.set("__step_counter__", int(ctr) + 1)
+        return fetches

@@ -1,0 +1,169 @@
+"""Program -> JAX lowering (the TPU-native replacement for Fluid's op-by-op
+Executor hot loop, framework/executor.cc:387-450).
+
+Instead of interpreting ops over mutable scopes, `execute_block` symbolically
+runs every op's JAX kernel over an environment of tracers; the whole block
+(forward + grad ops + optimizer ops) becomes ONE traced function that XLA
+compiles and fuses. Gradient ops are generic: a grad op re-runs its forward
+op's kernel under `jax.vjp` and applies the upstream cotangents — duplicate
+forward computation is eliminated by XLA CSE inside the single jitted step,
+which replaces Fluid's ~400 hand-written grad kernels
+(framework/grad_op_desc_maker.h machinery).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import registry
+
+
+class LoweringContext:
+    """Per-trace context handed to op kernels.
+
+    rng(attrs): deterministic per-op PRNG key — folded from (program seed,
+    op seed, step counter), so dropout masks differ across steps but the
+    grad op's forward recompute sees the identical mask (same fold inputs).
+    """
+
+    def __init__(self, base_key, is_test=False, data_axis=None, mesh=None):
+        self.base_key = base_key
+        self.is_test = is_test
+        # mesh axis name along which data-parallel collectives run (pmean in
+        # sync_batch_norm etc.); None outside shard_map/pmap tracing
+        self.data_axis = data_axis
+        self.mesh = mesh
+
+    def rng(self, attrs):
+        seed = attrs.get("__op_seed__")
+        if seed is None:
+            seed = attrs.get("seed", 0) or 0
+        return jax.random.fold_in(self.base_key, int(seed) & 0x7FFFFFFF)
+
+
+# ops that are pure program structure — no runtime kernel
+_STRUCTURAL = {"feed", "fetch", "read", "double_buffer", "create_py_reader",
+               "data", "depend"}
+
+# ops with bespoke lowering (control flow etc.) — populated by
+# ops/controlflow.py via register_special
+_SPECIAL = {}
+
+
+def register_special(op_type):
+    def deco(fn):
+        _SPECIAL[op_type] = fn
+        return fn
+
+    return deco
+
+
+def execute_block(block, env, ctx):
+    """Symbolically execute every op of `block` over env (name -> tracer)."""
+    for op in block.ops:
+        execute_op(op, env, ctx)
+    return env
+
+
+def execute_op(op, env, ctx):
+    if op.type in _STRUCTURAL:
+        return
+    if op.type in _SPECIAL:
+        _SPECIAL[op.type](op, env, ctx)
+        return
+    if "__fwd_op__" in op.attrs:
+        _execute_grad_op(op, env, ctx)
+        return
+    opdef = registry.get(op.type)
+    ins = {
+        slot: [env[v.name] for v in vs] for slot, vs in op.inputs.items() if vs
+    }
+    outs = opdef.impl(ctx, ins, op.attrs)
+    _bind_outputs(op, outs, env)
+
+
+def _bind_outputs(op, outs, env):
+    for slot, vs in op.outputs.items():
+        if not vs:
+            continue
+        produced = outs.get(slot)
+        if produced is None:
+            continue
+        for v, val in zip(vs, produced):
+            env[v.name] = val
+
+
+def _zero_cotangent(primal):
+    if jnp.issubdtype(jnp.result_type(primal), jnp.inexact):
+        return jnp.zeros_like(primal)
+    # integer/bool primals take float0 cotangents
+    return np.zeros(np.shape(primal), dtype=jax.dtypes.float0)
+
+
+def _execute_grad_op(op, env, ctx):
+    """Generic gradient kernel: vjp of the forward op's impl.
+
+    op.attrs carries:
+      __fwd_op__       : the forward Operator object
+      __grad_out_map__ : {slot: [grad var name or None per output]}
+      __grad_in_map__  : {slot: [grad var name or None per input]}
+    """
+    fwd = op.attrs["__fwd_op__"]
+    gout_map = op.attrs["__grad_out_map__"]
+    gin_map = op.attrs["__grad_in_map__"]
+    opdef = registry.get(fwd.type)
+
+    fwd_ins = {
+        slot: [env[v.name] for v in vs] for slot, vs in fwd.inputs.items() if vs
+    }
+    diff_slots = [
+        s
+        for s in fwd_ins
+        if s not in opdef.nondiff_inputs
+        and any(
+            jnp.issubdtype(jnp.result_type(x), jnp.inexact) for x in fwd_ins[s]
+        )
+    ]
+    const_ins = {s: v for s, v in fwd_ins.items() if s not in diff_slots}
+    diff_ins = {s: fwd_ins[s] for s in diff_slots}
+
+    def f(d):
+        return opdef.impl(ctx, {**const_ins, **d}, fwd.attrs)
+
+    primal_out, vjp_fn = jax.vjp(f, diff_ins)
+
+    # Build cotangents congruent with primal_out. For each produced output,
+    # pull the upstream grad from env when the backward pass created one,
+    # else a (symbolic) zero.
+    cots = {}
+    for slot, prim_list in primal_out.items():
+        names = gout_map.get(slot, [])
+        cot_list = []
+        for i, prim in enumerate(prim_list):
+            gname = names[i] if i < len(names) else None
+            if gname is not None and gname in env:
+                g = env[gname]
+                dt = jnp.result_type(prim)
+                if jnp.issubdtype(dt, jnp.inexact):
+                    cot_list.append(g.astype(dt))
+                else:
+                    cot_list.append(_zero_cotangent(prim))
+            else:
+                cot_list.append(_zero_cotangent(prim))
+        cots[slot] = cot_list
+    (gd,) = vjp_fn(cots)
+
+    # scatter input grads into env, accumulating on name collisions (a var
+    # feeding the same op twice)
+    for slot in diff_slots:
+        names = gin_map.get(slot, [])
+        for i, g in enumerate(gd[slot]):
+            gname = names[i] if i < len(names) else None
+            if gname is None:
+                continue
+            if isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0:
+                continue
+            if gname in env and op.attrs.get("__accumulate__", {}).get(gname):
+                env[gname] = env[gname] + g
+            else:
+                env[gname] = g

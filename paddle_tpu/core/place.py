@@ -1,0 +1,82 @@
+"""Device places (parity: paddle/fluid/platform/place.h, bound at
+pybind/pybind.cc:886-963).
+
+TPU-native: a Place names a JAX device set, not a CUDA ordinal. TPUPlace is
+the accelerator place; CUDAPlace is accepted as an alias so Fluid-style
+scripts run unchanged. `CUDAPinnedPlace` maps to host-committed memory used
+for async feeds.
+"""
+
+import functools
+
+
+class Place:
+    _kind = "base"
+
+    def __eq__(self, other):
+        return type(self) is type(other) and getattr(self, "device_id", 0) == getattr(
+            other, "device_id", 0
+        )
+
+    def __hash__(self):
+        return hash((self._kind, getattr(self, "device_id", 0)))
+
+    def __repr__(self):
+        if hasattr(self, "device_id"):
+            return "%s(%d)" % (type(self).__name__, self.device_id)
+        return "%s()" % type(self).__name__
+
+
+class CPUPlace(Place):
+    _kind = "cpu"
+
+    def jax_device(self):
+        import jax
+
+        cpus = jax.devices("cpu") if _has_platform("cpu") else jax.devices()
+        return cpus[0]
+
+
+class TPUPlace(Place):
+    """The accelerator place. device_id indexes jax.devices()."""
+
+    _kind = "tpu"
+
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def jax_device(self):
+        import jax
+
+        devs = jax.devices()
+        return devs[self.device_id % len(devs)]
+
+
+class CUDAPlace(TPUPlace):
+    """Alias of TPUPlace for Fluid source compatibility (place.h CUDAPlace)."""
+
+    _kind = "tpu"
+
+
+class CUDAPinnedPlace(CPUPlace):
+    _kind = "pinned"
+
+
+@functools.lru_cache(maxsize=None)
+def _has_platform(name):
+    import jax
+
+    try:
+        return len(jax.devices(name)) > 0
+    except RuntimeError:
+        return False
+
+
+def default_place():
+    """Accelerator if present, else CPU."""
+    import jax
+
+    d = jax.devices()[0]
+    if d.platform == "cpu":
+        return CPUPlace()
+    return TPUPlace(0)

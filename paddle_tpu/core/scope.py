@@ -1,0 +1,119 @@
+"""Scope: name -> value store at the API edge (parity:
+framework/scope.h:45 — but only at the edge: inside a jitted step all state
+is a functional pytree; the Scope holds the device-resident persistable
+arrays between steps).
+"""
+
+import numpy as np
+
+__all__ = ["Scope", "global_scope", "scope_guard"]
+
+
+class Scope:
+    def __init__(self, parent=None):
+        self._vars = {}
+        self.parent = parent
+        self._kids = []
+
+    def var(self, name):
+        """Create (or get) a slot for `name`."""
+        if name not in self._vars:
+            self._vars[name] = None
+        return _VarHandle(self, name)
+
+    def find_var(self, name):
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return _VarHandle(s, name)
+            s = s.parent
+        return None
+
+    def new_scope(self):
+        k = Scope(self)
+        self._kids.append(k)
+        return k
+
+    def drop_kids(self):
+        self._kids = []
+
+    # -- raw value access used by the executor -----------------------------
+    def get(self, name, default=None):
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s.parent
+        return default
+
+    def set(self, name, value):
+        self._vars[name] = value
+
+    def has(self, name):
+        return self.get(name, _MISSING) is not _MISSING
+
+    def local_var_names(self):
+        return list(self._vars)
+
+    def __contains__(self, name):
+        return self.has(name)
+
+
+_MISSING = object()
+
+
+class _VarHandle:
+    """Fluid-style Variable handle into a scope slot."""
+
+    def __init__(self, scope, name):
+        self._scope = scope
+        self._name = name
+
+    def name(self):
+        return self._name
+
+    def get_tensor(self):
+        return _TensorHandle(self._scope, self._name)
+
+    def get_value(self):
+        return self._scope.get(self._name)
+
+    def set_value(self, v):
+        self._scope.set(self._name, v)
+
+
+class _TensorHandle:
+    def __init__(self, scope, name):
+        self._scope = scope
+        self._name = name
+
+    def set(self, array, place=None):
+        self._scope.set(self._name, np.asarray(array))
+
+    def shape(self):
+        v = self._scope.get(self._name)
+        return list(np.shape(v)) if v is not None else []
+
+    def __array__(self, dtype=None):
+        v = np.asarray(self._scope.get(self._name))
+        return v.astype(dtype) if dtype else v
+
+
+_global_scope = Scope()
+_scope_stack = [_global_scope]
+
+
+def global_scope():
+    return _scope_stack[-1]
+
+
+class scope_guard:
+    def __init__(self, scope):
+        self._scope = scope
+
+    def __enter__(self):
+        _scope_stack.append(self._scope)
+        return self._scope
+
+    def __exit__(self, *a):
+        _scope_stack.pop()

@@ -1,0 +1,106 @@
+"""Program (de)serialization — JSON descriptor layer (parity:
+framework/framework.proto + program_desc.cc round-trip; used by
+save/load_inference_model).
+
+Grad ops carrying live `__fwd_op__` references are re-linked after load via
+the recorded forward-op index.
+"""
+
+import json
+
+import numpy as np
+
+from .. import framework
+
+
+def _ser_attr(v):
+    if isinstance(v, framework.Block):
+        return {"__block__": v.idx}
+    if isinstance(v, framework.Operator):
+        return {"__op_index__": v.block.ops.index(v), "__op_block__": v.block.idx}
+    if isinstance(v, np.ndarray):
+        return {"__ndarray__": v.tolist(), "dtype": str(v.dtype)}
+    return v
+
+
+def program_to_desc(program):
+    blocks = []
+    for blk in program.blocks:
+        ops = []
+        for op in blk.ops:
+            ops.append({
+                "type": op.type,
+                "inputs": {k: [v.name for v in vs]
+                           for k, vs in op.inputs.items()},
+                "outputs": {k: [v.name for v in vs]
+                            for k, vs in op.outputs.items()},
+                "attrs": {k: _ser_attr(v) for k, v in op.attrs.items()},
+            })
+        blocks.append({
+            "idx": blk.idx,
+            "parent_idx": blk.parent_idx,
+            "vars": [v.to_desc() for v in blk.vars.values()],
+            "ops": ops,
+        })
+    return {"version": 1, "random_seed": program.random_seed,
+            "blocks": blocks}
+
+
+def program_from_desc(desc):
+    p = framework.Program()
+    p.random_seed = desc.get("random_seed", 0)
+    p.blocks = []
+    for bd in desc["blocks"]:
+        blk = framework.Block(p, bd["idx"], bd["parent_idx"])
+        p.blocks.append(blk)
+    for bd, blk in zip(desc["blocks"], p.blocks):
+        for vd in bd["vars"]:
+            common = dict(
+                name=vd["name"],
+                shape=vd["shape"],
+                dtype=vd["dtype"],
+                lod_level=vd.get("lod_level", 0),
+                stop_gradient=vd.get("stop_gradient", False),
+                is_data=vd.get("is_data", False),
+                type=vd.get("type"),
+            )
+            if vd.get("is_parameter"):
+                v = framework.Parameter(
+                    blk, shape=common.pop("shape"),
+                    dtype=common.pop("dtype"),
+                    trainable=vd.get("trainable", True), **common)
+            else:
+                v = framework.Variable(
+                    blk, persistable=vd.get("persistable", False), **common)
+            blk.vars[v.name] = v
+    for bd, blk in zip(desc["blocks"], p.blocks):
+        for od in bd["ops"]:
+            attrs = {}
+            for k, v in od["attrs"].items():
+                if isinstance(v, dict) and "__block__" in v:
+                    attrs[k] = p.blocks[v["__block__"]]
+                elif isinstance(v, dict) and "__ndarray__" in v:
+                    attrs[k] = np.asarray(v["__ndarray__"], dtype=v["dtype"])
+                else:
+                    attrs[k] = v
+            blk.append_op(
+                type=od["type"],
+                inputs={k: [blk.var(n) for n in ns]
+                        for k, ns in od["inputs"].items()},
+                outputs={k: [blk.var(n) for n in ns]
+                         for k, ns in od["outputs"].items()},
+                attrs=attrs,
+            )
+    # re-link grad ops to their forward ops
+    for blk in p.blocks:
+        for op in blk.ops:
+            ref = op.attrs.get("__fwd_op__")
+            if isinstance(ref, dict) and "__op_index__" in ref:
+                op.attrs["__fwd_op__"] = \
+                    p.blocks[ref["__op_block__"]].ops[ref["__op_index__"]]
+    p.current_block_idx = 0
+    return p
+
+
+def program_from_json(s):
+    return program_from_desc(json.loads(s))

@@ -1,0 +1,69 @@
+"""LoDTensor / LoDTensorArray at the API edge (parity:
+framework/lod_tensor.h:58-110; pybind.cc:396).
+
+TPU-native stance (SURVEY §5.7): ragged sequences are represented as padded
+dense arrays + explicit per-sequence lengths; the LoD offset table is kept on
+the host wrapper for API parity and converted to masks/segment-ids by the
+sequence ops."""
+
+import numpy as np
+
+__all__ = ["LoDTensor", "LoDTensorArray", "create_lod_tensor"]
+
+
+class LoDTensor:
+    def __init__(self, array=None, lod=None):
+        self._array = np.asarray(array) if array is not None else None
+        self._lod = lod or []
+
+    def set(self, array, place=None):
+        self._array = np.asarray(array)
+
+    def set_lod(self, lod):
+        self._lod = lod
+
+    def lod(self):
+        return self._lod
+
+    def set_recursive_sequence_lengths(self, lengths):
+        # convert lengths to offsets
+        lod = []
+        for lv in lengths:
+            offs = [0]
+            for n in lv:
+                offs.append(offs[-1] + n)
+            lod.append(offs)
+        self._lod = lod
+
+    def recursive_sequence_lengths(self):
+        out = []
+        for offs in self._lod:
+            out.append([offs[i + 1] - offs[i] for i in range(len(offs) - 1)])
+        return out
+
+    def has_valid_recursive_sequence_lengths(self):
+        if not self._lod:
+            return True
+        n = self._array.shape[0] if self._array is not None else 0
+        return self._lod[-1][-1] == n
+
+    def shape(self):
+        return list(self._array.shape) if self._array is not None else []
+
+    def __array__(self, dtype=None):
+        a = self._array
+        return a.astype(dtype) if dtype else a
+
+    def __repr__(self):
+        return "LoDTensor(shape=%s, lod=%s)" % (self.shape(), self._lod)
+
+
+class LoDTensorArray(list):
+    def append_tensor(self, t):
+        self.append(t)
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    t = LoDTensor(np.asarray(data))
+    t.set_recursive_sequence_lengths(recursive_seq_lens)
+    return t

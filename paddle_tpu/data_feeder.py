@@ -1,0 +1,63 @@
+"""DataFeeder (parity: python/paddle/fluid/data_feeder.py) — converts a
+batch of python rows into the executor feed dict."""
+
+import numpy as np
+
+from .core.tensor import LoDTensor
+from .framework import Variable, default_main_program, dtype_to_np
+
+__all__ = ["DataFeeder"]
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place=None, program=None):
+        self.feed_names = []
+        self.feed_shapes = []
+        self.feed_dtypes = []
+        self.feed_lod_level = []
+        program = program or default_main_program()
+        for v in feed_list:
+            if isinstance(v, str):
+                v = program.global_block().var(v)
+            self.feed_names.append(v.name)
+            self.feed_shapes.append(v.shape)
+            self.feed_dtypes.append(dtype_to_np(v.dtype))
+            self.feed_lod_level.append(v.lod_level)
+        self.place = place
+
+    def feed(self, iterable):
+        """iterable: list of rows, each row a tuple matching feed_list."""
+        columns = [[] for _ in self.feed_names]
+        for row in iterable:
+            for i, cell in enumerate(row):
+                columns[i].append(np.asarray(cell))
+        out = {}
+        for name, col, shape, dt, lod in zip(
+            self.feed_names, columns, self.feed_shapes, self.feed_dtypes,
+            self.feed_lod_level,
+        ):
+            if lod > 0:
+                # ragged: pad to max length; lod kept on a LoDTensor wrapper
+                maxlen = max(c.shape[0] for c in col)
+                padded = np.zeros((len(col), maxlen) + col[0].shape[1:], dt)
+                lengths = []
+                for i, c in enumerate(col):
+                    padded[i, : c.shape[0]] = c
+                    lengths.append(c.shape[0])
+                t = LoDTensor(padded)
+                t.set_recursive_sequence_lengths([lengths])
+                out[name] = padded.astype(dt)
+            else:
+                arr = np.stack(col).astype(dt)
+                # honor declared trailing shape (e.g. [-1, 1] labels)
+                if shape is not None:
+                    want_rank = len(shape)
+                    while arr.ndim < want_rank:
+                        arr = arr[..., None]
+                    if arr.ndim == want_rank:
+                        tgt = [d if d != -1 else arr.shape[i]
+                               for i, d in enumerate(shape)]
+                        if int(np.prod(tgt)) == arr.size:
+                            arr = arr.reshape(tgt)
+                out[name] = arr
+        return out

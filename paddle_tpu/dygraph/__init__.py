@@ -1,0 +1,13 @@
+"""Dygraph (eager) mode (parity: python/paddle/fluid/dygraph/ + C++
+imperative/ — SURVEY C21, call stack §3.4)."""
+
+from . import base
+from .base import guard, to_variable, no_grad, enable_dygraph, disable_dygraph
+from .layers import Layer
+from . import nn
+from .nn import *  # noqa: F401,F403
+from .checkpoint import save_dygraph, load_dygraph  # noqa: F401
+from .tracer import Tracer  # noqa: F401
+
+__all__ = ["guard", "to_variable", "no_grad", "Layer", "save_dygraph",
+           "load_dygraph", "enable_dygraph", "disable_dygraph"] + nn.__all__

@@ -1,0 +1,247 @@
+"""Dygraph (eager) mode core (parity: python/paddle/fluid/dygraph/base.py
+guard :29 / to_variable :47 + C++ imperative/ Tracer C21).
+
+Eager semantics TPU-style: ops run immediately as JAX calls (async dispatch
+gives the overlap the reference got from streams); a host-side tape records
+(fwd impl, inputs, outputs) and `VarBase.backward()` replays it in reverse
+through the same per-op `jax.vjp` machinery as the static path
+(imperative/layer.cc:131 Autograd::RunBackward parity).
+"""
+
+import contextlib
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import framework
+from ..core.lowering import LoweringContext
+from ..ops import registry
+
+__all__ = ["guard", "to_variable", "no_grad", "enable_dygraph",
+           "disable_dygraph", "enabled"]
+
+
+class Tape:
+    def __init__(self):
+        self.entries = []  # (op_type, ins{slot:[VarBase]}, attrs, outs{slot:[VarBase]})
+        self.recording = True
+
+
+class Tracer:
+    """Eager tracer (parity: imperative/tracer.h:50)."""
+
+    def __init__(self):
+        self.tape = Tape()
+        self._op_counter = 0
+        self._key = jax.random.PRNGKey(0)
+        self.is_test = False
+
+    def ctx(self):
+        self._op_counter += 1
+        return LoweringContext(
+            base_key=jax.random.fold_in(self._key, self._op_counter),
+            is_test=self.is_test,
+        )
+
+    def trace_op(self, op_type, ins, outs_wanted, attrs):
+        """Run op eagerly; return dict slot -> list[VarBase]."""
+        opdef = registry.get(op_type)
+        jins = {
+            slot: [v.value if isinstance(v, VarBase) else jnp.asarray(v)
+                   for v in vs]
+            for slot, vs in ins.items() if vs
+        }
+        outs = opdef.impl(self.ctx(), jins, attrs)
+        vouts = {}
+        stop = all(
+            getattr(v, "stop_gradient", True)
+            for vs in ins.values() for v in vs
+        ) or not opdef.differentiable
+        for slot in outs_wanted:
+            produced = outs.get(slot, [])
+            vouts[slot] = [VarBase(p, stop_gradient=stop) for p in produced]
+        if self.tape.recording and not stop:
+            self.tape.entries.append((op_type, dict(ins), dict(attrs), vouts))
+        return vouts
+
+
+_tracer = None
+
+
+def enabled():
+    return _tracer is not None
+
+
+def _current_tracer():
+    return _tracer
+
+
+def enable_dygraph(place=None):
+    global _tracer
+    _tracer = Tracer()
+    framework._dygraph_tracer_ = _tracer
+
+
+def disable_dygraph():
+    global _tracer
+    _tracer = None
+    framework._dygraph_tracer_ = None
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    enable_dygraph(place)
+    try:
+        yield
+    finally:
+        disable_dygraph()
+
+
+@contextlib.contextmanager
+def no_grad():
+    t = _current_tracer()
+    if t is None:
+        yield
+        return
+    prev = t.tape.recording
+    t.tape.recording = False
+    try:
+        yield
+    finally:
+        t.tape.recording = prev
+
+
+class VarBase:
+    """Eager tensor (parity: imperative/layer.h:116 VarBase)."""
+
+    def __init__(self, value, name=None, stop_gradient=False,
+                 persistable=False):
+        self.value = jnp.asarray(value)
+        self.name = name
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self._grad = None
+
+    # -- info ---------------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self.value.shape)
+
+    @property
+    def dtype(self):
+        return str(self.value.dtype)
+
+    def numpy(self):
+        return np.asarray(self.value)
+
+    def gradient(self):
+        return None if self._grad is None else np.asarray(self._grad)
+
+    def clear_gradient(self):
+        self._grad = None
+
+    def detach(self):
+        return VarBase(self.value, stop_gradient=True)
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self):
+        t = _current_tracer()
+        if t is None:
+            raise RuntimeError("backward() outside dygraph.guard()")
+        run_backward(self, t.tape)
+
+    def __repr__(self):
+        return "VarBase(shape=%s, dtype=%s)" % (self.shape, self.dtype)
+
+    # arithmetic sugar
+    def _binop(self, other, op):
+        from . import math_ops
+
+        return getattr(math_ops, op)(self, other)
+
+    def __add__(self, o):
+        return self._binop(o, "add")
+
+    def __sub__(self, o):
+        return self._binop(o, "sub")
+
+    def __mul__(self, o):
+        return self._binop(o, "mul")
+
+    def __truediv__(self, o):
+        return self._binop(o, "div")
+
+
+def to_variable(value, name=None, zero_copy=None):
+    if isinstance(value, VarBase):
+        return value
+    return VarBase(np.asarray(value), name=name)
+
+
+def run_backward(root, tape):
+    """Reverse-replay the tape accumulating grads into VarBase._grad
+    (parity: imperative/layer.cc Autograd::RunBackward)."""
+    grads = {}  # id(VarBase) -> jnp array
+    grads[id(root)] = jnp.ones_like(root.value)
+    ctx_tracer = _current_tracer()
+    for op_type, ins, attrs, vouts in reversed(tape.entries):
+        opdef = registry.get(op_type)
+        out_cots_needed = any(
+            id(v) in grads for vs in vouts.values() for v in vs
+        )
+        if not out_cots_needed:
+            continue
+        jins = {
+            slot: [v.value if isinstance(v, VarBase) else jnp.asarray(v)
+                   for v in vs]
+            for slot, vs in ins.items() if vs
+        }
+        diff_slots = [
+            s for s in jins
+            if s not in opdef.nondiff_inputs
+            and any(jnp.issubdtype(x.dtype, jnp.inexact) for x in jins[s])
+        ]
+        const_ins = {s: v for s, v in jins.items() if s not in diff_slots}
+        diff_ins = {s: jins[s] for s in diff_slots}
+        ctx = ctx_tracer.ctx() if ctx_tracer else LoweringContext(
+            jax.random.PRNGKey(0))
+
+        def f(d):
+            return opdef.impl(ctx, {**const_ins, **d}, attrs)
+
+        primal_out, vjp_fn = jax.vjp(f, diff_ins)
+        cots = {}
+        for slot, prim_list in primal_out.items():
+            vlist = vouts.get(slot, [])
+            cl = []
+            for i, prim in enumerate(prim_list):
+                g = None
+                if i < len(vlist):
+                    g = grads.get(id(vlist[i]))
+                if g is not None and jnp.issubdtype(prim.dtype, jnp.inexact):
+                    cl.append(g.astype(prim.dtype))
+                elif jnp.issubdtype(jnp.result_type(prim), jnp.inexact):
+                    cl.append(jnp.zeros_like(prim))
+                else:
+                    cl.append(np.zeros(np.shape(prim),
+                                       dtype=jax.dtypes.float0))
+            cots[slot] = cl
+        (gd,) = vjp_fn(cots)
+        for slot in diff_slots:
+            for v, g in zip(ins[slot], gd[slot]):
+                if not isinstance(v, VarBase) or v.stop_gradient:
+                    continue
+                if isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0:
+                    continue
+                prev = grads.get(id(v))
+                grads[id(v)] = g if prev is None else prev + g
+    # write grads back onto leaves
+    for op_type, ins, attrs, vouts in tape.entries:
+        for vs in list(ins.values()) + list(vouts.values()):
+            for v in vs:
+                if isinstance(v, VarBase) and id(v) in grads:
+                    g = grads[id(v)]
+                    v._grad = g if v._grad is None else v._grad + g
+                    del grads[id(v)]

@@ -1,0 +1,25 @@
+"""Dygraph checkpoint save/load (parity: python/paddle/fluid/dygraph/
+checkpoint.py — save/load state dict per Layer)."""
+
+import os
+
+import numpy as np
+
+__all__ = ["save_dygraph", "load_dygraph"]
+
+
+def save_dygraph(state_dict, model_path):
+    arrays = {k: np.asarray(v) for k, v in state_dict.items()}
+    d = os.path.dirname(model_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    np.savez(model_path + ".pdparams", **arrays)
+
+
+def load_dygraph(model_path):
+    path = model_path + ".pdparams.npz"
+    if not os.path.exists(path):
+        path = model_path + ".pdparams"
+    with np.load(path) as data:
+        state = {k: data[k] for k in data.files}
+    return state, None

@@ -1,0 +1,157 @@
+"""dygraph.Layer base (parity: python/paddle/fluid/dygraph/layers.py:31)."""
+
+import numpy as np
+
+import jax
+
+from .base import VarBase, _current_tracer
+from .. import unique_name
+
+__all__ = ["Layer"]
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self._full_name = unique_name.generate(
+            (name_scope or self.__class__.__name__.lower()))
+        self._dtype = dtype
+        self._parameters = {}
+        self._sub_layers = {}
+        self.training = True
+
+    def full_name(self):
+        return self._full_name
+
+    def create_parameter(self, shape, dtype=None, initializer=None,
+                         attr=None, is_bias=False):
+        from ..initializer import Constant, Xavier
+
+        init = initializer
+        if attr is not None and getattr(attr, "initializer", None) is not None:
+            init = attr.initializer
+        if init is None:
+            init = Constant(0.0) if is_bias else Xavier()
+        key = jax.random.PRNGKey(abs(hash(self._full_name + str(len(
+            self._parameters)))) % (2**31))
+        val = _materialize_init(init, shape, dtype or self._dtype, key)
+        name = unique_name.generate(self._full_name + (".b" if is_bias else ".w"))
+        p = VarBase(val, name=name, stop_gradient=False, persistable=True)
+        return p
+
+    def add_parameter(self, name, parameter):
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def parameters(self, include_sublayers=True):
+        out = list(self._parameters.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                out.extend(l.parameters())
+        return out
+
+    def sublayers(self, include_sublayers=True):
+        out = list(self._sub_layers.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                out.extend(l.sublayers())
+        return out
+
+    def train(self):
+        self.training = True
+        t = _current_tracer()
+        if t:
+            t.is_test = False
+        for l in self.sublayers():
+            l.training = True
+
+    def eval(self):
+        self.training = False
+        t = _current_tracer()
+        if t:
+            t.is_test = True
+        for l in self.sublayers():
+            l.training = False
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    def state_dict(self, include_sublayers=True, prefix=""):
+        out = {}
+        for k, p in self._parameters.items():
+            out[prefix + k] = p.numpy()
+        if include_sublayers:
+            for name, l in self._sub_layers.items():
+                out.update(l.state_dict(prefix=prefix + name + "."))
+        return out
+
+    def set_dict(self, state, include_sublayers=True, prefix=""):
+        for k, p in self._parameters.items():
+            if prefix + k in state:
+                p.value = jax.numpy.asarray(state[prefix + k])
+        if include_sublayers:
+            for name, l in self._sub_layers.items():
+                l.set_dict(state, prefix=prefix + name + ".")
+
+    load_dict = set_dict
+
+    def __setattr__(self, name, value):
+        if isinstance(value, VarBase) and value.persistable:
+            self.__dict__.setdefault("_parameters", {})[name] = value
+        elif isinstance(value, Layer):
+            self.__dict__.setdefault("_sub_layers", {})[name] = value
+        object.__setattr__(self, name, value)
+
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+
+def _materialize_init(init, shape, dtype, key):
+    """Evaluate a static-graph Initializer eagerly for dygraph params."""
+    from .. import initializer as I
+
+    shape = tuple(shape)
+    if isinstance(init, I.ConstantInitializer):
+        return np.full(shape, init.value, dtype=np.float32)
+    if isinstance(init, I.UniformInitializer):
+        return np.asarray(jax.random.uniform(
+            key, shape, minval=init.low, maxval=init.high))
+    if isinstance(init, I.NormalInitializer):
+        return np.asarray(jax.random.normal(key, shape) * init.scale + init.loc)
+    if isinstance(init, I.TruncatedNormalInitializer):
+        return np.asarray(jax.random.truncated_normal(key, -2, 2, shape)
+                          * init.scale + init.loc)
+    if isinstance(init, I.XavierInitializer):
+        fi, fo = I._fan_in_out(_FakeVar(shape))
+        fi = init.fan_in or fi
+        fo = init.fan_out or fo
+        if init.uniform:
+            limit = float(np.sqrt(6.0 / (fi + fo)))
+            return np.asarray(jax.random.uniform(key, shape, minval=-limit,
+                                                 maxval=limit))
+        std = float(np.sqrt(2.0 / (fi + fo)))
+        return np.asarray(jax.random.normal(key, shape) * std)
+    if isinstance(init, I.MSRAInitializer):
+        fi, _ = I._fan_in_out(_FakeVar(shape))
+        fi = init.fan_in or fi
+        if init.uniform:
+            limit = float(np.sqrt(6.0 / fi))
+            return np.asarray(jax.random.uniform(key, shape, minval=-limit,
+                                                 maxval=limit))
+        return np.asarray(jax.random.normal(key, shape)
+                          * float(np.sqrt(2.0 / fi)))
+    if isinstance(init, I.NumpyArrayInitializer):
+        return init.value.reshape(shape)
+    raise TypeError("unsupported initializer %r for dygraph" % (init,))
+
+
+class _FakeVar:
+    def __init__(self, shape):
+        self.shape = shape

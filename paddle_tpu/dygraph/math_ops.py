@@ -1,0 +1,32 @@
+"""Eager arithmetic helpers for VarBase."""
+
+import numpy as np
+
+from .base import VarBase, _current_tracer, to_variable
+
+
+def _run(op_type, x, y=None, attrs=None):
+    t = _current_tracer()
+    ins = {"X": [to_variable(x)]}
+    if y is not None:
+        yv = to_variable(y) if not np.isscalar(y) else to_variable(
+            np.full((1,), y, dtype=np.asarray(to_variable(x).value).dtype))
+        ins["Y"] = [yv]
+    outs = t.trace_op(op_type, ins, ["Out"], attrs or {})
+    return outs["Out"][0]
+
+
+def add(x, y):
+    return _run("elementwise_add", x, y)
+
+
+def sub(x, y):
+    return _run("elementwise_sub", x, y)
+
+
+def mul(x, y):
+    return _run("elementwise_mul", x, y)
+
+
+def div(x, y):
+    return _run("elementwise_div", x, y)

@@ -1,0 +1,440 @@
+"""Dygraph layer classes (parity: python/paddle/fluid/dygraph/nn.py — FC,
+Conv2D, Pool2D, BatchNorm, Embedding, LayerNorm, GRUUnit, PRelu,
+BilinearTensorProduct, Conv2DTranspose, ...)."""
+
+import numpy as np
+
+from .base import VarBase, _current_tracer, to_variable
+from .layers import Layer
+from ..initializer import Constant, Normal, Xavier
+from ..param_attr import ParamAttr
+
+__all__ = ["Conv2D", "Pool2D", "FC", "Linear", "BatchNorm", "Embedding",
+           "LayerNorm", "GRUUnit", "PRelu", "BilinearTensorProduct",
+           "Conv2DTranspose", "SpectralNorm", "GroupNorm", "NCE",
+           "Dropout"]
+
+
+def _trace(op_type, ins, outs, attrs=None):
+    return _current_tracer().trace_op(op_type, ins, outs, attrs or {})
+
+
+class FC(Layer):
+    def __init__(self, name_scope, size, num_flatten_dims=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._size = size
+        self._num_flatten_dims = num_flatten_dims
+        self._param_attr = ParamAttr._to_attr(param_attr)
+        self._bias_attr = bias_attr
+        self._act = act
+        self._w = None
+        self._b = None
+
+    def _build_once(self, input):
+        in_features = int(np.prod(input.shape[self._num_flatten_dims:]))
+        self._w = self.create_parameter(
+            [in_features, self._size], self._dtype,
+            attr=self._param_attr)
+        self.add_parameter("w", self._w)
+        if self._bias_attr is not False:
+            self._b = self.create_parameter([self._size], self._dtype,
+                                            is_bias=True)
+            self.add_parameter("b", self._b)
+
+    def forward(self, input):
+        if self._w is None:
+            self._build_once(input)
+        out = _trace("mul", {"X": [input], "Y": [self._w]}, ["Out"],
+                     {"x_num_col_dims": self._num_flatten_dims,
+                      "y_num_col_dims": 1})["Out"][0]
+        if self._b is not None:
+            out = _trace("elementwise_add", {"X": [out], "Y": [self._b]},
+                         ["Out"], {"axis": self._num_flatten_dims})["Out"][0]
+        if self._act:
+            out = _trace(self._act, {"X": [out]}, ["Out"])["Out"][0]
+        return out
+
+
+class Linear(FC):
+    def __init__(self, input_dim, output_dim, param_attr=None, bias_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__("linear", output_dim, 1, param_attr, bias_attr, act,
+                         dtype)
+        self._w = self.create_parameter([input_dim, output_dim], dtype,
+                                        attr=self._param_attr)
+        self.add_parameter("w", self._w)
+        if bias_attr is not False:
+            self._b = self.create_parameter([output_dim], dtype, is_bias=True)
+            self.add_parameter("b", self._b)
+
+
+class Conv2D(Layer):
+    def __init__(self, name_scope, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=None, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._num_filters = num_filters
+        self._filter_size = filter_size if isinstance(filter_size, (list, tuple)) \
+            else [filter_size] * 2
+        self._stride = stride if isinstance(stride, (list, tuple)) else [stride] * 2
+        self._padding = padding if isinstance(padding, (list, tuple)) else [padding] * 2
+        self._dilation = dilation if isinstance(dilation, (list, tuple)) else [dilation] * 2
+        self._groups = groups or 1
+        self._act = act
+        self._param_attr = ParamAttr._to_attr(param_attr)
+        self._bias_attr = bias_attr
+        self._w = None
+        self._b = None
+
+    def _build_once(self, input):
+        c_in = input.shape[1]
+        std = (2.0 / (self._filter_size[0] * self._filter_size[1] * c_in)) ** 0.5
+        init = self._param_attr.initializer or Normal(0.0, std)
+        self._w = self.create_parameter(
+            [self._num_filters, c_in // self._groups] + self._filter_size,
+            self._dtype, initializer=init)
+        self.add_parameter("w", self._w)
+        if self._bias_attr is not False:
+            self._b = self.create_parameter([self._num_filters], self._dtype,
+                                            is_bias=True)
+            self.add_parameter("b", self._b)
+
+    def forward(self, input):
+        if self._w is None:
+            self._build_once(input)
+        out = _trace("conv2d", {"Input": [input], "Filter": [self._w]},
+                     ["Output"],
+                     {"strides": list(self._stride),
+                      "paddings": list(self._padding),
+                      "dilations": list(self._dilation),
+                      "groups": self._groups})["Output"][0]
+        if self._b is not None:
+            out = _trace("elementwise_add", {"X": [out], "Y": [self._b]},
+                         ["Out"], {"axis": 1})["Out"][0]
+        if self._act:
+            out = _trace(self._act, {"X": [out]}, ["Out"])["Out"][0]
+        return out
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, name_scope, num_filters, filter_size, padding=0,
+                 stride=1, dilation=1, groups=None, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._num_filters = num_filters
+        self._filter_size = filter_size if isinstance(filter_size, (list, tuple)) \
+            else [filter_size] * 2
+        self._stride = stride if isinstance(stride, (list, tuple)) else [stride] * 2
+        self._padding = padding if isinstance(padding, (list, tuple)) else [padding] * 2
+        self._dilation = dilation if isinstance(dilation, (list, tuple)) else [dilation] * 2
+        self._groups = groups or 1
+        self._act = act
+        self._w = None
+        self._b = None
+
+    def forward(self, input):
+        if self._w is None:
+            c_in = input.shape[1]
+            self._w = self.create_parameter(
+                [c_in, self._num_filters // self._groups] + self._filter_size,
+                self._dtype)
+            self.add_parameter("w", self._w)
+            self._b = self.create_parameter([self._num_filters], self._dtype,
+                                            is_bias=True)
+            self.add_parameter("b", self._b)
+        out = _trace("conv2d_transpose",
+                     {"Input": [input], "Filter": [self._w]}, ["Output"],
+                     {"strides": list(self._stride),
+                      "paddings": list(self._padding),
+                      "dilations": list(self._dilation),
+                      "groups": self._groups})["Output"][0]
+        out = _trace("elementwise_add", {"X": [out], "Y": [self._b]},
+                     ["Out"], {"axis": 1})["Out"][0]
+        if self._act:
+            out = _trace(self._act, {"X": [out]}, ["Out"])["Out"][0]
+        return out
+
+
+class Pool2D(Layer):
+    def __init__(self, name_scope=None, pool_size=-1, pool_type="max",
+                 pool_stride=1, pool_padding=0, global_pooling=False,
+                 use_cudnn=True, ceil_mode=False, exclusive=True,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        _l = lambda v: v if isinstance(v, (list, tuple)) else [v] * 2
+        self._attrs = {
+            "pooling_type": pool_type, "ksize": _l(pool_size),
+            "strides": _l(pool_stride), "paddings": _l(pool_padding),
+            "global_pooling": global_pooling, "ceil_mode": ceil_mode,
+            "exclusive": exclusive,
+        }
+
+    def forward(self, input):
+        return _trace("pool2d", {"X": [input]}, ["Out"], self._attrs)["Out"][0]
+
+
+class BatchNorm(Layer):
+    def __init__(self, name_scope, num_channels, act=None, is_test=False,
+                 momentum=0.9, epsilon=1e-5, param_attr=None, bias_attr=None,
+                 dtype="float32", data_layout="NCHW", in_place=False,
+                 moving_mean_name=None, moving_variance_name=None,
+                 do_model_average_for_mean_and_var=False, fuse_with_relu=False,
+                 use_global_stats=False, trainable_statistics=False):
+        super().__init__(name_scope, dtype)
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._act = act
+        self._data_layout = data_layout
+        self._use_global_stats = use_global_stats
+        self.scale = self.create_parameter([num_channels], dtype,
+                                           initializer=Constant(1.0))
+        self.bias = self.create_parameter([num_channels], dtype, is_bias=True)
+        self._mean = VarBase(np.zeros([num_channels], np.float32),
+                             stop_gradient=True, persistable=True)
+        self._variance = VarBase(np.ones([num_channels], np.float32),
+                                 stop_gradient=True, persistable=True)
+        self.add_parameter("scale", self.scale)
+        self.add_parameter("offset", self.bias)
+
+    def forward(self, input):
+        outs = _trace(
+            "batch_norm",
+            {"X": [input], "Scale": [self.scale], "Bias": [self.bias],
+             "Mean": [self._mean], "Variance": [self._variance]},
+            ["Y", "MeanOut", "VarianceOut"],
+            {"momentum": self._momentum, "epsilon": self._epsilon,
+             "is_test": not self.training,
+             "data_layout": self._data_layout,
+             "use_global_stats": self._use_global_stats})
+        # moving stats update in place
+        self._mean.value = outs["MeanOut"][0].value
+        self._variance.value = outs["VarianceOut"][0].value
+        out = outs["Y"][0]
+        if self._act:
+            out = _trace(self._act, {"X": [out]}, ["Out"])["Out"][0]
+        return out
+
+
+class Embedding(Layer):
+    def __init__(self, name_scope=None, size=None, is_sparse=False,
+                 is_distributed=False, padding_idx=None, param_attr=None,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._size = size
+        self._padding_idx = -1 if padding_idx is None else padding_idx
+        attr = ParamAttr._to_attr(param_attr)
+        init = attr.initializer or Xavier()
+        self.weight = self.create_parameter(size, dtype, initializer=init)
+        self.add_parameter("weight", self.weight)
+
+    def forward(self, input):
+        return _trace("lookup_table",
+                      {"W": [self.weight], "Ids": [input]}, ["Out"],
+                      {"padding_idx": self._padding_idx})["Out"][0]
+
+
+class LayerNorm(Layer):
+    def __init__(self, name_scope, scale=True, shift=True,
+                 begin_norm_axis=1, epsilon=1e-5, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32",
+                 normalized_shape=None):
+        super().__init__(name_scope, dtype)
+        self._begin_norm_axis = begin_norm_axis
+        self._epsilon = epsilon
+        self._act = act
+        self._scale_flag = scale
+        self._shift_flag = shift
+        self._w = None
+        self._b = None
+
+    def forward(self, input):
+        if self._w is None and self._scale_flag:
+            feat = int(np.prod(input.shape[self._begin_norm_axis:]))
+            self._w = self.create_parameter([feat], self._dtype,
+                                            initializer=Constant(1.0))
+            self.add_parameter("scale", self._w)
+            if self._shift_flag:
+                self._b = self.create_parameter([feat], self._dtype,
+                                                is_bias=True)
+                self.add_parameter("bias", self._b)
+        ins = {"X": [input]}
+        if self._w is not None:
+            ins["Scale"] = [self._w]
+        if self._b is not None:
+            ins["Bias"] = [self._b]
+        out = _trace("layer_norm", ins, ["Y"],
+                     {"begin_norm_axis": self._begin_norm_axis,
+                      "epsilon": self._epsilon})["Y"][0]
+        if self._act:
+            out = _trace(self._act, {"X": [out]}, ["Out"])["Out"][0]
+        return out
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, dropout_implementation="downgrade_in_infer"):
+        super().__init__("dropout")
+        self._p = p
+        self._impl = dropout_implementation
+
+    def forward(self, input):
+        return _trace("dropout", {"X": [input]}, ["Out"],
+                      {"dropout_prob": self._p, "is_test": not self.training,
+                       "dropout_implementation": self._impl})["Out"][0]
+
+
+class GRUUnit(Layer):
+    def __init__(self, name_scope, size, param_attr=None, bias_attr=None,
+                 activation="tanh", gate_activation="sigmoid",
+                 origin_mode=False, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._size = size  # 3 * hidden
+        hidden = size // 3
+        self._hidden = hidden
+        self.weight = self.create_parameter([hidden, 3 * hidden], dtype)
+        self.add_parameter("weight", self.weight)
+        self.bias = self.create_parameter([1, 3 * hidden], dtype, is_bias=True)
+        self.add_parameter("bias", self.bias)
+        self._activation = activation
+        self._gate_activation = gate_activation
+        self._origin_mode = origin_mode
+
+    def forward(self, input, hidden):
+        outs = _trace(
+            "gru_unit",
+            {"Input": [input], "HiddenPrev": [hidden],
+             "Weight": [self.weight], "Bias": [self.bias]},
+            ["Hidden", "Gate", "ResetHiddenPrev"],
+            {"activation": self._activation,
+             "gate_activation": self._gate_activation,
+             "origin_mode": self._origin_mode})
+        return outs["Hidden"][0], outs["ResetHiddenPrev"][0], outs["Gate"][0]
+
+
+class PRelu(Layer):
+    def __init__(self, name_scope, mode, param_attr=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._mode = mode
+        self._param_attr = param_attr
+        self._alpha = None
+
+    def forward(self, input):
+        if self._alpha is None:
+            if self._mode == "all":
+                shape = [1]
+            elif self._mode == "channel":
+                shape = [1, input.shape[1], 1, 1]
+            else:
+                shape = [1] + list(input.shape[1:])
+            self._alpha = self.create_parameter(shape, self._dtype,
+                                                initializer=Constant(0.25))
+            self.add_parameter("alpha", self._alpha)
+        return _trace("prelu", {"X": [input], "Alpha": [self._alpha]},
+                      ["Out"], {"mode": self._mode})["Out"][0]
+
+
+class BilinearTensorProduct(Layer):
+    def __init__(self, name_scope, size, name=None, act=None,
+                 param_attr=None, bias_attr=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._size = size
+        self._act = act
+        self._w = None
+        self._b = None
+
+    def forward(self, x, y):
+        if self._w is None:
+            self._w = self.create_parameter(
+                [self._size, x.shape[1], y.shape[1]], self._dtype)
+            self.add_parameter("w", self._w)
+            self._b = self.create_parameter([1, self._size], self._dtype,
+                                            is_bias=True)
+            self.add_parameter("b", self._b)
+        out = _trace("bilinear_tensor_product",
+                     {"X": [x], "Y": [y], "Weight": [self._w],
+                      "Bias": [self._b]}, ["Out"])["Out"][0]
+        if self._act:
+            out = _trace(self._act, {"X": [out]}, ["Out"])["Out"][0]
+        return out
+
+
+class SpectralNorm(Layer):
+    def __init__(self, name_scope, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._dim = dim
+        self._power_iters = power_iters
+        self._eps = eps
+        self._u = None
+        self._v = None
+
+    def forward(self, weight):
+        if self._u is None:
+            h = weight.shape[self._dim]
+            w = int(np.prod(weight.shape)) // h
+            self._u = VarBase(np.random.randn(h).astype(np.float32),
+                              stop_gradient=True, persistable=True)
+            self._v = VarBase(np.random.randn(w).astype(np.float32),
+                              stop_gradient=True, persistable=True)
+        return _trace("spectral_norm",
+                      {"Weight": [weight], "U": [self._u], "V": [self._v]},
+                      ["Out"],
+                      {"dim": self._dim, "power_iters": self._power_iters,
+                       "eps": self._eps})["Out"][0]
+
+
+class GroupNorm(Layer):
+    def __init__(self, name_scope, groups, epsilon=1e-5, param_attr=None,
+                 bias_attr=None, act=None, data_layout="NCHW",
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._groups = groups
+        self._epsilon = epsilon
+        self._act = act
+        self._w = None
+        self._b = None
+
+    def forward(self, input):
+        if self._w is None:
+            c = input.shape[1]
+            self._w = self.create_parameter([c], self._dtype,
+                                            initializer=Constant(1.0))
+            self._b = self.create_parameter([c], self._dtype, is_bias=True)
+            self.add_parameter("scale", self._w)
+            self.add_parameter("bias", self._b)
+        out = _trace("group_norm",
+                     {"X": [input], "Scale": [self._w], "Bias": [self._b]},
+                     ["Y"],
+                     {"groups": self._groups, "epsilon": self._epsilon})["Y"][0]
+        if self._act:
+            out = _trace(self._act, {"X": [out]}, ["Out"])["Out"][0]
+        return out
+
+
+class NCE(Layer):
+    """API-parity NCE head; on TPU lowers to sampled softmax fallback."""
+
+    def __init__(self, name_scope, num_total_classes, param_attr=None,
+                 bias_attr=None, num_neg_samples=None, sampler="uniform",
+                 custom_dist=None, seed=0, is_sparse=False, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._num_total_classes = num_total_classes
+        self._w = None
+
+    def forward(self, input, label, sample_weight=None):
+        if self._w is None:
+            d = input.shape[-1]
+            self._w = self.create_parameter(
+                [self._num_total_classes, d], self._dtype)
+            self._b = self.create_parameter([self._num_total_classes],
+                                            self._dtype, is_bias=True)
+            self.add_parameter("w", self._w)
+            self.add_parameter("b", self._b)
+        logits = _trace("matmul", {"X": [input], "Y": [self._w]}, ["Out"],
+                        {"transpose_Y": True})["Out"][0]
+        logits = _trace("elementwise_add", {"X": [logits], "Y": [self._b]},
+                        ["Out"], {"axis": -1})["Out"][0]
+        outs = _trace("softmax_with_cross_entropy",
+                      {"Logits": [logits], "Label": [label]},
+                      ["Loss"], {})
+        return outs["Loss"][0]

@@ -1,0 +1,5 @@
+"""Tracer re-export (parity: python/paddle/fluid/dygraph/tracer.py:32)."""
+
+from .base import Tracer
+
+__all__ = ["Tracer"]

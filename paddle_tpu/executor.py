@@ -1,0 +1,186 @@
+"""Executor (parity: python/paddle/fluid/executor.py:292 `Executor`, :550
+`run`, :671 `_run` with program cache; C++ framework/executor.cc).
+
+TPU-native execution model: `run()` lowers the whole program (forward + grad
++ optimizer ops) into ONE pure function
+    step(state, feeds, step_counter) -> (fetches, new_state)
+jit-compiled by XLA with the state pytree donated, so parameter updates are
+in-place buffer aliases in HBM and the host loop does nothing but feed and
+fetch. Compiled executables are cached on (program fingerprint, feed
+signature, fetch names) — the analogue of Fluid's `_get_strong_program_cache_key`
+(executor.py:250), but a cache hit here skips XLA retracing entirely.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import framework
+from .core.lowering import LoweringContext, execute_block
+from .core.place import CPUPlace, TPUPlace, default_place
+from .core.scope import Scope, global_scope, scope_guard  # noqa: F401
+from .framework import Program, dtype_to_np
+
+__all__ = ["Executor", "global_scope", "scope_guard"]
+
+
+def _feed_signature(feed):
+    return tuple(
+        sorted((k, tuple(np.shape(v)), str(np.asarray(v).dtype)) for k, v in feed.items())
+    )
+
+
+def as_numpy(x):
+    return np.asarray(x)
+
+
+class _CompiledStep:
+    """One lowered+jitted step for a (program, feed signature, fetches)."""
+
+    def __init__(self, program, feed_names, fetch_names, scope, mesh_ctx=None):
+        self.program = program
+        self.feed_names = list(feed_names)
+        self.fetch_names = list(fetch_names)
+        block = program.global_block()
+
+        # classify persistable state the step reads/writes
+        produced = set()
+        state_in = []
+        state_out = set()
+        for op in block.ops:
+            for name in op.input_names():
+                v = block._find_var_recursive(name)
+                if v is not None and v.persistable and name not in produced \
+                        and name not in state_in:
+                    state_in.append(name)
+            for name in op.output_names():
+                produced.add(name)
+                v = block._find_var_recursive(name)
+                if v is not None and v.persistable:
+                    state_out.add(name)
+        # fetched persistables must also come from state
+        for name in self.fetch_names:
+            v = block._find_var_recursive(name)
+            if v is not None and v.persistable and name not in produced \
+                    and name not in state_in:
+                state_in.append(name)
+        self.state_out = sorted(state_out)
+        # split read state: donated (also written — param/accumulator updates
+        # alias in-place in HBM) vs const (read-only, e.g. learning rate)
+        self.mut_names = [n for n in state_in if n in state_out]
+        self.const_names = [n for n in state_in if n not in state_out]
+        seed = program.random_seed or 0
+        self._seed = seed
+
+        def step(mut_state, const_state, feeds, step_counter):
+            base_key = jax.random.fold_in(
+                jax.random.PRNGKey(self._seed), step_counter
+            )
+            ctx = LoweringContext(base_key=base_key)
+            env = {}
+            env.update(const_state)
+            env.update(mut_state)
+            env.update(feeds)
+            execute_block(block, env, ctx)
+            fetches = [env[n] for n in self.fetch_names]
+            new_state = {n: env[n] for n in self.state_out if n in env}
+            return fetches, new_state
+
+        self._jitted = jax.jit(step, donate_argnums=(0,))
+
+    def _read_state(self, scope, names):
+        state = {}
+        for name in names:
+            val = scope.get(name)
+            if val is None:
+                raise RuntimeError(
+                    "persistable var %r is not initialized — run the startup "
+                    "program first (exe.run(fluid.default_startup_program()))"
+                    % name
+                )
+            state[name] = val
+        return state
+
+    def run(self, scope, feed):
+        mut = self._read_state(scope, self.mut_names)
+        const = self._read_state(scope, self.const_names)
+        feeds = {}
+        block = self.program.global_block()
+        for name in self.feed_names:
+            v = block._find_var_recursive(name)
+            arr = np.asarray(feed[name])
+            if v is not None and v.shape is not None:
+                want = dtype_to_np(v.dtype)
+                if arr.dtype != want:
+                    arr = arr.astype(want)
+            feeds[name] = arr
+        step_counter = np.uint32(scope.get("__step_counter__", 0) or 0)
+        fetches, new_state = self._jitted(mut, const, feeds, step_counter)
+        for name, val in new_state.items():
+            scope.set(name, val)
+        scope.set("__step_counter__", int(step_counter) + 1)
+        return fetches
+
+
+class Executor:
+    """Drop-in parity with fluid.Executor (executor.py:292)."""
+
+    def __init__(self, place=None):
+        self.place = place if place is not None else default_place()
+        self._cache = {}
+
+    def close(self):
+        self._cache.clear()
+
+    def run(
+        self,
+        program=None,
+        feed=None,
+        fetch_list=None,
+        feed_var_name="feed",
+        fetch_var_name="fetch",
+        scope=None,
+        return_numpy=True,
+        use_program_cache=True,
+    ):
+        from .compiler import CompiledProgram
+
+        if program is None:
+            program = framework.default_main_program()
+        if isinstance(program, CompiledProgram):
+            return program._run(self, feed, fetch_list, scope, return_numpy)
+        feed = dict(feed or {})
+        fetch_list = list(fetch_list or [])
+        scope = scope if scope is not None else global_scope()
+
+        fetch_names = [
+            v.name if isinstance(v, framework.Variable) else str(v)
+            for v in fetch_list
+        ]
+        key = (
+            id(program),
+            program.version,
+            _feed_signature(feed),
+            tuple(fetch_names),
+        )
+        compiled = self._cache.get(key) if use_program_cache else None
+        if compiled is None:
+            compiled = _CompiledStep(program, feed.keys(), fetch_names, scope)
+            if use_program_cache:
+                self._cache[key] = compiled
+
+        with jax.default_device(self.place.jax_device()):
+            fetches = compiled.run(scope, feed)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return fetches
+
+    # Fluid API compat: infer_from / train_from_dataset land in M5+.
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        raise NotImplementedError(
+            "train_from_dataset (async trainer path) arrives with the "
+            "dataset subsystem"
+        )

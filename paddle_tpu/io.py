@@ -1,0 +1,188 @@
+"""Model save/load (parity: python/paddle/fluid/io.py — save_vars :98,
+save_params :232, save_persistables :460, load_* :510-693,
+save_inference_model :898, load_inference_model :1074; kernels
+operators/save_op.cc:25 / load_op.cc).
+
+Format: one `.npz`-style directory (or single combined file) of named numpy
+arrays + a JSON program for inference export. Orbax-grade sharded
+checkpointing for the distributed path lives in parallel/checkpoint.py.
+"""
+
+import json
+import os
+
+import numpy as np
+
+from . import framework
+from .core.scope import global_scope
+from .framework import Program
+
+__all__ = [
+    "save_vars", "save_params", "save_persistables",
+    "load_vars", "load_params", "load_persistables",
+    "save_inference_model", "load_inference_model",
+    "get_program_parameter", "get_program_persistable_vars",
+]
+
+
+def _is_persistable(var):
+    return var.persistable and not var.is_data
+
+
+def _is_parameter(var):
+    return isinstance(var, framework.Parameter)
+
+
+def get_program_parameter(program):
+    return [v for v in program.global_block().vars.values() if _is_parameter(v)]
+
+
+def get_program_persistable_vars(program):
+    return [v for v in program.list_vars() if _is_persistable(v)]
+
+
+def _gather(scope, var_list):
+    out = {}
+    for v in var_list:
+        val = scope.get(v.name)
+        if val is None:
+            raise RuntimeError("var %r has no value in scope; run startup "
+                               "program before saving" % v.name)
+        out[v.name] = np.asarray(val)
+    return out
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    main_program = main_program or framework.default_main_program()
+    if vars is None:
+        vars = [v for v in main_program.list_vars()
+                if predicate is None or predicate(v)]
+    scope = global_scope()
+    arrays = _gather(scope, vars)
+    os.makedirs(dirname, exist_ok=True)
+    if filename is not None:
+        np.savez(os.path.join(dirname, filename), **arrays)
+    else:
+        for name, arr in arrays.items():
+            np.save(os.path.join(dirname, name.replace("/", "__")), arr)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    main_program = main_program or framework.default_main_program()
+    save_vars(executor, dirname, main_program,
+              vars=get_program_parameter(main_program), filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    main_program = main_program or framework.default_main_program()
+    save_vars(executor, dirname, main_program,
+              vars=get_program_persistable_vars(main_program),
+              filename=filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    main_program = main_program or framework.default_main_program()
+    if vars is None:
+        vars = [v for v in main_program.list_vars()
+                if predicate is None or predicate(v)]
+    scope = global_scope()
+    if filename is not None:
+        path = os.path.join(dirname, filename)
+        if not os.path.exists(path) and os.path.exists(path + ".npz"):
+            path += ".npz"  # np.savez appends the suffix on save
+        with np.load(path) as data:
+            for v in vars:
+                if v.name in data:
+                    scope.set(v.name, data[v.name])
+                else:
+                    raise RuntimeError("var %r missing in %s" % (v.name, filename))
+    else:
+        for v in vars:
+            path = os.path.join(dirname, v.name.replace("/", "__") + ".npy")
+            if not os.path.exists(path):
+                raise RuntimeError("no saved file for var %r at %s"
+                                   % (v.name, path))
+            scope.set(v.name, np.load(path))
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    main_program = main_program or framework.default_main_program()
+    load_vars(executor, dirname, main_program,
+              vars=get_program_parameter(main_program), filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    main_program = main_program or framework.default_main_program()
+    load_vars(executor, dirname, main_program,
+              vars=get_program_persistable_vars(main_program),
+              filename=filename)
+
+
+def _prune_program(program, feed_names, fetch_vars):
+    """Prune to the subgraph producing fetch_vars from feed_names (parity:
+    Program._prune used by save_inference_model)."""
+    block = program.global_block()
+    needed = set(v.name for v in fetch_vars)
+    keep = [False] * len(block.ops)
+    for i in reversed(range(len(block.ops))):
+        op = block.ops[i]
+        if any(n in needed for n in op.output_names()):
+            keep[i] = True
+            for n in op.input_names():
+                needed.add(n)
+    pruned = program.clone(for_test=True)
+    pb = pruned.global_block()
+    pb.ops = [op for i, op in enumerate(pb.ops) if keep[i]]
+    return pruned
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True,
+                         program_only=False):
+    main_program = main_program or framework.default_main_program()
+    pruned = _prune_program(main_program, feeded_var_names, target_vars)
+    os.makedirs(dirname, exist_ok=True)
+    model_path = os.path.join(dirname, model_filename or "__model__")
+    meta = {
+        "program": json.loads(pruned.to_json()),
+        "feed_names": list(feeded_var_names),
+        "fetch_names": [v.name for v in target_vars],
+    }
+    with open(model_path, "w") as f:
+        json.dump(meta, f)
+    if program_only:
+        return [v.name for v in target_vars]
+    params = [v for v in pruned.list_vars() if _is_persistable(v)]
+    # only persistables actually referenced by the pruned op list
+    used = set()
+    for op in pruned.global_block().ops:
+        used.update(op.input_names())
+        used.update(op.output_names())
+    params = [v for v in params if v.name in used]
+    arrays = _gather(global_scope(), params)
+    np.savez(os.path.join(dirname, params_filename or "__params__"), **arrays)
+    return [v.name for v in target_vars]
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None, pserver_endpoints=None):
+    from .core import serde
+
+    model_path = os.path.join(dirname, model_filename or "__model__")
+    with open(model_path) as f:
+        meta = json.load(f)
+    program = serde.program_from_desc(meta["program"])
+    params_path = os.path.join(dirname, params_filename or "__params__")
+    if not params_path.endswith(".npz"):
+        params_path += ".npz"
+    if os.path.exists(params_path):
+        scope = global_scope()
+        with np.load(params_path) as data:
+            for name in data.files:
+                scope.set(name, data[name])
+    feed_names = meta["feed_names"]
+    fetch_vars = [program.global_block().var(n) for n in meta["fetch_names"]]
+    return [program, feed_names, fetch_vars]

@@ -1,0 +1,10 @@
+"""Layers DSL (parity: python/paddle/fluid/layers/)."""
+
+from . import nn
+from . import tensor
+from . import io
+from .nn import *  # noqa: F401,F403
+from .tensor import *  # noqa: F401,F403
+from .io import *  # noqa: F401,F403
+
+__all__ = list(set(nn.__all__) | set(tensor.__all__) | set(io.__all__))

@@ -1,0 +1,1715 @@
+"""NN layers DSL (parity: python/paddle/fluid/layers/nn.py — 169 functions).
+
+Every layer appends ops to the current block via LayerHelper, exactly like
+Fluid; kernels are the JAX lowerings in paddle_tpu/ops/.
+"""
+
+import numpy as np
+
+from ..framework import Variable, convert_dtype
+from ..layer_helper import LayerHelper
+from ..initializer import Constant, Normal, Xavier
+from ..param_attr import ParamAttr
+from . import tensor as tensor_layers
+
+__all__ = [
+    "fc", "embedding", "matmul", "mul", "softmax", "dropout", "cross_entropy",
+    "square_error_cost", "mean", "scale", "batch_norm", "layer_norm",
+    "group_norm", "l2_normalize", "one_hot", "topk", "reshape", "squeeze",
+    "unsqueeze", "flatten", "transpose", "split", "stack", "unstack", "expand",
+    "slice", "gather", "scatter", "pad", "pad2d", "pad_constant_like",
+    "label_smooth", "clip", "clip_by_norm", "elementwise_add",
+    "elementwise_sub", "elementwise_mul", "elementwise_div", "elementwise_max",
+    "elementwise_min", "elementwise_pow", "elementwise_mod",
+    "elementwise_floordiv", "equal", "not_equal", "less_than", "less_equal",
+    "greater_than", "greater_equal", "logical_and", "logical_or",
+    "logical_xor", "logical_not", "reduce_sum", "reduce_mean", "reduce_max",
+    "reduce_min", "reduce_prod", "reduce_all", "reduce_any", "cumsum",
+    "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
+    "smooth_l1", "log_loss", "huber_loss", "kldiv_loss", "hinge_loss",
+    "rank_loss", "margin_rank_loss", "bpr_loss", "npair_loss", "dice_loss",
+    "teacher_student_sigmoid_loss", "sampled_softmax_with_cross_entropy",
+    "conv2d", "conv3d", "conv2d_transpose", "conv3d_transpose", "pool2d",
+    "pool3d", "adaptive_pool2d", "adaptive_pool3d", "lrn", "maxout",
+    "pixel_shuffle", "space_to_depth", "shuffle_channel", "temporal_shift",
+    "add_position_encoding", "bilinear_tensor_product", "affine_channel",
+    "affine_grid", "grid_sampler", "prelu", "relu", "relu6", "sigmoid",
+    "logsigmoid", "tanh", "tanh_shrink", "softplus", "softsign", "softshrink",
+    "hard_shrink", "hard_sigmoid", "elu", "selu", "leaky_relu", "brelu",
+    "soft_relu", "swish", "thresholded_relu", "stanh", "exp", "log", "sqrt",
+    "rsqrt", "square", "reciprocal", "abs", "ceil", "floor", "round", "cos",
+    "sin", "acos", "asin", "atan", "pow", "sign", "gelu", "cos_sim", "sums",
+    "sum", "cast", "l1_norm", "shape", "where", "multiplex", "uniform_random",
+    "gaussian_random", "uniform_random_batch_size_like",
+    "gaussian_random_batch_size_like", "sampling_id", "random_crop",
+    "similarity_focus", "mean_iou", "diag", "gather_nd", "im2sequence",
+    "unfold", "data_norm", "spectral_norm", "npair_loss", "image_resize",
+    "resize_bilinear", "resize_nearest", "image_resize_short",
+]
+
+
+def _single_out(helper, op_type, inputs, attrs=None, out_dtype=None,
+                out_slot="Out", shape=None):
+    out = helper.create_variable_for_type_inference(
+        dtype=out_dtype or helper.input_dtype("x")
+        if "x" in helper.kwargs
+        else (out_dtype or "float32")
+    )
+    helper.append_op(type=op_type, inputs=inputs, outputs={out_slot: [out]},
+                     attrs=attrs or {})
+    if shape is not None:
+        out.shape = tuple(shape)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# core layers
+# ---------------------------------------------------------------------------
+
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, is_test=False, name=None):
+    """Fully-connected (parity: layers/nn.py fc). One MXU matmul per input
+    (summed when multiple inputs), channels-last, bias+act fused by XLA."""
+    helper = LayerHelper("fc", **locals())
+    dtype = helper.input_dtype()
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    param_attrs = helper.multiple_param_attr(len(inputs))
+    mul_results = []
+    for inp, p_attr in zip(inputs, param_attrs):
+        in_shape = inp.shape
+        fan_in = int(np.prod(in_shape[num_flatten_dims:]))
+        w = helper.create_parameter(
+            attr=p_attr, shape=[fan_in, size], dtype=dtype
+        )
+        tmp = helper.create_variable_for_type_inference(dtype)
+        helper.append_op(
+            type="mul",
+            inputs={"X": [inp], "Y": [w]},
+            outputs={"Out": [tmp]},
+            attrs={"x_num_col_dims": num_flatten_dims, "y_num_col_dims": 1},
+        )
+        tmp.shape = tuple(in_shape[:num_flatten_dims]) + (size,)
+        mul_results.append(tmp)
+    if len(mul_results) == 1:
+        pre_bias = mul_results[0]
+    else:
+        pre_bias = helper.create_variable_for_type_inference(dtype)
+        helper.append_op(type="sum", inputs={"X": mul_results},
+                         outputs={"Out": [pre_bias]})
+        pre_bias.shape = mul_results[0].shape
+    pre_act = helper.append_bias_op(pre_bias, dim_start=num_flatten_dims)
+    return helper.append_activation(pre_act)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    """Embedding lookup (parity: layers/nn.py embedding /
+    operators/lookup_table_op.cc). is_sparse is accepted for API parity; on
+    TPU the grad is a scatter-add XLA fuses efficiently."""
+    helper = LayerHelper("embedding", **locals())
+    w = helper.create_parameter(attr=helper.param_attr, shape=size,
+                                dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    padding_idx = (
+        -1 if padding_idx is None
+        else padding_idx if padding_idx >= 0 else (size[0] + padding_idx)
+    )
+    helper.append_op(
+        type="lookup_table",
+        inputs={"W": [w], "Ids": [input]},
+        outputs={"Out": [out]},
+        attrs={"is_sparse": is_sparse, "is_distributed": is_distributed,
+               "padding_idx": padding_idx},
+    )
+    ish = input.shape
+    if ish is not None:
+        base = ish[:-1] if (ish and ish[-1] == 1) else ish
+        out.shape = tuple(base) + (size[1],)
+    return out
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    helper = LayerHelper("matmul", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="matmul", inputs={"X": [x], "Y": [y]}, outputs={"Out": [out]},
+        attrs={"transpose_X": transpose_x, "transpose_Y": transpose_y,
+               "alpha": float(alpha)},
+    )
+    if x.shape is not None and y.shape is not None:
+        xs, ys = list(x.shape), list(y.shape)
+        if transpose_x and len(xs) > 1:
+            xs[-1], xs[-2] = xs[-2], xs[-1]
+        if transpose_y and len(ys) > 1:
+            ys[-1], ys[-2] = ys[-2], ys[-1]
+        if len(xs) > 1 and len(ys) > 1:
+            out.shape = tuple(xs[:-1] + [ys[-1]])
+        else:
+            out.shape = (1,)
+    return out
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    helper = LayerHelper("mul", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="mul", inputs={"X": [x], "Y": [y]}, outputs={"Out": [out]},
+        attrs={"x_num_col_dims": x_num_col_dims,
+               "y_num_col_dims": y_num_col_dims},
+    )
+    if x.shape is not None and y.shape is not None:
+        out.shape = tuple(x.shape[:x_num_col_dims]) + tuple(y.shape[y_num_col_dims:])
+    return out
+
+
+def softmax(input, use_cudnn=False, name=None, axis=-1):
+    helper = LayerHelper("softmax", **locals())
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type="softmax", inputs={"X": [input]},
+                     outputs={"Out": [out]}, attrs={"axis": axis})
+    out.shape = input.shape
+    return out
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
+            dropout_implementation="downgrade_in_infer"):
+    helper = LayerHelper("dropout", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    mask = helper.create_variable_for_type_inference(dtype=x.dtype,
+                                                     stop_gradient=True)
+    helper.append_op(
+        type="dropout", inputs={"X": [x]},
+        outputs={"Out": [out], "Mask": [mask]},
+        attrs={"dropout_prob": dropout_prob, "is_test": is_test,
+               "seed": seed or 0,
+               "dropout_implementation": dropout_implementation},
+    )
+    out.shape = x.shape
+    return out
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    helper = LayerHelper("cross_entropy", **locals())
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="cross_entropy", inputs={"X": [input], "Label": [label]},
+        outputs={"Y": [out]},
+        attrs={"soft_label": soft_label, "ignore_index": ignore_index},
+    )
+    if input.shape is not None:
+        out.shape = tuple(input.shape[:-1]) + (1,)
+    return out
+
+
+def square_error_cost(input, label):
+    """(input - label)^2, composed of sub + square ops (parity:
+    layers/nn.py square_error_cost)."""
+    helper = LayerHelper("square_error_cost", **locals())
+    minus_out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type="elementwise_sub",
+                     inputs={"X": [input], "Y": [label]},
+                     outputs={"Out": [minus_out]})
+    minus_out.shape = input.shape
+    sq = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type="square", inputs={"X": [minus_out]},
+                     outputs={"Out": [sq]})
+    sq.shape = input.shape
+    return sq
+
+
+def mean(x, name=None):
+    helper = LayerHelper("mean", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="mean", inputs={"X": [x]}, outputs={"Out": [out]})
+    out.shape = (1,)
+    return out
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    helper = LayerHelper("scale", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="scale", inputs={"X": [x]}, outputs={"Out": [out]},
+        attrs={"scale": float(scale), "bias": float(bias),
+               "bias_after_scale": bias_after_scale},
+    )
+    out.shape = x.shape
+    return helper.append_activation(out)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=False,
+               fuse_with_relu=False, use_global_stats=False):
+    helper = LayerHelper("batch_norm", **locals())
+    dtype = input.dtype
+    ch = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    scale = helper.create_parameter(attr=helper.param_attr, shape=[ch],
+                                    dtype=dtype,
+                                    default_initializer=Constant(1.0))
+    bias = helper.create_parameter(attr=helper.bias_attr, shape=[ch],
+                                   dtype=dtype, is_bias=True)
+    mean = helper.create_parameter(
+        attr=ParamAttr(name=moving_mean_name, initializer=Constant(0.0),
+                       trainable=False),
+        shape=[ch], dtype=dtype)
+    variance = helper.create_parameter(
+        attr=ParamAttr(name=moving_variance_name, initializer=Constant(1.0),
+                       trainable=False),
+        shape=[ch], dtype=dtype)
+    mean.stop_gradient = True
+    variance.stop_gradient = True
+
+    out = helper.create_variable_for_type_inference(dtype)
+    saved_mean = helper.create_variable_for_type_inference(dtype,
+                                                           stop_gradient=True)
+    saved_var = helper.create_variable_for_type_inference(dtype,
+                                                          stop_gradient=True)
+    helper.append_op(
+        type="batch_norm",
+        inputs={"X": [input], "Scale": [scale], "Bias": [bias],
+                "Mean": [mean], "Variance": [variance]},
+        outputs={"Y": [out], "MeanOut": [mean], "VarianceOut": [variance],
+                 "SavedMean": [saved_mean], "SavedVariance": [saved_var]},
+        attrs={"momentum": momentum, "epsilon": epsilon, "is_test": is_test,
+               "data_layout": data_layout,
+               "use_global_stats": use_global_stats},
+    )
+    out.shape = input.shape
+    return helper.append_activation(out)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    helper = LayerHelper("layer_norm", **locals())
+    dtype = input.dtype
+    feat = [int(np.prod(input.shape[begin_norm_axis:]))]
+    inputs = {"X": [input]}
+    if scale:
+        s = helper.create_parameter(attr=helper.param_attr, shape=feat,
+                                    dtype=dtype,
+                                    default_initializer=Constant(1.0))
+        inputs["Scale"] = [s]
+    if shift:
+        b = helper.create_parameter(attr=helper.bias_attr, shape=feat,
+                                    dtype=dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    out = helper.create_variable_for_type_inference(dtype)
+    mean_out = helper.create_variable_for_type_inference(dtype,
+                                                         stop_gradient=True)
+    var_out = helper.create_variable_for_type_inference(dtype,
+                                                        stop_gradient=True)
+    helper.append_op(
+        type="layer_norm", inputs=inputs,
+        outputs={"Y": [out], "Mean": [mean_out], "Variance": [var_out]},
+        attrs={"begin_norm_axis": begin_norm_axis, "epsilon": epsilon},
+    )
+    out.shape = input.shape
+    return helper.append_activation(out)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    helper = LayerHelper("group_norm", **locals())
+    dtype = input.dtype
+    ch = input.shape[1]
+    inputs = {"X": [input]}
+    if param_attr is not False:
+        s = helper.create_parameter(attr=helper.param_attr, shape=[ch],
+                                    dtype=dtype,
+                                    default_initializer=Constant(1.0))
+        inputs["Scale"] = [s]
+    if bias_attr is not False:
+        b = helper.create_parameter(attr=helper.bias_attr, shape=[ch],
+                                    dtype=dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    out = helper.create_variable_for_type_inference(dtype)
+    mean_out = helper.create_variable_for_type_inference(dtype, True)
+    var_out = helper.create_variable_for_type_inference(dtype, True)
+    helper.append_op(
+        type="group_norm", inputs=inputs,
+        outputs={"Y": [out], "Mean": [mean_out], "Variance": [var_out]},
+        attrs={"groups": groups, "epsilon": epsilon},
+    )
+    out.shape = input.shape
+    return helper.append_activation(out)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=False):
+    helper = LayerHelper("data_norm", **locals())
+    dtype = input.dtype
+    ch = input.shape[-1]
+    batch_size = helper.create_parameter(
+        attr=ParamAttr(initializer=Constant(1e4)), shape=[ch], dtype=dtype)
+    batch_sum = helper.create_parameter(
+        attr=ParamAttr(initializer=Constant(0.0)), shape=[ch], dtype=dtype)
+    batch_square_sum = helper.create_parameter(
+        attr=ParamAttr(initializer=Constant(1e4)), shape=[ch], dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    means = helper.create_variable_for_type_inference(dtype, True)
+    scales = helper.create_variable_for_type_inference(dtype, True)
+    helper.append_op(
+        type="data_norm",
+        inputs={"X": [input], "BatchSize": [batch_size],
+                "BatchSum": [batch_sum], "BatchSquareSum": [batch_square_sum]},
+        outputs={"Y": [out], "Means": [means], "Scales": [scales]},
+        attrs={"epsilon": epsilon},
+    )
+    out.shape = input.shape
+    return helper.append_activation(out)
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    helper = LayerHelper("l2_normalize", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    norm = helper.create_variable_for_type_inference(dtype=x.dtype, stop_gradient=True)
+    helper.append_op(
+        type="l2_normalize", inputs={"X": [x]},
+        outputs={"Out": [out], "Norm": [norm]},
+        attrs={"axis": axis, "epsilon": epsilon},
+    )
+    out.shape = x.shape
+    return out
+
+
+def one_hot(input, depth):
+    helper = LayerHelper("one_hot", **locals())
+    out = helper.create_variable_for_type_inference(dtype="float32")
+    helper.append_op(type="one_hot", inputs={"X": [input]},
+                     outputs={"Out": [out]}, attrs={"depth": depth})
+    if input.shape is not None:
+        base = input.shape[:-1] if input.shape[-1] == 1 else input.shape
+        out.shape = tuple(base) + (depth,)
+    out.stop_gradient = True
+    return out
+
+
+def topk(input, k, name=None):
+    helper = LayerHelper("top_k", **locals())
+    values = helper.create_variable_for_type_inference(dtype=input.dtype)
+    indices = helper.create_variable_for_type_inference(dtype="int64")
+    helper.append_op(
+        type="top_k", inputs={"X": [input]},
+        outputs={"Out": [values], "Indices": [indices]}, attrs={"k": k},
+    )
+    if input.shape is not None:
+        values.shape = tuple(input.shape[:-1]) + (k,)
+        indices.shape = values.shape
+    indices.stop_gradient = True
+    return values, indices
+
+
+# ---------------------------------------------------------------------------
+# shape manipulation
+# ---------------------------------------------------------------------------
+
+
+def reshape(x, shape, actual_shape=None, act=None, inplace=False, name=None):
+    helper = LayerHelper("reshape2", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    xshape = helper.create_variable_for_type_inference(dtype=x.dtype,
+                                                       stop_gradient=True)
+    helper.append_op(
+        type="reshape2", inputs={"X": [x]},
+        outputs={"Out": [out], "XShape": [xshape]},
+        attrs={"shape": list(shape)},
+    )
+    if x.shape is not None:
+        s = list(shape)
+        for i, d in enumerate(s):
+            if d == 0:
+                s[i] = x.shape[i]
+        known = int(np.prod([d for d in s if d > 0]))
+        total = int(np.prod([d for d in x.shape])) if all(
+            d != -1 for d in x.shape) else None
+        if -1 in s and total is not None:
+            s[s.index(-1)] = total // known
+        out.shape = tuple(s)
+    return helper.append_activation(out)
+
+
+def squeeze(input, axes, name=None):
+    helper = LayerHelper("squeeze", **locals())
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    xshape = helper.create_variable_for_type_inference(dtype=input.dtype, stop_gradient=True)
+    helper.append_op(type="squeeze2", inputs={"X": [input]},
+                     outputs={"Out": [out], "XShape": [xshape]},
+                     attrs={"axes": axes})
+    if input.shape is not None:
+        s = [d for i, d in enumerate(input.shape)
+             if i not in [a % len(input.shape) for a in axes]]
+        out.shape = tuple(s)
+    return out
+
+
+def unsqueeze(input, axes, name=None):
+    helper = LayerHelper("unsqueeze", **locals())
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    xshape = helper.create_variable_for_type_inference(dtype=input.dtype, stop_gradient=True)
+    helper.append_op(type="unsqueeze2", inputs={"X": [input]},
+                     outputs={"Out": [out], "XShape": [xshape]},
+                     attrs={"axes": axes})
+    if input.shape is not None:
+        s = list(input.shape)
+        for a in sorted(axes):
+            s.insert(a, 1)
+        out.shape = tuple(s)
+    return out
+
+
+def flatten(x, axis=1, name=None):
+    helper = LayerHelper("flatten", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    xshape = helper.create_variable_for_type_inference(dtype=x.dtype, stop_gradient=True)
+    helper.append_op(type="flatten2", inputs={"X": [x]},
+                     outputs={"Out": [out], "XShape": [xshape]},
+                     attrs={"axis": axis})
+    if x.shape is not None:
+        lead = int(np.prod(x.shape[:axis])) if axis > 0 else 1
+        rest = int(np.prod(x.shape[axis:]))
+        if any(d == -1 for d in x.shape[:axis]):
+            lead = -1
+        out.shape = (lead, rest)
+    return out
+
+
+def transpose(x, perm, name=None):
+    helper = LayerHelper("transpose2", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    xshape = helper.create_variable_for_type_inference(dtype=x.dtype, stop_gradient=True)
+    helper.append_op(type="transpose2", inputs={"X": [x]},
+                     outputs={"Out": [out], "XShape": [xshape]},
+                     attrs={"axis": list(perm)})
+    if x.shape is not None:
+        out.shape = tuple(x.shape[p] for p in perm)
+    return out
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    helper = LayerHelper("split", **locals())
+    ndim = len(input.shape)
+    dim = dim % ndim
+    if isinstance(num_or_sections, int):
+        num = num_or_sections
+        sections = []
+        sizes = [input.shape[dim] // num] * num if input.shape[dim] > 0 else [-1] * num
+    else:
+        sections = list(num_or_sections)
+        num = 0
+        sizes = sections
+    outs = [helper.create_variable_for_type_inference(dtype=input.dtype)
+            for _ in sizes]
+    helper.append_op(
+        type="split", inputs={"X": [input]}, outputs={"Out": outs},
+        attrs={"num": num, "sections": sections, "axis": dim},
+    )
+    for o, sz in zip(outs, sizes):
+        s = list(input.shape)
+        s[dim] = sz
+        o.shape = tuple(s)
+    return outs
+
+
+def stack(x, axis=0):
+    helper = LayerHelper("stack", **locals())
+    x = x if isinstance(x, (list, tuple)) else [x]
+    out = helper.create_variable_for_type_inference(dtype=x[0].dtype)
+    helper.append_op(type="stack", inputs={"X": x}, outputs={"Y": [out]},
+                     attrs={"axis": axis})
+    if x[0].shape is not None:
+        s = list(x[0].shape)
+        s.insert(axis % (len(s) + 1), len(x))
+        out.shape = tuple(s)
+    return out
+
+
+def unstack(x, axis=0, num=None):
+    helper = LayerHelper("unstack", **locals())
+    if num is None:
+        num = x.shape[axis]
+    outs = [helper.create_variable_for_type_inference(x.dtype)
+            for _ in range(num)]
+    helper.append_op(type="unstack", inputs={"X": [x]}, outputs={"Y": outs},
+                     attrs={"axis": axis, "num": num})
+    s = list(x.shape)
+    del s[axis % len(s)]
+    for o in outs:
+        o.shape = tuple(s)
+    return outs
+
+
+def expand(x, expand_times, name=None):
+    helper = LayerHelper("expand", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="expand", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"expand_times": list(expand_times)})
+    if x.shape is not None:
+        out.shape = tuple(
+            d * t if d != -1 else -1 for d, t in zip(x.shape, expand_times)
+        )
+    return out
+
+
+def slice(input, axes, starts, ends):
+    helper = LayerHelper("slice", **locals())
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="slice", inputs={"Input": [input]}, outputs={"Out": [out]},
+        attrs={"axes": list(axes), "starts": list(starts), "ends": list(ends)},
+    )
+    if input.shape is not None:
+        s = list(input.shape)
+        for ax, st, en in zip(axes, starts, ends):
+            d = s[ax]
+            if d == -1:
+                continue
+            st2 = max(st + d, 0) if st < 0 else min(st, d)
+            en2 = max(en + d, 0) if en < 0 else min(en, d)
+            s[ax] = max(en2 - st2, 0)
+        out.shape = tuple(s)
+    return out
+
+
+def gather(input, index, overwrite=True):
+    helper = LayerHelper("gather", **locals())
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type="gather", inputs={"X": [input], "Index": [index]},
+                     outputs={"Out": [out]})
+    if input.shape is not None and index.shape is not None:
+        n = index.shape[0]
+        out.shape = (n,) + tuple(input.shape[1:])
+    return out
+
+
+def gather_nd(input, index, name=None):
+    helper = LayerHelper("gather_nd", **locals())
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type="gather_nd", inputs={"X": [input], "Index": [index]},
+                     outputs={"Out": [out]})
+    if input.shape is not None and index.shape is not None:
+        out.shape = tuple(index.shape[:-1]) + tuple(
+            input.shape[index.shape[-1]:])
+    return out
+
+
+def scatter(input, index, updates, name=None, overwrite=True):
+    helper = LayerHelper("scatter", **locals())
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="scatter",
+        inputs={"X": [input], "Ids": [index], "Updates": [updates]},
+        outputs={"Out": [out]}, attrs={"overwrite": overwrite},
+    )
+    out.shape = input.shape
+    return out
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    helper = LayerHelper("pad", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="pad", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"paddings": list(paddings),
+                            "pad_value": float(pad_value)})
+    if x.shape is not None:
+        s = [d + paddings[2 * i] + paddings[2 * i + 1] if d != -1 else -1
+             for i, d in enumerate(x.shape)]
+        out.shape = tuple(s)
+    return out
+
+
+def pad2d(input, paddings=[0, 0, 0, 0], mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    helper = LayerHelper("pad2d", **locals())
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="pad2d", inputs={"X": [input]}, outputs={"Out": [out]},
+        attrs={"paddings": list(paddings), "mode": mode,
+               "pad_value": float(pad_value), "data_format": data_format},
+    )
+    if input.shape is not None:
+        s = list(input.shape)
+        if data_format == "NCHW":
+            s[2] += paddings[0] + paddings[1]
+            s[3] += paddings[2] + paddings[3]
+        else:
+            s[1] += paddings[0] + paddings[1]
+            s[2] += paddings[2] + paddings[3]
+        out.shape = tuple(s)
+    return out
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    helper = LayerHelper("pad_constant_like", **locals())
+    out = helper.create_variable_for_type_inference(dtype=y.dtype)
+    helper.append_op(type="pad_constant_like",
+                     inputs={"X": [x], "Y": [y]}, outputs={"Out": [out]},
+                     attrs={"pad_value": float(pad_value)})
+    out.shape = x.shape
+    return out
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",
+                 name=None):
+    helper = LayerHelper("label_smooth", **locals())
+    out = helper.create_variable_for_type_inference(dtype)
+    inputs = {"X": [label]}
+    if prior_dist is not None:
+        inputs["PriorDist"] = [prior_dist]
+    helper.append_op(type="label_smooth", inputs=inputs,
+                     outputs={"Out": [out]}, attrs={"epsilon": float(epsilon)})
+    out.shape = label.shape
+    return out
+
+
+def clip(x, min, max, name=None):
+    helper = LayerHelper("clip", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="clip", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"min": float(min), "max": float(max)})
+    out.shape = x.shape
+    return out
+
+
+def clip_by_norm(x, max_norm, name=None):
+    helper = LayerHelper("clip_by_norm", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="clip_by_norm", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"max_norm": float(max_norm)})
+    out.shape = x.shape
+    return out
+
+
+# ---------------------------------------------------------------------------
+# elementwise / compare / logical / reduce — generated wrappers
+# ---------------------------------------------------------------------------
+
+
+def _elementwise(op_type):
+    def layer(x, y, axis=-1, act=None, name=None):
+        helper = LayerHelper(op_type, **locals())
+        out = helper.create_variable_for_type_inference(dtype=x.dtype)
+        helper.append_op(type=op_type, inputs={"X": [x], "Y": [y]},
+                         outputs={"Out": [out]}, attrs={"axis": axis})
+        out.shape = x.shape
+        return helper.append_activation(out)
+
+    layer.__name__ = op_type
+    return layer
+
+
+elementwise_add = _elementwise("elementwise_add")
+elementwise_sub = _elementwise("elementwise_sub")
+elementwise_mul = _elementwise("elementwise_mul")
+elementwise_div = _elementwise("elementwise_div")
+elementwise_max = _elementwise("elementwise_max")
+elementwise_min = _elementwise("elementwise_min")
+elementwise_pow = _elementwise("elementwise_pow")
+elementwise_mod = _elementwise("elementwise_mod")
+elementwise_floordiv = _elementwise("elementwise_floordiv")
+
+
+def _compare(op_type):
+    def layer(x, y, cond=None):
+        helper = LayerHelper(op_type, **locals())
+        if cond is None:
+            cond = helper.create_variable_for_type_inference(dtype="bool")
+        helper.append_op(type=op_type, inputs={"X": [x], "Y": [y]},
+                         outputs={"Out": [cond]})
+        cond.shape = x.shape
+        cond.stop_gradient = True
+        return cond
+
+    layer.__name__ = op_type
+    return layer
+
+
+equal = _compare("equal")
+not_equal = _compare("not_equal")
+less_than = _compare("less_than")
+less_equal = _compare("less_equal")
+greater_than = _compare("greater_than")
+greater_equal = _compare("greater_equal")
+
+
+def _logical(op_type, unary=False):
+    def layer(x, y=None, out=None, name=None):
+        helper = LayerHelper(op_type, **locals())
+        if out is None:
+            out = helper.create_variable_for_type_inference(dtype="bool")
+        inputs = {"X": [x]} if unary else {"X": [x], "Y": [y]}
+        helper.append_op(type=op_type, inputs=inputs, outputs={"Out": [out]})
+        out.shape = x.shape
+        out.stop_gradient = True
+        return out
+
+    layer.__name__ = op_type
+    return layer
+
+
+logical_and = _logical("logical_and")
+logical_or = _logical("logical_or")
+logical_xor = _logical("logical_xor")
+logical_not = _logical("logical_not", unary=True)
+
+
+def _reduce(op_type):
+    def layer(input, dim=None, keep_dim=False, name=None):
+        helper = LayerHelper(op_type, **locals())
+        out = helper.create_variable_for_type_inference(dtype=input.dtype)
+        if dim is None:
+            attrs = {"dim": [0], "keep_dim": keep_dim, "reduce_all": True}
+        else:
+            dims = dim if isinstance(dim, (list, tuple)) else [dim]
+            attrs = {"dim": list(dims), "keep_dim": keep_dim,
+                     "reduce_all": False}
+        helper.append_op(type=op_type, inputs={"X": [input]},
+                         outputs={"Out": [out]}, attrs=attrs)
+        if input.shape is not None:
+            if dim is None:
+                out.shape = (1,)
+            else:
+                dims = [d % len(input.shape)
+                        for d in (dim if isinstance(dim, (list, tuple)) else [dim])]
+                if keep_dim:
+                    out.shape = tuple(
+                        1 if i in dims else d for i, d in enumerate(input.shape)
+                    )
+                else:
+                    out.shape = tuple(
+                        d for i, d in enumerate(input.shape) if i not in dims
+                    ) or (1,)
+        return out
+
+    layer.__name__ = op_type
+    return layer
+
+
+reduce_sum = _reduce("reduce_sum")
+reduce_mean = _reduce("reduce_mean")
+reduce_max = _reduce("reduce_max")
+reduce_min = _reduce("reduce_min")
+reduce_prod = _reduce("reduce_prod")
+reduce_all = _reduce("reduce_all")
+reduce_any = _reduce("reduce_any")
+
+
+def cumsum(x, axis=None, exclusive=None, reverse=None):
+    helper = LayerHelper("cumsum", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    attrs = {}
+    if axis is not None:
+        attrs["axis"] = axis
+    if exclusive is not None:
+        attrs["exclusive"] = exclusive
+    if reverse is not None:
+        attrs["reverse"] = reverse
+    helper.append_op(type="cumsum", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs=attrs)
+    out.shape = x.shape
+    return out
+
+
+# ---------------------------------------------------------------------------
+# activations — generated wrappers
+# ---------------------------------------------------------------------------
+
+
+def _activation(op_type, **default_attrs):
+    def layer(x, name=None, **kwargs):
+        helper = LayerHelper(op_type, **locals())
+        out = helper.create_variable_for_type_inference(dtype=x.dtype)
+        attrs = dict(default_attrs)
+        for k, v in kwargs.items():
+            if v is not None:
+                attrs[k] = v
+        helper.append_op(type=op_type, inputs={"X": [x]},
+                         outputs={"Out": [out]}, attrs=attrs)
+        out.shape = x.shape
+        return out
+
+    layer.__name__ = op_type
+    return layer
+
+
+relu = _activation("relu")
+relu6 = _activation("relu6")
+sigmoid = _activation("sigmoid")
+logsigmoid = _activation("logsigmoid")
+tanh = _activation("tanh")
+tanh_shrink = _activation("tanh_shrink")
+softplus = _activation("softplus")
+softsign = _activation("softsign")
+softshrink = _activation("softshrink")
+hard_shrink = _activation("hard_shrink")
+hard_sigmoid = _activation("hard_sigmoid")
+elu = _activation("elu")
+selu = _activation("selu")
+leaky_relu = _activation("leaky_relu")
+brelu = _activation("brelu")
+soft_relu = _activation("soft_relu")
+swish = _activation("swish")
+thresholded_relu = _activation("thresholded_relu")
+stanh = _activation("stanh")
+exp = _activation("exp")
+log = _activation("log")
+sqrt = _activation("sqrt")
+rsqrt = _activation("rsqrt")
+square = _activation("square")
+reciprocal = _activation("reciprocal")
+abs = _activation("abs")
+ceil = _activation("ceil")
+floor = _activation("floor")
+round = _activation("round")
+cos = _activation("cos")
+sin = _activation("sin")
+acos = _activation("acos")
+asin = _activation("asin")
+atan = _activation("atan")
+gelu = _activation("gelu")
+
+
+def pow(x, factor=1.0, name=None):
+    helper = LayerHelper("pow", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="pow", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"factor": float(factor)})
+    out.shape = x.shape
+    return out
+
+
+def sign(x):
+    helper = LayerHelper("sign", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="sign", inputs={"X": [x]}, outputs={"Out": [out]})
+    out.shape = x.shape
+    return out
+
+
+def prelu(x, mode, param_attr=None, name=None):
+    helper = LayerHelper("prelu", **locals())
+    if mode == "all":
+        alpha_shape = [1]
+    elif mode == "channel":
+        alpha_shape = [1, x.shape[1], 1, 1]
+    else:
+        alpha_shape = [1] + list(x.shape[1:])
+    alpha = helper.create_parameter(
+        attr=helper.param_attr, shape=alpha_shape, dtype=x.dtype,
+        default_initializer=Constant(0.25))
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="prelu", inputs={"X": [x], "Alpha": [alpha]},
+                     outputs={"Out": [out]}, attrs={"mode": mode})
+    out.shape = x.shape
+    return out
+
+
+# ---------------------------------------------------------------------------
+# losses beyond cross_entropy
+# ---------------------------------------------------------------------------
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    helper = LayerHelper("softmax_with_cross_entropy", **locals())
+    softmax_out = helper.create_variable_for_type_inference(dtype=logits.dtype)
+    loss = helper.create_variable_for_type_inference(dtype=logits.dtype)
+    helper.append_op(
+        type="softmax_with_cross_entropy",
+        inputs={"Logits": [logits], "Label": [label]},
+        outputs={"Softmax": [softmax_out], "Loss": [loss]},
+        attrs={"soft_label": soft_label, "ignore_index": ignore_index,
+               "axis": axis},
+    )
+    if logits.shape is not None:
+        s = list(logits.shape)
+        s[axis % len(s)] = 1
+        loss.shape = tuple(s)
+        softmax_out.shape = logits.shape
+    if return_softmax:
+        return loss, softmax_out
+    return loss
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100, name=None,
+                                      normalize=False):
+    helper = LayerHelper("sigmoid_cross_entropy_with_logits", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="sigmoid_cross_entropy_with_logits",
+        inputs={"X": [x], "Label": [label]}, outputs={"Out": [out]},
+        attrs={"ignore_index": ignore_index, "normalize": normalize},
+    )
+    out.shape = x.shape
+    return out
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    helper = LayerHelper("smooth_l1_loss", **locals())
+    diff = helper.create_variable_for_type_inference(dtype=x.dtype)
+    loss = helper.create_variable_for_type_inference(dtype=x.dtype)
+    inputs = {"X": [x], "Y": [y]}
+    if inside_weight is not None:
+        inputs["InsideWeight"] = [inside_weight]
+    if outside_weight is not None:
+        inputs["OutsideWeight"] = [outside_weight]
+    helper.append_op(
+        type="smooth_l1_loss", inputs=inputs,
+        outputs={"Diff": [diff], "Out": [loss]},
+        attrs={"sigma": sigma if sigma is not None else 1.0},
+    )
+    loss.shape = (x.shape[0] if x.shape else -1, 1)
+    return loss
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    helper = LayerHelper("log_loss", **locals())
+    loss = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type="log_loss",
+                     inputs={"Predicted": [input], "Labels": [label]},
+                     outputs={"Loss": [loss]}, attrs={"epsilon": epsilon})
+    loss.shape = input.shape
+    return loss
+
+
+def huber_loss(input, label, delta):
+    helper = LayerHelper("huber_loss", **locals())
+    residual = helper.create_variable_for_type_inference(dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type="huber_loss",
+                     inputs={"X": [input], "Y": [label]},
+                     outputs={"Out": [out], "Residual": [residual]},
+                     attrs={"delta": delta})
+    out.shape = input.shape
+    return out
+
+
+def kldiv_loss(x, target, reduction="mean", name=None):
+    helper = LayerHelper("kldiv_loss", **locals())
+    loss = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="kldiv_loss",
+                     inputs={"X": [x], "Target": [target]},
+                     outputs={"Loss": [loss]}, attrs={"reduction": reduction})
+    loss.shape = (1,) if reduction != "none" else x.shape
+    return loss
+
+
+def hinge_loss(input, label, name=None):
+    helper = LayerHelper("hinge_loss", **locals())
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type="hinge_loss",
+                     inputs={"Logits": [input], "Labels": [label]},
+                     outputs={"Loss": [out]})
+    out.shape = input.shape
+    return out
+
+
+def rank_loss(label, left, right, name=None):
+    helper = LayerHelper("rank_loss", **locals())
+    out = helper.create_variable_for_type_inference(dtype=left.dtype)
+    helper.append_op(type="rank_loss",
+                     inputs={"Label": [label], "Left": [left],
+                             "Right": [right]},
+                     outputs={"Out": [out]})
+    out.shape = left.shape
+    return out
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    helper = LayerHelper("margin_rank_loss", **locals())
+    out = helper.create_variable_for_type_inference(dtype=left.dtype)
+    act = helper.create_variable_for_type_inference(dtype=left.dtype, stop_gradient=True)
+    helper.append_op(type="margin_rank_loss",
+                     inputs={"Label": [label], "X1": [left], "X2": [right]},
+                     outputs={"Out": [out], "Activated": [act]},
+                     attrs={"margin": margin})
+    out.shape = left.shape
+    return out
+
+
+def bpr_loss(input, label, name=None):
+    helper = LayerHelper("bpr_loss", **locals())
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type="bpr_loss", inputs={"X": [input], "Label": [label]},
+                     outputs={"Y": [out]})
+    out.shape = (input.shape[0] if input.shape else -1, 1)
+    return out
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    helper = LayerHelper("npair_loss", **locals())
+    out = helper.create_variable_for_type_inference(dtype=anchor.dtype)
+    helper.append_op(
+        type="npair_loss",
+        inputs={"Anchor": [anchor], "Positive": [positive],
+                "Labels": [labels]},
+        outputs={"Out": [out]}, attrs={"l2_reg": l2_reg},
+    )
+    out.shape = (1,)
+    return out
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    label = one_hot(label, depth=input.shape[-1])
+    reduce_dim = list(range(1, len(input.shape)))
+    inse = reduce_sum(input * label, dim=reduce_dim)
+    dice_denominator = reduce_sum(input, dim=reduce_dim) + reduce_sum(
+        label, dim=reduce_dim)
+    dice_score = 1 - inse * 2 / (dice_denominator + epsilon)
+    return reduce_mean(dice_score)
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    helper = LayerHelper("teacher_student_sigmoid_loss", **locals())
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="teacher_student_sigmoid_loss",
+        inputs={"X": [input], "Label": [label]}, outputs={"Y": [out]},
+        attrs={"soft_max_up_bound": soft_max_up_bound,
+               "soft_max_lower_bound": soft_max_lower_bound},
+    )
+    out.shape = input.shape
+    return out
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples,
+                                       num_true=1, remove_accidental_hits=True,
+                                       use_customized_samples=False,
+                                       customized_samples=None,
+                                       customized_probabilities=None,
+                                       seed=0):
+    """Full-softmax fallback: on TPU the full softmax over the MXU is
+    usually faster than sampling's gather/scatter chains."""
+    return softmax_with_cross_entropy(logits, label)
+
+
+# ---------------------------------------------------------------------------
+# conv / pool (ops registered in ops/conv.py)
+# ---------------------------------------------------------------------------
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v] * n
+
+
+def _conv_out_dim(d, k, pad, stride, dilation=1):
+    if d == -1:
+        return -1
+    ke = dilation * (k - 1) + 1
+    return (d + 2 * pad - ke) // stride + 1
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None):
+    helper = LayerHelper("conv2d", **locals())
+    dtype = input.dtype
+    groups = groups or 1
+    c_in = input.shape[1]
+    fsize = _pair(filter_size)
+    stride = _pair(stride)
+    padding = _pair(padding)
+    dilation = _pair(dilation)
+    filter_shape = [num_filters, c_in // groups] + fsize
+    std = (2.0 / (fsize[0] * fsize[1] * c_in)) ** 0.5
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=filter_shape, dtype=dtype,
+        default_initializer=Normal(0.0, std))
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="conv2d",
+        inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={"strides": stride, "paddings": padding, "dilations": dilation,
+               "groups": groups},
+    )
+    if input.shape is not None:
+        n, _, h, wd = input.shape
+        out.shape = (
+            n, num_filters,
+            _conv_out_dim(h, fsize[0], padding[0], stride[0], dilation[0]),
+            _conv_out_dim(wd, fsize[1], padding[1], stride[1], dilation[1]),
+        )
+    pre_act = helper.append_bias_op(out, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None):
+    helper = LayerHelper("conv3d", **locals())
+    dtype = input.dtype
+    groups = groups or 1
+    c_in = input.shape[1]
+    fsize = _pair(filter_size, 3)
+    stride = _pair(stride, 3)
+    padding = _pair(padding, 3)
+    dilation = _pair(dilation, 3)
+    filter_shape = [num_filters, c_in // groups] + fsize
+    std = (2.0 / (int(np.prod(fsize)) * c_in)) ** 0.5
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=filter_shape, dtype=dtype,
+        default_initializer=Normal(0.0, std))
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="conv3d", inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={"strides": stride, "paddings": padding, "dilations": dilation,
+               "groups": groups},
+    )
+    if input.shape is not None:
+        n, _, d, h, wd = input.shape
+        out.shape = (
+            n, num_filters,
+            _conv_out_dim(d, fsize[0], padding[0], stride[0], dilation[0]),
+            _conv_out_dim(h, fsize[1], padding[1], stride[1], dilation[1]),
+            _conv_out_dim(wd, fsize[2], padding[2], stride[2], dilation[2]),
+        )
+    pre_act = helper.append_bias_op(out, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None):
+    helper = LayerHelper("conv2d_transpose", **locals())
+    dtype = input.dtype
+    groups = groups or 1
+    c_in = input.shape[1]
+    stride = _pair(stride)
+    padding = _pair(padding)
+    dilation = _pair(dilation)
+    if filter_size is None:
+        if output_size is None:
+            raise ValueError("output_size or filter_size required")
+        output_size = _pair(output_size)
+        h, wd = input.shape[2], input.shape[3]
+        filter_size = [
+            output_size[0] - (h - 1) * stride[0] + 2 * padding[0],
+            output_size[1] - (wd - 1) * stride[1] + 2 * padding[1],
+        ]
+    else:
+        filter_size = _pair(filter_size)
+    w = helper.create_parameter(
+        attr=helper.param_attr,
+        shape=[c_in, num_filters // groups] + filter_size, dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="conv2d_transpose",
+        inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={"strides": stride, "paddings": padding, "dilations": dilation,
+               "groups": groups},
+    )
+    if input.shape is not None:
+        n, _, h, wd = input.shape
+        oh = (h - 1) * stride[0] - 2 * padding[0] + dilation[0] * (
+            filter_size[0] - 1) + 1 if h != -1 else -1
+        ow = (wd - 1) * stride[1] - 2 * padding[1] + dilation[1] * (
+            filter_size[1] - 1) + 1 if wd != -1 else -1
+        out.shape = (n, num_filters, oh, ow)
+    pre_act = helper.append_bias_op(out, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None):
+    helper = LayerHelper("conv3d_transpose", **locals())
+    dtype = input.dtype
+    groups = groups or 1
+    c_in = input.shape[1]
+    stride = _pair(stride, 3)
+    padding = _pair(padding, 3)
+    dilation = _pair(dilation, 3)
+    filter_size = _pair(filter_size, 3)
+    w = helper.create_parameter(
+        attr=helper.param_attr,
+        shape=[c_in, num_filters // groups] + filter_size, dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="conv3d_transpose",
+        inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={"strides": stride, "paddings": padding, "dilations": dilation,
+               "groups": groups},
+    )
+    pre_act = helper.append_bias_op(out, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True):
+    helper = LayerHelper("pool2d", **locals())
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    pool_size = _pair(pool_size)
+    pool_stride = _pair(pool_stride)
+    pool_padding = _pair(pool_padding)
+    helper.append_op(
+        type="pool2d", inputs={"X": [input]}, outputs={"Out": [out]},
+        attrs={"pooling_type": pool_type, "ksize": pool_size,
+               "strides": pool_stride, "paddings": pool_padding,
+               "global_pooling": global_pooling, "ceil_mode": ceil_mode,
+               "exclusive": exclusive},
+    )
+    if input.shape is not None:
+        n, c, h, w = input.shape
+        if global_pooling:
+            out.shape = (n, c, 1, 1)
+        else:
+            def od(d, k, p, s):
+                if d == -1:
+                    return -1
+                if ceil_mode:
+                    return (d - k + 2 * p + s - 1) // s + 1
+                return (d - k + 2 * p) // s + 1
+
+            out.shape = (n, c,
+                         od(h, pool_size[0], pool_padding[0], pool_stride[0]),
+                         od(w, pool_size[1], pool_padding[1], pool_stride[1]))
+    return out
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True):
+    helper = LayerHelper("pool3d", **locals())
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="pool3d", inputs={"X": [input]}, outputs={"Out": [out]},
+        attrs={"pooling_type": pool_type, "ksize": _pair(pool_size, 3),
+               "strides": _pair(pool_stride, 3),
+               "paddings": _pair(pool_padding, 3),
+               "global_pooling": global_pooling, "ceil_mode": ceil_mode,
+               "exclusive": exclusive},
+    )
+    return out
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    helper = LayerHelper("adaptive_pool2d", **locals())
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="adaptive_pool2d", inputs={"X": [input]}, outputs={"Out": [out]},
+        attrs={"pooling_type": pool_type, "ksize": _pair(pool_size)},
+    )
+    if input.shape is not None:
+        n, c = input.shape[:2]
+        ps = _pair(pool_size)
+        out.shape = (n, c, ps[0], ps[1])
+    return out
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    helper = LayerHelper("adaptive_pool3d", **locals())
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="adaptive_pool3d", inputs={"X": [input]}, outputs={"Out": [out]},
+        attrs={"pooling_type": pool_type, "ksize": _pair(pool_size, 3)},
+    )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# misc vision / structure ops
+# ---------------------------------------------------------------------------
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
+    helper = LayerHelper("lrn", **locals())
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    mid = helper.create_variable_for_type_inference(dtype=input.dtype, stop_gradient=True)
+    helper.append_op(type="lrn", inputs={"X": [input]},
+                     outputs={"Out": [out], "MidOut": [mid]},
+                     attrs={"n": n, "k": k, "alpha": alpha, "beta": beta})
+    out.shape = input.shape
+    return out
+
+
+def maxout(x, groups, name=None):
+    helper = LayerHelper("maxout", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="maxout", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"groups": groups})
+    if x.shape is not None:
+        n, c, h, w = x.shape
+        out.shape = (n, c // groups, h, w)
+    return out
+
+
+def pixel_shuffle(x, upscale_factor):
+    helper = LayerHelper("pixel_shuffle", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="pixel_shuffle", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"upscale_factor": upscale_factor})
+    if x.shape is not None:
+        n, c, h, w = x.shape
+        r = upscale_factor
+        out.shape = (n, c // (r * r), h * r, w * r)
+    return out
+
+
+def space_to_depth(x, blocksize, name=None):
+    helper = LayerHelper("space_to_depth", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="space_to_depth", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"blocksize": blocksize})
+    if x.shape is not None:
+        n, c, h, w = x.shape
+        b = blocksize
+        out.shape = (n, c * b * b, h // b, w // b)
+    return out
+
+
+def shuffle_channel(x, group, name=None):
+    helper = LayerHelper("shuffle_channel", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="shuffle_channel", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"group": group})
+    out.shape = x.shape
+    return out
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    helper = LayerHelper("temporal_shift", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="temporal_shift", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"seg_num": seg_num, "shift_ratio": shift_ratio})
+    out.shape = x.shape
+    return out
+
+
+def add_position_encoding(input, alpha, beta, name=None):
+    helper = LayerHelper("add_position_encoding", **locals())
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type="add_position_encoding", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"alpha": alpha, "beta": beta})
+    out.shape = input.shape
+    return out
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None, param_attr=None,
+                            bias_attr=None):
+    helper = LayerHelper("bilinear_tensor_product", **locals())
+    dtype = x.dtype
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=[size, x.shape[1], y.shape[1]],
+        dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    inputs = {"X": [x], "Y": [y], "Weight": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(attr=helper.bias_attr, shape=[1, size],
+                                    dtype=dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    helper.append_op(type="bilinear_tensor_product", inputs=inputs,
+                     outputs={"Out": [out]})
+    out.shape = (x.shape[0], size)
+    return helper.append_activation(out)
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW", name=None):
+    helper = LayerHelper("affine_channel", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="affine_channel",
+                     inputs={"X": [x], "Scale": [scale], "Bias": [bias]},
+                     outputs={"Out": [out]},
+                     attrs={"data_layout": data_layout})
+    out.shape = x.shape
+    return out
+
+
+def affine_grid(theta, out_shape, name=None):
+    helper = LayerHelper("affine_grid", **locals())
+    out = helper.create_variable_for_type_inference(dtype=theta.dtype)
+    attrs = {"output_shape": list(out_shape) if not isinstance(
+        out_shape, Variable) else []}
+    helper.append_op(type="affine_grid", inputs={"Theta": [theta]},
+                     outputs={"Output": [out]}, attrs=attrs)
+    return out
+
+
+def grid_sampler(x, grid, name=None):
+    helper = LayerHelper("grid_sampler", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="grid_sampler",
+                     inputs={"X": [x], "Grid": [grid]},
+                     outputs={"Output": [out]})
+    out.shape = x.shape
+    return out
+
+
+def cos_sim(X, Y):
+    helper = LayerHelper("cos_sim", **locals())
+    out = helper.create_variable_for_type_inference(dtype=X.dtype)
+    xnorm = helper.create_variable_for_type_inference(dtype=X.dtype, stop_gradient=True)
+    ynorm = helper.create_variable_for_type_inference(dtype=X.dtype, stop_gradient=True)
+    helper.append_op(type="cos_sim", inputs={"X": [X], "Y": [Y]},
+                     outputs={"Out": [out], "XNorm": [xnorm],
+                              "YNorm": [ynorm]})
+    out.shape = (X.shape[0], 1)
+    return out
+
+
+def sums(input, out=None):
+    return tensor_layers.sums(input, out)
+
+
+def sum(x):
+    helper = LayerHelper("sum", **locals())
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    out = helper.create_variable_for_type_inference(dtype=xs[0].dtype)
+    helper.append_op(type="sum", inputs={"X": xs}, outputs={"Out": [out]})
+    out.shape = xs[0].shape
+    return out
+
+
+def cast(x, dtype):
+    return tensor_layers.cast(x, dtype)
+
+
+def l1_norm(x):
+    helper = LayerHelper("l1_norm", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="l1_norm", inputs={"X": [x]}, outputs={"Out": [out]})
+    out.shape = (1,)
+    return out
+
+
+def shape(input):
+    helper = LayerHelper("shape", **locals())
+    out = helper.create_variable_for_type_inference(dtype="int32")
+    helper.append_op(type="shape", inputs={"X": [input]},
+                     outputs={"Out": [out]})
+    out.shape = (len(input.shape),)
+    out.stop_gradient = True
+    return out
+
+
+def where(condition):
+    helper = LayerHelper("where", **locals())
+    out = helper.create_variable_for_type_inference(dtype="int64")
+    helper.append_op(type="where", inputs={"Condition": [condition]},
+                     outputs={"Out": [out]})
+    out.stop_gradient = True
+    return out
+
+
+def multiplex(inputs, index):
+    helper = LayerHelper("multiplex", **locals())
+    out = helper.create_variable_for_type_inference(dtype=inputs[0].dtype)
+    helper.append_op(type="multiplex",
+                     inputs={"X": inputs, "Ids": [index]},
+                     outputs={"Out": [out]})
+    out.shape = inputs[0].shape
+    return out
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0):
+    helper = LayerHelper("uniform_random", **locals())
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="uniform_random", outputs={"Out": [out]},
+                     attrs={"shape": list(shape), "dtype": dtype,
+                            "min": min, "max": max, "seed": seed})
+    out.shape = tuple(shape)
+    out.stop_gradient = True
+    return out
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32"):
+    helper = LayerHelper("gaussian_random", **locals())
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="gaussian_random", outputs={"Out": [out]},
+                     attrs={"shape": list(shape), "dtype": dtype,
+                            "mean": mean, "std": std, "seed": seed})
+    out.shape = tuple(shape)
+    out.stop_gradient = True
+    return out
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32",
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):
+    helper = LayerHelper("uniform_random_batch_size_like", **locals())
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="uniform_random_batch_size_like", inputs={"Input": [input]},
+        outputs={"Out": [out]},
+        attrs={"shape": list(shape), "dtype": dtype,
+               "input_dim_idx": input_dim_idx,
+               "output_dim_idx": output_dim_idx, "min": min, "max": max,
+               "seed": seed},
+    )
+    out.stop_gradient = True
+    return out
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype="float32"):
+    helper = LayerHelper("gaussian_random_batch_size_like", **locals())
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="gaussian_random_batch_size_like", inputs={"Input": [input]},
+        outputs={"Out": [out]},
+        attrs={"shape": list(shape), "dtype": dtype,
+               "input_dim_idx": input_dim_idx,
+               "output_dim_idx": output_dim_idx, "mean": mean, "std": std,
+               "seed": seed},
+    )
+    out.stop_gradient = True
+    return out
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32"):
+    helper = LayerHelper("sampling_id", **locals())
+    out = helper.create_variable_for_type_inference(dtype="int64")
+    helper.append_op(type="sampling_id", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"seed": seed})
+    out.shape = (x.shape[0],)
+    out.stop_gradient = True
+    return out
+
+
+def random_crop(x, shape, seed=None):
+    helper = LayerHelper("random_crop", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="random_crop", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"shape": list(shape), "seed": seed or 0})
+    out.shape = (x.shape[0],) + tuple(shape)
+    return out
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    helper = LayerHelper("similarity_focus", **locals())
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type="similarity_focus", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"axis": axis, "indexes": list(indexes)})
+    out.shape = input.shape
+    return out
+
+
+def mean_iou(input, label, num_classes):
+    helper = LayerHelper("mean_iou", **locals())
+    out_mean_iou = helper.create_variable_for_type_inference(dtype="float32")
+    out_wrong = helper.create_variable_for_type_inference(dtype="int32")
+    out_correct = helper.create_variable_for_type_inference(dtype="int32")
+    helper.append_op(
+        type="mean_iou", inputs={"Predictions": [input], "Labels": [label]},
+        outputs={"OutMeanIou": [out_mean_iou], "OutWrong": [out_wrong],
+                 "OutCorrect": [out_correct]},
+        attrs={"num_classes": num_classes},
+    )
+    return out_mean_iou, out_wrong, out_correct
+
+
+def diag(diagonal):
+    return tensor_layers.diag(diagonal)
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None,
+                out_stride=1, name=None):
+    helper = LayerHelper("im2sequence", **locals())
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="im2sequence", inputs={"X": [input]}, outputs={"Out": [out]},
+        attrs={"kernels": _pair(filter_size), "strides": _pair(stride),
+               "paddings": _pair(padding, 4)},
+    )
+    return out
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    helper = LayerHelper("unfold", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="unfold", inputs={"X": [x]}, outputs={"Y": [out]},
+        attrs={"kernel_sizes": _pair(kernel_sizes),
+               "strides": _pair(strides), "paddings": _pair(paddings, 4),
+               "dilations": _pair(dilations)},
+    )
+    return out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    helper = LayerHelper("spectral_norm", **locals())
+    dtype = weight.dtype
+    h = weight.shape[dim]
+    w = int(np.prod(weight.shape)) // h
+    u = helper.create_parameter(attr=ParamAttr(initializer=Normal(0.0, 1.0),
+                                               trainable=False),
+                                shape=[h], dtype=dtype)
+    v = helper.create_parameter(attr=ParamAttr(initializer=Normal(0.0, 1.0),
+                                               trainable=False),
+                                shape=[w], dtype=dtype)
+    u.stop_gradient = True
+    v.stop_gradient = True
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="spectral_norm",
+        inputs={"Weight": [weight], "U": [u], "V": [v]},
+        outputs={"Out": [out]},
+        attrs={"dim": dim, "power_iters": power_iters, "eps": eps},
+    )
+    out.shape = weight.shape
+    return out
+
+
+def image_resize(input, out_shape=None, scale=None, name=None,
+                 resample="BILINEAR", actual_shape=None, align_corners=True,
+                 align_mode=1):
+    helper = LayerHelper("image_resize", **locals())
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    if out_shape is None:
+        h = int(input.shape[2] * scale)
+        w = int(input.shape[3] * scale)
+        out_shape = [h, w]
+    op_type = "bilinear_interp" if resample == "BILINEAR" else "nearest_interp"
+    helper.append_op(
+        type=op_type, inputs={"X": [input]}, outputs={"Out": [out]},
+        attrs={"out_h": out_shape[0], "out_w": out_shape[1],
+               "align_corners": align_corners, "align_mode": align_mode},
+    )
+    if input.shape is not None:
+        out.shape = (input.shape[0], input.shape[1], out_shape[0], out_shape[1])
+    return out
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    actual_shape=None, align_corners=True, align_mode=1):
+    return image_resize(input, out_shape, scale, name, "BILINEAR",
+                        actual_shape, align_corners, align_mode)
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None,
+                   actual_shape=None, align_corners=True):
+    return image_resize(input, out_shape, scale, name, "NEAREST",
+                        actual_shape, align_corners)
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    h, w = input.shape[2], input.shape[3]
+    if h < w:
+        oh, ow = out_short_len, int(w * out_short_len / h)
+    else:
+        oh, ow = int(h * out_short_len / w), out_short_len
+    return image_resize(input, [oh, ow], resample=resample)

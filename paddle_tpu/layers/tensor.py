@@ -1,0 +1,295 @@
+"""Tensor layers (parity: python/paddle/fluid/layers/tensor.py)."""
+
+import numpy as np
+
+from ..framework import Variable, convert_dtype
+from ..layer_helper import LayerHelper
+from ..initializer import Constant
+
+__all__ = [
+    "create_tensor",
+    "create_parameter",
+    "create_global_var",
+    "cast",
+    "concat",
+    "sums",
+    "assign",
+    "fill_constant",
+    "fill_constant_batch_size_like",
+    "ones",
+    "zeros",
+    "zeros_like",
+    "reverse",
+    "has_inf",
+    "has_nan",
+    "isfinite",
+    "range",
+    "linspace",
+    "diag",
+    "argmin",
+    "argmax",
+    "argsort",
+]
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    helper = LayerHelper("create_tensor", name=name)
+    return helper.create_variable(
+        name=helper.name, dtype=dtype, persistable=persistable
+    )
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..param_attr import ParamAttr
+
+    helper = LayerHelper("create_parameter", **locals())
+    attr = attr or ParamAttr(name=name)
+    return helper.create_parameter(attr, shape, dtype, is_bias,
+                                   default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    helper = LayerHelper("global_var", name=name)
+    var = helper.create_global_variable(
+        dtype=dtype, shape=tuple(shape), persistable=persistable,
+        name=name, stop_gradient=True,
+    )
+    helper.set_variable_initializer(var, Constant(value=float(value)))
+    return var
+
+
+def cast(x, dtype):
+    helper = LayerHelper("cast", **locals())
+    dtype = convert_dtype(dtype)
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(
+        type="cast", inputs={"X": [x]}, outputs={"Out": [out]},
+        attrs={"in_dtype": x.dtype, "out_dtype": dtype},
+    )
+    out.shape = x.shape
+    return out
+
+
+def concat(input, axis=0, name=None):
+    helper = LayerHelper("concat", **locals())
+    out = helper.create_variable_for_type_inference(dtype=helper.input_dtype())
+    helper.append_op(
+        type="concat", inputs={"X": input}, outputs={"Out": [out]},
+        attrs={"axis": axis},
+    )
+    shapes = [v.shape for v in input]
+    if all(s is not None for s in shapes):
+        ax = axis % len(shapes[0])
+        dim = 0
+        for s in shapes:
+            if s[ax] == -1:
+                dim = -1
+                break
+            dim += s[ax]
+        out.shape = tuple(
+            dim if i == ax else shapes[0][i] for i in range(len(shapes[0]))
+        )
+    return out
+
+
+def sums(input, out=None):
+    helper = LayerHelper("sum", **locals())
+    if out is None:
+        out = helper.create_variable_for_type_inference(
+            dtype=helper.input_dtype()
+        )
+    helper.append_op(type="sum", inputs={"X": input}, outputs={"Out": [out]})
+    out.shape = input[0].shape
+    return out
+
+
+def assign(input, output=None):
+    helper = LayerHelper("assign", **locals())
+    if isinstance(input, Variable):
+        if output is None:
+            output = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op(type="assign", inputs={"X": [input]},
+                         outputs={"Out": [output]})
+        output.shape = input.shape
+    else:
+        arr = np.asarray(input)
+        if output is None:
+            output = helper.create_variable_for_type_inference(str(arr.dtype))
+        helper.append_op(
+            type="assign_value", outputs={"Out": [output]},
+            attrs={"shape": list(arr.shape), "dtype": str(arr.dtype),
+                   "values": arr.tolist()},
+        )
+        output.shape = tuple(arr.shape)
+    return output
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None):
+    helper = LayerHelper("fill_constant")
+    dtype = convert_dtype(dtype)
+    if out is None:
+        out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(
+        type="fill_constant", outputs={"Out": [out]},
+        attrs={"shape": list(shape), "dtype": dtype, "value": float(value)},
+    )
+    out.shape = tuple(shape)
+    out.stop_gradient = True
+    return out
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0):
+    helper = LayerHelper("fill_constant_batch_size_like")
+    dtype = convert_dtype(dtype)
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(
+        type="fill_constant_batch_size_like",
+        inputs={"Input": [input]},
+        outputs={"Out": [out]},
+        attrs={"shape": list(shape), "dtype": dtype, "value": float(value),
+               "input_dim_idx": input_dim_idx, "output_dim_idx": output_dim_idx},
+    )
+    s = list(shape)
+    s[output_dim_idx] = input.shape[input_dim_idx] if input.shape else -1
+    out.shape = tuple(s)
+    out.stop_gradient = True
+    return out
+
+
+def ones(shape, dtype, force_cpu=False):
+    return fill_constant(shape=shape, dtype=dtype, value=1.0)
+
+
+def zeros(shape, dtype, force_cpu=False):
+    return fill_constant(shape=shape, dtype=dtype, value=0.0)
+
+
+def zeros_like(x, out=None):
+    helper = LayerHelper("zeros_like")
+    if out is None:
+        out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="fill_zeros_like", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    out.shape = x.shape
+    return out
+
+
+def reverse(x, axis):
+    helper = LayerHelper("reverse", **locals())
+    if isinstance(axis, int):
+        axis = [axis]
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="reverse", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"axis": axis})
+    out.shape = x.shape
+    return out
+
+
+def has_inf(x):
+    helper = LayerHelper("isinf", **locals())
+    out = helper.create_variable_for_type_inference(dtype="bool")
+    helper.append_op(type="has_inf", inputs={"X": [x]}, outputs={"Out": [out]})
+    out.shape = (1,)
+    return out
+
+
+def has_nan(x):
+    helper = LayerHelper("isnan", **locals())
+    out = helper.create_variable_for_type_inference(dtype="bool")
+    helper.append_op(type="has_nan", inputs={"X": [x]}, outputs={"Out": [out]})
+    out.shape = (1,)
+    return out
+
+
+def isfinite(x):
+    helper = LayerHelper("isfinite", **locals())
+    out = helper.create_variable_for_type_inference(dtype="bool")
+    helper.append_op(type="isfinite", inputs={"X": [x]}, outputs={"Out": [out]})
+    out.shape = (1,)
+    return out
+
+
+def range(start, end, step, dtype):
+    helper = LayerHelper("range", **locals())
+    dtype = convert_dtype(dtype)
+    sv = [start if isinstance(start, Variable) else fill_constant([1], dtype, start),
+          end if isinstance(end, Variable) else fill_constant([1], dtype, end),
+          step if isinstance(step, Variable) else fill_constant([1], dtype, step)]
+    if not any(isinstance(v, Variable) for v in (start, end, step)):
+        n = int(np.ceil((end - start) / step))
+    else:
+        raise ValueError(
+            "range with Variable bounds needs static lengths on XLA; pass "
+            "python numbers"
+        )
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(
+        type="range",
+        inputs={"Start": [sv[0]], "End": [sv[1]], "Step": [sv[2]]},
+        outputs={"Out": [out]},
+        attrs={"__static_len__": n},
+    )
+    out.shape = (n,)
+    return out
+
+
+def linspace(start, stop, num, dtype):
+    helper = LayerHelper("linspace", **locals())
+    dtype = convert_dtype(dtype)
+    sv = start if isinstance(start, Variable) else fill_constant([1], dtype, start)
+    ev = stop if isinstance(stop, Variable) else fill_constant([1], dtype, stop)
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(
+        type="linspace", inputs={"Start": [sv], "Stop": [ev]},
+        outputs={"Out": [out]},
+        attrs={"__static_num__": int(num), "dtype": dtype},
+    )
+    out.shape = (int(num),)
+    return out
+
+
+def diag(diagonal):
+    helper = LayerHelper("diag", **locals())
+    out = helper.create_variable_for_type_inference(dtype=diagonal.dtype)
+    helper.append_op(type="diag", inputs={"X": [diagonal]},
+                     outputs={"Out": [out]})
+    n = diagonal.shape[0] if diagonal.shape else -1
+    out.shape = (n, n)
+    return out
+
+
+def _arg_minmax(x, axis, op):
+    helper = LayerHelper(op)
+    out = helper.create_variable_for_type_inference(dtype="int64")
+    helper.append_op(type=op, inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"axis": axis})
+    if x.shape is not None:
+        s = list(x.shape)
+        del s[axis % len(s)]
+        out.shape = tuple(s)
+    out.stop_gradient = True
+    return out
+
+
+def argmin(x, axis=0):
+    return _arg_minmax(x, axis, "argmin")
+
+
+def argmax(x, axis=0):
+    return _arg_minmax(x, axis, "argmax")
+
+
+def argsort(input, axis=-1, name=None):
+    helper = LayerHelper("argsort", **locals())
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    ids = helper.create_variable_for_type_inference(dtype="int64")
+    helper.append_op(
+        type="argsort", inputs={"X": [input]},
+        outputs={"Out": [out], "Indices": [ids]}, attrs={"axis": axis},
+    )
+    out.shape = input.shape
+    ids.shape = input.shape
+    return out, ids

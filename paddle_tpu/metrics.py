@@ -1,0 +1,200 @@
+"""Host-side metric accumulators (parity: python/paddle/fluid/metrics.py —
+Accuracy, Auc, ChunkEvaluator, CompositeMetric, DetectionMAP, EditDistance,
+Precision, Recall).
+"""
+
+import numpy as np
+
+__all__ = ["MetricBase", "CompositeMetric", "Precision", "Recall", "Accuracy",
+           "ChunkEvaluator", "EditDistance", "Auc", "DetectionMAP"]
+
+
+class MetricBase:
+    def __init__(self, name=None):
+        self._name = name or self.__class__.__name__
+
+    def reset(self):
+        for k in list(self.__dict__):
+            if not k.startswith("_"):
+                v = self.__dict__[k]
+                if isinstance(v, (int, float)):
+                    self.__dict__[k] = 0 if isinstance(v, int) else 0.0
+                elif isinstance(v, list):
+                    self.__dict__[k] = []
+
+    def get_config(self):
+        return {k: v for k, v in self.__dict__.items()
+                if not k.startswith("_")}
+
+    def update(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+
+class CompositeMetric(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._metrics = []
+
+    def add_metric(self, metric):
+        self._metrics.append(metric)
+
+    def update(self, preds, labels):
+        for m in self._metrics:
+            m.update(preds, labels)
+
+    def eval(self):
+        return [m.eval() for m in self._metrics]
+
+
+class Precision(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype(np.int64)
+        labels = np.asarray(labels).astype(np.int64)
+        for p, l in zip(preds.ravel(), labels.ravel()):
+            if p == 1:
+                if l == 1:
+                    self.tp += 1
+                else:
+                    self.fp += 1
+
+    def eval(self):
+        ap = self.tp + self.fp
+        return float(self.tp) / ap if ap else 0.0
+
+
+class Recall(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype(np.int64)
+        labels = np.asarray(labels).astype(np.int64)
+        for p, l in zip(preds.ravel(), labels.ravel()):
+            if l == 1:
+                if p == 1:
+                    self.tp += 1
+                else:
+                    self.fn += 1
+
+    def eval(self):
+        n = self.tp + self.fn
+        return float(self.tp) / n if n else 0.0
+
+
+class Accuracy(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight):
+        self.value += float(np.asarray(value).reshape(-1)[0]) * weight
+        self.weight += weight
+
+    def eval(self):
+        return self.value / self.weight if self.weight else 0.0
+
+
+class ChunkEvaluator(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks, num_correct_chunks):
+        self.num_infer_chunks += int(np.asarray(num_infer_chunks).reshape(-1)[0])
+        self.num_label_chunks += int(np.asarray(num_label_chunks).reshape(-1)[0])
+        self.num_correct_chunks += int(np.asarray(num_correct_chunks).reshape(-1)[0])
+
+    def eval(self):
+        precision = (self.num_correct_chunks / self.num_infer_chunks
+                     if self.num_infer_chunks else 0.0)
+        recall = (self.num_correct_chunks / self.num_label_chunks
+                  if self.num_label_chunks else 0.0)
+        f1 = (2 * precision * recall / (precision + recall)
+              if self.num_correct_chunks else 0.0)
+        return precision, recall, f1
+
+
+class EditDistance(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.total_distance = 0.0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    def update(self, distances, seq_num):
+        distances = np.asarray(distances)
+        self.total_distance += float(distances.sum())
+        self.seq_num += int(seq_num)
+        self.instance_error += int((distances > 0).sum())
+
+    def eval(self):
+        if self.seq_num == 0:
+            raise ValueError("no data updated")
+        return (self.total_distance / self.seq_num,
+                self.instance_error / self.seq_num)
+
+
+class Auc(MetricBase):
+    def __init__(self, name=None, curve="ROC", num_thresholds=4095):
+        super().__init__(name)
+        self._num_thresholds = num_thresholds
+        self._stat_pos = np.zeros(num_thresholds + 1)
+        self._stat_neg = np.zeros(num_thresholds + 1)
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        labels = np.asarray(labels).ravel()
+        pos_prob = preds[:, 1] if preds.ndim == 2 and preds.shape[1] == 2 \
+            else preds.ravel()
+        idx = np.minimum(
+            (pos_prob * self._num_thresholds).astype(np.int64),
+            self._num_thresholds)
+        for i, l in zip(idx, labels):
+            if l:
+                self._stat_pos[i] += 1
+            else:
+                self._stat_neg[i] += 1
+
+    def eval(self):
+        tot_pos = tot_neg = auc = 0.0
+        for i in range(self._num_thresholds, -1, -1):
+            p, n = self._stat_pos[i], self._stat_neg[i]
+            auc += n * tot_pos + p * n / 2.0
+            tot_pos += p
+            tot_neg += n
+        return auc / (tot_pos * tot_neg) if tot_pos and tot_neg else 0.0
+
+
+class DetectionMAP:
+    """In-graph detection mAP (parity: metrics.py DetectionMAP). Depends on
+    the detection op suite (layers/detection.py)."""
+
+    def __init__(self, input, gt_label, gt_box, gt_difficult=None,
+                 class_num=None, background_label=0, overlap_threshold=0.5,
+                 evaluate_difficult=True, ap_version="integral"):
+        from . import layers
+
+        self.helper_states = []
+        label = layers.concat([gt_label, gt_box], axis=1) \
+            if gt_difficult is None else layers.concat(
+                [gt_label, gt_difficult, gt_box], axis=1)
+        self.map = layers.detection.detection_map(
+            input, label, class_num, background_label,
+            overlap_threshold=overlap_threshold,
+            evaluate_difficult=evaluate_difficult, ap_version=ap_version)
+
+    def get_map_var(self):
+        return self.map

@@ -1,0 +1,13 @@
+"""TPU op corpus. Importing this package registers all op kernels
+(parity: the REGISTER_OPERATOR corpus, SURVEY §2.2 / Appendix A)."""
+
+from . import registry  # noqa: F401
+from . import math  # noqa: F401
+from . import elementwise  # noqa: F401
+from . import activations  # noqa: F401
+from . import reduce  # noqa: F401
+from . import tensor_ops  # noqa: F401
+from . import nn_ops  # noqa: F401
+from . import conv  # noqa: F401
+from . import loss_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401

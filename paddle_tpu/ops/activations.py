@@ -1,0 +1,76 @@
+"""Activation op family (parity: operators/activation_op.cc — the ~37
+activations registered via REGISTER_ACTIVATION_OP; SURVEY Appendix A list).
+
+All elementwise; XLA fuses them into producers/consumers so per-op kernels
+would be pure overhead — each is one jnp/lax expression.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import elementwise_unary, register
+
+
+def _a(name, fn, differentiable=True):
+    elementwise_unary(name, fn, differentiable=differentiable)
+
+
+_a("abs", lambda x, a: jnp.abs(x))
+_a("acos", lambda x, a: jnp.arccos(x))
+_a("asin", lambda x, a: jnp.arcsin(x))
+_a("atan", lambda x, a: jnp.arctan(x))
+_a("ceil", lambda x, a: jnp.ceil(x), differentiable=False)
+_a("floor", lambda x, a: jnp.floor(x), differentiable=False)
+_a("round", lambda x, a: jnp.round(x), differentiable=False)
+_a("cos", lambda x, a: jnp.cos(x))
+_a("sin", lambda x, a: jnp.sin(x))
+_a("exp", lambda x, a: jnp.exp(x))
+_a("log", lambda x, a: jnp.log(x))
+_a("sqrt", lambda x, a: jnp.sqrt(x))
+_a("rsqrt", lambda x, a: jax.lax.rsqrt(x))
+_a("square", lambda x, a: x * x)
+_a("reciprocal", lambda x, a: 1.0 / x)
+_a("sigmoid", lambda x, a: jax.nn.sigmoid(x))
+_a("logsigmoid", lambda x, a: jax.nn.log_sigmoid(x))
+_a("tanh", lambda x, a: jnp.tanh(x))
+_a("tanh_shrink", lambda x, a: x - jnp.tanh(x))
+_a("relu", lambda x, a: jax.nn.relu(x))
+_a("relu6", lambda x, a: jnp.clip(x, 0.0, a.get("threshold", 6.0)))
+_a("gelu", lambda x, a: jax.nn.gelu(x, approximate=False))
+_a("softplus", lambda x, a: jax.nn.softplus(x))
+_a("softsign", lambda x, a: jax.nn.soft_sign(x))
+_a("softshrink", lambda x, a: jnp.where(
+    x > a.get("lambda", 0.5), x - a.get("lambda", 0.5),
+    jnp.where(x < -a.get("lambda", 0.5), x + a.get("lambda", 0.5),
+              jnp.zeros_like(x))))
+_a("hard_shrink", lambda x, a: jnp.where(
+    jnp.abs(x) > a.get("threshold", 0.5), x, jnp.zeros_like(x)))
+_a("hard_sigmoid", lambda x, a: jnp.clip(
+    a.get("slope", 0.2) * x + a.get("offset", 0.5), 0.0, 1.0))
+_a("brelu", lambda x, a: jnp.clip(x, a.get("t_min", 0.0), a.get("t_max", 24.0)))
+_a("leaky_relu", lambda x, a: jnp.where(x >= 0, x, a.get("alpha", 0.02) * x))
+_a("elu", lambda x, a: jnp.where(
+    x > 0, x, a.get("alpha", 1.0) * (jnp.exp(x) - 1.0)))
+_a("selu", lambda x, a: a.get("scale", 1.0507009873554805) * jnp.where(
+    x > 0, x, a.get("alpha", 1.6732632423543772) * (jnp.exp(x) - 1.0)))
+_a("stanh", lambda x, a: a.get("scale_b", 1.7159) * jnp.tanh(
+    a.get("scale_a", 0.67) * x))
+_a("soft_relu", lambda x, a: jnp.log(
+    1.0 + jnp.exp(jnp.clip(x, -a.get("threshold", 40.0), a.get("threshold", 40.0)))))
+_a("swish", lambda x, a: x * jax.nn.sigmoid(a.get("beta", 1.0) * x))
+_a("thresholded_relu", lambda x, a: jnp.where(
+    x > a.get("threshold", 1.0), x, jnp.zeros_like(x)))
+_a("pow", lambda x, a: x ** a.get("factor", 1.0))
+
+
+@register("prelu")
+def _prelu(ctx, ins, attrs):
+    x, alpha = ins["X"][0], ins["Alpha"][0]
+    mode = attrs.get("mode", "all")
+    if mode == "all":
+        al = alpha.reshape(())
+    elif mode == "channel":
+        al = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    else:  # element
+        al = alpha.reshape((1,) + x.shape[1:])
+    return {"Out": [jnp.where(x >= 0, x, al * x)]}

@@ -1,0 +1,307 @@
+"""Convolution / pooling / interpolation ops (parity: operators/conv_op.cc,
+conv_cudnn_op.cu.cc, pool_op.cc, interpolate_op.cc, spectral_norm_op.cc).
+
+TPU-native: all convs lower to `lax.conv_general_dilated` which XLA maps onto
+the MXU (the cuDNN algo-search of the reference is subsumed by XLA autotuning,
+SURVEY §7 hard-parts note). NCHW layout is kept at the API for Fluid parity;
+XLA relayouts internally for the TPU.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+
+
+def _conv_nd(x, w, strides, paddings, dilations, groups, nd, transpose=False):
+    dn_str = {2: ("NCHW", "OIHW", "NCHW"), 3: ("NCDHW", "OIDHW", "NCDHW")}[nd]
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, dn_str)
+    pads = [(p, p) for p in paddings]
+    if not transpose:
+        return jax.lax.conv_general_dilated(
+            x, w, window_strides=strides, padding=pads,
+            rhs_dilation=dilations, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
+        ).astype(x.dtype)
+    # conv transpose: fractionally-strided conv. Fluid filter layout is
+    # [C_in, C_out/groups, *k]; flip spatial dims and swap io.
+    w_t = jnp.swapaxes(w, 0, 1)  # [C_out/groups, C_in, *k]
+    w_t = jnp.flip(w_t, axis=tuple(range(2, 2 + nd)))
+    k_eff = [d * (k - 1) + 1 for k, d in zip(w.shape[2:], dilations)]
+    pads_t = [(ke - 1 - p, ke - 1 - p) for ke, p in zip(k_eff, paddings)]
+    if groups > 1:
+        # grouped transpose: block-diagonal over groups
+        xs = jnp.split(x, groups, axis=1)
+        ws = jnp.split(w, groups, axis=0)
+        outs = []
+        for xg, wg in zip(xs, ws):
+            wg_t = jnp.flip(jnp.swapaxes(wg, 0, 1), axis=tuple(range(2, 2 + nd)))
+            outs.append(jax.lax.conv_general_dilated(
+                xg, wg_t, window_strides=(1,) * nd, padding=pads_t,
+                lhs_dilation=strides, rhs_dilation=dilations,
+                dimension_numbers=dn))
+        return jnp.concatenate(outs, axis=1)
+    return jax.lax.conv_general_dilated(
+        x, w_t, window_strides=(1,) * nd, padding=pads_t,
+        lhs_dilation=strides, rhs_dilation=dilations, dimension_numbers=dn,
+    ).astype(x.dtype)
+
+
+def _make_conv(name, nd, transpose=False):
+    def impl(ctx, ins, attrs):
+        x, w = ins["Input"][0], ins["Filter"][0]
+        out = _conv_nd(
+            x, w,
+            tuple(attrs.get("strides", [1] * nd)),
+            tuple(attrs.get("paddings", [0] * nd)),
+            tuple(attrs.get("dilations", [1] * nd)),
+            attrs.get("groups", 1) or 1, nd, transpose,
+        )
+        return {"Output": [out]}
+
+    register(name)(impl)
+
+
+_make_conv("conv2d", 2)
+_make_conv("conv3d", 3)
+_make_conv("depthwise_conv2d", 2)
+_make_conv("conv2d_transpose", 2, transpose=True)
+_make_conv("conv3d_transpose", 3, transpose=True)
+_make_conv("depthwise_conv2d_transpose", 2, transpose=True)
+
+
+def _pool_nd(x, attrs, nd):
+    ptype = attrs.get("pooling_type", "max")
+    ksize = list(attrs.get("ksize", [2] * nd))
+    strides = list(attrs.get("strides", [1] * nd))
+    paddings = list(attrs.get("paddings", [0] * nd))
+    exclusive = attrs.get("exclusive", True)
+    if attrs.get("global_pooling", False):
+        ksize = list(x.shape[2:])
+        strides = ksize
+        paddings = [0] * nd
+    window = (1, 1) + tuple(ksize)
+    strides_full = (1, 1) + tuple(strides)
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in paddings)
+    if ptype == "max":
+        init = -jnp.inf
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides_full,
+                                    pads)
+    else:
+        s = jax.lax.reduce_window(x, 0.0, jax.lax.add,
+                                  window, strides_full, pads)
+        if exclusive and any(p > 0 for p in paddings):
+            ones = jnp.ones_like(x)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                        strides_full, pads)
+            out = s / cnt
+        else:
+            out = s / float(np.prod(ksize))
+    return out
+
+
+@register("pool2d")
+def _pool2d(ctx, ins, attrs):
+    return {"Out": [_pool_nd(ins["X"][0], attrs, 2)]}
+
+
+@register("pool3d")
+def _pool3d(ctx, ins, attrs):
+    return {"Out": [_pool_nd(ins["X"][0], attrs, 3)]}
+
+
+def _adaptive_pool(x, out_sizes, ptype):
+    spatial = x.shape[2:]
+    # adaptive pooling with uniform windows when divisible (common case);
+    # falls back to mean/max over index buckets otherwise
+    if all(s % o == 0 for s, o in zip(spatial, out_sizes)):
+        ks = [s // o for s, o in zip(spatial, out_sizes)]
+        attrs = {"pooling_type": ptype, "ksize": ks, "strides": ks,
+                 "paddings": [0] * len(ks)}
+        return _pool_nd(x, attrs, len(ks))
+    # bucket-gather fallback (2-D only)
+    h, w = spatial
+    oh, ow = out_sizes
+    out_rows = []
+    for i in range(oh):
+        hs, he = (i * h) // oh, -(-((i + 1) * h) // oh)
+        row = []
+        for j in range(ow):
+            ws_, we = (j * w) // ow, -(-((j + 1) * w) // ow)
+            patch = x[:, :, hs:he, ws_:we]
+            if ptype == "max":
+                row.append(patch.max(axis=(2, 3)))
+            else:
+                row.append(patch.mean(axis=(2, 3)))
+        out_rows.append(jnp.stack(row, axis=-1))
+    return jnp.stack(out_rows, axis=-2)
+
+
+@register("adaptive_pool2d")
+def _adaptive_pool2d(ctx, ins, attrs):
+    return {"Out": [_adaptive_pool(ins["X"][0], attrs["ksize"],
+                                   attrs.get("pooling_type", "max"))]}
+
+
+@register("adaptive_pool3d")
+def _adaptive_pool3d(ctx, ins, attrs):
+    x = ins["X"][0]
+    ks = attrs["ksize"]
+    if all(s % o == 0 for s, o in zip(x.shape[2:], ks)):
+        kk = [s // o for s, o in zip(x.shape[2:], ks)]
+        a = {"pooling_type": attrs.get("pooling_type", "max"), "ksize": kk,
+             "strides": kk, "paddings": [0, 0, 0]}
+        return {"Out": [_pool_nd(x, a, 3)]}
+    raise NotImplementedError("non-divisible adaptive_pool3d")
+
+
+@register("max_pool2d_with_index")
+def _max_pool2d_with_index(ctx, ins, attrs):
+    x = ins["X"][0]
+    out = _pool_nd(x, {**attrs, "pooling_type": "max"}, 2)
+    return {"Out": [out], "Mask": [jnp.zeros_like(out, dtype=jnp.int32)]}
+
+
+def _resize_2d(x, oh, ow, method, align_corners):
+    n, c, h, w = x.shape
+    if method == "nearest":
+        if align_corners:
+            ys = jnp.round(jnp.linspace(0, h - 1, oh)).astype(jnp.int32)
+            xs = jnp.round(jnp.linspace(0, w - 1, ow)).astype(jnp.int32)
+        else:
+            ys = jnp.floor(jnp.arange(oh) * (h / oh)).astype(jnp.int32)
+            xs = jnp.floor(jnp.arange(ow) * (w / ow)).astype(jnp.int32)
+        return x[:, :, ys][:, :, :, xs]
+    # bilinear
+    if align_corners and oh > 1 and ow > 1:
+        fy = jnp.linspace(0.0, h - 1.0, oh)
+        fx = jnp.linspace(0.0, w - 1.0, ow)
+    else:
+        fy = (jnp.arange(oh) + 0.5) * (h / oh) - 0.5
+        fx = (jnp.arange(ow) + 0.5) * (w / ow) - 0.5
+    y0 = jnp.clip(jnp.floor(fy), 0, h - 1).astype(jnp.int32)
+    x0 = jnp.clip(jnp.floor(fx), 0, w - 1).astype(jnp.int32)
+    y1 = jnp.clip(y0 + 1, 0, h - 1)
+    x1 = jnp.clip(x0 + 1, 0, w - 1)
+    wy = jnp.clip(fy - y0, 0.0, 1.0)
+    wx = jnp.clip(fx - x0, 0.0, 1.0)
+    top = x[:, :, y0][:, :, :, x0] * (1 - wx) + x[:, :, y0][:, :, :, x1] * wx
+    bot = x[:, :, y1][:, :, :, x0] * (1 - wx) + x[:, :, y1][:, :, :, x1] * wx
+    return top * (1 - wy[:, None]) + bot * wy[:, None]
+
+
+@register("bilinear_interp")
+def _bilinear_interp(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [_resize_2d(x, attrs["out_h"], attrs["out_w"], "bilinear",
+                               attrs.get("align_corners", True))]}
+
+
+@register("nearest_interp")
+def _nearest_interp(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [_resize_2d(x, attrs["out_h"], attrs["out_w"], "nearest",
+                               attrs.get("align_corners", True))]}
+
+
+@register("spectral_norm")
+def _spectral_norm(ctx, ins, attrs):
+    w, u, v = ins["Weight"][0], ins["U"][0], ins["V"][0]
+    dim = attrs.get("dim", 0)
+    power_iters = attrs.get("power_iters", 1)
+    eps = attrs.get("eps", 1e-12)
+    if dim != 0:
+        perm = [dim] + [i for i in range(w.ndim) if i != dim]
+        wm = jnp.transpose(w, perm)
+    else:
+        wm = w
+    h = wm.shape[0]
+    mat = wm.reshape((h, -1))
+    for _ in range(power_iters):
+        v = mat.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = mat @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    sigma = u @ (mat @ v)
+    return {"Out": [w / sigma]}
+
+
+@register("random_crop", differentiable=False, stateful=True)
+def _random_crop(ctx, ins, attrs):
+    x = ins["X"][0]
+    shape = attrs["shape"]
+    key = ctx.rng(attrs)
+    nd = len(shape)
+    starts = []
+    for i, s in enumerate(shape):
+        d = x.shape[x.ndim - nd + i]
+        key, sub = jax.random.split(key)
+        starts.append(jax.random.randint(sub, (), 0, max(d - s + 1, 1)))
+    idx = [slice(None)] * (x.ndim - nd)
+    out = jax.lax.dynamic_slice(
+        x,
+        tuple([0] * (x.ndim - nd)) + tuple(starts),
+        tuple(x.shape[: x.ndim - nd]) + tuple(shape),
+    )
+    return {"Out": [out]}
+
+
+@register("im2sequence")
+def _im2sequence(ctx, ins, attrs):
+    x = ins["X"][0]
+    kh, kw = attrs["kernels"]
+    sh, sw = attrs.get("strides", [1, 1])
+    pt, pl, pb, pr = (attrs.get("paddings", [0, 0, 0, 0]) + [0, 0, 0, 0])[:4]
+    xp = jnp.pad(x, [(0, 0), (0, 0), (pt, pb), (pl, pr)])
+    n, c, h, w = xp.shape
+    oh = (h - kh) // sh + 1
+    ow = (w - kw) // sw + 1
+    patches = []
+    for i in range(kh):
+        for j in range(kw):
+            patches.append(xp[:, :, i : i + oh * sh : sh, j : j + ow * sw : sw])
+    stacked = jnp.stack(patches, axis=2)  # [n, c, kh*kw, oh, ow]
+    out = stacked.transpose((0, 3, 4, 1, 2)).reshape((n * oh * ow, c * kh * kw))
+    return {"Out": [out]}
+
+
+@register("unfold")
+def _unfold(ctx, ins, attrs):
+    x = ins["X"][0]
+    kh, kw = attrs["kernel_sizes"]
+    sh, sw = attrs.get("strides", [1, 1])
+    pt, pl, pb, pr = (attrs.get("paddings", [0, 0, 0, 0]) + [0, 0, 0, 0])[:4]
+    dh, dw = attrs.get("dilations", [1, 1])
+    xp = jnp.pad(x, [(0, 0), (0, 0), (pt, pb), (pl, pr)])
+    n, c, h, w = xp.shape
+    keh = dh * (kh - 1) + 1
+    kew = dw * (kw - 1) + 1
+    oh = (h - keh) // sh + 1
+    ow = (w - kew) // sw + 1
+    patches = []
+    for i in range(kh):
+        for j in range(kw):
+            ii, jj = i * dh, j * dw
+            patches.append(
+                xp[:, :, ii : ii + oh * sh : sh, jj : jj + ow * sw : sw])
+    stacked = jnp.stack(patches, axis=2)  # [n, c, kh*kw, oh, ow]
+    return {"Y": [stacked.reshape((n, c * kh * kw, oh * ow))]}
+
+
+@register("mean_iou", differentiable=False)
+def _mean_iou(ctx, ins, attrs):
+    pred = ins["Predictions"][0].reshape((-1,)).astype(jnp.int32)
+    label = ins["Labels"][0].reshape((-1,)).astype(jnp.int32)
+    n = attrs["num_classes"]
+    conf = jnp.zeros((n, n), jnp.int32).at[label, pred].add(1)
+    inter = jnp.diag(conf)
+    union = conf.sum(0) + conf.sum(1) - inter
+    valid = union > 0
+    iou = jnp.where(valid, inter / jnp.maximum(union, 1), 0.0)
+    miou = iou.sum() / jnp.maximum(valid.sum(), 1)
+    wrong = conf.sum(1) - inter
+    return {"OutMeanIou": [miou.astype(jnp.float32)],
+            "OutWrong": [wrong.astype(jnp.int32)],
+            "OutCorrect": [inter.astype(jnp.int32)]}

@@ -1,0 +1,64 @@
+"""Broadcasted elementwise ops (parity: operators/elementwise/, 31 files —
+elementwise_{add,sub,mul,div,min,max,mod,floordiv,pow}_op.cc with Fluid's
+`axis` broadcasting convention).
+
+These all fuse into neighbors under XLA, so each is a plain jnp expression.
+"""
+
+import jax.numpy as jnp
+
+from .registry import register, broadcast_to_axis
+
+
+def _binary(name, fn, differentiable=True):
+    def impl(ctx, ins, attrs):
+        x, y = ins["X"][0], ins["Y"][0]
+        y = broadcast_to_axis(y, x.ndim, attrs.get("axis", -1))
+        return {"Out": [fn(x, y)]}
+
+    register(name, differentiable=differentiable)(impl)
+
+
+_binary("elementwise_add", lambda x, y: x + y)
+_binary("elementwise_sub", lambda x, y: x - y)
+_binary("elementwise_mul", lambda x, y: x * y)
+_binary("elementwise_div", lambda x, y: x / y)
+_binary("elementwise_max", jnp.maximum)
+_binary("elementwise_min", jnp.minimum)
+_binary("elementwise_pow", lambda x, y: x**y)
+_binary("elementwise_mod", lambda x, y: jnp.mod(x, y), differentiable=False)
+_binary("elementwise_floordiv", lambda x, y: jnp.floor_divide(x, y),
+        differentiable=False)
+
+
+def _compare(name, fn):
+    def impl(ctx, ins, attrs):
+        x, y = ins["X"][0], ins["Y"][0]
+        y = broadcast_to_axis(y, x.ndim, attrs.get("axis", -1))
+        return {"Out": [fn(x, y)]}
+
+    register(name, differentiable=False)(impl)
+
+
+_compare("equal", lambda x, y: x == y)
+_compare("not_equal", lambda x, y: x != y)
+_compare("less_than", lambda x, y: x < y)
+_compare("less_equal", lambda x, y: x <= y)
+_compare("greater_than", lambda x, y: x > y)
+_compare("greater_equal", lambda x, y: x >= y)
+
+
+def _logical(name, fn, unary=False):
+    def impl(ctx, ins, attrs):
+        x = ins["X"][0]
+        if unary:
+            return {"Out": [fn(x)]}
+        return {"Out": [fn(x, ins["Y"][0])]}
+
+    register(name, differentiable=False)(impl)
+
+
+_logical("logical_and", jnp.logical_and)
+_logical("logical_or", jnp.logical_or)
+_logical("logical_xor", jnp.logical_xor)
+_logical("logical_not", jnp.logical_not, unary=True)

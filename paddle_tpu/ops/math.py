@@ -1,0 +1,245 @@
+"""Core math / tensor-creation ops.
+
+Parity targets (SURVEY §2.2 / Appendix A "Core math" group):
+operators/{matmul_op,mul_op,scale_op,sum_op,cast_op,fill_constant_op,
+uniform_random_op,gaussian_random_op,truncated_gaussian_random_op,clip_op,
+cumsum_op,sign_op,...}.cc — re-expressed as jax lowerings (MXU-friendly:
+matmuls stay single large dots so XLA tiles them onto the systolic array).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import registry
+from .registry import register, simple_op, np_dtype
+
+
+# -- creation ----------------------------------------------------------------
+
+
+@register("fill_constant", differentiable=False)
+def _fill_constant(ctx, ins, attrs):
+    shape = [int(s) for s in attrs["shape"]]
+    dt = np_dtype(attrs.get("dtype", "float32"))
+    return {"Out": [jnp.full(shape, attrs.get("value", 0.0), dtype=dt)]}
+
+
+@register("fill_constant_batch_size_like", differentiable=False)
+def _fill_constant_bsl(ctx, ins, attrs):
+    ref = ins["Input"][0]
+    shape = [int(s) for s in attrs["shape"]]
+    in_idx = attrs.get("input_dim_idx", 0)
+    out_idx = attrs.get("output_dim_idx", 0)
+    shape[out_idx] = ref.shape[in_idx]
+    dt = np_dtype(attrs.get("dtype", "float32"))
+    return {"Out": [jnp.full(shape, attrs.get("value", 0.0), dtype=dt)]}
+
+
+@register("fill_zeros_like", differentiable=False)
+def _fill_zeros_like(ctx, ins, attrs):
+    return {"Out": [jnp.zeros_like(ins["X"][0])]}
+
+
+@register("uniform_random", differentiable=False, stateful=True)
+def _uniform_random(ctx, ins, attrs):
+    shape = [int(s) for s in attrs["shape"]]
+    dt = np_dtype(attrs.get("dtype", "float32"))
+    key = ctx.rng(attrs)
+    lo = attrs.get("min", -1.0)
+    hi = attrs.get("max", 1.0)
+    return {"Out": [jax.random.uniform(key, shape, jnp.float32, lo, hi).astype(dt)]}
+
+
+@register("gaussian_random", differentiable=False, stateful=True)
+def _gaussian_random(ctx, ins, attrs):
+    shape = [int(s) for s in attrs["shape"]]
+    dt = np_dtype(attrs.get("dtype", "float32"))
+    key = ctx.rng(attrs)
+    mean = attrs.get("mean", 0.0)
+    std = attrs.get("std", 1.0)
+    return {"Out": [(jax.random.normal(key, shape) * std + mean).astype(dt)]}
+
+
+@register("truncated_gaussian_random", differentiable=False, stateful=True)
+def _truncated_gaussian_random(ctx, ins, attrs):
+    shape = [int(s) for s in attrs["shape"]]
+    dt = np_dtype(attrs.get("dtype", "float32"))
+    key = ctx.rng(attrs)
+    mean = attrs.get("mean", 0.0)
+    std = attrs.get("std", 1.0)
+    x = jax.random.truncated_normal(key, -2.0, 2.0, shape) * std + mean
+    return {"Out": [x.astype(dt)]}
+
+
+@register("assign")
+def _assign(ctx, ins, attrs):
+    if ins.get("X"):
+        return {"Out": [ins["X"][0]]}
+    v = np.asarray(attrs["value"], dtype=attrs.get("dtype", "float32"))
+    return {"Out": [jnp.asarray(v)]}
+
+
+@register("assign_value", differentiable=False)
+def _assign_value(ctx, ins, attrs):
+    dt = np_dtype(attrs.get("dtype", "float32"))
+    vals = np.asarray(attrs["values"], dtype=dt).reshape(attrs["shape"])
+    return {"Out": [jnp.asarray(vals)]}
+
+
+@simple_op("shape", differentiable=False)
+def _shape(ctx, x):
+    return jnp.asarray(x.shape, dtype=jnp.int32)
+
+
+@register("range", differentiable=False)
+def _range(ctx, ins, attrs):
+    start = ins["Start"][0].reshape(())
+    end = ins["End"][0].reshape(())
+    step = ins["Step"][0].reshape(())
+    # XLA needs static sizes: range bounds must be build-time constants, so
+    # the layer stores them as attrs too when known.
+    n = attrs["__static_len__"]
+    out = start + step * jnp.arange(n, dtype=start.dtype)
+    return {"Out": [out]}
+
+
+@register("linspace", differentiable=False)
+def _linspace(ctx, ins, attrs):
+    start = ins["Start"][0].reshape(())
+    stop = ins["Stop"][0].reshape(())
+    num = int(attrs["__static_num__"])
+    dt = np_dtype(attrs.get("dtype", "float32"))
+    return {"Out": [jnp.linspace(start, stop, num).astype(dt)]}
+
+
+# -- linear algebra ----------------------------------------------------------
+
+
+@register("matmul")
+def _matmul(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    tx = attrs.get("transpose_X", False)
+    ty = attrs.get("transpose_Y", False)
+    alpha = attrs.get("alpha", 1.0)
+    if tx:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if ty:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    out = x @ y
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": [out]}
+
+
+@register("mul")
+def _mul(ctx, ins, attrs):
+    """Fluid `mul`: flatten x to 2-D at x_num_col_dims, y at y_num_col_dims,
+    then matmul (operators/mul_op.cc). The FC workhorse — one big MXU dot."""
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = attrs.get("x_num_col_dims", 1)
+    yn = attrs.get("y_num_col_dims", 1)
+    xs, ys = x.shape, y.shape
+    x2 = x.reshape((int(np.prod(xs[:xn])), int(np.prod(xs[xn:]))))
+    y2 = y.reshape((int(np.prod(ys[:yn])), int(np.prod(ys[yn:]))))
+    out = x2 @ y2
+    return {"Out": [out.reshape(xs[:xn] + ys[yn:])]}
+
+
+@simple_op("scale")
+def _scale(ctx, x, scale=1.0, bias=0.0, bias_after_scale=True, **_):
+    if bias_after_scale:
+        return x * scale + jnp.asarray(bias, x.dtype)
+    return (x + jnp.asarray(bias, x.dtype)) * scale
+
+
+@register("sum")
+def _sum(ctx, ins, attrs):
+    xs = ins["X"]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": [out]}
+
+
+@simple_op("cast")
+def _cast(ctx, x, out_dtype="float32", **_):
+    return x.astype(np_dtype(out_dtype))
+
+
+@simple_op("sign")
+def _sign(ctx, x, **_):
+    return jnp.sign(x)
+
+
+@simple_op("clip")
+def _clip(ctx, x, min=None, max=None, **_):
+    return jnp.clip(x, min, max)
+
+
+@register("clip_by_norm")
+def _clip_by_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    max_norm = attrs["max_norm"]
+    norm = jnp.sqrt(jnp.sum(x.astype(jnp.float32) ** 2))
+    scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return {"Out": [(x * scale.astype(x.dtype))]}
+
+
+@simple_op("cumsum")
+def _cumsum(ctx, x, axis=-1, exclusive=False, reverse=False, **_):
+    if reverse:
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if exclusive:
+        out = out - x
+    if reverse:
+        out = jnp.flip(out, axis)
+    return out
+
+
+@simple_op("l1_norm")
+def _l1_norm(ctx, x, **_):
+    return jnp.sum(jnp.abs(x)).reshape((1,))
+
+
+@simple_op("squared_l2_norm")
+def _squared_l2_norm(ctx, x, **_):
+    return jnp.sum(x * x).reshape((1,))
+
+
+@register("squared_l2_distance")
+def _squared_l2_distance(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    d = x - y.reshape((-1,) + x.shape[1:]) if y.shape[0] == 1 else x - y
+    return {"Out": [jnp.sum(d * d, axis=tuple(range(1, d.ndim)), keepdims=False).reshape((-1, 1))], "sub_result": [d]}
+
+
+@register("cos_sim")
+def _cos_sim(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = jnp.sqrt(jnp.sum(x * x, -1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, -1, keepdims=True))
+    out = jnp.sum(x * y, -1, keepdims=True) / (xn * yn + 1e-12)
+    return {"Out": [out], "XNorm": [xn], "YNorm": [yn]}
+
+
+@simple_op("diag", differentiable=False)
+def _diag(ctx, x, **_):
+    return jnp.diag(x)
+
+
+@register("bilinear_tensor_product")
+def _bilinear_tensor_product(ctx, ins, attrs):
+    x, y, w = ins["X"][0], ins["Y"][0], ins["Weight"][0]
+    # w: [size, dx, dy]
+    out = jnp.einsum("bi,oij,bj->bo", x, w, y)
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0]
+    return {"Out": [out]}
+
+
+@register("dot")
+def _dot(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": [jnp.sum(x * y, axis=-1, keepdims=True)]}

@@ -1,0 +1,284 @@
+"""Optimizer update ops (parity: operators/optimizers/ — sgd_op.cc,
+momentum_op.cc, adam_op.h (fused CPU/GPU Adam), adagrad, rmsprop, lamb,
+lars_momentum, ftrl, adadelta, adamax, decayed_adagrad, proximal_*).
+
+Each op consumes Param (+ accumulator state) and Grad and produces ParamOut
+(+ state outs) aliasing the same persistable variables; the executor writes
+them back to the device-resident store with buffer donation, so updates are
+in-place at the XLA level. All state math in fp32 regardless of param dtype
+(master-weight behavior comes from the mixed-precision decorator).
+"""
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _p(ins, slot):
+    vs = ins.get(slot, [])
+    return vs[0] if vs else None
+
+
+def _lr(ins):
+    return _p(ins, "LearningRate").reshape(())
+
+
+@register("sgd", differentiable=False)
+def _sgd(ctx, ins, attrs):
+    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    lr = _lr(ins)
+    return {"ParamOut": [(p - lr.astype(p.dtype) * g.astype(p.dtype))]}
+
+
+@register("momentum", differentiable=False)
+def _momentum(ctx, ins, attrs):
+    p, g, v = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "Velocity")
+    lr = _lr(ins)
+    mu = attrs.get("mu", 0.9)
+    use_nesterov = attrs.get("use_nesterov", False)
+    v_out = mu * v + g
+    if use_nesterov:
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    return {"ParamOut": [p_out.astype(p.dtype)], "VelocityOut": [v_out]}
+
+
+@register("lars_momentum", differentiable=False)
+def _lars_momentum(ctx, ins, attrs):
+    p, g, v = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "Velocity")
+    lr = _lr(ins)
+    mu = attrs.get("mu", 0.9)
+    coeff = attrs.get("lars_coeff", 0.001)
+    decay = attrs.get("lars_weight_decay", 0.0005)
+    pn = jnp.sqrt(jnp.sum(p.astype(jnp.float32) ** 2))
+    gn = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+    local_lr = lr * coeff * pn / (gn + decay * pn + 1e-12)
+    v_out = mu * v + local_lr * (g + decay * p)
+    p_out = p - v_out
+    return {"ParamOut": [p_out.astype(p.dtype)], "VelocityOut": [v_out]}
+
+
+@register("adam", differentiable=False)
+def _adam(ctx, ins, attrs):
+    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    m, v = _p(ins, "Moment1"), _p(ins, "Moment2")
+    b1p = _p(ins, "Beta1Pow").reshape(())
+    b2p = _p(ins, "Beta2Pow").reshape(())
+    lr = _lr(ins)
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    gf = g.astype(jnp.float32)
+    m_out = b1 * m + (1.0 - b1) * gf
+    v_out = b2 * v + (1.0 - b2) * gf * gf
+    lr_t = lr * jnp.sqrt(1.0 - b2p) / (1.0 - b1p)
+    p_out = p.astype(jnp.float32) - lr_t * m_out / (jnp.sqrt(v_out) + eps)
+    return {
+        "ParamOut": [p_out.astype(p.dtype)],
+        "Moment1Out": [m_out],
+        "Moment2Out": [v_out],
+        "Beta1PowOut": [(b1p * b1).reshape((1,))],
+        "Beta2PowOut": [(b2p * b2).reshape((1,))],
+    }
+
+
+@register("adamax", differentiable=False)
+def _adamax(ctx, ins, attrs):
+    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    m, inf_norm = _p(ins, "Moment"), _p(ins, "InfNorm")
+    b1p = _p(ins, "Beta1Pow").reshape(())
+    lr = _lr(ins)
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    m_out = b1 * m + (1.0 - b1) * g
+    inf_out = jnp.maximum(b2 * inf_norm, jnp.abs(g))
+    p_out = p - (lr / (1.0 - b1p)) * m_out / (inf_out + eps)
+    return {"ParamOut": [p_out.astype(p.dtype)], "MomentOut": [m_out],
+            "InfNormOut": [inf_out]}
+
+
+@register("adagrad", differentiable=False)
+def _adagrad(ctx, ins, attrs):
+    p, g, mom = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "Moment")
+    lr = _lr(ins)
+    eps = attrs.get("epsilon", 1e-6)
+    mom_out = mom + g * g
+    p_out = p - lr * g / (jnp.sqrt(mom_out) + eps)
+    return {"ParamOut": [p_out.astype(p.dtype)], "MomentOut": [mom_out]}
+
+
+@register("decayed_adagrad", differentiable=False)
+def _decayed_adagrad(ctx, ins, attrs):
+    p, g, mom = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "Moment")
+    lr = _lr(ins)
+    decay = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    mom_out = decay * mom + (1.0 - decay) * g * g
+    p_out = p - lr * g / (jnp.sqrt(mom_out) + eps)
+    return {"ParamOut": [p_out.astype(p.dtype)], "MomentOut": [mom_out]}
+
+
+@register("adadelta", differentiable=False)
+def _adadelta(ctx, ins, attrs):
+    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    avg_sq_g = _p(ins, "AvgSquaredGrad")
+    avg_sq_u = _p(ins, "AvgSquaredUpdate")
+    rho = attrs.get("rho", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    g2 = rho * avg_sq_g + (1.0 - rho) * g * g
+    upd = -jnp.sqrt((avg_sq_u + eps) / (g2 + eps)) * g
+    u2 = rho * avg_sq_u + (1.0 - rho) * upd * upd
+    return {"ParamOut": [(p + upd).astype(p.dtype)],
+            "AvgSquaredGradOut": [g2], "AvgSquaredUpdateOut": [u2]}
+
+
+@register("rmsprop", differentiable=False)
+def _rmsprop(ctx, ins, attrs):
+    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    ms, mom = _p(ins, "MeanSquare"), _p(ins, "Moment")
+    lr = _lr(ins)
+    rho = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    momentum = attrs.get("momentum", 0.0)
+    centered = attrs.get("centered", False)
+    ms_out = rho * ms + (1.0 - rho) * g * g
+    if centered:
+        mg = _p(ins, "MeanGrad")
+        mg_out = rho * mg + (1.0 - rho) * g
+        denom = ms_out - mg_out * mg_out + eps
+    else:
+        mg_out = None
+        denom = ms_out + eps
+    mom_out = momentum * mom + lr * g / jnp.sqrt(denom)
+    outs = {"ParamOut": [(p - mom_out).astype(p.dtype)],
+            "MeanSquareOut": [ms_out], "MomentOut": [mom_out]}
+    if mg_out is not None:
+        outs["MeanGradOut"] = [mg_out]
+    return outs
+
+
+@register("ftrl", differentiable=False)
+def _ftrl(ctx, ins, attrs):
+    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    sq, lin = _p(ins, "SquaredAccumulator"), _p(ins, "LinearAccumulator")
+    lr = _lr(ins)
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    power = attrs.get("lr_power", -0.5)
+    new_sq = sq + g * g
+    if power == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
+    else:
+        sigma = (new_sq ** (-power) - sq ** (-power)) / lr
+    lin_out = lin + g - sigma * p
+    if power == -0.5:
+        x = l1 * jnp.sign(lin_out) - lin_out
+        y = jnp.sqrt(new_sq) / lr + 2 * l2
+    else:
+        x = l1 * jnp.sign(lin_out) - lin_out
+        y = new_sq ** (-power) / lr + 2 * l2
+    p_out = jnp.where(jnp.abs(lin_out) > l1, x / y, jnp.zeros_like(p))
+    return {"ParamOut": [p_out.astype(p.dtype)], "SquaredAccumOut": [new_sq],
+            "LinearAccumOut": [lin_out]}
+
+
+@register("lamb", differentiable=False)
+def _lamb(ctx, ins, attrs):
+    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    m, v = _p(ins, "Moment1"), _p(ins, "Moment2")
+    b1p = _p(ins, "Beta1Pow").reshape(())
+    b2p = _p(ins, "Beta2Pow").reshape(())
+    lr = _lr(ins)
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-6)
+    wd = attrs.get("weight_decay", 0.01)
+    gf = g.astype(jnp.float32)
+    pf = p.astype(jnp.float32)
+    m_out = b1 * m + (1.0 - b1) * gf
+    v_out = b2 * v + (1.0 - b2) * gf * gf
+    m_hat = m_out / (1.0 - b1p)
+    v_hat = v_out / (1.0 - b2p)
+    r = m_hat / (jnp.sqrt(v_hat) + eps) + wd * pf
+    p_norm = jnp.sqrt(jnp.sum(pf * pf))
+    r_norm = jnp.sqrt(jnp.sum(r * r))
+    ratio = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+    p_out = pf - lr * ratio * r
+    return {
+        "ParamOut": [p_out.astype(p.dtype)],
+        "Moment1Out": [m_out],
+        "Moment2Out": [v_out],
+        "Beta1PowOut": [(b1p * b1).reshape((1,))],
+        "Beta2PowOut": [(b2p * b2).reshape((1,))],
+    }
+
+
+@register("proximal_gd", differentiable=False)
+def _proximal_gd(ctx, ins, attrs):
+    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    lr = _lr(ins)
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    prox = p - lr * g
+    p_out = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) / (
+        1.0 + lr * l2)
+    return {"ParamOut": [p_out.astype(p.dtype)]}
+
+
+@register("proximal_adagrad", differentiable=False)
+def _proximal_adagrad(ctx, ins, attrs):
+    p, g, mom = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "Moment")
+    lr = _lr(ins)
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    mom_out = mom + g * g
+    eff_lr = lr / jnp.sqrt(mom_out)
+    prox = p - eff_lr * g
+    p_out = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - eff_lr * l1, 0.0) / (
+        1.0 + eff_lr * l2)
+    return {"ParamOut": [p_out.astype(p.dtype)], "MomentOut": [mom_out]}
+
+
+@register("dgc_momentum", differentiable=False)
+def _dgc_momentum(ctx, ins, attrs):
+    # falls back to plain momentum update (the DGC sparse path lives in
+    # parallel/dgc.py — top-k compress before the allreduce)
+    return _momentum(ctx, ins, attrs)
+
+
+@register("average_accumulates", differentiable=False)
+def _average_accumulates(ctx, ins, attrs):
+    param = _p(ins, "param")
+    sum1 = _p(ins, "in_sum_1")
+    sum2 = _p(ins, "in_sum_2")
+    sum3 = _p(ins, "in_sum_3")
+    num_acc = _p(ins, "in_num_accumulates").reshape(())
+    old_num = _p(ins, "in_old_num_accumulates").reshape(())
+    num_upd = _p(ins, "in_num_updates").reshape(())
+    avg_window = attrs.get("average_window", 0.15)
+    max_avg = attrs.get("max_average_window", 10000)
+    min_avg = attrs.get("min_average_window", 10000)
+    num_acc = num_acc + 1
+    num_upd = num_upd + 1
+    sum1 = sum1 + param
+    window = jnp.minimum(jnp.maximum(min_avg, num_upd * avg_window), max_avg)
+    do_shift = num_acc >= window
+    sum2_n = jnp.where(do_shift, sum2 + sum1, sum2)
+    sum1_n = jnp.where(do_shift, jnp.zeros_like(sum1), sum1)
+    old_num_n = jnp.where(do_shift, num_acc + old_num, old_num)
+    num_acc_n = jnp.where(do_shift, 0, num_acc)
+    # second-level shift
+    do_shift2 = old_num_n >= max_avg
+    sum3_n = jnp.where(do_shift2, sum2_n, sum3)
+    sum2_nn = jnp.where(do_shift2, jnp.zeros_like(sum2), sum2_n)
+    old_num_nn = jnp.where(do_shift2, 0, old_num_n)
+    return {
+        "out_sum_1": [sum1_n],
+        "out_sum_2": [sum2_nn],
+        "out_sum_3": [sum3_n],
+        "out_num_accumulates": [num_acc_n.astype(jnp.int64).reshape((1,))],
+        "out_old_num_accumulates": [old_num_nn.astype(jnp.int64).reshape((1,))],
+        "out_num_updates": [num_upd.astype(jnp.int64).reshape((1,))],
+    }

@@ -1,0 +1,94 @@
+"""Reductions (parity: operators/reduce_ops/ — reduce_{sum,mean,max,min,prod,
+all,any}_op.cc; plus mean_op.cc and argmin/argmax/top_k).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, simple_op
+
+
+def _reduce(name, fn, differentiable=True):
+    def impl(ctx, ins, attrs):
+        x = ins["X"][0]
+        dim = attrs.get("dim", [0])
+        keep_dim = attrs.get("keep_dim", False)
+        if attrs.get("reduce_all", False):
+            axis = None
+        else:
+            axis = tuple(d % x.ndim for d in (dim if isinstance(dim, (list, tuple)) else [dim]))
+        out = fn(x, axis=axis, keepdims=keep_dim)
+        if axis is None and not keep_dim:
+            out = out.reshape((1,))
+        return {"Out": [out]}
+
+    register(name, differentiable=differentiable)(impl)
+
+
+_reduce("reduce_sum", jnp.sum)
+_reduce("reduce_mean", jnp.mean)
+_reduce("reduce_max", jnp.max)
+_reduce("reduce_min", jnp.min)
+_reduce("reduce_prod", jnp.prod)
+_reduce("reduce_all", jnp.all, differentiable=False)
+_reduce("reduce_any", jnp.any, differentiable=False)
+
+
+@simple_op("mean")
+def _mean(ctx, x, **_):
+    # Fluid mean_op: mean over ALL elements -> shape [1]
+    return jnp.mean(x).reshape((1,))
+
+
+@register("argmax", differentiable=False)
+def _argmax(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    return {"Out": [jnp.argmax(x, axis=axis).astype(jnp.int64)]}
+
+
+@register("argmin", differentiable=False)
+def _argmin(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    return {"Out": [jnp.argmin(x, axis=axis).astype(jnp.int64)]}
+
+
+@register("argsort", differentiable=False)
+def _argsort(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    descending = attrs.get("descending", False)
+    key = -x if descending else x
+    idx = jnp.argsort(key, axis=axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return {"Out": [out], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register("top_k", differentiable=False)
+def _top_k(ctx, ins, attrs):
+    x = ins["X"][0]
+    k = int(attrs["k"])
+    vals, idx = jax.lax.top_k(x, k)
+    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register("isfinite", differentiable=False)
+def _isfinite(ctx, ins, attrs):
+    xs = ins["X"]
+    ok = jnp.asarray(True)
+    for x in xs:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(x.astype(jnp.float32))))
+    return {"Out": [ok.reshape((1,))]}
+
+
+@register("has_inf", differentiable=False)
+def _has_inf(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [jnp.any(jnp.isinf(x.astype(jnp.float32))).reshape((1,))]}
+
+
+@register("has_nan", differentiable=False)
+def _has_nan(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [jnp.any(jnp.isnan(x.astype(jnp.float32))).reshape((1,))]}

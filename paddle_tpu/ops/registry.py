@@ -1,0 +1,140 @@
+"""Op registry + kernel dispatch (parity: paddle/fluid/framework/op_registry.h
+REGISTER_OPERATOR :197 and OperatorWithKernel dispatch operator.cc:881-1160).
+
+TPU-native: an "op kernel" is a pure JAX-traceable function
+    impl(ctx, ins, attrs) -> outs
+where ins/outs are dict[slot -> list[jax.Array]] mirroring Fluid's named
+input/output slots. There is no (place, dtype, layout) kernel key — XLA
+compiles one kernel for whatever mesh/dtype the program is lowered with, and
+gradients are derived from the SAME impl via per-op `jax.vjp` at lowering
+time (see paddle_tpu/backward.py), replacing Fluid's hand-registered
+GradOpDescMakers (grad_op_desc_maker.h).
+
+`ctx` is a LoweringContext giving ops deterministic per-op PRNG keys (seeded
+by program seed + op id + step counter), the training/eval switch, and mesh
+info for collective ops.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_REGISTRY = {}
+
+
+class OpDef:
+    def __init__(
+        self,
+        name,
+        impl,
+        differentiable=True,
+        nondiff_inputs=(),
+        stateful=False,
+        infer_shape=None,
+    ):
+        self.name = name
+        self.impl = impl
+        self.differentiable = differentiable
+        # input slots that never receive gradients (e.g. integer id inputs)
+        self.nondiff_inputs = frozenset(nondiff_inputs)
+        # stateful ops use ctx.rng() or update persistable state
+        self.stateful = stateful
+        self.infer_shape = infer_shape
+
+    def __repr__(self):
+        return "OpDef(%s)" % self.name
+
+
+def register(name, differentiable=True, nondiff_inputs=(), stateful=False,
+             infer_shape=None):
+    """Decorator: register `impl(ctx, ins, attrs) -> outs` for op `name`."""
+
+    def deco(fn):
+        if name in _REGISTRY:
+            raise ValueError("op %r already registered" % name)
+        _REGISTRY[name] = OpDef(
+            name, fn, differentiable, nondiff_inputs, stateful, infer_shape
+        )
+        return fn
+
+    return deco
+
+
+def simple_op(name, in_slots=("X",), out_slot="Out", differentiable=True,
+              nondiff_inputs=(), stateful=False):
+    """Register an op whose slots each carry exactly one tensor:
+    fn(ctx, *tensors, **attrs) -> single tensor bound to `out_slot`.
+    Multi-output ops must use register() and return a slot dict."""
+
+    def deco(fn):
+        def impl(ctx, ins, attrs):
+            args = []
+            for s in in_slots:
+                vs = ins.get(s, [])
+                args.append(vs[0] if vs else None)
+            out = fn(ctx, *args, **attrs)
+            if isinstance(out, tuple):
+                raise TypeError(
+                    "simple_op %r returned a tuple; multi-output ops must "
+                    "use register() and return a slot dict" % name)
+            return {out_slot: [out]}
+
+        register(name, differentiable, nondiff_inputs, stateful)(impl)
+        return fn
+
+    return deco
+
+
+def elementwise_unary(name, fn, differentiable=True):
+    """Register a unary elementwise op X -> Out (activation family,
+    parity: operators/activation_op.cc REGISTER_ACTIVATION_OP)."""
+
+    def impl(ctx, ins, attrs):
+        return {"Out": [fn(ins["X"][0], attrs)]}
+
+    register(name, differentiable=differentiable)(impl)
+
+
+def get(name):
+    od = _REGISTRY.get(name)
+    if od is None:
+        raise KeyError(
+            "no TPU kernel registered for op %r (registered: %d ops)"
+            % (name, len(_REGISTRY))
+        )
+    return od
+
+
+def has(name):
+    return name in _REGISTRY
+
+
+def all_ops():
+    return sorted(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# helpers shared by op impls
+# ---------------------------------------------------------------------------
+
+
+def x_of(ins, slot="X"):
+    vs = ins.get(slot, [])
+    return vs[0] if vs else None
+
+
+def np_dtype(name):
+    if name == "bfloat16":
+        return jnp.bfloat16
+    return np.dtype(name)
+
+
+def broadcast_to_axis(y, x_ndim, axis):
+    """Fluid elementwise broadcasting: align y's dims to x starting at `axis`
+    (operators/elementwise/elementwise_op_function.h semantics). axis=-1
+    means trailing alignment (numpy default)."""
+    if axis is None or axis == -1 or y.ndim == 0 or y.ndim == x_ndim:
+        return y
+    # pad y's shape with 1s: axis leading, rest trailing
+    shape = (1,) * axis + tuple(y.shape) + (1,) * (x_ndim - axis - y.ndim)
+    return y.reshape(shape)

@@ -1,0 +1,327 @@
+"""Tensor manipulation ops (parity: SURVEY Appendix A "Tensor manipulation"
+group — reshape/concat/split/transpose/gather/scatter/one_hot/slice/pad/
+expand/stack/squeeze/... from operators/*.cc).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register, simple_op, np_dtype
+
+
+@register("reshape2")
+def _reshape2(ctx, ins, attrs):
+    x = ins["X"][0]
+    shape = list(attrs["shape"])
+    # Fluid reshape semantics: 0 means copy input dim, -1 inferred
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = x.shape[i]
+    out = x.reshape(shape)
+    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, x.dtype)]}
+
+
+@register("reshape")
+def _reshape(ctx, ins, attrs):
+    x = ins["X"][0]
+    shape = list(attrs["shape"])
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = x.shape[i]
+    return {"Out": [x.reshape(shape)]}
+
+
+@register("squeeze2")
+def _squeeze2(ctx, ins, attrs):
+    x = ins["X"][0]
+    axes = attrs.get("axes", [])
+    if axes:
+        out = x
+        for ax in sorted((a % x.ndim for a in axes), reverse=True):
+            out = jnp.squeeze(out, axis=ax)
+    else:
+        out = jnp.squeeze(x)
+    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, x.dtype)]}
+
+
+@register("squeeze")
+def _squeeze(ctx, ins, attrs):
+    x = ins["X"][0]
+    axes = attrs.get("axes", [])
+    if axes:
+        out = x
+        for ax in sorted((a % x.ndim for a in axes), reverse=True):
+            out = jnp.squeeze(out, axis=ax)
+    else:
+        out = jnp.squeeze(x)
+    return {"Out": [out]}
+
+
+@register("unsqueeze2")
+def _unsqueeze2(ctx, ins, attrs):
+    x = ins["X"][0]
+    out = x
+    for ax in sorted(attrs["axes"]):
+        out = jnp.expand_dims(out, axis=ax)
+    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, x.dtype)]}
+
+
+@register("unsqueeze")
+def _unsqueeze(ctx, ins, attrs):
+    x = ins["X"][0]
+    out = x
+    for ax in sorted(attrs["axes"]):
+        out = jnp.expand_dims(out, axis=ax)
+    return {"Out": [out]}
+
+
+@register("flatten2")
+def _flatten2(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 1)
+    lead = int(np.prod(x.shape[:axis])) if axis > 0 else 1
+    out = x.reshape((lead, -1))
+    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, x.dtype)]}
+
+
+@register("flatten")
+def _flatten(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 1)
+    lead = int(np.prod(x.shape[:axis])) if axis > 0 else 1
+    return {"Out": [x.reshape((lead, -1))]}
+
+
+@register("transpose2")
+def _transpose2(ctx, ins, attrs):
+    x = ins["X"][0]
+    out = jnp.transpose(x, attrs["axis"])
+    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, x.dtype)]}
+
+
+@register("transpose")
+def _transpose(ctx, ins, attrs):
+    return {"Out": [jnp.transpose(ins["X"][0], attrs["axis"])]}
+
+
+@register("concat")
+def _concat(ctx, ins, attrs):
+    return {"Out": [jnp.concatenate(ins["X"], axis=attrs.get("axis", 0))]}
+
+
+@register("split")
+def _split(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    num = attrs.get("num", 0)
+    sections = attrs.get("sections", [])
+    if sections:
+        idx = np.cumsum(sections)[:-1].tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    return {"Out": list(outs)}
+
+
+@register("stack")
+def _stack(ctx, ins, attrs):
+    return {"Y": [jnp.stack(ins["X"], axis=attrs.get("axis", 0))]}
+
+
+@register("unstack")
+def _unstack(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    n = x.shape[axis]
+    outs = [jnp.squeeze(a, axis) for a in jnp.split(x, n, axis=axis)]
+    return {"Y": outs}
+
+
+@register("expand")
+def _expand(ctx, ins, attrs):
+    x = ins["X"][0]
+    times = attrs["expand_times"]
+    return {"Out": [jnp.tile(x, times)]}
+
+
+@register("slice")
+def _slice(ctx, ins, attrs):
+    x = ins["Input"][0]
+    axes = attrs["axes"]
+    starts = attrs["starts"]
+    ends = attrs["ends"]
+    idx = [slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        d = x.shape[ax]
+        st = max(st + d, 0) if st < 0 else min(st, d)
+        en = max(en + d, 0) if en < 0 else min(en, d)
+        idx[ax] = slice(st, en)
+    return {"Out": [x[tuple(idx)]]}
+
+
+@register("strided_slice")
+def _strided_slice(ctx, ins, attrs):
+    x = ins["Input"][0]
+    idx = [slice(None)] * x.ndim
+    for ax, st, en, stride in zip(attrs["axes"], attrs["starts"], attrs["ends"],
+                                  attrs["strides"]):
+        idx[ax] = slice(st, en, stride)
+    return {"Out": [x[tuple(idx)]]}
+
+
+@register("crop")
+def _crop(ctx, ins, attrs):
+    x = ins["X"][0]
+    offsets = attrs.get("offsets")
+    shape = attrs.get("shape")
+    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return {"Out": [x[idx]]}
+
+
+@register("pad")
+def _pad(ctx, ins, attrs):
+    x = ins["X"][0]
+    p = attrs["paddings"]
+    pad_width = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": [jnp.pad(x, pad_width, constant_values=attrs.get("pad_value", 0.0))]}
+
+
+@register("pad2d")
+def _pad2d(ctx, ins, attrs):
+    x = ins["X"][0]  # NCHW
+    p = attrs["paddings"]  # [top, bottom, left, right]
+    mode = attrs.get("mode", "constant")
+    pw = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    if attrs.get("data_format", "NCHW") == "NHWC":
+        pw = [(0, 0), (p[0], p[1]), (p[2], p[3]), (0, 0)]
+    if mode == "constant":
+        out = jnp.pad(x, pw, constant_values=attrs.get("pad_value", 0.0))
+    elif mode == "reflect":
+        out = jnp.pad(x, pw, mode="reflect")
+    else:
+        out = jnp.pad(x, pw, mode="edge")
+    return {"Out": [out]}
+
+
+@register("pad_constant_like")
+def _pad_constant_like(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    pw = [(0, xs - ys) for xs, ys in zip(x.shape, y.shape)]
+    return {"Out": [jnp.pad(y, pw, constant_values=attrs.get("pad_value", 0.0))]}
+
+
+@register("gather", nondiff_inputs=("Index",))
+def _gather(ctx, ins, attrs):
+    x, idx = ins["X"][0], ins["Index"][0]
+    return {"Out": [jnp.take(x, idx.reshape((-1,)), axis=0)]}
+
+
+@register("gather_nd", nondiff_inputs=("Index",))
+def _gather_nd(ctx, ins, attrs):
+    x, idx = ins["X"][0], ins["Index"][0]
+    return {"Out": [x[tuple(jnp.moveaxis(idx, -1, 0))]]}
+
+
+@register("scatter", nondiff_inputs=("Ids",))
+def _scatter(ctx, ins, attrs):
+    x, ids, upd = ins["X"][0], ins["Ids"][0], ins["Updates"][0]
+    ids = ids.reshape((-1,))
+    if attrs.get("overwrite", True):
+        return {"Out": [x.at[ids].set(upd)]}
+    return {"Out": [x.at[ids].add(upd)]}
+
+
+@register("one_hot", differentiable=False)
+def _one_hot(ctx, ins, attrs):
+    x = ins["X"][0]
+    depth = int(attrs["depth"])
+    flat = x.reshape(x.shape[:-1]) if x.shape and x.shape[-1] == 1 else x
+    return {"Out": [jax.nn.one_hot(flat, depth, dtype=jnp.float32)]}
+
+
+@register("multiplex", nondiff_inputs=("Ids",))
+def _multiplex(ctx, ins, attrs):
+    ids = ins["Ids"][0].reshape((-1,))
+    stacked = jnp.stack(ins["X"], axis=0)  # [n, batch, ...]
+    rows = jnp.arange(stacked.shape[1])
+    return {"Out": [stacked[ids, rows]]}
+
+
+@register("reverse")
+def _reverse(ctx, ins, attrs):
+    x = ins["X"][0]
+    out = x
+    for ax in attrs["axis"]:
+        out = jnp.flip(out, axis=ax)
+    return {"Out": [out]}
+
+
+@register("where", differentiable=False)
+def _where(ctx, ins, attrs):
+    cond = ins["Condition"][0]
+    return {"Out": [jnp.argwhere(cond).astype(jnp.int64)]}
+
+
+@register("where_op_select")
+def _where_select(ctx, ins, attrs):
+    return {"Out": [jnp.where(ins["Condition"][0], ins["X"][0], ins["Y"][0])]}
+
+
+@register("is_empty", differentiable=False)
+def _is_empty(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [jnp.asarray(x.size == 0).reshape((1,))]}
+
+
+@register("label_smooth")
+def _label_smooth(ctx, ins, attrs):
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 0.0)
+    if ins.get("PriorDist"):
+        prior = ins["PriorDist"][0]
+        return {"Out": [(1 - eps) * x + eps * prior]}
+    return {"Out": [(1 - eps) * x + eps / x.shape[-1]]}
+
+
+@register("shard_index", differentiable=False)
+def _shard_index(ctx, ins, attrs):
+    x = ins["X"][0]
+    index_num = attrs["index_num"]
+    nshards = attrs["nshards"]
+    shard_id = attrs["shard_id"]
+    ignore_value = attrs.get("ignore_value", -1)
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (x // shard_size) == shard_id
+    return {"Out": [jnp.where(in_shard, x % shard_size, ignore_value)]}
+
+
+@register("sampling_id", differentiable=False, stateful=True)
+def _sampling_id(ctx, ins, attrs):
+    x = ins["X"][0]
+    key = ctx.rng(attrs)
+    ids = jax.random.categorical(key, jnp.log(jnp.maximum(x, 1e-20)), axis=-1)
+    return {"Out": [ids.astype(jnp.int64)]}
+
+
+@register("uniform_random_batch_size_like", differentiable=False, stateful=True)
+def _uniform_random_bsl(ctx, ins, attrs):
+    ref = ins["Input"][0]
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = ref.shape[attrs.get("input_dim_idx", 0)]
+    key = ctx.rng(attrs)
+    dt = np_dtype(attrs.get("dtype", "float32"))
+    return {"Out": [jax.random.uniform(key, shape, jnp.float32,
+                                       attrs.get("min", -1.0),
+                                       attrs.get("max", 1.0)).astype(dt)]}
+
+
+@register("gaussian_random_batch_size_like", differentiable=False, stateful=True)
+def _gaussian_random_bsl(ctx, ins, attrs):
+    ref = ins["Input"][0]
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = ref.shape[attrs.get("input_dim_idx", 0)]
+    key = ctx.rng(attrs)
+    dt = np_dtype(attrs.get("dtype", "float32"))
+    out = jax.random.normal(key, shape) * attrs.get("std", 1.0) + attrs.get("mean", 0.0)
+    return {"Out": [out.astype(dt)]}

@@ -1,0 +1,642 @@
+"""Optimizers (parity: python/paddle/fluid/optimizer.py — base :49,
+minimize :472 = backward :351 + apply_gradients :409; 15 classes §L5).
+
+Each optimizer appends per-param update ops that the executor fuses into the
+single jitted train step; accumulators are persistable vars initialized by
+the startup program. On a data-parallel mesh the gradient allreduce comes
+from sharding propagation (compiler.py), not from ops here.
+"""
+
+import numpy as np
+
+from . import framework, unique_name
+from .backward import append_backward
+from .framework import Variable, default_main_program, default_startup_program
+from .initializer import Constant
+from .layer_helper import LayerHelper
+
+__all__ = [
+    "SGD", "Momentum", "Adagrad", "Adam", "Adamax", "DecayedAdagrad",
+    "Adadelta", "RMSProp", "Ftrl", "Lamb", "LarsMomentum", "DGCMomentum",
+    "SGDOptimizer", "MomentumOptimizer", "AdagradOptimizer", "AdamOptimizer",
+    "AdamaxOptimizer", "DecayedAdagradOptimizer", "AdadeltaOptimizer",
+    "RMSPropOptimizer", "FtrlOptimizer", "LambOptimizer",
+    "LarsMomentumOptimizer", "DGCMomentumOptimizer", "ModelAverage",
+    "ExponentialMovingAverage",
+]
+
+
+class Optimizer:
+    """Base (parity: optimizer.py:49)."""
+
+    def __init__(self, learning_rate, regularization=None, name=None):
+        self.regularization = regularization
+        self._name = name
+        self._learning_rate = learning_rate
+        self._learning_rate_map = {}
+        self._accumulators = {}  # {acc_name: {param_name: var}}
+        self.helper = None
+
+    # -- learning rate -----------------------------------------------------
+    def _create_global_learning_rate(self, prog=None):
+        prog = prog or default_main_program()
+        lr = self._learning_rate_map.get(prog)
+        if lr is not None:
+            return
+        if isinstance(self._learning_rate, Variable):
+            self._learning_rate_map[prog] = self._learning_rate
+            return
+        lr_name = unique_name.generate("learning_rate")
+        gb = prog.global_block()
+        lr_var = gb.create_var(
+            name=lr_name, shape=(1,), dtype="float32", persistable=True,
+            stop_gradient=True,
+        )
+        sb = default_startup_program().global_block()
+        sv = sb.create_var(name=lr_name, shape=(1,), dtype="float32",
+                           persistable=True)
+        Constant(float(self._learning_rate))(sv, sb)
+        self._learning_rate_map[prog] = lr_var
+
+    def _global_learning_rate(self, program=None):
+        program = program or default_main_program()
+        return self._learning_rate_map.get(program)
+
+    def _create_param_lr(self, param_and_grad):
+        param = param_and_grad[0]
+        base = self._global_learning_rate(param.block.program)
+        mult = (param.optimize_attr or {}).get("learning_rate", 1.0)
+        if mult == 1.0:
+            return base
+        helper = LayerHelper("param_lr")
+        out = helper.create_variable_for_type_inference("float32")
+        helper.append_op(
+            type="scale", inputs={"X": [base]}, outputs={"Out": [out]},
+            attrs={"scale": float(mult)},
+        )
+        out.shape = (1,)
+        return out
+
+    # -- accumulators --------------------------------------------------------
+    def _add_accumulator(self, name, param, dtype=None, fill_value=0.0,
+                         shape=None):
+        if name in self._accumulators and param.name in self._accumulators[name]:
+            return self._accumulators[name][param.name]
+        acc_name = unique_name.generate("%s_%s" % (param.name, name))
+        shape = shape if shape is not None else param.shape
+        dtype = dtype or "float32"
+        gb = default_main_program().global_block()
+        acc = gb.create_var(name=acc_name, shape=tuple(shape), dtype=dtype,
+                            persistable=True, stop_gradient=True)
+        sb = default_startup_program().global_block()
+        sv = sb.create_var(name=acc_name, shape=tuple(shape), dtype=dtype,
+                           persistable=True)
+        Constant(float(fill_value))(sv, sb)
+        self._accumulators.setdefault(name, {})[param.name] = acc
+        return acc
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][param.name]
+
+    # -- hooks ---------------------------------------------------------------
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    def _finish_update(self, block, parameters_and_grads):
+        pass
+
+    # -- API -----------------------------------------------------------------
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return append_backward(loss, parameter_list, no_grad_set, callbacks)
+
+    def apply_gradients(self, params_grads):
+        # ops must land in the program that owns the params — which may not
+        # be the current default program (e.g. minimize() after the guard)
+        if params_grads:
+            prog = params_grads[0][0].block.program
+        else:
+            prog = default_main_program()
+        block = prog.global_block()
+        self.helper = LayerHelper(self.__class__.__name__)
+        with framework.program_guard(prog):
+            self._create_global_learning_rate(prog)
+
+            from .clip import append_gradient_clip_ops
+            from .regularizer import append_regularization_ops
+
+            params_grads = append_gradient_clip_ops(params_grads)
+            params_grads = append_regularization_ops(params_grads,
+                                                     self.regularization)
+
+            self._create_accumulators(block, [p for p, _ in params_grads])
+            start = len(block.ops)
+            for pg in params_grads:
+                self._append_optimize_op(block, pg)
+            self._finish_update(block, params_grads)
+            return list(block.ops[start:])
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        optimize_ops = self.apply_gradients(params_grads)
+        return optimize_ops, params_grads
+
+
+class SGDOptimizer(Optimizer):
+    def __init__(self, learning_rate, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "sgd"
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        block.append_op(
+            type="sgd",
+            inputs={"Param": [p], "Grad": [g],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [p]},
+        )
+
+
+class MomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate, momentum, use_nesterov=False,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "momentum"
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        v = self._get_accumulator("velocity", p)
+        block.append_op(
+            type="momentum",
+            inputs={"Param": [p], "Grad": [g], "Velocity": [v],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [p], "VelocityOut": [v]},
+            attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov},
+        )
+
+
+class LarsMomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate, momentum, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "lars_momentum"
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        v = self._get_accumulator("velocity", p)
+        block.append_op(
+            type="lars_momentum",
+            inputs={"Param": [p], "Grad": [g], "Velocity": [v],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [p], "VelocityOut": [v]},
+            attrs={"mu": self._momentum, "lars_coeff": self._lars_coeff,
+                   "lars_weight_decay": self._lars_weight_decay},
+        )
+
+
+class DGCMomentumOptimizer(MomentumOptimizer):
+    """API parity for DGC (P9). Dense momentum update here; the sparse top-k
+    compressed allreduce engages in data-parallel compilation (parallel/dgc)."""
+
+    def __init__(self, learning_rate, momentum, rampup_begin_step=0,
+                 rampup_step=1, sparsity=(0.999,), use_nesterov=False,
+                 local_grad_clip_norm=None, num_trainers=None,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, momentum, use_nesterov,
+                         regularization, name)
+        self.type = "dgc_momentum"
+        self._rampup_begin_step = rampup_begin_step
+        self._sparsity = sparsity
+
+
+class AdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, regularization=None,
+                 name=None, initial_accumulator_value=0.0):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "adagrad"
+        self._epsilon = epsilon
+        self._initial = initial_accumulator_value
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p, fill_value=self._initial)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._get_accumulator("moment", p)
+        block.append_op(
+            type="adagrad",
+            inputs={"Param": [p], "Grad": [g], "Moment": [m],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [p], "MomentOut": [m]},
+            attrs={"epsilon": self._epsilon},
+        )
+
+
+class AdamOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, regularization=None, name=None,
+                 lazy_mode=False):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "adam"
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1,
+                                  shape=[1])
+            self._add_accumulator("beta2_pow_acc", p, fill_value=self._beta2,
+                                  shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m1 = self._get_accumulator("moment1", p)
+        m2 = self._get_accumulator("moment2", p)
+        b1p = self._get_accumulator("beta1_pow_acc", p)
+        b2p = self._get_accumulator("beta2_pow_acc", p)
+        block.append_op(
+            type="adam",
+            inputs={"Param": [p], "Grad": [g], "Moment1": [m1],
+                    "Moment2": [m2], "Beta1Pow": [b1p], "Beta2Pow": [b2p],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [p], "Moment1Out": [m1], "Moment2Out": [m2],
+                     "Beta1PowOut": [b1p], "Beta2PowOut": [b2p]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon},
+        )
+
+
+class AdamaxOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "adamax"
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+            self._add_accumulator("inf_norm", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1,
+                                  shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        block.append_op(
+            type="adamax",
+            inputs={"Param": [p], "Grad": [g],
+                    "Moment": [self._get_accumulator("moment", p)],
+                    "InfNorm": [self._get_accumulator("inf_norm", p)],
+                    "Beta1Pow": [self._get_accumulator("beta1_pow_acc", p)],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [p],
+                     "MomentOut": [self._get_accumulator("moment", p)],
+                     "InfNormOut": [self._get_accumulator("inf_norm", p)]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon},
+        )
+
+    def _finish_update(self, block, parameters_and_grads):
+        for p, _ in parameters_and_grads:
+            b1p = self._get_accumulator("beta1_pow_acc", p)
+            block.append_op(
+                type="scale", inputs={"X": [b1p]}, outputs={"Out": [b1p]},
+                attrs={"scale": self._beta1},
+            )
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "decayed_adagrad"
+        self._decay, self._epsilon = decay, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._get_accumulator("moment", p)
+        block.append_op(
+            type="decayed_adagrad",
+            inputs={"Param": [p], "Grad": [g], "Moment": [m],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [p], "MomentOut": [m]},
+            attrs={"decay": self._decay, "epsilon": self._epsilon},
+        )
+
+
+class AdadeltaOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "adadelta"
+        self._epsilon, self._rho = epsilon, rho
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("_avg_squared_grad", p)
+            self._add_accumulator("_avg_squared_update", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        asg = self._get_accumulator("_avg_squared_grad", p)
+        asu = self._get_accumulator("_avg_squared_update", p)
+        block.append_op(
+            type="adadelta",
+            inputs={"Param": [p], "Grad": [g], "AvgSquaredGrad": [asg],
+                    "AvgSquaredUpdate": [asu]},
+            outputs={"ParamOut": [p], "AvgSquaredGradOut": [asg],
+                     "AvgSquaredUpdateOut": [asu]},
+            attrs={"epsilon": self._epsilon, "rho": self._rho},
+        )
+
+
+class RMSPropOptimizer(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "rmsprop"
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("momentum", p)
+            self._add_accumulator("mean_square", p)
+            self._add_accumulator("mean_grad", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        block.append_op(
+            type="rmsprop",
+            inputs={"Param": [p], "Grad": [g],
+                    "Moment": [self._get_accumulator("momentum", p)],
+                    "MeanSquare": [self._get_accumulator("mean_square", p)],
+                    "MeanGrad": [self._get_accumulator("mean_grad", p)],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [p],
+                     "MomentOut": [self._get_accumulator("momentum", p)],
+                     "MeanSquareOut": [self._get_accumulator("mean_square", p)],
+                     "MeanGradOut": [self._get_accumulator("mean_grad", p)]},
+            attrs={"decay": self._rho, "epsilon": self._epsilon,
+                   "momentum": self._momentum, "centered": self._centered},
+        )
+
+
+class FtrlOptimizer(Optimizer):
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "ftrl"
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("squared", p)
+            self._add_accumulator("linear", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        block.append_op(
+            type="ftrl",
+            inputs={"Param": [p], "Grad": [g],
+                    "SquaredAccumulator": [self._get_accumulator("squared", p)],
+                    "LinearAccumulator": [self._get_accumulator("linear", p)],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [p],
+                     "SquaredAccumOut": [self._get_accumulator("squared", p)],
+                     "LinearAccumOut": [self._get_accumulator("linear", p)]},
+            attrs={"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power},
+        )
+
+
+class LambOptimizer(AdamOptimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, regularization=None,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon,
+                         regularization, name)
+        self.type = "lamb"
+        self._weight_decay = lamb_weight_decay
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        block.append_op(
+            type="lamb",
+            inputs={"Param": [p], "Grad": [g],
+                    "Moment1": [self._get_accumulator("moment1", p)],
+                    "Moment2": [self._get_accumulator("moment2", p)],
+                    "Beta1Pow": [self._get_accumulator("beta1_pow_acc", p)],
+                    "Beta2Pow": [self._get_accumulator("beta2_pow_acc", p)],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [p],
+                     "Moment1Out": [self._get_accumulator("moment1", p)],
+                     "Moment2Out": [self._get_accumulator("moment2", p)],
+                     "Beta1PowOut": [self._get_accumulator("beta1_pow_acc", p)],
+                     "Beta2PowOut": [self._get_accumulator("beta2_pow_acc", p)]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon,
+                   "weight_decay": self._weight_decay},
+        )
+
+
+class ModelAverage(Optimizer):
+    """Parameter averaging over a sliding window (parity: optimizer.py:2002).
+    apply()/restore() swap averaged params in and out of the scope."""
+
+    def __init__(self, average_window_rate=0.15, min_average_window=10000,
+                 max_average_window=10000, regularization=None, name=None):
+        super().__init__(0.0, regularization, name)
+        self.average_window = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self.params_grads = []
+        prog = default_main_program()
+        for p in prog.global_block().all_parameters():
+            if p.trainable:
+                self.params_grads.append((p, None))
+        self.helper = LayerHelper("model_average")
+        self._create_accumulators(prog.global_block(),
+                                  [p for p, _ in self.params_grads])
+        for pg in self.params_grads:
+            self._append_optimize_op(prog.global_block(), pg)
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("sum_1", p)
+            self._add_accumulator("sum_2", p)
+            self._add_accumulator("sum_3", p)
+            self._add_accumulator("num_accumulates", p, dtype="int64",
+                                  fill_value=0, shape=[1])
+            self._add_accumulator("old_num_accumulates", p, dtype="int64",
+                                  fill_value=0, shape=[1])
+            self._add_accumulator("num_updates", p, dtype="int64",
+                                  fill_value=0, shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, _ = param_and_grad
+        block.append_op(
+            type="average_accumulates",
+            inputs={
+                "param": [p],
+                "in_sum_1": [self._get_accumulator("sum_1", p)],
+                "in_sum_2": [self._get_accumulator("sum_2", p)],
+                "in_sum_3": [self._get_accumulator("sum_3", p)],
+                "in_num_accumulates": [self._get_accumulator("num_accumulates", p)],
+                "in_old_num_accumulates": [self._get_accumulator("old_num_accumulates", p)],
+                "in_num_updates": [self._get_accumulator("num_updates", p)],
+            },
+            outputs={
+                "out_sum_1": [self._get_accumulator("sum_1", p)],
+                "out_sum_2": [self._get_accumulator("sum_2", p)],
+                "out_sum_3": [self._get_accumulator("sum_3", p)],
+                "out_num_accumulates": [self._get_accumulator("num_accumulates", p)],
+                "out_old_num_accumulates": [self._get_accumulator("old_num_accumulates", p)],
+                "out_num_updates": [self._get_accumulator("num_updates", p)],
+            },
+            attrs={"average_window": self.average_window,
+                   "min_average_window": self.min_average_window,
+                   "max_average_window": self.max_average_window},
+        )
+
+    def _param_backup_name(self, p):
+        return p.name + "@MODEL_AVG_BACKUP"
+
+    def apply(self, executor, need_restore=True):
+        """Swap averaged values into the params in the current scope."""
+        from .core.scope import global_scope
+
+        scope = global_scope()
+        for p, _ in self.params_grads:
+            s1 = np.asarray(scope.get(self._get_accumulator("sum_1", p).name))
+            s2 = np.asarray(scope.get(self._get_accumulator("sum_2", p).name))
+            s3 = np.asarray(scope.get(self._get_accumulator("sum_3", p).name))
+            na = int(np.asarray(scope.get(self._get_accumulator("num_accumulates", p).name)).reshape(()))
+            ona = int(np.asarray(scope.get(self._get_accumulator("old_num_accumulates", p).name)).reshape(()))
+            total = max(na + ona, 1)
+            if need_restore:
+                scope.set(self._param_backup_name(p),
+                          np.asarray(scope.get(p.name)))
+            scope.set(p.name, ((s1 + s2 + s3) / total).astype(
+                np.asarray(scope.get(p.name)).dtype))
+
+    def restore(self, executor):
+        from .core.scope import global_scope
+
+        scope = global_scope()
+        for p, _ in self.params_grads:
+            backup = scope.get(self._param_backup_name(p))
+            if backup is not None:
+                scope.set(p.name, backup)
+
+
+class ExponentialMovingAverage:
+    """EMA of params (parity: optimizer.py:2161). update() is appended to the
+    train program; apply()/restore() swap shadow params at eval time."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._name = name or ""
+        self._shadows = {}
+        prog = default_main_program()
+        block = prog.global_block()
+        helper = LayerHelper("ema")
+        self._helper = helper
+        for p in block.all_parameters():
+            if p.trainable:
+                shadow_name = p.name + ".ema"
+                shadow = block.create_var(name=shadow_name, shape=p.shape,
+                                          dtype=p.dtype, persistable=True,
+                                          stop_gradient=True)
+                sb = default_startup_program().global_block()
+                sv = sb.create_var(name=shadow_name, shape=p.shape,
+                                   dtype=p.dtype, persistable=True)
+                Constant(0.0)(sv, sb)
+                self._shadows[p.name] = shadow
+
+    def update(self):
+        prog = default_main_program()
+        block = prog.global_block()
+        for pname, shadow in self._shadows.items():
+            p = block.var(pname)
+            tmp = self._helper.create_variable_for_type_inference(p.dtype)
+            block.append_op(
+                type="scale", inputs={"X": [shadow]}, outputs={"Out": [tmp]},
+                attrs={"scale": self._decay})
+            tmp2 = self._helper.create_variable_for_type_inference(p.dtype)
+            block.append_op(
+                type="scale", inputs={"X": [p]}, outputs={"Out": [tmp2]},
+                attrs={"scale": 1.0 - self._decay})
+            block.append_op(
+                type="elementwise_add", inputs={"X": [tmp], "Y": [tmp2]},
+                outputs={"Out": [shadow]})
+
+    def apply(self, executor=None, need_restore=True):
+        from .core.scope import global_scope
+
+        scope = global_scope()
+        self._backups = {}
+        for pname, shadow in self._shadows.items():
+            if need_restore:
+                self._backups[pname] = np.asarray(scope.get(pname))
+            sval = scope.get(shadow.name)
+            if sval is not None:
+                scope.set(pname, np.asarray(sval))
+        return _EMAGuard(self)
+
+    def restore(self, executor=None):
+        from .core.scope import global_scope
+
+        scope = global_scope()
+        for pname, val in getattr(self, "_backups", {}).items():
+            scope.set(pname, val)
+
+
+class _EMAGuard:
+    def __init__(self, ema):
+        self._ema = ema
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self._ema.restore()
+
+
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adagrad = AdagradOptimizer
+Adam = AdamOptimizer
+Adamax = AdamaxOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+Adadelta = AdadeltaOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer
+Lamb = LambOptimizer
+LarsMomentum = LarsMomentumOptimizer
+DGCMomentum = DGCMomentumOptimizer

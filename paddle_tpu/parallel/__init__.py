@@ -1,0 +1,12 @@
+"""Parallelism package (SURVEY §2.3 P1-P12 TPU-native equivalents).
+
+The reference's ParallelExecutor + NCCL op-handle machinery (C10-C14) maps
+to jax.sharding over a device Mesh; this package holds the mesh/planner
+layer, the data-parallel ParallelExecutor facade, and (beyond the 2019
+reference) tensor/pipeline/sequence/expert parallelism built TPU-first.
+"""
+
+from .mesh import get_mesh, mesh_axis_sizes  # noqa: F401
+from .parallel_executor import ParallelExecutor  # noqa: F401
+
+__all__ = ["ParallelExecutor", "get_mesh", "mesh_axis_sizes"]

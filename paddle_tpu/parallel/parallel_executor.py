@@ -1,0 +1,45 @@
+"""ParallelExecutor facade (parity: framework/parallel_executor.cc:195/:513 +
+python ParallelExecutor wrapper).
+
+TPU-native: no per-device graph replication or op-handle scheduling — the
+program compiles once as an SPMD computation over the data mesh
+(compiler._DataParallelStep); XLA inserts the gradient all-reduces over ICI.
+"""
+
+import numpy as np
+
+from .. import framework
+from ..compiler import BuildStrategy, CompiledProgram, ExecutionStrategy
+
+__all__ = ["ParallelExecutor"]
+
+
+class ParallelExecutor:
+    def __init__(self, use_cuda=True, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None, build_strategy=None,
+                 num_trainers=1, trainer_id=0, scope=None):
+        self._program = main_program or framework.default_main_program()
+        self._compiled = CompiledProgram(self._program).with_data_parallel(
+            loss_name=loss_name,
+            build_strategy=build_strategy or BuildStrategy(),
+            exec_strategy=exec_strategy or ExecutionStrategy(),
+            share_vars_from=share_vars_from and share_vars_from._compiled,
+        )
+        self._scope = scope
+        from ..executor import Executor
+
+        self._exe = Executor()
+
+    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+        feed = feed if feed is not None else feed_dict
+        return self._compiled._run(self._exe, feed, fetch_list, self._scope,
+                                   return_numpy)
+
+    @property
+    def device_count(self):
+        import jax
+
+        return len(jax.devices())
+
+    def drop_local_exe_scopes(self):
+        pass

@@ -1,0 +1,103 @@
+"""Profiler (parity: python/paddle/fluid/profiler.py + platform/profiler.cc
++ tools/timeline.py).
+
+TPU-native: wraps jax.profiler (XPlane) for device traces — the replacement
+for the CUPTI DeviceTracer (SURVEY §5.1) — plus a lightweight host-side
+event aggregator with the reference's calls/avg/max/min table output.
+Traces are viewable in TensorBoard/Perfetto (the chrome://tracing shape the
+reference's timeline.py produced).
+"""
+
+import contextlib
+import os
+import time
+from collections import defaultdict
+
+__all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler",
+           "stop_profiler", "record_event"]
+
+_events = defaultdict(lambda: [0, 0.0, 0.0, float("inf")])  # calls,total,max,min
+_active = [False]
+_trace_dir = [None]
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file, output_mode=None, config=None):
+    """Accelerator passthrough profiler (nvprof parity shim): emits a JAX
+    device trace instead."""
+    with profiler("All", "total", output_file):
+        yield
+
+
+def reset_profiler():
+    _events.clear()
+
+
+def start_profiler(state="All", tracer_option=None, trace_dir=None):
+    if _active[0]:
+        return
+    _active[0] = True
+    if trace_dir:
+        import jax
+
+        _trace_dir[0] = trace_dir
+        jax.profiler.start_trace(trace_dir)
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    if not _active[0]:
+        return
+    _active[0] = False
+    if _trace_dir[0]:
+        import jax
+
+        jax.profiler.stop_trace()
+        _trace_dir[0] = None
+    _print_summary(sorted_key)
+
+
+def _print_summary(sorted_key=None):
+    if not _events:
+        return
+    rows = []
+    for name, (calls, total, mx, mn) in _events.items():
+        rows.append((name, calls, total, total / max(calls, 1), mx, mn))
+    key_idx = {"calls": 1, "total": 2, "ave": 3, "max": 4, "min": 5}.get(
+        sorted_key, 2)
+    rows.sort(key=lambda r: r[key_idx], reverse=True)
+    print("%-40s %8s %12s %12s %12s %12s" % (
+        "Event", "Calls", "Total(ms)", "Avg(ms)", "Max(ms)", "Min(ms)"))
+    for name, calls, total, avg, mx, mn in rows:
+        print("%-40s %8d %12.4f %12.4f %12.4f %12.4f" % (
+            name, calls, total * 1e3, avg * 1e3, mx * 1e3, mn * 1e3))
+
+
+@contextlib.contextmanager
+def record_event(name):
+    """Host-side RAII event marker (parity: platform/profiler.h RecordEvent)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        ev = _events[name]
+        ev[0] += 1
+        ev[1] += dt
+        ev[2] = max(ev[2], dt)
+        ev[3] = min(ev[3], dt)
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/profile",
+             tracer_option=None):
+    """Context profiler (parity: fluid.profiler.profiler). Starts a JAX
+    device trace when profile_path is a directory-like path."""
+    trace_dir = None
+    if profile_path and not profile_path.endswith((".txt", ".pb")):
+        trace_dir = profile_path
+        os.makedirs(trace_dir, exist_ok=True)
+    start_profiler(state, tracer_option, trace_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)

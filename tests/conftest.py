@@ -1,0 +1,43 @@
+"""Test config: run on a virtual 8-device CPU mesh so sharding paths are
+exercised without TPU hardware (SURVEY §4 implication — the multi-process
+trick maps to XLA_FLAGS=--xla_force_host_platform_device_count=N).
+
+NOTE: this environment pins JAX_PLATFORMS=axon (TPU); the env var alone
+does not win against the plugin, so we must also jax.config.update.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    """Each test gets fresh default programs, scope and name counters."""
+    import paddle_tpu as fluid
+    from paddle_tpu import framework, unique_name
+    from paddle_tpu.core import scope as scope_mod
+
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    old_gen = unique_name.switch()
+    scope_mod._scope_stack[:] = [scope_mod.Scope()]
+    np.random.seed(42)
+    yield
+    unique_name.switch(old_gen)
+
+
+def assert_devices():
+    assert len(jax.devices()) == 8, jax.devices()

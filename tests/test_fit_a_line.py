@@ -1,0 +1,83 @@
+"""E2E acceptance: fit-a-line linear regression (parity:
+python/paddle/fluid/tests/book/test_fit_a_line.py:27-68 — train loop with
+decreasing loss, then save + reload + infer :96-120)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _make_data(n=256):
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-1, 1, size=(n, 13)).astype(np.float32)
+    w = rng.uniform(-2, 2, size=(13, 1)).astype(np.float32)
+    y = x @ w + 0.5 + rng.normal(scale=0.01, size=(n, 1)).astype(np.float32)
+    return x, y
+
+
+def test_fit_a_line_trains_and_infers(tmp_path):
+    x_data, y_data = _make_data()
+
+    x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    y_predict = fluid.layers.fc(input=x, size=1, act=None)
+    cost = fluid.layers.square_error_cost(input=y_predict, label=y)
+    avg_cost = fluid.layers.mean(cost)
+
+    sgd = fluid.optimizer.SGD(learning_rate=0.05)
+    sgd.minimize(avg_cost)
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+
+    batch = 64
+    losses = []
+    for epoch in range(30):
+        for i in range(0, len(x_data), batch):
+            loss_val, = exe.run(
+                fluid.default_main_program(),
+                feed={"x": x_data[i : i + batch], "y": y_data[i : i + batch]},
+                fetch_list=[avg_cost],
+            )
+        losses.append(float(loss_val[0]))
+
+    assert losses[-1] < losses[0] * 0.2, "loss must decrease: %s" % losses
+    assert losses[-1] < 0.1, "final loss too high: %s" % losses[-1]
+
+    # save + reload + infer (book test :96-120)
+    model_dir = str(tmp_path / "fit_a_line.model")
+    fluid.io.save_inference_model(model_dir, ["x"], [y_predict], exe)
+
+    infer_prog, feed_names, fetch_vars = fluid.io.load_inference_model(
+        model_dir, exe)
+    preds, = exe.run(infer_prog, feed={feed_names[0]: x_data[:8]},
+                     fetch_list=fetch_vars)
+    assert preds.shape == (8, 1)
+    np.testing.assert_allclose(preds, x_data[:8] @ np.asarray(
+        fluid.global_scope().get(
+            infer_prog.global_block().all_parameters()[0].name)), atol=1.0)
+
+
+def test_param_values_update():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    params = fluid.default_main_program().all_parameters()
+    before = {p.name: np.asarray(fluid.global_scope().get(p.name)).copy()
+              for p in params}
+    xd = np.random.rand(16, 4).astype(np.float32)
+    yd = np.random.rand(16, 1).astype(np.float32)
+    exe.run(feed={"x": xd, "y": yd}, fetch_list=[loss])
+    after = {p.name: np.asarray(fluid.global_scope().get(p.name))
+             for p in params}
+    for name in before:
+        assert not np.allclose(before[name], after[name]), \
+            "param %s did not update" % name
