@@ -1,0 +1,84 @@
+"""Benchmark harness (parity: benchmark/fluid/fluid_benchmark.py — prints
+throughput the same way, normalized per chip).
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+
+Default benchmark: Transformer-base LM training throughput, tokens/sec/chip
+on the attached accelerator (BASELINE.json north-star metric). The
+vs_baseline denominator is 90% of a published A100 transformer-base
+training figure (~55k tokens/s/GPU for a 65M-param model in bf16) per the
+BASELINE.md note that the reference repo publishes no numbers of its own.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+# 90% of A100 transformer-base tokens/sec (north star: >= 90% of A100)
+BASELINE_TOKENS_PER_SEC = 0.9 * 55000.0
+
+
+def bench_transformer(steps=20, warmup=3, batch=16, seq=512):
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.transformer import (
+        TransformerConfig, init_params, single_chip_loss)
+
+    cfg = TransformerConfig(
+        vocab_size=32000, d_model=512, n_heads=8, n_layers=6, d_ff=2048,
+        max_seq_len=seq, dtype=jnp.bfloat16, remat=False)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    params = jax.tree.map(lambda x: x.astype(jnp.bfloat16)
+                          if x.dtype == jnp.float32 and x.ndim >= 2 else x,
+                          params)
+
+    lr = 1e-4
+
+    def train_step(params, tokens, labels):
+        loss, grads = jax.value_and_grad(
+            lambda p: single_chip_loss(p, tokens, labels, cfg))(params)
+        new_params = jax.tree.map(
+            lambda p, g: (p.astype(jnp.float32)
+                          - lr * g.astype(jnp.float32)).astype(p.dtype),
+            params, grads)
+        return new_params, loss
+
+    step = jax.jit(train_step, donate_argnums=(0,))
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32)
+    labs = np.roll(toks, -1, axis=1).astype(np.int32)
+
+    # IMPORTANT: sync via host transfer each step — on the experimental
+    # axon TPU platform block_until_ready does not reliably block, and
+    # queuing many large async steps can wedge the device tunnel.
+    for _ in range(warmup):
+        params, loss = step(params, toks, labs)
+        float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, loss = step(params, toks, labs)
+        float(loss)
+    dt = time.perf_counter() - t0
+
+    n_chips = 1  # single-chip bench; per-chip normalization
+    tokens_per_sec = steps * batch * seq / dt / n_chips
+    return tokens_per_sec, float(loss)
+
+
+def main():
+    tokens_per_sec, last_loss = bench_transformer()
+    print(json.dumps({
+        "metric": "transformer_base_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(tokens_per_sec / BASELINE_TOKENS_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -102,15 +102,13 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
             attrs={"__op_role__": "backward"},
         )
     else:
+        # fill_any_like (not fill_constant) so targets with symbolic -1
+        # batch dims get their cotangent shape from the runtime value
         block.append_op(
-            type="fill_constant",
+            type="fill_any_like",
+            inputs={"X": [loss]},
             outputs={"Out": [loss_grad]},
-            attrs={
-                "shape": list(loss.shape or (1,)),
-                "value": 1.0,
-                "dtype": loss.dtype,
-                "__op_role__": "backward",
-            },
+            attrs={"value": 1.0, "__op_role__": "backward"},
         )
 
     grad_map = {loss.name: loss_grad_name}  # primal name -> grad var name
@@ -209,12 +207,26 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
             target_gradients, (list, tuple)):
         target_gradients = [target_gradients]
     leaves = tuple(v.name for v in inputs)
-    for i, loss in enumerate(targets):
-        tg = None
-        if target_gradients is not None and i < len(target_gradients):
-            tg = target_gradients[i]
-        append_backward(loss, parameter_list=None, no_grad_set=no_grad_set,
-                        _extra_leaves=leaves, _target_gradients=tg)
+    if len(targets) == 1 and target_gradients is None:
+        append_backward(targets[0], parameter_list=None,
+                        no_grad_set=no_grad_set, _extra_leaves=leaves)
+    else:
+        # multiple targets / explicit cotangents: differentiate the scalar
+        # L = Σ_i sum(y_i ⊙ tg_i), whose gradient is the accumulated
+        # per-target contribution (Fluid calc_gradient semantics)
+        from . import layers
+
+        with framework.program_guard(targets[0].block.program):
+            parts = []
+            for i, y in enumerate(targets):
+                tg = None
+                if target_gradients is not None and i < len(target_gradients):
+                    tg = target_gradients[i]
+                term = y if tg is None else layers.elementwise_mul(y, tg)
+                parts.append(layers.reduce_sum(term))
+            total = parts[0] if len(parts) == 1 else layers.sums(parts)
+            append_backward(total, parameter_list=None,
+                            no_grad_set=no_grad_set, _extra_leaves=leaves)
     block = targets[0].block
     outs = []
     for v in inputs:

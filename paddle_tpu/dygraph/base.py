@@ -53,7 +53,10 @@ class Tracer:
                    for v in vs]
             for slot, vs in ins.items() if vs
         }
-        outs = opdef.impl(self.ctx(), jins, attrs)
+        # the ctx (and its RNG key) is captured on the tape so the backward
+        # vjp-recompute sees the IDENTICAL dropout mask / random draw
+        ctx = self.ctx()
+        outs = opdef.impl(ctx, jins, attrs)
         vouts = {}
         stop = all(
             getattr(v, "stop_gradient", True)
@@ -63,7 +66,8 @@ class Tracer:
             produced = outs.get(slot, [])
             vouts[slot] = [VarBase(p, stop_gradient=stop) for p in produced]
         if self.tape.recording and not stop:
-            self.tape.entries.append((op_type, dict(ins), dict(attrs), vouts))
+            self.tape.entries.append(
+                (op_type, dict(ins), dict(attrs), vouts, ctx))
         return vouts
 
 
@@ -185,8 +189,7 @@ def run_backward(root, tape):
     (parity: imperative/layer.cc Autograd::RunBackward)."""
     grads = {}  # id(VarBase) -> jnp array
     grads[id(root)] = jnp.ones_like(root.value)
-    ctx_tracer = _current_tracer()
-    for op_type, ins, attrs, vouts in reversed(tape.entries):
+    for op_type, ins, attrs, vouts, fwd_ctx in reversed(tape.entries):
         opdef = registry.get(op_type)
         out_cots_needed = any(
             id(v) in grads for vs in vouts.values() for v in vs
@@ -205,11 +208,10 @@ def run_backward(root, tape):
         ]
         const_ins = {s: v for s, v in jins.items() if s not in diff_slots}
         diff_ins = {s: jins[s] for s in diff_slots}
-        ctx = ctx_tracer.ctx() if ctx_tracer else LoweringContext(
-            jax.random.PRNGKey(0))
 
         def f(d):
-            return opdef.impl(ctx, {**const_ins, **d}, attrs)
+            # replay with the forward op's OWN ctx: identical RNG draws
+            return opdef.impl(fwd_ctx, {**const_ins, **d}, attrs)
 
         primal_out, vjp_fn = jax.vjp(f, diff_ins)
         cots = {}
@@ -238,7 +240,7 @@ def run_backward(root, tape):
                 prev = grads.get(id(v))
                 grads[id(v)] = g if prev is None else prev + g
     # write grads back onto leaves
-    for op_type, ins, attrs, vouts in tape.entries:
+    for op_type, ins, attrs, vouts, _ctx in tape.entries:
         for vs in list(ins.values()) + list(vouts.values()):
             for v in vs:
                 if isinstance(v, VarBase) and id(v) in grads:

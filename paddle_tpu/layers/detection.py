@@ -1,0 +1,389 @@
+"""Detection layers (parity: python/paddle/fluid/layers/detection.py —
+prior_box, multi_box_head, multiclass_nms, box_coder, detection_output,
+ssd_loss, yolo_box, yolov3_loss, iou_similarity, bipartite_match,
+target_assign, detection_map, anchor_generator, roi_align/pool, box_clip,
+polygon_box_transform...)."""
+
+import numpy as np
+
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "prior_box", "density_prior_box", "anchor_generator", "iou_similarity",
+    "box_coder", "box_clip", "bipartite_match", "target_assign",
+    "multiclass_nms", "detection_output", "ssd_loss", "yolo_box",
+    "yolov3_loss", "detection_map", "polygon_box_transform", "roi_align",
+    "roi_pool", "multi_box_head", "generate_proposals",
+]
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=[1.],
+              variance=[0.1, 0.1, 0.2, 0.2], flip=False, clip=False,
+              steps=[0.0, 0.0], offset=0.5, name=None,
+              min_max_aspect_ratios_order=False):
+    helper = LayerHelper("prior_box", **locals())
+    boxes = helper.create_variable_for_type_inference("float32", True)
+    var = helper.create_variable_for_type_inference("float32", True)
+    helper.append_op(
+        type="prior_box", inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [boxes], "Variances": [var]},
+        attrs={"min_sizes": list(min_sizes),
+               "max_sizes": list(max_sizes or []),
+               "aspect_ratios": list(aspect_ratios),
+               "variances": list(variance), "flip": flip, "clip": clip,
+               "step_w": steps[0], "step_h": steps[1], "offset": offset},
+    )
+    return boxes, var
+
+
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=[0.1, 0.1, 0.2, 0.2],
+                      clip=False, steps=[0.0, 0.0], offset=0.5,
+                      flatten_to_2d=False, name=None):
+    helper = LayerHelper("density_prior_box", **locals())
+    boxes = helper.create_variable_for_type_inference("float32", True)
+    var = helper.create_variable_for_type_inference("float32", True)
+    helper.append_op(
+        type="density_prior_box",
+        inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [boxes], "Variances": [var]},
+        attrs={"densities": list(densities or []),
+               "fixed_sizes": list(fixed_sizes or []),
+               "fixed_ratios": list(fixed_ratios or []),
+               "variances": list(variance), "clip": clip,
+               "step_w": steps[0], "step_h": steps[1], "offset": offset},
+    )
+    return boxes, var
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=[0.1, 0.1, 0.2, 0.2], stride=None, offset=0.5,
+                     name=None):
+    helper = LayerHelper("anchor_generator", **locals())
+    anchors = helper.create_variable_for_type_inference("float32", True)
+    var = helper.create_variable_for_type_inference("float32", True)
+    helper.append_op(
+        type="anchor_generator", inputs={"Input": [input]},
+        outputs={"Anchors": [anchors], "Variances": [var]},
+        attrs={"anchor_sizes": list(anchor_sizes),
+               "aspect_ratios": list(aspect_ratios),
+               "variances": list(variance), "stride": list(stride),
+               "offset": offset},
+    )
+    return anchors, var
+
+
+def iou_similarity(x, y, name=None):
+    helper = LayerHelper("iou_similarity", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="iou_similarity", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    if x.shape is not None and y.shape is not None:
+        out.shape = (x.shape[0], y.shape[0])
+    return out
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, name=None,
+              axis=0):
+    helper = LayerHelper("box_coder", **locals())
+    out = helper.create_variable_for_type_inference(target_box.dtype)
+    inputs = {"PriorBox": [prior_box], "TargetBox": [target_box]}
+    if isinstance(prior_box_var, Variable):
+        inputs["PriorBoxVar"] = [prior_box_var]
+    helper.append_op(
+        type="box_coder", inputs=inputs, outputs={"OutputBox": [out]},
+        attrs={"code_type": code_type, "box_normalized": box_normalized,
+               "axis": axis},
+    )
+    return out
+
+
+def box_clip(input, im_info, name=None):
+    helper = LayerHelper("box_clip", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="box_clip",
+                     inputs={"Input": [input], "ImInfo": [im_info]},
+                     outputs={"Output": [out]})
+    out.shape = input.shape
+    return out
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    helper = LayerHelper("bipartite_match", **locals())
+    match_indices = helper.create_variable_for_type_inference("int32", True)
+    match_distance = helper.create_variable_for_type_inference(
+        dist_matrix.dtype, True)
+    helper.append_op(
+        type="bipartite_match", inputs={"DistMat": [dist_matrix]},
+        outputs={"ColToRowMatchIndices": [match_indices],
+                 "ColToRowMatchDist": [match_distance]},
+        attrs={"match_type": match_type or "bipartite",
+               "dist_threshold": dist_threshold or 0.5},
+    )
+    return match_indices, match_distance
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=None, name=None):
+    helper = LayerHelper("target_assign", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out_weight = helper.create_variable_for_type_inference("float32", True)
+    helper.append_op(
+        type="target_assign",
+        inputs={"X": [input], "MatchIndices": [matched_indices]},
+        outputs={"Out": [out], "OutWeight": [out_weight]},
+        attrs={"mismatch_value": mismatch_value or 0},
+    )
+    return out, out_weight
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, name=None):
+    helper = LayerHelper("multiclass_nms", **locals())
+    out = helper.create_variable_for_type_inference(bboxes.dtype)
+    helper.append_op(
+        type="multiclass_nms",
+        inputs={"BBoxes": [bboxes], "Scores": [scores]},
+        outputs={"Out": [out]},
+        attrs={"score_threshold": score_threshold, "nms_top_k": nms_top_k,
+               "keep_top_k": keep_top_k, "nms_threshold": nms_threshold,
+               "background_label": background_label,
+               "normalized": normalized, "nms_eta": nms_eta},
+    )
+    if bboxes.shape is not None:
+        out.shape = (bboxes.shape[0], keep_top_k, 6)
+    return out
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0):
+    """SSD head decode + NMS (layers/detection.py detection_output)."""
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size")
+    from . import nn
+
+    scores_t = nn.transpose(scores, perm=[0, 2, 1])  # [N, C, M]
+    return multiclass_nms(decoded, scores_t, score_threshold, nms_top_k,
+                          keep_top_k, nms_threshold,
+                          background_label=background_label)
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mining_type="max_negative", normalize=True,
+             sample_size=None):
+    """SSD multibox loss composed from matching + target assign + smooth_l1
+    + softmax CE (layers/detection.py ssd_loss). Works on padded gt arrays
+    (invalid gt rows have label < 0)."""
+    from . import nn
+
+    iou = iou_similarity(gt_box, prior_box)  # [G, M] per batch? padded form
+    matched, match_dist = bipartite_match(iou, match_type, neg_overlap)
+    # conf targets
+    conf_target, conf_w = target_assign(gt_label, matched,
+                                        mismatch_value=background_label)
+    loc_target, loc_w = target_assign(gt_box, matched, mismatch_value=0)
+    enc = box_coder(prior_box, prior_box_var, loc_target) \
+        if prior_box_var is not None else loc_target
+    loc_loss = nn.smooth_l1(location, enc)
+    conf_loss = nn.softmax_with_cross_entropy(confidence, conf_target)
+    total = nn.elementwise_add(
+        nn.scale(nn.reduce_mean(loc_loss), scale=loc_loss_weight),
+        nn.scale(nn.reduce_mean(conf_loss), scale=conf_loss_weight))
+    return total
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, name=None):
+    helper = LayerHelper("yolo_box", **locals())
+    boxes = helper.create_variable_for_type_inference(x.dtype)
+    scores = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="yolo_box", inputs={"X": [x], "ImgSize": [img_size]},
+        outputs={"Boxes": [boxes], "Scores": [scores]},
+        attrs={"anchors": list(anchors), "class_num": class_num,
+               "conf_thresh": conf_thresh,
+               "downsample_ratio": downsample_ratio},
+    )
+    return boxes, scores
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, name=None):
+    helper = LayerHelper("yolov3_loss", **locals())
+    loss = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": [x], "GTBox": [gt_box], "GTLabel": [gt_label]}
+    if gt_score is not None:
+        inputs["GTScore"] = [gt_score]
+    helper.append_op(
+        type="yolov3_loss", inputs=inputs, outputs={"Loss": [loss]},
+        attrs={"anchors": list(anchors), "anchor_mask": list(anchor_mask),
+               "class_num": class_num, "ignore_thresh": ignore_thresh,
+               "downsample_ratio": downsample_ratio,
+               "use_label_smooth": use_label_smooth},
+    )
+    if x.shape is not None:
+        loss.shape = (x.shape[0],)
+    return loss
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.3, evaluate_difficult=True,
+                  has_state=None, input_states=None, out_states=None,
+                  ap_version="integral", gt_box=None, gt_difficult=None):
+    helper = LayerHelper("detection_map", **locals())
+    map_out = helper.create_variable_for_type_inference("float32", True)
+    pos_cnt = helper.create_variable_for_type_inference("int32", True)
+    true_pos = helper.create_variable_for_type_inference("float32", True)
+    false_pos = helper.create_variable_for_type_inference("float32", True)
+    inputs = {"DetectRes": [detect_res], "Label": [label]}
+    if gt_box is not None:
+        inputs["GTBox"] = [gt_box]
+    helper.append_op(
+        type="detection_map", inputs=inputs,
+        outputs={"MAP": [map_out], "AccumPosCount": [pos_cnt],
+                 "AccumTruePos": [true_pos], "AccumFalsePos": [false_pos]},
+        attrs={"class_num": class_num, "background_label": background_label,
+               "overlap_threshold": overlap_threshold,
+               "evaluate_difficult": evaluate_difficult,
+               "ap_type": ap_version},
+    )
+    map_out.shape = (1,)
+    return map_out
+
+
+def polygon_box_transform(input, name=None):
+    helper = LayerHelper("polygon_box_transform", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="polygon_box_transform", inputs={"Input": [input]},
+                     outputs={"Output": [out]})
+    out.shape = input.shape
+    return out
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, name=None,
+              rois_batch_id=None):
+    helper = LayerHelper("roi_align", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_batch_id is not None:
+        inputs["BatchId"] = [rois_batch_id]
+    helper.append_op(
+        type="roi_align", inputs=inputs, outputs={"Out": [out]},
+        attrs={"pooled_height": pooled_height, "pooled_width": pooled_width,
+               "spatial_scale": spatial_scale,
+               "sampling_ratio": sampling_ratio},
+    )
+    if input.shape is not None and rois.shape is not None:
+        out.shape = (rois.shape[0], input.shape[1], pooled_height,
+                     pooled_width)
+    return out
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, rois_batch_id=None):
+    helper = LayerHelper("roi_pool", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    argmax = helper.create_variable_for_type_inference("int32", True)
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_batch_id is not None:
+        inputs["BatchId"] = [rois_batch_id]
+    helper.append_op(
+        type="roi_pool", inputs=inputs,
+        outputs={"Out": [out], "Argmax": [argmax]},
+        attrs={"pooled_height": pooled_height, "pooled_width": pooled_width,
+               "spatial_scale": spatial_scale},
+    )
+    if input.shape is not None and rois.shape is not None:
+        out.shape = (rois.shape[0], input.shape[1], pooled_height,
+                     pooled_width)
+    return out
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None):
+    helper = LayerHelper("generate_proposals", **locals())
+    rois = helper.create_variable_for_type_inference(scores.dtype, True)
+    roi_probs = helper.create_variable_for_type_inference(scores.dtype, True)
+    helper.append_op(
+        type="generate_proposals",
+        inputs={"Scores": [scores], "BboxDeltas": [bbox_deltas],
+                "ImInfo": [im_info], "Anchors": [anchors],
+                "Variances": [variances]},
+        outputs={"RpnRois": [rois], "RpnRoiProbs": [roi_probs]},
+        attrs={"pre_nms_topN": pre_nms_top_n, "post_nms_topN": post_nms_top_n,
+               "nms_thresh": nms_thresh, "min_size": min_size, "eta": eta},
+    )
+    return rois, roi_probs
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=[0.1, 0.1, 0.2, 0.2], flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD multibox head over multiple feature maps (layers/detection.py
+    multi_box_head)."""
+    from . import nn, tensor
+
+    if min_sizes is None:
+        num_layer = len(inputs)
+        min_sizes = []
+        max_sizes = []
+        step = int(np.floor((max_ratio - min_ratio) / (num_layer - 2)))
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.1] + min_sizes
+        max_sizes = [base_size * 0.2] + max_sizes
+
+    locs, confs, boxes_list, vars_list = [], [], [], []
+    for i, inp in enumerate(inputs):
+        ms = min_sizes[i]
+        ms = [ms] if not isinstance(ms, list) else ms
+        Ms = None
+        if max_sizes:
+            Ms = max_sizes[i]
+            Ms = [Ms] if not isinstance(Ms, list) else Ms
+        ar = aspect_ratios[i]
+        ar = [ar] if not isinstance(ar, list) else ar
+        step_ = [step_w[i] if step_w else 0.0, step_h[i] if step_h else 0.0] \
+            if (step_w or step_h) else (
+                [steps[i], steps[i]] if steps else [0.0, 0.0])
+        box, var = prior_box(inp, image, ms, Ms, ar, variance, flip, clip,
+                             step_, offset)
+        num_boxes = 1
+        n_ar = len(ar) * 2 - 1 if flip else len(ar)
+        num_boxes = len(ms) * (1 + (1 if flip else 0)) + n_ar - 1 + (
+            len(Ms) if Ms else 0)
+        # prior_box returns [H, W, nb, 4]; count from its shape
+        num_loc = num_boxes * 4
+        mbox_loc = nn.conv2d(input=inp, num_filters=num_loc,
+                             filter_size=kernel_size, padding=pad,
+                             stride=stride)
+        mbox_loc = nn.transpose(mbox_loc, perm=[0, 2, 3, 1])
+        locs.append(nn.reshape(mbox_loc, shape=[0, -1, 4]))
+        num_conf = num_boxes * num_classes
+        mbox_conf = nn.conv2d(input=inp, num_filters=num_conf,
+                              filter_size=kernel_size, padding=pad,
+                              stride=stride)
+        mbox_conf = nn.transpose(mbox_conf, perm=[0, 2, 3, 1])
+        confs.append(nn.reshape(mbox_conf, shape=[0, -1, num_classes]))
+        boxes_list.append(nn.reshape(box, shape=[-1, 4]))
+        vars_list.append(nn.reshape(var, shape=[-1, 4]))
+    mbox_locs = tensor.concat(locs, axis=1)
+    mbox_confs = tensor.concat(confs, axis=1)
+    boxes = tensor.concat(boxes_list, axis=0)
+    box_vars = tensor.concat(vars_list, axis=0)
+    return mbox_locs, mbox_confs, boxes, box_vars

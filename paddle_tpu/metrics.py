@@ -185,16 +185,15 @@ class DetectionMAP:
     def __init__(self, input, gt_label, gt_box, gt_difficult=None,
                  class_num=None, background_label=0, overlap_threshold=0.5,
                  evaluate_difficult=True, ap_version="integral"):
-        from . import layers
+        from .layers import detection
 
         self.helper_states = []
-        label = layers.concat([gt_label, gt_box], axis=1) \
-            if gt_difficult is None else layers.concat(
-                [gt_label, gt_difficult, gt_box], axis=1)
-        self.map = layers.detection.detection_map(
-            input, label, class_num, background_label,
+        label = None
+        self.map = detection.detection_map(
+            input, gt_label, class_num, background_label,
             overlap_threshold=overlap_threshold,
-            evaluate_difficult=evaluate_difficult, ap_version=ap_version)
+            evaluate_difficult=evaluate_difficult, ap_version=ap_version,
+            gt_box=gt_box, gt_difficult=gt_difficult)
 
     def get_map_var(self):
         return self.map

@@ -11,3 +11,6 @@ from . import nn_ops  # noqa: F401
 from . import conv  # noqa: F401
 from . import loss_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
+from . import rnn_ops  # noqa: F401
+from . import sequence_ops  # noqa: F401
+from . import detection_ops  # noqa: F401

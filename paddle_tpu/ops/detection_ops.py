@@ -1,0 +1,623 @@
+"""Detection ops (parity: operators/detection/, 56 files — prior_box,
+multiclass_nms, yolo_box, yolov3_loss, box_coder, iou_similarity,
+bipartite_match, target_assign, box_clip, anchor_generator,
+density_prior_box, detection_map ...).
+
+Static-shape doctrine: ops that emit variable-length results in the
+reference (NMS, detection_map matches) emit fixed-capacity tensors padded
+with -1 labels / zero scores plus masks — the XLA-compilable equivalent of
+LoD outputs.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+
+
+def _iou_matrix(a, b):
+    """a [N,4] b [M,4] xyxy -> [N, M] IoU."""
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * jnp.maximum(
+        a[:, 3] - a[:, 1], 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * jnp.maximum(
+        b[:, 3] - b[:, 1], 0)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+@register("iou_similarity", differentiable=False)
+def _iou_similarity(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": [_iou_matrix(x, y)]}
+
+
+@register("prior_box", differentiable=False)
+def _prior_box(ctx, ins, attrs):
+    """SSD prior boxes over the feature map grid (detection/prior_box_op)."""
+    feat = ins["Input"][0]  # [N, C, H, W]
+    image = ins["Image"][0]  # [N, C, IH, IW]
+    min_sizes = attrs["min_sizes"]
+    max_sizes = attrs.get("max_sizes", [])
+    ars_in = attrs.get("aspect_ratios", [1.0])
+    flip = attrs.get("flip", False)
+    clip = attrs.get("clip", False)
+    step_w = attrs.get("step_w", 0.0)
+    step_h = attrs.get("step_h", 0.0)
+    offset = attrs.get("offset", 0.5)
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    H, W = feat.shape[2], feat.shape[3]
+    IH, IW = image.shape[2], image.shape[3]
+    sw = step_w or IW / W
+    sh = step_h or IH / H
+    ars = [1.0]
+    for ar in ars_in:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+
+    boxes = []
+    for ms_i, ms in enumerate(min_sizes):
+        for ar in ars:
+            bw = ms * np.sqrt(ar) / 2.0
+            bh = ms / np.sqrt(ar) / 2.0
+            boxes.append((bw, bh))
+        if max_sizes:
+            Ms = max_sizes[ms_i]
+            s = np.sqrt(ms * Ms) / 2.0
+            boxes.append((s, s))
+    nb = len(boxes)
+    cx = (np.arange(W) + offset) * sw
+    cy = (np.arange(H) + offset) * sh
+    gx, gy = np.meshgrid(cx, cy)  # [H, W]
+    out = np.zeros((H, W, nb, 4), np.float32)
+    for i, (bw, bh) in enumerate(boxes):
+        out[:, :, i, 0] = (gx - bw) / IW
+        out[:, :, i, 1] = (gy - bh) / IH
+        out[:, :, i, 2] = (gx + bw) / IW
+        out[:, :, i, 3] = (gy + bh) / IH
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variances, np.float32),
+                          out.shape).copy()
+    return {"Boxes": [jnp.asarray(out)], "Variances": [jnp.asarray(var)]}
+
+
+@register("density_prior_box", differentiable=False)
+def _density_prior_box(ctx, ins, attrs):
+    feat = ins["Input"][0]
+    image = ins["Image"][0]
+    fixed_sizes = attrs.get("fixed_sizes", [])
+    fixed_ratios = attrs.get("fixed_ratios", [1.0])
+    densities = attrs.get("densities", [1])
+    step_w = attrs.get("step_w", 0.0)
+    step_h = attrs.get("step_h", 0.0)
+    offset = attrs.get("offset", 0.5)
+    clip = attrs.get("clip", False)
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    H, W = feat.shape[2], feat.shape[3]
+    IH, IW = image.shape[2], image.shape[3]
+    sw = step_w or IW / W
+    sh = step_h or IH / H
+    all_boxes = []
+    for size, density in zip(fixed_sizes, densities):
+        for ratio in fixed_ratios:
+            bw = size * np.sqrt(ratio)
+            bh = size / np.sqrt(ratio)
+            step = 1.0 / density
+            for di in range(density):
+                for dj in range(density):
+                    cx_off = (dj + 0.5) * step - 0.5
+                    cy_off = (di + 0.5) * step - 0.5
+                    all_boxes.append((cx_off, cy_off, bw, bh))
+    nb = len(all_boxes)
+    cx = (np.arange(W) + offset) * sw
+    cy = (np.arange(H) + offset) * sh
+    gx, gy = np.meshgrid(cx, cy)
+    out = np.zeros((H, W, nb, 4), np.float32)
+    for i, (cxo, cyo, bw, bh) in enumerate(all_boxes):
+        ccx = gx + cxo * sw
+        ccy = gy + cyo * sh
+        out[:, :, i, 0] = (ccx - bw / 2) / IW
+        out[:, :, i, 1] = (ccy - bh / 2) / IH
+        out[:, :, i, 2] = (ccx + bw / 2) / IW
+        out[:, :, i, 3] = (ccy + bh / 2) / IH
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variances, np.float32), out.shape).copy()
+    return {"Boxes": [jnp.asarray(out)], "Variances": [jnp.asarray(var)]}
+
+
+@register("anchor_generator", differentiable=False)
+def _anchor_generator(ctx, ins, attrs):
+    feat = ins["Input"][0]
+    anchor_sizes = attrs["anchor_sizes"]
+    aspect_ratios = attrs["aspect_ratios"]
+    stride = attrs["stride"]
+    offset = attrs.get("offset", 0.5)
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    H, W = feat.shape[2], feat.shape[3]
+    base = []
+    for ar in aspect_ratios:
+        for s in anchor_sizes:
+            w = s * np.sqrt(ar)
+            h = s / np.sqrt(ar)
+            base.append((w, h))
+    nb = len(base)
+    cx = (np.arange(W) + offset) * stride[0]
+    cy = (np.arange(H) + offset) * stride[1]
+    gx, gy = np.meshgrid(cx, cy)
+    out = np.zeros((H, W, nb, 4), np.float32)
+    for i, (w, h) in enumerate(base):
+        out[:, :, i, 0] = gx - w / 2
+        out[:, :, i, 1] = gy - h / 2
+        out[:, :, i, 2] = gx + w / 2
+        out[:, :, i, 3] = gy + h / 2
+    var = np.broadcast_to(np.asarray(variances, np.float32), out.shape).copy()
+    return {"Anchors": [jnp.asarray(out)], "Variances": [jnp.asarray(var)]}
+
+
+@register("box_coder", differentiable=False)
+def _box_coder(ctx, ins, attrs):
+    prior = ins["PriorBox"][0]  # [M, 4]
+    target = ins["TargetBox"][0]
+    code_type = attrs.get("code_type", "encode_center_size")
+    norm = attrs.get("box_normalized", True)
+    pv = ins["PriorBoxVar"][0] if ins.get("PriorBoxVar") else None
+    add = 0.0 if norm else 1.0
+    pw = prior[:, 2] - prior[:, 0] + add
+    ph = prior[:, 3] - prior[:, 1] + add
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph / 2
+    if pv is None:
+        pv = jnp.ones((4,), jnp.float32)
+        pvx, pvy, pvw, pvh = pv[0], pv[1], pv[2], pv[3]
+    elif pv.ndim == 1:
+        pvx, pvy, pvw, pvh = pv[0], pv[1], pv[2], pv[3]
+    else:
+        pvx, pvy, pvw, pvh = pv[:, 0], pv[:, 1], pv[:, 2], pv[:, 3]
+    if code_type.lower() == "encode_center_size":
+        tw = target[:, 2] - target[:, 0] + add
+        th = target[:, 3] - target[:, 1] + add
+        tcx = target[:, 0] + tw / 2
+        tcy = target[:, 1] + th / 2
+        ox = (tcx[:, None] - pcx[None]) / pw[None] / pvx
+        oy = (tcy[:, None] - pcy[None]) / ph[None] / pvy
+        ow = jnp.log(jnp.maximum(tw[:, None] / pw[None], 1e-10)) / pvw
+        oh = jnp.log(jnp.maximum(th[:, None] / ph[None], 1e-10)) / pvh
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)  # [N, M, 4]
+    else:  # decode_center_size
+        # target: [N, M, 4] deltas (or [N, 4] broadcast)
+        t = target if target.ndim == 3 else target[:, None, :]
+        dcx = pvx * t[..., 0] * pw + pcx
+        dcy = pvy * t[..., 1] * ph + pcy
+        dw = jnp.exp(jnp.minimum(pvw * t[..., 2], 20.0)) * pw
+        dh = jnp.exp(jnp.minimum(pvh * t[..., 3], 20.0)) * ph
+        out = jnp.stack([dcx - dw / 2, dcy - dh / 2,
+                         dcx + dw / 2 - add, dcy + dh / 2 - add], axis=-1)
+    return {"OutputBox": [out]}
+
+
+@register("box_clip", differentiable=False)
+def _box_clip(ctx, ins, attrs):
+    x = ins["Input"][0]
+    im_info = ins["ImInfo"][0]  # [N, 3] (h, w, scale)
+    h = im_info[:, 0] - 1
+    w = im_info[:, 1] - 1
+    while h.ndim < x.ndim - 1:
+        h = h[:, None]
+        w = w[:, None]
+    out = jnp.stack([
+        jnp.clip(x[..., 0], 0, w), jnp.clip(x[..., 1], 0, h),
+        jnp.clip(x[..., 2], 0, w), jnp.clip(x[..., 3], 0, h)], axis=-1)
+    return {"Output": [out]}
+
+
+@register("bipartite_match", differentiable=False)
+def _bipartite_match(ctx, ins, attrs):
+    """Greedy bipartite matching (detection/bipartite_match_op.cc):
+    DistMat [M, N] (gt x prior)."""
+    dist = ins["DistMat"][0]
+    M, N = dist.shape
+
+    def body(carry, _):
+        d, match_idx, match_dist = carry
+        flat = jnp.argmax(d)
+        i, j = flat // N, flat % N
+        best = d[i, j]
+        do = best > -1e9
+        match_idx = jnp.where(do, match_idx.at[j].set(i), match_idx)
+        match_dist = jnp.where(do, match_dist.at[j].set(best), match_dist)
+        d = jnp.where(do, d.at[i, :].set(-1e10).at[:, j].set(-1e10), d)
+        return (d, match_idx, match_dist), None
+
+    init = (dist, -jnp.ones((N,), jnp.int32), jnp.zeros((N,), jnp.float32))
+    (_, match_idx, match_dist), _ = jax.lax.scan(
+        body, init, None, length=min(M, N))
+    mtype = attrs.get("match_type", "bipartite")
+    if mtype == "per_prediction":
+        thr = attrs.get("dist_threshold", 0.5)
+        col_best = jnp.argmax(dist, axis=0)
+        col_val = jnp.max(dist, axis=0)
+        extra = (match_idx < 0) & (col_val >= thr)
+        match_idx = jnp.where(extra, col_best.astype(jnp.int32), match_idx)
+        match_dist = jnp.where(extra, col_val, match_dist)
+    return {"ColToRowMatchIndices": [match_idx[None]],
+            "ColToRowMatchDist": [match_dist[None]]}
+
+
+@register("multiclass_nms", differentiable=False)
+def _multiclass_nms(ctx, ins, attrs):
+    """Per-class NMS with fixed-capacity output [keep_top_k, 6]
+    (label, score, x1, y1, x2, y2), padded with label=-1."""
+    boxes = ins["BBoxes"][0]    # [N, M, 4]
+    scores = ins["Scores"][0]   # [N, C, M]
+    bg = attrs.get("background_label", 0)
+    score_thr = attrs.get("score_threshold", 0.0)
+    nms_thr = attrs.get("nms_threshold", 0.3)
+    nms_top_k = attrs.get("nms_top_k", 400)
+    keep_top_k = attrs.get("keep_top_k", 100)
+    N, C, M = scores.shape
+
+    def one_image(b, s):
+        # b [M,4], s [C,M]
+        results = []
+        k = min(nms_top_k, M)
+        for c in range(C):
+            if c == bg:
+                continue
+            sc = s[c]
+            vals, idx = jax.lax.top_k(sc, k)
+            bb = b[idx]
+            keep = _nms_mask(bb, vals, nms_thr) & (vals > score_thr)
+            lab = jnp.full((k,), c, jnp.float32)
+            results.append(jnp.concatenate(
+                [lab[:, None], jnp.where(keep, vals, -1.0)[:, None], bb],
+                axis=1))
+        allr = jnp.concatenate(results, axis=0)  # [(C-1)*k, 6]
+        order = jnp.argsort(-allr[:, 1])
+        allr = allr[order][:keep_top_k]
+        valid = allr[:, 1] > score_thr
+        out = jnp.where(valid[:, None],
+                        allr,
+                        jnp.asarray([-1., 0., 0., 0., 0., 0.]))
+        # pad to keep_top_k
+        pad = keep_top_k - out.shape[0]
+        if pad > 0:
+            out = jnp.concatenate(
+                [out, jnp.tile(jnp.asarray([[-1., 0., 0., 0., 0., 0.]]),
+                               (pad, 1))], axis=0)
+        return out
+
+    outs = jax.vmap(one_image)(boxes, scores)  # [N, keep_top_k, 6]
+    return {"Out": [outs]}
+
+
+def _nms_mask(boxes, scores, thr):
+    """boxes sorted by score desc; True = kept."""
+    n = boxes.shape[0]
+    iou = _iou_matrix(boxes, boxes)
+
+    def body(keep, i):
+        sup = (iou[i] > thr) & keep[i] & (jnp.arange(n) > i)
+        return keep & ~sup, None
+
+    keep0 = jnp.ones((n,), jnp.bool_)
+    keep, _ = jax.lax.scan(body, keep0, jnp.arange(n))
+    return keep
+
+
+@register("yolo_box", differentiable=False)
+def _yolo_box(ctx, ins, attrs):
+    x = ins["X"][0]  # [N, A*(5+C), H, W]
+    img_size = ins["ImgSize"][0]  # [N, 2]
+    anchors = attrs["anchors"]
+    class_num = attrs["class_num"]
+    conf_thresh = attrs.get("conf_thresh", 0.01)
+    downsample = attrs.get("downsample_ratio", 32)
+    N, _, H, W = x.shape
+    A = len(anchors) // 2
+    x = x.reshape(N, A, 5 + class_num, H, W)
+    gx, gy = jnp.meshgrid(jnp.arange(W), jnp.arange(H))
+    bx = (jax.nn.sigmoid(x[:, :, 0]) + gx[None, None]) / W
+    by = (jax.nn.sigmoid(x[:, :, 1]) + gy[None, None]) / H
+    aw = jnp.asarray(anchors[0::2], jnp.float32)[None, :, None, None]
+    ah = jnp.asarray(anchors[1::2], jnp.float32)[None, :, None, None]
+    input_size = downsample * max(H, W)
+    bw = jnp.exp(x[:, :, 2]) * aw / (W * downsample)
+    bh = jnp.exp(x[:, :, 3]) * ah / (H * downsample)
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    imh = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    imw = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    x1 = (bx - bw / 2) * imw
+    y1 = (by - bh / 2) * imh
+    x2 = (bx + bw / 2) * imw
+    y2 = (by + bh / 2) * imh
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(N, -1, 4)
+    scores = probs.transpose(0, 1, 3, 4, 2).reshape(N, -1, class_num)
+    mask = (conf.reshape(N, -1) > conf_thresh)[..., None]
+    scores = jnp.where(mask, scores, 0.0)
+    return {"Boxes": [boxes], "Scores": [scores]}
+
+
+@register("yolov3_loss", nondiff_inputs=("GTBox", "GTLabel"))
+def _yolov3_loss(ctx, ins, attrs):
+    """Simplified dense yolov3 loss: objectness + box + class terms on the
+    best-matching anchor per gt (detection/yolov3_loss_op.cc semantics on
+    padded gt arrays)."""
+    x = ins["X"][0]  # [N, A*(5+C), H, W]
+    gt_box = ins["GTBox"][0]  # [N, G, 4] (cx, cy, w, h) normalized
+    gt_label = ins["GTLabel"][0]  # [N, G]
+    anchors = attrs["anchors"]
+    anchor_mask = attrs.get("anchor_mask", list(range(len(anchors) // 2)))
+    class_num = attrs["class_num"]
+    ignore_thresh = attrs.get("ignore_thresh", 0.7)
+    downsample = attrs.get("downsample_ratio", 32)
+    N, _, H, W = x.shape
+    A = len(anchor_mask)
+    x = x.reshape(N, A, 5 + class_num, H, W)
+    tx, ty, tw, th = x[:, :, 0], x[:, :, 1], x[:, :, 2], x[:, :, 3]
+    obj = x[:, :, 4]
+    cls = x[:, :, 5:]
+
+    # build dense targets from padded gt (gt with w<=0 are padding)
+    gw = gt_box[..., 2]
+    valid = gw > 1e-6  # [N, G]
+    gi = jnp.clip((gt_box[..., 0] * W).astype(jnp.int32), 0, W - 1)
+    gj = jnp.clip((gt_box[..., 1] * H).astype(jnp.int32), 0, H - 1)
+    # best anchor per gt by wh IoU
+    aw = jnp.asarray([anchors[2 * i] for i in anchor_mask],
+                     jnp.float32) / (W * downsample)
+    ah = jnp.asarray([anchors[2 * i + 1] for i in anchor_mask],
+                     jnp.float32) / (H * downsample)
+    inter = jnp.minimum(gt_box[..., 2:3], aw) * jnp.minimum(
+        gt_box[..., 3:4], ah)
+    union = (gt_box[..., 2:3] * gt_box[..., 3:4] + aw * ah - inter)
+    wh_iou = inter / jnp.maximum(union, 1e-10)  # [N, G, A]
+    best_a = jnp.argmax(wh_iou, axis=-1)  # [N, G]
+
+    obj_target = jnp.zeros((N, A, H, W))
+    bidx = jnp.arange(N)[:, None].repeat(gt_box.shape[1], 1)
+    obj_target = obj_target.at[bidx, best_a, gj, gi].max(
+        valid.astype(jnp.float32))
+    obj_loss = jnp.mean(
+        jnp.maximum(obj, 0) - obj * obj_target
+        + jnp.log1p(jnp.exp(-jnp.abs(obj))))
+    # box loss on assigned cells
+    px = jax.nn.sigmoid(tx[bidx, best_a, gj, gi])
+    py = jax.nn.sigmoid(ty[bidx, best_a, gj, gi])
+    tgt_x = gt_box[..., 0] * W - gi
+    tgt_y = gt_box[..., 1] * H - gj
+    box_loss = jnp.sum(valid * ((px - tgt_x) ** 2 + (py - tgt_y) ** 2)) / N
+    # class loss
+    logits = cls[bidx, best_a, :, gj, gi]  # [N, G, C]
+    onehot = jax.nn.one_hot(gt_label, class_num)
+    cls_bce = jnp.maximum(logits, 0) - logits * onehot + jnp.log1p(
+        jnp.exp(-jnp.abs(logits)))
+    cls_loss = jnp.sum(valid[..., None] * cls_bce) / N
+    loss = obj_loss + box_loss + cls_loss
+    return {"Loss": [jnp.full((N,), loss / N)]}
+
+
+@register("target_assign", differentiable=False)
+def _target_assign(ctx, ins, attrs):
+    x = ins["X"][0]          # [M, K] (e.g. gt labels per row)
+    match = ins["MatchIndices"][0]  # [N, P]
+    mismatch_value = attrs.get("mismatch_value", 0)
+    N, P = match.shape
+    xx = x if x.ndim == 2 else x.reshape(x.shape[0], -1)
+    safe = jnp.maximum(match, 0)
+    out = xx[safe]  # [N, P, K]
+    neg = (match < 0)[..., None]
+    out = jnp.where(neg, mismatch_value, out)
+    wt = jnp.where(match < 0, 0.0, 1.0)
+    return {"Out": [out], "OutWeight": [wt[..., None]]}
+
+
+@register("polygon_box_transform", differentiable=False)
+def _polygon_box_transform(ctx, ins, attrs):
+    x = ins["Input"][0]  # [N, geo, H, W]
+    n, g, h, w = x.shape
+    gx = jnp.tile(jnp.arange(w), (h, 1)) * 4.0
+    gy = jnp.tile(jnp.arange(h)[:, None], (1, w)) * 4.0
+    out = x.at[:, 0::2].set(gx[None, None] - x[:, 0::2])
+    out = out.at[:, 1::2].set(gy[None, None] - x[:, 1::2])
+    return {"Output": [out]}
+
+
+@register("detection_map", differentiable=False)
+def _detection_map(ctx, ins, attrs):
+    """mAP over fixed-capacity detections (detection/detection_map_op.cc).
+    DetectRes [N, K, 6] (label, score, box), GTLabel [N, G], GTBox [N,G,4]."""
+    det = ins["DetectRes"][0]
+    gt_label = ins["Label"][0]
+    gt_box = ins["GTBox"][0]
+    overlap = attrs.get("overlap_threshold", 0.5)
+    class_num = attrs["class_num"]
+    N, K, _ = det.shape
+    G = gt_label.shape[1]
+
+    def per_image(d, gl, gb):
+        # count matches per class
+        dl = d[:, 0].astype(jnp.int32)
+        ds = d[:, 1]
+        dbox = d[:, 2:6]
+        valid_d = dl >= 0
+        valid_g = gl >= 0
+        iou = _iou_matrix(dbox, gb)  # [K, G]
+        same = dl[:, None] == gl[None, :]
+        ok = (iou > overlap) & same & valid_d[:, None] & valid_g[None, :]
+        tp = jnp.any(ok, axis=1) & valid_d
+        return tp, ds, dl, valid_d, valid_g, gl
+
+    tp, ds, dl, vd, vg, gl = jax.vmap(per_image)(det, gt_label, gt_box)
+    # flatten and compute AP (area under PR, integral style) per class, mean
+    tp = tp.reshape(-1)
+    ds = ds.reshape(-1)
+    dl = dl.reshape(-1)
+    vd = vd.reshape(-1)
+    order = jnp.argsort(-jnp.where(vd, ds, -jnp.inf))
+    tp_sorted = tp[order]
+    vd_sorted = vd[order]
+    dl_sorted = dl[order]
+    aps = []
+    for c in range(class_num):
+        in_c = (dl_sorted == c) & vd_sorted
+        npos = jnp.sum((gl.reshape(-1) == c)
+                       & vg.reshape(-1)).astype(jnp.float32)
+        ctp = jnp.cumsum(jnp.where(in_c, tp_sorted, 0))
+        cfp = jnp.cumsum(jnp.where(in_c, ~tp_sorted & in_c, 0))
+        recall = ctp / jnp.maximum(npos, 1)
+        precision = ctp / jnp.maximum(ctp + cfp, 1)
+        d_rec = jnp.diff(recall, prepend=0.0)
+        ap = jnp.sum(precision * d_rec * jnp.where(in_c, 1.0, 0.0))
+        aps.append(jnp.where(npos > 0, ap, -1.0))
+    aps = jnp.stack(aps)
+    have = aps >= 0
+    mAP = jnp.sum(jnp.where(have, aps, 0)) / jnp.maximum(
+        jnp.sum(have), 1)
+    return {"MAP": [mAP.reshape((1,))],
+            "AccumPosCount": [jnp.zeros((1,), jnp.int32)],
+            "AccumTruePos": [jnp.zeros((1, 2), jnp.float32)],
+            "AccumFalsePos": [jnp.zeros((1, 2), jnp.float32)]}
+
+
+@register("generate_proposals", differentiable=False)
+def _generate_proposals(ctx, ins, attrs):
+    """RPN proposal generation with fixed post_nms_topN output."""
+    scores = ins["Scores"][0]       # [N, A, H, W]
+    deltas = ins["BboxDeltas"][0]   # [N, A*4, H, W]
+    im_info = ins["ImInfo"][0]      # [N, 3]
+    anchors = ins["Anchors"][0]     # [H, W, A, 4]
+    variances = ins["Variances"][0]
+    pre_n = attrs.get("pre_nms_topN", 6000)
+    post_n = attrs.get("post_nms_topN", 1000)
+    nms_thr = attrs.get("nms_thresh", 0.7)
+    N = scores.shape[0]
+    A = scores.shape[1]
+    H, W = scores.shape[2], scores.shape[3]
+    anc = anchors.reshape(-1, 4)
+    var = variances.reshape(-1, 4)
+
+    def per_image(sc, dl, ii):
+        sc = sc.transpose(1, 2, 0).reshape(-1)          # [H*W*A]
+        dl = dl.reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        k = min(pre_n, sc.shape[0])
+        vals, idx = jax.lax.top_k(sc, k)
+        a = anc[idx]
+        v = var[idx]
+        d = dl[idx]
+        aw = a[:, 2] - a[:, 0] + 1
+        ah = a[:, 3] - a[:, 1] + 1
+        acx = a[:, 0] + aw / 2
+        acy = a[:, 1] + ah / 2
+        cx = v[:, 0] * d[:, 0] * aw + acx
+        cy = v[:, 1] * d[:, 1] * ah + acy
+        w = jnp.exp(jnp.minimum(v[:, 2] * d[:, 2], 10.0)) * aw
+        h = jnp.exp(jnp.minimum(v[:, 3] * d[:, 3], 10.0)) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                          axis=1)
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, ii[1] - 1),
+            jnp.clip(boxes[:, 1], 0, ii[0] - 1),
+            jnp.clip(boxes[:, 2], 0, ii[1] - 1),
+            jnp.clip(boxes[:, 3], 0, ii[0] - 1)], axis=1)
+        keep = _nms_mask(boxes, vals, nms_thr)
+        score_keep = jnp.where(keep, vals, -jnp.inf)
+        vals2, idx2 = jax.lax.top_k(score_keep, post_n)
+        return boxes[idx2], vals2
+
+    rois, rscores = jax.vmap(per_image)(scores, deltas, im_info)
+    return {"RpnRois": [rois], "RpnRoiProbs": [rscores]}
+
+
+@register("roi_align")
+def _roi_align(ctx, ins, attrs):
+    x = ins["X"][0]          # [N, C, H, W]
+    rois = ins["ROIs"][0]    # [R, 4] (x1,y1,x2,y2), batch idx via RoisLod/BatchId
+    pooled_h = attrs.get("pooled_height", 1)
+    pooled_w = attrs.get("pooled_width", 1)
+    scale = attrs.get("spatial_scale", 1.0)
+    sampling = attrs.get("sampling_ratio", -1)
+    batch_ids = (ins["BatchId"][0].reshape(-1).astype(jnp.int32)
+                 if ins.get("BatchId")
+                 else jnp.zeros((rois.shape[0],), jnp.int32))
+    N, C, H, W = x.shape
+
+    def one_roi(roi, bid):
+        x1, y1, x2, y2 = roi * scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_w = rw / pooled_w
+        bin_h = rh / pooled_h
+        s = sampling if sampling > 0 else 2
+        py = jnp.arange(pooled_h)
+        px = jnp.arange(pooled_w)
+        sy = jnp.arange(s)
+        sx = jnp.arange(s)
+        yy = y1 + (py[:, None] + (sy[None, :] + 0.5) / s) * bin_h  # [ph, s]
+        xx = x1 + (px[:, None] + (sx[None, :] + 0.5) / s) * bin_w  # [pw, s]
+        yy = yy.reshape(-1)
+        xx = xx.reshape(-1)
+        y0 = jnp.clip(jnp.floor(yy), 0, H - 1).astype(jnp.int32)
+        x0 = jnp.clip(jnp.floor(xx), 0, W - 1).astype(jnp.int32)
+        y1i = jnp.clip(y0 + 1, 0, H - 1)
+        x1i = jnp.clip(x0 + 1, 0, W - 1)
+        wy = jnp.clip(yy - y0, 0, 1)
+        wx = jnp.clip(xx - x0, 0, 1)
+        img = x[bid]  # [C, H, W]
+        v = (img[:, y0][:, :, x0] * 0)  # placeholder to get shape right
+
+        def bilinear(yi, xi, wyy, wxx):
+            return img[:, yi, :][:, :, xi] * 0
+
+        # vectorized gather: [C, len(yy)] per corner at matching (y, x)
+        g00 = img[:, y0, x0]
+        g01 = img[:, y0, x1i]
+        g10 = img[:, y1i, x0]
+        g11 = img[:, y1i, x1i]
+        val = (g00 * (1 - wy) * (1 - wx) + g01 * (1 - wy) * wx
+               + g10 * wy * (1 - wx) + g11 * wy * wx)  # [C, ph*s*pw*s]
+        val = val.reshape(C, pooled_h, s, pooled_w, s).mean(axis=(2, 4))
+        return val
+
+    out = jax.vmap(one_roi)(rois, batch_ids)  # [R, C, ph, pw]
+    return {"Out": [out]}
+
+
+@register("roi_pool", differentiable=False)
+def _roi_pool(ctx, ins, attrs):
+    x = ins["X"][0]
+    rois = ins["ROIs"][0]
+    pooled_h = attrs.get("pooled_height", 1)
+    pooled_w = attrs.get("pooled_width", 1)
+    scale = attrs.get("spatial_scale", 1.0)
+    batch_ids = (ins["BatchId"][0].reshape(-1).astype(jnp.int32)
+                 if ins.get("BatchId")
+                 else jnp.zeros((rois.shape[0],), jnp.int32))
+    N, C, H, W = x.shape
+
+    def one_roi(roi, bid):
+        x1 = jnp.round(roi[0] * scale).astype(jnp.int32)
+        y1 = jnp.round(roi[1] * scale).astype(jnp.int32)
+        x2 = jnp.round(roi[2] * scale).astype(jnp.int32)
+        y2 = jnp.round(roi[3] * scale).astype(jnp.int32)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        img = x[bid]
+        # sample a fixed grid then max over it
+        gy = y1 + (jnp.arange(pooled_h * 2) * rh) // (pooled_h * 2)
+        gx = x1 + (jnp.arange(pooled_w * 2) * rw) // (pooled_w * 2)
+        gy = jnp.clip(gy, 0, H - 1)
+        gx = jnp.clip(gx, 0, W - 1)
+        patch = img[:, gy][:, :, gx]  # [C, 2ph, 2pw]
+        return patch.reshape(C, pooled_h, 2, pooled_w, 2).max(axis=(2, 4))
+
+    out = jax.vmap(one_roi)(rois, batch_ids)
+    return {"Out": [out], "Argmax": [jnp.zeros(out.shape, jnp.int32)]}

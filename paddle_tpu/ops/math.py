@@ -41,6 +41,12 @@ def _fill_zeros_like(ctx, ins, attrs):
     return {"Out": [jnp.zeros_like(ins["X"][0])]}
 
 
+@register("fill_any_like", differentiable=False)
+def _fill_any_like(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [jnp.full_like(x, attrs.get("value", 0.0))]}
+
+
 @register("uniform_random", differentiable=False, stateful=True)
 def _uniform_random(ctx, ins, attrs):
     shape = [int(s) for s in attrs["shape"]]

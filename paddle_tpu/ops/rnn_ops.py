@@ -1,0 +1,137 @@
+"""Recurrent cell ops (parity: operators/gru_unit_op.cc, lstm_unit_op.cc,
+gru_op.cc, lstm_op.cc — the fused recurrences lower to lax.scan over MXU
+matmul steps).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _act(name):
+    return {
+        "sigmoid": jax.nn.sigmoid,
+        "tanh": jnp.tanh,
+        "relu": jax.nn.relu,
+        "identity": lambda x: x,
+    }[name]
+
+
+@register("gru_unit")
+def _gru_unit(ctx, ins, attrs):
+    """One GRU step (gru_unit_op.cc). Input: [B, 3D] projected input;
+    HiddenPrev [B, D]; Weight [D, 3D] (gates [D, 2D] | candidate [D, D])."""
+    x = ins["Input"][0]
+    h_prev = ins["HiddenPrev"][0]
+    w = ins["Weight"][0]
+    d = h_prev.shape[-1]
+    gate_act = _act(attrs.get("gate_activation", "sigmoid"))
+    act = _act(attrs.get("activation", "tanh"))
+    origin_mode = attrs.get("origin_mode", False)
+
+    xg = x
+    if ins.get("Bias"):
+        xg = xg + ins["Bias"][0]
+    w_gates = w[:, : 2 * d]
+    w_cand = w[:, 2 * d :]
+    gates = gate_act(xg[:, : 2 * d] + h_prev @ w_gates)
+    u, r = gates[:, :d], gates[:, d:]
+    reset_h = r * h_prev
+    cand = act(xg[:, 2 * d :] + reset_h @ w_cand)
+    if origin_mode:
+        h = u * h_prev + (1.0 - u) * cand
+    else:
+        h = (1.0 - u) * h_prev + u * cand
+    return {"Hidden": [h], "Gate": [jnp.concatenate([u, r, cand], -1)],
+            "ResetHiddenPrev": [reset_h]}
+
+
+@register("lstm_unit")
+def _lstm_unit(ctx, ins, attrs):
+    """One LSTM step (lstm_unit_op.cc): X [B, 4D] pre-projected, C_prev."""
+    x = ins["X"][0]
+    c_prev = ins["C_prev"][0]
+    d = c_prev.shape[-1]
+    forget_bias = attrs.get("forget_bias", 0.0)
+    i, j, f, o = jnp.split(x, 4, axis=-1)
+    c = c_prev * jax.nn.sigmoid(f + forget_bias) + jax.nn.sigmoid(
+        i) * jnp.tanh(j)
+    h = jnp.tanh(c) * jax.nn.sigmoid(o)
+    return {"C": [c], "H": [h]}
+
+
+@register("gru")
+def _gru(ctx, ins, attrs):
+    """Full-sequence GRU (gru_op.cc): Input [B, T, 3D] pre-projected,
+    lax.scan over time."""
+    x = ins["Input"][0]
+    w = ins["Weight"][0]
+    d = w.shape[0]
+    b = x.shape[0]
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((b, d), x.dtype)
+    bias = ins["Bias"][0] if ins.get("Bias") else None
+    is_reverse = attrs.get("is_reverse", False)
+    gate_act = _act(attrs.get("gate_activation", "sigmoid"))
+    act = _act(attrs.get("activation", "tanh"))
+    origin_mode = attrs.get("origin_mode", False)
+    xt_seq = jnp.swapaxes(x, 0, 1)  # [T, B, 3D]
+    if is_reverse:
+        xt_seq = jnp.flip(xt_seq, 0)
+
+    w_gates = w[:, : 2 * d]
+    w_cand = w[:, 2 * d :]
+
+    def step(h_prev, xt):
+        if bias is not None:
+            xt = xt + bias
+        gates = gate_act(xt[:, : 2 * d] + h_prev @ w_gates)
+        u, r = gates[:, :d], gates[:, d:]
+        cand = act(xt[:, 2 * d :] + (r * h_prev) @ w_cand)
+        if origin_mode:
+            h = u * h_prev + (1.0 - u) * cand
+        else:
+            h = (1.0 - u) * h_prev + u * cand
+        return h, h
+
+    h_last, hs = jax.lax.scan(step, h0, xt_seq)
+    if is_reverse:
+        hs = jnp.flip(hs, 0)
+    hidden = jnp.swapaxes(hs, 0, 1)  # [B, T, D]
+    return {"Hidden": [hidden], "BatchGate": [hidden],
+            "BatchResetHiddenPrev": [hidden], "BatchHidden": [hidden]}
+
+
+@register("lstm")
+def _lstm(ctx, ins, attrs):
+    """Full-sequence LSTM (lstm_op.cc): Input [B, T, 4D] pre-projected."""
+    x = ins["Input"][0]
+    w = ins["Weight"][0]  # [D, 4D]
+    d = w.shape[0]
+    b = x.shape[0]
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((b, d), x.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((b, d), x.dtype)
+    bias = ins["Bias"][0] if ins.get("Bias") else None
+    is_reverse = attrs.get("is_reverse", False)
+    xt_seq = jnp.swapaxes(x, 0, 1)
+    if is_reverse:
+        xt_seq = jnp.flip(xt_seq, 0)
+
+    def step(carry, xt):
+        h_prev, c_prev = carry
+        g = xt + h_prev @ w
+        if bias is not None:
+            g = g + bias[:, : 4 * d] if bias.ndim == 2 else g + bias
+        i, f, cand, o = jnp.split(g, 4, axis=-1)
+        c = jax.nn.sigmoid(f) * c_prev + jax.nn.sigmoid(i) * jnp.tanh(cand)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (h, c), (h, c)
+
+    (h_last, c_last), (hs, cs) = jax.lax.scan(step, (h0, c0), xt_seq)
+    if is_reverse:
+        hs = jnp.flip(hs, 0)
+        cs = jnp.flip(cs, 0)
+    return {"Hidden": [jnp.swapaxes(hs, 0, 1)],
+            "Cell": [jnp.swapaxes(cs, 0, 1)],
+            "BatchGate": [jnp.swapaxes(hs, 0, 1)],
+            "BatchCellPreAct": [jnp.swapaxes(cs, 0, 1)]}

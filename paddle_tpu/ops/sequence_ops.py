@@ -1,0 +1,227 @@
+"""Sequence ops (parity: operators/sequence_ops/, 46 files — SURVEY §5.7).
+
+TPU-native representation: a batch of sequences is a padded dense tensor
+[B, T, ...] plus an optional per-sequence Length tensor [B] (the LoD offset
+table of the reference becomes lengths/masks — static shapes for XLA).
+When no Length input is given, every row is treated as full length.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+
+
+def _mask(x, ins, time_axis=1):
+    """[B, T] validity mask from the optional Length input."""
+    B, T = x.shape[0], x.shape[time_axis]
+    if ins.get("Length"):
+        lens = ins["Length"][0].reshape((-1,))
+        return (jnp.arange(T)[None, :] < lens[:, None]).astype(jnp.float32), lens
+    return jnp.ones((B, T), jnp.float32), jnp.full((B,), T, jnp.int32)
+
+
+@register("sequence_pool")
+def _sequence_pool(ctx, ins, attrs):
+    x = ins["X"][0]  # [B, T, D]
+    ptype = attrs.get("pooltype", "AVERAGE").upper()
+    mask, lens = _mask(x, ins)
+    m = mask[..., None]
+    if ptype == "SUM":
+        out = jnp.sum(x * m, axis=1)
+    elif ptype == "AVERAGE":
+        out = jnp.sum(x * m, axis=1) / jnp.maximum(lens[:, None], 1)
+    elif ptype == "SQRT":
+        out = jnp.sum(x * m, axis=1) / jnp.sqrt(
+            jnp.maximum(lens[:, None], 1).astype(jnp.float32))
+    elif ptype == "MAX":
+        out = jnp.max(jnp.where(m > 0, x, -jnp.inf), axis=1)
+    elif ptype == "LAST":
+        idx = jnp.maximum(lens - 1, 0)
+        out = jnp.take_along_axis(x, idx[:, None, None].astype(jnp.int32),
+                                  axis=1)[:, 0]
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise ValueError("unknown pooltype %r" % ptype)
+    return {"Out": [out], "MaxIndex": [jnp.zeros(out.shape, jnp.int32)]}
+
+
+@register("sequence_conv")
+def _sequence_conv(ctx, ins, attrs):
+    """Context-window conv over time (sequence_conv_op.cc): filter
+    [ctx_len*D, F]."""
+    x = ins["X"][0]  # [B, T, D]
+    w = ins["Filter"][0]
+    ctx_len = attrs.get("contextLength", 3)
+    ctx_start = attrs.get("contextStart", -(ctx_len // 2))
+    B, T, D = x.shape
+    cols = []
+    for i in range(ctx_len):
+        off = ctx_start + i
+        shifted = jnp.roll(x, -off, axis=1)
+        if off < 0:
+            pad_mask = jnp.arange(T)[None, :, None] >= -off
+        else:
+            pad_mask = jnp.arange(T)[None, :, None] < T - off
+        cols.append(jnp.where(pad_mask, shifted, 0.0))
+    ctx_mat = jnp.concatenate(cols, axis=-1)  # [B, T, ctx_len*D]
+    out = jnp.einsum("btc,cf->btf", ctx_mat, w)
+    return {"Out": [out]}
+
+
+@register("sequence_softmax")
+def _sequence_softmax(ctx, ins, attrs):
+    x = ins["X"][0]  # [B, T] or [B, T, 1]
+    squeeze = x.ndim == 3
+    xs = x[..., 0] if squeeze else x
+    mask, _ = _mask(xs, ins)
+    logits = jnp.where(mask > 0, xs, -1e30)
+    out = jax.nn.softmax(logits, axis=1) * mask
+    return {"Out": [out[..., None] if squeeze else out]}
+
+
+@register("sequence_expand")
+def _sequence_expand(ctx, ins, attrs):
+    """Row-wise expand of X by Y's repeat structure. Padded-dense version:
+    X [B, ...] tiled along a new time axis to match Y's T."""
+    x, y = ins["X"][0], ins["Y"][0]
+    if x.shape[0] == y.shape[0] and x.ndim < y.ndim:
+        reps = y.shape[1]
+        return {"Out": [jnp.repeat(x[:, None], reps, axis=1)]}
+    return {"Out": [jnp.broadcast_to(x, y.shape[: x.ndim])]}
+
+
+@register("sequence_expand_as")
+def _sequence_expand_as(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    if x.shape[0] == y.shape[0] and x.ndim == 2 and y.ndim == 3:
+        return {"Out": [jnp.repeat(x[:, None], y.shape[1], axis=1)]}
+    return {"Out": [jnp.broadcast_to(x, y.shape)]}
+
+
+@register("sequence_reshape")
+def _sequence_reshape(ctx, ins, attrs):
+    x = ins["X"][0]  # [B, T, D]
+    new_dim = attrs["new_dim"]
+    B = x.shape[0]
+    return {"Out": [x.reshape(B, -1, new_dim)]}
+
+
+@register("sequence_reverse")
+def _sequence_reverse(ctx, ins, attrs):
+    x = ins["X"][0]
+    if ins.get("Length"):
+        lens = ins["Length"][0].reshape((-1,))
+        T = x.shape[1]
+        idx = jnp.arange(T)[None, :]
+        rev_idx = jnp.where(idx < lens[:, None], lens[:, None] - 1 - idx, idx)
+        out = jnp.take_along_axis(
+            x, rev_idx[..., None].astype(jnp.int32).repeat(x.shape[-1], -1),
+            axis=1) if x.ndim == 3 else jnp.take_along_axis(
+                x, rev_idx.astype(jnp.int32), axis=1)
+        return {"Y": [out]}
+    return {"Y": [jnp.flip(x, axis=1)]}
+
+
+@register("sequence_concat")
+def _sequence_concat(ctx, ins, attrs):
+    return {"Out": [jnp.concatenate(ins["X"], axis=1)]}
+
+
+@register("sequence_slice")
+def _sequence_slice(ctx, ins, attrs):
+    x = ins["X"][0]
+    offset = int(np.asarray(attrs.get("offset_val", 0)))
+    length = int(np.asarray(attrs.get("length_val", x.shape[1])))
+    return {"Out": [jax.lax.dynamic_slice_in_dim(x, offset, length, axis=1)]}
+
+
+@register("sequence_pad", nondiff_inputs=("PadValue",))
+def _sequence_pad(ctx, ins, attrs):
+    # inputs already padded-dense in this representation: identity + length
+    x = ins["X"][0]
+    mask, lens = _mask(x, ins)
+    return {"Out": [x], "Length": [lens.astype(jnp.int64)]}
+
+
+@register("sequence_unpad", nondiff_inputs=("Length",))
+def _sequence_unpad(ctx, ins, attrs):
+    x = ins["X"][0]
+    lens = ins["Length"][0].reshape((-1,))
+    mask = (jnp.arange(x.shape[1])[None, :] < lens[:, None])
+    for _ in range(x.ndim - 2):
+        mask = mask[..., None]
+    return {"Out": [jnp.where(mask, x, 0.0)]}
+
+
+@register("sequence_mask", differentiable=False)
+def _sequence_mask(ctx, ins, attrs):
+    x = ins["X"][0].reshape((-1,))
+    maxlen = attrs.get("maxlen", -1)
+    if maxlen is None or maxlen < 0:
+        maxlen = int(attrs["__static_maxlen__"])
+    from .registry import np_dtype
+
+    dt = np_dtype(attrs.get("out_dtype", attrs.get("dtype", "int64")))
+    out = (jnp.arange(maxlen)[None, :] < x[:, None]).astype(dt)
+    return {"Y": [out]}
+
+
+@register("sequence_enumerate", differentiable=False)
+def _sequence_enumerate(ctx, ins, attrs):
+    x = ins["X"][0]  # [B, T] int
+    win = attrs["win_size"]
+    pad = attrs.get("pad_value", 0)
+    B, T = x.shape[:2]
+    cols = []
+    for i in range(win):
+        shifted = jnp.roll(x, -i, axis=1)
+        valid = jnp.arange(T)[None, :] < T - i
+        cols.append(jnp.where(valid, shifted, pad))
+    return {"Out": [jnp.stack(cols, axis=-1)]}
+
+
+@register("sequence_erase", differentiable=False)
+def _sequence_erase(ctx, ins, attrs):
+    """Padded-dense variant: erased tokens are REPLACED by a pad marker
+    (-1) — static shapes forbid true removal; downstream masks skip them."""
+    x = ins["X"][0]
+    tokens = attrs.get("tokens", [])
+    bad = jnp.zeros_like(x, dtype=jnp.bool_)
+    for t in tokens:
+        bad = bad | (x == t)
+    return {"Out": [jnp.where(bad, -1, x)]}
+
+
+@register("sequence_scatter", nondiff_inputs=("Ids",))
+def _sequence_scatter(ctx, ins, attrs):
+    x = ins["X"][0]
+    ids = ins["Ids"][0]
+    upd = ins["Updates"][0]
+    B = x.shape[0]
+    bidx = jnp.arange(B)[:, None].repeat(ids.shape[1], 1)
+    return {"Out": [x.at[bidx.reshape(-1),
+                         ids.reshape(-1).astype(jnp.int32)].add(
+        upd.reshape(-1, *upd.shape[2:]))]}
+
+
+@register("similarity_focus", differentiable=False)
+def _similarity_focus(ctx, ins, attrs):
+    """similarity_focus_op.cc: for each selected channel, mark the max cell
+    per (row, col) producing a focus mask over [B, C, H, W]."""
+    x = ins["X"][0]
+    axis = attrs["axis"]
+    indexes = attrs["indexes"]
+    if axis != 1:
+        raise NotImplementedError("similarity_focus supports axis=1 (C)")
+    B, C, H, W = x.shape
+    out = jnp.zeros_like(x)
+    for idx in indexes:
+        ch = x[:, idx]  # [B, H, W]
+        row_max = (ch == ch.max(axis=2, keepdims=True))
+        col_max = (ch == ch.max(axis=1, keepdims=True))
+        mask = (row_max | col_max).astype(x.dtype)  # [B, H, W]
+        out = jnp.maximum(out, mask[:, None, :, :])
+    return {"Out": [out]}

@@ -54,6 +54,10 @@ def chain(*readers):
     return reader
 
 
+class ComposeNotAligned(ValueError):
+    pass
+
+
 def compose(*readers, **kwargs):
     check_alignment = kwargs.pop("check_alignment", True)
 
@@ -68,7 +72,14 @@ def compose(*readers, **kwargs):
             for outputs in zip(*rs):
                 yield sum(list(map(make_tuple, outputs)), ())
         else:
-            for outputs in zip(*rs):
+            sentinel = object()
+            import itertools
+
+            for outputs in itertools.zip_longest(*rs, fillvalue=sentinel):
+                if sentinel in outputs:
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned — one reader "
+                        "ended before the others")
                 yield sum(list(map(make_tuple, outputs)), ())
 
     return reader

@@ -1,0 +1,312 @@
+"""Single-op numeric tests for the math/elementwise/reduce/activation corpus
+(parity model: unittests/test_*_op.py via the OpTest harness)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+class TestMatmul(OpTest):
+    op_type = "matmul"
+
+    def setup(self):
+        rng = np.random.RandomState(1)
+        self.x = rng.rand(4, 5).astype(np.float32)
+        self.y = rng.rand(5, 3).astype(np.float32)
+        self.inputs = {"X": [("x", self.x)], "Y": [("y", self.y)]}
+        self.outputs = {"Out": [("out", self.x @ self.y)]}
+
+    def test_output_and_grad(self):
+        self.setup()
+        self.check_output()
+        self.check_grad(["x", "y"], "out")
+
+
+class TestMatmulTranspose(OpTest):
+    op_type = "matmul"
+
+    def test_output(self):
+        rng = np.random.RandomState(2)
+        x = rng.rand(5, 4).astype(np.float32)
+        y = rng.rand(3, 5).astype(np.float32)
+        self.inputs = {"X": [("x", x)], "Y": [("y", y)]}
+        self.attrs = {"transpose_X": True, "transpose_Y": True}
+        self.outputs = {"Out": [("out", x.T @ y.T)]}
+        self.check_output()
+
+
+class TestMul(OpTest):
+    op_type = "mul"
+
+    def test_output_and_grad(self):
+        rng = np.random.RandomState(3)
+        x = rng.rand(2, 3, 4).astype(np.float32)
+        y = rng.rand(12, 5).astype(np.float32)
+        self.inputs = {"X": [("x", x)], "Y": [("y", y)]}
+        self.attrs = {"x_num_col_dims": 1, "y_num_col_dims": 1}
+        self.outputs = {"Out": [("out", x.reshape(2, 12) @ y)]}
+        self.check_output()
+        self.check_grad(["x", "y"], "out")
+
+
+@pytest.mark.parametrize("op,fn", [
+    ("elementwise_add", lambda x, y: x + y),
+    ("elementwise_sub", lambda x, y: x - y),
+    ("elementwise_mul", lambda x, y: x * y),
+    ("elementwise_div", lambda x, y: x / y),
+    ("elementwise_max", np.maximum),
+    ("elementwise_min", np.minimum),
+])
+def test_elementwise_ops(op, fn):
+    rng = np.random.RandomState(4)
+    x = (rng.rand(3, 4) + 0.5).astype(np.float32)
+    y = (rng.rand(3, 4) + 0.5).astype(np.float32)
+    t = OpTest()
+    t.op_type = op
+    t.inputs = {"X": [("x", x)], "Y": [("y", y)]}
+    t.outputs = {"Out": [("out", fn(x, y))]}
+    t.attrs = {}
+    t.check_output()
+    if op in ("elementwise_add", "elementwise_sub", "elementwise_mul",
+              "elementwise_div"):
+        t.check_grad(["x", "y"], "out")
+
+
+def test_elementwise_broadcast_axis():
+    """Fluid axis-broadcasting: y [3] added at axis=1 of x [2,3,4]."""
+    rng = np.random.RandomState(5)
+    x = rng.rand(2, 3, 4).astype(np.float32)
+    y = rng.rand(3).astype(np.float32)
+    t = OpTest()
+    t.op_type = "elementwise_add"
+    t.inputs = {"X": [("x", x)], "Y": [("y", y)]}
+    t.attrs = {"axis": 1}
+    t.outputs = {"Out": [("out", x + y.reshape(1, 3, 1))]}
+    t.check_output()
+    t.check_grad(["x", "y"], "out")
+
+
+@pytest.mark.parametrize("op,fn", [
+    ("relu", lambda x: np.maximum(x, 0)),
+    ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+    ("tanh", np.tanh),
+    ("exp", np.exp),
+    ("sqrt", lambda x: np.sqrt(np.abs(x) + 1.0)),
+    ("square", lambda x: x * x),
+    ("softplus", lambda x: np.log1p(np.exp(x))),
+])
+def test_activations(op, fn):
+    rng = np.random.RandomState(6)
+    x = (rng.rand(3, 5) * 2 - 1).astype(np.float32)
+    if op == "sqrt":
+        x = np.abs(x) + 1.0
+        expected = np.sqrt(x)
+    else:
+        expected = fn(x)
+    t = OpTest()
+    t.op_type = op
+    t.inputs = {"X": [("x", x)]}
+    t.outputs = {"Out": [("out", expected)]}
+    t.attrs = {}
+    t.check_output()
+    t.check_grad(["x"], "out", max_relative_error=0.01)
+
+
+@pytest.mark.parametrize("op,npfn", [
+    ("reduce_sum", np.sum),
+    ("reduce_mean", np.mean),
+    ("reduce_max", np.max),
+    ("reduce_min", np.min),
+])
+def test_reduce_ops(op, npfn):
+    rng = np.random.RandomState(7)
+    x = rng.rand(3, 4, 5).astype(np.float32)
+    t = OpTest()
+    t.op_type = op
+    t.inputs = {"X": [("x", x)]}
+    t.attrs = {"dim": [1], "keep_dim": False}
+    t.outputs = {"Out": [("out", npfn(x, axis=1))]}
+    t.check_output()
+    if op in ("reduce_sum", "reduce_mean"):
+        t.check_grad(["x"], "out")
+
+
+def test_softmax_op():
+    rng = np.random.RandomState(8)
+    x = rng.rand(4, 7).astype(np.float32)
+    e = np.exp(x - x.max(-1, keepdims=True))
+    t = OpTest()
+    t.op_type = "softmax"
+    t.inputs = {"X": [("x", x)]}
+    t.outputs = {"Out": [("out", e / e.sum(-1, keepdims=True))]}
+    t.attrs = {}
+    t.check_output()
+    t.check_grad(["x"], "out", max_relative_error=0.01)
+
+
+def test_cross_entropy_op():
+    rng = np.random.RandomState(9)
+    x = rng.rand(5, 4).astype(np.float32)
+    x = x / x.sum(-1, keepdims=True)
+    label = rng.randint(0, 4, size=(5, 1)).astype(np.int64)
+    expected = -np.log(x[np.arange(5), label.ravel()]).reshape(5, 1)
+    t = OpTest()
+    t.op_type = "cross_entropy"
+    t.inputs = {"X": [("x", x)], "Label": [("label", label)]}
+    t.outputs = {"Y": [("y_out", expected)]}
+    t.attrs = {}
+    t.check_output()
+    t.check_grad(["x"], "y_out", max_relative_error=0.01)
+
+
+def test_softmax_with_cross_entropy_op():
+    rng = np.random.RandomState(10)
+    logits = rng.rand(6, 5).astype(np.float32) * 3
+    label = rng.randint(0, 5, size=(6, 1)).astype(np.int64)
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    sm = e / e.sum(-1, keepdims=True)
+    expected = -np.log(sm[np.arange(6), label.ravel()]).reshape(6, 1)
+    t = OpTest()
+    t.op_type = "softmax_with_cross_entropy"
+    t.inputs = {"Logits": [("logits", logits)], "Label": [("label", label)]}
+    t.outputs = {"Loss": [("loss", expected)], "Softmax": [("sm", sm)]}
+    t.attrs = {}
+    t.check_output(atol=1e-4)
+    t.check_grad(["logits"], "loss", max_relative_error=0.01)
+
+
+def test_layer_norm_op():
+    rng = np.random.RandomState(11)
+    x = rng.rand(4, 10).astype(np.float32)
+    scale = rng.rand(10).astype(np.float32)
+    bias = rng.rand(10).astype(np.float32)
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    expected = (x - mean) / np.sqrt(var + 1e-5) * scale + bias
+    t = OpTest()
+    t.op_type = "layer_norm"
+    t.inputs = {"X": [("x", x)], "Scale": [("scale", scale)],
+                "Bias": [("bias", bias)]}
+    t.outputs = {"Y": [("y_out", expected)]}
+    t.attrs = {"begin_norm_axis": 1, "epsilon": 1e-5}
+    t.check_output(atol=1e-4)
+    t.check_grad(["x", "scale", "bias"], "y_out", max_relative_error=0.02)
+
+
+def test_lookup_table_op():
+    rng = np.random.RandomState(12)
+    w = rng.rand(10, 6).astype(np.float32)
+    ids = rng.randint(0, 10, size=(4, 1)).astype(np.int64)
+    expected = w[ids.ravel()]
+    t = OpTest()
+    t.op_type = "lookup_table"
+    t.inputs = {"W": [("w", w)], "Ids": [("ids", ids)]}
+    t.outputs = {"Out": [("out", expected)]}
+    t.attrs = {}
+    t.check_output()
+    t.check_grad(["w"], "out")
+
+
+def test_conv2d_op():
+    rng = np.random.RandomState(13)
+    x = rng.rand(2, 3, 8, 8).astype(np.float32)
+    w = rng.rand(4, 3, 3, 3).astype(np.float32)
+    # numpy reference conv (stride 1, pad 1)
+    xp = np.pad(x, [(0, 0), (0, 0), (1, 1), (1, 1)])
+    out = np.zeros((2, 4, 8, 8), np.float32)
+    for i in range(8):
+        for j in range(8):
+            patch = xp[:, :, i : i + 3, j : j + 3]
+            out[:, :, i, j] = np.einsum("ncij,ocij->no", patch, w)
+    t = OpTest()
+    t.op_type = "conv2d"
+    t.inputs = {"Input": [("x", x)], "Filter": [("w", w)]}
+    t.attrs = {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+               "groups": 1}
+    t.outputs = {"Output": [("out", out)]}
+    t.check_output(atol=1e-4)
+
+
+def test_pool2d_op():
+    rng = np.random.RandomState(14)
+    x = rng.rand(2, 3, 4, 4).astype(np.float32)
+    expected = x.reshape(2, 3, 2, 2, 2, 2).max(axis=(3, 5))
+    t = OpTest()
+    t.op_type = "pool2d"
+    t.inputs = {"X": [("x", x)]}
+    t.attrs = {"pooling_type": "max", "ksize": [2, 2], "strides": [2, 2],
+               "paddings": [0, 0]}
+    t.outputs = {"Out": [("out", expected)]}
+    t.check_output()
+    # grad check on avg pool (max-pool numeric grads are ill-conditioned
+    # near ties — same caveat as the reference OpTest)
+    t2 = OpTest()
+    t2.op_type = "pool2d"
+    t2.inputs = {"X": [("x", x)]}
+    t2.attrs = {"pooling_type": "avg", "ksize": [2, 2], "strides": [2, 2],
+                "paddings": [0, 0]}
+    t2.outputs = {"Out": [("out", x.reshape(2, 3, 2, 2, 2, 2).mean(axis=(3, 5)))]}
+    t2.check_output()
+    t2.check_grad(["x"], "out", max_relative_error=0.01)
+
+
+def test_batch_norm_infer():
+    rng = np.random.RandomState(15)
+    x = rng.rand(2, 3, 4, 4).astype(np.float32)
+    scale = rng.rand(3).astype(np.float32)
+    bias = rng.rand(3).astype(np.float32)
+    mean = rng.rand(3).astype(np.float32)
+    var = (rng.rand(3) + 0.5).astype(np.float32)
+    b = lambda a: a.reshape(1, 3, 1, 1)
+    expected = (x - b(mean)) / np.sqrt(b(var) + 1e-5) * b(scale) + b(bias)
+    t = OpTest()
+    t.op_type = "batch_norm"
+    t.inputs = {"X": [("x", x)], "Scale": [("scale", scale)],
+                "Bias": [("bias", bias)], "Mean": [("mean", mean)],
+                "Variance": [("var", var)]}
+    t.attrs = {"is_test": True, "epsilon": 1e-5}
+    t.outputs = {"Y": [("y_out", expected)]}
+    t.check_output(atol=1e-4)
+
+
+def test_transpose_concat_split():
+    rng = np.random.RandomState(16)
+    x = rng.rand(2, 3, 4).astype(np.float32)
+    t = OpTest()
+    t.op_type = "transpose2"
+    t.inputs = {"X": [("x", x)]}
+    t.attrs = {"axis": [1, 0, 2]}
+    t.outputs = {"Out": [("out", x.transpose(1, 0, 2))]}
+    t.check_output()
+    t.check_grad(["x"], "out")
+
+    a = rng.rand(2, 3).astype(np.float32)
+    b = rng.rand(2, 5).astype(np.float32)
+    t2 = OpTest()
+    t2.op_type = "concat"
+    t2.inputs = {"X": [("a", a), ("b", b)]}
+    t2.attrs = {"axis": 1}
+    t2.outputs = {"Out": [("out", np.concatenate([a, b], 1))]}
+    t2.check_output()
+    t2.check_grad(["a", "b"], "out")
+
+
+def test_dropout_deterministic_between_fwd_and_grad():
+    """Dropout mask must be identical in forward and recomputed-vjp grad —
+    gradient of sum(dropout(x)) must be exactly mask/keep_prob pattern."""
+    import paddle_tpu as fluid
+    from paddle_tpu import framework
+
+    x = fluid.layers.data(name="x", shape=[64], dtype="float32")
+    x.stop_gradient = False
+    y = fluid.layers.dropout(x, dropout_prob=0.5,
+                             dropout_implementation="upscale_in_train")
+    loss = fluid.layers.reduce_sum(y)
+    (gx,) = fluid.gradients(loss, [x])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xd = np.ones((4, 64), np.float32)
+    yv, gv = exe.run(feed={"x": xd}, fetch_list=[y, gx])
+    # where output is zero grad must be zero; where output is 2 grad must be 2
+    np.testing.assert_allclose(np.asarray(yv), np.asarray(gv))
