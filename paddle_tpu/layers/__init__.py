@@ -5,11 +5,14 @@ from . import tensor
 from . import io
 from . import sequence
 from . import detection
+from . import metric_op
 from .nn import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
 from .io import *  # noqa: F401,F403
 from .sequence import *  # noqa: F401,F403
 from .detection import *  # noqa: F401,F403
+from .metric_op import *  # noqa: F401,F403
 
 __all__ = list(set(nn.__all__) | set(tensor.__all__) | set(io.__all__)
-               | set(sequence.__all__) | set(detection.__all__))
+               | set(sequence.__all__) | set(detection.__all__)
+               | set(metric_op.__all__))

@@ -89,7 +89,7 @@ def concat(input, axis=0, name=None):
                 break
             dim += s[ax]
         out.shape = tuple(
-            dim if i == ax else shapes[0][i] for i in range(len(shapes[0]))
+            dim if i == ax else d for i, d in enumerate(shapes[0])
         )
     return out
 

@@ -1,0 +1,18 @@
+"""Model zoo (parity: /root/reference/benchmark/fluid/models/ — mnist,
+resnet, vgg, stacked_dynamic_lstm, machine_translation — plus the flagship
+TPU-native Transformer and a DeepFM CTR model for the sparse-embedding
+configs in BASELINE.md).
+
+Each model module exposes a `build(...)` function that constructs the
+network in the current default Program via the `paddle_tpu.layers` DSL and
+returns the variables a training loop needs (loss, inputs, predictions).
+"""
+
+from . import mnist  # noqa: F401
+from . import resnet  # noqa: F401
+from . import vgg  # noqa: F401
+from . import stacked_lstm  # noqa: F401
+from . import word2vec  # noqa: F401
+from . import machine_translation  # noqa: F401
+from . import deepfm  # noqa: F401
+from . import transformer  # noqa: F401

@@ -1,0 +1,69 @@
+"""Seq2seq NMT with attention (parity: benchmark/fluid/models/
+machine_translation.py — GRU encoder/decoder + Bahdanau-style attention,
+teacher-forced training).
+
+TPU design: the reference builds the decoder with DynamicRNN over LoD
+batches; here the whole decoder is one `dynamic_gru` pass plus a batched
+attention matmul over padded [B, T] inputs with length masks — a single
+fused XLA computation rather than per-step scopes (SURVEY §5.7)."""
+
+from .. import layers
+
+
+def encoder(src_word, src_len, dict_size, embed_dim, hidden_dim):
+    emb = layers.embedding(input=src_word, size=[dict_size, embed_dim])
+    proj = layers.fc(input=emb, size=hidden_dim * 3, num_flatten_dims=2,
+                     bias_attr=False)
+    enc = layers.dynamic_gru(input=proj, size=hidden_dim)
+    return enc
+
+
+def attention(dec_state, enc_states, enc_mask):
+    """Additive-free dot attention: scores = dec_state @ enc^T, masked
+    softmax over source positions, context = weights @ enc."""
+    # dec_state [B, Td, H]; enc_states [B, Ts, H]
+    scores = layers.matmul(dec_state, enc_states, transpose_y=True)
+    # mask [B, Ts] -> [B, 1, Ts]
+    mask = layers.unsqueeze(enc_mask, axes=[1])
+    big_neg = layers.scale(mask, scale=-1e9, bias=1e9)  # 0 where valid
+    scores = layers.elementwise_add(scores, big_neg)
+    weights = layers.softmax(scores)
+    return layers.matmul(weights, enc_states)
+
+
+def build(src_dict_size=10000, trg_dict_size=10000, embed_dim=512,
+          hidden_dim=512, max_len=50):
+    src = layers.data(name="src_word", shape=[max_len], dtype="int64")
+    src_len = layers.data(name="src_len", shape=[1], dtype="int64")
+    trg = layers.data(name="trg_word", shape=[max_len], dtype="int64")
+    trg_next = layers.data(name="trg_next", shape=[max_len], dtype="int64")
+    trg_len = layers.data(name="trg_len", shape=[1], dtype="int64")
+
+    enc = encoder(src, src_len, src_dict_size, embed_dim, hidden_dim)
+    src_mask = layers.cast(
+        layers.sequence_mask(src_len, maxlen=max_len, dtype="float32"),
+        "float32")
+
+    trg_emb = layers.embedding(input=trg, size=[trg_dict_size, embed_dim])
+    dec_proj = layers.fc(input=trg_emb, size=hidden_dim * 3,
+                         num_flatten_dims=2, bias_attr=False)
+    dec = layers.dynamic_gru(input=dec_proj, size=hidden_dim)
+
+    ctxt = attention(dec, enc, src_mask)
+    dec_ctx = layers.concat([dec, ctxt], axis=2)
+    logits = layers.fc(input=dec_ctx, size=trg_dict_size, num_flatten_dims=2)
+
+    # masked token cross-entropy over the padded target
+    flat_logits = layers.reshape(logits, shape=[-1, trg_dict_size])
+    flat_label = layers.reshape(trg_next, shape=[-1, 1])
+    cost = layers.softmax_with_cross_entropy(logits=flat_logits,
+                                             label=flat_label)
+    cost = layers.reshape(cost, shape=[-1, max_len])
+    trg_mask = layers.cast(
+        layers.sequence_mask(trg_len, maxlen=max_len, dtype="float32"),
+        "float32")
+    masked = layers.elementwise_mul(cost, trg_mask)
+    total = layers.reduce_sum(masked)
+    denom = layers.reduce_sum(trg_mask)
+    avg_cost = layers.elementwise_div(total, denom)
+    return (src, src_len, trg, trg_next, trg_len), logits, avg_cost

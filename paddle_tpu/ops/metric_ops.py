@@ -1,0 +1,102 @@
+"""In-graph metric ops (parity: operators/metrics/ — accuracy_op.cc,
+auc_op.cc, precision_recall_op.cc)."""
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("accuracy", differentiable=False)
+def _accuracy(ctx, ins, attrs):
+    """Top-k accuracy (accuracy_op.cc): Out=topk values, Indices=topk ids,
+    Label=[N,1] int labels -> Accuracy [1], Correct [1], Total [1]."""
+    indices = ins["Indices"][0]
+    label = ins["Label"][0].reshape((-1, 1))
+    correct_mask = jnp.any(indices == label, axis=1)
+    correct = jnp.sum(correct_mask.astype(jnp.float32))
+    total = jnp.asarray(indices.shape[0], jnp.float32)
+    acc = (correct / total).reshape((1,))
+    return {
+        "Accuracy": [acc],
+        "Correct": [correct.reshape((1,)).astype(jnp.int32)],
+        "Total": [total.reshape((1,)).astype(jnp.int32)],
+    }
+
+
+@register("auc", differentiable=False)
+def _auc(ctx, ins, attrs):
+    """Streaming AUC by threshold histogram (auc_op.cc): positive/negative
+    counts bucketed over `num_thresholds` prediction bins, carried in
+    persistable StatPos/StatNeg vars that this op updates functionally."""
+    predict = ins["Predict"][0]
+    label = ins["Label"][0].reshape((-1,))
+    stat_pos = ins["StatPos"][0]
+    stat_neg = ins["StatNeg"][0]
+    num_thresholds = stat_pos.shape[0] - 1
+
+    # probability of the positive class: column 1 of [N,2] softmax, or the
+    # raw score when 1-D
+    score = predict[:, 1] if predict.ndim == 2 and predict.shape[1] >= 2 \
+        else predict.reshape((-1,))
+    bins = jnp.clip((score * num_thresholds).astype(jnp.int32),
+                    0, num_thresholds)
+    is_pos = (label > 0).astype(stat_pos.dtype)
+    stat_pos = stat_pos.at[bins].add(is_pos)
+    stat_neg = stat_neg.at[bins].add(1.0 - is_pos)
+
+    # trapezoid rule over the ROC curve swept from the highest bin down
+    pos_flip = stat_pos[::-1]
+    neg_flip = stat_neg[::-1]
+    tp = jnp.cumsum(pos_flip)
+    fp = jnp.cumsum(neg_flip)
+    tot_pos = tp[-1]
+    tot_neg = fp[-1]
+    tp_prev = jnp.concatenate([jnp.zeros((1,), tp.dtype), tp[:-1]])
+    fp_prev = jnp.concatenate([jnp.zeros((1,), fp.dtype), fp[:-1]])
+    area = jnp.sum((fp - fp_prev) * (tp + tp_prev) / 2.0)
+    denom = tot_pos * tot_neg
+    auc = jnp.where(denom > 0, area / jnp.maximum(denom, 1.0), 0.0)
+    return {
+        "AUC": [auc.reshape((1,))],
+        "StatPosOut": [stat_pos],
+        "StatNegOut": [stat_neg],
+    }
+
+
+@register("precision_recall", differentiable=False)
+def _precision_recall(ctx, ins, attrs):
+    """Multi-class precision/recall/F1, macro + micro averaged
+    (precision_recall_op.cc). MaxProbs-free variant: takes Indices (predicted
+    class ids) + Labels; accumulates into StatesInfo [C,4] rows of
+    (TP, FP, TN, FN)."""
+    idx = ins["Indices"][0].reshape((-1,))
+    label = ins["Labels"][0].reshape((-1,))
+    states = ins["StatesInfo"][0]
+    ncls = states.shape[0]
+
+    onehot_pred = (idx[:, None] == jnp.arange(ncls)[None, :])
+    onehot_lab = (label[:, None] == jnp.arange(ncls)[None, :])
+    tp = jnp.sum(onehot_pred & onehot_lab, axis=0).astype(states.dtype)
+    fp = jnp.sum(onehot_pred & ~onehot_lab, axis=0).astype(states.dtype)
+    fn = jnp.sum(~onehot_pred & onehot_lab, axis=0).astype(states.dtype)
+    tn = jnp.sum(~onehot_pred & ~onehot_lab, axis=0).astype(states.dtype)
+    states = states + jnp.stack([tp, fp, tn, fn], axis=1)
+
+    def prf(tp_, fp_, fn_):
+        prec = tp_ / jnp.maximum(tp_ + fp_, 1.0)
+        rec = tp_ / jnp.maximum(tp_ + fn_, 1.0)
+        f1 = 2 * prec * rec / jnp.maximum(prec + rec, 1e-6)
+        return prec, rec, f1
+
+    # batch metrics from this batch only; accum metrics from updated states
+    b = prf(tp, fp, fn)
+    a = prf(states[:, 0], states[:, 1], states[:, 3])
+    batch_metrics = jnp.concatenate([jnp.mean(m).reshape((1,)) for m in b]
+                                    + [jnp.sum(tp).reshape((1,))])
+    accum_metrics = jnp.concatenate([jnp.mean(m).reshape((1,)) for m in a]
+                                    + [jnp.sum(states[:, 0]).reshape((1,))])
+    return {
+        "BatchMetrics": [batch_metrics],
+        "AccumMetrics": [accum_metrics],
+        "AccumStatesInfo": [states],
+    }

@@ -1,0 +1,37 @@
+"""Book test: seq2seq NMT with attention on a synthetic copy task
+(parity: tests/book/test_machine_translation.py)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models import machine_translation
+
+
+def test_nmt_attention_trains_on_copy_task():
+    V, T = 40, 10
+    inputs, logits, avg_cost = machine_translation.build(
+        src_dict_size=V, trg_dict_size=V, embed_dim=16, hidden_dim=16,
+        max_len=T)
+    fluid.optimizer.Adam(learning_rate=1e-2).minimize(avg_cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    rng = np.random.RandomState(4)
+    n = 96
+    lens = rng.randint(3, T + 1, size=(n, 1)).astype(np.int64)
+    src = np.zeros((n, T), np.int64)
+    for i in range(n):
+        src[i, : lens[i, 0]] = rng.randint(2, V, size=lens[i, 0])
+    # copy task: trg = <bos>=1 + src shifted; next = src
+    trg = np.zeros((n, T), np.int64)
+    trg[:, 0] = 1
+    trg[:, 1:] = src[:, :-1]
+    feed_all = {"src_word": src, "src_len": lens, "trg_word": trg,
+                "trg_next": src, "trg_len": lens}
+    losses = []
+    for epoch in range(12):
+        for i in range(0, n, 32):
+            feed = {k: v[i:i + 32] for k, v in feed_all.items()}
+            lv, = exe.run(feed=feed, fetch_list=[avg_cost])
+        losses.append(float(lv[0]))
+    assert losses[-1] < losses[0] * 0.9, losses

@@ -1,0 +1,50 @@
+"""Book test: MNIST digit recognition, MLP + CNN variants (parity:
+python/paddle/fluid/tests/book/test_recognize_digits.py — train loop with
+decreasing loss + accuracy metric)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models import mnist
+
+
+def _synthetic_mnist(n=512, flat=True, seed=0):
+    """Linearly-separable-ish synthetic digits: class-dependent means."""
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, size=(n, 1)).astype(np.int64)
+    d = 784 if flat else (1, 28, 28)
+    base = rng.normal(size=(10,) + ((784,) if flat else d)).astype(np.float32)
+    imgs = base[labels[:, 0]] + 0.3 * rng.normal(
+        size=(n,) + ((784,) if flat else d)).astype(np.float32)
+    return imgs.astype(np.float32), labels
+
+
+def _train(arch, imgs, labels, epochs=8, batch=64, lr=0.05):
+    img, label, pred, avg_cost, acc = mnist.build(arch=arch)
+    opt = fluid.optimizer.Adam(learning_rate=lr) if arch == "cnn" \
+        else fluid.optimizer.SGD(learning_rate=lr)
+    opt.minimize(avg_cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    losses, accs = [], []
+    for _ in range(epochs):
+        for i in range(0, len(imgs), batch):
+            lv, av = exe.run(
+                feed={"img": imgs[i:i + batch], "label": labels[i:i + batch]},
+                fetch_list=[avg_cost, acc])
+        losses.append(float(lv[0]))
+        accs.append(float(av[0]))
+    return losses, accs
+
+
+def test_mnist_mlp_trains():
+    imgs, labels = _synthetic_mnist(flat=True)
+    losses, accs = _train("mlp", imgs, labels)
+    assert losses[-1] < losses[0] * 0.5, losses
+    assert accs[-1] > 0.7, accs
+
+
+def test_mnist_cnn_trains():
+    imgs, labels = _synthetic_mnist(n=128, flat=False)
+    losses, accs = _train("cnn", imgs, labels, epochs=4, batch=32, lr=1e-3)
+    assert losses[-1] < losses[0], losses

@@ -1,0 +1,40 @@
+"""Book test: stacked-LSTM sentiment classification on synthetic padded
+sequences (parity: tests/book/test_understand_sentiment.py stacked_lstm)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models import stacked_lstm
+
+
+def _synthetic_imdb(n=128, seq_len=24, dict_size=200, seed=2):
+    """Class 1 sequences draw from the top half of the vocab."""
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 2, size=(n, 1)).astype(np.int64)
+    lens = rng.randint(seq_len // 2, seq_len + 1, size=(n, 1)).astype(np.int64)
+    words = np.zeros((n, seq_len), np.int64)
+    for i in range(n):
+        lo, hi = (dict_size // 2, dict_size) if labels[i, 0] else (0, dict_size // 2)
+        L = int(lens[i, 0])
+        words[i, :L] = rng.randint(lo, hi, size=L)
+    return words, labels, lens
+
+
+def test_stacked_lstm_sentiment_trains():
+    words, labels, lens = _synthetic_imdb()
+    data, label, lengths, pred, avg_cost, acc = stacked_lstm.build(
+        dict_size=200, emb_dim=16, hid_dim=16, stacked_num=2, seq_len=24)
+    fluid.optimizer.Adam(learning_rate=2e-3).minimize(avg_cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    batch = 32
+    losses = []
+    for epoch in range(6):
+        for i in range(0, len(words), batch):
+            lv, av = exe.run(
+                feed={"words": words[i:i + batch],
+                      "label": labels[i:i + batch],
+                      "seq_len": lens[i:i + batch]},
+                fetch_list=[avg_cost, acc])
+        losses.append(float(lv[0]))
+    assert losses[-1] < losses[0], losses

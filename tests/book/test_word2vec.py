@@ -1,0 +1,30 @@
+"""Book test: word2vec N-gram LM (parity: tests/book/test_word2vec.py)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models import word2vec
+
+
+def test_word2vec_trains():
+    dict_size = 64
+    words, pred, avg_cost = word2vec.build(dict_size=dict_size,
+                                           embed_size=8, hidden_size=32)
+    fluid.optimizer.SGD(learning_rate=0.05).minimize(avg_cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    # deterministic next-word: next = (sum of context) % dict_size
+    rng = np.random.RandomState(3)
+    n = 256
+    ctx = rng.randint(0, dict_size, size=(n, 4)).astype(np.int64)
+    nxt = (ctx.sum(axis=1) % dict_size).astype(np.int64)[:, None]
+    feed_names = ["firstw", "secondw", "thirdw", "forthw", "nextw"]
+    losses = []
+    for epoch in range(15):
+        for i in range(0, n, 64):
+            feed = {feed_names[j]: ctx[i:i + 64, j:j + 1] for j in range(4)}
+            feed["nextw"] = nxt[i:i + 64]
+            lv, = exe.run(feed=feed, fetch_list=[avg_cost])
+        losses.append(float(lv[0]))
+    assert losses[-1] < losses[0], losses
