@@ -6,6 +6,10 @@ from . import io
 from . import sequence
 from . import detection
 from . import metric_op
+from . import control_flow
+from . import learning_rate_scheduler
+from .control_flow import *  # noqa: F401,F403
+from .learning_rate_scheduler import *  # noqa: F401,F403
 from .nn import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
 from .io import *  # noqa: F401,F403
@@ -15,4 +19,5 @@ from .metric_op import *  # noqa: F401,F403
 
 __all__ = list(set(nn.__all__) | set(tensor.__all__) | set(io.__all__)
                | set(sequence.__all__) | set(detection.__all__)
-               | set(metric_op.__all__))
+               | set(metric_op.__all__) | set(control_flow.__all__)
+               | set(learning_rate_scheduler.__all__))

@@ -1,0 +1,565 @@
+"""Control-flow layers (parity: python/paddle/fluid/layers/control_flow.py —
+While :620, StaticRNN :272, DynamicRNN :1646, IfElse :1516, Switch :1390,
+increment, array ops, Print :135).
+
+Sub-blocks are real nested Blocks in the Program (BlockDesc parent_idx
+parity); the control-flow ops list every touched outer var as an input so
+lowering/autodiff see through the region (ops/controlflow.py).
+"""
+
+import contextlib
+
+import numpy as np
+
+from .. import framework
+from ..framework import Variable, default_main_program
+from ..layer_helper import LayerHelper
+from . import tensor as tensor_layers
+from . import nn as nn_layers
+
+__all__ = [
+    "While", "Switch", "IfElse", "StaticRNN", "DynamicRNN", "cond",
+    "increment", "array_write", "array_read", "array_length", "create_array",
+    "less_than", "less_equal", "greater_than", "greater_equal", "equal",
+    "not_equal", "Print", "is_empty",
+]
+
+# re-export the compare layers that live in nn.py so control_flow is
+# API-complete (Fluid defines them in layers/control_flow.py)
+less_than = nn_layers.less_than
+less_equal = nn_layers.less_equal
+greater_than = nn_layers.greater_than
+greater_equal = nn_layers.greater_equal
+equal = nn_layers.equal
+not_equal = nn_layers.not_equal
+
+
+def increment(x, value=1.0, in_place=True):
+    """x += value (parity: control_flow.py increment)."""
+    helper = LayerHelper("increment", **locals())
+    out = x if in_place else helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="increment", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"step": float(value)})
+    out.shape = x.shape
+    return out
+
+
+def Print(input, first_n=-1, message=None, summarize=-1,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """In-graph tensor printing (control_flow.py:135) via jax.debug.print."""
+    helper = LayerHelper("print", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="print", inputs={"In": [input]}, outputs={"Out": [out]},
+        attrs={"message": message or input.name})
+    out.shape = input.shape
+    return out
+
+
+def is_empty(x, cond=None):
+    helper = LayerHelper("is_empty", **locals())
+    if cond is None:
+        cond = helper.create_variable_for_type_inference("bool")
+    helper.append_op(type="is_empty", inputs={"X": [x]},
+                     outputs={"Out": [cond]})
+    cond.shape = (1,)
+    return cond
+
+
+# ---------------------------------------------------------------------------
+# sub-block bookkeeping
+# ---------------------------------------------------------------------------
+
+
+def _block_reads_writes(block):
+    """(outer-read names, parent-visible write names) of a sub-block tree."""
+    local = set(block.vars)
+    reads, writes = [], []
+    seen_r, seen_w = set(), set()
+
+    def visit(b, local_names):
+        for op in b.ops:
+            for vs in op.inputs.values():
+                for v in vs:
+                    if v.name not in local_names and v.name not in seen_r:
+                        seen_r.add(v.name)
+                        reads.append(v.name)
+            for battr in ("sub_block", "true_block", "false_block"):
+                sub = op.attrs.get(battr)
+                if isinstance(sub, framework.Block):
+                    visit(sub, local_names | set(sub.vars))
+            for vs in op.outputs.values():
+                for v in vs:
+                    if v.name not in local_names and v.name not in seen_w:
+                        seen_w.add(v.name)
+                        writes.append(v.name)
+
+    visit(block, local)
+    return reads, writes
+
+
+def _outer_var(block, name):
+    return block._find_var_recursive(name)
+
+
+@contextlib.contextmanager
+def _sub_block():
+    prog = default_main_program()
+    blk = prog._create_block()
+    try:
+        yield blk
+    finally:
+        prog._rollback()
+
+
+@contextlib.contextmanager
+def _in_parent_block():
+    """Temporarily append ops to the parent of the current (sub-)block —
+    for values a control-flow op consumes from outside (boot memories,
+    time-major transposes)."""
+    prog = default_main_program()
+    cur = prog.current_block_idx
+    parent = prog.blocks[cur].parent_idx
+    if parent < 0:
+        yield
+        return
+    prog.current_block_idx = parent
+    try:
+        yield
+    finally:
+        prog.current_block_idx = cur
+
+
+# ---------------------------------------------------------------------------
+# While
+# ---------------------------------------------------------------------------
+
+
+class While:
+    """Fluid While (control_flow.py:620):
+
+        cond = layers.less_than(i, n)
+        loop = layers.While(cond)
+        with loop.block():
+            ...                       # ops writing i / cond in place
+    Forward-only under XLA (lax.while_loop); use StaticRNN/DynamicRNN for
+    differentiable recurrences."""
+
+    def __init__(self, cond, is_test=False, name=None):
+        self.cond_var = cond
+        self.helper = LayerHelper("while", name=name)
+
+    @contextlib.contextmanager
+    def block(self):
+        parent = default_main_program().current_block()
+        with _sub_block() as blk:
+            yield
+        reads, writes = _block_reads_writes(blk)
+        cond_name = self.cond_var.name
+        # inputs: everything the body touches that lives in the outer scope
+        x_names = []
+        for n in dict.fromkeys(reads + writes):
+            if n == cond_name:
+                continue
+            v = parent._find_var_recursive(n)
+            if v is not None:
+                x_names.append(n)
+        out_names = [n for n in writes
+                     if n != cond_name and parent._find_var_recursive(n)]
+        carry_names = list(out_names)
+        if cond_name not in carry_names:
+            carry_names.append(cond_name)
+        parent.append_op(
+            type="while",
+            inputs={"Condition": [self.cond_var],
+                    "X": [parent.var(n) for n in x_names]},
+            outputs={"Out": [parent.var(n) for n in out_names]},
+            attrs={"sub_block": blk, "x_names": x_names,
+                   "out_names": out_names, "carry_names": carry_names,
+                   "cond_name": cond_name},
+        )
+
+
+# ---------------------------------------------------------------------------
+# cond / Switch / IfElse
+# ---------------------------------------------------------------------------
+
+
+def _append_cond_op(parent, pred, true_block, false_block, out_names):
+    reads = []
+    for blk in (true_block, false_block):
+        if blk is not None:
+            r, w = _block_reads_writes(blk)
+            reads += r
+            # written vars with a pre-existing value feed the skip-branch
+            # fallback; fresh outputs of this very cond op (produced only
+            # inside its own branch blocks) do not
+            for n in w:
+                v = parent._find_var_recursive(n)
+                if v is None:
+                    continue
+                producer = getattr(v, "op", None)
+                if v.persistable or (
+                        producer is not None
+                        and producer.block not in (true_block, false_block)):
+                    reads.append(n)
+    x_names = []
+    for n in dict.fromkeys(reads):
+        v = parent._find_var_recursive(n)
+        if v is not None and n != pred.name:
+            x_names.append(n)
+    attrs = {"true_block": true_block, "false_block": false_block,
+             "x_names": x_names, "out_names": out_names}
+    parent.append_op(
+        type="cond",
+        inputs={"Cond": [pred], "X": [parent.var(n) for n in x_names]},
+        outputs={"Out": [parent.var(n) for n in out_names]},
+        attrs=attrs,
+    )
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """Functional conditional (modern fluid layers.cond). Both branches run
+    under lax.cond; returns the selected branch outputs (var or list)."""
+    helper = LayerHelper("cond", name=name)
+    parent = default_main_program().current_block()
+
+    def build(fn):
+        if fn is None:
+            return None, None
+        with _sub_block() as blk:
+            ret = fn()
+        rets = ret if isinstance(ret, (list, tuple)) else (
+            [] if ret is None else [ret])
+        return blk, list(rets)
+
+    true_block, true_rets = build(true_fn)
+    false_block, false_rets = build(false_fn)
+    n_out = max(len(true_rets or []), len(false_rets or []))
+    if (true_rets is not None and false_rets is not None
+            and len(true_rets) != len(false_rets)):
+        raise ValueError("cond branches must return the same number of vars")
+
+    outs = []
+    for i in range(n_out):
+        proto = (true_rets or false_rets)[i]
+        out = parent.create_var(
+            name=helper.name + ".out%d" % i, dtype=proto.dtype,
+            shape=proto.shape)
+        outs.append(out)
+        # each branch assigns its result into the shared output var
+        for blk, rets in ((true_block, true_rets), (false_block, false_rets)):
+            if blk is not None and rets:
+                blk.append_op(type="assign", inputs={"X": [rets[i]]},
+                              outputs={"Out": [out]})
+    _append_cond_op(parent, pred, true_block, false_block,
+                    [o.name for o in outs])
+    if not outs:
+        return None
+    return outs[0] if n_out == 1 else outs
+
+
+class Switch:
+    """First-match multiway branch (control_flow.py:1390), used by LR
+    schedules:
+
+        with switch.case(cond1): ...assign...
+        with switch.default():   ...assign...
+    Lowered as a chain of `cond` ops guarded by a running not-yet-matched
+    flag."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("switch", name=name)
+        self._matched = None  # bool var: some earlier case fired
+
+    def _parent(self):
+        return default_main_program().current_block()
+
+    @contextlib.contextmanager
+    def case(self, condition):
+        parent = self._parent()
+        if self._matched is None:
+            eff = condition
+        else:
+            not_prev = nn_layers.logical_not(self._matched)
+            eff = nn_layers.logical_and(condition, not_prev)
+        with _sub_block() as blk:
+            yield
+        _reads, writes = _block_reads_writes(blk)
+        out_names = [n for n in writes if parent._find_var_recursive(n)]
+        _append_cond_op(parent, eff, blk, None, out_names)
+        self._matched = condition if self._matched is None else \
+            nn_layers.logical_or(self._matched, condition)
+
+    @contextlib.contextmanager
+    def default(self):
+        parent = self._parent()
+        if self._matched is None:
+            raise ValueError("Switch.default() before any case()")
+        pred = nn_layers.logical_not(self._matched)
+        with _sub_block() as blk:
+            yield
+        _reads, writes = _block_reads_writes(blk)
+        out_names = [n for n in writes if parent._find_var_recursive(n)]
+        _append_cond_op(parent, pred, blk, None, out_names)
+
+
+class IfElse:
+    """Row-partitioned conditional (control_flow.py:1516).
+
+    Fluid splits the batch by a bool mask, runs each block on its rows and
+    merges (split_lod_tensor/merge_lod_tensor — data-dependent shapes).
+    TPU-native: both bodies run on the FULL batch in the parent block and
+    outputs merge row-wise with a select op — identical results for the
+    row-independent bodies IfElse supports, with static shapes."""
+
+    def __init__(self, cond, name=None):
+        self.cond = cond
+        self.helper = LayerHelper("ifelse", name=name)
+        self._true_pending = []
+        self._false_pending = []
+        self._pending = None
+
+    @contextlib.contextmanager
+    def true_block(self):
+        self._pending = self._true_pending
+        yield
+        self._pending = None
+
+    @contextlib.contextmanager
+    def false_block(self):
+        self._pending = self._false_pending
+        yield
+        self._pending = None
+
+    def input(self, x):
+        return x
+
+    def output(self, *outs):
+        if self._pending is None:
+            raise ValueError("IfElse.output() outside true/false block")
+        self._pending.extend(outs)
+
+    def __call__(self):
+        t_outs, f_outs = self._true_pending, self._false_pending
+        if len(t_outs) != len(f_outs):
+            raise ValueError("IfElse branches must output the same vars")
+        outs = []
+        for t, f in zip(t_outs, f_outs):
+            helper = LayerHelper("select")
+            sel = helper.create_variable_for_type_inference(t.dtype)
+            helper.append_op(type="select_rowwise",
+                             inputs={"Cond": [self.cond], "X": [t],
+                                     "Y": [f]},
+                             outputs={"Out": [sel]})
+            sel.shape = t.shape
+            outs.append(sel)
+        return outs if len(outs) != 1 else outs[0]
+
+
+# ---------------------------------------------------------------------------
+# StaticRNN / DynamicRNN  (recurrent_op.cc parity over lax.scan)
+# ---------------------------------------------------------------------------
+
+
+class StaticRNN:
+    """Time-major recurrence (control_flow.py:272): step inputs are sliced
+    on axis 0, memories carry across steps, outputs stack on axis 0."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("static_rnn", name=name)
+        self._step_inputs = []   # (outer var, inner var)
+        self._memories = []      # (pre var, boot var); post filled by update
+        self._mem_post = {}
+        self._step_outputs = []
+        self._blk = None
+
+    @contextlib.contextmanager
+    def step(self):
+        with _sub_block() as blk:
+            self._blk = blk
+            yield
+
+    def step_input(self, x):
+        blk = default_main_program().current_block()
+        inner = blk.create_var(
+            name=self.helper.name + ".in%d" % len(self._step_inputs),
+            dtype=x.dtype,
+            shape=tuple(x.shape[1:]) if x.shape else None)
+        self._step_inputs.append((x, inner))
+        return inner
+
+    def memory(self, init=None, shape=None, batch_ref=None, value=0.0,
+               dtype="float32"):
+        blk = default_main_program().current_block()
+        if init is None:
+            if shape is None:
+                raise ValueError("StaticRNN.memory needs init or shape")
+            with _in_parent_block():
+                init = tensor_layers.fill_constant(
+                    shape=list(shape), dtype=dtype, value=value)
+        pre = blk.create_var(
+            name=self.helper.name + ".mem%d" % len(self._memories),
+            dtype=init.dtype, shape=init.shape)
+        self._memories.append((pre, init))
+        return pre
+
+    def update_memory(self, mem, var):
+        self._mem_post[mem.name] = var
+
+    def step_output(self, o):
+        self._step_outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def __call__(self):
+        parent = default_main_program().current_block()
+        blk = self._blk
+        reads, _writes = _block_reads_writes(blk)
+        inner_names = ({i.name for _, i in self._step_inputs}
+                       | {p.name for p, _ in self._memories})
+        x_names = [n for n in reads
+                   if n not in inner_names and parent._find_var_recursive(n)]
+
+        T = None
+        for outer, _ in self._step_inputs:
+            if outer.shape:
+                T = outer.shape[0]
+                break
+        outs = []
+        for i, inner_o in enumerate(self._step_outputs):
+            out = parent.create_var(
+                name=self.helper.name + ".out%d" % i, dtype=inner_o.dtype,
+                shape=(T,) + tuple(inner_o.shape or ()) if T else None)
+            outs.append(out)
+        finals = []
+        for i, (pre, boot) in enumerate(self._memories):
+            fin = parent.create_var(
+                name=self.helper.name + ".final%d" % i, dtype=pre.dtype,
+                shape=pre.shape)
+            finals.append(fin)
+
+        mem_pairs = []
+        for pre, _boot in self._memories:
+            post = self._mem_post.get(pre.name)
+            if post is None:
+                raise ValueError("memory %s never updated" % pre.name)
+            mem_pairs.append((pre.name, post.name))
+
+        parent.append_op(
+            type="recurrent",
+            inputs={"StepInputs": [o for o, _ in self._step_inputs],
+                    "Boot": [b for _, b in self._memories],
+                    "X": [parent.var(n) for n in x_names]},
+            outputs={"StepOutputs": outs, "FinalMemories": finals},
+            attrs={"sub_block": blk,
+                   "step_input_names": [i.name for _, i in self._step_inputs],
+                   "memory_names": mem_pairs,
+                   "step_output_names": [o.name for o in self._step_outputs],
+                   "x_names": x_names, "max_len": T},
+        )
+        return outs if len(outs) != 1 else outs[0]
+
+
+class DynamicRNN:
+    """Variable-length recurrence (control_flow.py:1646). Batch-major padded
+    input [B, T, ...] + per-row lengths replace LoD; memory updates freeze
+    once a row's sequence ends (ops/controlflow.py recurrent SeqLen mask)."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("dynamic_rnn", name=name)
+        self._rnn = StaticRNN(name=(name or "") + "_drnn")
+        self._seq_len = None
+        self._step_inputs = []  # (outer batch-major, inner)
+
+    @contextlib.contextmanager
+    def block(self):
+        with self._rnn.step():
+            yield
+
+    def step_input(self, x, sequence_length=None):
+        if sequence_length is not None:
+            self._seq_len = sequence_length
+        # batch-major [B, T, ...] -> time-major [T, B, ...]
+        perm = list(range(len(x.shape or (0, 0))))
+        perm[0], perm[1] = perm[1], perm[0]
+        with _in_parent_block():
+            xt = nn_layers.transpose(x, perm=perm)
+        return self._rnn.step_input(xt)
+
+    def memory(self, init=None, shape=None, value=0.0, dtype="float32",
+               need_reorder=False):
+        return self._rnn.memory(init=init, shape=shape, value=value,
+                                dtype=dtype)
+
+    def update_memory(self, mem, var):
+        self._rnn.update_memory(mem, var)
+
+    def output(self, *outputs):
+        self._rnn.output(*outputs)
+
+    def __call__(self):
+        parent = default_main_program().current_block()
+        ret = self._rnn()
+        # attach SeqLen to the recurrent op we just appended
+        op = parent.ops[-1]
+        assert op.type == "recurrent"
+        if self._seq_len is not None:
+            op.inputs["SeqLen"] = [self._seq_len]
+        rets = ret if isinstance(ret, (list, tuple)) else [ret]
+        outs = []
+        for r in rets:
+            perm = list(range(len(r.shape or (0, 0))))
+            perm[0], perm[1] = perm[1], perm[0]
+            outs.append(nn_layers.transpose(r, perm=perm))
+        return outs if len(outs) != 1 else outs[0]
+
+
+# ---------------------------------------------------------------------------
+# tensor arrays (LoDTensorArray parity — static-indexed)
+# ---------------------------------------------------------------------------
+
+
+def create_array(dtype):
+    """LoDTensorArray var (control_flow.py create_array). Arrays here are
+    host-side lists manipulated between jitted segments (beam-search decode
+    parity); in-graph loops use StaticRNN/DynamicRNN stacking instead."""
+    from ..core.tensor import LoDTensorArray
+
+    helper = LayerHelper("array")
+    v = default_main_program().current_block().create_var(
+        name=helper.name, dtype=dtype, shape=None, persistable=False)
+    v.is_tensor_array = True
+    v._array = LoDTensorArray()
+    return v
+
+
+def array_write(x, i, array=None):
+    helper = LayerHelper("array_write", **locals())
+    if array is None:
+        array = create_array(x.dtype)
+    helper.append_op(type="array_write",
+                     inputs={"X": [x], "I": [i]},
+                     outputs={"Out": [array]})
+    return array
+
+
+def array_read(array, i):
+    helper = LayerHelper("array_read", **locals())
+    out = helper.create_variable_for_type_inference(array.dtype)
+    helper.append_op(type="array_read", inputs={"X": [array], "I": [i]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def array_length(array):
+    helper = LayerHelper("array_length", **locals())
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op(type="array_length", inputs={"X": [array]},
+                     outputs={"Out": [out]})
+    out.shape = (1,)
+    return out

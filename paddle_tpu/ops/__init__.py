@@ -15,3 +15,4 @@ from . import rnn_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import metric_ops  # noqa: F401
+from . import controlflow  # noqa: F401

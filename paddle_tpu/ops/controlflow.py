@@ -1,0 +1,175 @@
+"""Control-flow ops (parity: operators/controlflow/ — WhileOp
+while_op.cc:43, ConditionalBlockOp conditional_block_op.cc:75,
+recurrent_op.cc; plus increment/print utility ops).
+
+TPU-native design (SURVEY §7 "hard parts"): Fluid interprets sub-blocks
+over mutable step scopes; here the sub-block is *symbolically re-executed*
+inside `lax.while_loop` / `lax.cond` / `lax.scan` with explicit carried
+state. Each control-flow op lists every outer variable its sub-block touches
+as a real input (slot "X", names in attr `x_names`), so
+ (a) the executor's persistable-state scan sees through the loop, and
+ (b) the generic vjp grad machinery (core/lowering.py) differentiates
+     through `cond`/`recurrent` with no hand-written grad kernels.
+`while` is forward-only (lax.while_loop has no reverse-mode rule); Fluid
+models needing a differentiable loop express it as `recurrent` (StaticRNN/
+DynamicRNN), same as the reference's preferred path.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.lowering import execute_block
+from .registry import register, simple_op
+
+
+@simple_op("increment")
+def _increment(ctx, x, **attrs):
+    return x + jnp.asarray(attrs.get("step", 1.0), x.dtype)
+
+
+@register("print", differentiable=False)
+def _print(ctx, ins, attrs):
+    x = ins["In"][0]
+    msg = attrs.get("message", "") or ""
+    jax.debug.print(msg + " {x}", x=x)
+    return {"Out": [x]}
+
+
+@register("select_rowwise")
+def _select_rowwise(ctx, ins, attrs):
+    """Row-wise merge for IfElse (split/merge_lod_tensor parity without
+    data-dependent shapes): out[b] = cond[b] ? x[b] : y[b]."""
+    c = ins["Cond"][0]
+    x, y = ins["X"][0], ins["Y"][0]
+    c = jnp.reshape(c.astype(bool), (-1,) + (1,) * (x.ndim - 1))
+    return {"Out": [jnp.where(c, x, y)]}
+
+
+@register("array_write", differentiable=False)
+def _array_write(ctx, ins, attrs):
+    """LoDTensorArray write (tensor_array_read_write.cc). Arrays are
+    host-side lists: usable between jitted program segments; inside a traced
+    loop the index would be abstract — StaticRNN/DynamicRNN stacking is the
+    in-graph path (SURVEY §7 LoD hard-part)."""
+    arr = ins.get("ArrayIn", [None])[0] or []
+    i = int(ins["I"][0].reshape(()))
+    arr = list(arr)
+    while len(arr) <= i:
+        arr.append(None)
+    arr[i] = ins["X"][0]
+    return {"Out": [arr]}
+
+
+@register("array_read", differentiable=False)
+def _array_read(ctx, ins, attrs):
+    arr = ins["X"][0]
+    i = int(ins["I"][0].reshape(()))
+    return {"Out": [arr[i]]}
+
+
+@register("array_length", differentiable=False)
+def _array_length(ctx, ins, attrs):
+    return {"Out": [jnp.asarray([len(ins["X"][0])], jnp.int32)]}
+
+
+def _env_of(ins, attrs):
+    return dict(zip(attrs["x_names"], ins.get("X", [])))
+
+
+@register("while", differentiable=False, nondiff_inputs=("X", "Condition"))
+def _while(ctx, ins, attrs):
+    """while_op.cc:43 — iterate sub_block until Condition goes false.
+    Carried state = attr `carry_names` (sub-block writes that are
+    parent-visible, incl. the condition)."""
+    block = attrs["sub_block"]
+    carry_names = list(attrs["carry_names"])
+    env = _env_of(ins, attrs)
+    env[attrs["cond_name"]] = ins["Condition"][0]
+    cond_idx = carry_names.index(attrs["cond_name"])
+
+    def cond_fn(carry):
+        return jnp.reshape(carry[cond_idx], ()).astype(bool)
+
+    def body_fn(carry):
+        local = dict(env)
+        local.update(zip(carry_names, carry))
+        execute_block(block, local, ctx)
+        return tuple(local[n] for n in carry_names)
+
+    init = tuple(env[n] for n in carry_names)
+    final = jax.lax.while_loop(cond_fn, body_fn, init)
+    out_names = attrs["out_names"]
+    final_env = dict(zip(carry_names, final))
+    return {"Out": [final_env[n] for n in out_names]}
+
+
+@register("cond")
+def _cond(ctx, ins, attrs):
+    """Functional two-branch conditional (modern layers.cond; IfElse/Switch
+    lower onto this). A branch that doesn't write an output var falls back
+    to the var's incoming value (conditional_block_op.cc:75 skip
+    semantics)."""
+    pred = jnp.reshape(ins["Cond"][0], ()).astype(bool)
+    env = _env_of(ins, attrs)
+    out_names = attrs["out_names"]
+
+    def run(block):
+        local = dict(env)
+        if block is not None:
+            execute_block(block, local, ctx)
+        return tuple(local[n] for n in out_names)
+
+    outs = jax.lax.cond(pred,
+                        lambda: run(attrs["true_block"]),
+                        lambda: run(attrs.get("false_block")))
+    return {"Out": list(outs)}
+
+
+@register("recurrent")
+def _recurrent(ctx, ins, attrs):
+    """recurrent_op.cc — scan sub_block over the leading (time) axis.
+
+    slots: StepInputs (time-major [T, ...]), Boot (initial memories),
+    X (closure); attrs: step_input_names/memory_names (inner [pre, post]
+    pairs)/step_output_names/x_names/sub_block; optional SeqLen input masks
+    memory updates past each sequence's length (DynamicRNN parity without
+    LoD batch shrinking — SURVEY §5.7)."""
+    block = attrs["sub_block"]
+    env = _env_of(ins, attrs)
+    step_in_names = attrs["step_input_names"]
+    mem_pairs = attrs["memory_names"]  # [(pre_name, post_name), ...]
+    step_out_names = attrs["step_output_names"]
+    reverse = bool(attrs.get("is_reverse", False))
+
+    xs = tuple(ins.get("StepInputs", []))
+    init = tuple(ins.get("Boot", []))
+    seq_len = ins.get("SeqLen", [None])[0]
+
+    def step(carry, xs_and_t):
+        t, xs_t = xs_and_t
+        local = dict(env)
+        local.update(zip(step_in_names, xs_t))
+        local.update(zip([p for p, _ in mem_pairs], carry))
+        execute_block(block, local, ctx)
+        new = [local[q] for _, q in mem_pairs]
+        if seq_len is not None:
+            # batch rows whose sequence ended keep their old memory
+            alive = t < seq_len.reshape((-1,))
+
+            def sel(n, c):
+                return jnp.where(
+                    jnp.reshape(alive, (-1,) + (1,) * (n.ndim - 1)), n, c)
+
+            new = [sel(n, c) for n, c in zip(new, carry)]
+            ys = tuple(
+                jnp.where(jnp.reshape(alive, (-1,) + (1,) * (y.ndim - 1)),
+                          y, jnp.zeros_like(y))
+                for y in (local[n] for n in step_out_names))
+        else:
+            ys = tuple(local[n] for n in step_out_names)
+        return tuple(new), ys
+
+    T = xs[0].shape[0] if xs else attrs["max_len"]
+    ts = jnp.arange(T)
+    final_carry, ys = jax.lax.scan(step, init, (ts, xs), reverse=reverse)
+    return {"StepOutputs": list(ys), "FinalMemories": list(final_carry)}

@@ -1,0 +1,193 @@
+"""Control-flow tests (parity: unittests test_while_op.py, test_cond.py,
+test_static_rnn / test_dynamic_rnn, test_learning_rate_scheduler.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _exe():
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return exe
+
+
+def test_while_loop_sums():
+    i = layers.fill_constant(shape=[1], dtype="int64", value=0)
+    ten = layers.fill_constant(shape=[1], dtype="int64", value=10)
+    acc = layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+    cond = layers.less_than(x=i, y=ten)
+    loop = layers.While(cond=cond)
+    with loop.block():
+        layers.increment(x=acc, value=2.0, in_place=True)
+        layers.increment(x=i, value=1, in_place=True)
+        layers.less_than(x=i, y=ten, cond=cond)
+    exe = _exe()
+    acc_v, i_v = exe.run(feed={}, fetch_list=[acc, i])
+    assert float(acc_v[0]) == 20.0
+    assert int(i_v[0]) == 10
+
+
+def test_cond_selects_branch():
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    flag = layers.data(name="flag", shape=[1], dtype="bool")
+    out = layers.cond(flag,
+                      lambda: layers.scale(x, scale=2.0),
+                      lambda: layers.scale(x, scale=-1.0))
+    exe = _exe()
+    xd = np.arange(8, dtype=np.float32).reshape(2, 4)
+    r_true, = exe.run(feed={"x": xd, "flag": np.array([True])},
+                      fetch_list=[out])
+    r_false, = exe.run(feed={"x": xd, "flag": np.array([False])},
+                       fetch_list=[out])
+    np.testing.assert_allclose(r_true, xd * 2.0)
+    np.testing.assert_allclose(r_false, -xd)
+
+
+def test_cond_gradient_flows():
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    flag = layers.data(name="flag", shape=[1], dtype="bool")
+    w = layers.create_parameter(shape=[4, 4], dtype="float32")
+    h = layers.mul(x, w)
+    out = layers.cond(flag,
+                      lambda: layers.scale(h, scale=3.0),
+                      lambda: layers.scale(h, scale=1.0))
+    loss = layers.mean(out)
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = _exe()
+    before = np.asarray(fluid.global_scope().get(w.name)).copy()
+    exe.run(feed={"x": np.ones((2, 4), np.float32),
+                  "flag": np.array([True])}, fetch_list=[loss])
+    after = np.asarray(fluid.global_scope().get(w.name))
+    assert not np.allclose(before, after)
+
+
+def test_switch_piecewise():
+    lr = layers.piecewise_decay(boundaries=[3, 6], values=[1.0, 0.5, 0.1])
+    exe = _exe()
+    got = [float(exe.run(feed={}, fetch_list=[lr])[0][0]) for _ in range(8)]
+    # steps 1..8 -> <3: 1.0 (steps 1,2), <6: 0.5 (3,4,5), else 0.1
+    np.testing.assert_allclose(
+        got, [1.0, 1.0, 0.5, 0.5, 0.5, 0.1, 0.1, 0.1], rtol=1e-6)
+
+
+def test_static_rnn_matches_numpy_scan():
+    T, B, D = 5, 3, 4
+    x = layers.data(name="x", shape=[B, D], dtype="float32")  # time-major
+    x.shape = (T, B, D)
+    h0 = layers.fill_constant(shape=[B, D], dtype="float32", value=0.0)
+    rnn = layers.StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(x)
+        h_prev = rnn.memory(init=h0)
+        h = layers.scale(layers.elementwise_add(x_t, h_prev), scale=0.5)
+        rnn.update_memory(h_prev, h)
+        rnn.step_output(h)
+    out = rnn()
+    exe = _exe()
+    xd = np.random.RandomState(0).rand(T, B, D).astype(np.float32)
+    got, = exe.run(feed={"x": xd}, fetch_list=[out])
+    h = np.zeros((B, D), np.float32)
+    want = []
+    for t in range(T):
+        h = 0.5 * (xd[t] + h)
+        want.append(h)
+    np.testing.assert_allclose(got, np.stack(want), rtol=1e-5)
+
+
+def test_static_rnn_gradient_to_params():
+    T, B, D = 4, 2, 3
+    x = layers.data(name="x", shape=[B, D], dtype="float32")
+    x.shape = (T, B, D)
+    h0 = layers.fill_constant(shape=[B, D], dtype="float32", value=0.0)
+    w = layers.create_parameter(shape=[D, D], dtype="float32")
+    rnn = layers.StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(x)
+        h_prev = rnn.memory(init=h0)
+        h = layers.tanh(layers.elementwise_add(layers.mul(x_t, w), h_prev))
+        rnn.update_memory(h_prev, h)
+        rnn.step_output(h)
+    out = rnn()
+    loss = layers.mean(out)
+    fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+    exe = _exe()
+    before = np.asarray(fluid.global_scope().get(w.name)).copy()
+    exe.run(feed={"x": np.ones((T, B, D), np.float32)}, fetch_list=[loss])
+    after = np.asarray(fluid.global_scope().get(w.name))
+    assert not np.allclose(before, after)
+
+
+def test_dynamic_rnn_respects_lengths():
+    B, T, D = 3, 6, 2
+    x = layers.data(name="x", shape=[T, D], dtype="float32")
+    lens = layers.data(name="lens", shape=[1], dtype="int64")
+    drnn = layers.DynamicRNN()
+    with drnn.block():
+        x_t = drnn.step_input(x, sequence_length=lens)
+        h_prev = drnn.memory(shape=[B, D], value=0.0)
+        h = layers.elementwise_add(x_t, h_prev)
+        drnn.update_memory(h_prev, h)
+        drnn.output(h)
+    out = drnn()
+    exe = _exe()
+    xd = np.ones((B, T, D), np.float32)
+    ld = np.array([[2], [4], [6]], np.int64)
+    got, = exe.run(feed={"x": xd, "lens": ld}, fetch_list=[out])
+    # outputs are zero-padded past each row's length; valid prefix = cumsum
+    for b, L in enumerate([2, 4, 6]):
+        want = np.arange(1, T + 1).astype(np.float32)
+        want[L:] = 0.0
+        np.testing.assert_allclose(got[b, :, 0], want)
+
+
+def test_ifelse_rowwise_merge():
+    x = layers.data(name="x", shape=[1], dtype="float32")
+    zero = layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+    cond = layers.greater_than(x, zero)
+    ie = layers.IfElse(cond)
+    with ie.true_block():
+        ie.output(layers.scale(x, scale=10.0))
+    with ie.false_block():
+        ie.output(layers.scale(x, scale=-1.0))
+    out = ie()
+    exe = _exe()
+    xd = np.array([[1.0], [-2.0], [3.0]], np.float32)
+    got, = exe.run(feed={"x": xd}, fetch_list=[out])
+    np.testing.assert_allclose(got, np.array([[10.0], [2.0], [30.0]]))
+
+
+@pytest.mark.parametrize("sched,args,check", [
+    ("exponential_decay", dict(learning_rate=1.0, decay_steps=2,
+                               decay_rate=0.5),
+     lambda v: v[1] < v[0]),
+    ("noam_decay", dict(d_model=64, warmup_steps=4),
+     lambda v: v[1] > v[0]),
+    ("cosine_decay", dict(learning_rate=1.0, step_each_epoch=1, epochs=10),
+     lambda v: v[2] < v[0]),
+    ("polynomial_decay", dict(learning_rate=1.0, decay_steps=5),
+     lambda v: v[2] < v[0]),
+])
+def test_lr_schedules(sched, args, check):
+    lr = getattr(layers, sched)(**args)
+    exe = _exe()
+    vals = [float(exe.run(feed={}, fetch_list=[lr])[0][0]) for _ in range(4)]
+    assert check(vals), (sched, vals)
+
+
+def test_optimizer_with_lr_variable():
+    lr = layers.exponential_decay(learning_rate=0.1, decay_steps=1,
+                                  decay_rate=0.9)
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    pred = layers.fc(input=x, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    exe = _exe()
+    for _ in range(3):
+        lv, = exe.run(feed={"x": np.random.rand(8, 4).astype(np.float32),
+                            "y": np.random.rand(8, 1).astype(np.float32)},
+                      fetch_list=[loss])
+    assert np.isfinite(lv).all()
