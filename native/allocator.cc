@@ -1,0 +1,136 @@
+// Buddy allocator over a host arena (parity: memory/detail/
+// buddy_allocator.h:34 over a SystemAllocator; stats parity with
+// pybind.cc:185 get_mem_usage). Serves pinned host staging buffers for
+// feed/fetch batches so the Python hot loop doesn't hit malloc per batch.
+#include "ptpu_native.h"
+
+#include <cstdlib>
+#include <map>
+#include <mutex>
+#include <unordered_map>
+
+namespace {
+
+struct Buddy {
+  char* base;
+  uint64_t total;
+  uint64_t min_chunk;
+  int max_order;
+  // free lists per order: set of offsets
+  std::map<int, std::map<uint64_t, bool>> free_lists;
+  std::unordered_map<uint64_t, int> allocated;  // offset -> order
+  std::mutex mu;
+  uint64_t in_use = 0, peak = 0, count = 0;
+
+  uint64_t block_size(int order) const { return min_chunk << order; }
+};
+
+int order_for(Buddy* b, uint64_t size) {
+  int order = 0;
+  uint64_t sz = b->min_chunk;
+  while (sz < size) {
+    sz <<= 1;
+    order++;
+  }
+  return order;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ptpu_allocator_create(uint64_t total_bytes, uint64_t min_chunk_bytes) {
+  Buddy* b = new Buddy();
+  b->min_chunk = min_chunk_bytes ? min_chunk_bytes : 256;
+  // round total down to a power-of-two multiple of min_chunk
+  int order = 0;
+  while (b->min_chunk << (order + 1) <= total_bytes) order++;
+  b->max_order = order;
+  b->total = b->min_chunk << order;
+  b->base = static_cast<char*>(malloc(b->total));
+  if (!b->base) {
+    delete b;
+    return nullptr;
+  }
+  b->free_lists[order][0] = true;
+  return b;
+}
+
+void* ptpu_alloc(void* ap, uint64_t size) {
+  Buddy* b = static_cast<Buddy*>(ap);
+  if (size == 0) size = 1;
+  std::lock_guard<std::mutex> lk(b->mu);
+  int want = order_for(b, size);
+  if (want > b->max_order) return nullptr;
+  // find smallest free block >= want
+  int from = -1;
+  for (int o = want; o <= b->max_order; o++) {
+    auto it = b->free_lists.find(o);
+    if (it != b->free_lists.end() && !it->second.empty()) {
+      from = o;
+      break;
+    }
+  }
+  if (from < 0) return nullptr;
+  uint64_t off = b->free_lists[from].begin()->first;
+  b->free_lists[from].erase(off);
+  // split down to the wanted order, freeing the upper halves
+  for (int o = from; o > want; o--) {
+    uint64_t buddy_off = off + b->block_size(o - 1);
+    b->free_lists[o - 1][buddy_off] = true;
+  }
+  b->allocated[off] = want;
+  b->in_use += b->block_size(want);
+  if (b->in_use > b->peak) b->peak = b->in_use;
+  b->count++;
+  return b->base + off;
+}
+
+void ptpu_free(void* ap, void* p) {
+  Buddy* b = static_cast<Buddy*>(ap);
+  if (!p) return;
+  std::lock_guard<std::mutex> lk(b->mu);
+  uint64_t off = static_cast<char*>(p) - b->base;
+  auto it = b->allocated.find(off);
+  if (it == b->allocated.end()) return;
+  int order = it->second;
+  b->allocated.erase(it);
+  b->in_use -= b->block_size(order);
+  // coalesce with buddy while possible
+  while (order < b->max_order) {
+    uint64_t buddy_off = off ^ b->block_size(order);
+    auto& fl = b->free_lists[order];
+    auto bit = fl.find(buddy_off);
+    if (bit == fl.end()) break;
+    fl.erase(bit);
+    off = off < buddy_off ? off : buddy_off;
+    order++;
+  }
+  b->free_lists[order][off] = true;
+}
+
+uint64_t ptpu_allocator_in_use(void* ap) {
+  Buddy* b = static_cast<Buddy*>(ap);
+  std::lock_guard<std::mutex> lk(b->mu);
+  return b->in_use;
+}
+
+uint64_t ptpu_allocator_peak(void* ap) {
+  Buddy* b = static_cast<Buddy*>(ap);
+  std::lock_guard<std::mutex> lk(b->mu);
+  return b->peak;
+}
+
+uint64_t ptpu_allocator_alloc_count(void* ap) {
+  Buddy* b = static_cast<Buddy*>(ap);
+  std::lock_guard<std::mutex> lk(b->mu);
+  return b->count;
+}
+
+void ptpu_allocator_destroy(void* ap) {
+  Buddy* b = static_cast<Buddy*>(ap);
+  free(b->base);
+  delete b;
+}
+
+}  // extern "C"

@@ -1,0 +1,126 @@
+// C++ unit tests for the native spine (parity: the reference's in-tree
+// gtests — scope_test.cc, memory/allocation/*_test.cc, recordio tests —
+// SURVEY §4.2; assert-based, no gtest dependency in this image).
+#include "ptpu_native.h"
+
+#include <cassert>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+static void test_recordio() {
+  const char* path = "/tmp/ptpu_test.rec";
+  void* w = ptpu_recordio_writer_open(path, 3, 1 << 20);
+  assert(w);
+  for (int i = 0; i < 10; i++) {
+    std::string rec = "record-" + std::to_string(i);
+    assert(ptpu_recordio_writer_write(w, rec.data(), rec.size()) == 0);
+  }
+  assert(ptpu_recordio_writer_close(w) == 0);
+
+  void* s = ptpu_recordio_scanner_open(path);
+  assert(s);
+  for (int i = 0; i < 10; i++) {
+    const char* out;
+    int64_t n = ptpu_recordio_scanner_next(s, &out);
+    std::string want = "record-" + std::to_string(i);
+    assert(n == (int64_t)want.size());
+    assert(memcmp(out, want.data(), n) == 0);
+  }
+  const char* out;
+  assert(ptpu_recordio_scanner_next(s, &out) == -1);  // EOF
+  ptpu_recordio_scanner_close(s);
+  remove(path);
+  printf("recordio ok\n");
+}
+
+static void test_queue() {
+  void* q = ptpu_queue_create(4);
+  std::thread producer([q] {
+    for (int i = 0; i < 100; i++) {
+      std::string msg = "m" + std::to_string(i);
+      ptpu_queue_push(q, msg.data(), msg.size(), -1);
+    }
+    ptpu_queue_close(q);
+  });
+  int got = 0;
+  while (true) {
+    char* buf;
+    int64_t n = ptpu_queue_pop(q, &buf, -1);
+    if (n == -2) break;
+    assert(n > 0);
+    ptpu_buf_free(buf);
+    got++;
+  }
+  producer.join();
+  assert(got == 100);
+  ptpu_queue_destroy(q);
+  printf("queue ok\n");
+}
+
+static void test_allocator() {
+  void* a = ptpu_allocator_create(1 << 20, 256);
+  assert(a);
+  std::vector<void*> ptrs;
+  for (int i = 0; i < 100; i++) {
+    void* p = ptpu_alloc(a, 1000);
+    assert(p);
+    memset(p, i, 1000);
+    ptrs.push_back(p);
+  }
+  assert(ptpu_allocator_in_use(a) == 100 * 1024);  // rounded to 1K blocks
+  for (void* p : ptrs) ptpu_free(a, p);
+  assert(ptpu_allocator_in_use(a) == 0);
+  assert(ptpu_allocator_peak(a) == 100 * 1024);
+  // after full coalescing a max-size alloc must succeed
+  void* big = ptpu_alloc(a, 1 << 20);
+  assert(big);
+  ptpu_free(a, big);
+  ptpu_allocator_destroy(a);
+  printf("allocator ok\n");
+}
+
+static void test_program_seal() {
+  std::string payload = "{\"blocks\": []}";
+  char* sealed;
+  int64_t n = ptpu_program_seal(payload.data(), payload.size(), &sealed);
+  assert(n > (int64_t)payload.size());
+  char* out;
+  int64_t m = ptpu_program_unseal(sealed, n, &out);
+  assert(m == (int64_t)payload.size());
+  assert(memcmp(out, payload.data(), m) == 0);
+  // corrupt a payload byte -> CRC failure
+  sealed[n - 1] ^= 0xFF;
+  char* out2;
+  assert(ptpu_program_unseal(sealed, n, &out2) == -3);
+  ptpu_buf_free(sealed);
+  ptpu_buf_free(out);
+  printf("program seal ok\n");
+}
+
+static void test_profiler() {
+  ptpu_prof_reset();
+  ptpu_prof_enable(1);
+  ptpu_prof_push("step");
+  ptpu_prof_push("matmul");
+  ptpu_prof_pop();
+  ptpu_prof_pop();
+  ptpu_prof_mark("device_span", 100, 200);
+  int64_t n = ptpu_prof_dump_chrome("/tmp/ptpu_trace.json");
+  assert(n == 3);
+  remove("/tmp/ptpu_trace.json");
+  ptpu_prof_enable(0);
+  printf("profiler ok\n");
+}
+
+int main() {
+  test_recordio();
+  test_queue();
+  test_allocator();
+  test_program_seal();
+  test_profiler();
+  printf("ALL NATIVE TESTS PASSED\n");
+  return 0;
+}

@@ -1,0 +1,107 @@
+// Host event profiler + chrome-trace exporter (parity: platform/
+// profiler.cc RecordEvent tables + tools/timeline.py _ChromeTraceFormatter —
+// same "collect spans, dump chrome://tracing JSON" shape; device-side spans
+// come from jax.profiler and are merged by the Python layer).
+#include "ptpu_native.h"
+
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Event {
+  std::string name;
+  int64_t us_start;
+  int64_t us_end;
+  uint64_t tid;
+};
+
+std::atomic<int> g_enabled{0};
+std::mutex g_mu;
+std::vector<Event> g_events;
+thread_local std::vector<std::pair<std::string, int64_t>> t_stack;
+
+int64_t now_us() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+uint64_t tid_hash() {
+  return std::hash<std::thread::id>()(std::this_thread::get_id()) % 100000;
+}
+
+void json_escape(const std::string& in, std::string* out) {
+  for (char c : in) {
+    if (c == '"' || c == '\\') {
+      out->push_back('\\');
+      out->push_back(c);
+    } else if (static_cast<unsigned char>(c) >= 0x20) {
+      out->push_back(c);
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void ptpu_prof_enable(int on) { g_enabled.store(on ? 1 : 0); }
+int ptpu_prof_enabled(void) { return g_enabled.load(); }
+
+void ptpu_prof_push(const char* name) {
+  if (!g_enabled.load()) return;
+  t_stack.emplace_back(name, now_us());
+}
+
+void ptpu_prof_pop(void) {
+  if (t_stack.empty()) return;
+  auto [name, start] = t_stack.back();
+  t_stack.pop_back();
+  if (!g_enabled.load()) return;
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_events.push_back({std::move(name), start, now_us(), tid_hash()});
+}
+
+void ptpu_prof_mark(const char* name, int64_t us_start, int64_t us_end) {
+  if (!g_enabled.load()) return;
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_events.push_back({name, us_start, us_end, tid_hash()});
+}
+
+int64_t ptpu_prof_dump_chrome(const char* path) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  FILE* f = fopen(path, "wb");
+  if (!f) return -1;
+  fputs("{\"traceEvents\":[", f);
+  for (size_t i = 0; i < g_events.size(); i++) {
+    const Event& e = g_events[i];
+    std::string name;
+    json_escape(e.name, &name);
+    fprintf(f,
+            "%s{\"name\":\"%s\",\"ph\":\"X\",\"pid\":0,\"tid\":%llu,"
+            "\"ts\":%lld,\"dur\":%lld,\"cat\":\"op\"}",
+            i ? "," : "", name.c_str(),
+            static_cast<unsigned long long>(e.tid),
+            static_cast<long long>(e.us_start),
+            static_cast<long long>(e.us_end - e.us_start));
+  }
+  fputs("]}", f);
+  fclose(f);
+  return static_cast<int64_t>(g_events.size());
+}
+
+void ptpu_prof_reset(void) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_events.clear();
+}
+
+const char* ptpu_version(void) { return "paddle-tpu-native 0.1.0"; }
+
+}  // extern "C"

@@ -1,0 +1,52 @@
+// Program serialization framing (parity: framework/program_desc
+// serialization + framework/version.h compat gate — IsProgramVersionSupported
+// checked at pybind.cc:1087; save_op.cc writes version + payload).
+//
+// Frame: magic 'PTPG' u32 | format_version u32 | payload_len u64 |
+//        payload_crc32 u32 | payload bytes.
+#include "ptpu_native.h"
+
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+constexpr uint32_t kMagic = 0x50545047;  // "PTPG"
+constexpr uint32_t kVersion = 1;
+constexpr uint32_t kMinSupported = 1;
+}  // namespace
+
+extern "C" {
+
+int64_t ptpu_program_seal(const char* payload, uint64_t len, char** out) {
+  uint64_t total = 4 + 4 + 8 + 4 + len;
+  char* buf = static_cast<char*>(malloc(total));
+  if (!buf) return -1;
+  uint32_t crc = ptpu_crc32(payload, len);
+  memcpy(buf, &kMagic, 4);
+  memcpy(buf + 4, &kVersion, 4);
+  memcpy(buf + 8, &len, 8);
+  memcpy(buf + 16, &crc, 4);
+  memcpy(buf + 20, payload, len);
+  *out = buf;
+  return static_cast<int64_t>(total);
+}
+
+int64_t ptpu_program_unseal(const char* buf, uint64_t len, char** out) {
+  if (len < 20) return -1;
+  uint32_t magic, version, crc;
+  uint64_t plen;
+  memcpy(&magic, buf, 4);
+  if (magic != kMagic) return -1;
+  memcpy(&version, buf + 4, 4);
+  if (version < kMinSupported || version > kVersion) return -2;
+  memcpy(&plen, buf + 8, 8);
+  memcpy(&crc, buf + 16, 4);
+  if (20 + plen > len) return -3;
+  if (ptpu_crc32(buf + 20, plen) != crc) return -3;
+  char* payload = static_cast<char*>(malloc(plen ? plen : 1));
+  memcpy(payload, buf + 20, plen);
+  *out = payload;
+  return static_cast<int64_t>(plen);
+}
+
+}  // extern "C"

@@ -1,0 +1,85 @@
+// paddle_tpu native runtime spine — C API surface.
+//
+// TPU-native counterpart of the reference's C++ runtime (SURVEY §2.4): under
+// XLA the op hot loop is the jitted step, so the native layer owns what
+// remains host-side: record IO (recordio/ C18), the input-pipeline blocking
+// queue (operators/reader/ C17 LoDTensorBlockingQueue), a buddy allocator
+// with stats for host staging buffers (memory/detail/buddy_allocator.h C19),
+// the profiler event collector + chrome-trace export (platform/profiler.cc
+// §5.1), and versioned program serialization (framework/program_desc +
+// framework/version.h C1).
+//
+// All functions are extern "C" for ctypes binding (pybind11 not available in
+// this image).
+#pragma once
+#include <cstdint>
+
+#if defined(_WIN32)
+#define PTPU_API __declspec(dllexport)
+#else
+#define PTPU_API __attribute__((visibility("default")))
+#endif
+
+extern "C" {
+
+// ---- recordio (chunked, CRC32-checked record file; recordio/ parity) ----
+PTPU_API void* ptpu_recordio_writer_open(const char* path,
+                                         uint64_t max_chunk_records,
+                                         uint64_t max_chunk_bytes);
+PTPU_API int ptpu_recordio_writer_write(void* w, const char* data,
+                                        uint64_t len);
+PTPU_API int ptpu_recordio_writer_close(void* w);
+PTPU_API void* ptpu_recordio_scanner_open(const char* path);
+// returns record length and sets *out (valid until next call), -1 at EOF,
+// -2 on corruption
+PTPU_API int64_t ptpu_recordio_scanner_next(void* s, const char** out);
+PTPU_API void ptpu_recordio_scanner_close(void* s);
+
+// ---- blocking queue of byte blobs (LoDTensorBlockingQueue parity) ----
+PTPU_API void* ptpu_queue_create(uint64_t capacity);
+// 1 ok, 0 closed, -1 timeout
+PTPU_API int ptpu_queue_push(void* q, const char* data, uint64_t len,
+                             int timeout_ms);
+// record length and sets *out (caller frees with ptpu_buf_free);
+// -1 timeout, -2 closed+empty
+PTPU_API int64_t ptpu_queue_pop(void* q, char** out, int timeout_ms);
+PTPU_API uint64_t ptpu_queue_size(void* q);
+PTPU_API void ptpu_queue_close(void* q);
+PTPU_API void ptpu_queue_destroy(void* q);
+
+// ---- buddy allocator over a host arena (buddy_allocator.h parity) ----
+PTPU_API void* ptpu_allocator_create(uint64_t total_bytes,
+                                     uint64_t min_chunk_bytes);
+PTPU_API void* ptpu_alloc(void* a, uint64_t size);
+PTPU_API void ptpu_free(void* a, void* p);
+PTPU_API uint64_t ptpu_allocator_in_use(void* a);
+PTPU_API uint64_t ptpu_allocator_peak(void* a);
+PTPU_API uint64_t ptpu_allocator_alloc_count(void* a);
+PTPU_API void ptpu_allocator_destroy(void* a);
+
+// ---- profiler (platform/profiler.cc + tools/timeline.py parity) ----
+PTPU_API void ptpu_prof_enable(int on);
+PTPU_API int ptpu_prof_enabled(void);
+PTPU_API void ptpu_prof_push(const char* name);   // RecordEvent begin
+PTPU_API void ptpu_prof_pop(void);                // RecordEvent end
+PTPU_API void ptpu_prof_mark(const char* name, int64_t us_start,
+                             int64_t us_end);     // externally-timed span
+// writes chrome://tracing JSON; returns number of events written
+PTPU_API int64_t ptpu_prof_dump_chrome(const char* path);
+PTPU_API void ptpu_prof_reset(void);
+
+// ---- program serialization (framework/version.h compat checks) ----
+// payload (any bytes, e.g. the program JSON) -> framed binary with magic,
+// format version and CRC32. Caller frees *out with ptpu_buf_free.
+PTPU_API int64_t ptpu_program_seal(const char* payload, uint64_t len,
+                                   char** out);
+// verifies magic/version/CRC; returns payload length, -1 bad magic,
+// -2 unsupported version, -3 CRC mismatch
+PTPU_API int64_t ptpu_program_unseal(const char* buf, uint64_t len,
+                                     char** out);
+
+PTPU_API void ptpu_buf_free(char* buf);
+PTPU_API uint32_t ptpu_crc32(const char* data, uint64_t len);
+PTPU_API const char* ptpu_version(void);
+
+}  // extern "C"

@@ -1,0 +1,89 @@
+// Bounded blocking queue of byte blobs (parity: operators/reader/
+// lod_tensor_blocking_queue.h + buffered_reader.cc — the C++ side of the
+// py_reader / double-buffer input pipeline). Feeds serialized tensor batches
+// from producer threads to the training loop with backpressure.
+#include "ptpu_native.h"
+
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+
+namespace {
+
+struct Queue {
+  std::deque<std::string> items;
+  std::mutex mu;
+  std::condition_variable not_full, not_empty;
+  uint64_t capacity;
+  bool closed = false;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ptpu_queue_create(uint64_t capacity) {
+  Queue* q = new Queue();
+  q->capacity = capacity ? capacity : 2;
+  return q;
+}
+
+int ptpu_queue_push(void* qp, const char* data, uint64_t len, int timeout_ms) {
+  Queue* q = static_cast<Queue*>(qp);
+  std::unique_lock<std::mutex> lk(q->mu);
+  auto ready = [q] { return q->closed || q->items.size() < q->capacity; };
+  if (timeout_ms < 0) {
+    q->not_full.wait(lk, ready);
+  } else if (!q->not_full.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                   ready)) {
+    return -1;
+  }
+  if (q->closed) return 0;
+  q->items.emplace_back(data, len);
+  q->not_empty.notify_one();
+  return 1;
+}
+
+int64_t ptpu_queue_pop(void* qp, char** out, int timeout_ms) {
+  Queue* q = static_cast<Queue*>(qp);
+  std::unique_lock<std::mutex> lk(q->mu);
+  auto ready = [q] { return q->closed || !q->items.empty(); };
+  if (timeout_ms < 0) {
+    q->not_empty.wait(lk, ready);
+  } else if (!q->not_empty.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                    ready)) {
+    return -1;
+  }
+  if (q->items.empty()) return -2;  // closed and drained
+  std::string& front = q->items.front();
+  char* buf = static_cast<char*>(malloc(front.size()));
+  memcpy(buf, front.data(), front.size());
+  int64_t n = static_cast<int64_t>(front.size());
+  q->items.pop_front();
+  q->not_full.notify_one();
+  *out = buf;
+  return n;
+}
+
+uint64_t ptpu_queue_size(void* qp) {
+  Queue* q = static_cast<Queue*>(qp);
+  std::lock_guard<std::mutex> lk(q->mu);
+  return q->items.size();
+}
+
+void ptpu_queue_close(void* qp) {
+  Queue* q = static_cast<Queue*>(qp);
+  std::lock_guard<std::mutex> lk(q->mu);
+  q->closed = true;
+  q->not_empty.notify_all();
+  q->not_full.notify_all();
+}
+
+void ptpu_queue_destroy(void* qp) { delete static_cast<Queue*>(qp); }
+
+void ptpu_buf_free(char* buf) { free(buf); }
+
+}  // extern "C"

@@ -1,0 +1,257 @@
+"""ctypes binding to the C++ runtime spine (native/ — SURVEY §2.4).
+
+Loads libpaddle_tpu_native.so, building it with `make` on first use if the
+checkout has a toolchain. Every consumer degrades gracefully to a pure-
+Python fallback when the library is unavailable (`native.lib() is None`),
+so the framework works on toolchain-less hosts; with the library, record
+IO / reader queues / profiling / program framing run in C++.
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_LIB_NAME = "libpaddle_tpu_native.so"
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _configure(lib):
+    lib.ptpu_recordio_writer_open.restype = ctypes.c_void_p
+    lib.ptpu_recordio_writer_open.argtypes = [ctypes.c_char_p,
+                                              ctypes.c_uint64,
+                                              ctypes.c_uint64]
+    lib.ptpu_recordio_writer_write.restype = ctypes.c_int
+    lib.ptpu_recordio_writer_write.argtypes = [ctypes.c_void_p,
+                                               ctypes.c_char_p,
+                                               ctypes.c_uint64]
+    lib.ptpu_recordio_writer_close.restype = ctypes.c_int
+    lib.ptpu_recordio_writer_close.argtypes = [ctypes.c_void_p]
+    lib.ptpu_recordio_scanner_open.restype = ctypes.c_void_p
+    lib.ptpu_recordio_scanner_open.argtypes = [ctypes.c_char_p]
+    lib.ptpu_recordio_scanner_next.restype = ctypes.c_int64
+    lib.ptpu_recordio_scanner_next.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_char))]
+    lib.ptpu_recordio_scanner_close.argtypes = [ctypes.c_void_p]
+
+    lib.ptpu_queue_create.restype = ctypes.c_void_p
+    lib.ptpu_queue_create.argtypes = [ctypes.c_uint64]
+    lib.ptpu_queue_push.restype = ctypes.c_int
+    lib.ptpu_queue_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_uint64, ctypes.c_int]
+    lib.ptpu_queue_pop.restype = ctypes.c_int64
+    lib.ptpu_queue_pop.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
+        ctypes.c_int]
+    lib.ptpu_queue_size.restype = ctypes.c_uint64
+    lib.ptpu_queue_size.argtypes = [ctypes.c_void_p]
+    lib.ptpu_queue_close.argtypes = [ctypes.c_void_p]
+    lib.ptpu_queue_destroy.argtypes = [ctypes.c_void_p]
+
+    lib.ptpu_allocator_create.restype = ctypes.c_void_p
+    lib.ptpu_allocator_create.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
+    lib.ptpu_alloc.restype = ctypes.c_void_p
+    lib.ptpu_alloc.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.ptpu_free.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    for fn in ("ptpu_allocator_in_use", "ptpu_allocator_peak",
+               "ptpu_allocator_alloc_count"):
+        getattr(lib, fn).restype = ctypes.c_uint64
+        getattr(lib, fn).argtypes = [ctypes.c_void_p]
+    lib.ptpu_allocator_destroy.argtypes = [ctypes.c_void_p]
+
+    lib.ptpu_prof_enable.argtypes = [ctypes.c_int]
+    lib.ptpu_prof_enabled.restype = ctypes.c_int
+    lib.ptpu_prof_push.argtypes = [ctypes.c_char_p]
+    lib.ptpu_prof_mark.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                   ctypes.c_int64]
+    lib.ptpu_prof_dump_chrome.restype = ctypes.c_int64
+    lib.ptpu_prof_dump_chrome.argtypes = [ctypes.c_char_p]
+
+    lib.ptpu_program_seal.restype = ctypes.c_int64
+    lib.ptpu_program_seal.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_char))]
+    lib.ptpu_program_unseal.restype = ctypes.c_int64
+    lib.ptpu_program_unseal.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_char))]
+    lib.ptpu_buf_free.argtypes = [ctypes.POINTER(ctypes.c_char)]
+    lib.ptpu_crc32.restype = ctypes.c_uint32
+    lib.ptpu_crc32.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.ptpu_version.restype = ctypes.c_char_p
+    return lib
+
+
+def lib():
+    """The loaded native library, or None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        path = os.path.abspath(os.path.join(_NATIVE_DIR, _LIB_NAME))
+        if not os.path.exists(path):
+            try:
+                subprocess.run(["make", "-s"], cwd=os.path.dirname(path),
+                               check=True, capture_output=True, timeout=120)
+            except Exception:
+                return None
+        try:
+            _lib = _configure(ctypes.CDLL(path))
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def _take_buf(l, ptr, n):
+    data = ctypes.string_at(ptr, n)
+    l.ptpu_buf_free(ptr)
+    return data
+
+
+def program_seal(payload: bytes) -> bytes:
+    """Frame program bytes with magic/version/CRC (framework/version.h
+    parity). Pure-python fallback mirrors the same layout."""
+    l = lib()
+    if l is not None:
+        out = ctypes.POINTER(ctypes.c_char)()
+        n = l.ptpu_program_seal(payload, len(payload), ctypes.byref(out))
+        if n > 0:
+            return _take_buf(l, out, n)
+    import struct, zlib
+
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    return (struct.pack("<IIQI", 0x50545047, 1, len(payload), crc) + payload)
+
+
+def program_unseal(buf: bytes) -> bytes:
+    l = lib()
+    if l is not None:
+        out = ctypes.POINTER(ctypes.c_char)()
+        n = l.ptpu_program_unseal(buf, len(buf), ctypes.byref(out))
+        if n >= 0:
+            return _take_buf(l, out, n)
+        raise ValueError("bad program file (code %d: magic/version/crc)" % n)
+    import struct, zlib
+
+    if len(buf) < 20:
+        raise ValueError("bad program file: truncated")
+    magic, version, plen, crc = struct.unpack("<IIQI", buf[:20])
+    if magic != 0x50545047:
+        raise ValueError("bad program file: magic")
+    if version != 1:
+        raise ValueError("unsupported program version %d" % version)
+    payload = buf[20:20 + plen]
+    if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+        raise ValueError("bad program file: CRC mismatch")
+    return payload
+
+
+class NativeQueue:
+    """Bounded blocking queue of byte blobs backed by C++
+    (LoDTensorBlockingQueue parity); falls back to queue.Queue."""
+
+    def __init__(self, capacity):
+        self._l = lib()
+        if self._l is not None:
+            self._q = self._l.ptpu_queue_create(capacity)
+            self._py = None
+        else:
+            import queue as _queue
+
+            self._py = _queue.Queue(maxsize=capacity)
+            self._closed = False
+
+    def push(self, data: bytes, timeout_ms=-1):
+        if self._py is None:
+            return self._l.ptpu_queue_push(self._q, data, len(data),
+                                           timeout_ms) == 1
+        self._py.put(data)
+        return True
+
+    def pop(self, timeout_ms=-1):
+        """bytes, or None when closed and drained."""
+        if self._py is None:
+            out = ctypes.POINTER(ctypes.c_char)()
+            n = self._l.ptpu_queue_pop(self._q, ctypes.byref(out), timeout_ms)
+            if n == -2:
+                return None
+            if n < 0:
+                raise TimeoutError("queue pop timed out")
+            return _take_buf(self._l, out, n)
+        item = self._py.get()
+        return item  # None sentinel used for close
+
+    def size(self):
+        if self._py is None:
+            return self._l.ptpu_queue_size(self._q)
+        return self._py.qsize()
+
+    def close(self):
+        if self._py is None:
+            self._l.ptpu_queue_close(self._q)
+        else:
+            self._py.put(None)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_py", True) is None and lib() is not None:
+                self._l.ptpu_queue_destroy(self._q)
+        except Exception:
+            pass
+
+
+class RecordIOWriter:
+    """Chunked CRC'd record file writer (recordio/ parity)."""
+
+    def __init__(self, path, max_chunk_records=1000,
+                 max_chunk_bytes=1 << 20):
+        self._l = lib()
+        if self._l is None:
+            raise RuntimeError("native library unavailable for RecordIO")
+        self._w = self._l.ptpu_recordio_writer_open(
+            path.encode(), max_chunk_records, max_chunk_bytes)
+        if not self._w:
+            raise IOError("cannot open %s" % path)
+
+    def write(self, record: bytes):
+        if self._l.ptpu_recordio_writer_write(self._w, record,
+                                              len(record)) != 0:
+            raise IOError("recordio write failed")
+
+    def close(self):
+        if self._w:
+            self._l.ptpu_recordio_writer_close(self._w)
+            self._w = None
+
+
+class RecordIOScanner:
+    def __init__(self, path):
+        self._l = lib()
+        if self._l is None:
+            raise RuntimeError("native library unavailable for RecordIO")
+        self._s = self._l.ptpu_recordio_scanner_open(path.encode())
+        if not self._s:
+            raise IOError("cannot open %s" % path)
+
+    def __iter__(self):
+        out = ctypes.POINTER(ctypes.c_char)()
+        while True:
+            n = self._l.ptpu_recordio_scanner_next(self._s,
+                                                   ctypes.byref(out))
+            if n == -1:
+                return
+            if n == -2:
+                raise IOError("corrupt recordio chunk (CRC)")
+            yield ctypes.string_at(out, n)
+
+    def close(self):
+        if self._s:
+            self._l.ptpu_recordio_scanner_close(self._s)
+            self._s = None

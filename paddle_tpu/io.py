@@ -142,6 +142,8 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                          main_program=None, model_filename=None,
                          params_filename=None, export_for_deployment=True,
                          program_only=False):
+    from .core import native
+
     main_program = main_program or framework.default_main_program()
     pruned = _prune_program(main_program, feeded_var_names, target_vars)
     os.makedirs(dirname, exist_ok=True)
@@ -151,8 +153,10 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
         "feed_names": list(feeded_var_names),
         "fetch_names": [v.name for v in target_vars],
     }
-    with open(model_path, "w") as f:
-        json.dump(meta, f)
+    # sealed binary frame: magic + format version + CRC (framework/version.h
+    # IsProgramVersionSupported parity), written by the native layer
+    with open(model_path, "wb") as f:
+        f.write(native.program_seal(json.dumps(meta).encode("utf-8")))
     if program_only:
         return [v.name for v in target_vars]
     params = [v for v in pruned.list_vars() if _is_persistable(v)]
@@ -169,11 +173,15 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
 
 def load_inference_model(dirname, executor, model_filename=None,
                          params_filename=None, pserver_endpoints=None):
-    from .core import serde
+    from .core import native, serde
 
     model_path = os.path.join(dirname, model_filename or "__model__")
-    with open(model_path) as f:
-        meta = json.load(f)
+    with open(model_path, "rb") as f:
+        raw = f.read()
+    try:
+        meta = json.loads(native.program_unseal(raw).decode("utf-8"))
+    except ValueError:
+        meta = json.loads(raw.decode("utf-8"))  # pre-seal format
     program = serde.program_from_desc(meta["program"])
     params_path = os.path.join(dirname, params_filename or "__params__")
     if not params_path.endswith(".npz"):

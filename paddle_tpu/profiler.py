@@ -14,7 +14,7 @@ import time
 from collections import defaultdict
 
 __all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler",
-           "stop_profiler", "record_event"]
+           "stop_profiler", "record_event", "dump_chrome_trace"]
 
 _events = defaultdict(lambda: [0, 0.0, 0.0, float("inf")])  # calls,total,max,min
 _active = [False]
@@ -37,6 +37,11 @@ def start_profiler(state="All", tracer_option=None, trace_dir=None):
     if _active[0]:
         return
     _active[0] = True
+    from .core import native
+
+    l = native.lib()
+    if l is not None:
+        l.ptpu_prof_enable(1)
     if trace_dir:
         import jax
 
@@ -48,6 +53,11 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
     if not _active[0]:
         return
     _active[0] = False
+    from .core import native
+
+    l = native.lib()
+    if l is not None:
+        l.ptpu_prof_enable(0)
     if _trace_dir[0]:
         import jax
 
@@ -74,17 +84,41 @@ def _print_summary(sorted_key=None):
 
 @contextlib.contextmanager
 def record_event(name):
-    """Host-side RAII event marker (parity: platform/profiler.h RecordEvent)."""
+    """Host-side RAII event marker (parity: platform/profiler.h RecordEvent).
+    When the native library is present, spans also land in the C++ collector
+    (platform/profiler.cc parity) for chrome-trace export."""
+    from .core import native
+
+    l = native.lib()
     t0 = time.perf_counter()
+    if l is not None and _active[0]:
+        l.ptpu_prof_push(name.encode())
     try:
         yield
     finally:
+        if l is not None and _active[0]:
+            l.ptpu_prof_pop()
         dt = time.perf_counter() - t0
         ev = _events[name]
         ev[0] += 1
         ev[1] += dt
         ev[2] = max(ev[2], dt)
         ev[3] = min(ev[3], dt)
+
+
+def dump_chrome_trace(path):
+    """Export collected host events as chrome://tracing JSON (parity:
+    tools/timeline.py). Returns the number of events written."""
+    from .core import native
+
+    l = native.lib()
+    if l is None:
+        import json as _json
+
+        with open(path, "w") as f:
+            _json.dump({"traceEvents": []}, f)
+        return 0
+    return l.ptpu_prof_dump_chrome(path.encode())
 
 
 @contextlib.contextmanager

@@ -1,0 +1,109 @@
+"""Tests for the C++ runtime spine bindings (native/ — recordio, blocking
+queue, buddy allocator, profiler, program framing; SURVEY §2.4)."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core import native
+
+
+def _need_lib():
+    if native.lib() is None:
+        pytest.skip("native library unavailable (no toolchain)")
+
+
+def test_program_seal_roundtrip_and_crc():
+    payload = json.dumps({"blocks": [1, 2, 3]}).encode()
+    sealed = native.program_seal(payload)
+    assert native.program_unseal(sealed) == payload
+    corrupted = sealed[:-1] + bytes([sealed[-1] ^ 0xFF])
+    with pytest.raises(ValueError):
+        native.program_unseal(corrupted)
+
+
+def test_recordio_roundtrip(tmp_path):
+    _need_lib()
+    path = str(tmp_path / "data.rec")
+    w = native.RecordIOWriter(path, max_chunk_records=4)
+    recs = [("rec-%d" % i).encode() * (i + 1) for i in range(17)]
+    for r in recs:
+        w.write(r)
+    w.close()
+    s = native.RecordIOScanner(path)
+    assert list(s) == recs
+    s.close()
+
+
+def test_native_queue_producer_consumer():
+    _need_lib()
+    q = native.NativeQueue(capacity=3)
+    items = [("item-%d" % i).encode() for i in range(50)]
+
+    def produce():
+        for it in items:
+            q.push(it)
+        q.close()
+
+    t = threading.Thread(target=produce)
+    t.start()
+    got = []
+    while True:
+        b = q.pop()
+        if b is None:
+            break
+        got.append(b)
+    t.join()
+    assert got == items
+
+
+def test_allocator_stats():
+    _need_lib()
+    l = native.lib()
+    a = l.ptpu_allocator_create(1 << 20, 256)
+    p1 = l.ptpu_alloc(a, 1000)
+    p2 = l.ptpu_alloc(a, 5000)
+    assert p1 and p2
+    assert l.ptpu_allocator_in_use(a) == 1024 + 8192
+    l.ptpu_free(a, p1)
+    l.ptpu_free(a, p2)
+    assert l.ptpu_allocator_in_use(a) == 0
+    assert l.ptpu_allocator_peak(a) == 1024 + 8192
+    l.ptpu_allocator_destroy(a)
+
+
+def test_profiler_chrome_trace(tmp_path):
+    _need_lib()
+    from paddle_tpu import profiler
+
+    profiler.reset_profiler()
+    profiler.start_profiler()
+    with profiler.record_event("host_step"):
+        np.dot(np.ones((64, 64)), np.ones((64, 64)))
+    profiler.stop_profiler(profile_path=str(tmp_path / "p.txt"))
+    out = str(tmp_path / "trace.json")
+    n = profiler.dump_chrome_trace(out)
+    assert n >= 1
+    with open(out) as f:
+        trace = json.load(f)
+    assert any(e["name"] == "host_step" for e in trace["traceEvents"])
+
+
+def test_inference_model_sealed_format(tmp_path):
+    """save_inference_model writes the sealed binary frame; load verifies."""
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.fc(input=x, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    d = str(tmp_path / "model")
+    fluid.io.save_inference_model(d, ["x"], [y], exe)
+    raw = open(os.path.join(d, "__model__"), "rb").read()
+    assert raw[:4] == b"GPTP"  # magic 0x50545047 little-endian
+    prog, feeds, fetches = fluid.io.load_inference_model(d, exe)
+    out, = exe.run(prog, feed={"x": np.ones((3, 4), np.float32)},
+                   fetch_list=fetches)
+    assert out.shape == (3, 2)
