@@ -52,6 +52,10 @@ from . import metrics
 from . import nets
 from . import profiler
 from . import reader
+from . import dataset
+from . import recordio_writer
+from .recordio_writer import convert_reader_to_recordio_file  # noqa: F401
+from .dataset_api import DatasetFactory, InMemoryDataset, QueueDataset  # noqa
 from . import dygraph
 from .dygraph.base import enable_dygraph, disable_dygraph  # noqa: F401
 from . import parallel

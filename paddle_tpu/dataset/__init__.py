@@ -1,0 +1,15 @@
+"""Datasets (parity: python/paddle/dataset/ — mnist, cifar, uci_housing,
+imdb, wmt16, movielens…).
+
+The reference downloads real corpora at import time; this environment has
+zero egress, so each dataset is a *deterministic synthetic generator* with
+the exact sample shapes/dtypes/vocab structure of the original (seeded, so
+train/test splits are reproducible). The reader-creator API is identical:
+`dataset.mnist.train()` returns a reader function yielding samples.
+"""
+
+from . import mnist  # noqa: F401
+from . import cifar  # noqa: F401
+from . import uci_housing  # noqa: F401
+from . import imdb  # noqa: F401
+from . import wmt16  # noqa: F401

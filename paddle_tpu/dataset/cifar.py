@@ -1,0 +1,36 @@
+"""CIFAR reader creators (parity: python/paddle/dataset/cifar.py —
+train10/test10/train100/test100; samples are (3072 float32, int label))."""
+
+import numpy as np
+
+TRAIN_SIZE = 4096
+TEST_SIZE = 512
+
+
+def _reader(n, num_classes, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        protos = rng.normal(size=(num_classes, 3 * 32 * 32)).astype(
+            np.float32)
+        for _ in range(n):
+            label = int(rng.randint(0, num_classes))
+            img = protos[label] + 0.25 * rng.normal(
+                size=3 * 32 * 32).astype(np.float32)
+            yield img.astype(np.float32), label
+    return reader
+
+
+def train10():
+    return _reader(TRAIN_SIZE, 10, seed=20061)
+
+
+def test10():
+    return _reader(TEST_SIZE, 10, seed=20062)
+
+
+def train100():
+    return _reader(TRAIN_SIZE, 100, seed=20063)
+
+
+def test100():
+    return _reader(TEST_SIZE, 100, seed=20064)

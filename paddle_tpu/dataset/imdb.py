@@ -1,0 +1,32 @@
+"""IMDB sentiment reader creators (parity: python/paddle/dataset/imdb.py —
+word-id sequences + binary label; word_dict() vocabulary)."""
+
+import numpy as np
+
+_VOCAB = 5149  # reference vocab size ballpark
+TRAIN_SIZE = 2048
+TEST_SIZE = 256
+
+
+def word_dict():
+    return {("w%d" % i).encode(): i for i in range(_VOCAB)}
+
+
+def _reader(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            label = int(rng.randint(0, 2))
+            length = int(rng.randint(8, 120))
+            lo, hi = (_VOCAB // 2, _VOCAB) if label else (2, _VOCAB // 2)
+            words = rng.randint(lo, hi, size=length).astype(np.int64)
+            yield words.tolist(), label
+    return reader
+
+
+def train(word_idx=None):
+    return _reader(TRAIN_SIZE, seed=41001)
+
+
+def test(word_idx=None):
+    return _reader(TEST_SIZE, seed=41002)

@@ -1,0 +1,28 @@
+"""MNIST reader creators (parity: python/paddle/dataset/mnist.py — train()
+:113, test() :121; samples are (784 float32 in [-1,1], int64 label)).
+Synthetic: class-conditional Gaussian digits, deterministic by seed."""
+
+import numpy as np
+
+TRAIN_SIZE = 8192
+TEST_SIZE = 1024
+
+
+def _reader(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        protos = rng.normal(size=(10, 784)).astype(np.float32)
+        for _ in range(n):
+            label = int(rng.randint(0, 10))
+            img = protos[label] + 0.3 * rng.normal(size=784).astype(
+                np.float32)
+            yield np.clip(img, -1.0, 1.0).astype(np.float32), label
+    return reader
+
+
+def train():
+    return _reader(TRAIN_SIZE, seed=90051)
+
+
+def test():
+    return _reader(TEST_SIZE, seed=90052)

@@ -1,0 +1,34 @@
+"""UCI housing reader creators (parity: python/paddle/dataset/uci_housing.py
+— 13 float features, float target; used by fit-a-line)."""
+
+import numpy as np
+
+TRAIN_SIZE = 404
+TEST_SIZE = 102
+_W = None
+
+
+def _true_w(rng):
+    global _W
+    if _W is None:
+        _W = rng.uniform(-2, 2, size=(13,)).astype(np.float32)
+    return _W
+
+
+def _reader(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        w = _true_w(np.random.RandomState(13))
+        for _ in range(n):
+            x = rng.uniform(-1, 1, size=13).astype(np.float32)
+            y = np.array([x @ w + 0.5 + 0.05 * rng.normal()], np.float32)
+            yield x, y
+    return reader
+
+
+def train():
+    return _reader(TRAIN_SIZE, seed=31001)
+
+
+def test():
+    return _reader(TEST_SIZE, seed=31002)

@@ -1,0 +1,29 @@
+"""WMT16 translation reader creators (parity: python/paddle/dataset/
+wmt16.py — (src_ids, trg_ids, trg_next_ids) triples with BOS=0/EOS=1/UNK=2)."""
+
+import numpy as np
+
+TRAIN_SIZE = 1024
+TEST_SIZE = 128
+
+
+def _reader(n, src_dict_size, trg_dict_size, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            L = int(rng.randint(4, 30))
+            src = rng.randint(3, src_dict_size, size=L).astype(np.int64)
+            # synthetic "translation": reversed ids mapped into trg vocab
+            trg_core = (src[::-1] % (trg_dict_size - 3)) + 3
+            trg = np.concatenate([[0], trg_core]).astype(np.int64)
+            trg_next = np.concatenate([trg_core, [1]]).astype(np.int64)
+            yield src.tolist(), trg.tolist(), trg_next.tolist()
+    return reader
+
+
+def train(src_dict_size=10000, trg_dict_size=10000, src_lang="en"):
+    return _reader(TRAIN_SIZE, src_dict_size, trg_dict_size, seed=51001)
+
+
+def test(src_dict_size=10000, trg_dict_size=10000, src_lang="en"):
+    return _reader(TEST_SIZE, src_dict_size, trg_dict_size, seed=51002)

@@ -1,0 +1,126 @@
+"""Dataset classes + factory (parity: framework/data_set.h C16 —
+`Dataset::LoadIntoMemory/LocalShuffle/GlobalShuffle`, dataset_factory.cc,
+python dataset.py DatasetFactory/InMemoryDataset/QueueDataset).
+
+TPU-native: file lists hold recordio shards (native/recordio.cc). The
+Hogwild thread-per-core consumption model (C15) collapses into the single
+jitted step fed batch-by-batch — `Executor.train_from_dataset` drives it.
+GlobalShuffle's cross-node RPC exchange becomes a deterministic
+shard-reassignment by hash (same sample redistribution capability, no RPC:
+every worker reads the shards whose hash maps to it).
+"""
+
+import random
+
+import numpy as np
+
+from . import recordio_writer
+
+__all__ = ["DatasetFactory", "InMemoryDataset", "QueueDataset"]
+
+
+class DatasetBase:
+    def __init__(self):
+        self._filelist = []
+        self._batch_size = 1
+        self._use_var = []
+        self._thread = 1
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def set_batch_size(self, batch_size):
+        self._batch_size = batch_size
+
+    def set_thread(self, thread_num):
+        self._thread = thread_num
+
+    def set_use_var(self, var_list):
+        self._use_var = list(var_list)
+
+    def _sample_reader(self):
+        return recordio_writer.recordio_reader_creator(self._filelist)
+
+    def _batches(self):
+        feed_names = [v.name for v in self._use_var]
+        batch = []
+        for sample in self._iter_samples():
+            batch.append(sample)
+            if len(batch) == self._batch_size:
+                yield self._to_feed(feed_names, batch)
+                batch = []
+        if batch:
+            yield self._to_feed(feed_names, batch)
+
+    @staticmethod
+    def _to_feed(feed_names, batch):
+        cols = list(zip(*batch))
+        feed = {}
+        for name, col in zip(feed_names, cols):
+            stacked = np.stack([np.asarray(c) for c in col])
+            if stacked.ndim == 1:  # scalar fields batch to [N, 1] (labels)
+                stacked = stacked.reshape(-1, 1)
+            feed[name] = stacked
+        return feed
+
+    def _iter_samples(self):
+        raise NotImplementedError
+
+
+class QueueDataset(DatasetBase):
+    """Streaming dataset: shards are read on the fly (data_set.h
+    QueueDataset — no in-memory shuffle)."""
+
+    def _iter_samples(self):
+        return self._sample_reader()()
+
+
+class InMemoryDataset(DatasetBase):
+    """load_into_memory + local/global shuffle (data_set.h:77-83)."""
+
+    def __init__(self):
+        super().__init__()
+        self._samples = None
+        self._rank = 0
+        self._world = 1
+
+    def load_into_memory(self):
+        self._samples = list(self._sample_reader()())
+
+    def local_shuffle(self, seed=None):
+        assert self._samples is not None, "call load_into_memory first"
+        random.Random(seed).shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, seed=0):
+        """Cross-worker sample redistribution (data_set.h GlobalShuffle).
+        Single-process: a seeded full shuffle. Multi-worker (fleet set):
+        keep the samples whose hash maps to this worker — all workers
+        together see every sample exactly once, shuffled."""
+        assert self._samples is not None, "call load_into_memory first"
+        if fleet is not None:
+            self._rank = fleet.worker_index()
+            self._world = fleet.worker_num()
+        rng = random.Random(seed)
+        order = list(range(len(self._samples)))
+        rng.shuffle(order)
+        if self._world > 1:
+            order = [i for i in order if i % self._world == self._rank]
+        self._samples = [self._samples[i] for i in order]
+
+    def release_memory(self):
+        self._samples = None
+
+    def _iter_samples(self):
+        assert self._samples is not None, "call load_into_memory first"
+        return iter(self._samples)
+
+
+class DatasetFactory:
+    """dataset_factory.cc parity."""
+
+    def create_dataset(self, datafeed_class="QueueDataset"):
+        if datafeed_class == "InMemoryDataset":
+            return InMemoryDataset()
+        if datafeed_class == "QueueDataset":
+            return QueueDataset()
+        raise ValueError("unknown dataset class %r" % datafeed_class)

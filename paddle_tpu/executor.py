@@ -176,11 +176,30 @@ class Executor:
             return [np.asarray(f) for f in fetches]
         return fetches
 
-    # Fluid API compat: infer_from / train_from_dataset land in M5+.
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
                            fetch_info=None, print_period=100):
-        raise NotImplementedError(
-            "train_from_dataset (async trainer path) arrives with the "
-            "dataset subsystem"
-        )
+        """Drive a whole Dataset through the program (parity: executor.py:851
+        → C++ MultiTrainer/HogwildWorker trainer.h:71/C15). The reference's
+        thread-per-core Hogwild collapses into the single jitted step: the
+        dataset iterator feeds batches, XLA owns the parallelism."""
+        if dataset is None:
+            raise ValueError("train_from_dataset needs a dataset")
+        program = program or framework.default_main_program()
+        fetch_list = list(fetch_list or [])
+        fetch_names = [v.name if isinstance(v, framework.Variable) else str(v)
+                       for v in fetch_list]
+        step = 0
+        last = None
+        for feed in dataset._batches():
+            last = self.run(program, feed=feed, fetch_list=fetch_list,
+                            scope=scope)
+            step += 1
+            if debug and fetch_names and step % print_period == 0:
+                info = fetch_info or fetch_names
+                print("step %d: %s" % (step, {
+                    k: np.asarray(v).ravel()[:4]
+                    for k, v in zip(info, last)}))
+        return last
+
+    infer_from_dataset = train_from_dataset

@@ -214,6 +214,7 @@ class PyReader:
         self._generator = None
         self._places = None
         self._feeder = None
+        self._use_double_buffer = use_double_buffer
 
     def decorate_sample_list_generator(self, generator, places=None):
         from ..data_feeder import DataFeeder
@@ -249,11 +250,33 @@ class PyReader:
         t = threading.Thread(target=worker)
         t.daemon = True
         t.start()
+
+        # double buffer: async-transfer the NEXT batch to device while the
+        # CURRENT one trains (operators/reader/buffered_reader.cc parity —
+        # H2D overlap on its own stream; jax.device_put is async)
+        pending = None
         while True:
             item = q.get()
             if item is end:
                 break
-            yield item
+            staged = self._stage(item)
+            if pending is not None:
+                yield pending
+            pending = staged
+        if pending is not None:
+            yield pending
+
+    def _stage(self, item):
+        if not self._use_double_buffer:
+            return item
+        try:
+            import jax
+
+            if isinstance(item, dict):
+                return {k: jax.device_put(v) for k, v in item.items()}
+        except Exception:
+            pass
+        return item
 
     def start(self):
         self._iter = iter(self)

@@ -1,0 +1,85 @@
+"""RecordIO reader/writer bridge (parity: python/paddle/fluid/
+recordio_writer.py convert_reader_to_recordio_file + paddle.reader.creator
+.recordio; C++ backend native/recordio.cc — recordio/ C18).
+
+Sample serialization: each sample (a tuple of numpy arrays / scalars) is one
+record — little-endian field count, then per field: dtype tag, ndim, dims,
+raw bytes.
+"""
+
+import io as _io
+import struct
+
+import numpy as np
+
+from .core import native
+
+__all__ = ["convert_reader_to_recordio_file", "recordio_reader_creator",
+           "serialize_sample", "deserialize_sample"]
+
+
+def serialize_sample(sample) -> bytes:
+    fields = sample if isinstance(sample, (list, tuple)) else [sample]
+    buf = _io.BytesIO()
+    buf.write(struct.pack("<I", len(fields)))
+    for f in fields:
+        arr = np.asarray(f)
+        dt = arr.dtype.str.encode()
+        buf.write(struct.pack("<I", len(dt)))
+        buf.write(dt)
+        buf.write(struct.pack("<I", arr.ndim))
+        for d in arr.shape:
+            buf.write(struct.pack("<q", d))
+        raw = arr.tobytes()
+        buf.write(struct.pack("<Q", len(raw)))
+        buf.write(raw)
+    return buf.getvalue()
+
+
+def deserialize_sample(record: bytes):
+    buf = _io.BytesIO(record)
+    (nf,) = struct.unpack("<I", buf.read(4))
+    fields = []
+    for _ in range(nf):
+        (dtlen,) = struct.unpack("<I", buf.read(4))
+        dt = np.dtype(buf.read(dtlen).decode())
+        (ndim,) = struct.unpack("<I", buf.read(4))
+        shape = [struct.unpack("<q", buf.read(8))[0] for _ in range(ndim)]
+        (rawlen,) = struct.unpack("<Q", buf.read(8))
+        arr = np.frombuffer(buf.read(rawlen), dtype=dt).reshape(shape)
+        fields.append(arr)
+    return tuple(fields)
+
+
+def convert_reader_to_recordio_file(filename, reader_creator,
+                                    compressor=None, max_num_records=1000,
+                                    feeder=None):
+    """Write every sample of a reader into a recordio file; returns the
+    record count (parity: fluid/recordio_writer.py:42)."""
+    w = native.RecordIOWriter(filename, max_chunk_records=max_num_records)
+    n = 0
+    try:
+        for sample in reader_creator():
+            w.write(serialize_sample(sample))
+            n += 1
+    finally:
+        w.close()
+    return n
+
+
+def recordio_reader_creator(paths):
+    """Reader over one or more recordio files (parity:
+    paddle/reader/creator.py recordio)."""
+    if isinstance(paths, str):
+        paths = paths.split(",")
+
+    def reader():
+        for path in paths:
+            s = native.RecordIOScanner(path)
+            try:
+                for rec in s:
+                    yield deserialize_sample(rec)
+            finally:
+                s.close()
+
+    return reader
