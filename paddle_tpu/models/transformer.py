@@ -98,11 +98,23 @@ def layer_norm(x, scale, bias, eps=1e-5):
     return out.astype(x.dtype)
 
 
-def causal_attention(q, k, v, seq_offset=0):
+def causal_attention(q, k, v, seq_offset=0, use_flash=None):
     """q,k,v: [B, T, H, Dh] (H may be a tp-local slice). fp32 softmax,
-    bf16 matmuls on the MXU."""
+    bf16 matmuls on the MXU. On TPU with block-aligned self-attention the
+    Pallas flash kernel (ops/pallas_kernels.py) replaces the naive [T, T]
+    path — O(block) VMEM instead of materializing scores in HBM."""
     B, Tq, H, Dh = q.shape
     Tk = k.shape[1]
+    if use_flash is None:
+        use_flash = (jax.default_backend() == "tpu" and seq_offset == 0
+                     and Tq == Tk and Tq >= 256 and Dh >= 64)
+    if use_flash:
+        from ..ops.pallas_kernels import flash_attention
+
+        ctx = flash_attention(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), True, 1.0 / math.sqrt(Dh))
+        return ctx.transpose(0, 2, 1, 3)
     scale = 1.0 / math.sqrt(Dh)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale

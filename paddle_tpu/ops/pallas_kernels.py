@@ -1,0 +1,175 @@
+"""Pallas TPU kernels for the hot ops XLA doesn't fuse optimally
+(SURVEY §7 design mapping: "hand-written Pallas kernels only where XLA
+underperforms — attention/softmax fusions, top-k/DGC").
+
+flash_attention: blocked causal attention with online softmax — the
+  O(T) -memory replacement for the naive [T, T] score matrix. Forward is a
+  Pallas kernel (grid over (batch*heads, q blocks, kv blocks), VMEM
+  accumulators carried across the innermost kv dimension); backward is the
+  standard recompute formulation via jax.custom_vjp, left to XLA fusion.
+
+Kernels run under interpret=True off-TPU so the CPU test mesh exercises the
+same code path (tests/test_pallas.py).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU memory spaces; absent on some CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                sm_scale, causal, block_q, block_k, kv_len):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+
+    # skip fully-masked kv blocks (strictly above the causal diagonal)
+    run = True
+    if causal:
+        run = (ki * block_k) <= (qi * block_q + block_q - 1)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        mask = k_pos < kv_len  # padded keys
+        if causal:
+            mask = mask & (k_pos <= q_pos)
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_scr[:, :1]                      # [bq, 1]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)            # [bq, 1]
+        l_new = l_scr[:, :1] * alpha + p.sum(axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        o_ref[0] = (acc_scr[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _pad_to(x, axis, mult):
+    size = x.shape[axis]
+    pad = (-size) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal=True, sm_scale=None, block_q=128,
+                    block_k=128):
+    """Blocked attention, O(block) VMEM (q, k, v: [B, H, T, D])."""
+    return _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    B, H, T, D = q.shape
+    Tk = k.shape[2]
+    if sm_scale is None:
+        sm_scale = D ** -0.5
+    interpret = jax.default_backend() != "tpu"
+
+    qp = _pad_to(q.reshape(B * H, T, D), 1, block_q)
+    kp = _pad_to(k.reshape(B * H, Tk, D), 1, block_k)
+    vp = _pad_to(v.reshape(B * H, Tk, D), 1, block_k)
+    Tq_p, Tk_p = qp.shape[1], kp.shape[1]
+    grid = (B * H, Tq_p // block_q, Tk_p // block_k)
+
+    if pltpu is not None:
+        scratch = [
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ]
+    else:  # pragma: no cover - CPU-only install without the tpu module
+        scratch = [
+            jax.ShapeDtypeStruct((block_q, 128), jnp.float32),
+            jax.ShapeDtypeStruct((block_q, 128), jnp.float32),
+            jax.ShapeDtypeStruct((block_q, D), jnp.float32),
+        ]
+
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
+        block_k=block_k, kv_len=Tk)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq_p, D), q.dtype),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(qp, kp, vp)
+    return out[:, :T].reshape(B, H, T, D)
+
+
+def _flash_fwd_rule(q, k, v, causal, sm_scale, block_q, block_k):
+    out = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _flash_bwd_rule(causal, sm_scale, block_q, block_k, res, g):
+    """Backward by recompute (standard flash-attention formulation); the
+    [T, T] intermediate is rematerialized and XLA-fused, trading FLOPs for
+    the HBM the naive backward would burn."""
+    q, k, v = res
+    D = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else D ** -0.5
+
+    def attn(q32, k32, v32):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32, k32) * scale
+        if causal:
+            Tq, Tk = s.shape[-2], s.shape[-1]
+            mask = jnp.tril(jnp.ones((Tq, Tk), bool), Tk - Tq)
+            s = jnp.where(mask, s, _NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v32)
+
+    f32 = jnp.float32
+    _, vjp = jax.vjp(attn, q.astype(f32), k.astype(f32), v.astype(f32))
+    dq, dk, dv = vjp(g.astype(f32))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
