@@ -22,7 +22,7 @@ __all__ = [
     "AdamaxOptimizer", "DecayedAdagradOptimizer", "AdadeltaOptimizer",
     "RMSPropOptimizer", "FtrlOptimizer", "LambOptimizer",
     "LarsMomentumOptimizer", "DGCMomentumOptimizer", "ModelAverage",
-    "ExponentialMovingAverage",
+    "ExponentialMovingAverage", "GradientMergeOptimizer",
 ]
 
 
@@ -626,6 +626,73 @@ class _EMAGuard:
 
     def __exit__(self, *a):
         self._ema.restore()
+
+
+class GradientMergeOptimizer:
+    """Gradient accumulation over k steps (parity: SURVEY §2.3 P10
+    multi-batch-merge — ir/multi_batch_merge_pass.cc replicated fwd/bwd K
+    times per iteration; here: grads accumulate into persistable buffers and
+    the wrapped optimizer's update runs under a `cond` every k-th step)."""
+
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        self.inner = inner_optimizer
+        self.k_steps = int(k_steps)
+        self.avg = avg
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from .layers import control_flow, learning_rate_scheduler, nn, tensor
+
+        params_grads = self.inner.backward(loss, startup_program,
+                                           parameter_list, no_grad_set)
+        if self.k_steps <= 1:
+            return self.inner.apply_gradients(params_grads), params_grads
+
+        prog = params_grads[0][0].block.program
+        block = prog.global_block()
+        with framework.program_guard(prog):
+            self.inner.helper = LayerHelper("gradient_merge")
+            self.inner._create_global_learning_rate(prog)
+            self.inner._create_accumulators(block,
+                                            [p for p, _ in params_grads])
+            merged = []
+            for p, g in params_grads:
+                acc = block.create_var(
+                    name=unique_name.generate(p.name + "_grad_merge"),
+                    shape=p.shape, dtype="float32", persistable=True,
+                    stop_gradient=True)
+                sb = default_startup_program().global_block()
+                sv = sb.create_var(name=acc.name, shape=p.shape,
+                                   dtype="float32", persistable=True)
+                Constant(0.0)(sv, sb)
+                block.append_op(type="elementwise_add",
+                                inputs={"X": [acc], "Y": [g]},
+                                outputs={"Out": [acc]}, attrs={"axis": -1})
+                merged.append((p, acc))
+
+            counter = learning_rate_scheduler.autoincreased_step_counter(
+                counter_name="@gradient_merge_step@")
+            kvar = tensor.fill_constant([1], "int64", self.k_steps)
+            zero = tensor.fill_constant([1], "int64", 0)
+            rem = nn.elementwise_mod(counter, kvar)
+            pred = nn.equal(rem, zero)
+
+            with control_flow._sub_block() as update_blk:
+                for p, acc in merged:
+                    g_eff = nn.scale(
+                        acc, scale=1.0 / self.k_steps) if self.avg else acc
+                    self.inner._append_optimize_op(update_blk, (p, g_eff))
+                    # reset the accumulator after applying
+                    zg = tensor.fill_constant(p.shape, "float32", 0.0)
+                    update_blk.append_op(type="assign",
+                                         inputs={"X": [zg]},
+                                         outputs={"Out": [acc]})
+                if merged:
+                    self.inner._finish_update(update_blk, merged)
+            control_flow._append_cond_op(
+                block, pred, update_blk, None,
+                [p.name for p, _ in merged] + [a.name for _, a in merged])
+        return [], params_grads
 
 
 SGD = SGDOptimizer

@@ -1,0 +1,173 @@
+"""Fleet — high-level distributed API (parity: incubate/fleet/base/
+fleet_base.py:38 `Fleet.init/init_worker/init_server/distributed_optimizer`;
+collective mode incubate/fleet/collective/__init__.py:72
+CollectiveOptimizer; role makers reading PADDLE_* env vars,
+test_fit_a_line.py:75-93).
+
+TPU-native: the collective backend is the JAX distributed runtime over
+ICI/DCN (jax.distributed.initialize replaces gen_nccl_id RPC + NCCLContextMap
+— SURVEY §5.8). Parameter-server roles map onto the same worker set: the
+"server" capability (sharded optimizer state) is ShardedAdam
+(parallel/zero.py), selected via DistributeTranspilerConfig-style options.
+"""
+
+import os
+
+from . import mesh as mesh_mod
+
+__all__ = ["Fleet", "fleet", "PaddleCloudRoleMaker", "UserDefinedRoleMaker",
+           "DistributedStrategy"]
+
+
+class PaddleCloudRoleMaker:
+    """Reads the PADDLE_* env contract (fleet_base.py / role_maker.py):
+    PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ENDPOINTS,
+    PADDLE_CURRENT_ENDPOINT, TRAINING_ROLE."""
+
+    def __init__(self, is_collective=True):
+        self._is_collective = is_collective
+        self._id = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        self._num = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._endpoints = eps.split(",") if eps else []
+        self._current = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+        self._role = os.environ.get("TRAINING_ROLE", "TRAINER")
+
+    def worker_index(self):
+        return self._id
+
+    def worker_num(self):
+        return self._num
+
+    def is_worker(self):
+        return self._role == "TRAINER"
+
+    def is_server(self):
+        return self._role == "PSERVER"
+
+    def is_first_worker(self):
+        return self._id == 0
+
+    def get_trainer_endpoints(self):
+        return self._endpoints
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    def __init__(self, current_id=0, role="TRAINER", worker_num=1,
+                 server_endpoints=None, is_collective=True):
+        super().__init__(is_collective)
+        self._id = current_id
+        self._num = worker_num
+        self._role = "TRAINER" if role in ("TRAINER", 1) else "PSERVER"
+        self._endpoints = server_endpoints or []
+
+
+class DistributedStrategy:
+    """CollectiveOptimizer strategy knobs (+ the TPU-native extensions)."""
+
+    def __init__(self):
+        self.mode = "collective"       # collective | sharded (reduce/ZeRO)
+        self.nccl_comm_num = 1         # accepted for parity; unused (ICI)
+        self.use_dgc = False
+        self.dgc_sparsity = 0.99
+        self.gradient_merge_k = 1      # multi-batch-merge (P10)
+        self.amp = False
+
+
+class Fleet:
+    """Singleton facade (fleet_base.py:38)."""
+
+    def __init__(self):
+        self._role_maker = None
+        self._strategy = None
+
+    # -- lifecycle (init :175, init_worker :207, init_server :211) ---------
+    def init(self, role_maker=None):
+        self._role_maker = role_maker or PaddleCloudRoleMaker()
+        # multi-host bring-up: replaces gen_nccl_id_op + NCCLContextMap
+        # rank joining (platform/nccl_helper.h:130)
+        if self._role_maker.worker_num() > 1 and os.environ.get(
+                "PADDLE_COORDINATOR_ADDR"):
+            import jax
+
+            jax.distributed.initialize(
+                coordinator_address=os.environ["PADDLE_COORDINATOR_ADDR"],
+                num_processes=self._role_maker.worker_num(),
+                process_id=self._role_maker.worker_index())
+        return self
+
+    def init_worker(self):
+        pass
+
+    def init_server(self, *args, **kwargs):
+        pass
+
+    def run_server(self):
+        pass
+
+    def stop_worker(self):
+        pass
+
+    def barrier_worker(self):
+        import jax
+
+        # a tiny psum over all devices acts as the barrier
+        if self.worker_num() > 1:
+            import jax.numpy as jnp
+
+            jax.block_until_ready(
+                jax.pmap(lambda x: jax.lax.psum(x, "i"), "i")(
+                    jnp.ones((jax.local_device_count(),))))
+
+    # -- info ---------------------------------------------------------------
+    def worker_index(self):
+        return self._role_maker.worker_index() if self._role_maker else 0
+
+    def worker_num(self):
+        return self._role_maker.worker_num() if self._role_maker else 1
+
+    def is_first_worker(self):
+        return self.worker_index() == 0
+
+    def worker_endpoints(self):
+        return (self._role_maker.get_trainer_endpoints()
+                if self._role_maker else [])
+
+    # -- the main entry (distributed_optimizer :223) ------------------------
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._strategy = strategy or DistributedStrategy()
+        return CollectiveOptimizer(optimizer, self._strategy, self)
+
+
+class CollectiveOptimizer:
+    """Wraps a fluid-API optimizer for data-parallel training
+    (incubate/fleet/collective/__init__.py:72). minimize() behaves like the
+    wrapped optimizer; the Program is then run through
+    CompiledProgram.with_data_parallel, where gradient allreduce comes from
+    sharding propagation over the mesh (compiler.py), replacing the nccl2
+    transpile at :130-134."""
+
+    def __init__(self, optimizer, strategy, fleet_ref):
+        self._optimizer = optimizer
+        self._strategy = strategy
+        self._fleet = fleet_ref
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        result = self._optimizer.minimize(
+            loss, startup_program=startup_program,
+            parameter_list=parameter_list, no_grad_set=no_grad_set)
+        # mark the program so CompiledProgram picks the data-parallel path
+        prog = loss.block.program
+        prog._fleet_opt = {
+            "mode": self._strategy.mode,
+            "use_dgc": self._strategy.use_dgc,
+            "gradient_merge_k": self._strategy.gradient_merge_k,
+        }
+        return result
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+
+fleet = Fleet()
