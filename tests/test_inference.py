@@ -1,0 +1,62 @@
+"""Inference-engine tests (parity: inference/api tests — load, optimize,
+repeated run, isolated scope; SURVEY §3.5 call stack)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.inference import (AnalysisConfig, PaddleTensor,
+                                  create_paddle_predictor)
+
+
+def _export_model(tmp_path):
+    x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+    h = fluid.layers.fc(input=x, size=16, act="relu")
+    y = fluid.layers.fc(input=h, size=3, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    d = str(tmp_path / "m")
+    fluid.io.save_inference_model(d, ["x"], [y], exe)
+    # reference output for parity check
+    xd = np.random.RandomState(0).rand(4, 8).astype(np.float32)
+    want, = exe.run(fluid.default_main_program(), feed={"x": xd},
+                    fetch_list=[y])
+    return d, xd, want
+
+
+def test_predictor_runs_and_matches_training_graph(tmp_path):
+    d, xd, want = _export_model(tmp_path)
+    cfg = AnalysisConfig(d)
+    cfg.disable_gpu()
+    pred = create_paddle_predictor(cfg)
+    assert pred.get_input_names() == ["x"]
+    outs = pred.run([PaddleTensor(xd, name="x")])
+    np.testing.assert_allclose(outs[0].as_ndarray(), want, rtol=1e-5,
+                               atol=1e-6)
+    # repeated run, same executable (program cache path)
+    outs2 = pred.run([PaddleTensor(xd)])
+    np.testing.assert_allclose(outs2[0].as_ndarray(), want, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_predictor_aot_warmup(tmp_path):
+    d, xd, want = _export_model(tmp_path)
+    cfg = AnalysisConfig(d)
+    cfg.disable_gpu()
+    cfg.set_aot_shapes({"x": (4, 8)})
+    pred = create_paddle_predictor(cfg)
+    outs = pred.run([PaddleTensor(xd, name="x")])
+    np.testing.assert_allclose(outs[0].as_ndarray(), want, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_predictor_scope_isolated(tmp_path):
+    d, xd, _ = _export_model(tmp_path)
+    cfg = AnalysisConfig(d)
+    cfg.disable_gpu()
+    pred = create_paddle_predictor(cfg)
+    # global scope must not see the predictor's params
+    pnames = [v.name for v in pred._program.global_block().all_parameters()]
+    global_vals = [fluid.global_scope().get(n) for n in pnames]
+    # predictor works regardless of global scope contents
+    pred.run([PaddleTensor(xd)])
+    assert pred._scope.get(pnames[0]) is not None
