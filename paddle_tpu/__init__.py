@@ -61,7 +61,7 @@ from .dygraph.base import enable_dygraph, disable_dygraph  # noqa: F401
 from . import parallel
 from .parallel import ParallelExecutor  # noqa: F401
 from .initializer import Constant, Uniform, Normal, Xavier, MSRA  # noqa
-from .data_feeder import DataFeeder  # noqa: F401
+from .data_feeder import DataFeeder, DataFeedDesc  # noqa: F401
 from .core.tensor import LoDTensor, LoDTensorArray  # noqa: F401
 
 

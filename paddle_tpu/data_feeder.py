@@ -61,3 +61,63 @@ class DataFeeder:
                             arr = arr.reshape(tgt)
                 out[name] = arr
         return out
+
+
+class DataFeedDesc:
+    """Declarative feed description (parity: fluid/data_feed_desc.py wrapping
+    framework/data_feed.proto). Configures slot names/types/dense-ness for
+    Dataset-driven training (train_from_dataset)."""
+
+    def __init__(self, proto_file=None):
+        self.name = "MultiSlotDataFeed"
+        self.batch_size = 1
+        self.slots = []  # dicts: name, type, shape, is_dense, is_used
+        self._slot_index = {}
+        if proto_file is not None:
+            self._parse(proto_file)
+
+    def _parse(self, proto_file):
+        import re
+        with open(proto_file) as f:
+            text = f.read()
+        for m in re.finditer(
+                r"slots\s*\{([^}]*)\}", text):
+            body = m.group(1)
+            get = lambda k, d=None: (re.search(k + r':\s*"?([\w.]+)"?', body)
+                                     or [None, d])[1]
+            self.add_slot(get("name", ""), get("type", "float"),
+                          is_dense=get("is_dense", "false") == "true")
+        bs = re.search(r"batch_size:\s*(\d+)", text)
+        if bs:
+            self.batch_size = int(bs.group(1))
+
+    def add_slot(self, name, dtype="float", shape=None, is_dense=False):
+        self._slot_index[name] = len(self.slots)
+        self.slots.append({"name": name, "type": dtype,
+                           "shape": list(shape or []),
+                           "is_dense": is_dense, "is_used": True})
+        return self
+
+    def set_batch_size(self, batch_size):
+        self.batch_size = batch_size
+
+    def set_dense_slots(self, dense_slots_name):
+        for n in dense_slots_name:
+            self.slots[self._slot_index[n]]["is_dense"] = True
+
+    def set_use_slots(self, use_slots_name):
+        for s in self.slots:
+            s["is_used"] = False
+        for n in use_slots_name:
+            self.slots[self._slot_index[n]]["is_used"] = True
+
+    def desc(self):
+        lines = ["name: \"%s\"" % self.name,
+                 "batch_size: %d" % self.batch_size]
+        for s in self.slots:
+            lines.append(
+                "slots {\n  name: \"%s\"\n  type: \"%s\"\n  is_dense: %s\n"
+                "  is_used: %s\n}" % (s["name"], s["type"],
+                                      str(s["is_dense"]).lower(),
+                                      str(s["is_used"]).lower()))
+        return "\n".join(lines)

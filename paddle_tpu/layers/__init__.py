@@ -8,6 +8,8 @@ from . import detection
 from . import metric_op
 from . import control_flow
 from . import learning_rate_scheduler
+from . import extras
+from .extras import *  # noqa: F401,F403
 from .control_flow import *  # noqa: F401,F403
 from .learning_rate_scheduler import *  # noqa: F401,F403
 from .nn import *  # noqa: F401,F403
@@ -20,4 +22,4 @@ from .metric_op import *  # noqa: F401,F403
 __all__ = list(set(nn.__all__) | set(tensor.__all__) | set(io.__all__)
                | set(sequence.__all__) | set(detection.__all__)
                | set(metric_op.__all__) | set(control_flow.__all__)
-               | set(learning_rate_scheduler.__all__))
+               | set(learning_rate_scheduler.__all__) | set(extras.__all__))

@@ -15,6 +15,7 @@ __all__ = [
     "multiclass_nms", "detection_output", "ssd_loss", "yolo_box",
     "yolov3_loss", "detection_map", "polygon_box_transform", "roi_align",
     "roi_pool", "multi_box_head", "generate_proposals",
+    "rpn_target_assign", "generate_proposal_labels", "generate_mask_labels", "collect_fpn_proposals", "distribute_fpn_proposals", "box_decoder_and_assign", "psroi_pool", "roi_perspective_transform",
 ]
 
 
@@ -387,3 +388,185 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
     boxes = tensor.concat(boxes_list, axis=0)
     box_vars = tensor.concat(vars_list, axis=0)
     return mbox_locs, mbox_confs, boxes, box_vars
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    """RPN anchor sampling (parity: layers/detection.py rpn_target_assign /
+    rpn_target_assign_op.cc). Fixed-size sampling: outputs are padded to the
+    quota and masked via BBoxInsideWeight / score validity."""
+    helper = LayerHelper("rpn_target_assign", **locals())
+    mk = lambda dt: helper.create_variable_for_type_inference(dtype=dt)
+    loc_idx, score_idx = mk("int32"), mk("int32")
+    tgt_lbl, tgt_bbox, in_w, score_valid = (mk("int32"), mk("float32"),
+                                            mk("float32"), mk("bool"))
+    ins = {"Anchor": [anchor_box], "GtBoxes": [gt_boxes]}
+    if is_crowd is not None:
+        ins["IsCrowd"] = [is_crowd]
+    if im_info is not None:
+        ins["ImInfo"] = [im_info]
+    helper.append_op(
+        type="rpn_target_assign", inputs=ins,
+        outputs={"LocationIndex": [loc_idx], "ScoreIndex": [score_idx],
+                 "TargetLabel": [tgt_lbl], "TargetBBox": [tgt_bbox],
+                 "BBoxInsideWeight": [in_w], "ScoreValid": [score_valid]},
+        attrs={"rpn_batch_size_per_im": rpn_batch_size_per_im,
+               "rpn_straddle_thresh": rpn_straddle_thresh,
+               "rpn_fg_fraction": rpn_fg_fraction,
+               "rpn_positive_overlap": rpn_positive_overlap,
+               "rpn_negative_overlap": rpn_negative_overlap,
+               "use_random": use_random})
+    for v in (loc_idx, score_idx, tgt_lbl, tgt_bbox, in_w, score_valid):
+        v.stop_gradient = True
+    # gather predictions at the sampled indices, as the reference does
+    from . import nn as nn_layers
+    pred_loc = nn_layers.gather(bbox_pred, loc_idx)
+    pred_score = nn_layers.gather(cls_logits, score_idx)
+    return pred_score, pred_loc, tgt_lbl, tgt_bbox, in_w
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info=None, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.25,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=[0.1, 0.1, 0.2, 0.2],
+                             class_nums=None, use_random=True,
+                             is_cls_agnostic=False, is_cascade_rcnn=False):
+    helper = LayerHelper("generate_proposal_labels", **locals())
+    mk = lambda dt: helper.create_variable_for_type_inference(dtype=dt)
+    rois, labels = mk("float32"), mk("int32")
+    bbox_targets, in_w, out_w = mk("float32"), mk("float32"), mk("float32")
+    ins = {"RpnRois": [rpn_rois], "GtClasses": [gt_classes],
+           "GtBoxes": [gt_boxes]}
+    if is_crowd is not None:
+        ins["IsCrowd"] = [is_crowd]
+    if im_info is not None:
+        ins["ImInfo"] = [im_info]
+    helper.append_op(
+        type="generate_proposal_labels", inputs=ins,
+        outputs={"Rois": [rois], "LabelsInt32": [labels],
+                 "BboxTargets": [bbox_targets],
+                 "BboxInsideWeights": [in_w],
+                 "BboxOutsideWeights": [out_w]},
+        attrs={"batch_size_per_im": batch_size_per_im,
+               "fg_fraction": fg_fraction, "fg_thresh": fg_thresh,
+               "bg_thresh_hi": bg_thresh_hi, "bg_thresh_lo": bg_thresh_lo,
+               "class_nums": class_nums or 81})
+    for v in (rois, labels, bbox_targets, in_w, out_w):
+        v.stop_gradient = True
+    return rois, labels, bbox_targets, in_w, out_w
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes=81, resolution=14,
+                         gt_boxes=None):
+    """Mask-RCNN mask targets; gt_segms is a dense bitmap [G, Hm, Wm]
+    (polygon→bitmap happens in the host input pipeline). When gt_boxes is
+    omitted the op derives each gt's box from its mask extent."""
+    helper = LayerHelper("generate_mask_labels", **locals())
+    mk = lambda dt: helper.create_variable_for_type_inference(dtype=dt)
+    mask_rois, has_mask, mask_int32 = mk("float32"), mk("int32"), mk("int32")
+    ins = {"Rois": [rois], "GtSegms": [gt_segms],
+           "LabelsInt32": [labels_int32]}
+    if gt_boxes is not None:
+        ins["GtBoxes"] = [gt_boxes]
+    helper.append_op(
+        type="generate_mask_labels", inputs=ins,
+        outputs={"MaskRois": [mask_rois], "RoiHasMaskInt32": [has_mask],
+                 "MaskInt32": [mask_int32]},
+        attrs={"num_classes": num_classes, "resolution": resolution})
+    for v in (mask_rois, has_mask, mask_int32):
+        v.stop_gradient = True
+    return mask_rois, has_mask, mask_int32
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, name=None):
+    helper = LayerHelper("collect_fpn_proposals", **locals())
+    out = helper.create_variable_for_type_inference(dtype="float32")
+    num = helper.create_variable_for_type_inference(dtype="int32")
+    helper.append_op(
+        type="collect_fpn_proposals",
+        inputs={"MultiLevelRois": list(multi_rois),
+                "MultiLevelScores": list(multi_scores)},
+        outputs={"FpnRois": [out], "RoisNum": [num]},
+        attrs={"post_nms_topN": post_nms_top_n})
+    out.stop_gradient = True
+    return out
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, name=None):
+    helper = LayerHelper("distribute_fpn_proposals", **locals())
+    n_levels = max_level - min_level + 1
+    outs = [helper.create_variable_for_type_inference(dtype="float32")
+            for _ in range(n_levels)]
+    restore = helper.create_variable_for_type_inference(dtype="int32")
+    lvl = helper.create_variable_for_type_inference(dtype="int32")
+    helper.append_op(
+        type="distribute_fpn_proposals", inputs={"FpnRois": [fpn_rois]},
+        outputs={"MultiFpnRois": outs, "RestoreIndex": [restore],
+                 "LevelIndex": [lvl]},
+        attrs={"min_level": min_level, "max_level": max_level,
+               "refer_level": refer_level, "refer_scale": refer_scale})
+    for v in outs + [restore, lvl]:
+        v.stop_gradient = True
+    return outs, restore
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip, name=None):
+    helper = LayerHelper("box_decoder_and_assign", **locals())
+    decoded = helper.create_variable_for_type_inference(dtype="float32")
+    assigned = helper.create_variable_for_type_inference(dtype="float32")
+    helper.append_op(
+        type="box_decoder_and_assign",
+        inputs={"PriorBox": [prior_box], "PriorBoxVar": [prior_box_var],
+                "TargetBox": [target_box], "BoxScore": [box_score]},
+        outputs={"DecodeBox": [decoded], "OutputAssignBox": [assigned]},
+        attrs={"box_clip": box_clip})
+    return decoded, assigned
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, name=None, rois_batch_id=None):
+    helper = LayerHelper("psroi_pool", **locals())
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    ins = {"X": [input], "ROIs": [rois]}
+    if rois_batch_id is not None:
+        ins["BatchId"] = [rois_batch_id]
+    helper.append_op(
+        type="psroi_pool", inputs=ins, outputs={"Out": [out]},
+        attrs={"output_channels": output_channels,
+               "spatial_scale": spatial_scale,
+               "pooled_height": pooled_height,
+               "pooled_width": pooled_width})
+    if rois.shape:
+        out.shape = (rois.shape[0], output_channels, pooled_height,
+                     pooled_width)
+    return out
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              name=None, rois_batch_id=None):
+    helper = LayerHelper("roi_perspective_transform", **locals())
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    mask = helper.create_variable_for_type_inference(dtype="int32")
+    tm = helper.create_variable_for_type_inference(dtype=input.dtype)
+    ins = {"X": [input], "ROIs": [rois]}
+    if rois_batch_id is not None:
+        ins["BatchId"] = [rois_batch_id]
+    helper.append_op(
+        type="roi_perspective_transform", inputs=ins,
+        outputs={"Out": [out], "Mask": [mask], "TransformMatrix": [tm]},
+        attrs={"transformed_height": transformed_height,
+               "transformed_width": transformed_width,
+               "spatial_scale": spatial_scale})
+    if rois.shape and input.shape:
+        out.shape = (rois.shape[0], input.shape[1], transformed_height,
+                     transformed_width)
+    return out

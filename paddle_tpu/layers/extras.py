@@ -1,0 +1,397 @@
+"""Layers completing the fluid.layers surface: CRF, CTC, sampled losses,
+beam search, structural/LoD utilities (parity: python/paddle/fluid/layers/
+nn.py linear_chain_crf/crf_decoding/warpctc/nce/hsigmoid/..., control_flow
+reorder_lod_tensor_by_rank, tensor.py tensor_array_to_tensor — SURVEY
+Appendix B missing-function list)."""
+
+import numpy as np
+
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+from ..param_attr import ParamAttr
+
+__all__ = [
+    "linear_chain_crf", "crf_decoding", "ctc_greedy_decoder", "edit_distance",
+    "warpctc", "nce", "hsigmoid", "crop", "rank", "hash", "fsp_matrix",
+    "row_conv", "tree_conv", "lod_reset", "reorder_lod_tensor_by_rank",
+    "tensor_array_to_tensor", "get_tensor_from_selected_rows",
+    "merge_selected_rows", "continuous_value_model", "chunk_eval",
+    "py_func", "beam_search", "beam_search_decode",
+]
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    """CRF log-likelihood over padded-dense emissions [B, T, C]
+    (parity: layers/nn.py linear_chain_crf; LoD → Length)."""
+    helper = LayerHelper("linear_chain_crf", **locals())
+    num_classes = input.shape[-1]
+    transition = helper.create_parameter(
+        attr=helper.param_attr, shape=[num_classes + 2, num_classes],
+        dtype=input.dtype)
+    alpha = helper.create_variable_for_type_inference(dtype=input.dtype)
+    e_exps = helper.create_variable_for_type_inference(dtype=input.dtype)
+    t_exps = helper.create_variable_for_type_inference(dtype=input.dtype)
+    ll = helper.create_variable_for_type_inference(dtype=input.dtype)
+    ins = {"Emission": [input], "Transition": [transition], "Label": [label]}
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op(
+        type="linear_chain_crf", inputs=ins,
+        outputs={"Alpha": [alpha], "EmissionExps": [e_exps],
+                 "TransitionExps": [t_exps], "LogLikelihood": [ll]})
+    ll.shape = (input.shape[0], 1) if input.shape else None
+    return ll
+
+
+def crf_decoding(input, param_attr, label=None, length=None):
+    helper = LayerHelper("crf_decoding", **locals())
+    transition = helper.main_program.global_block().var(param_attr.name)
+    path = helper.create_variable_for_type_inference(dtype="int64")
+    ins = {"Emission": [input], "Transition": [transition]}
+    if label is not None:
+        ins["Label"] = [label]
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op(type="crf_decoding", inputs=ins,
+                     outputs={"ViterbiPath": [path]})
+    path.stop_gradient = True
+    return path
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, name=None):
+    """Greedy CTC: argmax over classes then merge-repeats/strip-blanks.
+    Output is padded with -1 (parity: layers/nn.py ctc_greedy_decoder)."""
+    helper = LayerHelper("ctc_greedy_decoder", **locals())
+    argmax = helper.create_variable_for_type_inference(dtype="int64")
+    helper.append_op(type="argmax", inputs={"X": [input]},
+                     outputs={"Out": [argmax]}, attrs={"axis": -1})
+    out = helper.create_variable_for_type_inference(dtype="int64")
+    out_len = helper.create_variable_for_type_inference(dtype="int32")
+    ins = {"Input": [argmax]}
+    if input_length is not None:
+        ins["Length"] = [input_length]
+    helper.append_op(type="ctc_align", inputs=ins,
+                     outputs={"Output": [out], "OutputLength": [out_len]},
+                     attrs={"blank": blank})
+    out.stop_gradient = True
+    if input_length is None:
+        return out
+    return out, out_len
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    helper = LayerHelper("edit_distance", **locals())
+    out = helper.create_variable_for_type_inference(dtype="float32")
+    seq_num = helper.create_variable_for_type_inference(dtype="int64")
+    ins = {"Hyps": [input], "Refs": [label]}
+    if input_length is not None:
+        ins["HypsLength"] = [input_length]
+    if label_length is not None:
+        ins["RefsLength"] = [label_length]
+    helper.append_op(type="edit_distance", inputs=ins,
+                     outputs={"Out": [out], "SequenceNum": [seq_num]},
+                     attrs={"normalized": normalized})
+    out.stop_gradient = True
+    seq_num.stop_gradient = True
+    return out, seq_num
+
+
+def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,
+            label_length=None):
+    """Native CTC loss (parity: layers/nn.py warpctc; computed by the
+    log-semiring recursion, no external warp-ctc)."""
+    helper = LayerHelper("warpctc", **locals())
+    loss = helper.create_variable_for_type_inference(dtype=input.dtype)
+    grad = helper.create_variable_for_type_inference(dtype=input.dtype)
+    ins = {"Logits": [input], "Label": [label]}
+    if input_length is not None:
+        ins["LogitsLength"] = [input_length]
+    if label_length is not None:
+        ins["LabelLength"] = [label_length]
+    helper.append_op(type="warpctc", inputs=ins,
+                     outputs={"Loss": [loss], "WarpCTCGrad": [grad]},
+                     attrs={"blank": blank, "norm_by_times": norm_by_times})
+    loss.shape = (input.shape[0], 1) if input.shape else None
+    return loss
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=10, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    helper = LayerHelper("nce", **locals())
+    dim = input.shape[-1]
+    w = helper.create_parameter(attr=helper.param_attr,
+                                shape=[num_total_classes, dim],
+                                dtype=input.dtype)
+    b = helper.create_parameter(attr=helper.bias_attr,
+                                shape=[num_total_classes, 1],
+                                dtype=input.dtype, is_bias=True)
+    cost = helper.create_variable_for_type_inference(dtype=input.dtype)
+    sample_logits = helper.create_variable_for_type_inference(dtype=input.dtype)
+    sample_labels = helper.create_variable_for_type_inference(dtype="int64")
+    helper.append_op(
+        type="nce",
+        inputs={"Input": [input], "Label": [label], "Weight": [w],
+                "Bias": [b]},
+        outputs={"Cost": [cost], "SampleLogits": [sample_logits],
+                 "SampleLabels": [sample_labels]},
+        attrs={"num_neg_samples": num_neg_samples, "seed": seed})
+    cost.shape = (input.shape[0], 1) if input.shape else None
+    return cost
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None, is_custom=False,
+             is_sparse=False):
+    helper = LayerHelper("hsigmoid", **locals())
+    dim = input.shape[-1]
+    w = helper.create_parameter(attr=helper.param_attr,
+                                shape=[num_classes - 1, dim],
+                                dtype=input.dtype)
+    b = helper.create_parameter(attr=helper.bias_attr,
+                                shape=[num_classes - 1, 1],
+                                dtype=input.dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    pre_out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="hierarchical_sigmoid",
+        inputs={"X": [input], "W": [w], "Label": [label], "Bias": [b]},
+        outputs={"Out": [out], "PreOut": [pre_out]},
+        attrs={"num_classes": num_classes})
+    out.shape = (input.shape[0], 1) if input.shape else None
+    return out
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    helper = LayerHelper("crop", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    ins = {"X": [x]}
+    attrs = {}
+    if isinstance(shape, Variable):
+        ins["Y"] = [shape]
+    else:
+        attrs["shape"] = list(shape)
+    if isinstance(offsets, Variable):
+        ins["Offsets"] = [offsets]
+    else:
+        attrs["offsets"] = list(offsets or [0] * len(x.shape))
+    helper.append_op(type="crop", inputs=ins, outputs={"Out": [out]},
+                     attrs=attrs)
+    if not isinstance(shape, Variable):
+        out.shape = tuple(shape)
+    return out
+
+
+def rank(input):
+    """Static rank of a Variable as a 0-d int32 constant
+    (parity: layers/nn.py rank — computed from the compile-time shape)."""
+    from . import tensor as tensor_layers
+    return tensor_layers.fill_constant(
+        shape=[1], dtype="int32", value=len(input.shape))
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    helper = LayerHelper("hash", **locals())
+    out = helper.create_variable_for_type_inference(dtype="int64")
+    helper.append_op(type="hash", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"num_hash": num_hash, "mod_by": hash_size})
+    out.stop_gradient = True
+    return out
+
+
+def fsp_matrix(x, y):
+    helper = LayerHelper("fsp_matrix", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="fsp", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    if x.shape and y.shape:
+        out.shape = (x.shape[0], x.shape[1], y.shape[1])
+    return out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    helper = LayerHelper("row_conv", **locals())
+    d = input.shape[-1]
+    w = helper.create_parameter(attr=helper.param_attr,
+                                shape=[future_context_size + 1, d],
+                                dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type="row_conv",
+                     inputs={"X": [input], "Filter": [w]},
+                     outputs={"Out": [out]})
+    out.shape = input.shape
+    return helper.append_activation(out) if act else out
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+              name=None):
+    helper = LayerHelper("tree_conv", **locals())
+    d = nodes_vector.shape[-1]
+    w = helper.create_parameter(attr=helper.param_attr,
+                                shape=[d, 3, output_size, num_filters],
+                                dtype=nodes_vector.dtype)
+    out = helper.create_variable_for_type_inference(dtype=nodes_vector.dtype)
+    helper.append_op(
+        type="tree_conv",
+        inputs={"NodesVector": [nodes_vector], "EdgeSet": [edge_set],
+                "Filter": [w]},
+        outputs={"Out": [out]}, attrs={"max_depth": max_depth})
+    if nodes_vector.shape:
+        out.shape = (nodes_vector.shape[0], nodes_vector.shape[1],
+                     output_size, num_filters)
+    return out
+
+
+def lod_reset(x, y=None, target_lod=None):
+    """Padded-dense parity of lod_reset: data unchanged, new lengths carried
+    (parity: layers/nn.py lod_reset)."""
+    helper = LayerHelper("lod_reset", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    length = helper.create_variable_for_type_inference(dtype="int32")
+    ins = {"X": [x]}
+    attrs = {}
+    if y is not None:
+        ins["Y"] = [y]
+    elif target_lod is not None:
+        attrs["target_lod"] = list(target_lod)
+    helper.append_op(type="lod_reset", inputs=ins,
+                     outputs={"Out": [out], "Length": [length]}, attrs=attrs)
+    out.shape = x.shape
+    return out
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """Gather rows of x by the rank-table order (parity:
+    layers/control_flow.py:2068; the rank table is an int index Variable in
+    the padded-dense world)."""
+    from . import nn as nn_layers
+    return nn_layers.gather(x, rank_table)
+
+
+def tensor_array_to_tensor(input, axis=1, name=None):
+    """Stack/concat a TensorArray into one Tensor (parity: layers/tensor.py
+    tensor_array_to_tensor)."""
+    from . import tensor as tensor_layers
+    helper = LayerHelper("tensor_array_to_tensor", **locals())
+    out = helper.create_variable_for_type_inference(
+        dtype=input.dtype if hasattr(input, "dtype") else "float32")
+    index = helper.create_variable_for_type_inference(dtype="int32")
+    helper.append_op(type="tensor_array_to_tensor",
+                     inputs={"X": [input]},
+                     outputs={"Out": [out], "OutIndex": [index]},
+                     attrs={"axis": axis})
+    return out, index
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    helper = LayerHelper("get_tensor_from_selected_rows", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="get_tensor_from_selected_rows",
+                     inputs={"X": [x]}, outputs={"Out": [out]})
+    out.shape = x.shape
+    return out
+
+
+def merge_selected_rows(x, name=None):
+    helper = LayerHelper("merge_selected_rows", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="merge_selected_rows", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    out.shape = x.shape
+    return out
+
+
+def continuous_value_model(input, cvm, use_cvm=True):
+    helper = LayerHelper("cvm", **locals())
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type="cvm", inputs={"X": [input], "CVM": [cvm]},
+                     outputs={"Y": [out]}, attrs={"use_cvm": use_cvm})
+    if input.shape:
+        d = input.shape[-1]
+        out.shape = (input.shape[0], d if use_cvm else d - 2)
+    return out
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    helper = LayerHelper("chunk_eval", **locals())
+    mk = lambda dt: helper.create_variable_for_type_inference(dtype=dt)
+    precision, recall, f1 = mk("float32"), mk("float32"), mk("float32")
+    n_inf, n_lab, n_cor = mk("int64"), mk("int64"), mk("int64")
+    ins = {"Inference": [input], "Label": [label]}
+    if seq_length is not None:
+        ins["SeqLength"] = [seq_length]
+    helper.append_op(
+        type="chunk_eval", inputs=ins,
+        outputs={"Precision": [precision], "Recall": [recall],
+                 "F1-Score": [f1], "NumInferChunks": [n_inf],
+                 "NumLabelChunks": [n_lab], "NumCorrectChunks": [n_cor]},
+        attrs={"chunk_scheme": chunk_scheme,
+               "num_chunk_types": num_chunk_types,
+               "excluded_chunk_types": excluded_chunk_types or []})
+    for v in (precision, recall, f1, n_inf, n_lab, n_cor):
+        v.stop_gradient = True
+    return precision, recall, f1, n_inf, n_lab, n_cor
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-python op via jax.pure_callback (parity: layers/nn.py py_func /
+    py_func_op.cc)."""
+    from ..ops.misc_ops import register_py_func
+    helper = LayerHelper("py_func", **locals())
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    func_id = register_py_func(func)
+    helper.append_op(
+        type="py_func", inputs={"X": list(xs)},
+        outputs={"Out": list(outs)},
+        attrs={"func_id": func_id,
+               "out_shapes": [list(o.shape) for o in outs],
+               "out_dtypes": [o.dtype for o in outs]})
+    return out
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, name=None,
+                return_parent_idx=True):
+    """One step of beam search over dense [batch, beam(, K)] tensors
+    (parity: layers/nn.py beam_search; LoD lanes → dense beam axis)."""
+    helper = LayerHelper("beam_search", **locals())
+    sel_ids = helper.create_variable_for_type_inference(dtype="int64")
+    sel_scores = helper.create_variable_for_type_inference(dtype="float32")
+    parent_idx = helper.create_variable_for_type_inference(dtype="int32")
+    helper.append_op(
+        type="beam_search",
+        inputs={"pre_ids": [pre_ids], "pre_scores": [pre_scores],
+                "ids": [ids], "scores": [scores]},
+        outputs={"selected_ids": [sel_ids],
+                 "selected_scores": [sel_scores],
+                 "parent_idx": [parent_idx]},
+        attrs={"beam_size": beam_size, "end_id": end_id, "level": level,
+               "is_accumulated": is_accumulated})
+    for v in (sel_ids, sel_scores, parent_idx):
+        v.stop_gradient = True
+    if return_parent_idx:
+        return sel_ids, sel_scores, parent_idx
+    return sel_ids, sel_scores
+
+
+def beam_search_decode(ids, scores, parents, beam_size=None, end_id=0,
+                       name=None):
+    """Backtrack stacked beam-search steps [T, batch, beam] into sentences
+    (parity: layers/nn.py beam_search_decode)."""
+    helper = LayerHelper("beam_search_decode", **locals())
+    sent_ids = helper.create_variable_for_type_inference(dtype="int64")
+    sent_scores = helper.create_variable_for_type_inference(dtype="float32")
+    helper.append_op(
+        type="beam_search_decode",
+        inputs={"Ids": [ids], "Scores": [scores], "Parents": [parents]},
+        outputs={"SentenceIds": [sent_ids],
+                 "SentenceScores": [sent_scores]},
+        attrs={"end_id": end_id})
+    sent_ids.stop_gradient = True
+    sent_scores.stop_gradient = True
+    return sent_ids, sent_scores

@@ -1,10 +1,15 @@
-"""IO layers (parity: python/paddle/fluid/layers/io.py — `data` :39; the
-reader-op chain py_reader/double_buffer lives in paddle_tpu/reader/).
+"""IO layers (parity: python/paddle/fluid/layers/io.py — `data` :39,
+`py_reader` :643, double_buffer/batch/shuffle/open_files/read_file; the
+feed machinery lives in paddle_tpu/reader/).
 """
+
+import numpy as np
 
 from ..framework import convert_dtype, default_main_program, default_startup_program
 
-__all__ = ["data"]
+__all__ = ["data", "py_reader", "create_py_reader_by_data", "read_file",
+           "double_buffer", "batch", "shuffle", "open_files",
+           "random_data_generator", "load"]
 
 
 def data(name, shape, dtype="float32", lod_level=0, type=None,
@@ -29,3 +34,195 @@ def data(name, shape, dtype="float32", lod_level=0, type=None,
         sb.create_var(name=name, shape=shape, dtype=convert_dtype(dtype),
                       lod_level=lod_level, is_data=True, stop_gradient=True)
     return var
+
+
+class _GraphReader:
+    """Reader variable stand-in (the reference materializes readers as
+    Variables holding a ReaderHolder — operators/reader/; here a reader is a
+    host-side pipeline object bound to declared data slots)."""
+
+    def __init__(self, data_vars, reader_fn=None, capacity=64,
+                 use_double_buffer=True):
+        from ..reader import PyReader
+
+        self.data_vars = list(data_vars)
+        self._pyreader = PyReader(feed_list=self.data_vars,
+                                  capacity=capacity,
+                                  use_double_buffer=use_double_buffer)
+        # sample-level source (open_files/random_data_generator); wired
+        # lazily at iteration time so batch()/shuffle() decorators added
+        # after construction still apply
+        self._reader_fn = reader_fn
+        self._decorators = []
+        self._wired = False
+
+    # Fluid PyReader-style control surface
+    def decorate_sample_list_generator(self, generator, places=None):
+        self._pyreader.decorate_sample_list_generator(
+            self._apply_decorators(generator), places)
+        self._wired = True
+
+    decorate_paddle_reader = decorate_sample_list_generator
+
+    def decorate_batch_generator(self, generator, places=None):
+        self._pyreader.decorate_batch_generator(
+            self._apply_decorators(generator, normalize=False), places)
+        self._wired = True
+
+    def decorate_tensor_provider(self, generator, places=None):
+        self.decorate_batch_generator(generator, places)
+
+    def _apply_decorators(self, generator, normalize=True):
+        g = generator
+        for deco in self._decorators:
+            g = deco(g)
+        if not normalize:
+            return g
+
+        # DataFeeder.feed consumes a LIST of sample tuples per iteration;
+        # batch() yields lists already, raw sample readers yield tuples —
+        # normalize the un-batched case to single-sample batches
+        def normalized():
+            for item in g():
+                yield item if isinstance(item, list) else [item]
+
+        return normalized
+
+    def _wire(self):
+        if not self._wired:
+            if self._reader_fn is None:
+                raise RuntimeError(
+                    "reader has no data source; call "
+                    "decorate_sample_list_generator/decorate_batch_generator")
+            self.decorate_sample_list_generator(self._reader_fn)
+
+    def start(self):
+        self._wire()
+        self._pyreader.start()
+
+    def reset(self):
+        self._pyreader.reset()
+
+    def __iter__(self):
+        self._wire()
+        return iter(self._pyreader)
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """Declare a feed pipeline + its data slots (parity: layers/io.py:643).
+    Returns a reader; get its variables with `read_file(reader)`."""
+    from .. import unique_name
+
+    vars_ = []
+    for i, (shape, dtype) in enumerate(zip(shapes, dtypes)):
+        vname = unique_name.generate("%s_slot_%d" % (name or "py_reader", i))
+        lead_batch = shape[0] in (-1, None)
+        vars_.append(data(vname,
+                          list(shape)[1:] if lead_batch else list(shape),
+                          dtype=dtype, append_batch_size=lead_batch))
+    return _GraphReader(vars_, capacity=capacity,
+                        use_double_buffer=use_double_buffer)
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    """py_reader over pre-declared data Variables (layers/io.py parity)."""
+    return _GraphReader(feed_list, capacity=capacity,
+                        use_double_buffer=use_double_buffer)
+
+
+def read_file(reader):
+    """Unpack a reader's data Variables (parity: layers/io.py read_file)."""
+    vars_ = reader.data_vars
+    return vars_[0] if len(vars_) == 1 else list(vars_)
+
+
+def double_buffer(reader, place=None, name=None):
+    """Async H2D staging is PyReader's default; this marks it explicitly
+    (parity: layers/io.py double_buffer / buffered_reader.cc)."""
+    reader._pyreader._use_double_buffer = True
+    return reader
+
+
+def batch(reader, batch_size):
+    """Batch a sample-level reader in-graph (parity: layers/io.py batch)."""
+    from .. import reader as reader_mod
+
+    reader._decorators.append(
+        lambda g: reader_mod.batch(g, batch_size=batch_size))
+    return reader
+
+
+def shuffle(reader, buffer_size):
+    """Shuffle decorator on a reader variable (parity: layers/io.py)."""
+    from .. import reader as reader_mod
+
+    reader._decorators.append(
+        lambda g: reader_mod.shuffle(g, buf_size=buffer_size))
+    return reader
+
+
+def open_files(filenames, shapes, lod_levels=None, dtypes=None,
+               thread_num=1, buffer_size=None, pass_num=1,
+               is_test=False):
+    """Reader over recordio shard files (parity: layers/io.py open_files).
+    Records are decoded by the recordio bridge (native/recordio.cc)."""
+    from .. import unique_name
+    from ..recordio_writer import recordio_reader_creator
+
+    if isinstance(filenames, str):
+        filenames = [filenames]
+    dtypes = dtypes or ["float32"] * len(shapes)
+    vars_ = []
+    for i, (shape, dtype) in enumerate(zip(shapes, dtypes)):
+        vname = unique_name.generate("open_files_slot_%d" % i)
+        lead_batch = shape[0] in (-1, None)
+        vars_.append(data(vname,
+                          list(shape)[1:] if lead_batch else list(shape),
+                          dtype=dtype, append_batch_size=lead_batch))
+
+    def gen():
+        for _ in range(pass_num):
+            for fname in filenames:
+                for sample in recordio_reader_creator(fname)():
+                    yield sample
+
+    return _GraphReader(vars_, reader_fn=gen)
+
+
+def random_data_generator(low, high, shapes, lod_levels=None, for_parallel=True):
+    """Uniform-random in-graph data source (parity: layers/io.py
+    random_data_generator — used to drive tests without real IO)."""
+    from .. import unique_name
+
+    vars_ = []
+    for i, shape in enumerate(shapes):
+        vname = unique_name.generate("random_data_slot_%d" % i)
+        lead_batch = shape[0] in (-1, None)
+        vars_.append(data(vname,
+                          list(shape)[1:] if lead_batch else list(shape),
+                          dtype="float32", append_batch_size=lead_batch))
+
+    rng = np.random.RandomState(0)
+
+    def gen():
+        while True:
+            yield tuple(rng.uniform(low, high,
+                                    size=[abs(d) for d in s]).astype("float32")
+                        for s in shapes)
+
+    return _GraphReader(vars_, reader_fn=gen)
+
+
+def load(out, file_path, load_as_fp16=None):
+    """Load a saved variable's value into `out` (parity: layers/io.py load /
+    load_op.cc). The value is read eagerly into the global scope, which is
+    where lowering picks up persistable values."""
+    from ..core.scope import global_scope
+
+    value = np.load(file_path)
+    if load_as_fp16:
+        value = value.astype(np.float16)
+    global_scope().set(out.name, value)
+    return out

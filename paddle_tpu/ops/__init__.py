@@ -16,3 +16,4 @@ from . import sequence_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import metric_ops  # noqa: F401
 from . import controlflow  # noqa: F401
+from . import misc_ops  # noqa: F401

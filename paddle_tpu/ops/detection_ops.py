@@ -621,3 +621,338 @@ def _roi_pool(ctx, ins, attrs):
 
     out = jax.vmap(one_roi)(rois, batch_ids)
     return {"Out": [out], "Argmax": [jnp.zeros(out.shape, jnp.int32)]}
+
+
+# ---------------------------------------------------------------------------
+# RCNN training target assignment + FPN routing (operators/detection/
+# rpn_target_assign_op.cc, generate_proposal_labels_op.cc,
+# generate_mask_labels_op.cc, collect_fpn_proposals_op.cc,
+# distribute_fpn_proposals_op.cc, box_decoder_and_assign_op.cc,
+# psroi_pool_op.cc, roi_perspective_transform_op.cc).
+#
+# TPU-native contract: the reference emits dynamically-sized sampled index
+# lists (LoD); here every output is fixed-size — sampling pads to the
+# configured quota and companion weight outputs zero out the padding, so
+# XLA sees static shapes.
+# ---------------------------------------------------------------------------
+
+
+def _topk_mask_indices(key, mask, k):
+    """Indices of up to k true entries of `mask` (random order), padded by
+    repeating the first picked index. Returns (idx [k], valid [k])."""
+    noise = jax.random.uniform(key, mask.shape)
+    score = jnp.where(mask, 1.0 + noise, noise - 2.0)
+    kk = min(k, mask.shape[0])
+    _, idx = jax.lax.top_k(score, kk)
+    valid = jnp.take(mask, idx)
+    if kk < k:  # quota exceeds candidate count: pad (never valid)
+        idx = jnp.concatenate([idx, jnp.zeros((k - kk,), idx.dtype)])
+        valid = jnp.concatenate([valid, jnp.zeros((k - kk,), bool)])
+    first = idx[0]
+    idx = jnp.where(valid, idx, first)
+    return idx.astype(jnp.int32), valid
+
+
+@register("rpn_target_assign", differentiable=False, stateful=True)
+def _rpn_target_assign(ctx, ins, attrs):
+    anchors = ins["Anchor"][0].reshape((-1, 4))
+    gt = ins["GtBoxes"][0].reshape((-1, 4))
+    batch = attrs.get("rpn_batch_size_per_im", 256)
+    fg_frac = attrs.get("rpn_fg_fraction", 0.5)
+    pos_thr = attrs.get("rpn_positive_overlap", 0.7)
+    neg_thr = attrs.get("rpn_negative_overlap", 0.3)
+    fg_max = int(batch * fg_frac)
+    A = anchors.shape[0]
+
+    iou = _iou_matrix(anchors, gt)           # [A, G]
+    best_gt = jnp.argmax(iou, axis=1)        # [A]
+    best_iou = jnp.max(iou, axis=1)
+    # anchors with best overlap per gt are fg regardless of threshold
+    per_gt_best = jnp.max(iou, axis=0)       # [G]
+    is_best_of_gt = jnp.any(
+        (iou == per_gt_best[None, :]) & (per_gt_best[None, :] > 0), axis=1)
+    fg_mask = (best_iou >= pos_thr) | is_best_of_gt
+    bg_mask = (best_iou < neg_thr) & ~fg_mask
+
+    k1, k2 = jax.random.split(ctx.rng(attrs))
+    fg_idx, fg_valid = _topk_mask_indices(k1, fg_mask, fg_max)
+    bg_idx, bg_valid = _topk_mask_indices(k2, bg_mask, batch - fg_max)
+
+    score_idx = jnp.concatenate([fg_idx, bg_idx])
+    score_valid = jnp.concatenate([fg_valid, bg_valid])
+    labels = jnp.concatenate([
+        jnp.where(fg_valid, 1, -1), jnp.where(bg_valid, 0, -1)])
+
+    matched = gt[best_gt[fg_idx]]            # [fg_max, 4]
+    src = anchors[fg_idx]
+    # encode regression targets the standard RCNN way
+    sw, sh = src[:, 2] - src[:, 0], src[:, 3] - src[:, 1]
+    sx, sy = src[:, 0] + sw * 0.5, src[:, 1] + sh * 0.5
+    gw, gh = matched[:, 2] - matched[:, 0], matched[:, 3] - matched[:, 1]
+    gx, gy = matched[:, 0] + gw * 0.5, matched[:, 1] + gh * 0.5
+    tgt = jnp.stack([(gx - sx) / jnp.maximum(sw, 1e-6),
+                     (gy - sy) / jnp.maximum(sh, 1e-6),
+                     jnp.log(jnp.maximum(gw, 1e-6) / jnp.maximum(sw, 1e-6)),
+                     jnp.log(jnp.maximum(gh, 1e-6) / jnp.maximum(sh, 1e-6))],
+                    axis=1)
+    inside_w = jnp.where(fg_valid[:, None], 1.0, 0.0) * jnp.ones((1, 4))
+    return {"LocationIndex": [fg_idx],
+            "ScoreIndex": [score_idx],
+            "TargetLabel": [labels[:, None].astype(jnp.int32)],
+            "TargetBBox": [tgt],
+            "BBoxInsideWeight": [inside_w],
+            "ScoreValid": [score_valid]}
+
+
+@register("generate_proposal_labels", differentiable=False, stateful=True)
+def _generate_proposal_labels(ctx, ins, attrs):
+    rois = ins["RpnRois"][0].reshape((-1, 4))
+    gt_boxes = ins["GtBoxes"][0].reshape((-1, 4))
+    gt_classes = ins["GtClasses"][0].reshape((-1,)).astype(jnp.int32)
+    batch = attrs.get("batch_size_per_im", 512)
+    fg_frac = attrs.get("fg_fraction", 0.25)
+    fg_thr = attrs.get("fg_thresh", 0.5)
+    bg_hi = attrs.get("bg_thresh_hi", 0.5)
+    bg_lo = attrs.get("bg_thresh_lo", 0.0)
+    fg_max = int(batch * fg_frac)
+
+    # gt boxes join the candidate pool, as in the reference
+    cand = jnp.concatenate([rois, gt_boxes], axis=0)
+    iou = _iou_matrix(cand, gt_boxes)
+    best_gt = jnp.argmax(iou, axis=1)
+    best_iou = jnp.max(iou, axis=1)
+    fg_mask = best_iou >= fg_thr
+    bg_mask = (best_iou < bg_hi) & (best_iou >= bg_lo)
+
+    k1, k2 = jax.random.split(ctx.rng(attrs))
+    fg_idx, fg_valid = _topk_mask_indices(k1, fg_mask, fg_max)
+    bg_idx, bg_valid = _topk_mask_indices(k2, bg_mask, batch - fg_max)
+    sel = jnp.concatenate([fg_idx, bg_idx])
+    valid = jnp.concatenate([fg_valid, bg_valid])
+
+    sel_rois = cand[sel]
+    labels = jnp.where(
+        jnp.concatenate([fg_valid, jnp.zeros_like(bg_valid)]),
+        gt_classes[best_gt[sel]], 0)
+    matched = gt_boxes[best_gt[sel]]
+    sw, sh = (sel_rois[:, 2] - sel_rois[:, 0],
+              sel_rois[:, 3] - sel_rois[:, 1])
+    sx, sy = sel_rois[:, 0] + sw * 0.5, sel_rois[:, 1] + sh * 0.5
+    gw, gh = matched[:, 2] - matched[:, 0], matched[:, 3] - matched[:, 1]
+    gx, gy = matched[:, 0] + gw * 0.5, matched[:, 1] + gh * 0.5
+    tgt = jnp.stack([(gx - sx) / jnp.maximum(sw, 1e-6),
+                     (gy - sy) / jnp.maximum(sh, 1e-6),
+                     jnp.log(jnp.maximum(gw, 1e-6) / jnp.maximum(sw, 1e-6)),
+                     jnp.log(jnp.maximum(gh, 1e-6) / jnp.maximum(sh, 1e-6))],
+                    axis=1)
+    is_fg = jnp.concatenate([fg_valid, jnp.zeros_like(bg_valid)])
+    w_in = jnp.where(is_fg[:, None], 1.0, 0.0) * jnp.ones((1, 4))
+    return {"Rois": [sel_rois],
+            "LabelsInt32": [labels[:, None]],
+            "BboxTargets": [tgt * w_in],
+            "BboxInsideWeights": [w_in],
+            "BboxOutsideWeights": [jnp.where(valid[:, None], 1.0, 0.0)
+                                   * jnp.ones((1, 4))]}
+
+
+@register("generate_mask_labels", differentiable=False)
+def _generate_mask_labels(ctx, ins, attrs):
+    """Mask targets from dense gt masks. TPU-native contract: GtSegms is a
+    dense bitmap [G, Hm, Wm] per gt box (polygon rasterization happens in
+    the host pipeline); each fg roi crops+resizes its matched gt mask to
+    resolution^2 (generate_mask_labels_op.cc)."""
+    rois = ins["Rois"][0].reshape((-1, 4))
+    gt_masks = ins["GtSegms"][0]          # [G, Hm, Wm] {0,1}
+    labels = ins["LabelsInt32"][0].reshape((-1,)).astype(jnp.int32)
+    res = attrs.get("resolution", 14)
+    G, Hm, Wm = gt_masks.shape
+    if ins.get("GtBoxes"):
+        gt_boxes = ins["GtBoxes"][0].reshape((-1, 4))
+    else:
+        # derive each gt's box from its mask extent
+        ys = jnp.any(gt_masks > 0, axis=2)   # [G, Hm]
+        xs = jnp.any(gt_masks > 0, axis=1)   # [G, Wm]
+        yi = jnp.arange(Hm)[None, :]
+        xi = jnp.arange(Wm)[None, :]
+        y1 = jnp.min(jnp.where(ys, yi, Hm), axis=1).astype(jnp.float32)
+        y2 = jnp.max(jnp.where(ys, yi + 1, 0), axis=1).astype(jnp.float32)
+        x1 = jnp.min(jnp.where(xs, xi, Wm), axis=1).astype(jnp.float32)
+        x2 = jnp.max(jnp.where(xs, xi + 1, 0), axis=1).astype(jnp.float32)
+        gt_boxes = jnp.stack([x1, y1, x2, y2], axis=1)
+
+    iou = _iou_matrix(rois, gt_boxes)
+    best_gt = jnp.argmax(iou, axis=1)
+
+    # masks live in image pixel space ([Hm, Wm] = image grid); crop the
+    # matched gt mask over the roi rectangle and resize to res×res by
+    # nearest sampling (the reference rasterizes polygons to the same grid)
+    def one(roi, g):
+        mask = gt_masks[g]
+        t = (jnp.arange(res) + 0.5) / res
+        ys = roi[1] + t * (roi[3] - roi[1])
+        xs = roi[0] + t * (roi[2] - roi[0])
+        patch = mask[jnp.clip(ys.astype(jnp.int32), 0, Hm - 1)][
+            :, jnp.clip(xs.astype(jnp.int32), 0, Wm - 1)]
+        return patch
+
+    masks = jax.vmap(one)(rois, best_gt)
+    masks = masks * (labels > 0)[:, None, None]
+    return {"MaskRois": [rois], "RoiHasMaskInt32": [(labels > 0)[:, None]
+                                                    .astype(jnp.int32)],
+            "MaskInt32": [masks.astype(jnp.int32)]}
+
+
+@register("collect_fpn_proposals", differentiable=False)
+def _collect_fpn_proposals(ctx, ins, attrs):
+    rois_list = ins["MultiLevelRois"]     # list of [Ni, 4]
+    scores_list = ins["MultiLevelScores"]  # list of [Ni, 1]
+    post_nms_topn = attrs.get("post_nms_topN", 100)
+    rois = jnp.concatenate([r.reshape((-1, 4)) for r in rois_list], axis=0)
+    scores = jnp.concatenate([s.reshape((-1,)) for s in scores_list], axis=0)
+    k = min(post_nms_topn, rois.shape[0])
+    top_s, top_i = jax.lax.top_k(scores, k)
+    return {"FpnRois": [rois[top_i]], "RoisNum": [jnp.array([k], jnp.int32)]}
+
+
+@register("distribute_fpn_proposals", differentiable=False)
+def _distribute_fpn_proposals(ctx, ins, attrs):
+    rois = ins["FpnRois"][0].reshape((-1, 4))
+    min_level = attrs.get("min_level", 2)
+    max_level = attrs.get("max_level", 5)
+    refer_level = attrs.get("refer_level", 4)
+    refer_scale = attrs.get("refer_scale", 224)
+    N = rois.shape[0]
+    w = rois[:, 2] - rois[:, 0]
+    h = rois[:, 3] - rois[:, 1]
+    scale = jnp.sqrt(jnp.maximum(w * h, 1e-6))
+    lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-6)) + refer_level
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+
+    outs = []
+    for L in range(min_level, max_level + 1):
+        m = (lvl == L).astype(rois.dtype)[:, None]
+        outs.append(rois * m)  # static shape: non-members zeroed
+    # restore index for the zero-masked layout above: concat(MultiFpnRois)
+    # keeps every roi at row (level - min_level) * N + original_position
+    restore = ((lvl - min_level) * N
+               + jnp.arange(N, dtype=jnp.int32)).astype(jnp.int32)
+    return {"MultiFpnRois": outs, "RestoreIndex": [restore[:, None]],
+            "LevelIndex": [lvl[:, None]]}
+
+
+@register("box_decoder_and_assign", differentiable=False)
+def _box_decoder_and_assign(ctx, ins, attrs):
+    prior = ins["PriorBox"][0].reshape((-1, 4))       # [N, 4]
+    prior_var = ins["PriorBoxVar"][0].reshape((-1, 4))
+    deltas = ins["TargetBox"][0]                      # [N, C*4]
+    scores = ins["BoxScore"][0]                       # [N, C]
+    box_clip = attrs.get("box_clip", 4.135)
+    N = prior.shape[0]
+    C = scores.shape[1]
+    d = deltas.reshape((N, C, 4))
+
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    px = prior[:, 0] + pw * 0.5
+    py = prior[:, 1] + ph * 0.5
+    dx = d[..., 0] * prior_var[:, None, 0]
+    dy = d[..., 1] * prior_var[:, None, 1]
+    dw = jnp.clip(d[..., 2] * prior_var[:, None, 2], -box_clip, box_clip)
+    dh = jnp.clip(d[..., 3] * prior_var[:, None, 3], -box_clip, box_clip)
+    cx = px[:, None] + dx * pw[:, None]
+    cy = py[:, None] + dy * ph[:, None]
+    w = jnp.exp(dw) * pw[:, None]
+    h = jnp.exp(dh) * ph[:, None]
+    decoded = jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                         cx + w * 0.5 - 1, cy + h * 0.5 - 1], axis=-1)
+    best = jnp.argmax(scores, axis=1)
+    assigned = jnp.take_along_axis(
+        decoded, best[:, None, None].astype(jnp.int32)
+        * jnp.ones((1, 1, 4), jnp.int32), axis=1)[:, 0]
+    return {"DecodeBox": [decoded.reshape((N, C * 4))],
+            "OutputAssignBox": [assigned]}
+
+
+@register("psroi_pool")
+def _psroi_pool(ctx, ins, attrs):
+    """Position-sensitive RoI average pooling (psroi_pool_op.cc): channel
+    group (i, j) feeds output bin (i, j)."""
+    x = ins["X"][0]                      # [N, C*Ph*Pw, H, W]
+    rois = ins["ROIs"][0].reshape((-1, 4))
+    out_c = attrs.get("output_channels")
+    Ph = attrs.get("pooled_height", 7)
+    Pw = attrs.get("pooled_width", Ph)
+    scale = attrs.get("spatial_scale", 1.0)
+    batch_ids = (ins["BatchId"][0].reshape(-1).astype(jnp.int32)
+                 if ins.get("BatchId")
+                 else jnp.zeros((rois.shape[0],), jnp.int32))
+    N, Ctot, H, W = x.shape
+    S = 2  # sub-samples per bin edge
+
+    def one(roi, bid):
+        x1, y1 = roi[0] * scale, roi[1] * scale
+        x2, y2 = roi[2] * scale, roi[3] * scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        ty = (jnp.arange(Ph * S) + 0.5) / (Ph * S)
+        tx = (jnp.arange(Pw * S) + 0.5) / (Pw * S)
+        gy = jnp.clip((y1 + ty * rh).astype(jnp.int32), 0, H - 1)
+        gx = jnp.clip((x1 + tx * rw).astype(jnp.int32), 0, W - 1)
+        patch = x[bid][:, gy][:, :, gx]              # [C*Ph*Pw, PhS, PwS]
+        pooled = patch.reshape(Ctot, Ph, S, Pw, S).mean(axis=(2, 4))
+        pooled = pooled.reshape(out_c, Ph, Pw, Ph, Pw)
+        # dims (c, group_i, group_j, bin_i, bin_j): bin (i,j) reads its own
+        # channel group (i,j)
+        ii = jnp.arange(Ph)[:, None]
+        jj = jnp.arange(Pw)[None, :]
+        return pooled[:, ii, jj, ii, jj]
+
+    out = jax.vmap(one)(rois, batch_ids)
+    return {"Out": [out]}
+
+
+@register("roi_perspective_transform")
+def _roi_perspective_transform(ctx, ins, attrs):
+    """Warp quadrilateral rois ([x1..y4] 8 coords) to a fixed H×W patch by
+    bilinear sampling along the quad's bilinear surface
+    (roi_perspective_transform_op.cc)."""
+    x = ins["X"][0]                       # [N, C, H, W]
+    rois = ins["ROIs"][0].reshape((-1, 8))
+    oh = attrs.get("transformed_height", 8)
+    ow = attrs.get("transformed_width", 8)
+    scale = attrs.get("spatial_scale", 1.0)
+    batch_ids = (ins["BatchId"][0].reshape(-1).astype(jnp.int32)
+                 if ins.get("BatchId")
+                 else jnp.zeros((rois.shape[0],), jnp.int32))
+    N, C, H, W = x.shape
+
+    def one(roi, bid):
+        # corners in clockwise order (x1,y1)=(top-left) ... (x4,y4)=bottom-left
+        tl = roi[0:2] * scale
+        tr = roi[2:4] * scale
+        br = roi[4:6] * scale
+        bl = roi[6:8] * scale
+        u = (jnp.arange(ow) + 0.5) / ow
+        v = (jnp.arange(oh) + 0.5) / oh
+        top = tl[None, :] + u[:, None] * (tr - tl)[None, :]   # [ow, 2]
+        bot = bl[None, :] + u[:, None] * (br - bl)[None, :]
+        pts = top[None, :, :] + v[:, None, None] * (bot - top)[None, :, :]
+        px, py = pts[..., 0], pts[..., 1]                     # [oh, ow]
+        x0 = jnp.clip(jnp.floor(px).astype(jnp.int32), 0, W - 1)
+        y0 = jnp.clip(jnp.floor(py).astype(jnp.int32), 0, H - 1)
+        x1_ = jnp.clip(x0 + 1, 0, W - 1)
+        y1_ = jnp.clip(y0 + 1, 0, H - 1)
+        fx = jnp.clip(px - x0, 0.0, 1.0)
+        fy = jnp.clip(py - y0, 0.0, 1.0)
+        img = x[bid]                                          # [C, H, W]
+        v00 = img[:, y0, x0]
+        v01 = img[:, y0, x1_]
+        v10 = img[:, y1_, x0]
+        v11 = img[:, y1_, x1_]
+        return (v00 * (1 - fx) * (1 - fy) + v01 * fx * (1 - fy)
+                + v10 * (1 - fx) * fy + v11 * fx * fy)
+
+    out = jax.vmap(one)(rois, batch_ids)
+    mask = jnp.ones((rois.shape[0], 1, oh, ow), jnp.int32)
+    return {"Out": [out], "Mask": [mask],
+            "TransformMatrix": [jnp.zeros((rois.shape[0], 9), x.dtype)]}

@@ -1,0 +1,686 @@
+"""Misc op corpus: CRF, CTC, sampled losses, beam search, hashing, tree/row
+conv, chunk metrics (parity: operators/linear_chain_crf_op.cc,
+crf_decoding_op.cc, ctc_align_op.cc, edit_distance_op.cc, warpctc_op.cc,
+nce_op.cc, hierarchical_sigmoid_op.cc, crop_op.cc, hash_op.cc, fsp_op.cc,
+row_conv_op.cc, tree_conv_op.cc, beam_search_op.cc, beam_search_decode_op.cc,
+chunk_eval_op.cc, cvm_op.cc, merge_selected_rows_op.cc,
+get_tensor_from_selected_rows_op.cc, py_func_op.cc — SURVEY Appendix A).
+
+TPU-native conventions: ragged LoD inputs become padded-dense [B, T, ...]
+with an optional integer Length input; dynamic-programming recurrences
+(CRF forward, Viterbi, CTC, edit distance) are lax.scan loops over the
+time axis so XLA compiles them as single fused loops.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+
+_NEG = -1e30
+
+
+def _lengths(ins, B, T, slot="Length"):
+    if ins.get(slot):
+        return ins[slot][0].reshape((-1,)).astype(jnp.int32)
+    return jnp.full((B,), T, jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# linear-chain CRF (linear_chain_crf_op.cc / crf_decoding_op.cc)
+# ---------------------------------------------------------------------------
+# Transition layout matches the reference: row 0 = start weights, row 1 =
+# stop weights, rows 2..C+1 = transition[i][j] score of i -> j.
+
+
+def _crf_unpack(transition):
+    start, stop, trans = transition[0], transition[1], transition[2:]
+    return start, stop, trans
+
+
+@register("linear_chain_crf", nondiff_inputs=("Label", "Length"))
+def _linear_chain_crf(ctx, ins, attrs):
+    em = ins["Emission"][0]          # [B, T, C] unnormalized emission scores
+    transition = ins["Transition"][0]  # [C+2, C]
+    label = ins["Label"][0].reshape(em.shape[:2]).astype(jnp.int32)  # [B, T]
+    B, T, C = em.shape
+    lens = _lengths(ins, B, T)
+    start, stop, trans = _crf_unpack(transition)
+    em = em.astype(jnp.float32)
+
+    t_idx = jnp.arange(T)
+    valid = (t_idx[None, :] < lens[:, None])  # [B, T]
+
+    # --- log partition via forward algorithm (alpha recursion) ---
+    alpha0 = start[None, :] + em[:, 0]  # [B, C]
+
+    def fwd(alpha, xs):
+        e_t, valid_t = xs  # [B, C], [B]
+        nxt = jax.nn.logsumexp(alpha[:, :, None] + trans[None, :, :], axis=1)
+        nxt = nxt + e_t
+        alpha = jnp.where(valid_t[:, None], nxt, alpha)
+        return alpha, alpha
+
+    alphaT, alphas = jax.lax.scan(
+        fwd, alpha0, (jnp.swapaxes(em, 0, 1)[1:], jnp.swapaxes(valid, 0, 1)[1:]))
+    logZ = jax.nn.logsumexp(alphaT + stop[None, :], axis=1)  # [B]
+
+    # --- score of the gold path ---
+    emit_score = jnp.sum(
+        jnp.take_along_axis(em, label[:, :, None], axis=2)[..., 0]
+        * valid.astype(jnp.float32), axis=1)
+    prev, nxt = label[:, :-1], label[:, 1:]
+    trans_score = jnp.sum(
+        trans[prev, nxt] * valid[:, 1:].astype(jnp.float32), axis=1)
+    last = jnp.take_along_axis(
+        label, jnp.maximum(lens - 1, 0)[:, None], axis=1)[:, 0]
+    path = emit_score + trans_score + start[label[:, 0]] + stop[last]
+
+    ll = (path - logZ)[:, None]  # log-likelihood [B, 1]
+    alpha_full = jnp.concatenate([alpha0[:, None], jnp.swapaxes(alphas, 0, 1)],
+                                 axis=1)
+    return {"Alpha": [alpha_full], "EmissionExps": [jnp.exp(em)],
+            "TransitionExps": [jnp.exp(transition.astype(jnp.float32))],
+            "LogLikelihood": [ll]}
+
+
+@register("crf_decoding", differentiable=False,
+          nondiff_inputs=("Emission", "Transition", "Label", "Length"))
+def _crf_decoding(ctx, ins, attrs):
+    em = ins["Emission"][0].astype(jnp.float32)  # [B, T, C]
+    transition = ins["Transition"][0].astype(jnp.float32)
+    B, T, C = em.shape
+    lens = _lengths(ins, B, T)
+    start, stop, trans = _crf_unpack(transition)
+    valid = (jnp.arange(T)[None, :] < lens[:, None])
+
+    # Viterbi forward keeping backpointers
+    delta0 = start[None, :] + em[:, 0]
+
+    def vit(delta, xs):
+        e_t, valid_t = xs
+        cand = delta[:, :, None] + trans[None, :, :]     # [B, C_prev, C]
+        best = jnp.max(cand, axis=1) + e_t
+        bp = jnp.argmax(cand, axis=1).astype(jnp.int32)  # [B, C]
+        new = jnp.where(valid_t[:, None], best, delta)
+        bp = jnp.where(valid_t[:, None], bp, jnp.arange(C)[None, :])
+        return new, bp
+
+    deltaT, bps = jax.lax.scan(
+        vit, delta0,
+        (jnp.swapaxes(em, 0, 1)[1:], jnp.swapaxes(valid, 0, 1)[1:]))
+    lastmax = jnp.argmax(deltaT + stop[None, :], axis=1).astype(jnp.int32)
+
+    # backward pass: walk backpointers from each sequence's last position
+    def back(state, bp_t):
+        cur, t = state  # cur [B], t scalar index into bps (reversed walk)
+        prev = jnp.take_along_axis(bp_t, cur[:, None], axis=1)[:, 0]
+        # only move the pointer for rows where t < len-1 (inside the seq)
+        cur = jnp.where(t < lens - 1, prev, cur)
+        return (cur, t - 1), cur
+
+    (_, _), rev_path = jax.lax.scan(
+        back, (lastmax, jnp.full((), T - 2)), bps, reverse=True)
+    path = jnp.concatenate(
+        [jnp.swapaxes(rev_path, 0, 1), lastmax[:, None]], axis=1)  # [B, T]
+    path = jnp.where(valid, path, 0)
+
+    if ins.get("Label"):
+        lab = ins["Label"][0].reshape((B, T)).astype(jnp.int32)
+        # parity: with Label given, emit 1 where prediction is correct
+        out = (path == lab).astype(jnp.int64) * valid.astype(jnp.int64)
+        return {"ViterbiPath": [out[..., None]]}
+    return {"ViterbiPath": [path[..., None].astype(jnp.int64)]}
+
+
+# ---------------------------------------------------------------------------
+# CTC: greedy decode, edit distance, warpctc loss
+# ---------------------------------------------------------------------------
+
+
+@register("ctc_align", differentiable=False, nondiff_inputs=("Input",))
+def _ctc_align(ctx, ins, attrs):
+    """Greedy CTC decode: merge repeats then drop blanks. Output is padded
+    with -1 (the dense stand-in for the reference's LoD output)."""
+    ids = ins["Input"][0].astype(jnp.int32)  # [B, T] argmax'd ids
+    blank = attrs.get("blank", 0)
+    B, T = ids.shape
+    prev = jnp.concatenate([jnp.full((B, 1), -1, jnp.int32), ids[:, :-1]], axis=1)
+    keep = (ids != prev) & (ids != blank)
+    if ins.get("Length"):
+        lens = _lengths(ins, B, T)
+        keep = keep & (jnp.arange(T)[None, :] < lens[:, None])
+    # stable left-compaction of kept ids
+    pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    out = jnp.full((B, T), -1, jnp.int32)
+    bidx = jnp.repeat(jnp.arange(B)[:, None], T, axis=1)
+    out = out.at[bidx, jnp.where(keep, pos, T - 1)].set(
+        jnp.where(keep, ids, -1), mode="drop")
+    out_lens = jnp.sum(keep.astype(jnp.int32), axis=1)
+    return {"Output": [out], "OutputLength": [out_lens[:, None]]}
+
+
+@register("edit_distance", differentiable=False,
+          nondiff_inputs=("Hyps", "Refs", "HypsLength", "RefsLength"))
+def _edit_distance(ctx, ins, attrs):
+    """Levenshtein distance, batched. DP over the ref axis as a lax.scan;
+    pad token rows are neutralized via the Length inputs."""
+    hyp = ins["Hyps"][0].astype(jnp.int32)
+    ref = ins["Refs"][0].astype(jnp.int32)
+    if hyp.ndim == 3:
+        hyp = hyp[..., 0]
+    if ref.ndim == 3:
+        ref = ref[..., 0]
+    B, Th = hyp.shape
+    Tr = ref.shape[1]
+    hlens = _lengths(ins, B, Th, "HypsLength")
+    rlens = _lengths(ins, B, Tr, "RefsLength")
+
+    row0 = jnp.broadcast_to(jnp.arange(Th + 1, dtype=jnp.float32), (B, Th + 1))
+
+    def step(row, xs):
+        r_tok, i = xs  # ref token [B], row index (1-based)
+        inside = (i <= rlens).astype(jnp.float32)  # [B]
+        sub = (hyp != r_tok[:, None]).astype(jnp.float32)  # [B, Th]
+        # new[0] = i; new[j] = min(row[j]+1, new[j-1]+1, row[j-1]+sub)
+        # the left-to-right dependency is itself a scan over Th
+        def inner(left, xs2):
+            up, diag, s = xs2  # [B] each
+            val = jnp.minimum(jnp.minimum(up + 1.0, left + 1.0), diag + s)
+            return val, val
+
+        _, tail = jax.lax.scan(
+            inner, jnp.full((B,), i, jnp.float32),
+            (row[:, 1:].T, row[:, :-1].T, sub.T))
+        new = jnp.concatenate([jnp.full((B, 1), i, jnp.float32), tail.T], axis=1)
+        row = jnp.where(inside[:, None] > 0, new, row)
+        return row, None
+
+    row, _ = jax.lax.scan(
+        step, row0,
+        (ref.T, jnp.arange(1, Tr + 1, dtype=jnp.float32)))
+    dist = jnp.take_along_axis(row, hlens[:, None], axis=1)[:, 0]
+    if attrs.get("normalized", True):
+        dist = dist / jnp.maximum(rlens.astype(jnp.float32), 1.0)
+    seq_num = jnp.array([B], jnp.int64)
+    return {"Out": [dist[:, None]], "SequenceNum": [seq_num]}
+
+
+@register("warpctc", nondiff_inputs=("Label", "LogitsLength", "LabelLength"))
+def _warpctc(ctx, ins, attrs):
+    """CTC loss via the log-semiring alpha recursion (warpctc_op.cc parity,
+    computed natively instead of calling the warp-ctc library)."""
+    logits = ins["Logits"][0].astype(jnp.float32)  # [B, T, C] (batch-first)
+    label = ins["Label"][0].astype(jnp.int32)      # [B, S]
+    if label.ndim == 3:
+        label = label[..., 0]
+    blank = attrs.get("blank", 0)
+    if attrs.get("norm_by_times", False):
+        pass  # normalization applied at the end
+    B, T, C = logits.shape
+    S = label.shape[1]
+    llen = _lengths(ins, B, T, "LogitsLength")
+    slen = _lengths(ins, B, S, "LabelLength")
+
+    logp = jax.nn.log_softmax(logits, axis=-1)
+
+    # extended sequence: blank, l1, blank, l2, ..., blank  (length 2S+1)
+    ext = jnp.full((B, 2 * S + 1), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(label)
+    ext_valid = jnp.arange(2 * S + 1)[None, :] < (2 * slen + 1)[:, None]
+
+    # allow skip (alpha[s-2]) where ext[s] != blank and ext[s] != ext[s-2]
+    ext_m2 = jnp.concatenate(
+        [jnp.full((B, 2), blank, jnp.int32), ext[:, :-2]], axis=1)
+    can_skip = (ext != blank) & (ext != ext_m2)
+    can_skip = can_skip.at[:, :2].set(False)
+
+    def emit(t):
+        return jnp.take_along_axis(logp[:, t], ext, axis=1)  # [B, 2S+1]
+
+    a0 = jnp.full((B, 2 * S + 1), _NEG)
+    a0 = a0.at[:, 0].set(logp[:, 0, blank])
+    a0 = a0.at[:, 1].set(jnp.take_along_axis(
+        logp[:, 0], label[:, :1], axis=1)[:, 0])
+    a0 = jnp.where(ext_valid, a0, _NEG)
+
+    shift1 = jnp.full((B, 1), _NEG)
+
+    def step(alpha, t):
+        a1 = jnp.concatenate([shift1, alpha[:, :-1]], axis=1)
+        a2 = jnp.concatenate([shift1, shift1, alpha[:, :-2]], axis=1)
+        a2 = jnp.where(can_skip, a2, _NEG)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, a1), a2)
+        e = jnp.take_along_axis(logp[:, t], ext, axis=1)
+        new = jnp.where(ext_valid, merged + e, _NEG)
+        alpha = jnp.where((t < llen)[:, None], new, alpha)
+        return alpha, None
+
+    alphaT, _ = jax.lax.scan(step, a0, jnp.arange(1, T))
+    endpos = 2 * slen  # last blank
+    last_blank = jnp.take_along_axis(alphaT, endpos[:, None], axis=1)[:, 0]
+    last_label = jnp.take_along_axis(
+        alphaT, jnp.maximum(endpos - 1, 0)[:, None], axis=1)[:, 0]
+    ll = jnp.logaddexp(last_blank, last_label)
+    loss = -ll
+    if attrs.get("norm_by_times", False):
+        loss = loss / jnp.maximum(llen.astype(jnp.float32), 1.0)
+    return {"Loss": [loss[:, None]],
+            "WarpCTCGrad": [jnp.zeros_like(logits)]}
+
+
+# ---------------------------------------------------------------------------
+# sampled losses: NCE + hierarchical sigmoid
+# ---------------------------------------------------------------------------
+
+
+@register("nce", nondiff_inputs=("Label",), stateful=True)
+def _nce(ctx, ins, attrs):
+    """Noise-contrastive estimation with a uniform noise sampler
+    (nce_op.cc; the reference defaults to its uniform sampler too)."""
+    x = ins["Input"][0]                     # [B, D]
+    w = ins["Weight"][0]                    # [N, D]
+    label = ins["Label"][0].reshape((-1,)).astype(jnp.int32)  # [B]
+    bias = ins["Bias"][0] if ins.get("Bias") else None
+    num_total = w.shape[0]
+    num_neg = attrs.get("num_neg_samples", 10)
+    B = x.shape[0]
+
+    key = ctx.rng(attrs)
+    noise = jax.random.randint(key, (B, num_neg), 0, num_total)
+    ids = jnp.concatenate([label[:, None], noise], axis=1)  # [B, 1+K]
+
+    w_s = w[ids]                                    # [B, 1+K, D]
+    logits = jnp.einsum("bd,bkd->bk", x, w_s)
+    if bias is not None:
+        logits = logits + bias.reshape((-1,))[ids]
+    # NCE binary labels: first col true, rest noise
+    p_noise = 1.0 / num_total
+    logits = logits - jnp.log(num_neg * p_noise)
+    lab = jnp.zeros_like(logits).at[:, 0].set(1.0)
+    per = (jnp.maximum(logits, 0) - logits * lab
+           + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+    cost = jnp.sum(per, axis=1, keepdims=True)
+    return {"Cost": [cost], "SampleLogits": [logits],
+            "SampleLabels": [ids]}
+
+
+@register("hierarchical_sigmoid", nondiff_inputs=("Label",))
+def _hsigmoid(ctx, ins, attrs):
+    """Default complete-binary-tree hierarchical sigmoid
+    (hierarchical_sigmoid_op.cc). Codes/paths for class c come from the
+    bits of (c + num_classes) as in the reference's SimpleCode."""
+    x = ins["X"][0]                        # [B, D]
+    w = ins["W"][0]                        # [num_classes-1, D]
+    label = ins["Label"][0].reshape((-1,)).astype(jnp.int32)
+    bias = ins["Bias"][0] if ins.get("Bias") else None
+    num_classes = attrs["num_classes"]
+    B = x.shape[0]
+    max_code = int(np.ceil(np.log2(max(num_classes, 2))))
+
+    # SimpleCode: code(c) = c + num_classes; node at depth d =
+    # (code >> (L-d)) - 1 valid while (code >> (L-d)) > 1
+    code = label + num_classes
+    L = jnp.floor(jnp.log2(code.astype(jnp.float32))).astype(jnp.int32)
+    d = jnp.arange(max_code)[None, :]                     # [1, M]
+    shifted = code[:, None] >> jnp.maximum(L[:, None] - d, 0)
+    valid = d < L[:, None]
+    node = jnp.where(valid, shifted - 1, 0)               # [B, M]
+    bit = jnp.where(valid, (code[:, None] >> jnp.maximum(
+        L[:, None] - d - 1, 0)) & 1, 0)                   # next-branch bit
+
+    w_n = w[node]                                         # [B, M, D]
+    logits = jnp.einsum("bd,bmd->bm", x, w_n)
+    if bias is not None:
+        logits = logits + bias.reshape((-1,))[node]
+    t = bit.astype(jnp.float32)
+    per = (jnp.maximum(logits, 0) - logits * t
+           + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+    per = per * valid.astype(jnp.float32)
+    out = jnp.sum(per, axis=1, keepdims=True)
+    pre_out = jax.nn.sigmoid(logits)
+    return {"Out": [out], "PreOut": [pre_out]}
+
+
+# ---------------------------------------------------------------------------
+# small structural ops
+# ---------------------------------------------------------------------------
+
+
+@register("crop")
+def _crop(ctx, ins, attrs):
+    x = ins["X"][0]
+    if ins.get("Offsets"):
+        off = ins["Offsets"][0]
+        offsets = [off[i] for i in range(x.ndim)]
+    else:
+        offsets = attrs.get("offsets", [0] * x.ndim)
+    shape = attrs.get("shape")
+    if ins.get("Y") and shape is None:
+        shape = ins["Y"][0].shape
+    out = jax.lax.dynamic_slice(x, [jnp.asarray(o) for o in offsets],
+                                shape)
+    return {"Out": [out]}
+
+
+@register("hash", differentiable=False, nondiff_inputs=("X",))
+def _hash(ctx, ins, attrs):
+    """Multiplicative int hashing into num_hash buckets of size mod_by
+    (hash_op.cc uses xxhash over the id bytes; any stable hash satisfies
+    the contract of mapping id-tuples to [0, mod_by))."""
+    x = ins["X"][0].astype(jnp.uint32)     # [B, L] or [B, L, 1]
+    squeeze = x.ndim == 3
+    if squeeze:
+        x = x[..., 0]
+    num_hash = attrs.get("num_hash", 1)
+    mod_by = attrs.get("mod_by", 1)
+    seeds = jnp.arange(1, num_hash + 1, dtype=jnp.uint32) * np.uint32(0x9E3779B1)
+    h = x[..., None] * seeds + (x[..., None] >> 16)
+    h = h ^ (h >> 13)
+    h = h * np.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    out = (h % jnp.uint32(mod_by)).astype(jnp.int64)  # [B, L, num_hash]
+    return {"Out": [out]}
+
+
+@register("fsp")
+def _fsp(ctx, ins, attrs):
+    """Flow-of-solution-procedure matrix for distillation (fsp_op.cc):
+    Out[b, i, j] = mean_hw X[b,i,h,w] * Y[b,j,h,w]."""
+    x, y = ins["X"][0], ins["Y"][0]
+    hw = x.shape[2] * x.shape[3]
+    out = jnp.einsum("bihw,bjhw->bij", x, y) / hw
+    return {"Out": [out]}
+
+
+@register("row_conv")
+def _row_conv(ctx, ins, attrs):
+    """Lookahead row convolution (row_conv_op.cc): out[t] =
+    sum_{i<k} W[i] * x[t+i], batch-first padded-dense [B, T, D]."""
+    x = ins["X"][0]
+    w = ins["Filter"][0]  # [k, D]
+    k = w.shape[0]
+    B, T, D = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, k - 1), (0, 0)))
+    out = jnp.zeros_like(x)
+    for i in range(k):  # k is a small static constant; unrolled matmul-free
+        out = out + xp[:, i:i + T, :] * w[i][None, None, :]
+    return {"Out": [out]}
+
+
+@register("tree_conv")
+def _tree_conv(ctx, ins, attrs):
+    """Tree-based convolution (tree_conv_op.cc, TBCNN). NodesVector
+    [B, N, D], EdgeSet [B, E, 2] (parent->child int pairs), Filter
+    [D, 3, out, num_filters]. The three filter slices play the TBCNN
+    top/left/right roles; children aggregate into parents by mean."""
+    nodes = ins["NodesVector"][0]
+    edges = ins["EdgeSet"][0].astype(jnp.int32)
+    filt = ins["Filter"][0]       # [D, 3, out, F]
+    B, N, D = nodes.shape
+    E = edges.shape[1]
+    parent, child = edges[..., 0], edges[..., 1]  # [B, E]
+    ok = (parent >= 0) & (child >= 0) & (parent != child)
+
+    onehot = jax.nn.one_hot(jnp.where(ok, parent, N), N + 1,
+                            dtype=nodes.dtype)[..., :N]     # [B, E, N]
+    child_vec = jnp.take_along_axis(
+        nodes, jnp.where(ok, child, 0)[..., None], axis=1)  # [B, E, D]
+    child_vec = child_vec * ok[..., None].astype(nodes.dtype)
+    summed = jnp.einsum("ben,bed->bnd", onehot, child_vec)
+    cnt = jnp.maximum(jnp.einsum("ben->bn", onehot), 1.0)[..., None]
+    child_mean = summed / cnt
+
+    # left/right split: order of a child among its siblings (approximated by
+    # child id parity — static-shape friendly sibling ordering)
+    left_mask = (child % 2 == 0) & ok
+    right_mask = (child % 2 == 1) & ok
+    lsum = jnp.einsum("ben,bed->bnd", onehot * left_mask[..., None], child_vec)
+    rsum = jnp.einsum("ben,bed->bnd", onehot * right_mask[..., None], child_vec)
+
+    out = (jnp.einsum("bnd,dof->bnof", nodes, filt[:, 0])
+           + jnp.einsum("bnd,dof->bnof", lsum / cnt, filt[:, 1])
+           + jnp.einsum("bnd,dof->bnof", rsum / cnt, filt[:, 2]))
+    del child_mean
+    return {"Out": [jnp.tanh(out)]}
+
+
+@register("lod_reset")
+def _lod_reset(ctx, ins, attrs):
+    """Padded-dense parity: data passes through; the new per-row lengths (the
+    reference's target LoD) ride along as an extra output."""
+    x = ins["X"][0]
+    if ins.get("Y"):
+        lens = ins["Y"][0]
+    else:
+        tl = attrs.get("target_lod", [])
+        lens = jnp.diff(jnp.asarray(tl, jnp.int32)) if len(tl) else \
+            jnp.full((x.shape[0],), x.shape[1] if x.ndim > 1 else 1, jnp.int32)
+    return {"Out": [x], "Length": [lens]}
+
+
+@register("cvm", nondiff_inputs=("CVM",))
+def _cvm(ctx, ins, attrs):
+    """Continuous-value-model op (cvm_op.cc): X's first two features are
+    show/click counters; use_cvm keeps them log-transformed, otherwise they
+    are stripped."""
+    x = ins["X"][0]
+    use_cvm = attrs.get("use_cvm", True)
+    if use_cvm:
+        show = jnp.log(x[:, :1] + 1.0)
+        click = jnp.log(x[:, 1:2] + 1.0) - jnp.log(x[:, :1] + 1.0)
+        out = jnp.concatenate([show, click, x[:, 2:]], axis=1)
+    else:
+        out = x[:, 2:]
+    return {"Y": [out]}
+
+
+@register("merge_selected_rows")
+def _merge_selected_rows(ctx, ins, attrs):
+    # dense-grad world: rows are already merged by XLA scatter-add
+    return {"Out": [ins["X"][0]]}
+
+
+@register("get_tensor_from_selected_rows")
+def _get_tensor_from_selected_rows(ctx, ins, attrs):
+    return {"Out": [ins["X"][0]]}
+
+
+# ---------------------------------------------------------------------------
+# beam search (dense [batch, beam] semantics replacing the reference's LoD)
+# ---------------------------------------------------------------------------
+
+
+@register("beam_search", differentiable=False,
+          nondiff_inputs=("pre_ids", "pre_scores", "ids", "scores"))
+def _beam_search(ctx, ins, attrs):
+    """One beam-search step (beam_search_op.cc). Dense layout: pre_ids
+    [batch, beam], pre_scores [batch, beam], ids/scores [batch, beam, K]
+    per-candidate continuations. Emits top beam_size of beam*K candidates
+    per source sentence plus the parent beam index for backtracking."""
+    pre_ids = ins["pre_ids"][0].astype(jnp.int32)
+    pre_scores = ins["pre_scores"][0].astype(jnp.float32)
+    ids = ins["ids"][0].astype(jnp.int32)
+    scores = ins["scores"][0].astype(jnp.float32)
+    beam_size = attrs.get("beam_size", ids.shape[1])
+    end_id = attrs.get("end_id", 0)
+    Bz, W, K = scores.shape
+
+    finished = pre_ids == end_id
+    # finished beams only propagate themselves with unchanged score
+    cand = pre_scores[:, :, None] + jnp.log(jnp.maximum(scores, 1e-20))
+    cand = jnp.where(finished[:, :, None],
+                     jnp.where(jnp.arange(K)[None, None, :] == 0,
+                               pre_scores[:, :, None], _NEG),
+                     cand)
+    cand_ids = jnp.where(finished[:, :, None], end_id, ids)
+
+    flat = cand.reshape((Bz, W * K))
+    top_s, top_i = jax.lax.top_k(flat, beam_size)
+    parent = (top_i // K).astype(jnp.int32)
+    sel = jnp.take_along_axis(cand_ids.reshape((Bz, W * K)), top_i, axis=1)
+    return {"selected_ids": [sel], "selected_scores": [top_s],
+            "parent_idx": [parent]}
+
+
+@register("beam_search_decode", differentiable=False,
+          nondiff_inputs=("Ids", "Scores", "Parents"))
+def _beam_search_decode(ctx, ins, attrs):
+    """Backtrack stacked per-step ids/parents [T, batch, beam] into full
+    sequences [batch, beam, T] (beam_search_decode_op.cc)."""
+    ids = ins["Ids"][0].astype(jnp.int32)        # [T, B, W]
+    scores = ins["Scores"][0].astype(jnp.float32)
+    parents = ins["Parents"][0].astype(jnp.int32)
+    T, B, W = ids.shape
+
+    def back(beam_ptr, xs):
+        id_t, par_t = xs  # [B, W] each (walked in reverse time)
+        tok = jnp.take_along_axis(id_t, beam_ptr, axis=1)
+        beam_ptr = jnp.take_along_axis(par_t, beam_ptr, axis=1)
+        return beam_ptr, tok
+
+    ptr0 = jnp.broadcast_to(jnp.arange(W)[None, :], (B, W))
+    _, toks = jax.lax.scan(back, ptr0, (ids, parents), reverse=True)
+    seqs = jnp.transpose(toks, (1, 2, 0))  # [B, W, T]
+    final_scores = jnp.transpose(scores[-1], (0, 1))
+    return {"SentenceIds": [seqs], "SentenceScores": [final_scores]}
+
+
+# ---------------------------------------------------------------------------
+# chunk evaluation (NER-style chunk F1, chunk_eval_op.cc)
+# ---------------------------------------------------------------------------
+
+
+_SCHEME_NUM_TAG_TYPES = {"plain": 1, "IOB": 2, "IOE": 2, "IOBES": 4}
+
+
+def _chunk_bounds(tags, num_types, lens, scheme, excluded):
+    """Chunk begin/end masks for the reference's four tag schemes
+    (chunk_eval_op.cc): tag = chunk_type * num_tag_types + tag_type with
+    tag_type layouts plain:{}, IOB:{B,I}, IOE:{I,E}, IOBES:{B,I,E,S}.
+    Tags with type >= num_types (or in excluded_chunk_types) are outside."""
+    B_, T = tags.shape
+    ntt = _SCHEME_NUM_TAG_TYPES[scheme]
+    typ = tags // ntt
+    pos = tags % ntt
+    inside = ((tags >= 0) & (typ < num_types)
+              & (jnp.arange(T)[None, :] < lens[:, None]))
+    for ex in excluded:
+        inside = inside & (typ != ex)
+
+    def shift_prev(a, fill):
+        return jnp.concatenate(
+            [jnp.full((B_, 1), fill, a.dtype), a[:, :-1]], axis=1)
+
+    def shift_next(a, fill):
+        return jnp.concatenate(
+            [a[:, 1:], jnp.full((B_, 1), fill, a.dtype)], axis=1)
+
+    prev_typ = shift_prev(typ, -1)
+    next_typ = shift_next(typ, -1)
+    prev_inside = shift_prev(inside, False)
+    next_inside = shift_next(inside, False)
+    new_run = ~prev_inside | (typ != prev_typ)      # type/coverage break
+    run_ends = ~next_inside | (typ != next_typ)
+
+    if scheme == "plain":
+        begins = inside
+        ends = inside
+    elif scheme == "IOB":
+        is_b = pos == 0
+        begins = inside & (is_b | new_run)
+        next_is_b = shift_next(is_b & inside, False)
+        ends = inside & (next_is_b | run_ends)
+    elif scheme == "IOE":
+        is_e = pos == 1
+        prev_is_e = shift_prev(is_e & inside, False)
+        begins = inside & (prev_is_e | new_run)
+        ends = inside & (is_e | run_ends)
+    else:  # IOBES
+        is_b, is_e, is_s = pos == 0, pos == 2, pos == 3
+        prev_closed = shift_prev((is_e | is_s) & inside, False)
+        next_opens = shift_next((is_b | is_s) & inside, False)
+        begins = inside & (is_b | is_s | prev_closed | new_run)
+        ends = inside & (is_e | is_s | next_opens | run_ends)
+    return begins, ends, typ, inside
+
+
+@register("chunk_eval", differentiable=False,
+          nondiff_inputs=("Inference", "Label", "SeqLength"))
+def _chunk_eval(ctx, ins, attrs):
+    """A label chunk [s, e] counts as correct when inference tags equal label
+    tags on [s, e], inference also begins a chunk at s, and also ends one at
+    e — exactly the boundary+type match of the reference."""
+    inf = ins["Inference"][0].astype(jnp.int32)
+    lab = ins["Label"][0].astype(jnp.int32)
+    if inf.ndim == 3:
+        inf, lab = inf[..., 0], lab[..., 0]
+    B, T = inf.shape
+    lens = _lengths(ins, B, T, "SeqLength")
+    num_types = attrs.get("num_chunk_types", 1)
+    scheme = attrs.get("chunk_scheme", "IOB")
+    excluded = tuple(attrs.get("excluded_chunk_types", []) or [])
+
+    ib, ie, it, ii = _chunk_bounds(inf, num_types, lens, scheme, excluded)
+    lb, le, lt, li = _chunk_bounds(lab, num_types, lens, scheme, excluded)
+    num_inf = jnp.sum(ib.astype(jnp.int64))
+    num_lab = jnp.sum(lb.astype(jnp.int64))
+
+    # running flag: inside the current label chunk, tags have agreed since a
+    # joint begin
+    eq = (inf == lab)
+
+    def prop(ok, xs):
+        eq_t, lb_t, ib_t = xs
+        ok = jnp.where(lb_t, eq_t & ib_t, ok & eq_t)
+        return ok, ok
+
+    _, run = jax.lax.scan(prop, jnp.zeros((B,), bool),
+                          (jnp.swapaxes(eq, 0, 1), jnp.swapaxes(lb, 0, 1),
+                           jnp.swapaxes(ib, 0, 1)))
+    ok = jnp.swapaxes(run, 0, 1)
+    correct = jnp.sum((le & ie & ok).astype(jnp.int64))
+
+    prec = correct / jnp.maximum(num_inf, 1)
+    rec = correct / jnp.maximum(num_lab, 1)
+    f1 = 2 * prec * rec / jnp.maximum(prec + rec, 1e-12)
+    z = lambda v: jnp.asarray([v])
+    return {"Precision": [z(prec.astype(jnp.float32))],
+            "Recall": [z(rec.astype(jnp.float32))],
+            "F1-Score": [z(f1.astype(jnp.float32))],
+            "NumInferChunks": [z(num_inf)],
+            "NumLabelChunks": [z(num_lab)],
+            "NumCorrectChunks": [z(correct)]}
+
+
+# ---------------------------------------------------------------------------
+# py_func: host-python escape hatch (py_func_op.cc)
+# ---------------------------------------------------------------------------
+
+_PYFUNC_TABLE = []
+
+
+def register_py_func(fn):
+    _PYFUNC_TABLE.append(fn)
+    return len(_PYFUNC_TABLE) - 1
+
+
+@register("py_func", differentiable=False)
+def _py_func(ctx, ins, attrs):
+    fn = _PYFUNC_TABLE[attrs["func_id"]]
+    xs = ins.get("X", [])
+    shapes = attrs["out_shapes"]
+    dtypes = attrs["out_dtypes"]
+    shape_dtypes = [jax.ShapeDtypeStruct(tuple(s), np.dtype(d))
+                    for s, d in zip(shapes, dtypes)]
+
+    def host_fn(*arrays):
+        out = fn(*arrays)
+        if not isinstance(out, (list, tuple)):
+            out = (out,)
+        return tuple(np.asarray(o, dtype=sd.dtype).reshape(sd.shape)
+                     for o, sd in zip(out, shape_dtypes))
+
+    outs = jax.pure_callback(host_fn, tuple(shape_dtypes), *xs)
+    return {"Out": list(outs)}

@@ -170,15 +170,6 @@ def _strided_slice(ctx, ins, attrs):
     return {"Out": [x[tuple(idx)]]}
 
 
-@register("crop")
-def _crop(ctx, ins, attrs):
-    x = ins["X"][0]
-    offsets = attrs.get("offsets")
-    shape = attrs.get("shape")
-    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
-    return {"Out": [x[idx]]}
-
-
 @register("pad")
 def _pad(ctx, ins, attrs):
     x = ins["X"][0]

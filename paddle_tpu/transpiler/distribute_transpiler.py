@@ -1,0 +1,267 @@
+"""DistributeTranspiler (parity: python/paddle/fluid/transpiler/
+distribute_transpiler.py:169 — pserver + nccl2 modes).
+
+IR-level behavior mirrors the reference: `transpile` splits each
+param/grad into blocks, round-robins the blocks over pserver endpoints,
+rewrites the trainer program (grad → send, send_barrier, recv → param,
+fetch_barrier) and synthesizes one pserver program per endpoint whose
+optimizer ops update that endpoint's param blocks
+(distribute_transpiler.py:301/:609/:731).
+
+TPU-native execution: the same analysis doubles as a sharding planner —
+`get_sharding_plan()` returns a NamedSharding-style spec assigning each
+parameter's optimizer state to a mesh axis (the pserver block layout is
+exactly ZeRO-1 opt-state sharding, SURVEY §7 design mapping), which
+parallel/zero.py consumes. nccl2 mode maps to plain mesh data-parallelism
+(collectives ride ICI; no program rewrite needed beyond bookkeeping).
+"""
+
+import math
+
+from .. import framework
+from .ps_dispatcher import RoundRobin
+
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig"]
+
+# op types that update a parameter (the reference keys off op attr
+# OpRole.Optimize; our optimizer ops are recognizable by type)
+OPTIMIZE_OP_TYPES = frozenset([
+    "sgd", "momentum", "lars_momentum", "adagrad", "adam", "adamax",
+    "adadelta", "decayed_adagrad", "rmsprop", "ftrl", "lamb",
+    "dgc_momentum", "proximal_gd", "proximal_adagrad",
+])
+
+
+class DistributeTranspilerConfig:
+    """parity: distribute_transpiler.py:130."""
+
+    slice_var_up = True
+    split_method = RoundRobin
+    min_block_size = 8192
+    enable_dc_asgd = False
+    mode = "pserver"
+    print_log = False
+    runtime_split_send_recv = False
+    sync_mode = True
+
+
+def slice_variable(var_list, slice_count, min_block_size):
+    """Split each var into up to slice_count blocks of >= min_block_size
+    elements (parity: distribute_transpiler.py slice_variable)."""
+    blocks = []
+    for var in var_list:
+        numel = 1
+        for d in var.shape:
+            numel *= abs(d) if d else 1
+        split_count = slice_count
+        max_pieces = max(1, numel // min_block_size)
+        if max_pieces < split_count:
+            split_count = max_pieces
+        block_size = int(math.ceil(numel / float(split_count)))
+        # align block on the trailing-dim row size, as the reference does
+        row = 1
+        for d in var.shape[1:]:
+            row *= abs(d) if d else 1
+        if block_size % row:
+            block_size += row - (block_size % row)
+        split_count = int(math.ceil(numel / float(block_size)))
+        for i in range(split_count):
+            cur = min(block_size, numel - i * block_size)
+            blocks.append((var.name, i, cur))
+    return blocks
+
+
+class _VarBlockInfo:
+    def __init__(self, varname, block_id, size, endpoint):
+        self.varname = varname
+        self.block_id = block_id
+        self.size = size
+        self.endpoint = endpoint
+
+    @property
+    def blockname(self):
+        return "%s.block%d" % (self.varname, self.block_id)
+
+
+class DistributeTranspiler:
+    def __init__(self, config=None):
+        self.config = config or DistributeTranspilerConfig()
+
+    # -- public API (parity: transpile/get_trainer_program/
+    #    get_pserver_program/get_startup_program) ------------------------
+
+    def transpile(self, trainer_id, program=None, pservers="127.0.0.1:6170",
+                  trainers=1, sync_mode=True, startup_program=None,
+                  current_endpoint="127.0.0.1:6170"):
+        self.trainer_id = trainer_id
+        self.trainers = trainers
+        self.sync_mode = sync_mode
+        self.origin_program = program or framework.default_main_program()
+        self.startup_program = (startup_program
+                                or framework.default_startup_program())
+
+        if self.config.mode == "nccl2" or isinstance(pservers, int):
+            # nccl2/collective mode: no program surgery — the mesh provides
+            # the collectives (gen_nccl_id parity = mesh bootstrap)
+            self.pserver_endpoints = []
+            self.trainer_program = self.origin_program
+            self.origin_program._nranks = trainers
+            self.origin_program._trainer_id = trainer_id
+            self.params_grads = []
+            self.opt_ops = []
+            self.param_block_map = []
+            self.grad_block_map = []
+            self._pserver_programs = {}
+            return
+
+        if isinstance(pservers, str):
+            pservers = [e for e in pservers.split(",") if e]
+        self.pserver_endpoints = list(pservers)
+
+        main = self.origin_program
+        # collect (param, grad) pairs from optimizer ops, preserving order
+        self.params_grads = []
+        self.opt_ops = []
+        for op in main.global_block().ops:
+            if op.type in OPTIMIZE_OP_TYPES:
+                p = op.inputs.get("Param", [None])[0]
+                g = op.inputs.get("Grad", [None])[0]
+                if p is not None and g is not None:
+                    self.params_grads.append((p, g))
+                    self.opt_ops.append(op)
+
+        slice_count = (len(self.pserver_endpoints)
+                       if self.config.slice_var_up else 1)
+        param_blocks = slice_variable([p for p, _ in self.params_grads],
+                                      slice_count,
+                                      self.config.min_block_size)
+        grad_blocks = slice_variable([g for _, g in self.params_grads],
+                                     slice_count,
+                                     self.config.min_block_size)
+
+        dispatcher = self.config.split_method(self.pserver_endpoints)
+        eps = dispatcher.dispatch(
+            [type("B", (), {"name": "%s.block%d" % (n, i)})()
+             for n, i, _ in param_blocks])
+        self.param_block_map = [
+            _VarBlockInfo(n, i, sz, ep)
+            for (n, i, sz), ep in zip(param_blocks, eps)]
+        self.grad_block_map = [
+            _VarBlockInfo(n, i, sz, pb.endpoint)
+            for (n, i, sz), pb in zip(grad_blocks, self.param_block_map)]
+
+        self._build_trainer_program()
+        self._pserver_programs = {}
+
+    def _build_trainer_program(self):
+        """Clone the origin program, drop optimizer ops, append
+        send/send_barrier/recv/fetch_barrier (the reference's op sequence,
+        distribute_transpiler.py:609)."""
+        prog = self.origin_program.clone()
+        block = prog.global_block()
+        block.ops = [op for op in block.ops
+                     if op.type not in OPTIMIZE_OP_TYPES]
+
+        # per-endpoint grouped sends, in deterministic endpoint order
+        by_ep = {}
+        for gb in self.grad_block_map:
+            by_ep.setdefault(gb.endpoint, []).append(gb)
+        for ep in self.pserver_endpoints:
+            grads = [block.var(gb.varname) for gb in by_ep.get(ep, [])]
+            if not grads:
+                continue
+            block.append_op(
+                type="send", inputs={"X": grads}, outputs={},
+                attrs={"endpoint": ep, "sync_mode": self.sync_mode,
+                       "trainer_id": self.trainer_id})
+        if self.sync_mode:
+            block.append_op(type="send_barrier", inputs={}, outputs={},
+                            attrs={"endpoints": self.pserver_endpoints,
+                                   "trainer_id": self.trainer_id})
+        by_ep_p = {}
+        for pb in self.param_block_map:
+            by_ep_p.setdefault(pb.endpoint, []).append(pb)
+        for ep in self.pserver_endpoints:
+            params = [block.var(pb.varname) for pb in by_ep_p.get(ep, [])]
+            if not params:
+                continue
+            block.append_op(
+                type="recv", inputs={}, outputs={"Out": params},
+                attrs={"endpoint": ep, "trainer_id": self.trainer_id})
+        block.append_op(type="fetch_barrier", inputs={}, outputs={},
+                        attrs={"endpoints": self.pserver_endpoints,
+                               "trainer_id": self.trainer_id})
+        self.trainer_program = prog
+
+    def get_trainer_program(self, wait_port=True):
+        return self.trainer_program
+
+    def get_pserver_program(self, endpoint):
+        """One program per endpoint: a listen_and_serv op whose sub-blocks
+        hold the optimizer ops for this endpoint's param blocks
+        (distribute_transpiler.py:731 / listen_and_serv_op.cc:109)."""
+        if endpoint in self._pserver_programs:
+            return self._pserver_programs[endpoint]
+        prog = framework.Program()
+        gblock = prog.global_block()
+        my_params = [pb for pb in self.param_block_map
+                     if pb.endpoint == endpoint]
+        opt_sub_blocks = []
+        for pb in my_params:
+            # find this param's optimizer op in the origin program
+            opt_op = next(op for (p, _), op
+                          in zip(self.params_grads, self.opt_ops)
+                          if p.name == pb.varname)
+            sub = prog._create_block(parent_idx=0)
+            # mirror vars the op touches into the pserver program
+            ins, outs = {}, {}
+            for slot, vs in opt_op.inputs.items():
+                ins[slot] = [self._mirror_var(prog, v) for v in vs]
+            for slot, vs in opt_op.outputs.items():
+                outs[slot] = [self._mirror_var(prog, v) for v in vs]
+            sub.append_op(type=opt_op.type, inputs=ins, outputs=outs,
+                          attrs=dict(opt_op.attrs))
+            prog._rollback()
+            opt_sub_blocks.append(sub)
+        gblock.append_op(
+            type="listen_and_serv", inputs={}, outputs={},
+            attrs={"endpoint": endpoint,
+                   "sync_mode": self.sync_mode,
+                   "Fanin": self.trainers,
+                   "optimize_blocks": [b.idx for b in opt_sub_blocks],
+                   "param_block_names": [pb.blockname for pb in my_params]})
+        self._pserver_programs[endpoint] = prog
+        return prog
+
+    @staticmethod
+    def _mirror_var(prog, v):
+        gb = prog.global_block()
+        if gb.has_var(v.name):
+            return gb.var(v.name)
+        return gb.create_var(name=v.name, shape=v.shape, dtype=v.dtype,
+                             persistable=True)
+
+    def get_startup_program(self, endpoint, pserver_program=None,
+                            startup_program=None):
+        prog = framework.Program()
+        gb = prog.global_block()
+        for pb in self.param_block_map:
+            if pb.endpoint != endpoint:
+                continue
+            src = self.origin_program.global_block().var(pb.varname)
+            self._mirror_var(prog, src)
+        return prog
+
+    # -- TPU-native surface ---------------------------------------------
+
+    def get_sharding_plan(self, mesh_axis="dp"):
+        """The pserver block layout re-read as a ZeRO-1 plan: each param's
+        optimizer state lives on the shard owning its block(s). Returns
+        {param_name: {"axis": mesh_axis, "shard": endpoint_index}} for
+        parallel/zero.ShardedOptimizer."""
+        ep_index = {ep: i for i, ep in enumerate(self.pserver_endpoints)}
+        plan = {}
+        for pb in self.param_block_map:
+            plan.setdefault(pb.varname, {"axis": mesh_axis, "shards": []})
+            plan[pb.varname]["shards"].append(ep_index[pb.endpoint])
+        return plan

@@ -1,0 +1,43 @@
+"""Parameter-block → endpoint dispatchers (parity:
+python/paddle/fluid/transpiler/ps_dispatcher.py RoundRobin/HashName)."""
+
+__all__ = ["PSDispatcher", "RoundRobin", "HashName"]
+
+
+class PSDispatcher:
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+        self._step = 0
+
+    @property
+    def eps(self):
+        return self._eps
+
+    def reset(self):
+        self._step = 0
+
+    def dispatch(self, varlist):
+        raise NotImplementedError
+
+
+class RoundRobin(PSDispatcher):
+    def dispatch(self, varlist):
+        out = []
+        for _ in varlist:
+            out.append(self._eps[self._step])
+            self._step = (self._step + 1) % len(self._eps)
+        return out
+
+
+class HashName(PSDispatcher):
+    @staticmethod
+    def _hash_block(block_str, total):
+        # stable across processes (builtin hash() is salted per process,
+        # which would misroute blocks between trainer and pserver)
+        import zlib
+        return zlib.crc32(block_str.encode()) % total
+
+    def dispatch(self, varlist):
+        return [self._eps[self._hash_block(v.name if hasattr(v, "name")
+                                           else str(v), len(self._eps))]
+                for v in varlist]

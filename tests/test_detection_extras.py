@@ -1,0 +1,200 @@
+"""Tests for the RCNN target-assignment / FPN-routing detection op batch
+(parity model: unittests/test_rpn_target_assign_op.py,
+test_generate_proposal_labels_op.py, test_distribute_fpn_proposals_op.py,
+test_collect_fpn_proposals_op.py, test_box_decoder_and_assign_op.py,
+test_psroi_pool_op.py, test_roi_perspective_transform_op.py)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run(feed, fetch):
+    exe = fluid.Executor(fluid.CPUPlace())
+    return exe.run(fluid.default_main_program(), feed=feed, fetch_list=fetch)
+
+
+def _var(name, shape, dtype="float32"):
+    return fluid.default_main_program().global_block().create_var(
+        name=name, shape=shape, dtype=dtype, is_data=True)
+
+
+def test_rpn_target_assign_labels():
+    # 4 anchors; 1 gt matching anchor 0 exactly, anchor 1 far away
+    anchors_np = np.array([[0, 0, 10, 10], [50, 50, 60, 60],
+                           [0, 0, 9, 11], [100, 100, 110, 110]], np.float32)
+    gt_np = np.array([[0, 0, 10, 10]], np.float32)
+    anchor = _var("anchor", [4, 4])
+    gt = _var("gt", [1, 4])
+    bbox_pred = _var("bp", [4, 4])
+    cls_logits = _var("cl", [4, 1])
+    score, loc, lbl, tgt, w = layers.rpn_target_assign(
+        bbox_pred, cls_logits, anchor, None, gt,
+        rpn_batch_size_per_im=4, rpn_fg_fraction=0.5,
+        rpn_positive_overlap=0.7, rpn_negative_overlap=0.3)
+    outs = _run({"anchor": anchors_np, "gt": gt_np,
+                 "bp": np.zeros((4, 4), np.float32),
+                 "cl": np.zeros((4, 1), np.float32)},
+                [lbl.name, tgt.name, w.name])
+    lbl_, tgt_, w_ = [np.asarray(o) for o in outs]
+    # at least one fg (anchor 0, IoU 1.0) and bg anchors labeled 0
+    assert (lbl_ == 1).sum() >= 1
+    assert (lbl_ == 0).sum() >= 1
+    # the exactly-matching anchor's regression target is ~0
+    fg_rows = np.where(w_[:, 0] > 0)[0]
+    assert np.abs(tgt_[fg_rows]).min(axis=None) < 1e-4
+    assert np.isfinite(tgt_).all()
+
+
+def test_generate_proposal_labels_shapes_and_fg():
+    rois_np = np.array([[0, 0, 10, 10], [40, 40, 50, 50]], np.float32)
+    gtb_np = np.array([[0, 0, 10, 10]], np.float32)
+    gtc_np = np.array([[3]], np.int64)
+    rois = _var("rois", [2, 4])
+    gtb = _var("gtb", [1, 4])
+    gtc = _var("gtc", [1, 1], "int64")
+    out_rois, labels, tgts, w_in, w_out = layers.generate_proposal_labels(
+        rois, gtc, None, gtb, batch_size_per_im=8, fg_fraction=0.5,
+        fg_thresh=0.5)
+    outs = _run({"rois": rois_np, "gtb": gtb_np, "gtc": gtc_np},
+                [out_rois.name, labels.name, w_in.name])
+    r_, l_, w_ = [np.asarray(o) for o in outs]
+    assert r_.shape == (8, 4) and l_.shape == (8, 1)
+    # the exact-match roi (or the joined gt box) must be fg with class 3
+    assert (l_ == 3).sum() >= 1
+
+
+def test_distribute_and_collect_fpn_proposals():
+    # two rois: tiny (level 2) and huge (level 5)
+    rois_np = np.array([[0, 0, 20, 20], [0, 0, 800, 800]], np.float32)
+    rois = _var("rois", [2, 4])
+    outs, restore = layers.distribute_fpn_proposals(
+        rois, min_level=2, max_level=5, refer_level=4, refer_scale=224)
+    fetched = _run({"rois": rois_np},
+                   [o.name for o in outs] + [restore.name])
+    lvls = [np.asarray(f) for f in fetched[:-1]]
+    # tiny roi routed to level 2 (first output), huge to level 5 (last)
+    assert lvls[0][0].sum() > 0 and lvls[0][1].sum() == 0
+    assert lvls[-1][1].sum() > 0 and lvls[-1][0].sum() == 0
+
+    scores_np = np.array([[0.9], [0.1]], np.float32)
+    r1 = _var("r1", [2, 4])
+    s1 = _var("s1", [2, 1])
+    top = layers.collect_fpn_proposals([r1], [s1], 2, 5, post_nms_top_n=1)
+    # the program still holds the distribute op, so its feed stays required
+    got, = _run({"rois": rois_np, "r1": rois_np, "s1": scores_np},
+                [top.name])
+    np.testing.assert_allclose(np.asarray(got), rois_np[:1])
+
+
+def test_box_decoder_and_assign():
+    prior_np = np.array([[0, 0, 10, 10]], np.float32)
+    var_np = np.ones((1, 4), np.float32)
+    # class 1 shifts the box by +5 in x; class 0 identity
+    deltas_np = np.array([[0, 0, 0, 0, 0.5, 0, 0, 0]], np.float32)
+    score_np = np.array([[0.2, 0.8]], np.float32)
+    prior = _var("prior", [1, 4])
+    pvar = _var("pvar", [1, 4])
+    deltas = _var("deltas", [1, 8])
+    score = _var("score", [1, 2])
+    decoded, assigned = layers.box_decoder_and_assign(
+        prior, pvar, deltas, score, box_clip=4.135)
+    d_, a_ = [np.asarray(o) for o in
+              _run({"prior": prior_np, "pvar": var_np,
+                    "deltas": deltas_np, "score": score_np},
+                   [decoded.name, assigned.name])]
+    assert d_.shape == (1, 8)
+    # assigned box is the argmax class (class 1): shifted right by 0.5*w=5
+    np.testing.assert_allclose(a_[0], d_[0, 4:], rtol=1e-5)
+    assert a_[0, 0] > d_[0, 0]
+
+
+def test_psroi_pool_constant_groups():
+    # each channel group constant -> output bin equals its group's constant
+    P, C = 2, 3
+    x_np = np.zeros((1, C * P * P, 8, 8), np.float32)
+    # our op reshapes channels [C*P*P] -> [C, P, P]; fill accordingly
+    arr = np.arange(C * P * P, dtype=np.float32).reshape(C, P, P)
+    for c in range(C):
+        for i in range(P):
+            for j in range(P):
+                x_np[0, c * P * P + i * P + j] = arr[c, i, j]
+    rois_np = np.array([[0, 0, 8, 8]], np.float32)
+    x = _var("x", [1, C * P * P, 8, 8])
+    rois = _var("rois", [1, 4])
+    out = layers.psroi_pool(x, rois, output_channels=C, spatial_scale=1.0,
+                            pooled_height=P, pooled_width=P)
+    got, = _run({"x": x_np, "rois": rois_np}, [out.name])
+    got = np.asarray(got)
+    assert got.shape == (1, C, P, P)
+    np.testing.assert_allclose(got[0], arr, rtol=1e-5)
+
+
+def test_roi_perspective_transform_axis_aligned():
+    # axis-aligned quad == plain crop+resize of a linear ramp
+    H = W = 8
+    x_np = np.tile(np.arange(W, dtype=np.float32), (H, 1))[None, None]
+    # quad corners tl, tr, br, bl covering columns 2..6
+    rois_np = np.array([[2, 0, 6, 0, 6, 8, 2, 8]], np.float32)
+    x = _var("x", [1, 1, H, W])
+    rois = _var("rois", [1, 8])
+    out = layers.roi_perspective_transform(x, rois, transformed_height=4,
+                                           transformed_width=4)
+    got, = _run({"x": x_np, "rois": rois_np}, [out.name])
+    got = np.asarray(got)[0, 0]
+    assert got.shape == (4, 4)
+    # values increase left->right within [2, 6]
+    assert (np.diff(got, axis=1) > 0).all()
+    assert got.min() >= 2.0 - 1e-5 and got.max() <= 6.0 + 1e-5
+
+
+def test_generate_mask_labels_crops_mask():
+    # one gt mask: a filled square [2:6, 2:6] on an 8x8 image grid
+    masks_np = np.zeros((1, 8, 8), np.float32)
+    masks_np[0, 2:6, 2:6] = 1.0
+    rois_np = np.array([[2, 2, 6, 6]], np.float32)
+    labels_np = np.array([[1]], np.int32)
+    rois = _var("rois", [1, 4])
+    segms = _var("segms", [1, 8, 8])
+    labels = _var("labels", [1, 1], "int32")
+    mask_rois, has_mask, mask = layers.generate_mask_labels(
+        None, None, None, segms, rois, labels, resolution=4)
+    got, hm = [np.asarray(o) for o in
+               _run({"rois": rois_np, "segms": masks_np,
+                     "labels": labels_np}, [mask.name, has_mask.name])]
+    assert hm[0, 0] == 1
+    np.testing.assert_array_equal(got[0], np.ones((4, 4), np.int32))
+
+
+def test_distribute_fpn_restore_index_roundtrip():
+    rois_np = np.array([[0, 0, 300, 300], [0, 0, 20, 20],
+                        [0, 0, 100, 100]], np.float32)
+    rois = _var("rois", [3, 4])
+    outs, restore = layers.distribute_fpn_proposals(
+        rois, min_level=2, max_level=5, refer_level=4, refer_scale=224)
+    fetched = _run({"rois": rois_np},
+                   [o.name for o in outs] + [restore.name])
+    lvls = [np.asarray(f) for f in fetched[:-1]]
+    ridx = np.asarray(fetched[-1]).reshape(-1)
+    concat = np.concatenate(lvls, axis=0)
+    np.testing.assert_allclose(concat[ridx], rois_np)
+
+
+def test_psroi_pool_nonsquare():
+    Ph, Pw, C = 2, 3, 2
+    x_np = np.zeros((1, C * Ph * Pw, 6, 6), np.float32)
+    arr = np.arange(C * Ph * Pw, dtype=np.float32).reshape(C, Ph, Pw)
+    for c in range(C):
+        for i in range(Ph):
+            for j in range(Pw):
+                x_np[0, c * Ph * Pw + i * Pw + j] = arr[c, i, j]
+    rois_np = np.array([[0, 0, 6, 6]], np.float32)
+    x = _var("x", [1, C * Ph * Pw, 6, 6])
+    rois = _var("rois", [1, 4])
+    out = layers.psroi_pool(x, rois, output_channels=C, spatial_scale=1.0,
+                            pooled_height=Ph, pooled_width=Pw)
+    got, = _run({"x": x_np, "rois": rois_np}, [out.name])
+    got = np.asarray(got)
+    assert got.shape == (1, C, Ph, Pw)
+    np.testing.assert_allclose(got[0], arr, rtol=1e-5)

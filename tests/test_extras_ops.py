@@ -1,0 +1,520 @@
+"""Tests for the CRF/CTC/sampled-loss/beam-search/misc op batch (parity
+model: unittests/test_linear_chain_crf_op.py, test_crf_decoding_op.py,
+test_warpctc_op.py, test_edit_distance_op.py, test_ctc_align_op.py,
+test_nce.py, test_hsigmoid_op.py, test_beam_search_op.py,
+test_chunk_eval_op.py, ...)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run(feed, fetch, main=None):
+    exe = fluid.Executor(fluid.CPUPlace())
+    return exe.run(main or fluid.default_main_program(), feed=feed,
+                   fetch_list=fetch)
+
+
+# ---------------------------------------------------------------------------
+# CRF
+# ---------------------------------------------------------------------------
+
+
+def _crf_brute_force(em, trans, label, length):
+    """Enumerate all paths (tiny C, T) to get log-likelihood exactly."""
+    start, stop, T_ = trans[0], trans[1], trans[2:]
+    C = em.shape[-1]
+    out = []
+    for b in range(em.shape[0]):
+        L = length[b]
+        scores = []
+        for path in itertools.product(range(C), repeat=L):
+            s = start[path[0]] + stop[path[-1]]
+            s += sum(em[b, t, path[t]] for t in range(L))
+            s += sum(T_[path[t], path[t + 1]] for t in range(L - 1))
+            scores.append(s)
+        gold = label[b, :L]
+        g = start[gold[0]] + stop[gold[-1]]
+        g += sum(em[b, t, gold[t]] for t in range(L))
+        g += sum(T_[gold[t], gold[t + 1]] for t in range(L - 1))
+        logZ = np.log(np.sum(np.exp(np.array(scores))))
+        out.append(g - logZ)
+    return np.array(out)
+
+
+def test_linear_chain_crf_matches_brute_force():
+    B, T, C = 3, 4, 3
+    rng = np.random.RandomState(0)
+    em_np = rng.randn(B, T, C).astype(np.float32)
+    lab_np = rng.randint(0, C, (B, T, 1)).astype(np.int64)
+    len_np = np.array([4, 3, 2], np.int32)
+
+    em = layers.data("em", [T, C])
+    lab = layers.data("lab", [T, 1], dtype="int64")
+    length = layers.data("len", [], dtype="int32")
+    ll = layers.linear_chain_crf(
+        em, lab, param_attr=fluid.ParamAttr(name="crfw"), length=length)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    trans_np = np.asarray(
+        fluid.global_scope().get("crfw"), dtype=np.float32)
+    got, = _run({"em": em_np, "lab": lab_np, "len": len_np}, [ll.name])
+    want = _crf_brute_force(em_np.astype(np.float64),
+                            trans_np.astype(np.float64), lab_np[..., 0],
+                            len_np)
+    np.testing.assert_allclose(np.asarray(got)[:, 0], want, atol=1e-4)
+
+
+def test_crf_decoding_matches_brute_force():
+    B, T, C = 2, 4, 3
+    rng = np.random.RandomState(1)
+    em_np = rng.randn(B, T, C).astype(np.float32)
+    len_np = np.array([4, 3], np.int32)
+
+    em = layers.data("em", [T, C])
+    length = layers.data("len", [], dtype="int32")
+    lab = layers.data("lab", [T, 1], dtype="int64")
+    ll = layers.linear_chain_crf(
+        em, lab, param_attr=fluid.ParamAttr(name="crfw"), length=length)
+    path = layers.crf_decoding(
+        em, param_attr=fluid.ParamAttr(name="crfw"), length=length)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    trans_np = np.asarray(fluid.global_scope().get("crfw"), np.float64)
+    lab_np = np.zeros((B, T, 1), np.int64)
+    got, = _run({"em": em_np, "len": len_np, "lab": lab_np}, [path.name])
+    got = np.asarray(got)[..., 0]
+
+    start, stop, T_ = trans_np[0], trans_np[1], trans_np[2:]
+    for b in range(B):
+        L = len_np[b]
+        best, best_path = -1e30, None
+        for p in itertools.product(range(C), repeat=int(L)):
+            s = start[p[0]] + stop[p[-1]]
+            s += sum(em_np[b, t, p[t]] for t in range(L))
+            s += sum(T_[p[t], p[t + 1]] for t in range(L - 1))
+            if s > best:
+                best, best_path = s, p
+        np.testing.assert_array_equal(got[b, :L], np.array(best_path))
+
+
+def test_linear_chain_crf_trains():
+    """Loss (negative LL) decreases under SGD — the book-test shape of
+    label_semantic_roles."""
+    B, T, C = 4, 5, 4
+    rng = np.random.RandomState(2)
+    em_np = rng.randn(B, T, C).astype(np.float32)
+    lab_np = rng.randint(0, C, (B, T, 1)).astype(np.int64)
+
+    em = layers.data("em", [T, C], stop_gradient=False)
+    lab = layers.data("lab", [T, 1], dtype="int64")
+    ll = layers.linear_chain_crf(em, lab,
+                                 param_attr=fluid.ParamAttr(name="crfw2"))
+    loss = layers.mean(layers.scale(ll, scale=-1.0))
+    opt = fluid.optimizer.SGD(learning_rate=0.1)
+    opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    losses = []
+    for _ in range(8):
+        out, = exe.run(fluid.default_main_program(),
+                       feed={"em": em_np, "lab": lab_np},
+                       fetch_list=[loss.name])
+        losses.append(float(np.asarray(out)))
+    assert losses[-1] < losses[0]
+
+
+# ---------------------------------------------------------------------------
+# CTC
+# ---------------------------------------------------------------------------
+
+
+def test_warpctc_matches_torch():
+    torch = pytest.importorskip("torch")
+    B, T, C, S = 3, 8, 5, 3
+    rng = np.random.RandomState(3)
+    logits_np = rng.randn(B, T, C).astype(np.float32)
+    label_np = rng.randint(1, C, (B, S)).astype(np.int64)
+    llen_np = np.array([8, 7, 6], np.int32)
+    slen_np = np.array([3, 2, 3], np.int32)
+
+    logits = layers.data("logits", [T, C])
+    label = layers.data("label", [S], dtype="int64")
+    llen = layers.data("llen", [], dtype="int32")
+    slen = layers.data("slen", [], dtype="int32")
+    loss = layers.warpctc(logits, label, blank=0, input_length=llen,
+                          label_length=slen)
+    got, = _run({"logits": logits_np, "label": label_np,
+                 "llen": llen_np, "slen": slen_np}, [loss.name])
+
+    lt = torch.from_numpy(logits_np).permute(1, 0, 2).log_softmax(-1)
+    want = torch.nn.functional.ctc_loss(
+        lt, torch.from_numpy(label_np), torch.from_numpy(llen_np.astype(np.int64)),
+        torch.from_numpy(slen_np.astype(np.int64)), blank=0, reduction="none")
+    np.testing.assert_allclose(np.asarray(got)[:, 0], want.numpy(),
+                               atol=1e-3, rtol=1e-3)
+
+
+def test_ctc_greedy_decoder():
+    # argmax ids across classes chosen to produce blank/repeat patterns
+    B, T, C = 2, 6, 4
+    probs = np.zeros((B, T, C), np.float32)
+    # seq0 argmax: 1 1 0 2 2 3 -> merge/strip -> 1 2 3
+    for t, c in enumerate([1, 1, 0, 2, 2, 3]):
+        probs[0, t, c] = 1.0
+    # seq1 argmax: 0 0 1 1 0 1 -> 1 1
+    for t, c in enumerate([0, 0, 1, 1, 0, 1]):
+        probs[1, t, c] = 1.0
+    x = layers.data("x", [T, C])
+    out = layers.ctc_greedy_decoder(x, blank=0)
+    got, = _run({"x": probs}, [out.name])
+    got = np.asarray(got)
+    np.testing.assert_array_equal(got[0, :3], [1, 2, 3])
+    assert (got[0, 3:] == -1).all()
+    np.testing.assert_array_equal(got[1, :2], [1, 1])
+    assert (got[1, 2:] == -1).all()
+
+
+def test_edit_distance():
+    # "kitten" vs "sitting" -> 3
+    hyp_np = np.array([[11, 9, 20, 20, 5, 14, 0]], np.int64)
+    ref_np = np.array([[19, 9, 20, 20, 9, 14, 7]], np.int64)
+    hyp = layers.data("hyp", [7], dtype="int64")
+    ref = layers.data("ref", [7], dtype="int64")
+    hlen = layers.data("hlen", [], dtype="int32")
+    rlen = layers.data("rlen", [], dtype="int32")
+    dist, seq_num = layers.edit_distance(hyp, ref, normalized=False,
+                                         input_length=hlen, label_length=rlen)
+    got, n = _run({"hyp": hyp_np, "ref": ref_np,
+                   "hlen": np.array([6], np.int32),
+                   "rlen": np.array([7], np.int32)},
+                  [dist.name, seq_num.name])
+    assert float(np.asarray(got)[0, 0]) == 3.0
+    assert int(np.asarray(n)[0]) == 1
+
+
+# ---------------------------------------------------------------------------
+# sampled losses
+# ---------------------------------------------------------------------------
+
+
+def test_nce_finite_and_trains():
+    B, D, N = 8, 16, 50
+    rng = np.random.RandomState(4)
+    x_np = rng.randn(B, D).astype(np.float32)
+    lab_np = rng.randint(0, N, (B, 1)).astype(np.int64)
+    x = layers.data("x", [D], stop_gradient=False)
+    lab = layers.data("lab", [1], dtype="int64")
+    cost = layers.nce(x, lab, num_total_classes=N, num_neg_samples=5)
+    loss = layers.mean(cost)
+    fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    losses = [float(np.asarray(exe.run(
+        fluid.default_main_program(), feed={"x": x_np, "lab": lab_np},
+        fetch_list=[loss.name])[0])) for _ in range(20)]
+    assert all(np.isfinite(l) for l in losses)
+    # noise resampling makes per-step loss noisy; compare window means
+    assert np.mean(losses[-5:]) < np.mean(losses[:5])
+
+
+def test_hsigmoid_finite_and_trains():
+    B, D, N = 8, 12, 10
+    rng = np.random.RandomState(5)
+    x_np = rng.randn(B, D).astype(np.float32)
+    lab_np = rng.randint(0, N, (B, 1)).astype(np.int64)
+    x = layers.data("x", [D], stop_gradient=False)
+    lab = layers.data("lab", [1], dtype="int64")
+    out = layers.hsigmoid(x, lab, num_classes=N)
+    loss = layers.mean(out)
+    fluid.optimizer.SGD(learning_rate=0.2).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    losses = [float(np.asarray(exe.run(
+        fluid.default_main_program(), feed={"x": x_np, "lab": lab_np},
+        fetch_list=[loss.name])[0])) for _ in range(6)]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
+
+
+# ---------------------------------------------------------------------------
+# beam search
+# ---------------------------------------------------------------------------
+
+
+def test_beam_search_step_and_decode():
+    Bz, W, K = 1, 2, 3
+    pre_ids_np = np.array([[5, 7]], np.int64)
+    pre_scores_np = np.array([[-1.0, -2.0]], np.float32)
+    ids_np = np.arange(Bz * W * K).reshape(Bz, W, K).astype(np.int64)
+    # beam 0 candidates much better than beam 1
+    scores_np = np.array([[[0.6, 0.3, 0.1], [0.2, 0.1, 0.1]]], np.float32)
+
+    pre_ids = layers.data("pre_ids", [W], dtype="int64")
+    pre_scores = layers.data("pre_scores", [W])
+    ids = layers.data("ids", [W, K], dtype="int64")
+    scores = layers.data("scores", [W, K])
+    sel_ids, sel_scores, parent = layers.beam_search(
+        pre_ids, pre_scores, ids, scores, beam_size=W, end_id=0)
+    outs = _run({"pre_ids": pre_ids_np, "pre_scores": pre_scores_np,
+                 "ids": ids_np, "scores": scores_np},
+                [sel_ids.name, sel_scores.name, parent.name])
+    got_ids, got_scores, got_parent = [np.asarray(o) for o in outs]
+    # both winners must come from beam 0 (its log-prob additions dominate)
+    np.testing.assert_array_equal(got_parent[0], [0, 0])
+    np.testing.assert_array_equal(got_ids[0], [0, 1])
+    np.testing.assert_allclose(
+        got_scores[0], -1.0 + np.log(np.array([0.6, 0.3])), rtol=1e-5)
+
+
+def test_beam_search_decode_backtracks():
+    # T=3 steps, batch=1, beam=2; parents chain: step2 sel came from...
+    ids_np = np.array([[[3, 4]], [[5, 6]], [[7, 8]]], np.int64)  # [T,1,2]
+    par_np = np.array([[[0, 0]], [[1, 0]], [[0, 1]]], np.int32)
+    sc_np = np.zeros((3, 1, 2), np.float32)
+    ids = layers.data("ids", [1, 2], dtype="int64", append_batch_size=False)
+    # feed stacked [T, B, W] directly
+    ids = fluid.default_main_program().global_block().create_var(
+        name="ids3", shape=[3, 1, 2], dtype="int64", is_data=True)
+    par = fluid.default_main_program().global_block().create_var(
+        name="par3", shape=[3, 1, 2], dtype="int32", is_data=True)
+    sc = fluid.default_main_program().global_block().create_var(
+        name="sc3", shape=[3, 1, 2], dtype="float32", is_data=True)
+    sent, _ = layers.beam_search_decode(ids, sc, par, end_id=0)
+    got, = _run({"ids3": ids_np, "par3": par_np, "sc3": sc_np}, [sent.name])
+    got = np.asarray(got)
+    # beam 0 at T=2 token 7, parent 0 at step2 -> step1 beam0 token 5,
+    # parent of step1 beam0 is 1 -> step0 beam1 token 4
+    np.testing.assert_array_equal(got[0, 0], [4, 5, 7])
+    # beam 1: token 8, parent 1 -> step1 beam1 token 6, parent 0 -> token 3
+    np.testing.assert_array_equal(got[0, 1], [3, 6, 8])
+
+
+# ---------------------------------------------------------------------------
+# misc small ops
+# ---------------------------------------------------------------------------
+
+
+def test_crop():
+    x_np = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    x = layers.data("x", [3, 4], append_batch_size=False)
+    x = fluid.default_main_program().global_block().create_var(
+        name="xc", shape=[2, 3, 4], dtype="float32", is_data=True)
+    out = layers.crop(x, shape=[1, 2, 2], offsets=[1, 0, 1])
+    got, = _run({"xc": x_np}, [out.name])
+    np.testing.assert_array_equal(np.asarray(got), x_np[1:2, 0:2, 1:3])
+
+
+def test_hash_in_range_and_deterministic():
+    x_np = np.array([[1, 2, 3], [4, 5, 6]], np.int64)
+    x = layers.data("x", [3], dtype="int64")
+    out = layers.hash(x, hash_size=100, num_hash=4)
+    got1, = _run({"x": x_np}, [out.name])
+    got2, = _run({"x": x_np}, [out.name])
+    got1 = np.asarray(got1)
+    assert got1.shape == (2, 3, 4)
+    assert (got1 >= 0).all() and (got1 < 100).all()
+    np.testing.assert_array_equal(got1, np.asarray(got2))
+
+
+def test_fsp_matrix():
+    rng = np.random.RandomState(6)
+    x_np = rng.randn(2, 3, 4, 4).astype(np.float32)
+    y_np = rng.randn(2, 5, 4, 4).astype(np.float32)
+    x = layers.data("x", [3, 4, 4])
+    y = layers.data("y", [5, 4, 4])
+    out = layers.fsp_matrix(x, y)
+    got, = _run({"x": x_np, "y": y_np}, [out.name])
+    want = np.einsum("bihw,bjhw->bij", x_np, y_np) / 16.0
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-6)
+
+
+def test_row_conv():
+    rng = np.random.RandomState(7)
+    B, T, D, k = 2, 5, 3, 2
+    x_np = rng.randn(B, T, D).astype(np.float32)
+    x = layers.data("x", [T, D], stop_gradient=False)
+    out = layers.row_conv(x, future_context_size=k)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    w = np.asarray(fluid.global_scope().get(
+        [v.name for v in fluid.default_main_program().global_block()
+         .all_parameters()][0]))
+    got, = _run({"x": x_np}, [out.name])
+    xp = np.pad(x_np, ((0, 0), (0, k), (0, 0)))
+    want = sum(xp[:, i:i + T, :] * w[i][None, None, :] for i in range(k + 1))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+
+
+def test_cvm():
+    x_np = np.array([[3.0, 1.0, 0.5, 0.6]], np.float32)
+    x = layers.data("x", [4])
+    cvm_in = layers.data("cvm", [2])
+    out = layers.continuous_value_model(x, cvm_in, use_cvm=True)
+    got, = _run({"x": x_np, "cvm": np.zeros((1, 2), np.float32)}, [out.name])
+    got = np.asarray(got)
+    np.testing.assert_allclose(got[0, 0], np.log(4.0), rtol=1e-5)
+    np.testing.assert_allclose(got[0, 1], np.log(2.0) - np.log(4.0), rtol=1e-5)
+    np.testing.assert_allclose(got[0, 2:], x_np[0, 2:], rtol=1e-6)
+
+
+def test_chunk_eval_iob():
+    # IOB with 1 type: tag 0 = B, tag 1 = I, tag 2 = O
+    # label:  B I O B I   => chunks [0,1], [3,4]
+    # infer:  B I O B O   => chunks [0,1], [3,3]
+    lab_np = np.array([[0, 1, 2, 0, 1]], np.int64)
+    inf_np = np.array([[0, 1, 2, 0, 2]], np.int64)
+    inf = layers.data("inf", [5], dtype="int64")
+    lab = layers.data("lab", [5], dtype="int64")
+    p, r, f1, ni, nl, nc = layers.chunk_eval(inf, lab, "IOB",
+                                             num_chunk_types=1)
+    outs = _run({"inf": inf_np, "lab": lab_np},
+                [p.name, r.name, f1.name, ni.name, nl.name, nc.name])
+    p_, r_, f1_, ni_, nl_, nc_ = [np.asarray(o) for o in outs]
+    assert int(ni_[0]) == 2 and int(nl_[0]) == 2 and int(nc_[0]) == 1
+    np.testing.assert_allclose(p_[0], 0.5)
+    np.testing.assert_allclose(r_[0], 0.5)
+
+
+def test_py_func_roundtrip():
+    x_np = np.arange(6, dtype=np.float32).reshape(2, 3)
+    x = layers.data("x", [3])
+    out = fluid.default_main_program().global_block().create_var(
+        name="pf_out", shape=[2, 3], dtype="float32")
+    out.shape = (2, 3)
+    layers.py_func(lambda a: a * 2.0, x, out)
+    got, = _run({"x": x_np}, [out.name])
+    np.testing.assert_allclose(np.asarray(got), x_np * 2.0)
+
+
+def test_lod_reset_passthrough():
+    x_np = np.ones((2, 3), np.float32)
+    x = layers.data("x", [3])
+    out = layers.lod_reset(x, target_lod=[0, 3, 6])
+    got, = _run({"x": x_np}, [out.name])
+    np.testing.assert_array_equal(np.asarray(got), x_np)
+
+
+def test_rank_and_selected_rows_passthrough():
+    x = layers.data("x", [3])
+    r = layers.rank(x)
+    m = layers.merge_selected_rows(x)
+    g = layers.get_tensor_from_selected_rows(m)
+    got_r, got_g = _run({"x": np.ones((2, 3), np.float32)}, [r.name, g.name])
+    assert int(np.asarray(got_r)[0]) == 2
+    np.testing.assert_array_equal(np.asarray(got_g), np.ones((2, 3)))
+
+
+# ---------------------------------------------------------------------------
+# reader-layer shims
+# ---------------------------------------------------------------------------
+
+
+def test_py_reader_pipeline():
+    reader = layers.py_reader(capacity=4, shapes=[(-1, 3), (-1, 1)],
+                              dtypes=["float32", "int64"], name="r")
+    x, y = layers.read_file(reader)
+    out = layers.mean(x)
+
+    def gen():
+        for i in range(3):
+            yield [(np.full((3,), i, np.float32), np.array([i], np.int64))]
+
+    reader.decorate_sample_list_generator(gen)
+    exe = fluid.Executor(fluid.CPUPlace())
+    vals = []
+    for feed in reader:
+        res, = exe.run(fluid.default_main_program(), feed=feed,
+                       fetch_list=[out.name])
+        vals.append(float(np.asarray(res)))
+    np.testing.assert_allclose(vals, [0.0, 1.0, 2.0])
+
+
+def test_reader_batch_shuffle_decorators():
+    reader = layers.py_reader(capacity=4, shapes=[(-1, 2)],
+                              dtypes=["float32"], name="r2")
+    layers.shuffle(reader, buffer_size=8)
+
+    def gen():
+        for i in range(4):
+            yield [(np.full((2,), i, np.float32),)]
+
+    reader.decorate_sample_list_generator(gen)
+    seen = sum(1 for _ in reader)
+    assert seen == 4
+
+
+def test_load_layer(tmp_path):
+    w_np = np.arange(4, dtype=np.float32)
+    np.save(tmp_path / "w.npy", w_np)
+    v = fluid.default_main_program().global_block().create_var(
+        name="loaded_w", shape=[4], dtype="float32", persistable=True)
+    layers.load(v, str(tmp_path / "w.npy"))
+    np.testing.assert_array_equal(
+        np.asarray(fluid.global_scope().get("loaded_w")), w_np)
+
+
+def test_chunk_eval_iobes_and_plain():
+    # IOBES, 1 type: tags B=0 I=1 E=2 S=3, O=4
+    # label: S O B I E  => chunks [0,0], [2,4]
+    # infer: S O B E O  => chunks [0,0], [2,3]
+    lab_np = np.array([[3, 4, 0, 1, 2]], np.int64)
+    inf_np = np.array([[3, 4, 0, 2, 4]], np.int64)
+    inf = layers.data("inf", [5], dtype="int64")
+    lab = layers.data("lab", [5], dtype="int64")
+    p, r, f1, ni, nl, nc = layers.chunk_eval(inf, lab, "IOBES",
+                                             num_chunk_types=1)
+    outs = _run({"inf": inf_np, "lab": lab_np},
+                [ni.name, nl.name, nc.name])
+    ni_, nl_, nc_ = [int(np.asarray(o)[0]) for o in outs]
+    assert (ni_, nl_, nc_) == (2, 2, 1)
+
+
+def test_chunk_eval_plain_scheme():
+    # plain: every non-O token is its own chunk; type id == tag
+    lab_np = np.array([[0, 0, 1]], np.int64)
+    inf_np = np.array([[0, 1, 1]], np.int64)
+    inf = layers.data("inf", [3], dtype="int64")
+    lab = layers.data("lab", [3], dtype="int64")
+    p, r, f1, ni, nl, nc = layers.chunk_eval(inf, lab, "plain",
+                                             num_chunk_types=2)
+    outs = _run({"inf": inf_np, "lab": lab_np},
+                [ni.name, nl.name, nc.name])
+    ni_, nl_, nc_ = [int(np.asarray(o)[0]) for o in outs]
+    assert (ni_, nl_, nc_) == (3, 3, 2)
+
+
+def test_random_data_generator_iterates():
+    reader = layers.random_data_generator(0.0, 1.0, shapes=[(8, 3), (8, 1)])
+    x, y = layers.read_file(reader)
+    out = layers.mean(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    n = 0
+    for feed in reader:
+        res, = exe.run(fluid.default_main_program(), feed=feed,
+                       fetch_list=[out.name])
+        assert 0.0 <= float(np.asarray(res).reshape(-1)[0]) <= 1.0
+        n += 1
+        if n >= 2:
+            break
+    assert n == 2
+
+
+def test_reader_batch_decorator_applies():
+    reader = layers.py_reader(capacity=4, shapes=[(-1, 2)],
+                              dtypes=["float32"], name="rb")
+    layers.batch(reader, batch_size=3)
+
+    def gen():
+        for i in range(6):
+            yield (np.full((2,), i, np.float32),)
+
+    reader.decorate_sample_list_generator(gen)
+    batches = [f for f in reader]
+    assert len(batches) == 2  # 6 samples -> 2 batches of 3
+    first = next(iter(batches[0].values()))
+    assert np.asarray(first).shape == (3, 2)
