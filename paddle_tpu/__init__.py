@@ -88,15 +88,10 @@ def cuda_pinned_places(device_count=None):
     return [CUDAPinnedPlace() for _ in range(device_count or 1)]
 
 
-def memory_optimize(input_program, skip_opt_set=None, print_log=False,
-                    level=0, skip_grads=False):
-    """No-op under XLA: buffer reuse/inplace is done by the compiler
-    (parity shim for fluid.memory_optimize)."""
-    return None
-
-
-def release_memory(input_program, skip_opt_set=None):
-    return None
+# real lifetime-analysis implementations live in the transpiler package
+from .transpiler import memory_optimize, release_memory  # noqa: F401,E402
+from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401,E402
+from . import transpiler  # noqa: F401,E402
 
 
 __version__ = "0.1.0"

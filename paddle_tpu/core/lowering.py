@@ -43,7 +43,12 @@ class LoweringContext:
 
 # ops that are pure program structure — no runtime kernel
 _STRUCTURAL = {"feed", "fetch", "read", "double_buffer", "create_py_reader",
-               "data", "depend"}
+               "data", "depend",
+               # pserver RPC ops (transpiler/distribute_transpiler.py): in
+               # local/single-process lowering these are no-ops — params keep
+               # their scope values, the pserver applies updates remotely
+               "send", "recv", "send_barrier", "fetch_barrier",
+               "listen_and_serv", "checkpoint_notify", "gen_nccl_id"}
 
 # ops with bespoke lowering (control flow etc.) — populated by
 # ops/controlflow.py via register_special

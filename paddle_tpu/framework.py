@@ -473,10 +473,22 @@ class Program:
                 yield v
 
     # -- cloning / serialization -------------------------------------------
+    @staticmethod
+    def _is_train_only_op(op):
+        """Backward + optimizer ops, pruned by clone(for_test=True) the way
+        the reference prunes OpRole.Backward/Optimize ops."""
+        if "__fwd_op__" in op.attrs or op.type.endswith("_grad"):
+            return True
+        if op.type in _OPTIMIZER_OP_TYPES:
+            return True
+        # the loss-grad seed: fill op writing only @GRAD outputs
+        outs = op.output_names()
+        return bool(outs) and all(n.endswith("@GRAD") for n in outs)
+
     def clone(self, for_test=False):
         """Deep-copy the program. With for_test=True, switch train-only op
-        behavior (dropout, batch_norm) to inference mode (parity:
-        framework.py Program.clone)."""
+        behavior (dropout, batch_norm) to inference mode and prune
+        backward/optimizer ops (parity: framework.py Program.clone)."""
         p = Program()
         p.random_seed = self.random_seed
         p.blocks = []
@@ -513,6 +525,8 @@ class Program:
                     nv.initializer = v.initializer
                 nb.vars[name] = nv
             for op in blk.ops:
+                if for_test and self._is_train_only_op(op):
+                    continue
                 attrs = dict(op.attrs)
                 if for_test and "is_test" in _TEST_MODE_OPS.get(op.type, ()):
                     attrs["is_test"] = True
@@ -566,6 +580,14 @@ _TEST_MODE_OPS = {
     "batch_norm": ("is_test",),
     "layer_norm": (),
 }
+
+# parameter-update op types (mirrors transpiler OPTIMIZE_OP_TYPES; kept here
+# to avoid a framework -> transpiler import cycle)
+_OPTIMIZER_OP_TYPES = frozenset([
+    "sgd", "momentum", "lars_momentum", "adagrad", "adam", "adamax",
+    "adadelta", "decayed_adagrad", "rmsprop", "ftrl", "lamb",
+    "dgc_momentum", "proximal_gd", "proximal_adagrad",
+])
 
 
 # ---------------------------------------------------------------------------

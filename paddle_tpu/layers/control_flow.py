@@ -540,10 +540,13 @@ def create_array(dtype):
 
 def array_write(x, i, array=None):
     helper = LayerHelper("array_write", **locals())
+    ins = {"X": [x], "I": [i]}
     if array is None:
         array = create_array(x.dtype)
-    helper.append_op(type="array_write",
-                     inputs={"X": [x], "I": [i]},
+    else:
+        # chain the previous array value so earlier writes survive
+        ins["ArrayIn"] = [array]
+    helper.append_op(type="array_write", inputs=ins,
                      outputs={"Out": [array]})
     return array
 

@@ -454,6 +454,7 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
         attrs={"batch_size_per_im": batch_size_per_im,
                "fg_fraction": fg_fraction, "fg_thresh": fg_thresh,
                "bg_thresh_hi": bg_thresh_hi, "bg_thresh_lo": bg_thresh_lo,
+               "bbox_reg_weights": list(bbox_reg_weights),
                "class_nums": class_nums or 81})
     for v in (rois, labels, bbox_targets, in_w, out_w):
         v.stop_gradient = True

@@ -72,6 +72,23 @@ def _array_length(ctx, ins, attrs):
     return {"Out": [jnp.asarray([len(ins["X"][0])], jnp.int32)]}
 
 
+@register("tensor_array_to_tensor", differentiable=False)
+def _tensor_array_to_tensor(ctx, ins, attrs):
+    """Concat a LoDTensorArray (host-side list of arrays) along `axis`
+    (tensor_array_to_tensor_op.cc). OutIndex records each element's size
+    along the axis, the dense stand-in for the output LoD."""
+    arr = ins["X"][0]
+    axis = attrs.get("axis", 1)
+    use_stack = attrs.get("use_stack", False)
+    if use_stack:
+        out = jnp.stack(list(arr), axis=axis)
+        sizes = jnp.ones((len(arr),), jnp.int32)
+    else:
+        out = jnp.concatenate(list(arr), axis=axis)
+        sizes = jnp.asarray([a.shape[axis] for a in arr], jnp.int32)
+    return {"Out": [out], "OutIndex": [sizes]}
+
+
 def _env_of(ins, attrs):
     return dict(zip(attrs["x_names"], ins.get("X", [])))
 

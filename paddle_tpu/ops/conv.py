@@ -59,6 +59,11 @@ def _make_conv(name, nd, transpose=False):
             tuple(attrs.get("dilations", [1] * nd)),
             attrs.get("groups", 1) or 1, nd, transpose,
         )
+        if ins.get("FoldedBias"):
+            # per-out-channel shift left behind by conv+bn folding
+            # (transpiler/inference_transpiler.py)
+            b = ins["FoldedBias"][0].reshape((1, -1) + (1,) * nd)
+            out = out + b
         return {"Output": [out]}
 
     register(name)(impl)

@@ -661,18 +661,33 @@ def _rpn_target_assign(ctx, ins, attrs):
     fg_frac = attrs.get("rpn_fg_fraction", 0.5)
     pos_thr = attrs.get("rpn_positive_overlap", 0.7)
     neg_thr = attrs.get("rpn_negative_overlap", 0.3)
+    straddle = attrs.get("rpn_straddle_thresh", 0.0)
     fg_max = int(batch * fg_frac)
     A = anchors.shape[0]
 
     iou = _iou_matrix(anchors, gt)           # [A, G]
+    # crowd gt regions are excluded from matching entirely (their columns
+    # zeroed); anchors whose best box is crowd become plain background
+    if ins.get("IsCrowd"):
+        crowd = ins["IsCrowd"][0].reshape((-1,)).astype(bool)
+        iou = jnp.where(crowd[None, :], 0.0, iou)
     best_gt = jnp.argmax(iou, axis=1)        # [A]
     best_iou = jnp.max(iou, axis=1)
     # anchors with best overlap per gt are fg regardless of threshold
     per_gt_best = jnp.max(iou, axis=0)       # [G]
     is_best_of_gt = jnp.any(
         (iou == per_gt_best[None, :]) & (per_gt_best[None, :] > 0), axis=1)
-    fg_mask = (best_iou >= pos_thr) | is_best_of_gt
-    bg_mask = (best_iou < neg_thr) & ~fg_mask
+    inside_img = jnp.ones((A,), bool)
+    if ins.get("ImInfo") and straddle >= 0:
+        # discard anchors straddling the image border by > straddle pixels
+        im = ins["ImInfo"][0].reshape((-1,))  # [h, w, scale]
+        h, w = im[0], im[1]
+        inside_img = ((anchors[:, 0] >= -straddle)
+                      & (anchors[:, 1] >= -straddle)
+                      & (anchors[:, 2] < w + straddle)
+                      & (anchors[:, 3] < h + straddle))
+    fg_mask = ((best_iou >= pos_thr) | is_best_of_gt) & inside_img
+    bg_mask = (best_iou < neg_thr) & ~fg_mask & inside_img
 
     k1, k2 = jax.random.split(ctx.rng(attrs))
     fg_idx, fg_valid = _topk_mask_indices(k1, fg_mask, fg_max)
@@ -714,15 +729,28 @@ def _generate_proposal_labels(ctx, ins, attrs):
     fg_thr = attrs.get("fg_thresh", 0.5)
     bg_hi = attrs.get("bg_thresh_hi", 0.5)
     bg_lo = attrs.get("bg_thresh_lo", 0.0)
+    reg_w = jnp.asarray(
+        attrs.get("bbox_reg_weights", [0.1, 0.1, 0.2, 0.2]), jnp.float32)
+    class_nums = attrs.get("class_nums", 81)
     fg_max = int(batch * fg_frac)
 
-    # gt boxes join the candidate pool, as in the reference
+    # gt boxes join the candidate pool, as in the reference (crowd gt is
+    # excluded from both the pool and the matching targets)
     cand = jnp.concatenate([rois, gt_boxes], axis=0)
     iou = _iou_matrix(cand, gt_boxes)
+    if ins.get("IsCrowd"):
+        crowd = ins["IsCrowd"][0].reshape((-1,)).astype(bool)
+        iou = jnp.where(crowd[None, :], 0.0, iou)
+        # the appended gt candidates that are crowd can never be selected
+        n_rois = rois.shape[0]
+        cand_is_crowd = jnp.concatenate(
+            [jnp.zeros((n_rois,), bool), crowd])
+    else:
+        cand_is_crowd = jnp.zeros((cand.shape[0],), bool)
     best_gt = jnp.argmax(iou, axis=1)
     best_iou = jnp.max(iou, axis=1)
-    fg_mask = best_iou >= fg_thr
-    bg_mask = (best_iou < bg_hi) & (best_iou >= bg_lo)
+    fg_mask = (best_iou >= fg_thr) & ~cand_is_crowd
+    bg_mask = (best_iou < bg_hi) & (best_iou >= bg_lo) & ~cand_is_crowd
 
     k1, k2 = jax.random.split(ctx.rng(attrs))
     fg_idx, fg_valid = _topk_mask_indices(k1, fg_mask, fg_max)
@@ -744,15 +772,27 @@ def _generate_proposal_labels(ctx, ins, attrs):
                      (gy - sy) / jnp.maximum(sh, 1e-6),
                      jnp.log(jnp.maximum(gw, 1e-6) / jnp.maximum(sw, 1e-6)),
                      jnp.log(jnp.maximum(gh, 1e-6) / jnp.maximum(sh, 1e-6))],
-                    axis=1)
+                    axis=1) / reg_w[None, :]
     is_fg = jnp.concatenate([fg_valid, jnp.zeros_like(bg_valid)])
     w_in = jnp.where(is_fg[:, None], 1.0, 0.0) * jnp.ones((1, 4))
+    # per-class target layout [P, 4*class_nums]: only the label's 4-slot
+    # window holds the regression target (reference bbox_targets expansion)
+    P = sel.shape[0]
+    cls_idx = jnp.clip(labels, 0, class_nums - 1)
+    onehot = jax.nn.one_hot(cls_idx, class_nums,
+                            dtype=tgt.dtype)          # [P, C]
+    tgt_pc = (onehot[:, :, None] * (tgt * w_in)[:, None, :]).reshape(
+        (P, 4 * class_nums))
+    w_in_pc = (onehot[:, :, None] * w_in[:, None, :]).reshape(
+        (P, 4 * class_nums))
+    w_out_pc = (onehot[:, :, None]
+                * jnp.where(valid, 1.0, 0.0)[:, None, None]
+                * jnp.ones((1, 1, 4))).reshape((P, 4 * class_nums))
     return {"Rois": [sel_rois],
             "LabelsInt32": [labels[:, None]],
-            "BboxTargets": [tgt * w_in],
-            "BboxInsideWeights": [w_in],
-            "BboxOutsideWeights": [jnp.where(valid[:, None], 1.0, 0.0)
-                                   * jnp.ones((1, 4))]}
+            "BboxTargets": [tgt_pc],
+            "BboxInsideWeights": [w_in_pc],
+            "BboxOutsideWeights": [w_out_pc]}
 
 
 @register("generate_mask_labels", differentiable=False)

@@ -22,7 +22,11 @@ from .registry import register, simple_op, np_dtype
 def _fill_constant(ctx, ins, attrs):
     shape = [int(s) for s in attrs["shape"]]
     dt = np_dtype(attrs.get("dtype", "float32"))
-    return {"Out": [jnp.full(shape, attrs.get("value", 0.0), dtype=dt)]}
+    # numpy, not jnp: stays a trace-time CONSTANT under jit (omnistaging
+    # would stage jnp.full into the graph), so downstream consumers that
+    # need concrete values — TensorArray indices, shape args — still work;
+    # XLA folds it identically either way
+    return {"Out": [np.full(shape, attrs.get("value", 0.0), dtype=dt)]}
 
 
 @register("fill_constant_batch_size_like", differentiable=False)

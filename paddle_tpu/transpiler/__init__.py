@@ -1,0 +1,14 @@
+"""Transpilers (parity: python/paddle/fluid/transpiler/)."""
+
+from .distribute_transpiler import (DistributeTranspiler,
+                                    DistributeTranspilerConfig)
+from .ps_dispatcher import HashName, RoundRobin
+from .memory_optimization_transpiler import (memory_optimize, release_memory,
+                                             ControlFlowGraph)
+from .inference_transpiler import InferenceTranspiler
+
+__all__ = [
+    "DistributeTranspiler", "DistributeTranspilerConfig", "HashName",
+    "RoundRobin", "memory_optimize", "release_memory", "ControlFlowGraph",
+    "InferenceTranspiler",
+]

@@ -24,12 +24,9 @@ from .ps_dispatcher import RoundRobin
 __all__ = ["DistributeTranspiler", "DistributeTranspilerConfig"]
 
 # op types that update a parameter (the reference keys off op attr
-# OpRole.Optimize; our optimizer ops are recognizable by type)
-OPTIMIZE_OP_TYPES = frozenset([
-    "sgd", "momentum", "lars_momentum", "adagrad", "adam", "adamax",
-    "adadelta", "decayed_adagrad", "rmsprop", "ftrl", "lamb",
-    "dgc_momentum", "proximal_gd", "proximal_adagrad",
-])
+# OpRole.Optimize; our optimizer ops are recognizable by type) — single
+# source of truth lives in framework (clone(for_test=True) prunes the same set)
+OPTIMIZE_OP_TYPES = framework._OPTIMIZER_OP_TYPES
 
 
 class DistributeTranspilerConfig:
@@ -161,6 +158,7 @@ class DistributeTranspiler:
         block = prog.global_block()
         block.ops = [op for op in block.ops
                      if op.type not in OPTIMIZE_OP_TYPES]
+        prog._bump_version()
 
         # per-endpoint grouped sends, in deterministic endpoint order
         by_ep = {}
@@ -243,13 +241,28 @@ class DistributeTranspiler:
 
     def get_startup_program(self, endpoint, pserver_program=None,
                             startup_program=None):
+        """Startup program for one pserver: declares this endpoint's param
+        blocks and carries over their initializer ops from the origin
+        startup program (distribute_transpiler.py get_startup_program)."""
+        startup_program = startup_program or self.startup_program
+        my_params = {pb.varname for pb in self.param_block_map
+                     if pb.endpoint == endpoint}
         prog = framework.Program()
         gb = prog.global_block()
-        for pb in self.param_block_map:
-            if pb.endpoint != endpoint:
-                continue
-            src = self.origin_program.global_block().var(pb.varname)
+        for name in sorted(my_params):
+            src = self.origin_program.global_block().var(name)
             self._mirror_var(prog, src)
+        # copy initializer ops whose outputs are this endpoint's params
+        for op in startup_program.global_block().ops:
+            outs = op.output_names()
+            if outs and all(n in my_params for n in outs):
+                gb.append_op(
+                    type=op.type,
+                    inputs={k: [self._mirror_var(prog, v) for v in vs]
+                            for k, vs in op.inputs.items()},
+                    outputs={k: [self._mirror_var(prog, v) for v in vs]
+                             for k, vs in op.outputs.items()},
+                    attrs=dict(op.attrs))
         return prog
 
     # -- TPU-native surface ---------------------------------------------

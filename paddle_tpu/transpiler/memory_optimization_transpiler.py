@@ -1,0 +1,90 @@
+"""Memory-optimization transpiler (parity: python/paddle/fluid/transpiler/
+memory_optimization_transpiler.py).
+
+Under XLA the compiler owns buffer reuse, so the reference's var-renaming
+rewrite is unnecessary for performance; what this pass provides instead is
+the same *analysis* — variable lifetimes over the op list — exposed for
+inspection, plus annotation of reusable pairs on the program (consumed by
+the executor's donation logic and by tests). `release_memory` marks
+early-freeable vars (EagerDeletionPass parity)."""
+
+from .. import framework
+
+__all__ = ["memory_optimize", "release_memory", "ControlFlowGraph"]
+
+
+class ControlFlowGraph:
+    """Forward-order lifetime analysis of one block
+    (memory_optimization_transpiler.py ControlFlowGraph)."""
+
+    def __init__(self, program):
+        self._program = program
+        block = program.global_block()
+        self.ops = list(block.ops)
+        self.first_def = {}
+        self.last_use = {}
+        for i, op in enumerate(self.ops):
+            for name in op.output_names():
+                self.first_def.setdefault(name, i)
+                self.last_use[name] = i
+            for name in op.input_names():
+                self.last_use[name] = i
+
+    def lifetime(self, varname):
+        return self.first_def.get(varname), self.last_use.get(varname)
+
+    def reusable_pairs(self, skip=()):
+        """(dead_var, new_var) pairs where dead_var's last use precedes
+        new_var's definition and shapes/dtypes match — the candidates the
+        reference would alias in place."""
+        block = self._program.global_block()
+        pairs = []
+        names = [n for n in self.first_def
+                 if n not in skip and block.has_var(n)
+                 and not getattr(block.var(n), "persistable", False)
+                 and not getattr(block.var(n), "is_data", False)]
+        for dead in names:
+            for new in names:
+                if dead == new:
+                    continue
+                dv, nv = block.var(dead), block.var(new)
+                if dv.shape != nv.shape or dv.dtype != nv.dtype:
+                    continue
+                if self.last_use[dead] < self.first_def[new]:
+                    pairs.append((dead, new))
+        return pairs
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=False):
+    """Attach the reuse plan to the program (XLA performs the actual buffer
+    aliasing; donation hints come from this annotation)."""
+    skip = set(skip_opt_set or ())
+    if skip_grads:
+        skip |= {n for n in ControlFlowGraph(input_program).first_def
+                 if n.endswith("@GRAD")}
+    cfg = ControlFlowGraph(input_program)
+    pairs = cfg.reusable_pairs(skip)
+    input_program._memory_reuse_plan = pairs
+    if print_log:
+        for dead, new in pairs:
+            print("memory_optimize: %s -> %s" % (dead, new))
+    return pairs
+
+
+def release_memory(input_program, skip_opt_set=None):
+    """Mark non-persistable vars freeable right after their last use
+    (eager_deletion_pass.cc parity)."""
+    skip = set(skip_opt_set or ())
+    cfg = ControlFlowGraph(input_program)
+    block = input_program.global_block()
+    plan = {}
+    for name, last in cfg.last_use.items():
+        if name in skip or not block.has_var(name):
+            continue
+        v = block.var(name)
+        if getattr(v, "persistable", False) or getattr(v, "is_data", False):
+            continue
+        plan[name] = last
+    input_program._eager_deletion_plan = plan
+    return plan

@@ -198,3 +198,68 @@ def test_psroi_pool_nonsquare():
     got = np.asarray(got)
     assert got.shape == (1, C, Ph, Pw)
     np.testing.assert_allclose(got[0], arr, rtol=1e-5)
+
+
+def test_generate_proposal_labels_per_class_targets_and_crowd():
+    rois_np = np.array([[0, 0, 10, 10], [40, 40, 50, 50]], np.float32)
+    gtb_np = np.array([[0, 0, 10, 10], [40, 40, 50, 50]], np.float32)
+    gtc_np = np.array([[3], [5]], np.int64)
+    crowd_np = np.array([[0], [1]], np.int64)  # second gt is crowd
+    rois = _var("rois", [2, 4])
+    gtb = _var("gtb", [2, 4])
+    gtc = _var("gtc", [2, 1], "int64")
+    crowd = _var("crowd", [2, 1], "int64")
+    C = 8
+    out_rois, labels, tgts, w_in, w_out = layers.generate_proposal_labels(
+        rois, gtc, crowd, gtb, batch_size_per_im=8, fg_fraction=0.5,
+        fg_thresh=0.5, class_nums=C)
+    outs = _run({"rois": rois_np, "gtb": gtb_np, "gtc": gtc_np,
+                 "crowd": crowd_np},
+                [labels.name, tgts.name, w_in.name])
+    l_, t_, w_ = [np.asarray(o) for o in outs]
+    assert t_.shape == (8, 4 * C) and w_.shape == (8, 4 * C)
+    # crowd gt class 5 must never appear as a label
+    assert (l_ != 5).all()
+    # fg rows put weights exactly in their class's 4-slot window
+    for i in range(8):
+        if l_[i, 0] > 0:
+            cls = int(l_[i, 0])
+            assert w_[i, 4 * cls:4 * cls + 4].sum() == 4.0
+            other = np.delete(w_[i], np.s_[4 * cls:4 * cls + 4])
+            assert other.sum() == 0.0
+
+
+def test_rpn_target_assign_crowd_excluded():
+    anchors_np = np.array([[0, 0, 10, 10], [50, 50, 60, 60]], np.float32)
+    gt_np = np.array([[0, 0, 10, 10], [50, 50, 60, 60]], np.float32)
+    crowd_np = np.array([[0], [1]], np.int64)  # second gt is crowd
+    anchor = _var("anchor", [2, 4])
+    gt = _var("gt", [2, 4])
+    crowd = _var("crowd", [2, 1], "int64")
+    bbox_pred = _var("bp", [2, 4])
+    cls_logits = _var("cl", [2, 1])
+    score, loc, lbl, tgt, w = layers.rpn_target_assign(
+        bbox_pred, cls_logits, anchor, None, gt, is_crowd=crowd,
+        rpn_batch_size_per_im=2, rpn_fg_fraction=0.5)
+    outs = _run({"anchor": anchors_np, "gt": gt_np, "crowd": crowd_np,
+                 "bp": np.zeros((2, 4), np.float32),
+                 "cl": np.zeros((2, 1), np.float32)}, [lbl.name])
+    lbl_ = np.asarray(outs[0])
+    # only ONE fg possible (anchor 0); the crowd-matching anchor is bg
+    assert (lbl_ == 1).sum() == 1
+
+
+def test_tensor_array_to_tensor():
+    from paddle_tpu import layers as L
+    x1 = _var("a1", [2, 3])
+    x2 = _var("a2", [2, 3])
+    i0 = L.fill_constant([1], "int64", 0)
+    i1 = L.fill_constant([1], "int64", 1)
+    arr = L.array_write(x1, i0)
+    L.array_write(x2, i1, array=arr)
+    out, idx = L.tensor_array_to_tensor(arr, axis=0)
+    a = np.ones((2, 3), np.float32)
+    b = np.full((2, 3), 2.0, np.float32)
+    got, = _run({"a1": a, "a2": b}, [out.name])
+    np.testing.assert_array_equal(np.asarray(got),
+                                  np.concatenate([a, b], axis=0))
