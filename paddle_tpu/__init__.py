@@ -92,6 +92,7 @@ def cuda_pinned_places(device_count=None):
 from .transpiler import memory_optimize, release_memory  # noqa: F401,E402
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401,E402
 from . import transpiler  # noqa: F401,E402
+from . import contrib  # noqa: F401,E402
 
 
 __version__ = "0.1.0"

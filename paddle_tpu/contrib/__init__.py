@@ -1,0 +1,14 @@
+"""Contrib toolkit (parity: python/paddle/fluid/contrib/ — mixed precision,
+quantization, slim, decoder, memory estimation)."""
+
+from . import mixed_precision
+from . import quantize
+from . import slim
+from . import decoder
+from .memory_usage_calc import memory_usage
+from .decoder import BeamSearchDecoder, StateCell, TrainingDecoder
+from .quantize import QuantizeTranspiler
+
+__all__ = ["mixed_precision", "quantize", "slim", "decoder", "memory_usage",
+           "BeamSearchDecoder", "StateCell", "TrainingDecoder",
+           "QuantizeTranspiler"]

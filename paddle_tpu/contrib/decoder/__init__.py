@@ -1,0 +1,4 @@
+from .beam_search_decoder import (BeamSearchDecoder, StateCell,
+                                  TrainingDecoder)
+
+__all__ = ["BeamSearchDecoder", "StateCell", "TrainingDecoder"]

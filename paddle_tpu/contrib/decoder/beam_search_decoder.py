@@ -1,0 +1,117 @@
+"""Beam-search decoder DSL (parity: python/paddle/fluid/contrib/decoder/
+beam_search_decoder.py — StateCell / TrainingDecoder / BeamSearchDecoder).
+
+TPU-native shape: instead of the reference's LoD-lane machinery, decoding
+runs the user's cell over a dense [batch, beam] layout; each step scores
+candidates, calls the beam_search op (top-k over beam*K with finished-lane
+handling) and stacks selections that beam_search_decode backtracks."""
+
+import numpy as np
+
+from ... import framework
+from ...layer_helper import LayerHelper
+from ... import layers as nn_layers
+from ...layers import extras as extra_layers
+
+__all__ = ["StateCell", "TrainingDecoder", "BeamSearchDecoder"]
+
+
+class StateCell:
+    """Named-state step cell (parity: beam_search_decoder.py StateCell).
+    Register states + input slots, then provide a compute function that maps
+    (inputs, states) -> (output scores, new states)."""
+
+    def __init__(self, inputs, states, out_state=None, name=None):
+        self._input_names = list(inputs)
+        self._state_names = list(states)
+        self._compute = None
+        self.out_state = out_state
+
+    def register_updater(self, fn):
+        """fn(inputs: dict, states: dict) -> (scores_var, new_states dict)"""
+        self._compute = fn
+        return fn
+
+    def compute_state(self, inputs, states):
+        if self._compute is None:
+            raise RuntimeError("StateCell has no registered updater")
+        return self._compute(inputs, states)
+
+
+class TrainingDecoder:
+    """Teacher-forced unroll of a StateCell over gold sequences (parity:
+    TrainingDecoder: same cell as decoding, run time-major)."""
+
+    def __init__(self, state_cell, name=None):
+        self.cell = state_cell
+
+    def __call__(self, inputs_per_step, init_states):
+        """inputs_per_step: {name: Variable [B, T, ...]}; returns stacked
+        scores [B, T, V] built with the cell."""
+        states = dict(init_states)
+        outs = []
+        T = next(iter(inputs_per_step.values())).shape[1]
+        for t in range(T):
+            step_in = {k: nn_layers.slice(v, axes=[1], starts=[t],
+                                          ends=[t + 1])
+                       for k, v in inputs_per_step.items()}
+            step_in = {k: nn_layers.squeeze(v, axes=[1])
+                       for k, v in step_in.items()}
+            scores, states = self.cell.compute_state(step_in, states)
+            outs.append(nn_layers.unsqueeze(scores, axes=[1]))
+        return nn_layers.concat(outs, axis=1)
+
+
+class BeamSearchDecoder:
+    """Dense beam search driver (parity: BeamSearchDecoder.decode()).
+
+    The user's cell maps token ids [B*W] + states -> next-token log-prob
+    scores [B*W, V]; decode() expands beams, tracks finished lanes via
+    end_id, and returns (sentence_ids [B, W, T], sentence_scores [B, W])."""
+
+    def __init__(self, state_cell, init_ids, init_scores, target_dict_dim,
+                 word_dim=None, input_var_dict=None, topk_size=None,
+                 sparse_emb=True, max_candidate_level=None,
+                 beam_size=4, end_id=1, max_len=16, name=None):
+        self.cell = state_cell
+        self.init_ids = init_ids
+        self.init_scores = init_scores
+        self.vocab_size = target_dict_dim
+        self.beam_size = beam_size
+        self.end_id = end_id
+        self.max_len = max_len
+
+    def decode(self, init_states):
+        """Build the unrolled decode graph; returns (ids, scores) vars."""
+        W, V = self.beam_size, self.vocab_size
+        pre_ids = self.init_ids          # [B, W]
+        pre_scores = self.init_scores    # [B, W]
+        states = dict(init_states)
+        step_ids, step_scores, step_parents = [], [], []
+        k = min(2 * W, V)
+        for t in range(self.max_len):
+            flat_ids = nn_layers.reshape(pre_ids, shape=[-1])  # [B*W]
+            scores, states = self.cell.compute_state(
+                {"ids": flat_ids}, states)                     # [B*W, V]
+            topv, topi = nn_layers.topk(scores, k=k)
+            # [B, W, K] candidate continuations
+            cand_scores = nn_layers.reshape(topv, shape=[-1, W, k])
+            cand_ids = nn_layers.reshape(topi, shape=[-1, W, k])
+            probs = nn_layers.exp(cand_scores)  # beam_search expects probs
+            sel_ids, sel_scores, parents = extra_layers.beam_search(
+                pre_ids, pre_scores, cand_ids, probs,
+                beam_size=W, end_id=self.end_id)
+            step_ids.append(nn_layers.unsqueeze(sel_ids, axes=[0]))
+            step_scores.append(nn_layers.unsqueeze(sel_scores, axes=[0]))
+            step_parents.append(nn_layers.unsqueeze(parents, axes=[0]))
+            pre_ids, pre_scores = sel_ids, sel_scores
+        ids_arr = nn_layers.concat(step_ids, axis=0)        # [T, B, W]
+        scores_arr = nn_layers.concat(step_scores, axis=0)
+        parents_arr = nn_layers.concat(step_parents, axis=0)
+        return extra_layers.beam_search_decode(
+            ids_arr, scores_arr, parents_arr, beam_size=W,
+            end_id=self.end_id)
+
+    # reference-API aliases
+    def __call__(self, init_states):
+        return self.decode(init_states)

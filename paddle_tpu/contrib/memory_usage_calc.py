@@ -1,0 +1,30 @@
+"""Program memory estimation (parity: python/paddle/fluid/contrib/
+memory_usage_calc.py memory_usage)."""
+
+import numpy as np
+
+from .. import framework
+
+__all__ = ["memory_usage"]
+
+_DTYPE_SIZE = {"float16": 2, "bfloat16": 2, "float32": 4, "float64": 8,
+               "int8": 1, "int16": 2, "int32": 4, "int64": 8, "uint8": 1,
+               "bool": 1}
+
+
+def memory_usage(program, batch_size=1):
+    """Rough activation+param footprint of a program in MB, resolving -1
+    batch dims with batch_size (memory_usage_calc.py:memory_usage)."""
+    if program is None:
+        program = framework.default_main_program()
+    total = 0
+    for var in program.list_vars():
+        shape = var.shape
+        if shape is None:
+            continue
+        numel = 1
+        for d in shape:
+            numel *= batch_size if d in (-1, None) else d
+        total += numel * _DTYPE_SIZE.get(str(var.dtype), 4)
+    mb = total / (1024.0 ** 2)
+    return mb, mb * 0.8, mb * 1.2  # (estimate, low, high) like the reference

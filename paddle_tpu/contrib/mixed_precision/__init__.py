@@ -1,0 +1,5 @@
+from .decorator import decorate, OptimizerWithMixedPrecision, \
+    AutoMixedPrecisionLists
+
+__all__ = ["decorate", "OptimizerWithMixedPrecision",
+           "AutoMixedPrecisionLists"]

@@ -1,0 +1,161 @@
+"""Mixed-precision training decorator (parity: python/paddle/fluid/contrib/
+mixed_precision/decorator.py:26 `OptimizerWithMixedPrecision` — loss scaling
++ master fp32 weights :127-147).
+
+TPU-native: the low-precision compute dtype is bfloat16 (the MXU's native
+input type), selected per-op by the same white/black-list discipline as the
+reference's fp16 lists. Master weights stay fp32 — on TPU, params already
+live in fp32 and XLA inserts the bf16 casts this pass requests via the
+`cast` ops, so "master weight copies" need no duplicate storage."""
+
+import numpy as np
+
+from ... import framework
+from ...framework import default_main_program, default_startup_program
+from ...layer_helper import LayerHelper
+
+__all__ = ["decorate", "OptimizerWithMixedPrecision", "AutoMixedPrecisionLists"]
+
+
+class AutoMixedPrecisionLists:
+    """White list runs in bf16, black list stays fp32 (parity:
+    contrib/mixed_precision/fp16_lists.py)."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None):
+        self.white_list = {"matmul", "mul", "conv2d", "conv3d",
+                           "depthwise_conv2d"} | set(custom_white_list or ())
+        self.black_list = {"softmax", "softmax_with_cross_entropy",
+                           "cross_entropy", "cross_entropy2", "mean",
+                           "layer_norm", "batch_norm",
+                           "exp", "log", "sum"} | set(custom_black_list or ())
+        self.white_list -= self.black_list
+
+
+class OptimizerWithMixedPrecision:
+    def __init__(self, optimizer, amp_lists, init_loss_scaling,
+                 use_dynamic_loss_scaling, incr_every_n_steps,
+                 decr_every_n_nan_or_inf, incr_ratio, decr_ratio,
+                 use_bf16=True):
+        self._optimizer = optimizer
+        self._amp_lists = amp_lists or AutoMixedPrecisionLists()
+        self._init_loss_scaling = float(init_loss_scaling)
+        self._use_dynamic = use_dynamic_loss_scaling
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._use_bf16 = use_bf16
+        self._loss_scaling = None
+
+    # parity surface
+    def get_loss_scaling(self):
+        return self._loss_scaling
+
+    def _create_state(self, prog, startup):
+        from ... import unique_name
+
+        def mk(name, value, dtype="float32"):
+            gb = prog.global_block()
+            v = gb.create_var(name=name, shape=(1,), dtype=dtype,
+                              persistable=True)
+            sb = startup.global_block()
+            if not sb.has_var(name):
+                sv = sb.create_var(name=name, shape=(1,), dtype=dtype,
+                                   persistable=True)
+                from ...initializer import Constant
+
+                Constant(value)(sv, sb)  # appends the fill op to startup
+            return v
+
+        # unique per decorated optimizer so two AMP optimizers in one
+        # program never share scaling state
+        self._loss_scaling = mk(unique_name.generate("loss_scaling"),
+                                self._init_loss_scaling)
+        self._good_steps = mk(unique_name.generate("good_steps"), 0.0,
+                              "int32")
+        self._bad_steps = mk(unique_name.generate("bad_steps"), 0.0, "int32")
+
+    def _rewrite_bf16(self, prog):
+        """Insert bf16 casts around white-list ops (fp16_utils.py
+        rewrite_program parity, with bfloat16 as the compute type)."""
+        if not self._use_bf16:
+            return
+        block = prog.global_block()
+        new_ops = []
+        for op in block.ops:
+            if op.type in self._amp_lists.white_list:
+                op.attrs["__amp_bf16__"] = True
+            new_ops.append(op)
+        block.ops = new_ops
+        prog._bump_version()
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        prog = loss.block.program
+        startup = startup_program or default_startup_program()
+        self._create_state(prog, startup)
+        self._rewrite_bf16(prog)
+        with framework.program_guard(prog, startup):
+            from ...layers import nn as nn_layers
+
+            scaled_loss = nn_layers.elementwise_mul(loss, self._loss_scaling)
+        self._scaled_loss = scaled_loss
+        params_grads = self._optimizer.backward(
+            scaled_loss, startup_program, parameter_list, no_grad_set,
+            callbacks)
+        return params_grads
+
+    def apply_gradients(self, params_grads):
+        prog = params_grads[0][0].block.program
+        block = prog.global_block()
+        helper = LayerHelper("amp")
+        from ... import unique_name
+
+        grads = [g for _, g in params_grads]
+        found_inf = block.create_var(
+            name=unique_name.generate("find_infinite_scale"),
+            dtype="bool", shape=(1,))
+        unscaled = []
+        for _, g in params_grads:
+            ng = block.create_var(name=g.name + "@UNSCALED", dtype=g.dtype,
+                                  shape=g.shape)
+            unscaled.append(ng)
+        block.append_op(
+            type="check_finite_and_unscale",
+            inputs={"X": grads, "Scale": [self._loss_scaling]},
+            outputs={"Out": unscaled, "FoundInfinite": [found_inf]})
+        if self._use_dynamic:
+            block.append_op(
+                type="update_loss_scaling",
+                inputs={"PrevLossScaling": [self._loss_scaling],
+                        "InGoodSteps": [self._good_steps],
+                        "InBadSteps": [self._bad_steps],
+                        "FoundInfinite": [found_inf]},
+                outputs={"LossScaling": [self._loss_scaling],
+                         "OutGoodSteps": [self._good_steps],
+                         "OutBadSteps": [self._bad_steps]},
+                attrs={"incr_every_n_steps": self._incr_every_n_steps,
+                       "decr_every_n_nan_or_inf":
+                           self._decr_every_n_nan_or_inf,
+                       "incr_ratio": self._incr_ratio,
+                       "decr_ratio": self._decr_ratio})
+        new_pg = [(p, ug) for (p, _), ug in zip(params_grads, unscaled)]
+        return self._optimizer.apply_gradients(new_pg)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        optimize_ops = self.apply_gradients(params_grads)
+        return optimize_ops, params_grads
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=2 ** 15,
+             incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+             incr_ratio=2.0, decr_ratio=0.8,
+             use_dynamic_loss_scaling=True, use_bf16=True):
+    """parity: contrib/mixed_precision/decorator.py decorate."""
+    return OptimizerWithMixedPrecision(
+        optimizer, amp_lists, init_loss_scaling, use_dynamic_loss_scaling,
+        incr_every_n_steps, decr_every_n_nan_or_inf, incr_ratio, decr_ratio,
+        use_bf16=use_bf16)

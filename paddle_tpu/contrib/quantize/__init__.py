@@ -1,0 +1,3 @@
+from .quantize_transpiler import QuantizeTranspiler
+
+__all__ = ["QuantizeTranspiler"]

@@ -1,0 +1,137 @@
+"""Quantization-aware-training transpiler (parity: python/paddle/fluid/
+contrib/quantize/quantize_transpiler.py QuantizeTranspiler).
+
+training_transpile: insert fake-quant(+dequant) ops on the inputs (weights
+and activations) of quantizable ops so training sees int8 rounding noise.
+freeze_program: switch activation quantizers to inference mode and bake the
+weight quantization into the stored weights (scope edit), removing the
+weight quantizers — the int8-deploy shape of the reference."""
+
+import numpy as np
+
+from ... import framework
+from ...core.scope import global_scope
+
+__all__ = ["QuantizeTranspiler"]
+
+_QUANTIZABLE = ("conv2d", "depthwise_conv2d", "mul", "matmul")
+# input slots carrying weights for each quantizable op type
+_WEIGHT_SLOTS = {"conv2d": "Filter", "depthwise_conv2d": "Filter",
+                 "mul": "Y", "matmul": "Y"}
+_ACT_SLOTS = {"conv2d": "Input", "depthwise_conv2d": "Input",
+              "mul": "X", "matmul": "X"}
+
+
+class QuantizeTranspiler:
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 activation_quantize_type="abs_max",
+                 weight_quantize_type="abs_max", window_size=10000,
+                 moving_rate=0.9):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.activation_quantize_type = activation_quantize_type
+        self.weight_quantize_type = weight_quantize_type
+        self.window_size = window_size
+        self.moving_rate = moving_rate
+
+    # -- train-time rewrite ----------------------------------------------
+
+    def training_transpile(self, program=None, startup_program=None):
+        program = program or framework.default_main_program()
+        startup = startup_program or framework.default_startup_program()
+        block = program.global_block()
+        quantized = {}  # var name -> quantized var (reuse across consumers)
+        new_ops = []
+        for op in block.ops:
+            if op.type in _QUANTIZABLE and not op.attrs.get("__quantized__"):
+                for slot, is_weight in ((_ACT_SLOTS[op.type], False),
+                                        (_WEIGHT_SLOTS[op.type], True)):
+                    vs = op.inputs.get(slot, [])
+                    if not vs:
+                        continue
+                    v = vs[0]
+                    if v.name not in quantized:
+                        qv, q_ops = self._insert_quant(
+                            block, startup, v, is_weight)
+                        quantized[v.name] = qv
+                        new_ops.extend(q_ops)
+                    op.inputs[slot] = [quantized[v.name]]
+                op.attrs["__quantized__"] = True
+            new_ops.append(op)
+        block.ops = new_ops
+        program._bump_version()
+        return program
+
+    def _insert_quant(self, block, startup, v, is_weight):
+        from ...framework import Operator
+
+        bits = self.weight_bits if is_weight else self.activation_bits
+        qtype = (self.weight_quantize_type if is_weight
+                 else self.activation_quantize_type)
+        qv = block.create_var(name=v.name + ".quantized",
+                              dtype=v.dtype, shape=v.shape)
+        qv.shape = v.shape
+        scale = block.create_var(name=v.name + ".scale", dtype=v.dtype,
+                                 shape=(1,), persistable=True)
+        ops = []
+        if qtype == "abs_max":
+            op_type = ("fake_channel_wise_quantize_abs_max"
+                       if is_weight and v.shape and len(v.shape) == 4
+                       else "fake_quantize_abs_max")
+            ops.append(Operator(
+                block, op_type, inputs={"X": [v]},
+                outputs={"Out": [qv], "OutScale": [scale]},
+                attrs={"bit_length": bits}))
+        else:  # moving_average_abs_max / range_abs_max
+            sb = startup.global_block()
+            if not sb.has_var(scale.name):
+                from ...initializer import Constant
+
+                sv = sb.create_var(name=scale.name, shape=(1,),
+                                   dtype=v.dtype, persistable=True)
+                Constant(1.0)(sv, sb)
+            op_type = ("fake_quantize_moving_average_abs_max"
+                       if qtype == "moving_average_abs_max"
+                       else "fake_quantize_range_abs_max")
+            ops.append(Operator(
+                block, op_type,
+                inputs={"X": [v], "InScale": [scale]},
+                outputs={"Out": [qv], "OutScale": [scale]},
+                attrs={"bit_length": bits,
+                       "moving_rate": self.moving_rate,
+                       "window_size": self.window_size}))
+        return qv, ops
+
+    # -- deploy-time freeze ----------------------------------------------
+
+    def freeze_program(self, program, place=None, scope=None):
+        """Bake weight quantization into stored weights and flip activation
+        quantizers to inference mode."""
+        scope = scope or global_scope()
+        block = program.global_block()
+        new_ops = []
+        for op in block.ops:
+            if op.type.startswith("fake_quantize") or \
+                    op.type == "fake_channel_wise_quantize_abs_max":
+                src = op.inputs["X"][0]
+                val = scope.get(src.name)
+                if val is not None and getattr(src, "persistable", False):
+                    # weight: snap to the quant grid once, drop the op
+                    w = np.asarray(val)
+                    bnt = (1 << (self.weight_bits - 1)) - 1
+                    if w.ndim == 4:
+                        s = np.abs(w.reshape(w.shape[0], -1)).max(axis=1)
+                        s = np.maximum(s, 1e-8).reshape(-1, 1, 1, 1)
+                    else:
+                        s = max(float(np.abs(w).max()), 1e-8)
+                    wq = np.round(w / s * bnt) / bnt * s
+                    qname = op.outputs["Out"][0].name
+                    scope.set(qname, wq.astype(w.dtype))
+                    # declare as persistable so the executor feeds it
+                    block.var(qname).persistable = True
+                    continue
+                op.attrs["is_test"] = True
+            new_ops.append(op)
+        block.ops = new_ops
+        program._bump_version()
+        return program

@@ -1,0 +1,8 @@
+"""Model-slimming toolkit (parity: python/paddle/fluid/contrib/slim/ —
+prune / quantization / distillation strategies)."""
+
+from .prune import MagnitudePruner, SensitivePruner, prune_by_ratio
+from .distillation import fsp_loss, l2_loss, soft_label_loss
+
+__all__ = ["MagnitudePruner", "SensitivePruner", "prune_by_ratio",
+           "fsp_loss", "l2_loss", "soft_label_loss"]

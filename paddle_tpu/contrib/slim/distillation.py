@@ -1,0 +1,30 @@
+"""Distillation losses (parity: contrib/slim/distillation/ — FSP, L2 and
+soft-label losses combined into the student's objective)."""
+
+from ...layers import extras as extra_layers
+from ...layers import nn as nn_layers
+
+__all__ = ["fsp_loss", "l2_loss", "soft_label_loss"]
+
+
+def fsp_loss(teacher_var1, teacher_var2, student_var1, student_var2):
+    """Flow-of-solution-procedure distillation loss (fsp DistillationLoss)."""
+    t = extra_layers.fsp_matrix(teacher_var1, teacher_var2)
+    s = extra_layers.fsp_matrix(student_var1, student_var2)
+    diff = nn_layers.elementwise_sub(t, s)
+    return nn_layers.reduce_mean(nn_layers.square(diff))
+
+
+def l2_loss(teacher_var, student_var):
+    diff = nn_layers.elementwise_sub(teacher_var, student_var)
+    return nn_layers.reduce_mean(nn_layers.square(diff))
+
+
+def soft_label_loss(teacher_var, student_var, teacher_temperature=2.0,
+                    student_temperature=2.0):
+    t = nn_layers.softmax(nn_layers.scale(teacher_var,
+                                          scale=1.0 / teacher_temperature))
+    s = nn_layers.softmax(nn_layers.scale(student_var,
+                                          scale=1.0 / student_temperature))
+    return nn_layers.reduce_mean(nn_layers.cross_entropy(
+        s, t, soft_label=True))

@@ -1,0 +1,84 @@
+"""Pruning strategies (parity: contrib/slim/prune/ — magnitude and
+sensitivity pruners operating on scope weights)."""
+
+import numpy as np
+
+from ...core.scope import global_scope
+
+__all__ = ["MagnitudePruner", "SensitivePruner", "prune_by_ratio"]
+
+
+def prune_by_ratio(weight, ratio):
+    """Zero the smallest-|w| `ratio` fraction of entries; returns (pruned,
+    mask)."""
+    w = np.asarray(weight)
+    k = int(w.size * ratio)
+    if k == 0:
+        return w, np.ones_like(w, bool)
+    thresh = np.partition(np.abs(w).ravel(), k - 1)[k - 1]
+    mask = np.abs(w) > thresh
+    return w * mask, mask
+
+
+class MagnitudePruner:
+    """parity: slim MagnitudePruner — one-shot magnitude pruning of named
+    params in the scope; masks are remembered so apply_masks() can re-zero
+    after optimizer steps (iterative-magnitude-pruning loop)."""
+
+    def __init__(self, ratio=0.5, scope=None):
+        self.ratio = ratio
+        self._scope = scope
+        self.masks = {}
+
+    @property
+    def scope(self):
+        return self._scope or global_scope()
+
+    def prune(self, param_names):
+        stats = {}
+        for name in param_names:
+            w = self.scope.get(name)
+            if w is None:
+                raise KeyError("param %r not in scope" % name)
+            pruned, mask = prune_by_ratio(w, self.ratio)
+            self.scope.set(name, pruned)
+            self.masks[name] = mask
+            stats[name] = 1.0 - mask.mean()
+        return stats
+
+    def apply_masks(self):
+        for name, mask in self.masks.items():
+            w = self.scope.get(name)
+            self.scope.set(name, np.asarray(w) * mask)
+
+
+class SensitivePruner(MagnitudePruner):
+    """Pick per-param ratios by loss sensitivity: params whose pruning
+    degrades `eval_fn` least are pruned hardest (parity:
+    slim/prune sensitive pruning)."""
+
+    def sensitivities(self, param_names, eval_fn, ratios=(0.1, 0.3, 0.5)):
+        base = float(eval_fn())
+        table = {}
+        for name in param_names:
+            orig = np.asarray(self.scope.get(name)).copy()
+            table[name] = []
+            for r in ratios:
+                pruned, _ = prune_by_ratio(orig, r)
+                self.scope.set(name, pruned)
+                table[name].append(float(eval_fn()) - base)
+            self.scope.set(name, orig)
+        return table
+
+    def prune_sensitive(self, param_names, eval_fn, budget_ratio=0.5,
+                        ratios=(0.1, 0.3, 0.5)):
+        sens = self.sensitivities(param_names, eval_fn, ratios)
+        # hardest pruning to the least-sensitive params
+        order = sorted(param_names,
+                       key=lambda n: abs(sens[n][-1]))
+        stats = {}
+        for i, name in enumerate(order):
+            self.ratio = budget_ratio if i < len(order) // 2 else \
+                budget_ratio / 2
+            stats.update(self.prune([name]))
+        return stats

@@ -479,7 +479,7 @@ class Program:
         the reference prunes OpRole.Backward/Optimize ops."""
         if "__fwd_op__" in op.attrs or op.type.endswith("_grad"):
             return True
-        if op.type in _OPTIMIZER_OP_TYPES:
+        if op.type in _OPTIMIZER_OP_TYPES or op.type in _AMP_STATE_OP_TYPES:
             return True
         # the loss-grad seed: fill op writing only @GRAD outputs
         outs = op.output_names()
@@ -587,6 +587,12 @@ _OPTIMIZER_OP_TYPES = frozenset([
     "sgd", "momentum", "lars_momentum", "adagrad", "adam", "adamax",
     "adadelta", "decayed_adagrad", "rmsprop", "ftrl", "lamb",
     "dgc_momentum", "proximal_gd", "proximal_adagrad",
+])
+
+# AMP loss-scaling machinery (contrib/mixed_precision): reads @GRAD vars and
+# mutates persistent scaling state — train-only, pruned with the backward ops
+_AMP_STATE_OP_TYPES = frozenset([
+    "check_finite_and_unscale", "update_loss_scaling",
 ])
 
 

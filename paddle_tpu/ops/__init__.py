@@ -17,3 +17,4 @@ from . import detection_ops  # noqa: F401
 from . import metric_ops  # noqa: F401
 from . import controlflow  # noqa: F401
 from . import misc_ops  # noqa: F401
+from . import quant_ops  # noqa: F401

@@ -19,12 +19,14 @@ def _conv_nd(x, w, strides, paddings, dilations, groups, nd, transpose=False):
     dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, dn_str)
     pads = [(p, p) for p in paddings]
     if not transpose:
+        # NOTE: no preferred_element_type here — its transpose rule can't
+        # match a trailing cast (mixed-dtype grad error); XLA accumulates
+        # bf16 convs in fp32 on the MXU regardless
         return jax.lax.conv_general_dilated(
             x, w, window_strides=strides, padding=pads,
             rhs_dilation=dilations, dimension_numbers=dn,
             feature_group_count=groups,
-            preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
-        ).astype(x.dtype)
+        )
     # conv transpose: fractionally-strided conv. Fluid filter layout is
     # [C_in, C_out/groups, *k]; flip spatial dims and swap io.
     w_t = jnp.swapaxes(w, 0, 1)  # [C_out/groups, C_in, *k]
@@ -49,9 +51,19 @@ def _conv_nd(x, w, strides, paddings, dilations, groups, nd, transpose=False):
     ).astype(x.dtype)
 
 
+def _amp_bf16_pair(x, w, attrs):
+    """AMP white-list marking (contrib/mixed_precision): bf16 inputs with
+    fp32 accumulation — exactly the MXU's native mode. Differentiable
+    because the cast sits inside the op's own vjp."""
+    if attrs.get("__amp_bf16__") and x.dtype == jnp.float32:
+        return x.astype(jnp.bfloat16), w.astype(jnp.bfloat16)
+    return x, w
+
+
 def _make_conv(name, nd, transpose=False):
     def impl(ctx, ins, attrs):
         x, w = ins["Input"][0], ins["Filter"][0]
+        x, w = _amp_bf16_pair(x, w, attrs)
         out = _conv_nd(
             x, w,
             tuple(attrs.get("strides", [1] * nd)),
@@ -59,6 +71,8 @@ def _make_conv(name, nd, transpose=False):
             tuple(attrs.get("dilations", [1] * nd)),
             attrs.get("groups", 1) or 1, nd, transpose,
         )
+        if attrs.get("__amp_bf16__") and out.dtype == jnp.bfloat16:
+            out = out.astype(jnp.float32)
         if ins.get("FoldedBias"):
             # per-out-channel shift left behind by conv+bn folding
             # (transpiler/inference_transpiler.py)

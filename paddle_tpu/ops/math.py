@@ -126,6 +126,16 @@ def _linspace(ctx, ins, attrs):
 # -- linear algebra ----------------------------------------------------------
 
 
+def _amp_dot(x, y, attrs):
+    """AMP white-list matmul: bf16 operands, fp32 accumulation (MXU-native);
+    plain `@` otherwise."""
+    if attrs.get("__amp_bf16__") and x.dtype == jnp.float32 \
+            and y.dtype == jnp.float32:
+        return jnp.matmul(x.astype(jnp.bfloat16), y.astype(jnp.bfloat16),
+                          preferred_element_type=jnp.float32)
+    return x @ y
+
+
 @register("matmul")
 def _matmul(ctx, ins, attrs):
     x, y = ins["X"][0], ins["Y"][0]
@@ -136,7 +146,7 @@ def _matmul(ctx, ins, attrs):
         x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
     if ty:
         y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
-    out = x @ y
+    out = _amp_dot(x, y, attrs)
     if alpha != 1.0:
         out = out * alpha
     return {"Out": [out]}
@@ -152,7 +162,7 @@ def _mul(ctx, ins, attrs):
     xs, ys = x.shape, y.shape
     x2 = x.reshape((int(np.prod(xs[:xn])), int(np.prod(xs[xn:]))))
     y2 = y.reshape((int(np.prod(ys[:yn])), int(np.prod(ys[yn:]))))
-    out = x2 @ y2
+    out = _amp_dot(x2, y2, attrs)
     return {"Out": [out.reshape(xs[:xn] + ys[yn:])]}
 
 

@@ -1,0 +1,178 @@
+"""Quantization + mixed-precision ops (parity: the fake_quantize_* family
+operators/fake_quantize_op.cc, fake_dequantize_op.cc, quantize/dequantize/
+requantize mkldnn ops, and the AMP loss-scaling helpers the reference
+implements inside contrib/mixed_precision/decorator.py:127-147).
+
+Fake quantization simulates int8/intN rounding in fp32 so QAT gradients
+flow (straight-through estimator via jnp.round's zero gradient being
+replaced by identity in the custom pair below)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+
+
+def _quantize_ste(x, scale, bits):
+    """Quantize-dequantize with straight-through gradient."""
+    bnt = (1 << (bits - 1)) - 1
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(x / s, -1.0, 1.0)
+    # round with straight-through estimator: grad(round) := 1
+    rounded = q + jax.lax.stop_gradient(jnp.round(q * bnt) / bnt - q)
+    return rounded * s
+
+
+@register("fake_quantize_abs_max")
+def _fake_quantize_abs_max(ctx, ins, attrs):
+    x = ins["X"][0]
+    bits = attrs.get("bit_length", 8)
+    scale = jnp.max(jnp.abs(x))
+    out = _quantize_ste(x, scale, bits)
+    return {"Out": [out], "OutScale": [scale.reshape((1,))]}
+
+
+@register("fake_channel_wise_quantize_abs_max")
+def _fake_channel_wise_quantize_abs_max(ctx, ins, attrs):
+    x = ins["X"][0]  # [C_out, ...] conv filter layout
+    bits = attrs.get("bit_length", 8)
+    scale = jnp.max(jnp.abs(x.reshape((x.shape[0], -1))), axis=1)
+    shape = (-1,) + (1,) * (x.ndim - 1)
+    out = _quantize_ste(x, scale.reshape(shape), bits)
+    return {"Out": [out], "OutScale": [scale]}
+
+
+@register("fake_quantize_range_abs_max")
+def _fake_quantize_range_abs_max(ctx, ins, attrs):
+    """Train-time: sliding max over a window approximated by the running
+    max update rule of the reference (range_abs_max)."""
+    x = ins["X"][0]
+    bits = attrs.get("bit_length", 8)
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    in_scale = ins["InScale"][0].reshape(())
+    cur = jnp.max(jnp.abs(x))
+    scale = in_scale if is_test else jnp.maximum(cur, in_scale)
+    out = _quantize_ste(x, scale, bits)
+    return {"Out": [out], "OutScale": [scale.reshape((1,))],
+            "OutScales": [scale.reshape((1,))]}
+
+
+@register("fake_quantize_moving_average_abs_max")
+def _fake_quantize_moving_average_abs_max(ctx, ins, attrs):
+    x = ins["X"][0]
+    bits = attrs.get("bit_length", 8)
+    rate = attrs.get("moving_rate", 0.9)
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    in_scale = ins["InScale"][0].reshape(())
+    cur = jnp.max(jnp.abs(x))
+    scale = in_scale if is_test else rate * in_scale + (1 - rate) * cur
+    out = _quantize_ste(x, scale, bits)
+    return {"Out": [out], "OutScale": [scale.reshape((1,))]}
+
+
+@register("fake_quantize_dequantize_moving_average_abs_max")
+def _fake_qdq_moving_average(ctx, ins, attrs):
+    return _fake_quantize_moving_average_abs_max(ctx, ins, attrs)
+
+
+@register("moving_average_abs_max_scale", differentiable=False)
+def _moving_average_abs_max_scale(ctx, ins, attrs):
+    x = ins["X"][0]
+    rate = attrs.get("moving_rate", 0.9)
+    in_scale = ins["InScale"][0].reshape(())
+    cur = jnp.max(jnp.abs(x))
+    scale = rate * in_scale + (1 - rate) * cur
+    return {"Out": [x], "OutScale": [scale.reshape((1,))]}
+
+
+@register("fake_dequantize_max_abs")
+def _fake_dequantize_max_abs(ctx, ins, attrs):
+    x = ins["X"][0]
+    scale = ins["Scale"][0].reshape(())
+    max_range = attrs.get("max_range", 127.0)
+    return {"Out": [x * scale / max_range]}
+
+
+@register("fake_channel_wise_dequantize_max_abs")
+def _fake_channel_wise_dequantize_max_abs(ctx, ins, attrs):
+    x = ins["X"][0]
+    scales = ins["Scales"]
+    quant_bits = attrs.get("quant_bits", [8])
+    out = x
+    s0 = scales[0].reshape((-1,) + (1,) * (x.ndim - 1))
+    out = out * s0 / float((1 << (quant_bits[0] - 1)) - 1)
+    if len(scales) > 1 and len(quant_bits) > 1:
+        out = out * scales[1].reshape(()) / float(
+            (1 << (quant_bits[1] - 1)) - 1)
+    return {"Out": [out]}
+
+
+@register("quantize", differentiable=False)
+def _quantize(ctx, ins, attrs):
+    x = ins["Input"][0]
+    scale = attrs.get("Scale", 1.0)
+    return {"Output": [jnp.clip(jnp.round(x * scale), -128, 127)
+                       .astype(jnp.int8)]}
+
+
+@register("dequantize", differentiable=False)
+def _dequantize(ctx, ins, attrs):
+    x = ins["Input"][0]
+    scale = attrs.get("Scale", 1.0)
+    return {"Output": [x.astype(jnp.float32) / scale]}
+
+
+@register("requantize", differentiable=False)
+def _requantize(ctx, ins, attrs):
+    x = ins["Input"][0]
+    s_in = attrs.get("Scale_in", 1.0)
+    s_out = attrs.get("Scale_out", 1.0)
+    return {"Output": [jnp.clip(jnp.round(x.astype(jnp.float32)
+                                          / s_in * s_out), -128, 127)
+                       .astype(jnp.int8)]}
+
+
+# ---------------------------------------------------------------------------
+# AMP loss-scaling helpers (contrib/mixed_precision parity; the reference
+# does this in python graph ops, amp_ops in later versions)
+# ---------------------------------------------------------------------------
+
+
+@register("check_finite_and_unscale")
+def _check_finite_and_unscale(ctx, ins, attrs):
+    grads = ins["X"]
+    scale = ins["Scale"][0].reshape(())
+    finite = jnp.asarray(True)
+    for g in grads:
+        finite = finite & jnp.all(jnp.isfinite(g))
+    outs = [jnp.where(finite, g / scale, jnp.zeros_like(g)) for g in grads]
+    return {"Out": outs, "FoundInfinite": [(~finite).reshape((1,))]}
+
+
+@register("update_loss_scaling", differentiable=False)
+def _update_loss_scaling(ctx, ins, attrs):
+    """Dynamic loss scaling state machine (decorator.py:127-147): double the
+    scale after incr_every_n consecutive finite steps, halve on overflow."""
+    scale = ins["PrevLossScaling"][0].reshape(())
+    good = ins["InGoodSteps"][0].reshape(()).astype(jnp.int32)
+    bad = ins["InBadSteps"][0].reshape(()).astype(jnp.int32)
+    found_inf = ins["FoundInfinite"][0].reshape(()).astype(bool)
+    incr_every = attrs.get("incr_every_n_steps", 1000)
+    decr_every = attrs.get("decr_every_n_nan_or_inf", 2)
+    incr_ratio = attrs.get("incr_ratio", 2.0)
+    decr_ratio = attrs.get("decr_ratio", 0.5)
+
+    good_n = jnp.where(found_inf, 0, good + 1)
+    bad_n = jnp.where(found_inf, bad + 1, 0)
+    grow = (~found_inf) & (good_n >= incr_every)
+    shrink = found_inf & (bad_n >= decr_every)
+    new_scale = jnp.where(grow, scale * incr_ratio,
+                          jnp.where(shrink,
+                                    jnp.maximum(scale * decr_ratio, 1.0),
+                                    scale))
+    good_n = jnp.where(grow, 0, good_n)
+    bad_n = jnp.where(shrink, 0, bad_n)
+    return {"LossScaling": [new_scale.reshape((1,))],
+            "OutGoodSteps": [good_n.reshape((1,))],
+            "OutBadSteps": [bad_n.reshape((1,))]}

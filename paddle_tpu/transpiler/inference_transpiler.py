@@ -82,15 +82,17 @@ class InferenceTranspiler:
         vals = [scope.get(n) for n in (w_name, scale_n, bias_n, mean_n, var_n)]
         if any(v is None for v in vals):
             return False  # params not materialized yet (startup not run)
+        b = None
+        if conv_bias_name is not None:
+            b = scope.get(conv_bias_name)
+            if b is None:
+                return False  # validate BEFORE mutating any weights
         w, gamma, beta, mean, var = [np.asarray(v) for v in vals]
         eps = bn_op.attrs.get("epsilon", 1e-5)
         factor = gamma / np.sqrt(var + eps)
         scope.set(w_name, w * factor.reshape((-1, 1, 1, 1)).astype(w.dtype))
         shift = (beta - mean * factor).astype(w.dtype)
         if conv_bias_name is not None:
-            b = scope.get(conv_bias_name)
-            if b is None:
-                return False
             scope.set(conv_bias_name,
                       np.asarray(b) * factor.astype(w.dtype) + shift)
         else:
@@ -116,6 +118,7 @@ class InferenceTranspiler:
         for op in block.ops:
             if op.type == "dropout":
                 src = op.inputs["X"][0]
+                src = rename.get(src.name, src)  # chained dropouts
                 impl = op.attrs.get("dropout_implementation",
                                     "downgrade_in_infer")
                 if impl == "upscale_in_train":

@@ -60,10 +60,9 @@ def memory_optimize(input_program, skip_opt_set=None, print_log=False,
     """Attach the reuse plan to the program (XLA performs the actual buffer
     aliasing; donation hints come from this annotation)."""
     skip = set(skip_opt_set or ())
-    if skip_grads:
-        skip |= {n for n in ControlFlowGraph(input_program).first_def
-                 if n.endswith("@GRAD")}
     cfg = ControlFlowGraph(input_program)
+    if skip_grads:
+        skip |= {n for n in cfg.first_def if n.endswith("@GRAD")}
     pairs = cfg.reusable_pairs(skip)
     input_program._memory_reuse_plan = pairs
     if print_log:

@@ -1,0 +1,192 @@
+"""Contrib tests: mixed precision (loss scaling + bf16), QAT transpiler,
+slim pruning/distillation, beam-search decoder DSL, memory estimation
+(parity model: unittests/test_mixed_precision*.py, test_quantize_transpiler.py,
+slim tests, test_beam_search_decoder.py)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.contrib import mixed_precision as amp
+from paddle_tpu.contrib import slim
+from paddle_tpu.contrib import QuantizeTranspiler, StateCell, \
+    BeamSearchDecoder, memory_usage
+
+
+def _mlp(x_dim=8, hidden=16):
+    x = layers.data("x", [x_dim])
+    y = layers.data("y", [1])
+    h = layers.fc(x, size=hidden, act="relu",
+                  param_attr=fluid.ParamAttr(name="w1"))
+    pred = layers.fc(h, size=1, param_attr=fluid.ParamAttr(name="w2"))
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    return loss
+
+
+def test_amp_decorator_trains_and_scales():
+    loss = _mlp()
+    opt = amp.decorate(fluid.optimizer.SGD(learning_rate=0.05),
+                       init_loss_scaling=2.0 ** 8,
+                       use_dynamic_loss_scaling=True,
+                       incr_every_n_steps=4)
+    opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    xb = rng.rand(16, 8).astype(np.float32)
+    yb = (xb.sum(1, keepdims=True) * 0.3).astype(np.float32)
+    losses = []
+    for _ in range(12):
+        l, = exe.run(fluid.default_main_program(),
+                     feed={"x": xb, "y": yb}, fetch_list=[loss.name])
+        losses.append(float(np.asarray(l).reshape(-1)[0]))
+    assert losses[-1] < losses[0] * 0.7, losses
+    # dynamic scaling: after 12 finite steps with incr_every=4 the scale grew
+    scale = float(np.asarray(fluid.global_scope().get("loss_scaling_0")))
+    assert scale > 2.0 ** 8
+
+
+def test_amp_overflow_skips_update_and_shrinks_scale():
+    loss = _mlp()
+    opt = amp.decorate(fluid.optimizer.SGD(learning_rate=0.05),
+                       init_loss_scaling=2.0 ** 10,
+                       use_dynamic_loss_scaling=True,
+                       decr_every_n_nan_or_inf=1)
+    opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    w_before = np.asarray(fluid.global_scope().get("w1")).copy()
+    xb = np.full((4, 8), np.inf, np.float32)  # forces non-finite grads
+    yb = np.ones((4, 1), np.float32)
+    exe.run(fluid.default_main_program(), feed={"x": xb, "y": yb},
+            fetch_list=[loss.name])
+    w_after = np.asarray(fluid.global_scope().get("w1"))
+    # grads were zeroed -> no weight change; scale halved
+    np.testing.assert_allclose(w_after, w_before)
+    scale = float(np.asarray(fluid.global_scope().get("loss_scaling_0"))
+                  .reshape(-1)[0])
+    np.testing.assert_allclose(scale, 2.0 ** 10 * 0.8,
+                               rtol=1e-6)  # default decr_ratio=0.8
+
+
+def test_quantize_transpiler_training_and_freeze():
+    loss = _mlp()
+    qt = QuantizeTranspiler(weight_bits=8, activation_bits=8)
+    qt.training_transpile()
+    ops = [op.type for op in fluid.default_main_program().global_block().ops]
+    assert any(o.startswith("fake_quantize") for o in ops)
+    fluid.optimizer.SGD(0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(1)
+    xb = rng.rand(16, 8).astype(np.float32)
+    yb = (xb.sum(1, keepdims=True) * 0.3).astype(np.float32)
+    losses = []
+    for _ in range(10):
+        l, = exe.run(fluid.default_main_program(),
+                     feed={"x": xb, "y": yb}, fetch_list=[loss.name])
+        losses.append(float(np.asarray(l).reshape(-1)[0]))
+    assert losses[-1] < losses[0], losses
+
+    infer = fluid.default_main_program().clone(for_test=True)
+    qt.freeze_program(infer)
+    # weight quantizers gone; weights snapped to the int8 grid
+    assert not any(op.type.startswith("fake_quantize")
+                   and op.inputs["X"][0].persistable
+                   for op in infer.global_block().ops)
+    out, = exe.run(infer, feed={"x": xb, "y": yb}, fetch_list=[loss.name])
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_slim_magnitude_pruner():
+    loss = _mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    pruner = slim.MagnitudePruner(ratio=0.5)
+    stats = pruner.prune(["w1"])
+    w = np.asarray(fluid.global_scope().get("w1"))
+    sparsity = (w == 0).mean()
+    assert 0.4 <= sparsity <= 0.6, sparsity
+    assert abs(stats["w1"] - sparsity) < 1e-6
+    # masks re-apply after updates
+    fluid.global_scope().set("w1", np.ones_like(w))
+    pruner.apply_masks()
+    w2 = np.asarray(fluid.global_scope().get("w1"))
+    assert ((w2 == 0) == (w == 0)).all()
+
+
+def test_slim_distillation_losses():
+    t1 = layers.data("t1", [4, 5, 5])
+    t2 = layers.data("t2", [6, 5, 5])
+    s1 = layers.data("s1", [4, 5, 5])
+    s2 = layers.data("s2", [6, 5, 5])
+    dl = slim.fsp_loss(t1, t2, s1, s2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(2)
+    a = rng.rand(2, 4, 5, 5).astype(np.float32)
+    b = rng.rand(2, 6, 5, 5).astype(np.float32)
+    same, = exe.run(fluid.default_main_program(),
+                    feed={"t1": a, "t2": b, "s1": a, "s2": b},
+                    fetch_list=[dl.name])
+    assert abs(float(np.asarray(same).reshape(-1)[0])) < 1e-10
+    diff, = exe.run(fluid.default_main_program(),
+                    feed={"t1": a, "t2": b,
+                          "s1": a + 1.0, "s2": b},
+                    fetch_list=[dl.name])
+    assert float(np.asarray(diff).reshape(-1)[0]) > 0
+
+
+def test_beam_search_decoder_dsl():
+    """A toy LM whose next-token distribution always prefers token
+    (prev+1) % V: greedy path from token 0 is 1,2,3,..."""
+    V, B, W, T = 6, 1, 2, 4
+    cell = StateCell(inputs=["ids"], states=[])
+
+    @cell.register_updater
+    def step(inputs, states):
+        ids = inputs["ids"]                      # [B*W]
+        onehot = layers.one_hot(layers.unsqueeze(ids, axes=[1]), V)
+        nxt = layers.concat(
+            [layers.slice(onehot, axes=[1], starts=[V - 1], ends=[V]),
+             layers.slice(onehot, axes=[1], starts=[0], ends=[V - 1])],
+            axis=1)  # shift: prob mass at (prev+1) % V
+        scores = layers.log(
+            layers.scale(nxt, scale=0.9, bias=0.1 / V))
+        return scores, states
+
+    init_ids = layers.data("init_ids", [W], dtype="int64")
+    init_scores = layers.data("init_scores", [W])
+    dec = BeamSearchDecoder(cell, init_ids, init_scores, target_dict_dim=V,
+                            beam_size=W, end_id=5, max_len=T)
+    ids, scores = dec.decode({})
+    exe = fluid.Executor(fluid.CPUPlace())
+    got, = exe.run(fluid.default_main_program(),
+                   feed={"init_ids": np.zeros((B, W), np.int64),
+                         "init_scores": np.zeros((B, W), np.float32)},
+                   fetch_list=[ids.name])
+    got = np.asarray(got)
+    assert got.shape == (B, W, T)
+    np.testing.assert_array_equal(got[0, 0], [1, 2, 3, 4])
+
+
+def test_memory_usage():
+    _mlp()
+    est, lo, hi = memory_usage(fluid.default_main_program(), batch_size=32)
+    assert est > 0 and lo < est < hi
+
+
+def test_amp_program_clones_for_inference():
+    """clone(for_test=True) must prune the loss-scaling machinery along
+    with the backward ops it reads from."""
+    loss = _mlp()
+    amp.decorate(fluid.optimizer.SGD(0.1)).minimize(loss)
+    infer = fluid.default_main_program().clone(for_test=True)
+    ops = [op.type for op in infer.global_block().ops]
+    assert "check_finite_and_unscale" not in ops
+    assert "update_loss_scaling" not in ops
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    out, = exe.run(infer, feed={"x": np.ones((2, 8), np.float32),
+                                "y": np.ones((2, 1), np.float32)},
+                   fetch_list=[loss.name])
+    assert np.isfinite(np.asarray(out)).all()
