@@ -1,0 +1,3 @@
+"""Incubating APIs (parity: python/paddle/fluid/incubate/)."""
+
+from . import fleet  # noqa: F401

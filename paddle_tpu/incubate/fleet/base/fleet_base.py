@@ -1,0 +1,9 @@
+"""parity: incubate/fleet/base/fleet_base.py — re-exports the Fleet facade
+(implementation: paddle_tpu/parallel/fleet.py)."""
+
+from ....parallel.fleet import (DistributedStrategy, Fleet,  # noqa: F401
+                                PaddleCloudRoleMaker, UserDefinedRoleMaker,
+                                fleet)
+
+__all__ = ["Fleet", "fleet", "DistributedStrategy", "PaddleCloudRoleMaker",
+           "UserDefinedRoleMaker"]

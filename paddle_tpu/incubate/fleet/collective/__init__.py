@@ -1,0 +1,7 @@
+"""parity: incubate/fleet/collective/__init__.py — collective (nccl2-mode)
+fleet; on TPU the collectives come from the mesh (SURVEY §5.8)."""
+
+from ....parallel.fleet import (CollectiveOptimizer, DistributedStrategy,
+                                fleet)
+
+__all__ = ["fleet", "CollectiveOptimizer", "DistributedStrategy"]

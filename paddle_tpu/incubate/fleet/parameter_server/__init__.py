@@ -1,0 +1,10 @@
+"""Parameter-server fleet modes (parity: incubate/fleet/parameter_server/
+— the distribute_transpiler mode and the pslib/Downpour mode).
+
+TPU-native mapping (SURVEY §2.3 P4-P7): pserver programs still exist at the
+IR level (golden-test parity via DistributeTranspiler), but execution maps
+dense param sharding to ZeRO-style opt-state sharding and giant sparse
+embeddings to host-RAM tables (parallel/host_embedding.py)."""
+
+from . import distribute_transpiler  # noqa: F401
+from . import pslib  # noqa: F401

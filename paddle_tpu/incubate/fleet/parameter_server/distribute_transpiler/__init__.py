@@ -1,0 +1,96 @@
+"""Transpiler-based PS fleet (parity: incubate/fleet/parameter_server/
+distribute_transpiler/__init__.py — fleet.init_server/run_server +
+TranspilerOptimizer wrapping DistributeTranspiler)."""
+
+from ..... import framework
+from .....parallel.fleet import Fleet as _CollectiveFleet
+from .....parallel.fleet import PaddleCloudRoleMaker, UserDefinedRoleMaker
+from .....transpiler import DistributeTranspiler, DistributeTranspilerConfig
+
+__all__ = ["fleet", "PSFleet", "TranspilerOptimizer",
+           "PaddleCloudRoleMaker", "UserDefinedRoleMaker"]
+
+
+class PSFleet(_CollectiveFleet):
+    """Fleet facade for pserver-mode training. After
+    distributed_optimizer(...).minimize(loss), workers call
+    main_program()/startup_program() for their transpiled programs and
+    servers call run_server() (which in this single-binary build returns
+    the pserver program for the hosting executor)."""
+
+    def __init__(self):
+        super().__init__()
+        self._transpiler = None
+        self._trainer_program = None
+        self._server_programs = {}
+
+    # called by TranspilerOptimizer.minimize
+    def _set_transpiler(self, t):
+        self._transpiler = t
+        self._trainer_program = t.get_trainer_program()
+
+    def main_program(self):
+        return self._trainer_program
+
+    def server_endpoints(self):
+        return (self._transpiler.pserver_endpoints
+                if self._transpiler else [])
+
+    def init_server(self, model_dir=None, **kwargs):
+        if self._transpiler is None:
+            raise RuntimeError("call distributed_optimizer().minimize first")
+        ep = (self._role_maker._current if self._role_maker else
+              self.server_endpoints()[0])
+        prog = self._transpiler.get_pserver_program(ep)
+        startup = self._transpiler.get_startup_program(ep, prog)
+        self._server_programs[ep] = (prog, startup)
+        return prog, startup
+
+    def run_server(self):
+        if not self._server_programs:
+            self.init_server()
+        return next(iter(self._server_programs.values()))
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        if strategy is None:
+            strategy = DistributeTranspilerConfig()
+        return TranspilerOptimizer(optimizer, strategy, self)
+
+    def get_sharding_plan(self):
+        """TPU-native surface: the pserver layout as a ZeRO-1 plan."""
+        return (self._transpiler.get_sharding_plan()
+                if self._transpiler else {})
+
+
+class TranspilerOptimizer:
+    """parity: TranspilerOptimizer — minimize() runs the base optimizer then
+    transpiles the program for the role set in the role maker."""
+
+    def __init__(self, optimizer, config, fleet_ref):
+        self._optimizer = optimizer
+        self.config = config
+        self._fleet = fleet_ref
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        result = self._optimizer.minimize(
+            loss, startup_program=startup_program,
+            parameter_list=parameter_list, no_grad_set=no_grad_set)
+        rm = self._fleet._role_maker
+        eps = (",".join(rm._endpoints) if rm and rm._endpoints
+               else "127.0.0.1:6170")
+        t = DistributeTranspiler(config=self.config)
+        t.transpile(trainer_id=self._fleet.worker_index(),
+                    program=loss.block.program,
+                    pservers=eps,
+                    trainers=max(self._fleet.worker_num(), 1),
+                    sync_mode=getattr(self.config, "sync_mode", True),
+                    startup_program=startup_program)
+        self._fleet._set_transpiler(t)
+        return result
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+
+fleet = PSFleet()

@@ -1,0 +1,83 @@
+"""pslib/Downpour-mode fleet (parity: incubate/fleet/parameter_server/
+pslib/__init__.py + optimizer_factory.py:39 DownpourSGD).
+
+The reference wraps Baidu's closed-source pslib PS client
+(fleet_wrapper.h:55). The TPU-native equivalent serves the same
+capability — CTR-scale sparse embeddings with dense+sparse pull/push —
+from host-RAM sharded tables (parallel/host_embedding.py): `DownpourSGD`
+routes each sparse table's update into the table's own optimizer and the
+dense params through the wrapped optimizer."""
+
+from .....parallel.fleet import Fleet as _CollectiveFleet
+from .....parallel.host_embedding import HostEmbeddingTable
+
+__all__ = ["fleet", "PSLib", "DownpourSGD"]
+
+
+class PSLib(_CollectiveFleet):
+    def __init__(self):
+        super().__init__()
+        self._tables = {}
+
+    def init_server(self, model_dir=None, **kwargs):
+        pass  # host tables are created lazily by distributed_embedding
+
+    def init_worker(self):
+        pass
+
+    def save_persistables(self, executor, dirname, **kwargs):
+        """Snapshot host tables next to the dense persistables
+        (fleet pslib save parity)."""
+        import os
+
+        import numpy as np
+
+        from ..... import io as io_mod
+
+        io_mod.save_persistables(executor, dirname)
+        from .....parallel.host_embedding import _TABLES
+
+        for name, table in _TABLES.items():
+            np.savez(os.path.join(dirname, "host_table_%s.npz" % name),
+                     **table.state_dict())
+
+    def load_persistables(self, executor, dirname, **kwargs):
+        import os
+
+        import numpy as np
+
+        from ..... import io as io_mod
+
+        io_mod.load_persistables(executor, dirname)
+        from .....parallel.host_embedding import _TABLES
+
+        for name, table in _TABLES.items():
+            path = os.path.join(dirname, "host_table_%s.npz" % name)
+            if os.path.exists(path):
+                with np.load(path) as d:
+                    table.load_state_dict(dict(d))
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return DownpourSGD(optimizer, self)
+
+
+class DownpourSGD:
+    """parity: optimizer_factory.py DownpourSGD — dense grads through the
+    wrapped optimizer; sparse tables update themselves on backward (the
+    lookup_table_host op's push)."""
+
+    def __init__(self, optimizer, fleet_ref):
+        self._optimizer = optimizer
+        self._fleet = fleet_ref
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return self._optimizer.minimize(
+            loss, startup_program=startup_program,
+            parameter_list=parameter_list, no_grad_set=no_grad_set)
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+
+fleet = PSLib()

@@ -17,6 +17,7 @@ __all__ = [
     "tensor_array_to_tensor", "get_tensor_from_selected_rows",
     "merge_selected_rows", "continuous_value_model", "chunk_eval",
     "py_func", "beam_search", "beam_search_decode",
+    "distributed_embedding",
 ]
 
 
@@ -395,3 +396,42 @@ def beam_search_decode(ids, scores, parents, beam_size=None, end_id=0,
     sent_ids.stop_gradient = True
     sent_scores.stop_gradient = True
     return sent_ids, sent_scores
+
+
+def distributed_embedding(input, table_name=None, size=None, num_shards=1,
+                          optimizer="sgd", learning_rate=0.1, name=None):
+    """Embedding served from a host-RAM sharded table with sparse
+    push-on-backward (parity: the distributed lookup table, P6/P7 —
+    transpiler/distribute_lookup_table.py + fleet pull/push; SURVEY §7
+    "host-offloaded sharded embedding tables").
+
+    size = [num_rows, dim]. Creates the table on first use."""
+    from ..parallel.host_embedding import HostEmbeddingTable, _TABLES
+    from ..initializer import Constant
+
+    helper = LayerHelper("distributed_embedding", **locals())
+    table_name = table_name or helper.name
+    if table_name not in _TABLES:
+        if size is None:
+            raise ValueError("size=[num_rows, dim] required for a new table")
+        HostEmbeddingTable(table_name, size[0], size[1],
+                           num_shards=num_shards, optimizer=optimizer,
+                           learning_rate=learning_rate)
+    dim = _TABLES[table_name].dim
+    # float anchor: the hook the gradient machinery differentiates so the
+    # backward sparse push fires (ids are integers)
+    anchor = helper.create_parameter(
+        attr=ParamAttr(name=table_name + "_anchor", trainable=True),
+        shape=[1], dtype="float32", default_initializer=Constant(0.0))
+    out = helper.create_variable_for_type_inference(dtype="float32")
+    helper.append_op(
+        type="lookup_table_host",
+        inputs={"Ids": [input], "Anchor": [anchor]},
+        outputs={"Out": [out]},
+        attrs={"table_name": table_name})
+    if input.shape:
+        shp = list(input.shape)
+        if shp and shp[-1] == 1:
+            shp = shp[:-1]
+        out.shape = tuple(shp) + (dim,)
+    return out

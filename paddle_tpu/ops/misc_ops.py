@@ -684,3 +684,21 @@ def _py_func(ctx, ins, attrs):
 
     outs = jax.pure_callback(host_fn, tuple(shape_dtypes), *xs)
     return {"Out": list(outs)}
+
+
+# ---------------------------------------------------------------------------
+# distributed lookup table (host-offloaded embedding; P6/P7 parity —
+# operators/distributed/parameter_prefetch.cc + fleet_wrapper.h pull/push)
+# ---------------------------------------------------------------------------
+
+
+@register("lookup_table_host", nondiff_inputs=("Ids",))
+def _lookup_table_host(ctx, ins, attrs):
+    from ..parallel.host_embedding import host_embedding_lookup
+
+    ids = ins["Ids"][0]
+    if ids.ndim > 1 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    anchor = ins["Anchor"][0].reshape(())
+    out = host_embedding_lookup(attrs["table_name"], ids, anchor)
+    return {"Out": [out]}

@@ -1,0 +1,160 @@
+"""Host-offloaded sharded embedding tables (M5 / SURVEY §7: the
+parameter-server capability — giant sparse embeddings served from pserver
+RAM, P6/P7 distributed lookup table + Downpour — becomes host-RAM sharding
+on TPU).
+
+The table lives in host memory (numpy), sharded by row hash across
+`num_shards` logical shards (the pserver endpoints of the reference). The
+device-side op gathers only the rows a step touches via jax.pure_callback
+(a few KB over PCIe instead of the whole table in HBM), and the backward
+pass pushes sparse row gradients back with jax.experimental.io_callback —
+the TPU analogue of PullSparseVarsSync/PushSparseVarsWithLabelAsync
+(framework/fleet/fleet_wrapper.h:62/:95)."""
+
+import threading
+
+import numpy as np
+
+__all__ = ["HostEmbeddingTable", "host_embedding_lookup"]
+
+_TABLES = {}
+
+
+class HostEmbeddingTable:
+    """Sharded host-RAM embedding with built-in sparse SGD/Adagrad update
+    (the pserver's optimizer block, distribute_lookup_table.py parity)."""
+
+    def __init__(self, name, num_rows, dim, num_shards=1, optimizer="sgd",
+                 learning_rate=0.1, init_scale=0.01, seed=0,
+                 dtype=np.float32):
+        if name in _TABLES:
+            raise ValueError("embedding table %r already exists" % name)
+        self.name = name
+        self.num_rows = num_rows
+        self.dim = dim
+        self.num_shards = num_shards
+        self.optimizer = optimizer
+        self.learning_rate = learning_rate
+        rng = np.random.RandomState(seed)
+        # row i lives on shard i % num_shards (RoundRobin dispatch parity);
+        # storage is one array per shard to mirror pserver ownership
+        self._shards = []
+        for s in range(num_shards):
+            rows = len(range(s, num_rows, num_shards))
+            self._shards.append(
+                (rng.rand(rows, dim).astype(dtype) - 0.5) * 2 * init_scale)
+        if optimizer == "adagrad":
+            self._accum = [np.zeros_like(sh) for sh in self._shards]
+        self._lock = threading.Lock()
+        _TABLES[name] = self
+
+    # -- shard addressing -------------------------------------------------
+
+    def _locate(self, ids):
+        ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        shard = ids % self.num_shards
+        local = ids // self.num_shards
+        return shard, local
+
+    # -- pull / push (the RPC surface of the reference) -------------------
+
+    def pull(self, ids):
+        """Gather rows for `ids` ([N] int) -> [N, dim]."""
+        shard, local = self._locate(ids)
+        out = np.empty((len(shard), self.dim), self._shards[0].dtype)
+        with self._lock:
+            for s in range(self.num_shards):
+                m = shard == s
+                if m.any():
+                    out[m] = self._shards[s][local[m]]
+        return out
+
+    def push(self, ids, grads):
+        """Sparse update: scatter row grads back through the optimizer."""
+        shard, local = self._locate(ids)
+        grads = np.asarray(grads).reshape(len(shard), self.dim)
+        lr = self.learning_rate
+        with self._lock:
+            for s in range(self.num_shards):
+                m = shard == s
+                if not m.any():
+                    continue
+                rows = local[m]
+                g = np.zeros_like(self._shards[s])
+                np.add.at(g, rows, grads[m])  # duplicate ids accumulate
+                touched = np.unique(rows)
+                if self.optimizer == "adagrad":
+                    self._accum[s][touched] += g[touched] ** 2
+                    self._shards[s][touched] -= lr * g[touched] / (
+                        np.sqrt(self._accum[s][touched]) + 1e-6)
+                else:  # sgd
+                    self._shards[s][touched] -= lr * g[touched]
+
+    # -- whole-table io (checkpoint parity io.py:280) ---------------------
+
+    def state_dict(self):
+        d = {"shard_%d" % s: sh for s, sh in enumerate(self._shards)}
+        if self.optimizer == "adagrad":
+            d.update({"accum_%d" % s: a for s, a in enumerate(self._accum)})
+        return d
+
+    def load_state_dict(self, d):
+        for s in range(self.num_shards):
+            self._shards[s][...] = d["shard_%d" % s]
+            if self.optimizer == "adagrad" and ("accum_%d" % s) in d:
+                self._accum[s][...] = d["accum_%d" % s]
+
+    @staticmethod
+    def get(name):
+        return _TABLES[name]
+
+    @staticmethod
+    def reset_registry():
+        _TABLES.clear()
+
+
+def host_embedding_lookup(table_name, ids, anchor=None):
+    """JAX-traceable lookup with sparse push-on-backward.
+
+    Forward: pure_callback gather of the touched rows. Backward: io_callback
+    that pushes the row gradients into the table's optimizer — gradients
+    never materialize a dense [num_rows, dim] array on device.
+
+    `anchor` is a float scalar the gradient machinery differentiates with
+    respect to (ids are integers, so without it no cotangent would reach
+    this op and the push would never fire); its returned grad is zero."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import io_callback
+
+    table = _TABLES[table_name]
+    dim = table.dim
+    if anchor is None:
+        anchor = jnp.zeros((), jnp.float32)
+
+    @jax.custom_vjp
+    def lookup(anchor_, ids_):
+        flat = ids_.reshape((-1,))
+        out = jax.pure_callback(
+            lambda i: _TABLES[table_name].pull(i),
+            jax.ShapeDtypeStruct((flat.shape[0], dim), np.float32),
+            flat)
+        return out.reshape(ids_.shape + (dim,))
+
+    def fwd(anchor_, ids_):
+        return lookup(anchor_, ids_), (anchor_, ids_)
+
+    def bwd(res, ct):
+        anchor_, ids_ = res
+        flat = ids_.reshape((-1,))
+        g = ct.reshape((-1, dim))
+        io_callback(
+            lambda i, gg: _TABLES[table_name].push(i, gg),
+            None, flat, g, ordered=True)
+        ids_grad = (jnp.zeros(ids_.shape, ids_.dtype)
+                    if jnp.issubdtype(ids_.dtype, jnp.inexact) else
+                    np.zeros(np.shape(ids_), jax.dtypes.float0))
+        return (jnp.zeros_like(anchor_), ids_grad)
+
+    lookup.defvjp(fwd, bwd)
+    return lookup(anchor, ids)
