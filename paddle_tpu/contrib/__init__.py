@@ -8,7 +8,11 @@ from . import decoder
 from .memory_usage_calc import memory_usage
 from .decoder import BeamSearchDecoder, StateCell, TrainingDecoder
 from .quantize import QuantizeTranspiler
+from .int8_utility import Calibrator
+from .slim import Compressor
+from .hdfs_utils import HDFSClient, multi_download, multi_upload
 
 __all__ = ["mixed_precision", "quantize", "slim", "decoder", "memory_usage",
            "BeamSearchDecoder", "StateCell", "TrainingDecoder",
-           "QuantizeTranspiler"]
+           "QuantizeTranspiler", "Calibrator", "Compressor", "HDFSClient",
+           "multi_download", "multi_upload"]

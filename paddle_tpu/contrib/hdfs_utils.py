@@ -1,0 +1,149 @@
+"""HDFS shell wrapper (parity: python/paddle/fluid/contrib/utils/
+hdfs_utils.py HDFSClient — drives the `hadoop fs` CLI with retries; plus
+multi_download/multi_upload helpers).
+
+Gated: every call shells out to ${hadoop_home}/bin/hadoop; environments
+without a hadoop install get a clear error instead of an import failure.
+"""
+
+import os
+import subprocess
+import time
+
+__all__ = ["HDFSClient", "multi_download", "multi_upload"]
+
+
+class HDFSClient:
+    def __init__(self, hadoop_home, configs):
+        self.pre_commands = []
+        hadoop_bin = os.path.join(hadoop_home, "bin", "hadoop")
+        self.pre_commands.append(hadoop_bin)
+        self.pre_commands.append("fs")
+        for k, v in (configs or {}).items():
+            self.pre_commands.extend(["-D", "%s=%s" % (k, v)])
+        self._hadoop_bin = hadoop_bin
+
+    def _run(self, commands, retry_times=5):
+        if not os.path.exists(self._hadoop_bin):
+            raise RuntimeError(
+                "hadoop binary not found at %s" % self._hadoop_bin)
+        cmd = self.pre_commands + commands
+        retry_times = max(int(retry_times), 1)
+        for attempt in range(retry_times):
+            proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                    stderr=subprocess.PIPE)
+            out, err = proc.communicate()
+            if proc.returncode == 0:
+                return 0, out.decode("utf-8", "replace")
+            if attempt < retry_times - 1:
+                time.sleep(min(2 ** attempt, 30))
+        return proc.returncode, err.decode("utf-8", "replace")
+
+    def is_exist(self, hdfs_path=None):
+        code, _ = self._run(["-test", "-e", hdfs_path], retry_times=1)
+        return code == 0
+
+    def is_dir(self, hdfs_path=None):
+        code, _ = self._run(["-test", "-d", hdfs_path], retry_times=1)
+        return code == 0
+
+    def upload(self, hdfs_path, local_path, overwrite=False, retry_times=5):
+        if overwrite and self.is_exist(hdfs_path):
+            self.delete(hdfs_path)
+        code, _ = self._run(["-put", local_path, hdfs_path], retry_times)
+        return code == 0
+
+    def download(self, hdfs_path, local_path, overwrite=False, unzip=False):
+        if overwrite and os.path.exists(local_path):
+            os.remove(local_path)
+        code, _ = self._run(["-get", hdfs_path, local_path])
+        if code == 0 and unzip and local_path.endswith(".gz"):
+            import gzip
+            import shutil
+
+            target = local_path[:-3]
+            with gzip.open(local_path, "rb") as src, \
+                    open(target, "wb") as dst:
+                shutil.copyfileobj(src, dst)
+        return code == 0
+
+    def delete(self, hdfs_path):
+        code, _ = self._run(["-rm", "-r", hdfs_path])
+        return code == 0
+
+    def rename(self, hdfs_src_path, hdfs_dst_path, overwrite=False):
+        if overwrite and self.is_exist(hdfs_dst_path):
+            self.delete(hdfs_dst_path)
+        code, _ = self._run(["-mv", hdfs_src_path, hdfs_dst_path])
+        return code == 0
+
+    def makedirs(self, hdfs_path):
+        code, _ = self._run(["-mkdir", "-p", hdfs_path])
+        return code == 0
+
+    @staticmethod
+    def make_local_dirs(local_path):
+        os.makedirs(local_path, exist_ok=True)
+
+    def ls(self, hdfs_path):
+        code, out = self._run(["-ls", hdfs_path])
+        if code != 0:
+            return []
+        files = []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) >= 8:
+                files.append(parts[-1])
+        return files
+
+    def lsr(self, hdfs_path, only_file=True, sort=True):
+        code, out = self._run(["-lsr", hdfs_path])
+        if code != 0:
+            return []
+        entries = []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            if only_file and parts[0].startswith("d"):
+                continue
+            entries.append((parts[-1], " ".join(parts[5:7])))
+        if sort:
+            entries.sort(key=lambda e: e[1])
+        return [e[0] for e in entries]
+
+
+def _shard(datas, trainer_id, trainers):
+    return datas[trainer_id::trainers]
+
+
+def multi_download(client, hdfs_path, local_path, trainer_id, trainers,
+                   multi_processes=5):
+    """Download this trainer's shard of files under hdfs_path (reference
+    hdfs_utils.py:437 — round-robin file split across trainers, fetched
+    with a pool of workers)."""
+    from multiprocessing.pool import ThreadPool
+
+    client.make_local_dirs(local_path)
+    all_files = client.lsr(hdfs_path)
+    my_files = _shard(all_files, trainer_id, trainers)
+    with ThreadPool(max(int(multi_processes), 1)) as pool:
+        pool.map(lambda f: client.download(
+            f, os.path.join(local_path, os.path.basename(f))), my_files)
+    return [os.path.join(local_path, os.path.basename(f)) for f in my_files]
+
+
+def multi_upload(client, hdfs_path, local_path, multi_processes=5,
+                 overwrite=False):
+    from multiprocessing.pool import ThreadPool
+
+    client.makedirs(hdfs_path)
+    jobs = []
+    for root, _, files in os.walk(local_path):
+        for f in files:
+            local_file = os.path.join(root, f)
+            rel = os.path.relpath(local_file, local_path)
+            jobs.append((os.path.join(hdfs_path, rel), local_file))
+    with ThreadPool(max(int(multi_processes), 1)) as pool:
+        pool.map(lambda j: client.upload(j[0], j[1], overwrite=overwrite),
+                 jobs)

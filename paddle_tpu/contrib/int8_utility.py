@@ -1,0 +1,165 @@
+"""Post-training int8 calibration (parity: python/paddle/fluid/contrib/
+int8_inference/utility.py Calibrator).
+
+The reference Calibrator samples fp32 activations while running a saved
+inference program, derives a per-tensor scale with the KL-divergence method
+(TensorRT-style histogram search), and rewrites the program with
+quantize/dequantize ops around quantizable ops. The TPU-native shape is the
+same three phases, but the rewritten program carries `quantize`/`dequantize`
+ops that lower to XLA int8 round-trips (ops/quant_ops.py).
+"""
+
+import numpy as np
+
+from .. import framework
+from ..core.scope import global_scope
+
+__all__ = ["Calibrator"]
+
+_QUANTIZABLE = ("conv2d", "depthwise_conv2d", "mul", "matmul")
+
+
+_NUM_BINS = 2048
+
+
+def _kl_scale(hist, amax, num_quantized_bins=255):
+    """Histogram KL search for the saturation threshold (reference
+    utility.py get_optimal_scaling_factor)."""
+    num_bins = len(hist)
+    if amax == 0.0 or hist.sum() == 0:
+        return 1.0
+    best_div, best_t = float("inf"), num_bins
+    for t in range(num_quantized_bins, num_bins + 1, 16):
+        p = hist[:t].astype(np.float64).copy()
+        p[t - 1] += hist[t:].sum()  # clip outliers into last bin
+        # quantize p into num_quantized_bins then expand back
+        chunks = np.array_split(p, num_quantized_bins)
+        q = np.concatenate([
+            np.full(len(c), c.sum() / max((c > 0).sum(), 1)) * (c > 0)
+            for c in chunks])
+        p /= max(p.sum(), 1e-12)
+        q /= max(q.sum(), 1e-12)
+        mask = p > 0
+        div = float(np.sum(p[mask] * np.log(p[mask] / np.maximum(q[mask], 1e-12))))
+        if div < best_div:
+            best_div, best_t = div, t
+    return best_t * amax / num_bins
+
+
+class Calibrator:
+    """Collect activation statistics over sample batches, then emit an
+    int8-annotated program.
+
+    Usage (reference README flow):
+        calib = Calibrator(program=infer_prog, pretrained_model=path,
+                           algo="KL")
+        for batch: exe.run(...); calib.sample_data()
+        calib.save_int8_model()
+    """
+
+    def __init__(self, program=None, pretrained_model=None, algo="KL",
+                 exe=None, output=None, feed_var_names=None,
+                 fetch_list=None, scope=None):
+        self.program = program or framework.default_main_program()
+        self.algo = algo
+        self.exe = exe
+        self.output = output
+        self.feed_var_names = feed_var_names
+        self.fetch_list = fetch_list
+        self.scope = scope or global_scope()
+        # var name -> (histogram[_NUM_BINS], running abs-max); accumulated
+        # incrementally so calibration memory is O(vars), not O(batches)
+        self._stats = {}
+        self._scales = {}
+
+    def _watched_vars(self):
+        """Only the input-slot vars — those are the ones save_int8_model
+        annotates with scales."""
+        names = set()
+        for op in self.program.global_block().ops:
+            if op.type in _QUANTIZABLE:
+                for vs in op.inputs.values():
+                    for v in vs:
+                        names.add(v.name)
+        return names
+
+    def _accumulate(self, name, arr):
+        amax_new = float(np.abs(arr).max()) if arr.size else 0.0
+        hist_old, amax_old = self._stats.get(
+            name, (np.zeros(_NUM_BINS, np.int64), 0.0))
+        amax = max(amax_old, amax_new)
+        if amax == 0.0:
+            self._stats[name] = (hist_old, 0.0)
+            return
+        if amax > amax_old and hist_old.sum() > 0:
+            # range grew: re-bin the old histogram onto the wider range
+            old_centers = (np.arange(_NUM_BINS) + 0.5) * (amax_old / _NUM_BINS)
+            idx = np.minimum(
+                (old_centers / amax * _NUM_BINS).astype(np.int64),
+                _NUM_BINS - 1)
+            rebinned = np.zeros(_NUM_BINS, np.int64)
+            np.add.at(rebinned, idx, hist_old)
+            hist_old = rebinned
+        hist_new, _ = np.histogram(np.abs(arr), bins=_NUM_BINS,
+                                   range=(0, amax))
+        self._stats[name] = (hist_old + hist_new, amax)
+
+    def sample_data(self, fetched=None):
+        """Fold activation values into the running histograms (call once
+        per calibration batch). Weights are read from the scope; activation
+        vars are not persisted by the functional executor, so pass them via
+        `fetched` (dict name->array) or use run_and_sample()."""
+        for name in self._watched_vars():
+            if fetched is not None and name in fetched:
+                arr = fetched[name]
+            else:
+                var = self.scope.find_var(name)
+                if var is None or var.get_value() is None:
+                    continue
+                arr = var.get_value()
+            self._accumulate(name, np.asarray(arr, dtype=np.float32))
+
+    def watched_fetch_list(self):
+        """Names of watched vars that must be fetched per batch (the
+        non-persistable activations)."""
+        persist = set()
+        for v in self.program.global_block().vars.values():
+            if getattr(v, "persistable", False):
+                persist.add(v.name)
+        return sorted(self._watched_vars() - persist)
+
+    def run_and_sample(self, exe, feed):
+        """Run one calibration batch, fetching the activations the scope
+        does not retain, and fold everything into the histograms."""
+        names = self.watched_fetch_list()
+        vals = exe.run(self.program, feed=feed, fetch_list=list(names))
+        self.sample_data(dict(zip(names, map(np.asarray, vals))))
+
+    def compute_scales(self):
+        for name, (hist, amax) in self._stats.items():
+            if self.algo == "KL":
+                self._scales[name] = _kl_scale(hist, amax)
+            else:  # "direct" / abs_max
+                self._scales[name] = amax or 1.0
+        return dict(self._scales)
+
+    def save_int8_model(self):
+        """Annotate quantizable ops with calibrated scales and persist the
+        program if an output path was given."""
+        if not self._scales:
+            self.compute_scales()
+        block = self.program.global_block()
+        for op in block.ops:
+            if op.type not in _QUANTIZABLE:
+                continue
+            for slot, vs in op.inputs.items():
+                for v in vs:
+                    if v.name in self._scales:
+                        op.attrs["%s_scale" % slot] = self._scales[v.name]
+            op.attrs["use_int8"] = True
+        if self.output and self.exe is not None and self.feed_var_names:
+            from .. import io
+            io.save_inference_model(self.output, self.feed_var_names,
+                                    self.fetch_list, self.exe,
+                                    main_program=self.program)
+        return self.program

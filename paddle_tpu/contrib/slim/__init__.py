@@ -3,6 +3,7 @@ prune / quantization / distillation strategies)."""
 
 from .prune import MagnitudePruner, SensitivePruner, prune_by_ratio
 from .distillation import fsp_loss, l2_loss, soft_label_loss
+from .compressor import Compressor, Context
 
 __all__ = ["MagnitudePruner", "SensitivePruner", "prune_by_ratio",
-           "fsp_loss", "l2_loss", "soft_label_loss"]
+           "fsp_loss", "l2_loss", "soft_label_loss", "Compressor", "Context"]

@@ -17,11 +17,14 @@ from . import framework
 from .core.scope import global_scope
 from .framework import Program
 
+from .reader import PyReader  # noqa: F401  (parity: fluid.io.PyReader)
+
 __all__ = [
     "save_vars", "save_params", "save_persistables",
     "load_vars", "load_params", "load_persistables",
     "save_inference_model", "load_inference_model",
     "get_program_parameter", "get_program_persistable_vars",
+    "PyReader",
 ]
 
 

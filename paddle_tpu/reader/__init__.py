@@ -15,7 +15,7 @@ import threading
 
 __all__ = ["map_readers", "buffered", "compose", "chain", "shuffle",
            "firstn", "xmap_readers", "cache", "batch", "PyReader",
-           "multiprocess_reader"]
+           "multiprocess_reader", "PipeReader"]
 
 
 def map_readers(func, *readers):
@@ -286,3 +286,66 @@ class PyReader:
 
     def next(self):
         return next(self._iter)
+
+
+class PipeReader:
+    """Stream records from a shell command's stdout (parity:
+    python/paddle/reader/decorator.py PipeReader — reads the process output
+    in chunks and yields lines; used to read from hadoop/gzip pipes)."""
+
+    def __init__(self, command, bufsize=8192, file_type="plain"):
+        if not isinstance(command, str):
+            raise TypeError("PipeReader command must be a string")
+        self.command = command
+        self.bufsize = bufsize
+        self.file_type = file_type
+
+    def get_line(self, cut_lines=True, line_break="\n"):
+        import codecs
+        import subprocess
+
+        proc = subprocess.Popen(
+            self.command.split(" "), bufsize=self.bufsize,
+            stdout=subprocess.PIPE)
+        if self.file_type == "gzip":
+            import zlib
+
+            decomp = zlib.decompressobj(32 + zlib.MAX_WBITS)
+        # incremental decoder: a multibyte char may straddle a chunk boundary
+        decoder = codecs.getincrementaldecoder("utf-8")()
+        remained = ""
+        while True:
+            buff = proc.stdout.read(self.bufsize)
+            if not buff:
+                break
+            if self.file_type == "gzip":
+                raw = decomp.decompress(buff)
+                # multi-member gzip (concatenated part files): restart the
+                # decompressor on the leftover bytes of each finished member
+                while decomp.eof and decomp.unused_data:
+                    tail = decomp.unused_data
+                    decomp = zlib.decompressobj(32 + zlib.MAX_WBITS)
+                    raw += decomp.decompress(tail)
+                decomp_buff = decoder.decode(raw)
+            else:
+                decomp_buff = decoder.decode(buff)
+            if cut_lines:
+                lines = (remained + decomp_buff).split(line_break)
+                remained = lines.pop(-1)
+                for line in lines:
+                    yield line
+            else:
+                yield decomp_buff
+        tail = decoder.decode(
+            decomp.flush() if self.file_type == "gzip" else b"", final=True)
+        if cut_lines:
+            remained += tail
+        elif tail:
+            yield tail
+        if remained:
+            yield remained
+        returncode = proc.wait()
+        if returncode != 0:
+            raise RuntimeError(
+                "PipeReader command %r exited with %d"
+                % (self.command, returncode))

@@ -190,3 +190,77 @@ def test_amp_program_clones_for_inference():
                                 "y": np.ones((2, 1), np.float32)},
                    fetch_list=[loss.name])
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_calibrator_int8_scales():
+    """Calibrator samples activations over batches and annotates the
+    program with per-slot scales (contrib/int8_inference parity)."""
+    from paddle_tpu.contrib import Calibrator
+
+    loss = _mlp()
+    infer = fluid.default_main_program().clone(for_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    calib = Calibrator(program=infer, algo="KL")
+    rng = np.random.RandomState(1)
+    for _ in range(3):
+        calib.run_and_sample(
+            exe, {"x": rng.rand(4, 8).astype(np.float32),
+                  "y": rng.rand(4, 1).astype(np.float32)})
+    scales = calib.compute_scales()
+    assert scales and all(s > 0 for s in scales.values())
+    assert "x" in scales  # activations sampled, not just weights
+    calib.save_int8_model()
+    muls = [op for op in infer.global_block().ops if op.type == "mul"]
+    assert muls and all(op.attrs.get("use_int8") for op in muls)
+
+
+def test_compressor_runs_with_strategy_hooks():
+    from paddle_tpu.contrib import Compressor
+
+    loss = _mlp()
+    fluid.optimizer.SGD(0.05).minimize(loss)
+    rng = np.random.RandomState(2)
+
+    def train_reader():
+        for _ in range(3):
+            xb = rng.rand(8, 8).astype(np.float32)
+            yield [(xb[i], xb[i].sum(keepdims=True) * 0.3)
+                   for i in range(8)]
+
+    calls = []
+
+    class Probe:
+        def on_compression_begin(self, ctx):
+            calls.append("begin")
+
+        def on_epoch_end(self, ctx):
+            calls.append("epoch%d" % ctx.epoch_id)
+
+    x = fluid.default_main_program().global_block().var("x")
+    y = fluid.default_main_program().global_block().var("y")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    comp = Compressor(fluid.CPUPlace(), fluid.global_scope(),
+                      fluid.default_main_program(),
+                      train_reader=train_reader,
+                      train_feed_list=[x, y],
+                      train_fetch_list=[loss],
+                      checkpoint_path=None, epoch=2)
+    comp.add_strategy(Probe())
+    ctx = comp.run()
+    assert calls == ["begin", "epoch0", "epoch1"]
+    assert ctx.epoch_id == 1
+
+
+def test_pipe_reader_lines():
+    from paddle_tpu.reader import PipeReader
+
+    r = PipeReader("printf a\\nb\\nc")
+    assert list(r.get_line()) == ["a", "b", "c"]
+
+
+def test_io_pyreader_alias():
+    import paddle_tpu.reader as preader
+
+    assert fluid.io.PyReader is preader.PyReader
