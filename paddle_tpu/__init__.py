@@ -62,6 +62,7 @@ from . import parallel
 from .parallel import ParallelExecutor  # noqa: F401
 from .initializer import Constant, Uniform, Normal, Xavier, MSRA  # noqa
 from .data_feeder import DataFeeder, DataFeedDesc  # noqa: F401
+from .flags import set_flags, get_flags  # noqa: F401
 from .core.tensor import LoDTensor, LoDTensorArray  # noqa: F401
 
 

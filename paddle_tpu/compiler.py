@@ -14,6 +14,7 @@ its shard and gradients are averaged by psum/num_replicas via propagation.
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import framework
@@ -108,8 +109,10 @@ class CompiledProgram:
             v.name if isinstance(v, framework.Variable) else str(v)
             for v in (fetch_list or [])
         ]
+        from .flags import flag
+
         key = (self._program.version, _feed_signature(feed),
-               tuple(fetch_names))
+               tuple(fetch_names), bool(flag("check_nan_inf")))
         step = self._compiled_steps.get(key)
         if step is None:
             step = _DataParallelStep(self._program, feed.keys(), fetch_names,
@@ -161,11 +164,23 @@ class _DataParallelStep:
         batch = NamedSharding(mesh, P("dp"))
         self._repl = repl
         self._batch = batch
+        # mesh spanning several processes (DCN): numpy feeds must become
+        # global jax.Arrays — every worker feeds the identical global batch
+        # and each process materializes only its addressable shards
+        self._multiprocess = any(
+            d.process_index != jax.process_index()
+            for d in mesh.devices.flat)
+
+        from .flags import flag
+
+        self._check_nan_inf = bool(flag("check_nan_inf"))
+        self._nan_labels = []
 
         def step(mut_state, const_state, feeds, step_counter):
             base_key = jax.random.fold_in(
                 jax.random.PRNGKey(self._seed), step_counter)
-            ctx = LoweringContext(base_key=base_key, mesh=mesh)
+            ctx = LoweringContext(base_key=base_key, mesh=mesh,
+                                  check_nan_inf=self._check_nan_inf)
             env = {}
             env.update(const_state)
             env.update(mut_state)
@@ -173,7 +188,10 @@ class _DataParallelStep:
             execute_block(block, env, ctx)
             fetches = [env[n] for n in self.fetch_names]
             new_state = {n: env[n] for n in self.state_out if n in env}
-            return fetches, new_state
+            self._nan_labels = [label for label, _ in ctx.nan_reports]
+            finite = (jnp.stack([f for _, f in ctx.nan_reports])
+                      if ctx.nan_reports else jnp.ones((0,), bool))
+            return fetches, new_state, finite
 
         # params/state replicated; feeds sharded on batch dim. XLA sharding
         # propagation turns the param-grad reductions into ICI all-reduces.
@@ -181,7 +199,7 @@ class _DataParallelStep:
             step,
             donate_argnums=(0,),
             in_shardings=(repl, repl, batch, None),
-            out_shardings=(repl, repl),
+            out_shardings=(repl, repl, repl),
         )
 
     def run(self, scope, feed):
@@ -204,8 +222,35 @@ class _DataParallelStep:
                 if arr.dtype != want:
                     arr = arr.astype(want)
             feeds[name] = arr
+        if self._multiprocess:
+            feeds = {
+                name: jax.make_array_from_callback(
+                    arr.shape, self._batch,
+                    lambda idx, a=arr: a[idx])
+                for name, arr in feeds.items()}
+            for store in (mut, const):
+                for name, val in store.items():
+                    # only host values need lifting to global arrays; after
+                    # step 1 the scope already holds repl-sharded jax.Arrays
+                    # (out_shardings) — re-lifting would round-trip all
+                    # params device->host->device every step
+                    if isinstance(val, jax.Array) and \
+                            val.sharding.is_equivalent_to(self._repl,
+                                                          np.ndim(val)):
+                        continue
+                    v = np.asarray(val)
+                    store[name] = jax.make_array_from_callback(
+                        v.shape, self._repl, lambda idx, a=v: a[idx])
         ctr = np.uint32(scope.get("__step_counter__", 0) or 0)
-        fetches, new_state = self._jitted(mut, const, feeds, ctr)
+        fetches, new_state, finite = self._jitted(mut, const, feeds, ctr)
+        if self._check_nan_inf and finite.size:
+            finite_np = np.asarray(finite)
+            if not finite_np.all():
+                bad = [label for label, ok in
+                       zip(self._nan_labels, finite_np) if not ok]
+                raise RuntimeError(
+                    "Operator output contains Inf/Nan (FLAGS_check_nan_inf): "
+                    + "; ".join(bad[:8]))
         for name, val in new_state.items():
             scope.set(name, val)
         scope.set("__step_counter__", int(ctr) + 1)

@@ -127,10 +127,22 @@ def multi_download(client, hdfs_path, local_path, trainer_id, trainers,
     client.make_local_dirs(local_path)
     all_files = client.lsr(hdfs_path)
     my_files = _shard(all_files, trainer_id, trainers)
+
+    def _local(f):
+        # preserve the remote directory structure — distinct shards often
+        # share basenames (shard0/part-00000, shard1/part-00000)
+        rel = os.path.relpath(f, hdfs_path) if f.startswith(
+            hdfs_path.rstrip("/") + "/") else f.lstrip("/")
+        return os.path.join(local_path, rel)
+
+    def _fetch(f):
+        target = _local(f)
+        os.makedirs(os.path.dirname(target), exist_ok=True)
+        client.download(f, target)
+
     with ThreadPool(max(int(multi_processes), 1)) as pool:
-        pool.map(lambda f: client.download(
-            f, os.path.join(local_path, os.path.basename(f))), my_files)
-    return [os.path.join(local_path, os.path.basename(f)) for f in my_files]
+        pool.map(_fetch, my_files)
+    return [_local(f) for f in my_files]
 
 
 def multi_upload(client, hdfs_path, local_path, multi_processes=5,

@@ -132,7 +132,8 @@ class Calibrator:
         """Run one calibration batch, fetching the activations the scope
         does not retain, and fold everything into the histograms."""
         names = self.watched_fetch_list()
-        vals = exe.run(self.program, feed=feed, fetch_list=list(names))
+        vals = exe.run(self.program, feed=feed, fetch_list=list(names),
+                       scope=self.scope)
         self.sample_data(dict(zip(names, map(np.asarray, vals))))
 
     def compute_scales(self):

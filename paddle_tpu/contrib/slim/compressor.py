@@ -197,17 +197,21 @@ class Compressor:
             return
 
     def run(self):
+        from ...core.scope import scope_guard
         from ...executor import Executor
 
         exe = Executor(self.place)
-        self._load_checkpoint()
-        self._hook("on_compression_begin")
-        for epoch_id in range(self.context.epoch_id, self.epoch):
-            self.context.epoch_id = epoch_id
-            self._hook("on_epoch_begin")
-            self._train_one_epoch(exe)
-            self._hook("on_epoch_end")
-            self._eval(exe)
-            self._save_checkpoint()
-        self._hook("on_compression_end")
+        # all training/eval/checkpoint IO resolves names in the caller's
+        # scope, not whatever global scope happens to be active
+        with scope_guard(self.scope):
+            self._load_checkpoint()
+            self._hook("on_compression_begin")
+            for epoch_id in range(self.context.epoch_id, self.epoch):
+                self.context.epoch_id = epoch_id
+                self._hook("on_epoch_begin")
+                self._train_one_epoch(exe)
+                self._hook("on_epoch_end")
+                self._eval(exe)
+                self._save_checkpoint()
+            self._hook("on_compression_end")
         return self.context

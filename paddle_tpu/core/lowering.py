@@ -26,13 +26,19 @@ class LoweringContext:
     grad op's forward recompute sees the identical mask (same fold inputs).
     """
 
-    def __init__(self, base_key, is_test=False, data_axis=None, mesh=None):
+    def __init__(self, base_key, is_test=False, data_axis=None, mesh=None,
+                 check_nan_inf=False):
         self.base_key = base_key
         self.is_test = is_test
         # mesh axis name along which data-parallel collectives run (pmean in
         # sync_batch_norm etc.); None outside shard_map/pmap tracing
         self.data_axis = data_axis
         self.mesh = mesh
+        # FLAGS_check_nan_inf parity (operator.cc:950): when on, every
+        # floating op output contributes an isfinite-all flag; the executor
+        # raises host-side naming the first offending op/var
+        self.check_nan_inf = check_nan_inf
+        self.nan_reports = []   # list of (label, bool scalar tracer)
 
     def rng(self, attrs):
         seed = attrs.get("__op_seed__")
@@ -84,10 +90,19 @@ def execute_op(op, env, ctx):
         slot: [env[v.name] for v in vs] for slot, vs in op.inputs.items() if vs
     }
     outs = opdef.impl(ctx, ins, op.attrs)
-    _bind_outputs(op, outs, env)
+    _bind_outputs(op, outs, env, ctx)
 
 
-def _bind_outputs(op, outs, env):
+def _nan_check(ctx, label, val):
+    try:
+        dt = jnp.result_type(val)
+    except TypeError:
+        return
+    if jnp.issubdtype(dt, jnp.inexact):
+        ctx.nan_reports.append((label, jnp.isfinite(val).all()))
+
+
+def _bind_outputs(op, outs, env, ctx=None):
     for slot, vs in op.outputs.items():
         if not vs:
             continue
@@ -96,6 +111,8 @@ def _bind_outputs(op, outs, env):
             continue
         for v, val in zip(vs, produced):
             env[v.name] = val
+            if ctx is not None and ctx.check_nan_inf:
+                _nan_check(ctx, "%s -> %s" % (op.type, v.name), val)
 
 
 def _zero_cotangent(primal):
@@ -172,3 +189,6 @@ def _execute_grad_op(op, env, ctx):
                 env[gname] = env[gname] + g
             else:
                 env[gname] = g
+            if ctx.check_nan_inf:
+                _nan_check(ctx, "%s_grad -> %s" % (fwd.type, gname),
+                           env[gname])

@@ -33,7 +33,10 @@ class CPUPlace(Place):
     def jax_device(self):
         import jax
 
-        cpus = jax.devices("cpu") if _has_platform("cpu") else jax.devices()
+        # local (addressable) devices: under a multi-process DCN runtime
+        # jax.devices() is global and rank>0 must not target rank 0's device
+        cpus = (jax.local_devices(backend="cpu") if _has_platform("cpu")
+                else jax.local_devices())
         return cpus[0]
 
 
@@ -48,7 +51,7 @@ class TPUPlace(Place):
     def jax_device(self):
         import jax
 
-        devs = jax.devices()
+        devs = jax.local_devices()
         return devs[self.device_id % len(devs)]
 
 

@@ -13,37 +13,11 @@ import numpy as np
 from .. import framework
 
 
-def _ser_attr(v):
-    if isinstance(v, framework.Block):
-        return {"__block__": v.idx}
-    if isinstance(v, framework.Operator):
-        return {"__op_index__": v.block.ops.index(v), "__op_block__": v.block.idx}
-    if isinstance(v, np.ndarray):
-        return {"__ndarray__": v.tolist(), "dtype": str(v.dtype)}
-    return v
-
-
 def program_to_desc(program):
-    blocks = []
-    for blk in program.blocks:
-        ops = []
-        for op in blk.ops:
-            ops.append({
-                "type": op.type,
-                "inputs": {k: [v.name for v in vs]
-                           for k, vs in op.inputs.items()},
-                "outputs": {k: [v.name for v in vs]
-                            for k, vs in op.outputs.items()},
-                "attrs": {k: _ser_attr(v) for k, v in op.attrs.items()},
-            })
-        blocks.append({
-            "idx": blk.idx,
-            "parent_idx": blk.parent_idx,
-            "vars": [v.to_desc() for v in blk.vars.values()],
-            "ops": ops,
-        })
+    # single canonical serializer: Block.to_desc / Operator.to_desc
+    # (framework.py) — keep attr handling in ONE place
     return {"version": 1, "random_seed": program.random_seed,
-            "blocks": blocks}
+            "blocks": [blk.to_desc() for blk in program.blocks]}
 
 
 def program_from_desc(desc):

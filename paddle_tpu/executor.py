@@ -73,11 +73,17 @@ class _CompiledStep:
         seed = program.random_seed or 0
         self._seed = seed
 
+        from .flags import flag
+
+        self._check_nan_inf = bool(flag("check_nan_inf"))
+        self._nan_labels = []
+
         def step(mut_state, const_state, feeds, step_counter):
             base_key = jax.random.fold_in(
                 jax.random.PRNGKey(self._seed), step_counter
             )
-            ctx = LoweringContext(base_key=base_key)
+            ctx = LoweringContext(base_key=base_key,
+                                  check_nan_inf=self._check_nan_inf)
             env = {}
             env.update(const_state)
             env.update(mut_state)
@@ -85,7 +91,12 @@ class _CompiledStep:
             execute_block(block, env, ctx)
             fetches = [env[n] for n in self.fetch_names]
             new_state = {n: env[n] for n in self.state_out if n in env}
-            return fetches, new_state
+            # FLAGS_check_nan_inf parity: one fused bool per op output;
+            # labels are trace-static, flags come back as a packed array
+            self._nan_labels = [label for label, _ in ctx.nan_reports]
+            finite = (jnp.stack([f for _, f in ctx.nan_reports])
+                      if ctx.nan_reports else jnp.ones((0,), bool))
+            return fetches, new_state, finite
 
         self._jitted = jax.jit(step, donate_argnums=(0,))
 
@@ -116,7 +127,16 @@ class _CompiledStep:
                     arr = arr.astype(want)
             feeds[name] = arr
         step_counter = np.uint32(scope.get("__step_counter__", 0) or 0)
-        fetches, new_state = self._jitted(mut, const, feeds, step_counter)
+        fetches, new_state, finite = self._jitted(
+            mut, const, feeds, step_counter)
+        if self._check_nan_inf and finite.size:
+            finite_np = np.asarray(finite)
+            if not finite_np.all():
+                bad = [label for label, ok in
+                       zip(self._nan_labels, finite_np) if not ok]
+                raise RuntimeError(
+                    "Operator output contains Inf/Nan (FLAGS_check_nan_inf): "
+                    + "; ".join(bad[:8]))
         for name, val in new_state.items():
             scope.set(name, val)
         scope.set("__step_counter__", int(step_counter) + 1)
@@ -158,11 +178,14 @@ class Executor:
             v.name if isinstance(v, framework.Variable) else str(v)
             for v in fetch_list
         ]
+        from .flags import flag
+
         key = (
             id(program),
             program.version,
             _feed_signature(feed),
             tuple(fetch_names),
+            bool(flag("check_nan_inf")),
         )
         compiled = self._cache.get(key) if use_program_cache else None
         if compiled is None:

@@ -1,0 +1,67 @@
+"""Runtime flags facade (parity: gflags + the env-var bootstrap of
+python/paddle/fluid/__init__.py:104-165 `__bootstrap__` — a curated
+FLAGS_* allowlist is read from the environment at import; programmatic
+set_flags/get_flags mirror the later fluid API).
+
+Supported flags:
+  check_nan_inf       : after every op kernel, verify all floating outputs
+                        are finite; raise naming the op/var (reference
+                        FLAGS_check_nan_inf, framework/operator.cc:950).
+                        The check compiles into the jitted step as
+                        isfinite-all reductions, so it costs one fused
+                        reduction per op output when on and nothing when off.
+  cpu_deterministic   : deterministic reductions (XLA is deterministic by
+                        default on TPU; kept for API parity).
+  eager_delete_tensor_gb : accepted for parity; XLA buffer liveness already
+                        frees intermediates (donation in executor).
+"""
+
+import os
+
+_FLAGS = {
+    "check_nan_inf": False,
+    "cpu_deterministic": True,
+    "eager_delete_tensor_gb": 0.0,
+}
+
+_ENV_ALLOWLIST = {
+    "FLAGS_check_nan_inf": ("check_nan_inf", lambda s: s not in
+                            ("0", "false", "False", "")),
+    "FLAGS_cpu_deterministic": ("cpu_deterministic", lambda s: s not in
+                                ("0", "false", "False", "")),
+    "FLAGS_eager_delete_tensor_gb": ("eager_delete_tensor_gb", float),
+}
+
+
+def _bootstrap():
+    for env, (name, conv) in _ENV_ALLOWLIST.items():
+        if env in os.environ:
+            try:
+                _FLAGS[name] = conv(os.environ[env])
+            except ValueError:
+                pass
+
+
+_bootstrap()
+
+
+def set_flags(flags):
+    for k, v in flags.items():
+        key = k[6:] if k.startswith("FLAGS_") else k
+        if key not in _FLAGS:
+            raise KeyError("unknown flag %r" % k)
+        _FLAGS[key] = v
+
+
+def get_flags(keys):
+    if isinstance(keys, str):
+        keys = [keys]
+    out = {}
+    for k in keys:
+        key = k[6:] if k.startswith("FLAGS_") else k
+        out[k] = _FLAGS[key]
+    return out
+
+
+def flag(name):
+    return _FLAGS[name]

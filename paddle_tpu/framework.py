@@ -294,6 +294,11 @@ class Operator:
         def _ser_attr(v):
             if isinstance(v, Block):
                 return {"__block__": v.idx}
+            if isinstance(v, Operator):
+                # grad ops reference their forward op (__fwd_op__); persist
+                # as (block idx, op index) and re-link on load (serde)
+                return {"__op_index__": v.block.ops.index(v),
+                        "__op_block__": v.block.idx}
             if isinstance(v, np.ndarray):
                 return {"__ndarray__": v.tolist(), "dtype": str(v.dtype)}
             return v

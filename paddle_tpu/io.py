@@ -22,7 +22,7 @@ from .reader import PyReader  # noqa: F401  (parity: fluid.io.PyReader)
 __all__ = [
     "save_vars", "save_params", "save_persistables",
     "load_vars", "load_params", "load_persistables",
-    "save_inference_model", "load_inference_model",
+    "save_inference_model", "load_inference_model", "save_train_model",
     "get_program_parameter", "get_program_persistable_vars",
     "PyReader",
 ]
@@ -171,6 +171,30 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     params = [v for v in params if v.name in used]
     arrays = _gather(global_scope(), params)
     np.savez(os.path.join(dirname, params_filename or "__params__"), **arrays)
+    return [v.name for v in target_vars]
+
+
+def save_train_model(dirname, feeded_var_names, target_vars, executor,
+                     main_program=None):
+    """Export the FULL training program (backward + optimizer ops included,
+    no pruning) plus every persistable, in the sealed __model__/__params__
+    format load_inference_model reads. This is the artifact the pure-C++
+    trainer consumes (parity: paddle/fluid/train/demo_trainer.cc, which
+    trains from a saved ProgramDesc + persistables)."""
+    from .core import native
+
+    main_program = main_program or framework.default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    meta = {
+        "program": json.loads(main_program.to_json()),
+        "feed_names": list(feeded_var_names),
+        "fetch_names": [v.name for v in target_vars],
+    }
+    with open(os.path.join(dirname, "__model__"), "wb") as f:
+        f.write(native.program_seal(json.dumps(meta).encode("utf-8")))
+    params = [v for v in main_program.list_vars() if _is_persistable(v)]
+    arrays = _gather(global_scope(), params)
+    np.savez(os.path.join(dirname, "__params__"), **arrays)
     return [v.name for v in target_vars]
 
 

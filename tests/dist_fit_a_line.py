@@ -1,0 +1,59 @@
+"""Runnable distributed worker (parity: the reference's separate runnable
+model scripts dist_mnist.py / dist_se_resnext.py driven by TestDistBase,
+test_dist_base.py:38). Trains fit-a-line data-parallel over the JAX
+distributed runtime (DCN/Gloo on CPU) and prints per-step losses on
+stdout for the parent test to compare.
+
+Env contract (PaddleCloudRoleMaker): PADDLE_TRAINER_ID,
+PADDLE_TRAINERS_NUM, PADDLE_COORDINATOR_ADDR. Run with no env for the
+single-process baseline.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu.parallel.fleet import fleet  # noqa: E402
+
+
+def main(steps=8, batch=32):
+    fleet.init()
+
+    x = fluid.layers.data(name="x", shape=[13])
+    y = fluid.layers.data(name="y", shape=[1])
+    pred = fluid.layers.fc(input=x, size=1,
+                           param_attr=fluid.ParamAttr(name="fc_w"))
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    opt = fluid.optimizer.SGD(learning_rate=0.05)
+    if fleet.worker_num() > 1:
+        opt = fleet.distributed_optimizer(opt)
+    opt.minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    prog = fluid.default_main_program()
+    if fleet.worker_num() > 1 or os.environ.get("DIST_FORCE_PARALLEL"):
+        prog = fluid.CompiledProgram(prog).with_data_parallel(
+            loss_name=loss.name)
+
+    rng = np.random.RandomState(0)   # same data stream on every worker:
+    # the global batch is identical, each process consumes its own shard
+    w = np.arange(13, dtype=np.float32)[:, None] * 0.1
+    for i in range(steps):
+        xb = rng.rand(batch, 13).astype(np.float32)
+        yb = xb @ w + 0.5
+        l, = exe.run(prog, feed={"x": xb, "y": yb}, fetch_list=[loss.name])
+        print("loss:%.8f" % float(np.asarray(l).mean()), flush=True)
+
+
+if __name__ == "__main__":
+    main()

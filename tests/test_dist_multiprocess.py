@@ -1,0 +1,81 @@
+"""Multi-process distributed training test (parity: TestDistBase,
+test_dist_base.py:305 — fork local subprocesses on free localhost ports,
+collect losses from stdout, assert trainer/local loss closeness; SURVEY §4.4
+and the §4 implication: the DCN layer gets real subprocess tests).
+
+Two trainer processes join over jax.distributed (Gloo on CPU); losses must
+match the single-process baseline bitwise-closely, because both see the
+same global batch and gradient averaging is exact.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_WORKER = os.path.join(_ROOT, "tests", "dist_fit_a_line.py")
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _clean_env(**extra):
+    env = dict(os.environ)
+    # each worker gets ONE local cpu device (the parent's 8-device flag
+    # would otherwise multiply the mesh)
+    env.pop("XLA_FLAGS", None)
+    env.pop("PADDLE_COORDINATOR_ADDR", None)
+    env.pop("PADDLE_TRAINER_ID", None)
+    env.pop("PADDLE_TRAINERS_NUM", None)
+    env.update(extra)
+    return env
+
+
+def _losses(out):
+    return [float(line.split(":")[1]) for line in out.splitlines()
+            if line.startswith("loss:")]
+
+
+def test_two_process_dcn_training_matches_local():
+    port = _free_port()
+    coord = "127.0.0.1:%d" % port
+
+    # single-process baseline
+    base = subprocess.run([sys.executable, _WORKER], env=_clean_env(),
+                          capture_output=True, text=True, timeout=300)
+    assert base.returncode == 0, base.stderr[-2000:]
+    base_losses = _losses(base.stdout)
+    assert len(base_losses) == 8 and base_losses[-1] < base_losses[0]
+
+    # two trainers over the distributed runtime
+    procs = []
+    for rank in range(2):
+        env = _clean_env(PADDLE_TRAINER_ID=str(rank),
+                         PADDLE_TRAINERS_NUM="2",
+                         PADDLE_COORDINATOR_ADDR=coord)
+        procs.append(subprocess.Popen(
+            [sys.executable, _WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("distributed worker timed out")
+        assert p.returncode == 0, err[-2000:]
+        outs.append(out)
+
+    for out in outs:
+        dist_losses = _losses(out)
+        assert len(dist_losses) == 8
+        np.testing.assert_allclose(dist_losses, base_losses,
+                                   rtol=1e-5, atol=1e-6)

@@ -518,3 +518,55 @@ def test_reader_batch_decorator_applies():
     assert len(batches) == 2  # 6 samples -> 2 batches of 3
     first = next(iter(batches[0].values()))
     assert np.asarray(first).shape == (3, 2)
+
+
+def test_check_nan_inf_flag_names_offending_op():
+    """FLAGS_check_nan_inf parity (framework/operator.cc:950): with the
+    flag on, a step producing non-finite values raises naming the op."""
+    import pytest
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    x = layers.data("x", [4])
+    y = layers.log(x)          # log(-1) -> nan
+    z = layers.scale(y, 2.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with pytest.raises(RuntimeError, match="Inf/Nan.*log"):
+            exe.run(fluid.default_main_program(),
+                    feed={"x": -np.ones((2, 4), np.float32)},
+                    fetch_list=[z.name])
+        # finite input passes
+        out, = exe.run(fluid.default_main_program(),
+                       feed={"x": np.ones((2, 4), np.float32)},
+                       fetch_list=[z.name])
+        assert np.isfinite(np.asarray(out)).all()
+    finally:
+        fluid.set_flags({"FLAGS_check_nan_inf": False})
+    # flag off: same bad input does not raise
+    out, = exe.run(fluid.default_main_program(),
+                   feed={"x": -np.ones((2, 4), np.float32)},
+                   fetch_list=[z.name])
+    assert np.isnan(np.asarray(out)).all()
+
+
+def test_check_nan_inf_applies_to_data_parallel_path():
+    import pytest
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    x = layers.data("x", [4])
+    y = layers.log(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    cp = fluid.CompiledProgram(
+        fluid.default_main_program()).with_data_parallel(loss_name=y.name)
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with pytest.raises(RuntimeError, match="Inf/Nan.*log"):
+            exe.run(cp, feed={"x": -np.ones((8, 4), np.float32)},
+                    fetch_list=[y.name])
+    finally:
+        fluid.set_flags({"FLAGS_check_nan_inf": False})

@@ -107,3 +107,29 @@ def test_inference_model_sealed_format(tmp_path):
     out, = exe.run(prog, feed={"x": np.ones((3, 4), np.float32)},
                    fetch_list=fetches)
     assert out.shape == (3, 2)
+
+
+def test_native_trainer_trains_from_saved_program(tmp_path):
+    """C26 parity: the C++ binary trains from a sealed program with no user
+    Python script, and exits 0 iff the loss decreased."""
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    binary = os.path.join(root, "native", "native_trainer")
+    if not os.path.exists(binary):
+        r = subprocess.run(["make", "-C", os.path.join(root, "native"),
+                            "native_trainer"], capture_output=True)
+        if r.returncode != 0:
+            pytest.skip("cannot build native_trainer: %s" % r.stderr[-200:])
+    model_dir = str(tmp_path / "fit_a_line")
+    env = dict(os.environ, NT_PLATFORM="cpu", PADDLE_TPU_ROOT=root)
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "tools",
+                                      "export_train_program.py"), model_dir],
+        env=env, capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-2000:]
+    r = subprocess.run([binary, model_dir, "12", "16"], env=env,
+                       capture_output=True, text=True)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    assert "TRAIN OK" in r.stdout

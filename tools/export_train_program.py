@@ -1,0 +1,34 @@
+"""Build and export a fit-a-line TRAINING program for the pure-C++ trainer
+(native/trainer.cc — C26 parity with paddle/fluid/train/demo/).
+
+Usage: python tools/export_train_program.py <out_dir>
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main(out_dir, platform=None):
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+    import paddle_tpu as fluid
+
+    x = fluid.layers.data(name="x", shape=[13])
+    y = fluid.layers.data(name="y", shape=[1])
+    pred = fluid.layers.fc(input=x, size=1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    fluid.io.save_train_model(out_dir, ["x", "y"], [loss], exe)
+    print("exported train program to", out_dir)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], platform=os.environ.get("NT_PLATFORM"))
