@@ -18,7 +18,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import framework
-from .core.lowering import LoweringContext, execute_block
+from .core.lowering import (LoweringContext, execute_block, pack_nan_reports,
+                            raise_if_nonfinite)
 from .framework import dtype_to_np
 
 __all__ = ["CompiledProgram", "ExecutionStrategy", "BuildStrategy"]
@@ -188,16 +189,17 @@ class _DataParallelStep:
             execute_block(block, env, ctx)
             fetches = [env[n] for n in self.fetch_names]
             new_state = {n: env[n] for n in self.state_out if n in env}
-            self._nan_labels = [label for label, _ in ctx.nan_reports]
-            finite = (jnp.stack([f for _, f in ctx.nan_reports])
-                      if ctx.nan_reports else jnp.ones((0,), bool))
+            self._nan_labels, finite = pack_nan_reports(ctx)
             return fetches, new_state, finite
 
         # params/state replicated; feeds sharded on batch dim. XLA sharding
         # propagation turns the param-grad reductions into ICI all-reduces.
+        # under the debug flag, keep state undonated so a nan raise can
+        # leave the scope at its pre-step values (catch-and-continue safe)
+        donate = () if self._check_nan_inf else (0,)
         self._jitted = jax.jit(
             step,
-            donate_argnums=(0,),
+            donate_argnums=donate,
             in_shardings=(repl, repl, batch, None),
             out_shardings=(repl, repl, repl),
         )
@@ -244,13 +246,10 @@ class _DataParallelStep:
         ctr = np.uint32(scope.get("__step_counter__", 0) or 0)
         fetches, new_state, finite = self._jitted(mut, const, feeds, ctr)
         if self._check_nan_inf and finite.size:
-            finite_np = np.asarray(finite)
-            if not finite_np.all():
-                bad = [label for label, ok in
-                       zip(self._nan_labels, finite_np) if not ok]
-                raise RuntimeError(
-                    "Operator output contains Inf/Nan (FLAGS_check_nan_inf): "
-                    + "; ".join(bad[:8]))
+            # state was NOT donated under the debug flag: raising here leaves
+            # the scope at its pre-step values, so the poisoned update is
+            # discarded and training can resume after catching
+            raise_if_nonfinite(self._nan_labels, finite)
         for name, val in new_state.items():
             scope.set(name, val)
         scope.set("__step_counter__", int(ctr) + 1)

@@ -11,6 +11,8 @@ which replaces Fluid's ~400 hand-written grad kernels
 (framework/grad_op_desc_maker.h machinery).
 """
 
+from contextlib import contextmanager
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -39,6 +41,20 @@ class LoweringContext:
         # raises host-side naming the first offending op/var
         self.check_nan_inf = check_nan_inf
         self.nan_reports = []   # list of (label, bool scalar tracer)
+        self._nan_suppress = 0
+
+    @contextmanager
+    def inner_trace(self):
+        """Suppress nan-report collection while lowering a control-flow
+        sub-block (lax.while_loop/cond/scan body): values produced there are
+        tracers of the INNER trace and may not leak into the outer step's
+        nan_reports. The control-flow op's own outputs are still checked by
+        `_bind_outputs` in the outer trace."""
+        self._nan_suppress += 1
+        try:
+            yield
+        finally:
+            self._nan_suppress -= 1
 
     def rng(self, attrs):
         seed = attrs.get("__op_seed__")
@@ -94,12 +110,37 @@ def execute_op(op, env, ctx):
 
 
 def _nan_check(ctx, label, val):
+    if ctx._nan_suppress:
+        return
     try:
         dt = jnp.result_type(val)
     except TypeError:
         return
     if jnp.issubdtype(dt, jnp.inexact):
         ctx.nan_reports.append((label, jnp.isfinite(val).all()))
+
+
+def pack_nan_reports(ctx):
+    """Collapse ctx.nan_reports into (static labels, packed bool tracer) for
+    a jitted step to return alongside its outputs."""
+    labels = [label for label, _ in ctx.nan_reports]
+    finite = (jnp.stack([f for _, f in ctx.nan_reports])
+              if ctx.nan_reports else jnp.ones((0,), bool))
+    return labels, finite
+
+
+def raise_if_nonfinite(labels, finite):
+    """Host-side FLAGS_check_nan_inf raise (operator.cc:950 parity), naming
+    the offending op outputs. Callers must NOT donate the step's state when
+    the flag is on: raising before write-back then leaves the scope at its
+    pre-step values, discarding the poisoned update."""
+    finite_np = np.asarray(finite)
+    if finite_np.all():
+        return
+    bad = [label for label, ok in zip(labels, finite_np) if not ok]
+    raise RuntimeError(
+        "Operator output contains Inf/Nan (FLAGS_check_nan_inf): "
+        + "; ".join(bad[:8]))
 
 
 def _bind_outputs(op, outs, env, ctx=None):

@@ -17,7 +17,8 @@ import jax
 import jax.numpy as jnp
 
 from . import framework
-from .core.lowering import LoweringContext, execute_block
+from .core.lowering import (LoweringContext, execute_block, pack_nan_reports,
+                            raise_if_nonfinite)
 from .core.place import CPUPlace, TPUPlace, default_place
 from .core.scope import Scope, global_scope, scope_guard  # noqa: F401
 from .framework import Program, dtype_to_np
@@ -93,12 +94,13 @@ class _CompiledStep:
             new_state = {n: env[n] for n in self.state_out if n in env}
             # FLAGS_check_nan_inf parity: one fused bool per op output;
             # labels are trace-static, flags come back as a packed array
-            self._nan_labels = [label for label, _ in ctx.nan_reports]
-            finite = (jnp.stack([f for _, f in ctx.nan_reports])
-                      if ctx.nan_reports else jnp.ones((0,), bool))
+            self._nan_labels, finite = pack_nan_reports(ctx)
             return fetches, new_state, finite
 
-        self._jitted = jax.jit(step, donate_argnums=(0,))
+        # under the debug flag, keep state undonated so a nan raise can
+        # leave the scope at its pre-step values (catch-and-continue safe)
+        donate = () if self._check_nan_inf else (0,)
+        self._jitted = jax.jit(step, donate_argnums=donate)
 
     def _read_state(self, scope, names):
         state = {}
@@ -130,13 +132,10 @@ class _CompiledStep:
         fetches, new_state, finite = self._jitted(
             mut, const, feeds, step_counter)
         if self._check_nan_inf and finite.size:
-            finite_np = np.asarray(finite)
-            if not finite_np.all():
-                bad = [label for label, ok in
-                       zip(self._nan_labels, finite_np) if not ok]
-                raise RuntimeError(
-                    "Operator output contains Inf/Nan (FLAGS_check_nan_inf): "
-                    + "; ".join(bad[:8]))
+            # state was NOT donated under the debug flag: raising here leaves
+            # the scope at its pre-step values, so the poisoned update is
+            # discarded and training can resume after catching
+            raise_if_nonfinite(self._nan_labels, finite)
         for name, val in new_state.items():
             scope.set(name, val)
         scope.set("__step_counter__", int(step_counter) + 1)

@@ -141,37 +141,49 @@ def _prune_program(program, feed_names, fetch_vars):
     return pruned
 
 
+def _write_sealed_model(dirname, program, feed_names, fetch_names,
+                        model_filename=None, params_filename=None,
+                        param_vars=None):
+    """Shared exporter tail: write the sealed __model__ frame (magic + format
+    version + CRC — framework/version.h IsProgramVersionSupported parity, via
+    the native layer) and, when param_vars is not None, the __params__ savez
+    of their scope values."""
+    from .core import native
+
+    os.makedirs(dirname, exist_ok=True)
+    meta = {
+        "program": json.loads(program.to_json()),
+        "feed_names": list(feed_names),
+        "fetch_names": list(fetch_names),
+    }
+    model_path = os.path.join(dirname, model_filename or "__model__")
+    with open(model_path, "wb") as f:
+        f.write(native.program_seal(json.dumps(meta).encode("utf-8")))
+    if param_vars is not None:
+        arrays = _gather(global_scope(), param_vars)
+        np.savez(os.path.join(dirname, params_filename or "__params__"),
+                 **arrays)
+
+
 def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                          main_program=None, model_filename=None,
                          params_filename=None, export_for_deployment=True,
                          program_only=False):
-    from .core import native
-
     main_program = main_program or framework.default_main_program()
     pruned = _prune_program(main_program, feeded_var_names, target_vars)
-    os.makedirs(dirname, exist_ok=True)
-    model_path = os.path.join(dirname, model_filename or "__model__")
-    meta = {
-        "program": json.loads(pruned.to_json()),
-        "feed_names": list(feeded_var_names),
-        "fetch_names": [v.name for v in target_vars],
-    }
-    # sealed binary frame: magic + format version + CRC (framework/version.h
-    # IsProgramVersionSupported parity), written by the native layer
-    with open(model_path, "wb") as f:
-        f.write(native.program_seal(json.dumps(meta).encode("utf-8")))
-    if program_only:
-        return [v.name for v in target_vars]
-    params = [v for v in pruned.list_vars() if _is_persistable(v)]
-    # only persistables actually referenced by the pruned op list
-    used = set()
-    for op in pruned.global_block().ops:
-        used.update(op.input_names())
-        used.update(op.output_names())
-    params = [v for v in params if v.name in used]
-    arrays = _gather(global_scope(), params)
-    np.savez(os.path.join(dirname, params_filename or "__params__"), **arrays)
-    return [v.name for v in target_vars]
+    fetch_names = [v.name for v in target_vars]
+    params = None
+    if not program_only:
+        params = [v for v in pruned.list_vars() if _is_persistable(v)]
+        # only persistables actually referenced by the pruned op list
+        used = set()
+        for op in pruned.global_block().ops:
+            used.update(op.input_names())
+            used.update(op.output_names())
+        params = [v for v in params if v.name in used]
+    _write_sealed_model(dirname, pruned, feeded_var_names, fetch_names,
+                        model_filename, params_filename, params)
+    return fetch_names
 
 
 def save_train_model(dirname, feeded_var_names, target_vars, executor,
@@ -181,20 +193,10 @@ def save_train_model(dirname, feeded_var_names, target_vars, executor,
     format load_inference_model reads. This is the artifact the pure-C++
     trainer consumes (parity: paddle/fluid/train/demo_trainer.cc, which
     trains from a saved ProgramDesc + persistables)."""
-    from .core import native
-
     main_program = main_program or framework.default_main_program()
-    os.makedirs(dirname, exist_ok=True)
-    meta = {
-        "program": json.loads(main_program.to_json()),
-        "feed_names": list(feeded_var_names),
-        "fetch_names": [v.name for v in target_vars],
-    }
-    with open(os.path.join(dirname, "__model__"), "wb") as f:
-        f.write(native.program_seal(json.dumps(meta).encode("utf-8")))
     params = [v for v in main_program.list_vars() if _is_persistable(v)]
-    arrays = _gather(global_scope(), params)
-    np.savez(os.path.join(dirname, "__params__"), **arrays)
+    _write_sealed_model(dirname, main_program, feeded_var_names,
+                        [v.name for v in target_vars], param_vars=params)
     return [v.name for v in target_vars]
 
 

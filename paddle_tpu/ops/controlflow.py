@@ -110,7 +110,8 @@ def _while(ctx, ins, attrs):
     def body_fn(carry):
         local = dict(env)
         local.update(zip(carry_names, carry))
-        execute_block(block, local, ctx)
+        with ctx.inner_trace():
+            execute_block(block, local, ctx)
         return tuple(local[n] for n in carry_names)
 
     init = tuple(env[n] for n in carry_names)
@@ -133,7 +134,8 @@ def _cond(ctx, ins, attrs):
     def run(block):
         local = dict(env)
         if block is not None:
-            execute_block(block, local, ctx)
+            with ctx.inner_trace():
+                execute_block(block, local, ctx)
         return tuple(local[n] for n in out_names)
 
     outs = jax.lax.cond(pred,
@@ -167,7 +169,8 @@ def _recurrent(ctx, ins, attrs):
         local = dict(env)
         local.update(zip(step_in_names, xs_t))
         local.update(zip([p for p, _ in mem_pairs], carry))
-        execute_block(block, local, ctx)
+        with ctx.inner_trace():
+            execute_block(block, local, ctx)
         new = [local[q] for _, q in mem_pairs]
         if seq_len is not None:
             # batch rows whose sequence ended keep their old memory

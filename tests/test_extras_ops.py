@@ -570,3 +570,78 @@ def test_check_nan_inf_applies_to_data_parallel_path():
                     fetch_list=[y.name])
     finally:
         fluid.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_check_nan_inf_with_control_flow_compiles():
+    """Regression: with the flag on, programs containing lax-traced
+    control-flow sub-blocks (While/cond) must still compile — inner-trace
+    values may not leak into the outer step's nan reports; the loop's own
+    outputs are still checked in the outer trace."""
+    import pytest
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    x = layers.data("x", [4])
+    i = layers.fill_constant([1], "int64", 0)
+    n = layers.fill_constant([1], "int64", 3)
+    acc = layers.scale(x, 1.0)
+    cond = layers.less_than(i, n)
+    w = layers.While(cond)
+    with w.block():
+        acc2 = layers.scale(acc, 2.0)
+        layers.assign(acc2, acc)
+        layers.increment(i)
+        layers.assign(layers.less_than(i, n), cond)
+    out = layers.log(acc)   # nan for negative inputs, checked in outer trace
+    exe = fluid.Executor(fluid.CPUPlace())
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        res, = exe.run(fluid.default_main_program(),
+                       feed={"x": np.ones((2, 4), np.float32)},
+                       fetch_list=[out.name])
+        np.testing.assert_allclose(np.asarray(res), np.log(8.0), rtol=1e-5)
+        with pytest.raises(RuntimeError, match="Inf/Nan"):
+            exe.run(fluid.default_main_program(),
+                    feed={"x": -np.ones((2, 4), np.float32)},
+                    fetch_list=[out.name])
+    finally:
+        fluid.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_check_nan_inf_raise_keeps_scope_usable():
+    """Regression: under the debug flag state is not donated and the raise
+    precedes write-back — after catching, params hold their PRE-step (finite)
+    values and training continues cleanly."""
+    import pytest
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    x = layers.data("x", [4])
+    y = layers.data("y", [1])
+    pred = layers.fc(input=x, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    ok_feed = {"x": np.ones((2, 4), np.float32),
+               "y": np.ones((2, 1), np.float32)}
+    bad_feed = {"x": np.full((2, 4), np.inf, np.float32),
+                "y": np.ones((2, 1), np.float32)}
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        exe.run(feed=ok_feed, fetch_list=[loss.name])
+        wname = [v.name for v in fluid.default_main_program().list_vars()
+                 if v.persistable and "fc" in v.name and ".w" in v.name][0]
+        w_before = np.array(fluid.global_scope().get(wname))
+        with pytest.raises(RuntimeError, match="Inf/Nan"):
+            exe.run(feed=bad_feed, fetch_list=[loss.name])
+        # the poisoned update was discarded: params hold pre-step values
+        w_after = np.asarray(fluid.global_scope().get(wname))
+        np.testing.assert_array_equal(w_after, w_before)
+        # and a clean step still runs with finite loss
+        l, = exe.run(feed=ok_feed, fetch_list=[loss.name])
+        assert np.isfinite(np.asarray(l)).all()
+    finally:
+        fluid.set_flags({"FLAGS_check_nan_inf": False})

@@ -117,11 +117,14 @@ def test_native_trainer_trains_from_saved_program(tmp_path):
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     binary = os.path.join(root, "native", "native_trainer")
-    if not os.path.exists(binary):
-        r = subprocess.run(["make", "-C", os.path.join(root, "native"),
-                            "native_trainer"], capture_output=True)
-        if r.returncode != 0:
-            pytest.skip("cannot build native_trainer: %s" % r.stderr[-200:])
+    # always invoke make: it is incremental, and an existing binary may be
+    # stale (built against another machine's libpython) or out of date with
+    # trainer.cc edits
+    r = subprocess.run(["make", "-C", os.path.join(root, "native"),
+                        "native_trainer"], capture_output=True)
+    if r.returncode != 0:
+        # never fall back to a possibly-stale on-disk binary
+        pytest.skip("cannot build native_trainer: %s" % r.stderr[-200:])
     model_dir = str(tmp_path / "fit_a_line")
     env = dict(os.environ, NT_PLATFORM="cpu", PADDLE_TPU_ROOT=root)
     r = subprocess.run(
