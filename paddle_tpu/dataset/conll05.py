@@ -1,0 +1,45 @@
+"""CoNLL-2005 SRL reader creators (parity: python/paddle/dataset/conll05.py
+— test() yields (word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, verb, mark,
+label) id sequences; get_dict()/get_embedding() helpers). Synthetic."""
+
+import numpy as np
+
+_WORD_VOCAB = 44068
+_VERB_VOCAB = 3162
+_LABEL_VOCAB = 59
+TEST_SIZE = 512
+
+
+def get_dict():
+    word_dict = {("w%d" % i): i for i in range(_WORD_VOCAB)}
+    verb_dict = {("v%d" % i): i for i in range(_VERB_VOCAB)}
+    label_dict = {("l%d" % i): i for i in range(_LABEL_VOCAB)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    rng = np.random.RandomState(71000)
+    return rng.normal(scale=0.1,
+                      size=(_WORD_VOCAB, 32)).astype(np.float32)
+
+
+def _reader(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            L = int(rng.randint(4, 40))
+            words = rng.randint(0, _WORD_VOCAB, size=L).astype(np.int64)
+            # the five context windows are shifts of the word sequence
+            ctxs = [np.roll(words, s) for s in (2, 1, 0, -1, -2)]
+            verb_idx = int(rng.randint(0, L))
+            verb = np.full(L, rng.randint(0, _VERB_VOCAB), np.int64)
+            mark = np.zeros(L, np.int64)
+            mark[verb_idx] = 1
+            labels = rng.randint(0, _LABEL_VOCAB, size=L).astype(np.int64)
+            yield tuple(x.tolist() for x in
+                        [words] + ctxs + [verb, mark, labels])
+    return reader
+
+
+def test():
+    return _reader(TEST_SIZE, seed=71002)

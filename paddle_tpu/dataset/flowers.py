@@ -1,0 +1,34 @@
+"""Oxford-102 flowers reader creators (parity: python/paddle/dataset/
+flowers.py — train()/test()/valid() yield (3x224x224 float32 CHW image,
+int64 label in [0,102))). Synthetic class-conditional color fields."""
+
+import numpy as np
+
+_CLASSES = 102
+TRAIN_SIZE = 1024
+TEST_SIZE = 128
+VALID_SIZE = 128
+
+
+def _reader(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        means = rng.uniform(-0.5, 0.5, size=(_CLASSES, 3)).astype(np.float32)
+        for _ in range(n):
+            label = int(rng.randint(0, _CLASSES))
+            img = (means[label][:, None, None]
+                   + 0.2 * rng.normal(size=(3, 224, 224))).astype(np.float32)
+            yield img, label
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
+    return _reader(TRAIN_SIZE, seed=31001)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
+    return _reader(TEST_SIZE, seed=31002)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True):
+    return _reader(VALID_SIZE, seed=31003)

@@ -1,0 +1,103 @@
+"""Image preprocessing utilities (parity: python/paddle/dataset/image.py —
+resize_short, to_chw, center_crop, random_crop, left_right_flip,
+simple_transform, load_and_transform). Pure-numpy implementations (the
+reference shells out to cv2; zero-egress image has no cv2 — bilinear resize
+is implemented directly)."""
+
+import numpy as np
+
+__all__ = ["resize_short", "to_chw", "center_crop", "random_crop",
+           "left_right_flip", "simple_transform", "load_image",
+           "load_and_transform", "batch_images_from_tar"]
+
+
+def _resize(im, h, w):
+    """Bilinear resize of an HWC (or HW) uint8/float array."""
+    src_h, src_w = im.shape[:2]
+    if (src_h, src_w) == (h, w):
+        return im
+    ys = (np.arange(h) + 0.5) * src_h / h - 0.5
+    xs = (np.arange(w) + 0.5) * src_w / w - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, src_h - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, src_w - 1)
+    y1 = np.clip(y0 + 1, 0, src_h - 1)
+    x1 = np.clip(x0 + 1, 0, src_w - 1)
+    wy = np.clip(ys - y0, 0.0, 1.0)[:, None]
+    wx = np.clip(xs - x0, 0.0, 1.0)[None, :]
+    if im.ndim == 2:
+        im = im[:, :, None]
+    im_f = im.astype(np.float32)
+    top = im_f[y0][:, x0] * (1 - wx[..., None]) + im_f[y0][:, x1] * wx[..., None]
+    bot = im_f[y1][:, x0] * (1 - wx[..., None]) + im_f[y1][:, x1] * wx[..., None]
+    out = top * (1 - wy[..., None]) + bot * wy[..., None]
+    return out.astype(im.dtype) if im.dtype != np.float32 else out
+
+
+def resize_short(im, size):
+    """Resize so the shorter edge equals `size`, keeping aspect ratio."""
+    h, w = im.shape[:2]
+    if h < w:
+        return _resize(im, size, int(round(w * size / h)))
+    return _resize(im, int(round(h * size / w)), size)
+
+
+def to_chw(im, order=(2, 0, 1)):
+    return im.transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    h0 = max((h - size) // 2, 0)
+    w0 = max((w - size) // 2, 0)
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def random_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    h0 = np.random.randint(0, max(h - size, 0) + 1)
+    w0 = np.random.randint(0, max(w - size, 0) + 1)
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def left_right_flip(im, is_color=True):
+    return im[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None):
+    """resize_short → crop (random+flip when training, center otherwise) →
+    CHW float32, optionally mean-subtracted (parity: image.py
+    simple_transform)."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size)
+        if np.random.randint(2) == 0:
+            im = left_right_flip(im)
+    else:
+        im = center_crop(im, crop_size)
+    if im.ndim == 2:
+        im = im[:, :, None]
+    im = to_chw(im).astype(np.float32)
+    if mean is not None:
+        mean = np.asarray(mean, np.float32)
+        im -= mean[:, None, None] if mean.ndim == 1 else mean
+    return im
+
+
+def load_image(file_path, is_color=True):
+    """Load an image file saved as .npy (the zero-egress stand-in for
+    cv2.imread)."""
+    return np.load(file_path)
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    return simple_transform(load_image(filename, is_color), resize_size,
+                            crop_size, is_train, is_color, mean)
+
+
+def batch_images_from_tar(data_file, dataset_name, img2label,
+                          num_per_batch=1024):
+    raise NotImplementedError(
+        "tar batching requires on-disk corpora; use the synthetic dataset "
+        "readers (paddle_tpu.dataset.*) in this environment")

@@ -1,0 +1,74 @@
+"""MovieLens-1M reader creators (parity: python/paddle/dataset/movielens.py
+— train()/test() yield [user_id, gender_id, age_id, job_id, movie_id,
+category_ids, title_ids, score]; max_user_id/max_movie_id/max_job_id
+helpers). Synthetic, deterministic by seed."""
+
+import numpy as np
+
+_MAX_USER = 6040
+_MAX_MOVIE = 3952
+_MAX_JOB = 20
+_NUM_CATEGORIES = 18
+_TITLE_VOCAB = 5174
+TRAIN_SIZE = 4096
+TEST_SIZE = 512
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+
+def max_user_id():
+    return _MAX_USER
+
+
+def max_movie_id():
+    return _MAX_MOVIE
+
+
+def max_job_id():
+    return _MAX_JOB
+
+
+def max_age_index():
+    return len(age_table) - 1
+
+
+def categories():
+    return ["cat%d" % i for i in range(_NUM_CATEGORIES)]
+
+
+def user_info():
+    return {}
+
+
+def movie_info():
+    return {}
+
+
+def _reader(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            user = int(rng.randint(1, _MAX_USER + 1))
+            gender = int(rng.randint(0, 2))
+            age = int(rng.randint(0, len(age_table)))
+            job = int(rng.randint(0, _MAX_JOB + 1))
+            movie = int(rng.randint(1, _MAX_MOVIE + 1))
+            ncat = int(rng.randint(1, 4))
+            cats = rng.choice(_NUM_CATEGORIES, size=ncat,
+                              replace=False).astype(np.int64)
+            tlen = int(rng.randint(1, 6))
+            title = rng.randint(0, _TITLE_VOCAB, size=tlen).astype(np.int64)
+            # score correlated with (user+movie) parity so models can learn
+            base = 3.0 + ((user + movie) % 3 - 1)
+            score = float(np.clip(base + rng.normal(0, 0.5), 1.0, 5.0))
+            yield [user, gender, age, job, movie, cats.tolist(),
+                   title.tolist(), score]
+    return reader
+
+
+def train():
+    return _reader(TRAIN_SIZE, seed=61001)
+
+
+def test():
+    return _reader(TEST_SIZE, seed=61002)

@@ -1,0 +1,33 @@
+"""NLTK movie-review sentiment reader creators (parity:
+python/paddle/dataset/sentiment.py — train()/test() yield (word-id list,
+label in {0,1}); get_word_dict()). Synthetic, label-correlated vocab."""
+
+import numpy as np
+
+_VOCAB = 2048
+NUM_TRAINING_INSTANCES = 1600
+NUM_TOTAL_INSTANCES = 2000
+
+
+def get_word_dict():
+    return {("w%d" % i): i for i in range(_VOCAB)}
+
+
+def _reader(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            label = int(rng.randint(0, 2))
+            length = int(rng.randint(8, 80))
+            lo, hi = (_VOCAB // 2, _VOCAB) if label else (0, _VOCAB // 2)
+            words = rng.randint(lo, hi, size=length).astype(np.int64)
+            yield words.tolist(), label
+    return reader
+
+
+def train():
+    return _reader(NUM_TRAINING_INSTANCES, seed=81001)
+
+
+def test():
+    return _reader(NUM_TOTAL_INSTANCES - NUM_TRAINING_INSTANCES, seed=81002)

@@ -1,0 +1,38 @@
+"""WMT14 en-fr reader creators (parity: python/paddle/dataset/wmt14.py —
+train()/test() yield (src_ids, trg_ids, trg_next_ids) with <s>=0, <e>=1,
+<unk>=2). Synthetic, same id conventions as wmt16."""
+
+import numpy as np
+
+TRAIN_SIZE = 1024
+TEST_SIZE = 128
+
+
+def _reader(n, dict_size, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            L = int(rng.randint(4, 30))
+            src = rng.randint(3, dict_size, size=L).astype(np.int64)
+            trg_core = (src[::-1] % (dict_size - 3)) + 3
+            trg = np.concatenate([[0], trg_core]).astype(np.int64)
+            trg_next = np.concatenate([trg_core, [1]]).astype(np.int64)
+            yield src.tolist(), trg.tolist(), trg_next.tolist()
+    return reader
+
+
+def train(dict_size=30000):
+    return _reader(TRAIN_SIZE, dict_size, seed=52001)
+
+
+def test(dict_size=30000):
+    return _reader(TEST_SIZE, dict_size, seed=52002)
+
+
+def get_dict(dict_size=30000, reverse=False):
+    src = {("s%d" % i): i for i in range(dict_size)}
+    trg = {("t%d" % i): i for i in range(dict_size)}
+    if reverse:
+        src = {v: k for k, v in src.items()}
+        trg = {v: k for k, v in trg.items()}
+    return src, trg

@@ -2,12 +2,18 @@
 imperative/ — SURVEY C21, call stack §3.4)."""
 
 from . import base
-from .base import guard, to_variable, no_grad, enable_dygraph, disable_dygraph
+from .base import (guard, to_variable, no_grad, enabled, enable_dygraph,
+                   disable_dygraph)
 from .layers import Layer
 from . import nn
 from .nn import *  # noqa: F401,F403
 from .checkpoint import save_dygraph, load_dygraph  # noqa: F401
 from .tracer import Tracer  # noqa: F401
+from . import parallel  # noqa: F401
+from .parallel import (Env, ParallelEnv, prepare_context,  # noqa: F401
+                       DataParallel)
 
-__all__ = ["guard", "to_variable", "no_grad", "Layer", "save_dygraph",
-           "load_dygraph", "enable_dygraph", "disable_dygraph"] + nn.__all__
+__all__ = ["guard", "to_variable", "no_grad", "enabled", "Layer",
+           "save_dygraph", "load_dygraph", "enable_dygraph",
+           "disable_dygraph", "Env", "ParallelEnv", "prepare_context",
+           "DataParallel"] + nn.__all__

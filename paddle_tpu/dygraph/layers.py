@@ -47,10 +47,20 @@ class Layer:
         return sublayer
 
     def parameters(self, include_sublayers=True):
-        out = list(self._parameters.values())
+        # __setattr__ auto-registers persistable VarBase attrs AND layers
+        # call add_parameter explicitly, so the same object can appear under
+        # two names ('_w' and 'w') — dedupe by identity
+        out, seen = [], set()
+        for p in self._parameters.values():
+            if id(p) not in seen:
+                seen.add(id(p))
+                out.append(p)
         if include_sublayers:
             for l in self._sub_layers.values():
-                out.extend(l.parameters())
+                for p in l.parameters():
+                    if id(p) not in seen:
+                        seen.add(id(p))
+                        out.append(p)
         return out
 
     def sublayers(self, include_sublayers=True):

@@ -9,10 +9,11 @@ from .layers import Layer
 from ..initializer import Constant, Normal, Xavier
 from ..param_attr import ParamAttr
 
-__all__ = ["Conv2D", "Pool2D", "FC", "Linear", "BatchNorm", "Embedding",
-           "LayerNorm", "GRUUnit", "PRelu", "BilinearTensorProduct",
-           "Conv2DTranspose", "SpectralNorm", "GroupNorm", "NCE",
-           "Dropout"]
+__all__ = ["Conv2D", "Conv3D", "Pool2D", "FC", "Linear", "BatchNorm",
+           "Embedding", "LayerNorm", "GRUUnit", "PRelu",
+           "BilinearTensorProduct", "Conv2DTranspose", "Conv3DTranspose",
+           "SpectralNorm", "GroupNorm", "NCE", "Dropout", "SequenceConv",
+           "RowConv", "TreeConv"]
 
 
 def _trace(op_type, ins, outs, attrs=None):
@@ -117,6 +118,52 @@ class Conv2D(Layer):
         return out
 
 
+class Conv3D(Layer):
+    def __init__(self, name_scope, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=None, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        _l = lambda v: list(v) if isinstance(v, (list, tuple)) else [v] * 3
+        self._num_filters = num_filters
+        self._filter_size = _l(filter_size)
+        self._stride = _l(stride)
+        self._padding = _l(padding)
+        self._dilation = _l(dilation)
+        self._groups = groups or 1
+        self._act = act
+        self._param_attr = ParamAttr._to_attr(param_attr)
+        self._bias_attr = bias_attr
+        self._w = None
+        self._b = None
+
+    def forward(self, input):
+        if self._w is None:
+            c_in = input.shape[1]
+            fan_in = c_in * int(np.prod(self._filter_size))
+            init = self._param_attr.initializer or Normal(
+                0.0, (2.0 / fan_in) ** 0.5)
+            self._w = self.create_parameter(
+                [self._num_filters, c_in // self._groups] + self._filter_size,
+                self._dtype, initializer=init)
+            self.add_parameter("w", self._w)
+            if self._bias_attr is not False:
+                self._b = self.create_parameter([self._num_filters],
+                                                self._dtype, is_bias=True)
+                self.add_parameter("b", self._b)
+        out = _trace("conv3d", {"Input": [input], "Filter": [self._w]},
+                     ["Output"],
+                     {"strides": list(self._stride),
+                      "paddings": list(self._padding),
+                      "dilations": list(self._dilation),
+                      "groups": self._groups})["Output"][0]
+        if self._b is not None:
+            out = _trace("elementwise_add", {"X": [out], "Y": [self._b]},
+                         ["Out"], {"axis": 1})["Out"][0]
+        if self._act:
+            out = _trace(self._act, {"X": [out]}, ["Out"])["Out"][0]
+        return out
+
+
 class Conv2DTranspose(Layer):
     def __init__(self, name_scope, num_filters, filter_size, padding=0,
                  stride=1, dilation=1, groups=None, param_attr=None,
@@ -151,6 +198,144 @@ class Conv2DTranspose(Layer):
                       "groups": self._groups})["Output"][0]
         out = _trace("elementwise_add", {"X": [out], "Y": [self._b]},
                      ["Out"], {"axis": 1})["Out"][0]
+        if self._act:
+            out = _trace(self._act, {"X": [out]}, ["Out"])["Out"][0]
+        return out
+
+
+class Conv3DTranspose(Layer):
+    def __init__(self, name_scope, num_filters, filter_size, padding=0,
+                 stride=1, dilation=1, groups=None, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        _l = lambda v: list(v) if isinstance(v, (list, tuple)) else [v] * 3
+        self._num_filters = num_filters
+        self._filter_size = _l(filter_size)
+        self._stride = _l(stride)
+        self._padding = _l(padding)
+        self._dilation = _l(dilation)
+        self._groups = groups or 1
+        self._act = act
+        self._bias_attr = bias_attr
+        self._w = None
+        self._b = None
+
+    def forward(self, input):
+        if self._w is None:
+            c_in = input.shape[1]
+            self._w = self.create_parameter(
+                [c_in, self._num_filters // self._groups] + self._filter_size,
+                self._dtype)
+            self.add_parameter("w", self._w)
+            if self._bias_attr is not False:
+                self._b = self.create_parameter([self._num_filters],
+                                                self._dtype, is_bias=True)
+                self.add_parameter("b", self._b)
+        out = _trace("conv3d_transpose",
+                     {"Input": [input], "Filter": [self._w]}, ["Output"],
+                     {"strides": list(self._stride),
+                      "paddings": list(self._padding),
+                      "dilations": list(self._dilation),
+                      "groups": self._groups})["Output"][0]
+        if self._b is not None:
+            out = _trace("elementwise_add", {"X": [out], "Y": [self._b]},
+                         ["Out"], {"axis": 1})["Out"][0]
+        if self._act:
+            out = _trace(self._act, {"X": [out]}, ["Out"])["Out"][0]
+        return out
+
+
+class SequenceConv(Layer):
+    """Context-window convolution over a [B, T, D] padded sequence batch
+    (parity: dygraph/nn.py SequenceConv / sequence_conv_op.cc)."""
+
+    def __init__(self, name_scope, num_filters, filter_size=3,
+                 filter_stride=1, padding=None, bias_attr=None,
+                 param_attr=None, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._num_filters = num_filters
+        self._filter_size = filter_size
+        self._act = act
+        self._bias_attr = bias_attr
+        self._w = None
+        self._b = None
+
+    def forward(self, input):
+        if self._w is None:
+            d = input.shape[-1]
+            self._w = self.create_parameter(
+                [self._filter_size * d, self._num_filters], self._dtype)
+            self.add_parameter("w", self._w)
+            if self._bias_attr is not False:
+                self._b = self.create_parameter([self._num_filters],
+                                                self._dtype, is_bias=True)
+                self.add_parameter("b", self._b)
+        out = _trace("sequence_conv",
+                     {"X": [input], "Filter": [self._w]}, ["Out"],
+                     {"contextLength": self._filter_size,
+                      "contextStart": -(self._filter_size // 2)})["Out"][0]
+        if self._b is not None:
+            out = _trace("elementwise_add", {"X": [out], "Y": [self._b]},
+                         ["Out"], {"axis": -1})["Out"][0]
+        if self._act:
+            out = _trace(self._act, {"X": [out]}, ["Out"])["Out"][0]
+        return out
+
+
+class RowConv(Layer):
+    """Lookahead row convolution (parity: dygraph/nn.py RowConv /
+    row_conv_op.cc) on a [B, T, D] padded batch."""
+
+    def __init__(self, name_scope, future_context_size, param_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._k = future_context_size + 1
+        self._act = act
+        self._w = None
+
+    def forward(self, input):
+        if self._w is None:
+            d = input.shape[-1]
+            self._w = self.create_parameter([self._k, d], self._dtype)
+            self.add_parameter("w", self._w)
+        out = _trace("row_conv", {"X": [input], "Filter": [self._w]},
+                     ["Out"])["Out"][0]
+        if self._act:
+            out = _trace(self._act, {"X": [out]}, ["Out"])["Out"][0]
+        return out
+
+
+class TreeConv(Layer):
+    """Tree-based convolution (parity: dygraph/nn.py TreeConv /
+    tree_conv_op.cc, TBCNN)."""
+
+    def __init__(self, name_scope, output_size, num_filters=1,
+                 max_depth=8, act=None, param_attr=None, bias_attr=None,
+                 name=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._output_size = output_size
+        self._num_filters = num_filters
+        self._act = act
+        self._bias_attr = bias_attr
+        self._w = None
+        self._b = None
+
+    def forward(self, nodes_vector, edge_set):
+        if self._w is None:
+            d = nodes_vector.shape[-1]
+            self._w = self.create_parameter(
+                [d, 3, self._output_size, self._num_filters], self._dtype)
+            self.add_parameter("w", self._w)
+            if self._bias_attr is not False:
+                self._b = self.create_parameter(
+                    [self._num_filters], self._dtype, is_bias=True)
+                self.add_parameter("b", self._b)
+        out = _trace("tree_conv",
+                     {"NodesVector": [nodes_vector], "EdgeSet": [edge_set],
+                      "Filter": [self._w]}, ["Out"])["Out"][0]
+        if self._b is not None:
+            out = _trace("elementwise_add", {"X": [out], "Y": [self._b]},
+                         ["Out"], {"axis": -1})["Out"][0]
         if self._act:
             out = _trace(self._act, {"X": [out]}, ["Out"])["Out"][0]
         return out

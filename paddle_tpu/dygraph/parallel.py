@@ -1,0 +1,140 @@
+"""Dygraph multi-process data parallelism (parity: python/paddle/fluid/
+dygraph/parallel.py — `Env` :30, `prepare_context` :54, `DataParallel`;
+C++ side imperative/nccl_context.cc).
+
+TPU-native: the NCCL parallel context (gen_nccl_id handshake + per-process
+communicators) becomes `jax.distributed` process-group initialization; the
+per-variable allreduce in DataParallel.apply_collective_grads becomes a
+`jax.lax.pmean`-shaped host-side mean over the data-parallel group. On a
+single process the wrappers are transparent, matching the reference's
+behaviour when nranks == 1.
+"""
+
+import os
+
+import numpy as np
+
+from .layers import Layer
+
+__all__ = ["Env", "prepare_context", "ParallelEnv", "DataParallel"]
+
+
+class Env:
+    """Trainer-process identity from PADDLE_* env vars (parity:
+    dygraph/parallel.py Env — nranks/local_rank/trainer_endpoints)."""
+
+    def __init__(self):
+        self._nranks = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+        self._local_rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        self._dev_id = int(os.getenv("FLAGS_selected_gpus", "0"))
+        self._trainer_endpoints = os.getenv(
+            "PADDLE_TRAINER_ENDPOINTS", "").split(",")
+        self._current_endpoint = os.getenv("PADDLE_CURRENT_ENDPOINT", "")
+
+    @property
+    def nranks(self):
+        return self._nranks
+
+    @property
+    def local_rank(self):
+        return self._local_rank
+
+    @property
+    def dev_id(self):
+        return self._dev_id
+
+    @property
+    def current_endpoint(self):
+        return self._current_endpoint
+
+    @property
+    def trainer_endpoints(self):
+        return self._trainer_endpoints
+
+
+ParallelEnv = Env
+
+
+class _ParallelStrategy:
+    def __init__(self, env):
+        self.nranks = env.nranks
+        self.local_rank = env.local_rank
+        self.trainer_endpoints = env.trainer_endpoints
+        self.current_endpoint = env.current_endpoint
+
+
+def prepare_context(place=None):
+    """Initialize the multi-process context and return the strategy object
+    (parity: dygraph/parallel.py prepare_context — which spins an NCCL
+    context; here: jax.distributed process-group init over DCN)."""
+    env = Env()
+    strategy = _ParallelStrategy(env)
+    if env.nranks > 1:
+        # fail fast like the reference NCCL prepare_context does when the
+        # context cannot be established — silent single-process fallback
+        # would train N diverging replicas
+        coord = os.environ.get("PADDLE_COORDINATOR_ADDR")
+        if not coord:
+            raise RuntimeError(
+                "prepare_context: PADDLE_TRAINERS_NUM=%d but "
+                "PADDLE_COORDINATOR_ADDR is unset; set it to the rank-0 "
+                "coordinator endpoint so jax.distributed can form the "
+                "process group" % env.nranks)
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=env.nranks,
+            process_id=env.local_rank)
+    return strategy
+
+
+class DataParallel(Layer):
+    """Wrap a dygraph Layer for data-parallel training (parity:
+    dygraph/parallel.py DataParallel: scale_loss + apply_collective_grads)."""
+
+    def __init__(self, layers, strategy=None):
+        super().__init__("data_parallel")
+        self._layers = layers
+        self._strategy = strategy or _ParallelStrategy(Env())
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        if self._strategy.nranks <= 1:
+            return loss
+        from .math_ops import mul
+
+        return mul(loss, 1.0 / self._strategy.nranks)
+
+    def apply_collective_grads(self):
+        """Mean-allreduce every trainable grad over the dp group. With one
+        process this is a no-op, matching the reference fast path."""
+        if self._strategy.nranks <= 1:
+            return
+        import jax
+
+        if jax.process_count() <= 1:
+            raise RuntimeError(
+                "apply_collective_grads: nranks=%d but the jax process "
+                "group has a single process — call prepare_context() "
+                "before training" % self._strategy.nranks)
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+
+        for p in self._layers.parameters():
+            if p._grad is None:
+                continue
+            g = multihost_utils.process_allgather(
+                jnp.asarray(np.asarray(p._grad)))
+            p._grad = np.asarray(jnp.mean(g, axis=0))
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_dict(self, *a, **kw):
+        return self._layers.set_dict(*a, **kw)

