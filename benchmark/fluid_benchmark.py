@@ -1,0 +1,202 @@
+"""Benchmark harness (parity: /root/reference/benchmark/fluid/
+fluid_benchmark.py — same models, same `examples/sec` reporting
+(print_train_time :296-300), per-chip normalization per BASELINE.md).
+
+Usage:
+  python benchmark/fluid_benchmark.py --model mnist --iterations 50
+  python benchmark/fluid_benchmark.py --model resnet --batch_size 64
+  python benchmark/fluid_benchmark.py --model transformer --device TPU
+  python benchmark/fluid_benchmark.py --model resnet --update_method spmd
+
+Models mirror the reference set (benchmark/fluid/README.md:15-22): mnist,
+resnet (cifar10), vgg, stacked_dynamic_lstm, machine_translation — plus
+deepfm (CTR, BASELINE.json config 4) and the flagship transformer
+(tokens/sec, BASELINE.json config 3). `--update_method spmd` is the nccl2
+mode's TPU equivalent: the same program data-parallel over all visible
+devices via ParallelExecutor (mesh dp axis) instead of NCCL allreduce.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# run from anywhere: the repo root is one level up
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_args():
+    p = argparse.ArgumentParser("paddle_tpu benchmark harness")
+    p.add_argument("--model", default="mnist",
+                   choices=["mnist", "resnet", "vgg", "stacked_dynamic_lstm",
+                            "machine_translation", "deepfm", "transformer"])
+    p.add_argument("--batch_size", type=int, default=None,
+                   help="per-step global batch (model default if unset)")
+    p.add_argument("--iterations", type=int, default=30)
+    p.add_argument("--pass_num", type=int, default=1)
+    p.add_argument("--skip_batch_num", type=int, default=5,
+                   help="warmup steps excluded from timing (reference arg)")
+    p.add_argument("--device", default=None, choices=[None, "CPU", "TPU"],
+                   help="default: whatever jax.default_backend() is")
+    p.add_argument("--update_method", default="local",
+                   choices=["local", "spmd", "nccl2"],
+                   help="nccl2 is accepted as an alias of spmd")
+    p.add_argument("--learning_rate", type=float, default=0.01)
+    p.add_argument("--profile", action="store_true",
+                   help="wrap the loop in the paddle_tpu profiler and dump "
+                        "a chrome trace next to the run")
+    p.add_argument("--json", action="store_true",
+                   help="also print one machine-readable JSON line")
+    return p.parse_args()
+
+
+_DEFAULT_BATCH = {
+    "mnist": 128, "resnet": 64, "vgg": 64, "stacked_dynamic_lstm": 32,
+    "machine_translation": 16, "deepfm": 256, "transformer": 16,
+}
+
+
+def _feeds(model, batch, rng):
+    """Synthetic reference-shaped batches (the reference harness reads the
+    real corpora; dataset modules here are synthetic for zero egress)."""
+    if model == "mnist":
+        return {"img": rng.rand(batch, 784).astype(np.float32),
+                "label": rng.randint(0, 10, (batch, 1)).astype(np.int64)}
+    if model in ("resnet", "vgg"):
+        return {"img": rng.rand(batch, 3, 32, 32).astype(np.float32),
+                "label": rng.randint(0, 10, (batch, 1)).astype(np.int64)}
+    if model == "stacked_dynamic_lstm":
+        return {"words": rng.randint(0, 30000, (batch, 80)).astype(np.int64),
+                "label": rng.randint(0, 2, (batch, 1)).astype(np.int64),
+                "seq_len": rng.randint(8, 81, (batch, 1)).astype(np.int64)}
+    if model == "machine_translation":
+        return {"src_word": rng.randint(3, 10000, (batch, 50)).astype(np.int64),
+                "src_len": rng.randint(4, 51, (batch, 1)).astype(np.int64),
+                "trg_word": rng.randint(3, 10000, (batch, 50)).astype(np.int64),
+                "trg_next": rng.randint(3, 10000, (batch, 50)).astype(np.int64),
+                "trg_len": rng.randint(4, 51, (batch, 1)).astype(np.int64)}
+    if model == "deepfm":
+        return {"sparse_ids": rng.randint(0, int(1e5), (batch, 26)).astype(np.int64),
+                "dense_x": rng.rand(batch, 13).astype(np.float32),
+                "label": rng.randint(0, 2, (batch, 1)).astype(np.int64)}
+    raise ValueError(model)
+
+
+def _build(model):
+    from paddle_tpu import models
+
+    if model == "mnist":
+        *_, loss, _acc = models.mnist.build(arch="mlp")
+    elif model == "resnet":
+        *_, loss, _acc = models.resnet.build(dataset="cifar10")
+    elif model == "vgg":
+        *_, loss, _acc = models.vgg.build(dataset="cifar10")
+    elif model == "stacked_dynamic_lstm":
+        *_, loss, _acc = models.stacked_lstm.build()
+    elif model == "machine_translation":
+        _, _, loss = models.machine_translation.build()
+    elif model == "deepfm":
+        _, _, loss, _auc = models.deepfm.build()
+    else:
+        raise ValueError(model)
+    return loss
+
+
+def print_train_time(start_time, end_time, num_samples, n_chips=1):
+    """Reference-format throughput line (fluid_benchmark.py:296-300)."""
+    train_elapsed = end_time - start_time
+    examples_per_sec = num_samples / train_elapsed
+    print("\nTotal examples: %d, total time: %.5f, %.5f examples/sec, "
+          "%d chip(s), %.5f examples/sec/chip\n" %
+          (num_samples, train_elapsed, examples_per_sec, n_chips,
+           examples_per_sec / n_chips))
+    return examples_per_sec
+
+
+def run_transformer(args):
+    """tokens/sec path on the flagship model (BASELINE.json config 3)."""
+    import bench
+
+    tokens_per_sec, last_loss = bench.bench_transformer(
+        steps=args.iterations, warmup=args.skip_batch_num,
+        batch=args.batch_size or _DEFAULT_BATCH["transformer"])
+    print("\nTransformer-base: %.1f tokens/sec/chip (last loss %.4f)\n"
+          % (tokens_per_sec, last_loss))
+    return {"metric": "%s_tokens_per_sec_per_chip" % args.model,
+            "value": round(tokens_per_sec, 1), "unit": "tokens/s/chip"}
+
+
+def run_static_model(args):
+    import paddle_tpu as fluid
+
+    if args.device == "CPU":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    batch = args.batch_size or _DEFAULT_BATCH[args.model]
+    loss = _build(args.model)
+    fluid.optimizer.Adam(args.learning_rate).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace() if args.device == "CPU"
+                         else fluid.TPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    n_chips = 1
+    runner = exe
+    if args.update_method in ("spmd", "nccl2"):
+        pe = fluid.ParallelExecutor(loss_name=loss.name)
+        n_chips = pe.device_count
+        runner = pe
+
+    rng = np.random.RandomState(0)
+    feed = _feeds(args.model, batch, rng)
+
+    prof_ctx = None
+    if args.profile:
+        from paddle_tpu import profiler
+
+        profiler.start_profiler("All")
+
+    losses = []
+    num_samples = 0
+    start = None
+    for it in range(args.skip_batch_num + args.iterations):
+        if it == args.skip_batch_num:
+            start = time.perf_counter()
+            num_samples = 0
+        if runner is exe:
+            out, = exe.run(feed=feed, fetch_list=[loss])
+        else:
+            out, = runner.run(feed=feed, fetch_list=[loss.name])
+        losses.append(float(np.asarray(out).mean()))
+        num_samples += batch
+    end = time.perf_counter()
+
+    if args.profile:
+        from paddle_tpu import profiler
+
+        profiler.stop_profiler("total", "fluid_benchmark.profile")
+
+    eps = print_train_time(start, end, num_samples, n_chips)
+    print("last loss: %.5f (first %.5f)" % (losses[-1], losses[0]))
+    return {"metric": "%s_examples_per_sec_per_chip" % args.model,
+            "value": round(eps / n_chips, 2), "unit": "examples/s/chip",
+            "n_chips": n_chips, "first_loss": round(losses[0], 5),
+            "last_loss": round(losses[-1], 5)}
+
+
+def main():
+    args = parse_args()
+    if args.model == "transformer":
+        rec = run_transformer(args)
+    else:
+        rec = run_static_model(args)
+    if args.json:
+        print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

@@ -12,8 +12,11 @@ VALID_SIZE = 128
 
 def _reader(n, seed):
     def reader():
+        # label->color mapping shared by all splits (fixed seed) so a model
+        # trained on train() is actually evaluable on test()/valid()
+        means = np.random.RandomState(31000).uniform(
+            -0.5, 0.5, size=(_CLASSES, 3)).astype(np.float32)
         rng = np.random.RandomState(seed)
-        means = rng.uniform(-0.5, 0.5, size=(_CLASSES, 3)).astype(np.float32)
         for _ in range(n):
             label = int(rng.randint(0, _CLASSES))
             img = (means[label][:, None, None]

@@ -24,13 +24,18 @@ def _resize(im, h, w):
     x1 = np.clip(x0 + 1, 0, src_w - 1)
     wy = np.clip(ys - y0, 0.0, 1.0)[:, None]
     wx = np.clip(xs - x0, 0.0, 1.0)[None, :]
-    if im.ndim == 2:
+    was_2d = im.ndim == 2
+    if was_2d:
         im = im[:, :, None]
     im_f = im.astype(np.float32)
     top = im_f[y0][:, x0] * (1 - wx[..., None]) + im_f[y0][:, x1] * wx[..., None]
     bot = im_f[y1][:, x0] * (1 - wx[..., None]) + im_f[y1][:, x1] * wx[..., None]
     out = top * (1 - wy[..., None]) + bot * wy[..., None]
-    return out.astype(im.dtype) if im.dtype != np.float32 else out
+    if was_2d:
+        out = out[:, :, 0]
+    if im.dtype != np.float32:
+        out = np.round(out).astype(im.dtype)
+    return out
 
 
 def resize_short(im, size):

@@ -123,12 +123,14 @@ class DataParallel(Layer):
         import jax.numpy as jnp
         from jax.experimental import multihost_utils
 
+        # SUM across processes (reference AllReduceOpHandle semantics) —
+        # pairs with scale_loss's 1/nranks so the result is the global mean
         for p in self._layers.parameters():
             if p._grad is None:
                 continue
             g = multihost_utils.process_allgather(
                 jnp.asarray(np.asarray(p._grad)))
-            p._grad = np.asarray(jnp.mean(g, axis=0))
+            p._grad = np.asarray(jnp.sum(g, axis=0))
 
     def parameters(self, include_sublayers=True):
         return self._layers.parameters(include_sublayers)

@@ -235,8 +235,6 @@ class Preprocessor:
     def __init__(self, reader, name=None):
         self._reader = reader
         self.sub_block_started = False
-        self._transform = None
-        self._inputs_taken = False
         self._out_vars = None
 
     class _blockguard:
@@ -258,7 +256,6 @@ class Preprocessor:
         if not self.sub_block_started:
             raise RuntimeError("Preprocessor.inputs() must be called inside "
                                "the block() context")
-        self._inputs_taken = True
         vars_ = self._reader.data_vars
         return vars_[0] if len(vars_) == 1 else list(vars_)
 
@@ -270,19 +267,21 @@ class Preprocessor:
 
     def add_transform(self, fn):
         """Host-side transform: fn(*columns) -> tuple(columns). Applied
-        per-sample on sample-list readers (each yielded item is a LIST of
-        sample tuples) and per-batch on batch readers (each item is a tuple
-        of column arrays)."""
-        self._transform = fn
+        per-sample on sample-list readers (item = LIST of sample tuples)
+        and per-batch on batch readers (item = tuple/list of column
+        arrays)."""
 
         def apply(cols):
-            out = fn(*cols) if isinstance(cols, tuple) else fn(cols)
+            out = fn(*cols) if isinstance(cols, (tuple, list)) else fn(cols)
             return out if isinstance(out, tuple) else (out,)
 
         def deco(g):
             def wrapped():
                 for item in g():
-                    if isinstance(item, list):
+                    # a list whose elements are tuples/lists is a
+                    # sample-list batch; a list of arrays is a column batch
+                    if isinstance(item, list) and item and all(
+                            isinstance(s, (tuple, list)) for s in item):
                         yield [apply(sample) for sample in item]
                     else:
                         yield apply(item)

@@ -1,0 +1,59 @@
+"""L7 tooling tests: API-freeze (parity: reference CI diff_api.py check,
+SURVEY §4 item 10), timeline merger, benchmark harness smoke run."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_api_spec_frozen():
+    """The committed API.spec must match the live package exactly."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        from gen_api_spec import spec_lines
+    finally:
+        sys.path.pop(0)
+    with open(os.path.join(REPO, "API.spec")) as f:
+        pinned = f.read().splitlines()
+    live = spec_lines()
+    assert pinned == live, (
+        "public API surface drifted; regenerate deliberately with "
+        "`python tools/gen_api_spec.py > API.spec`")
+
+
+def test_timeline_merge(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import timeline
+    finally:
+        sys.path.pop(0)
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps({"traceEvents": [
+        {"name": "op1", "ph": "X", "ts": 0, "dur": 5, "pid": 99, "tid": 1}]}))
+    b.write_text(json.dumps({"traceEvents": [
+        {"name": "op2", "ph": "X", "ts": 2, "dur": 3, "pid": 42, "tid": 7}]}))
+    trace = timeline.merge_profiles([("trainer", str(a)), ("pserver", str(b))])
+    evs = trace["traceEvents"]
+    metas = [e for e in evs if e.get("ph") == "M"]
+    assert [m["args"]["name"] for m in metas] == ["trainer", "pserver"]
+    pids = {e["name"]: e["pid"] for e in evs if e.get("ph") == "X"}
+    assert pids == {"op1": 0, "op2": 1}  # re-homed per profile
+
+
+def test_fluid_benchmark_mnist_smoke():
+    env = dict(os.environ,
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmark", "fluid_benchmark.py"),
+         "--model", "mnist", "--iterations", "18", "--skip_batch_num", "2",
+         "--device", "CPU", "--json"],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["unit"] == "examples/s/chip" and rec["value"] > 0
+    assert rec["last_loss"] < rec["first_loss"]

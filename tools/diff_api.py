@@ -1,0 +1,38 @@
+"""API-freeze checker (parity: /root/reference/tools/diff_api.py — diffs
+the committed API.spec against the live package in CI and fails on any
+signature change, forcing API changes to be explicit).
+
+Usage:  python tools/diff_api.py [API.spec]
+Exit code 0 = surface unchanged; 1 = diff printed.
+Regenerate deliberately with:  python tools/gen_api_spec.py > API.spec
+"""
+
+import difflib
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gen_api_spec import spec_lines  # noqa: E402
+
+
+def main():
+    spec_path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "API.spec")
+    with open(spec_path) as f:
+        pinned = f.read().splitlines()
+    live = spec_lines()
+    diff = list(difflib.unified_diff(pinned, live, "API.spec (pinned)",
+                                     "live package", lineterm=""))
+    if diff:
+        print("\n".join(diff))
+        print("\nAPI surface changed! If intentional, regenerate with:\n"
+              "  python tools/gen_api_spec.py > API.spec")
+        return 1
+    print("API surface unchanged (%d symbols)." % len(pinned))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

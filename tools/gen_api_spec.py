@@ -1,0 +1,72 @@
+"""Generate API.spec — the frozen public API surface (parity:
+/root/reference/paddle/fluid/API.spec, 579 pinned signatures, CI-enforced
+by tools/diff_api.py; reference checker tools/diff_api.py + print_signatures
+in paddle/scripts/paddle_build.sh).
+
+One line per symbol: `<qualified name> (<signature>)` for callables,
+`<qualified name> <class>` for classes without a useful __init__ signature.
+Run from the repo root:  python tools/gen_api_spec.py > API.spec
+"""
+
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the pinned namespaces (SURVEY.md Appendix B breakdown)
+NAMESPACES = [
+    ("paddle_tpu", None),
+    ("paddle_tpu.layers", None),
+    ("paddle_tpu.io", None),
+    ("paddle_tpu.initializer", None),
+    ("paddle_tpu.optimizer", None),
+    ("paddle_tpu.clip", None),
+    ("paddle_tpu.regularizer", None),
+    ("paddle_tpu.transpiler", None),
+    ("paddle_tpu.nets", None),
+    ("paddle_tpu.profiler", None),
+    ("paddle_tpu.unique_name", None),
+    ("paddle_tpu.reader", None),
+    ("paddle_tpu.metrics", None),
+    ("paddle_tpu.dygraph", None),
+    ("paddle_tpu.contrib", None),
+    ("paddle_tpu.dataset", None),
+]
+
+
+def _sig(obj):
+    try:
+        return str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return "(...)"
+
+
+def spec_lines():
+    import importlib
+
+    lines = []
+    for mod_name, _ in NAMESPACES:
+        mod = importlib.import_module(mod_name)
+        names = getattr(mod, "__all__", None)
+        if names is None:
+            names = sorted(n for n in dir(mod) if not n.startswith("_"))
+        for name in sorted(set(names)):
+            obj = getattr(mod, name, None)
+            if obj is None:
+                continue
+            qual = "%s.%s" % (mod_name, name)
+            if inspect.ismodule(obj):
+                lines.append("%s <module>" % qual)
+            elif inspect.isclass(obj):
+                lines.append("%s.__init__ %s" % (qual, _sig(obj.__init__)))
+            elif callable(obj):
+                lines.append("%s %s" % (qual, _sig(obj)))
+            else:
+                lines.append("%s <%s>" % (qual, type(obj).__name__))
+    return lines
+
+
+if __name__ == "__main__":
+    for line in spec_lines():
+        print(line)

@@ -1,0 +1,69 @@
+"""Offline timeline viewer/merger (parity: /root/reference/tools/
+timeline.py — converts serialized profiles to one chrome://tracing JSON,
+merging multiple trainer/pserver profiles with `--profile_path
+trainer1=f1,trainer2=f2`).
+
+The reference reads a platform/profiler.proto `Profile`; paddle_tpu's
+profiler already emits chrome-trace JSON (`profiler.dump_chrome_trace`,
+native/profiler.cc), so this tool's job is the merge/namespace step: each
+named input's events are re-homed onto a distinct pid labelled with the
+role name, producing one timeline for chrome://tracing or Perfetto.
+"""
+
+import argparse
+import json
+
+
+def parse_args():
+    p = argparse.ArgumentParser(__doc__)
+    p.add_argument("--profile_path", type=str, required=True,
+                   help="'name1=path1,name2=path2,...' or a single path")
+    p.add_argument("--timeline_path", type=str, default="/tmp/timeline.json",
+                   help="output chrome trace file")
+    return p.parse_args()
+
+
+def _load_events(path):
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, dict):
+        return list(data.get("traceEvents", []))
+    return list(data)
+
+
+def merge_profiles(named_paths):
+    """[(name, path)] -> chrome trace dict with one pid block per input."""
+    out = []
+    for pid, (name, path) in enumerate(named_paths):
+        out.append({"name": "process_name", "ph": "M", "pid": pid,
+                    "args": {"name": name}})
+        for ev in _load_events(path):
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                # keep the original role label as a sort-index hint only
+                continue
+            ev = dict(ev)
+            ev["pid"] = pid
+            out.append(ev)
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def main():
+    args = parse_args()
+    if "=" in args.profile_path:
+        named = []
+        for part in args.profile_path.split(","):
+            if not part:
+                continue
+            name, _, path = part.partition("=")
+            named.append((name, path))
+    else:
+        named = [("profile", args.profile_path)]
+    trace = merge_profiles(named)
+    with open(args.timeline_path, "w") as f:
+        json.dump(trace, f)
+    print("wrote %d events from %d profile(s) to %s"
+          % (len(trace["traceEvents"]), len(named), args.timeline_path))
+
+
+if __name__ == "__main__":
+    main()
