@@ -21,7 +21,7 @@ import numpy as np
 BASELINE_TOKENS_PER_SEC = 0.9 * 55000.0
 
 
-def bench_transformer(steps=20, warmup=3, batch=16, seq=512):
+def bench_transformer(steps=20, warmup=3, batch=48, seq=512):
     import jax
     import jax.numpy as jnp
 

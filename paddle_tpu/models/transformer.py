@@ -109,11 +109,33 @@ def causal_attention(q, k, v, seq_offset=0, use_flash=None):
         use_flash = (jax.default_backend() == "tpu" and seq_offset == 0
                      and Tq == Tk and Tq >= 256 and Dh >= 64)
     if use_flash:
+        qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+        scale = 1.0 / math.sqrt(Dh)
+        if jax.default_backend() == "tpu":
+            # the library TPU kernel has a fully-blocked Pallas backward
+            # (no [T, T] residuals); measured in-model on v5e it beats both
+            # our portable kernel and the naive einsum path, and widening
+            # the blocks to the full 512 sequence beats the 128 defaults
+            # by a further ~20% (fewer grid steps, same VMEM fit)
+            try:
+                from jax.experimental.pallas.ops.tpu.flash_attention import (
+                    BlockSizes, flash_attention as tpu_flash)
+
+                blk = next(b for b in (512, 256, 128)
+                           if Tq % b == 0 and b <= Tq)
+                bs = BlockSizes(
+                    block_q=blk, block_k_major=blk, block_k=blk, block_b=1,
+                    block_q_major_dkv=blk, block_k_major_dkv=blk,
+                    block_k_dkv=blk, block_q_dkv=blk,
+                    block_k_major_dq=blk, block_k_dq=blk, block_q_dq=blk)
+                ctx = tpu_flash(qt, kt, vt, causal=True, sm_scale=scale,
+                                block_sizes=bs)
+                return ctx.transpose(0, 2, 1, 3)
+            except Exception:
+                pass
         from ..ops.pallas_kernels import flash_attention
 
-        ctx = flash_attention(
-            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-            v.transpose(0, 2, 1, 3), True, 1.0 / math.sqrt(Dh))
+        ctx = flash_attention(qt, kt, vt, True, scale)
         return ctx.transpose(0, 2, 1, 3)
     scale = 1.0 / math.sqrt(Dh)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
