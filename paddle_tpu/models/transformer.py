@@ -100,42 +100,21 @@ def layer_norm(x, scale, bias, eps=1e-5):
 
 def causal_attention(q, k, v, seq_offset=0, use_flash=None):
     """q,k,v: [B, T, H, Dh] (H may be a tp-local slice). fp32 softmax,
-    bf16 matmuls on the MXU. On TPU with block-aligned self-attention the
-    Pallas flash kernel (ops/pallas_kernels.py) replaces the naive [T, T]
-    path — O(block) VMEM instead of materializing scores in HBM."""
+    bf16 matmuls on the MXU. On block-aligned self-attention the flash
+    kernel dispatcher (ops/pallas_kernels.flash_attention — library TPU
+    kernel on-chip, portable Pallas kernel elsewhere) replaces the naive
+    [T, T] path — O(block) VMEM instead of materializing scores in HBM."""
     B, Tq, H, Dh = q.shape
     Tk = k.shape[1]
     if use_flash is None:
         use_flash = (jax.default_backend() == "tpu" and seq_offset == 0
                      and Tq == Tk and Tq >= 256 and Dh >= 64)
     if use_flash:
-        qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
-        scale = 1.0 / math.sqrt(Dh)
-        if jax.default_backend() == "tpu":
-            # the library TPU kernel has a fully-blocked Pallas backward
-            # (no [T, T] residuals); measured in-model on v5e it beats both
-            # our portable kernel and the naive einsum path, and widening
-            # the blocks to the full 512 sequence beats the 128 defaults
-            # by a further ~20% (fewer grid steps, same VMEM fit)
-            try:
-                from jax.experimental.pallas.ops.tpu.flash_attention import (
-                    BlockSizes, flash_attention as tpu_flash)
-
-                blk = next(b for b in (512, 256, 128)
-                           if Tq % b == 0 and b <= Tq)
-                bs = BlockSizes(
-                    block_q=blk, block_k_major=blk, block_k=blk, block_b=1,
-                    block_q_major_dkv=blk, block_k_major_dkv=blk,
-                    block_k_dkv=blk, block_q_dkv=blk,
-                    block_k_major_dq=blk, block_k_dq=blk, block_q_dq=blk)
-                ctx = tpu_flash(qt, kt, vt, causal=True, sm_scale=scale,
-                                block_sizes=bs)
-                return ctx.transpose(0, 2, 1, 3)
-            except Exception:
-                pass
         from ..ops.pallas_kernels import flash_attention
 
-        ctx = flash_attention(qt, kt, vt, True, scale)
+        ctx = flash_attention(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), True, 1.0 / math.sqrt(Dh))
         return ctx.transpose(0, 2, 1, 3)
     scale = 1.0 / math.sqrt(Dh)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,

@@ -92,10 +92,42 @@ def _pad_to(x, axis, mult):
     return jnp.pad(x, widths)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal=True, sm_scale=None, block_q=128,
                     block_k=128):
-    """Blocked attention, O(block) VMEM (q, k, v: [B, H, T, D])."""
+    """Blocked attention (q, k, v: [B, H, T, D]). Single dispatch point:
+    on a real TPU backend this routes to the jax library's TPU flash kernel
+    (fully-blocked Pallas backward, no [T, T] residuals — measured ~20%
+    faster in-model with seq-wide blocks than the 128 defaults); everywhere
+    else (CPU mesh, interpret mode) it runs the portable in-repo kernel
+    below, whose backward recomputes attention through XLA."""
+    if jax.default_backend() == "tpu":
+        T = q.shape[2]
+        blk = next((b for b in (512, 256, 128) if T % b == 0 and b <= T),
+                   None)
+        if blk is not None:
+            try:
+                from jax.experimental.pallas.ops.tpu.flash_attention import (
+                    BlockSizes, flash_attention as tpu_flash)
+            except ImportError:
+                tpu_flash = None
+            if tpu_flash is not None:
+                bs = BlockSizes(
+                    block_q=blk, block_k_major=blk, block_k=blk, block_b=1,
+                    block_q_major_dkv=blk, block_k_major_dkv=blk,
+                    block_k_dkv=blk, block_q_dkv=blk,
+                    block_k_major_dq=blk, block_k_dq=blk, block_q_dq=blk)
+                if sm_scale is None:
+                    sm_scale = q.shape[-1] ** -0.5
+                return tpu_flash(q, k, v, causal=causal, sm_scale=sm_scale,
+                                 block_sizes=bs)
+    return flash_attention_portable(q, k, v, causal, sm_scale, block_q,
+                                    block_k)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_portable(q, k, v, causal=True, sm_scale=None,
+                             block_q=128, block_k=128):
+    """The in-repo blocked kernel, O(block) VMEM (q, k, v: [B, H, T, D])."""
     return _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
 
 
@@ -172,4 +204,4 @@ def _flash_bwd_rule(causal, sm_scale, block_q, block_k, res, g):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+flash_attention_portable.defvjp(_flash_fwd_rule, _flash_bwd_rule)

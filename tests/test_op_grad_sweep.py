@@ -1,0 +1,228 @@
+"""Broad numeric-gradient sweep over the op corpus (parity: the reference's
+~300 OpTest-based test_*_op.py files — SURVEY §4.1; this sweep covers the
+families the dedicated tests in test_ops_math.py don't).
+
+Each case builds a tiny layer graph, takes analytic gradients via
+fluid.gradients, and compares against central-difference numeric gradients
+computed through the same executor path.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import framework
+
+
+def _numeric_grad(run_fwd, feeds, wrt, delta=1e-3):
+    base = feeds[wrt].astype(np.float64)
+    num = np.zeros_like(base)
+    flat_view = base.reshape(-1)
+    out = num.reshape(-1)
+    for i in range(flat_view.size):
+        orig = flat_view[i]
+        for sign, acc in ((+1, 1.0), (-1, -1.0)):
+            flat_view[i] = orig + sign * delta
+            f = dict(feeds)
+            f[wrt] = base.astype(np.float32)
+            out[i] += acc * run_fwd(f)
+        flat_view[i] = orig
+    return num / (2 * delta)
+
+
+def check_layer_grad(build, feeds, max_rel_err=5e-2, delta=1e-3):
+    """build(vars_dict) -> output var; checks d sum(out) / d each feed."""
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        in_vars = {}
+        for name, arr in feeds.items():
+            in_vars[name] = fluid.layers.data(
+                name=name, shape=list(arr.shape), dtype=str(arr.dtype),
+                append_batch_size=False, stop_gradient=False)
+        out = build(in_vars)
+        loss = fluid.layers.reduce_sum(out)
+        float_ins = [v for n, v in in_vars.items()
+                     if feeds[n].dtype == np.float32]
+        grads = fluid.gradients(loss, float_ins)
+        # ops with non-differentiable slots (labels etc.) yield None grads
+        pairs = [(v, g) for v, g in zip(float_ins, grads) if g is not None]
+        assert pairs, "no differentiable inputs produced gradients"
+        float_ins = [v for v, _ in pairs]
+        grads = [g for _, g in pairs]
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    analytic = exe.run(main, feed=feeds, fetch_list=list(grads))
+
+    fwd_main, fwd_startup = framework.Program(), framework.Program()
+    with framework.program_guard(fwd_main, fwd_startup):
+        fwd_vars = {}
+        for name, arr in feeds.items():
+            fwd_vars[name] = fluid.layers.data(
+                name=name, shape=list(arr.shape), dtype=str(arr.dtype),
+                append_batch_size=False, stop_gradient=False)
+        fwd_out = build(fwd_vars)
+        fwd_loss = fluid.layers.reduce_sum(fwd_out)
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    exe2.run(fwd_startup)
+
+    def run_fwd(f):
+        r, = exe2.run(fwd_main, feed=f, fetch_list=[fwd_loss])
+        return float(np.asarray(r, np.float64).sum())
+
+    for v, ga in zip(float_ins, analytic):
+        num = _numeric_grad(run_fwd, dict(feeds), v.name, delta)
+        ga = np.asarray(ga, np.float64).reshape(num.shape)
+        rel = (np.abs(ga - num) / np.maximum(np.abs(num), 1.0)).max()
+        assert rel < max_rel_err, (
+            "grad wrt %s: rel err %.4f\nanalytic=%s\nnumeric=%s"
+            % (v.name, rel, ga, num))
+
+
+RNG = np.random.RandomState(7)
+
+# interior points keep every op differentiable at the sample
+_X_SMOOTH = (RNG.rand(2, 3).astype(np.float32) * 0.8 + 0.1)       # (0.1, 0.9)
+_X_SIGNED = np.array([[-0.9, -0.4, 0.6], [0.3, -0.7, 0.8]], np.float32)
+_X_BIG = np.array([[1.3, 2.1, 0.7], [1.8, 0.4, 2.6]], np.float32)
+
+_UNARY = {
+    "exp": _X_SIGNED, "tanh": _X_SIGNED, "sigmoid": _X_SIGNED,
+    "log": _X_BIG, "sqrt": _X_BIG, "square": _X_SIGNED,
+    "abs": _X_SIGNED, "relu": _X_SIGNED, "leaky_relu": _X_SIGNED,
+    "elu": _X_SIGNED, "softplus": _X_SIGNED, "softsign": _X_SIGNED,
+    "reciprocal": _X_BIG, "rsqrt": _X_BIG, "sin": _X_SIGNED,
+    "cos": _X_SIGNED, "asin": _X_SIGNED, "acos": _X_SIGNED,
+    "atan": _X_SIGNED, "stanh": _X_SIGNED, "swish": _X_SIGNED,
+    "logsigmoid": _X_SIGNED, "tanh_shrink": _X_SIGNED,
+    "softshrink": _X_BIG, "hard_shrink": _X_BIG,
+    "thresholded_relu": _X_BIG, "relu6": _X_SIGNED, "brelu": _X_SIGNED,
+    "selu": _X_SIGNED, "soft_relu": _X_SIGNED, "hard_sigmoid": _X_SIGNED,
+    "sigmoid_cross_entropy_with_logits": None,  # handled separately
+}
+
+
+@pytest.mark.parametrize("name", sorted(n for n, v in _UNARY.items()
+                                        if v is not None))
+def test_unary_activation_grad(name):
+    x = _UNARY[name]
+    check_layer_grad(lambda vs: getattr(fluid.layers, name)(vs["x"]),
+                     {"x": x})
+
+
+@pytest.mark.parametrize("name", ["elementwise_add", "elementwise_sub",
+                                  "elementwise_mul", "elementwise_div",
+                                  "elementwise_max", "elementwise_min",
+                                  "elementwise_pow"])
+def test_binary_grad(name):
+    x = _X_BIG
+    y = _X_BIG.T.reshape(2, 3) + 0.5  # distinct values, no max/min ties
+    check_layer_grad(
+        lambda vs: getattr(fluid.layers, name)(vs["x"], vs["y"]),
+        {"x": x, "y": y})
+
+
+@pytest.mark.parametrize("name", ["reduce_sum", "reduce_mean", "reduce_max",
+                                  "reduce_min", "reduce_prod"])
+def test_reduce_grad(name):
+    x = np.array([[0.3, 1.7, 0.9], [2.2, 0.6, 1.4]], np.float32)  # unique
+    check_layer_grad(lambda vs: getattr(fluid.layers, name)(vs["x"], dim=[1]),
+                     {"x": x})
+
+
+@pytest.mark.parametrize("case", [
+    ("scale", lambda vs: fluid.layers.scale(vs["x"], scale=2.5, bias=0.3)),
+    ("clip", lambda vs: fluid.layers.clip(vs["x"], min=-0.5, max=0.5)),
+    ("cumsum", lambda vs: fluid.layers.cumsum(vs["x"], axis=1)),
+    ("transpose", lambda vs: fluid.layers.transpose(vs["x"], perm=[1, 0])),
+    ("reshape", lambda vs: fluid.layers.reshape(vs["x"], shape=[3, 2])),
+    ("flatten", lambda vs: fluid.layers.flatten(vs["x"], axis=1)),
+    ("squeeze", lambda vs: fluid.layers.squeeze(
+        fluid.layers.unsqueeze(vs["x"], axes=[0]), axes=[0])),
+    ("pad", lambda vs: fluid.layers.pad(vs["x"],
+                                        paddings=[0, 1, 1, 0])),
+    ("slice", lambda vs: fluid.layers.slice(vs["x"], axes=[0, 1],
+                                            starts=[0, 1], ends=[2, 3])),
+    ("expand", lambda vs: fluid.layers.expand(vs["x"],
+                                              expand_times=[2, 1])),
+    ("stack", lambda vs: fluid.layers.stack([vs["x"], vs["x"]], axis=0)),
+    ("l2_normalize", lambda vs: fluid.layers.l2_normalize(vs["x"], axis=1)),
+    ("log_softmax_path", lambda vs: fluid.layers.log(
+        fluid.layers.softmax(vs["x"]))),
+    ("mean", lambda vs: fluid.layers.mean(vs["x"])),
+    ("pow", lambda vs: fluid.layers.pow(vs["x"], factor=2.0)),
+    ("sums", lambda vs: fluid.layers.sums([vs["x"], vs["x"]])),
+    ("label_smooth_path", lambda vs: fluid.layers.label_smooth(
+        fluid.layers.softmax(vs["x"]), epsilon=0.1)),
+], ids=lambda c: c[0])
+def test_misc_op_grad(case):
+    _, build = case
+    check_layer_grad(build, {"x": _X_BIG})
+
+
+@pytest.mark.parametrize("case", [
+    ("square_error_cost", lambda vs: fluid.layers.square_error_cost(
+        vs["x"], vs["y"])),
+    ("huber_loss", lambda vs: fluid.layers.huber_loss(vs["x"], vs["y"],
+                                                      delta=0.8)),
+    ("log_loss", lambda vs: fluid.layers.log_loss(
+        fluid.layers.sigmoid(vs["x"]), vs["y"], epsilon=1e-4)),
+    ("smooth_l1", lambda vs: fluid.layers.smooth_l1(vs["x"], vs["y"])),
+    ("margin_rank_loss", lambda vs: fluid.layers.margin_rank_loss(
+        vs["x"], vs["y"], fluid.layers.scale(vs["y"], scale=0.5))),
+], ids=lambda c: c[0])
+def test_loss_op_grad(case):
+    _, build = case
+    x = _X_SIGNED
+    y = np.clip(_X_SMOOTH, 0.05, 0.95).astype(np.float32)
+    check_layer_grad(build, {"x": x, "y": y}, max_rel_err=6e-2)
+
+
+def test_sigmoid_cross_entropy_with_logits_grad():
+    lab = np.array([[0.0, 1.0, 0.0], [1.0, 0.0, 1.0]], np.float32)
+
+    def build(vs):
+        return fluid.layers.sigmoid_cross_entropy_with_logits(
+            x=vs["x"], label=vs["lab"])
+
+    # label is also float input; restrict check to x by making label grad
+    # well-defined anyway (it is: -x contribution)
+    check_layer_grad(build, {"x": _X_SIGNED, "lab": lab})
+
+
+def test_matmul_transpose_variants_grad():
+    a = RNG.rand(2, 3).astype(np.float32)
+    b = RNG.rand(2, 3).astype(np.float32)
+    check_layer_grad(
+        lambda vs: fluid.layers.matmul(vs["a"], vs["b"], transpose_y=True),
+        {"a": a, "b": b})
+    check_layer_grad(
+        lambda vs: fluid.layers.matmul(vs["a"], vs["b"], transpose_x=True),
+        {"a": a, "b": b})
+
+
+def test_gather_grad():
+    idx = np.array([0, 2, 1], np.int64)
+
+    def build(vs):
+        return fluid.layers.gather(vs["x"], vs["idx"])
+
+    check_layer_grad(build, {"x": _X_BIG.T.copy(), "idx": idx})
+
+
+def test_concat_split_grad():
+    def build(vs):
+        a, b = fluid.layers.split(vs["x"], num_or_sections=2, dim=1)
+        return fluid.layers.concat([b, a], axis=1)
+
+    x = RNG.rand(2, 4).astype(np.float32)
+    check_layer_grad(build, {"x": x})
+
+
+def test_bilinear_tensor_product_path_grad():
+    def build(vs):
+        return fluid.layers.elementwise_mul(
+            fluid.layers.cos_sim(vs["x"], vs["y"]),
+            fluid.layers.reduce_sum(vs["x"], dim=[1], keep_dim=True))
+
+    check_layer_grad(build, {"x": _X_BIG, "y": _X_BIG + 0.3},
+                     max_rel_err=6e-2)
