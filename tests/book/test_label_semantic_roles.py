@@ -1,0 +1,97 @@
+"""Book test: SRL with a linear-chain CRF head (parity: tests/book/
+test_label_semantic_roles.py — conll05 features -> embeddings -> FCs ->
+linear_chain_crf loss, crf_decoding inference). Padded-dense sequences with
+explicit lengths replace LoD."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import dataset
+
+WORD_V = 200
+VERB_V = 20
+LABELS = 7
+T = 12
+EMB = 16
+HID = 32
+
+
+def _build():
+    word = fluid.layers.data(name="word", shape=[T], dtype="int64")
+    verb = fluid.layers.data(name="verb", shape=[T], dtype="int64")
+    mark = fluid.layers.data(name="mark", shape=[T], dtype="int64")
+    label = fluid.layers.data(name="label", shape=[T], dtype="int64")
+    length = fluid.layers.data(name="length", shape=[1], dtype="int64")
+
+    embs = [
+        fluid.layers.embedding(input=word, size=[WORD_V, EMB]),
+        fluid.layers.embedding(input=verb, size=[VERB_V, EMB]),
+        fluid.layers.embedding(input=mark, size=[2, EMB]),
+    ]
+    h = fluid.layers.fc(input=embs, size=HID, num_flatten_dims=2,
+                        act="tanh")
+    emission = fluid.layers.fc(input=h, size=LABELS, num_flatten_dims=2)
+    crf_cost = fluid.layers.linear_chain_crf(
+        input=emission, label=label,
+        param_attr=fluid.ParamAttr(name="crfw"), length=length)
+    avg_cost = fluid.layers.mean(fluid.layers.scale(crf_cost, scale=-1.0))
+    return emission, avg_cost
+
+
+def _batches(n, rng):
+    """Synthetic SRL batches with a learnable rule: the gold label is
+    (word + is-predicate) mod LABELS."""
+    words = rng.randint(0, WORD_V, size=(n, T)).astype(np.int64)
+    verbs = rng.randint(0, VERB_V, size=(n, T)).astype(np.int64)
+    lens = rng.randint(4, T + 1, size=(n, 1)).astype(np.int64)
+    mark = np.zeros((n, T), np.int64)
+    mark[np.arange(n), rng.randint(0, 4, size=n)] = 1
+    labels = ((words + mark) % LABELS).astype(np.int64)
+    return words, verbs, mark, labels, lens
+
+
+def test_srl_crf_trains_and_decodes():
+    emission, avg_cost = _build()
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(avg_cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    rng = np.random.RandomState(11)
+    words, verbs, mark, labels, lens = _batches(128, rng)
+    losses = []
+    for epoch in range(12):
+        for i in range(0, 128, 32):
+            sl = slice(i, i + 32)
+            lv, = exe.run(feed={
+                "word": words[sl], "verb": verbs[sl], "mark": mark[sl],
+                "label": labels[sl], "length": lens[sl],
+            }, fetch_list=[avg_cost])
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert losses[-1] < losses[0] * 0.7, losses
+
+    # viterbi decode path agrees with gold on the (now mostly learned) rule
+    decode_prog = fluid.default_main_program().clone(for_test=True)
+    with fluid.program_guard(decode_prog):
+        em_var = decode_prog.global_block().var(emission.name)
+        path = fluid.layers.crf_decoding(
+            input=em_var, param_attr=fluid.ParamAttr(name="crfw"),
+            length=decode_prog.global_block().var("length"))
+    got, = exe.run(decode_prog, feed={
+        "word": words[:32], "verb": verbs[:32], "mark": mark[:32],
+        "label": labels[:32], "length": lens[:32]}, fetch_list=[path])
+    got = np.asarray(got).reshape(32, T)
+    valid = np.arange(T)[None, :] < lens[:32]
+    acc = (got[:32] == labels[:32])[valid].mean()
+    assert acc > 0.5, acc
+
+
+def test_conll05_reader_feeds_the_model():
+    """The dataset module's samples batch into the model's padded layout."""
+    sample = next(iter(dataset.conll05.test()()))
+    assert len(sample) == 9
+    word, *ctxs, verb, mark, lab = sample
+    L = len(word)
+    assert all(len(c) == L for c in ctxs) and len(lab) == L
+    padded = np.zeros((1, max(L, 4)), np.int64)
+    padded[0, :L] = np.asarray(word) % WORD_V
+    assert padded.shape[1] >= 4
