@@ -86,12 +86,31 @@ def test_srl_crf_trains_and_decodes():
 
 
 def test_conll05_reader_feeds_the_model():
-    """The dataset module's samples batch into the model's padded layout."""
-    sample = next(iter(dataset.conll05.test()()))
-    assert len(sample) == 9
-    word, *ctxs, verb, mark, lab = sample
-    L = len(word)
-    assert all(len(c) == L for c in ctxs) and len(lab) == L
-    padded = np.zeros((1, max(L, 4)), np.int64)
-    padded[0, :L] = np.asarray(word) % WORD_V
-    assert padded.shape[1] >= 4
+    """conll05 samples, padded to the model layout, run through the CRF
+    graph end-to-end and produce a finite loss."""
+    _, avg_cost = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    B = 8
+    words = np.zeros((B, T), np.int64)
+    verbs = np.zeros((B, T), np.int64)
+    mark = np.zeros((B, T), np.int64)
+    labels = np.zeros((B, T), np.int64)
+    lens = np.ones((B, 1), np.int64)
+    for i, sample in enumerate(dataset.conll05.test()()):
+        if i >= B:
+            break
+        word, *ctxs, verb, vmark, lab = sample
+        assert len(sample) == 9
+        L = min(len(word), T)
+        assert all(len(c) == len(word) for c in ctxs)
+        words[i, :L] = np.asarray(word[:L]) % WORD_V
+        verbs[i, :L] = np.asarray(verb[:L]) % VERB_V
+        mark[i, :L] = np.asarray(vmark[:L]) % 2
+        labels[i, :L] = np.asarray(lab[:L]) % LABELS
+        lens[i, 0] = L
+    lv, = exe.run(feed={"word": words, "verb": verbs, "mark": mark,
+                        "label": labels, "length": lens},
+                  fetch_list=[avg_cost])
+    assert np.isfinite(np.asarray(lv)).all()

@@ -46,9 +46,11 @@ def _build():
     return pred, avg_cost
 
 
-def _from_reader(n):
+def _from_reader(n, split="train"):
+    reader = (dataset.movielens.train() if split == "train"
+              else dataset.movielens.test())
     raw = []
-    for s in dataset.movielens.train()():
+    for s in reader():
         raw.append(s)
         if len(raw) >= n:
             break
@@ -84,8 +86,8 @@ def test_recommender_trains_on_movielens():
     # movielens scores correlate with (user+movie) parity — learnable
     assert losses[-1] < losses[0] * 0.8, losses
 
-    # inference-style run on the test split must produce in-range scores
-    test_data = _from_reader(64)
+    # inference-style run on the (held-out) test split
+    test_data = _from_reader(64, split="test")
     infer_prog = fluid.default_main_program().clone(for_test=True)
     out, = exe.run(infer_prog, feed=test_data, fetch_list=[pred])
     out = np.asarray(out)
