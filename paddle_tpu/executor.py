@@ -122,7 +122,12 @@ class _CompiledStep:
         block = self.program.global_block()
         for name in self.feed_names:
             v = block._find_var_recursive(name)
-            arr = np.asarray(feed[name])
+            arr = feed[name]
+            # device-resident arrays (PyReader double-buffer, user
+            # device_put) pass through untouched — np.asarray here would
+            # round-trip them over the host link every step
+            if not isinstance(arr, jax.Array):
+                arr = np.asarray(arr)
             if v is not None and v.shape is not None:
                 want = dtype_to_np(v.dtype)
                 if arr.dtype != want:
