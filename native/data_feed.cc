@@ -1,0 +1,156 @@
+// MultiSlot text data feed parser (parity: framework/data_feed.cc
+// MultiSlotDataFeed::ParseOneInstance / CheckFile — C16). The format is the
+// reference's CTR ingestion format: one instance per line, and for each
+// slot in declared order: "<num> <v1> ... <vnum>" whitespace-separated.
+// Slot values are int64 ids (sparse) or floats (dense stats).
+//
+// The parser returns columnar storage (per-slot value arrays + per-record
+// offsets), which maps directly onto the padded-dense + lengths batching
+// the TPU lowering uses instead of LoD.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "ptpu_native.h"
+
+namespace {
+
+struct MSlotData {
+  int n_slots = 0;
+  std::vector<int> types;  // 0 = int64, 1 = float32
+  int64_t n_records = 0;
+  int64_t bad_lines = 0;
+  std::vector<std::vector<int64_t>> ints;
+  std::vector<std::vector<float>> floats;
+  // offsets[slot] has n_records+1 entries: record r's values live in
+  // [offsets[r], offsets[r+1]) of the slot's value array
+  std::vector<std::vector<int64_t>> offsets;
+};
+
+// a parsed token must end at whitespace/EOL — a digit-prefix parse of
+// "2.5" as count 2 would silently misread the rest of the line
+bool at_boundary(const char* p) {
+  return *p == '\0' || *p == ' ' || *p == '\t' || *p == '\r' || *p == '\n';
+}
+
+// parse one line; returns false (and rolls back) on malformed input
+bool parse_line(const char* p, MSlotData* d) {
+  std::vector<size_t> int_sizes(d->n_slots), float_sizes(d->n_slots);
+  for (int s = 0; s < d->n_slots; ++s) {
+    int_sizes[s] = d->ints[s].size();
+    float_sizes[s] = d->floats[s].size();
+  }
+  const char* cur = p;
+  for (int s = 0; s < d->n_slots; ++s) {
+    char* end = nullptr;
+    errno = 0;
+    long long num = strtoll(cur, &end, 10);
+    if (end == cur || num < 0 || errno == ERANGE || !at_boundary(end))
+      goto fail;
+    cur = end;
+    for (long long i = 0; i < num; ++i) {
+      if (d->types[s] == 0) {
+        errno = 0;
+        long long v = strtoll(cur, &end, 10);
+        // out-of-range ids (uint64 hashes past int64) are rejected, not
+        // saturated — matches the Python fallback's overflow handling
+        if (end == cur || errno == ERANGE || !at_boundary(end)) goto fail;
+        d->ints[s].push_back(static_cast<int64_t>(v));
+      } else {
+        errno = 0;
+        float v = strtof(cur, &end);
+        if (end == cur || !at_boundary(end)) goto fail;
+        d->floats[s].push_back(v);
+      }
+      cur = end;
+    }
+  }
+  // trailing garbage after the last slot is a format error (CheckFile
+  // parity: the reference rejects lines with leftover columns)
+  while (*cur == ' ' || *cur == '\t' || *cur == '\r' || *cur == '\n') ++cur;
+  if (*cur != '\0') goto fail;
+  for (int s = 0; s < d->n_slots; ++s) {
+    d->offsets[s].push_back(static_cast<int64_t>(
+        d->types[s] == 0 ? d->ints[s].size() : d->floats[s].size()));
+  }
+  d->n_records++;
+  return true;
+fail:
+  for (int s = 0; s < d->n_slots; ++s) {
+    d->ints[s].resize(int_sizes[s]);
+    d->floats[s].resize(float_sizes[s]);
+  }
+  d->bad_lines++;
+  return false;
+}
+
+}  // namespace
+
+extern "C" {
+
+PTPU_API void* ptpu_mslot_parse_file(const char* path, int n_slots,
+                                     const int* slot_types) {
+  if (n_slots <= 0) return nullptr;
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  auto* d = new MSlotData();
+  d->n_slots = n_slots;
+  d->types.assign(slot_types, slot_types + n_slots);
+  d->ints.resize(n_slots);
+  d->floats.resize(n_slots);
+  d->offsets.assign(n_slots, std::vector<int64_t>(1, 0));
+
+  std::string line;
+  char buf[1 << 16];
+  while (fgets(buf, sizeof(buf), f)) {
+    line += buf;
+    if (!line.empty() && line.back() != '\n' && !feof(f)) continue;
+    if (line.find_first_not_of(" \t\r\n") != std::string::npos) {
+      parse_line(line.c_str(), d);
+    }
+    line.clear();
+  }
+  fclose(f);
+  return d;
+}
+
+PTPU_API int64_t ptpu_mslot_num_records(void* h) {
+  return static_cast<MSlotData*>(h)->n_records;
+}
+
+PTPU_API int64_t ptpu_mslot_bad_lines(void* h) {
+  return static_cast<MSlotData*>(h)->bad_lines;
+}
+
+PTPU_API int64_t ptpu_mslot_slot_total(void* h, int slot) {
+  auto* d = static_cast<MSlotData*>(h);
+  if (slot < 0 || slot >= d->n_slots) return -1;
+  return d->types[slot] == 0
+             ? static_cast<int64_t>(d->ints[slot].size())
+             : static_cast<int64_t>(d->floats[slot].size());
+}
+
+PTPU_API void ptpu_mslot_copy_int64(void* h, int slot, int64_t* out) {
+  auto* d = static_cast<MSlotData*>(h);
+  memcpy(out, d->ints[slot].data(), d->ints[slot].size() * sizeof(int64_t));
+}
+
+PTPU_API void ptpu_mslot_copy_float(void* h, int slot, float* out) {
+  auto* d = static_cast<MSlotData*>(h);
+  memcpy(out, d->floats[slot].data(), d->floats[slot].size() * sizeof(float));
+}
+
+PTPU_API void ptpu_mslot_copy_offsets(void* h, int slot, int64_t* out) {
+  auto* d = static_cast<MSlotData*>(h);
+  memcpy(out, d->offsets[slot].data(),
+         d->offsets[slot].size() * sizeof(int64_t));
+}
+
+PTPU_API void ptpu_mslot_free(void* h) { delete static_cast<MSlotData*>(h); }
+
+}  // extern "C"

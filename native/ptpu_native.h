@@ -78,6 +78,20 @@ PTPU_API int64_t ptpu_program_seal(const char* payload, uint64_t len,
 PTPU_API int64_t ptpu_program_unseal(const char* buf, uint64_t len,
                                      char** out);
 
+// ---- MultiSlot text data feed (framework/data_feed.cc C16 parity) ----
+// slot_types: 0 = int64 ids, 1 = float32. Returns a handle (NULL on open
+// failure); malformed lines are counted and skipped (CheckFile behavior).
+PTPU_API void* ptpu_mslot_parse_file(const char* path, int n_slots,
+                                     const int* slot_types);
+PTPU_API int64_t ptpu_mslot_num_records(void* h);
+PTPU_API int64_t ptpu_mslot_bad_lines(void* h);
+PTPU_API int64_t ptpu_mslot_slot_total(void* h, int slot);
+PTPU_API void ptpu_mslot_copy_int64(void* h, int slot, int64_t* out);
+PTPU_API void ptpu_mslot_copy_float(void* h, int slot, float* out);
+// out must hold num_records+1 entries
+PTPU_API void ptpu_mslot_copy_offsets(void* h, int slot, int64_t* out);
+PTPU_API void ptpu_mslot_free(void* h);
+
 PTPU_API void ptpu_buf_free(char* buf);
 PTPU_API uint32_t ptpu_crc32(const char* data, uint64_t len);
 PTPU_API const char* ptpu_version(void);

@@ -71,6 +71,7 @@ class DataFeedDesc:
     def __init__(self, proto_file=None):
         self.name = "MultiSlotDataFeed"
         self.batch_size = 1
+        self._batch_size_set = False
         self.slots = []  # dicts: name, type, shape, is_dense, is_used
         self._slot_index = {}
         if proto_file is not None:
@@ -90,6 +91,7 @@ class DataFeedDesc:
         bs = re.search(r"batch_size:\s*(\d+)", text)
         if bs:
             self.batch_size = int(bs.group(1))
+            self._batch_size_set = True
 
     def add_slot(self, name, dtype="float", shape=None, is_dense=False):
         self._slot_index[name] = len(self.slots)
@@ -100,6 +102,7 @@ class DataFeedDesc:
 
     def set_batch_size(self, batch_size):
         self.batch_size = batch_size
+        self._batch_size_set = True
 
     def set_dense_slots(self, dense_slots_name):
         for n in dense_slots_name:

@@ -25,9 +25,19 @@ class DatasetBase:
         self._batch_size = 1
         self._use_var = []
         self._thread = 1
+        self._feed_desc = None
 
     def set_filelist(self, filelist):
         self._filelist = list(filelist)
+
+    def set_data_feed_desc(self, desc):
+        """Attach a DataFeedDesc: the filelist is then read as MultiSlot
+        TEXT files through the C++ parser (native/data_feed.cc —
+        MultiSlotDataFeed parity) instead of recordio shards."""
+        self._feed_desc = desc
+        # only an explicitly-set desc batch size overrides the dataset's
+        if getattr(desc, "_batch_size_set", False):
+            self._batch_size = desc.batch_size
 
     def set_batch_size(self, batch_size):
         self._batch_size = batch_size
@@ -39,6 +49,30 @@ class DatasetBase:
         self._use_var = list(var_list)
 
     def _sample_reader(self):
+        if self._feed_desc is not None:
+            from .core import native
+
+            desc = self._feed_desc
+            # ALL declared slots are parsed (they're in the file), but only
+            # is_used slots are yielded, in declaration order — matching
+            # set_use_slots/set_use_var binding semantics
+            types = [s["type"] for s in desc.slots]
+            used = [i for i, s in enumerate(desc.slots)
+                    if s.get("is_used", True)]
+
+            def reader():
+                for path in self._filelist:
+                    records, bad = native.parse_multislot_file(path, types)
+                    if bad:
+                        import logging
+
+                        logging.warning(
+                            "MultiSlot file %s: skipped %d malformed "
+                            "line(s)", path, bad)
+                    for rec in records:
+                        yield tuple(rec[i] for i in used)
+
+            return reader
         return recordio_writer.recordio_reader_creator(self._filelist)
 
     def _batches(self):
@@ -57,7 +91,15 @@ class DatasetBase:
         cols = list(zip(*batch))
         feed = {}
         for name, col in zip(feed_names, cols):
-            stacked = np.stack([np.asarray(c) for c in col])
+            arrs = [np.asarray(c) for c in col]
+            # variable-length sparse slots (the MultiSlot norm) batch
+            # padded-dense: pad 1-D id/value lists with 0 to the batch max
+            # (the LoD -> padded+lengths bridge, SURVEY §5.7)
+            if (arrs[0].ndim == 1
+                    and len({a.shape[0] for a in arrs}) > 1):
+                maxlen = max(a.shape[0] for a in arrs)
+                arrs = [np.pad(a, (0, maxlen - a.shape[0])) for a in arrs]
+            stacked = np.stack(arrs)
             if stacked.ndim == 1:  # scalar fields batch to [N, 1] (labels)
                 stacked = stacked.reshape(-1, 1)
             feed[name] = stacked
