@@ -141,10 +141,111 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
+        from .dygraph import base as dy_base
+
+        if dy_base.enabled():
+            return self._dygraph_minimize(loss, parameter_list)
         params_grads = self.backward(loss, startup_program, parameter_list,
                                      no_grad_set)
         optimize_ops = self.apply_gradients(params_grads)
         return optimize_ops, params_grads
+
+    # -- dygraph (eager) path ------------------------------------------------
+    # Parity: the reference optimizer applies updates directly to VarBase
+    # params after loss.backward() populates their gradients
+    # (optimizer.py minimize under in_dygraph_mode). Accumulators live on
+    # the optimizer instance, keyed by the parameter object. Updates run
+    # in jnp (device-resident, no host round-trip) at fp32, cast back to
+    # the parameter's own dtype. Gradient clipping (set_gradient_clip) is
+    # static-graph-only in the reference too; weight decay IS applied.
+
+    def _eager_lr(self):
+        lr = self._learning_rate
+        if isinstance(lr, (int, float)):
+            return float(lr)
+        if callable(lr):
+            return float(lr())
+        raise NotImplementedError(
+            "%s: dygraph mode needs a numeric learning rate (got %r)"
+            % (self.__class__.__name__, lr))
+
+    def _eager_state_for(self, p):
+        # keyed by the VarBase object (holds a reference — same lifetime
+        # as the reference's per-param accumulator vars; id() alone could
+        # be reused after gc)
+        if not hasattr(self, "_eager_state"):
+            self._eager_state = {}
+        return self._eager_state.setdefault(p, {})
+
+    def _eager_update(self, p, g, lr):
+        raise NotImplementedError(
+            "%s has no dygraph update rule" % self.__class__.__name__)
+
+    @staticmethod
+    def _eager_param_f32(p):
+        import jax.numpy as jnp
+
+        return jnp.asarray(p.value).astype(jnp.float32)
+
+    @staticmethod
+    def _eager_assign(p, new_f32):
+        import jax.numpy as jnp
+
+        p.value = new_f32.astype(jnp.asarray(p.value).dtype)
+
+    def _eager_parameters(self):
+        """Parameters seen on the tracer tape, discovered incrementally
+        (the tape is append-only; rescanning it whole every step would be
+        O(steps^2))."""
+        from .dygraph import base as dy_base
+
+        t = dy_base._current_tracer()
+        if not hasattr(self, "_eager_params"):
+            self._eager_params = []
+            self._eager_seen = set()
+            self._tape_key = None
+            self._tape_pos = 0
+        if self._tape_key != id(t.tape):
+            self._tape_key = id(t.tape)
+            self._tape_pos = 0
+        entries = t.tape.entries
+        for _op, ins, _attrs, vouts, _ctx in entries[self._tape_pos:]:
+            for vs in list(ins.values()) + list(vouts.values()):
+                for v in vs:
+                    if (isinstance(v, dy_base.VarBase) and v.persistable
+                            and not v.stop_gradient
+                            and id(v) not in self._eager_seen):
+                        self._eager_seen.add(id(v))
+                        self._eager_params.append(v)
+        self._tape_pos = len(entries)
+        return self._eager_params
+
+    def _dygraph_minimize(self, loss, parameter_list=None):
+        """Apply updates to every tracked parameter with a gradient (the
+        user has already called loss.backward())."""
+        import jax.numpy as jnp
+
+        if parameter_list is None:
+            parameter_list = self._eager_parameters()
+        lr = self._eager_lr()
+        reg = self.regularization
+        params_grads = []
+        for p in parameter_list:
+            if getattr(p, "_grad", None) is None:
+                continue
+            g = jnp.asarray(p._grad).astype(jnp.float32)
+            if reg is not None:
+                from .regularizer import (L1DecayRegularizer,
+                                          L2DecayRegularizer)
+
+                pv = jnp.asarray(p.value).astype(jnp.float32)
+                if isinstance(reg, L2DecayRegularizer):
+                    g = g + reg._coeff * pv
+                elif isinstance(reg, L1DecayRegularizer):
+                    g = g + reg._coeff * jnp.sign(pv)
+            self._eager_update(p, g, lr)
+            params_grads.append((p, p._grad))
+        return [], params_grads
 
 
 class SGDOptimizer(Optimizer):
@@ -160,6 +261,9 @@ class SGDOptimizer(Optimizer):
                     "LearningRate": [self._create_param_lr(param_and_grad)]},
             outputs={"ParamOut": [p]},
         )
+
+    def _eager_update(self, p, g, lr):
+        self._eager_assign(p, self._eager_param_f32(p) - lr * g)
 
 
 class MomentumOptimizer(Optimizer):
@@ -184,6 +288,14 @@ class MomentumOptimizer(Optimizer):
             outputs={"ParamOut": [p], "VelocityOut": [v]},
             attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov},
         )
+
+    def _eager_update(self, p, g, lr):
+        st = self._eager_state_for(p)
+        v = st.get("velocity")
+        v = g if v is None else self._momentum * v + g
+        st["velocity"] = v
+        step = (g + self._momentum * v) if self._use_nesterov else v
+        self._eager_assign(p, self._eager_param_f32(p) - lr * step)
 
 
 class LarsMomentumOptimizer(Optimizer):
@@ -250,6 +362,16 @@ class AdagradOptimizer(Optimizer):
             attrs={"epsilon": self._epsilon},
         )
 
+    def _eager_update(self, p, g, lr):
+        import jax.numpy as jnp
+
+        st = self._eager_state_for(p)
+        m = st.get("moment", jnp.full_like(g, self._initial)) + g * g
+        st["moment"] = m
+        self._eager_assign(
+            p, self._eager_param_f32(p)
+            - lr * g / (jnp.sqrt(m) + self._epsilon))
+
 
 class AdamOptimizer(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
@@ -286,6 +408,22 @@ class AdamOptimizer(Optimizer):
             attrs={"beta1": self._beta1, "beta2": self._beta2,
                    "epsilon": self._epsilon},
         )
+
+    def _eager_update(self, p, g, lr):
+        import jax.numpy as jnp
+
+        st = self._eager_state_for(p)
+        m = st.get("m", jnp.zeros_like(g))
+        v = st.get("v", jnp.zeros_like(g))
+        b1p = st.get("b1p", 1.0) * self._beta1
+        b2p = st.get("b2p", 1.0) * self._beta2
+        m = self._beta1 * m + (1.0 - self._beta1) * g
+        v = self._beta2 * v + (1.0 - self._beta2) * g * g
+        st.update(m=m, v=v, b1p=b1p, b2p=b2p)
+        lr_t = lr * float(np.sqrt(1.0 - b2p) / (1.0 - b1p))
+        self._eager_assign(
+            p, self._eager_param_f32(p)
+            - lr_t * m / (jnp.sqrt(v) + self._epsilon))
 
 
 class AdamaxOptimizer(Optimizer):
@@ -406,6 +544,23 @@ class RMSPropOptimizer(Optimizer):
             attrs={"decay": self._rho, "epsilon": self._epsilon,
                    "momentum": self._momentum, "centered": self._centered},
         )
+
+    def _eager_update(self, p, g, lr):
+        import jax.numpy as jnp
+
+        st = self._eager_state_for(p)
+        ms = st.get("mean_square", jnp.zeros_like(g))
+        mg = st.get("mean_grad", jnp.zeros_like(g))
+        mom = st.get("moment", jnp.zeros_like(g))
+        ms = self._rho * ms + (1.0 - self._rho) * g * g
+        if self._centered:
+            mg = self._rho * mg + (1.0 - self._rho) * g
+            denom = jnp.sqrt(ms - mg * mg + self._epsilon)
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * mom + lr * g / denom
+        st.update(mean_square=ms, mean_grad=mg, moment=mom)
+        self._eager_assign(p, self._eager_param_f32(p) - mom)
 
 
 class FtrlOptimizer(Optimizer):
