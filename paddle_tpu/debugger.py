@@ -1,0 +1,105 @@
+"""Program debugging utilities (parity: python/paddle/fluid/debugger.py —
+pprint_program_codes :105, pprint_block_codes :114, draw_block_graphviz
+:222 — and graphviz.py's DOT writer, implemented here without external
+dependencies against this framework's Program/Block/Operator IR)."""
+
+__all__ = ["repr_var", "repr_op", "pprint_block_codes",
+           "pprint_program_codes", "draw_block_graphviz"]
+
+
+def repr_var(var):
+    """`name : type(dtype, shape)` pseudo-declaration line."""
+    shape = tuple(var.shape) if var.shape is not None else "?"
+    tags = []
+    if getattr(var, "persistable", False):
+        tags.append("persist")
+    if getattr(var, "is_data", False):
+        tags.append("data")
+    suffix = (" [%s]" % ",".join(tags)) if tags else ""
+    return "%s : %s(%s, %s)%s" % (var.name, var.type, var.dtype, shape,
+                                  suffix)
+
+
+def _fmt_attr(v):
+    if isinstance(v, float):
+        return "%g" % v
+    if isinstance(v, str):
+        return repr(v)
+    return repr(v)
+
+
+def repr_op(op):
+    """`outs = op_type(slot=ins, ..., attr=value, ...)` pseudo-code line."""
+    outs = ", ".join("%s=[%s]" % (slot, ", ".join(v.name for v in vs))
+                     for slot, vs in sorted(op.outputs.items()) if vs)
+    ins = ", ".join("%s=[%s]" % (slot, ", ".join(v.name for v in vs))
+                    for slot, vs in sorted(op.inputs.items()) if vs)
+    attrs = ", ".join("%s=%s" % (k, _fmt_attr(v))
+                      for k, v in sorted(op.attrs.items())
+                      if not k.startswith("__"))
+    parts = [p for p in (ins, attrs) if p]
+    return "%s = %s(%s)" % (outs or "()", op.type, ", ".join(parts))
+
+
+def pprint_block_codes(block, show_backward=False, _out=None):
+    """Readable pseudo-code for one Block (debugger.py:114). Grad ops are
+    hidden unless show_backward."""
+    lines = ["# block %d" % getattr(block, "idx", 0)]
+    for var in sorted(block.vars.values(), key=lambda v: v.name):
+        lines.append("var " + repr_var(var))
+    lines.append("")
+    for op in block.ops:
+        if not show_backward and op.type.endswith("_grad"):
+            continue
+        lines.append(repr_op(op))
+    text = "\n".join(lines) + "\n"
+    if _out is not None:
+        _out.write(text)
+    else:
+        print(text)
+    return text
+
+
+def pprint_program_codes(program, show_backward=False):
+    out = []
+    for block in program.blocks:
+        out.append(pprint_block_codes(block, show_backward))
+    return "".join(out)
+
+
+def draw_block_graphviz(block, highlights=None, path="./temp.dot"):
+    """Write a DOT graph of the block's dataflow: op nodes (boxes) wired to
+    variable nodes (ellipses); `highlights` var names render red
+    (debugger.py:222 behavior, self-contained DOT emission)."""
+    highlights = set(highlights or [])
+    lines = ["digraph G {", "  rankdir=TB;"]
+
+    def var_id(name):
+        return "var_" + "".join(c if c.isalnum() else "_" for c in name)
+
+    emitted = set()
+
+    def emit_var(name):
+        if name in emitted:
+            return
+        emitted.add(name)
+        color = ' color=red style=filled fillcolor="#ffdddd"' \
+            if name in highlights else ""
+        lines.append('  %s [label="%s" shape=ellipse%s];'
+                     % (var_id(name), name, color))
+
+    for i, op in enumerate(block.ops):
+        op_node = "op_%d" % i
+        lines.append('  %s [label="%s" shape=box style=filled '
+                     'fillcolor="#ddddff"];' % (op_node, op.type))
+        for name in op.input_names():
+            emit_var(name)
+            lines.append("  %s -> %s;" % (var_id(name), op_node))
+        for name in op.output_names():
+            emit_var(name)
+            lines.append("  %s -> %s;" % (op_node, var_id(name)))
+    lines.append("}")
+    text = "\n".join(lines) + "\n"
+    with open(path, "w") as f:
+        f.write(text)
+    return path

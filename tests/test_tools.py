@@ -57,3 +57,28 @@ def test_fluid_benchmark_mnist_smoke():
     rec = json.loads(out.stdout.strip().splitlines()[-1])
     assert rec["unit"] == "examples/s/chip" and rec["value"] > 0
     assert rec["last_loss"] < rec["first_loss"]
+
+
+def test_debugger_pprint_and_dot(tmp_path):
+    import paddle_tpu as fluid
+    from paddle_tpu import debugger
+
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.fc(input=x, size=2, act="relu")
+    loss = fluid.layers.mean(y)
+    fluid.optimizer.SGD(0.1).minimize(loss)
+
+    block = fluid.default_main_program().global_block()
+    text = debugger.pprint_block_codes(block, _out=open(os.devnull, "w"))
+    assert "fc" in text or "mul" in text
+    assert "_grad" not in text  # hidden by default
+    text_bw = debugger.pprint_program_codes(
+        fluid.default_main_program(), show_backward=True)
+    assert "_grad" in text_bw
+
+    dot = tmp_path / "g.dot"
+    debugger.draw_block_graphviz(block, highlights=[loss.name],
+                                 path=str(dot))
+    content = dot.read_text()
+    assert content.startswith("digraph G {") and "shape=box" in content
+    assert "fillcolor=\"#ffdddd\"" in content  # highlighted loss var
