@@ -15,7 +15,9 @@ import threading
 
 __all__ = ["map_readers", "buffered", "compose", "chain", "shuffle",
            "firstn", "xmap_readers", "cache", "batch", "PyReader",
-           "multiprocess_reader", "PipeReader"]
+           "multiprocess_reader", "PipeReader", "creator"]
+
+from . import creator  # noqa: F401,E402
 
 
 def map_readers(func, *readers):

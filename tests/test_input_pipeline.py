@@ -123,3 +123,25 @@ def test_pyreader_iterates_batches():
     py_reader.decorate_batch_generator(gen)
     got = [b["x"][0, 0] for b in py_reader()]
     assert got == [float(i) for i in range(6)]
+
+
+def test_reader_creator_package(tmp_path):
+    """paddle.reader.creator parity: np_array, text_file, recordio."""
+    import numpy as np
+    from paddle_tpu import reader as reader_mod
+    from paddle_tpu.recordio_writer import convert_reader_to_recordio_file
+
+    arr = np.arange(12).reshape(4, 3)
+    rows = list(reader_mod.creator.np_array(arr)())
+    assert len(rows) == 4 and np.array_equal(rows[1], [3, 4, 5])
+
+    txt = tmp_path / "lines.txt"
+    txt.write_text("alpha\nbeta\ngamma\n")
+    assert list(reader_mod.creator.text_file(str(txt))()) == [
+        "alpha", "beta", "gamma"]
+
+    rio = str(tmp_path / "data.recordio")
+    convert_reader_to_recordio_file(
+        rio, lambda: iter([(np.float32(1.5),), (np.float32(2.5),)]))
+    got = [s[0] for s in reader_mod.creator.recordio(rio)()]
+    assert [float(v) for v in got] == [1.5, 2.5]
