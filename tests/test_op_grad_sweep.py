@@ -53,20 +53,11 @@ def check_layer_grad(build, feeds, max_rel_err=5e-2, delta=1e-3):
     exe.run(startup)
     analytic = exe.run(main, feed=feeds, fetch_list=list(grads))
 
-    fwd_main, fwd_startup = framework.Program(), framework.Program()
-    with framework.program_guard(fwd_main, fwd_startup):
-        fwd_vars = {}
-        for name, arr in feeds.items():
-            fwd_vars[name] = fluid.layers.data(
-                name=name, shape=list(arr.shape), dtype=str(arr.dtype),
-                append_batch_size=False, stop_gradient=False)
-        fwd_out = build(fwd_vars)
-        fwd_loss = fluid.layers.reduce_sum(fwd_out)
-    exe2 = fluid.Executor(fluid.CPUPlace())
-    exe2.run(fwd_startup)
-
+    # numeric runs reuse the SAME program and scope: layers that create
+    # parameters (sequence_conv, dynamic_gru, ...) must see the exact
+    # weights the analytic gradients were computed against
     def run_fwd(f):
-        r, = exe2.run(fwd_main, feed=f, fetch_list=[fwd_loss])
+        r, = exe.run(main, feed=f, fetch_list=[loss])
         return float(np.asarray(r, np.float64).sum())
 
     for v, ga in zip(float_ins, analytic):

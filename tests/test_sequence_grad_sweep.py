@@ -1,0 +1,108 @@
+"""Numeric-gradient coverage for the sequence-op family (parity: the
+reference's sequence_ops/ OpTest files — SURVEY §2.2; padded-dense +
+lengths semantics per §5.7). Reuses the check_layer_grad harness."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+from test_op_grad_sweep import check_layer_grad
+
+RNG = np.random.RandomState(13)
+X = RNG.rand(3, 5, 4).astype(np.float32) + 0.1   # [B, T, D]
+LENS = np.array([[5], [3], [4]], np.int64)
+
+
+def _len_var(vs):
+    return vs["lens"]
+
+
+@pytest.mark.parametrize("ptype", ["SUM", "AVERAGE", "MAX", "SQRT",
+                                   "LAST", "FIRST"])
+def test_sequence_pool_grad(ptype):
+    x = X.copy()
+    if ptype == "MAX":
+        # unique values so max is differentiable at the sample point
+        x = (np.arange(x.size, dtype=np.float32).reshape(x.shape) / x.size
+             + x / 10.0)
+
+    def build(vs):
+        return fluid.layers.sequence_pool(vs["x"], pool_type=ptype.lower(),
+                                          sequence_length=_len_var(vs))
+
+    check_layer_grad(build, {"x": x, "lens": LENS})
+
+
+def test_sequence_softmax_grad():
+    def build(vs):
+        return fluid.layers.sequence_softmax(
+            vs["x"], sequence_length=_len_var(vs))
+
+    check_layer_grad(build, {"x": X[:, :, 0].copy(), "lens": LENS})
+
+
+def test_sequence_reverse_grad():
+    def build(vs):
+        return fluid.layers.sequence_reverse(
+            vs["x"], sequence_length=_len_var(vs))
+
+    check_layer_grad(build, {"x": X, "lens": LENS})
+
+
+def test_sequence_conv_grad():
+    def build(vs):
+        return fluid.layers.sequence_conv(vs["x"], num_filters=6,
+                                          filter_size=3)
+
+    check_layer_grad(build, {"x": X})
+
+
+def test_sequence_pad_unpad_roundtrip_grad():
+    def build(vs):
+        padded, _ = fluid.layers.sequence_pad(
+            vs["x"], pad_value=fluid.layers.fill_constant(
+                shape=[1], dtype="float32", value=0.0),
+            sequence_length=_len_var(vs))
+        return fluid.layers.sequence_unpad(padded, _len_var(vs))
+
+    check_layer_grad(build, {"x": X, "lens": LENS})
+
+
+def test_sequence_expand_as_grad():
+    x = RNG.rand(3, 1, 4).astype(np.float32)
+
+    def build(vs):
+        return fluid.layers.sequence_expand_as(vs["x"], vs["y"])
+
+    check_layer_grad(build, {"x": x, "y": X})
+
+
+def test_sequence_first_last_step_grad():
+    def build_first(vs):
+        return fluid.layers.sequence_first_step(
+            vs["x"], sequence_length=_len_var(vs))
+
+    def build_last(vs):
+        return fluid.layers.sequence_last_step(
+            vs["x"], sequence_length=_len_var(vs))
+
+    check_layer_grad(build_first, {"x": X, "lens": LENS})
+    check_layer_grad(build_last, {"x": X, "lens": LENS})
+
+
+def test_dynamic_gru_lstm_grad():
+    x = RNG.rand(2, 4, 12).astype(np.float32)  # gru input: 3*hidden
+
+    def build_gru(vs):
+        return fluid.layers.dynamic_gru(vs["x"], size=4)
+
+    check_layer_grad(build_gru, {"x": x}, max_rel_err=8e-2, delta=2e-3)
+
+    x2 = RNG.rand(2, 4, 16).astype(np.float32)  # lstm input: 4*hidden
+
+    def build_lstm(vs):
+        h, _c = fluid.layers.dynamic_lstm(vs["x"], size=16)
+        return h
+
+    check_layer_grad(build_lstm, {"x": x2}, max_rel_err=8e-2, delta=2e-3)
