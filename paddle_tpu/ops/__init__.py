@@ -18,3 +18,4 @@ from . import metric_ops  # noqa: F401
 from . import controlflow  # noqa: F401
 from . import misc_ops  # noqa: F401
 from . import quant_ops  # noqa: F401
+from . import compat_ops  # noqa: F401

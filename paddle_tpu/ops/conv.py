@@ -176,11 +176,49 @@ def _adaptive_pool3d(ctx, ins, attrs):
     raise NotImplementedError("non-divisible adaptive_pool3d")
 
 
+def _pool_max_with_index(x, attrs, nd):
+    """Max pool returning (values, argmax Mask of flat indices into the
+    input's spatial volume — max_pool_with_index_op.cc semantics)."""
+    ksize = list(attrs.get("ksize", [2] * nd))
+    strides = list(attrs.get("strides", ksize))
+    paddings = list(attrs.get("paddings", [0] * nd))
+    if attrs.get("global_pooling", False):
+        ksize = list(x.shape[2:])
+        strides = ksize
+        paddings = [0] * nd
+    spatial = x.shape[2:]
+    # pad explicitly with -inf so padding cells never win the argmax
+    widths = [(0, 0), (0, 0)] + [(p, p) for p in paddings]
+    xp = jnp.pad(x, widths, constant_values=-jnp.inf)
+    patches = jax.lax.conv_general_dilated_patches(
+        xp, filter_shape=ksize, window_strides=strides,
+        padding=[(0, 0)] * nd)          # [N, C*prod(k), *out_spatial]
+    n, c = x.shape[:2]
+    k_total = int(np.prod(ksize))
+    out_sp = patches.shape[2:]
+    patches = patches.reshape((n, c, k_total) + out_sp)
+    out = jnp.max(patches, axis=2)
+    win_off = jnp.argmax(patches, axis=2)  # flat offset within the window
+    # input coordinate = window_start - pad + in-window offset, per dim
+    flat = jnp.zeros_like(win_off)
+    rem = win_off
+    for d in range(nd):
+        stride_rest = int(np.prod(ksize[d + 1:]))
+        off_d = rem // stride_rest
+        rem = rem % stride_rest
+        grid = jnp.arange(out_sp[d]) * strides[d] - paddings[d]
+        shape = [1] * (2 + nd)
+        shape[2 + d] = out_sp[d]
+        coord = grid.reshape(shape) + off_d
+        coord = jnp.clip(coord, 0, spatial[d] - 1)
+        flat = flat * spatial[d] + coord
+    return out, flat.astype(jnp.int32)
+
+
 @register("max_pool2d_with_index")
 def _max_pool2d_with_index(ctx, ins, attrs):
-    x = ins["X"][0]
-    out = _pool_nd(x, {**attrs, "pooling_type": "max"}, 2)
-    return {"Out": [out], "Mask": [jnp.zeros_like(out, dtype=jnp.int32)]}
+    out, mask = _pool_max_with_index(ins["X"][0], attrs, 2)
+    return {"Out": [out], "Mask": [mask]}
 
 
 def _resize_2d(x, oh, ow, method, align_corners):

@@ -1,0 +1,237 @@
+"""Coverage for the Appendix-A compat op batch (ops/compat_ops.py):
+each op's kernel is invoked through the registry on concrete arrays."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu  # noqa: F401  (registers the op corpus)
+from paddle_tpu.ops import registry
+
+
+class _Ctx:
+    is_test = True
+    data_axis = None
+    check_nan_inf = False
+
+    def rng(self, attrs):
+        return jax.random.PRNGKey(0)
+
+
+def run_op(name, ins, attrs=None):
+    return registry.get(name).impl(
+        _Ctx(), {k: [jnp.asarray(x) for x in v] for k, v in ins.items()},
+        attrs or {})
+
+
+def test_minus_fill_zeroslike():
+    out = run_op("minus", {"X": [np.ones((2, 2), np.float32)],
+                           "Y": [np.full((2, 2), 0.25, np.float32)]})
+    np.testing.assert_allclose(out["Out"][0], 0.75)
+    out = run_op("fill", {}, {"shape": [2, 3], "value": [7.0] * 6,
+                              "dtype": "float32"})
+    assert out["Out"][0].shape == (2, 3) and float(out["Out"][0][0, 0]) == 7
+    out = run_op("fill_zeros_like2",
+                 {"X": [np.ones((3,), np.float32)]})
+    np.testing.assert_allclose(out["Out"][0], 0.0)
+
+
+def test_modified_huber_loss_branches():
+    x = np.array([[2.0], [0.5], [-2.0]], np.float32)
+    y = np.array([[1.0], [1.0], [1.0]], np.float32)
+    out = run_op("modified_huber_loss", {"X": [x], "Y": [y]})["Out"][0]
+    np.testing.assert_allclose(
+        np.asarray(out).ravel(), [0.0, 0.25, 8.0], atol=1e-6)
+
+
+def test_conv_shift_circular():
+    x = np.array([[1, 2, 3, 4]], np.float32)
+    y = np.array([[0, 1, 0]], np.float32)  # identity kernel (center tap)
+    out = run_op("conv_shift", {"X": [x], "Y": [y]})["Out"][0]
+    np.testing.assert_allclose(out, x)
+
+
+def test_spp_output_size():
+    x = np.random.RandomState(0).rand(2, 3, 8, 8).astype(np.float32)
+    out = run_op("spp", {"X": [x]}, {"pyramid_height": 2})["Out"][0]
+    assert out.shape == (2, 3 * (1 + 4))
+
+
+def test_unpool_scatters_to_indices():
+    x = np.array([[[[5.0, 7.0]]]], np.float32).reshape(1, 1, 1, 2)
+    idx = np.array([[[[0, 3]]]], np.int64).reshape(1, 1, 1, 2)
+    # output size = (in-1)*stride + ksize: (1-1)*2+2 x (2-1)*2+2 = 2x4
+    out = run_op("unpool", {"X": [x], "Indices": [idx]},
+                 {"ksize": [2, 2], "strides": [2, 2]})["Out"][0]
+    assert out.shape == (1, 1, 2, 4)
+    flat = np.asarray(out).reshape(-1)
+    assert flat[0] == 5 and flat[3] == 7 and flat.sum() == 12
+
+
+def test_pool_with_index_roundtrips_through_unpool():
+    """Mask holds real argmax flat indices (not zeros): pool -> unpool
+    restores each max to its original position."""
+    rng = np.random.RandomState(0)
+    x = rng.rand(1, 1, 4, 4).astype(np.float32)
+    pooled = run_op("max_pool2d_with_index", {"X": [x]},
+                    {"ksize": [2, 2], "strides": [2, 2],
+                     "paddings": [0, 0]})
+    vals, mask = pooled["Out"][0], pooled["Mask"][0]
+    restored = run_op("unpool", {"X": [vals], "Indices": [mask]},
+                      {"ksize": [2, 2], "strides": [2, 2]})["Out"][0]
+    restored = np.asarray(restored)
+    assert restored.shape == x.shape
+    # every max value sits at its source position; other cells are zero
+    for i in range(2):
+        for j in range(2):
+            window = x[0, 0, 2*i:2*i+2, 2*j:2*j+2]
+            pos = np.unravel_index(window.argmax(), (2, 2))
+            assert restored[0, 0, 2*i+pos[0], 2*j+pos[1]] == window.max()
+    assert (restored != 0).sum() == 4
+
+
+def test_max_pool3d_with_index():
+    x = np.random.RandomState(0).rand(1, 2, 4, 4, 4).astype(np.float32)
+    out = run_op("max_pool3d_with_index", {"X": [x]},
+                 {"ksize": [2, 2, 2], "strides": [2, 2, 2],
+                  "paddings": [0, 0, 0]})
+    assert out["Out"][0].shape == (1, 2, 2, 2, 2)
+    mask = np.asarray(out["Mask"][0])
+    # first window of channel 0: argmax flat index within the 4x4x4 volume
+    win = x[0, 0, :2, :2, :2]
+    d, h, w = np.unravel_index(win.argmax(), (2, 2, 2))
+    assert mask[0, 0, 0, 0, 0] == d * 16 + h * 4 + w
+
+
+def test_fused_elemwise_activation_composition():
+    x = np.full((2,), 3.0, np.float32)
+    y = np.full((2,), 1.0, np.float32)
+    # binary outer: X + scale(Y) = 3 + 2*1 = 5
+    out = run_op("fused_elemwise_activation", {"X": [x], "Y": [y]},
+                 {"functor_list": ["elementwise_add", "scale"], "scale": 2.0})
+    np.testing.assert_allclose(out["Out"][0], 5.0)
+    np.testing.assert_allclose(out["IntermediateOut"][0], 2.0)
+    # unary outer: scale(X + Y) = 2*(3+1) = 8
+    out = run_op("fused_elemwise_activation", {"X": [x], "Y": [y]},
+                 {"functor_list": ["scale", "elementwise_add"], "scale": 2.0})
+    np.testing.assert_allclose(out["Out"][0], 8.0)
+    np.testing.assert_allclose(out["IntermediateOut"][0], 4.0)
+
+
+def test_positive_negative_pair():
+    score = np.array([0.9, 0.1, 0.8, 0.2], np.float32)
+    label = np.array([1.0, 0.0, 0.0, 1.0], np.float32)
+    qid = np.array([7, 7, 8, 8], np.int64)
+    out = run_op("positive_negative_pair",
+                 {"Score": [score], "Label": [label], "QueryID": [qid]})
+    assert float(out["PositivePair"][0][0]) == 1.0   # query 7 ordered right
+    assert float(out["NegativePair"][0][0]) == 1.0   # query 8 ordered wrong
+
+
+def test_mine_hard_examples_ratio():
+    match = np.array([[0, -1, -1, -1, -1]], np.int64)  # 1 pos, 4 neg
+    loss = np.array([[0.1, 0.9, 0.8, 0.2, 0.3]], np.float32)
+    out = run_op("mine_hard_examples",
+                 {"ClsLoss": [loss], "MatchIndices": [match]},
+                 {"neg_pos_ratio": 2.0})
+    sel = np.asarray(out["NegIndices"][0])[0]
+    assert sel.sum() == 2 and sel[1] == 1 and sel[2] == 1  # two hardest
+
+
+def test_sample_logits_gathers_label_first():
+    logits = np.arange(12, dtype=np.float32).reshape(2, 6)
+    labels = np.array([[2], [5]], np.int64)
+    out = run_op("sample_logits", {"Logits": [logits], "Labels": [labels]},
+                 {"num_samples": 3})
+    sampled = np.asarray(out["SampledLogits"][0])
+    assert sampled.shape == (2, 4)
+    np.testing.assert_allclose(sampled[:, 0], [2.0, 11.0])  # true logits
+    assert np.all(np.asarray(out["SampledLabels"][0]) == 0)
+
+
+def test_split_merge_ids_roundtrip():
+    ids = np.array([0, 3, 4, 7, 2], np.int64)
+    table = np.arange(16, dtype=np.float32).reshape(8, 2)
+    split = run_op("split_ids", {"Ids": [ids]}, {"num_shards": 2})["Out"]
+    assert len(split) == 2
+    # shard rows: embeddings of this shard's ids in original order
+    rows = []
+    for s in range(2):
+        keep = ids[ids % 2 == s]
+        rows.append(table[keep])
+    out = run_op("merge_ids", {"Ids": [ids], "X": rows})["Out"][0]
+    np.testing.assert_allclose(out, table[ids])
+
+
+def test_split_selected_rows_sections():
+    x = np.arange(12, dtype=np.float32).reshape(6, 2)
+    outs = run_op("split_selected_rows", {"X": [x]},
+                  {"height_sections": [2, 4]})["Out"]
+    assert outs[0].shape == (2, 2) and outs[1].shape == (4, 2)
+
+
+def test_fused_embedding_seq_pool_sums():
+    table = np.arange(10, dtype=np.float32).reshape(5, 2)
+    ids = np.array([[1, 2, 0]], np.int64)
+    out = run_op("fused_embedding_seq_pool",
+                 {"W": [table], "Ids": [ids]}, {"padding_idx": 0})["Out"][0]
+    np.testing.assert_allclose(np.asarray(out).reshape(-1),
+                               table[1] + table[2])
+
+
+def test_fusion_gru_lstm_and_lstmp_shapes():
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 5, 3).astype(np.float32)
+    d = 4
+    out = run_op("fusion_gru",
+                 {"X": [x], "WeightX": [rng.rand(3, 3 * d).astype(np.float32)],
+                  "WeightH": [rng.rand(d, 3 * d).astype(np.float32)]})
+    assert out["Hidden"][0].shape == (2, 5, d)
+    out = run_op("fusion_lstm",
+                 {"X": [x], "WeightX": [rng.rand(3, 4 * d).astype(np.float32)],
+                  "WeightH": [rng.rand(d, 4 * d).astype(np.float32)]})
+    assert out["Hidden"][0].shape == (2, 5, d)
+    p = 3
+    out = run_op("lstmp",
+                 {"Input": [rng.rand(2, 5, 4 * d).astype(np.float32)],
+                  "Weight": [rng.rand(p, 4 * d).astype(np.float32)],
+                  "ProjWeight": [rng.rand(d, p).astype(np.float32)]})
+    assert out["Projection"][0].shape == (2, 5, p)
+    out = run_op("attention_lstm",
+                 {"X": [x],
+                  "AttentionWeight": [rng.rand(3 + d, 1).astype(np.float32)],
+                  "LSTMWeight": [rng.rand(3 + d, 4 * d).astype(np.float32)],
+                  "LSTMBias": [rng.rand(1, 4 * d).astype(np.float32)]})
+    assert out["Hidden"][0].shape == (2, 5, d)
+
+
+def test_dgc_sparsifies_and_keeps_residual():
+    g = np.array([1.0, -5.0, 0.5, 3.0], np.float32)
+    u = np.zeros(4, np.float32)
+    v = np.zeros(4, np.float32)
+    out = run_op("dgc", {"Grad": [g], "U": [u], "V": [v]},
+                 {"m": 0.9, "sparsity": [0.5]})
+    dense = np.asarray(out["Grad_out"][0])
+    assert (dense != 0).sum() == 2  # top-2 of 4 kept
+    np.testing.assert_allclose(np.asarray(out["V_out"][0]) + dense, g)
+    # index half of the encode buffer is a BITCAST of int32 (decode with
+    # bitcast_convert_type), so huge indices survive float32 transport
+    enc = np.asarray(out["EncodeGrad"][0])
+    idx = np.asarray(enc[:2], np.float32).view(np.int32)
+    assert set(idx.tolist()) == {1, 3}  # positions of -5.0 and 3.0
+
+    out2 = run_op("dgc_clip_by_norm",
+                  {"X": [g], "current_step": [np.asarray([0.0])]},
+                  {"max_norm": 1.0, "rampup_begin_step": 10.0})
+    np.testing.assert_allclose(out2["Out"][0], g)  # before rampup: no clip
+
+
+def test_alloc_continuous_space_flattens():
+    a = np.ones((2, 2), np.float32)
+    b = np.full((3,), 2.0, np.float32)
+    out = run_op("alloc_continuous_space", {"Input": [a, b]})
+    assert out["FusedOutput"][0].shape == (7,)
+    out = run_op("alloc_continuous_space", {"Input": [a, b]},
+                 {"set_constant": True, "constant": 0.5})
+    np.testing.assert_allclose(out["Output"][0], 0.5)
