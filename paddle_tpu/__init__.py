@@ -65,6 +65,8 @@ from .data_feeder import DataFeeder, DataFeedDesc  # noqa: F401
 from .flags import set_flags, get_flags  # noqa: F401
 from .core.tensor import LoDTensor, LoDTensorArray  # noqa: F401
 from . import debugger  # noqa: F401
+from . import install_check  # noqa: F401
+from .reader import batch  # noqa: F401  (top-level paddle.batch parity)
 
 
 def cuda_places(device_ids=None):

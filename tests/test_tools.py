@@ -82,3 +82,12 @@ def test_debugger_pprint_and_dot(tmp_path):
     content = dot.read_text()
     assert content.startswith("digraph G {") and "shape=box" in content
     assert "fillcolor=\"#ffdddd\"" in content  # highlighted loss var
+
+
+def test_install_check_runs():
+    import paddle_tpu as fluid
+
+    fluid.install_check.run_check()  # must not raise (8-dev CPU mesh)
+    # top-level batch alias (paddle.batch parity)
+    batches = list(fluid.batch(lambda: iter(range(10)), batch_size=4)())
+    assert [len(b) for b in batches] == [4, 4, 2]
