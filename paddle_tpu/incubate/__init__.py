@@ -1,3 +1,4 @@
 """Incubating APIs (parity: python/paddle/fluid/incubate/)."""
 
 from . import fleet  # noqa: F401
+from . import data_generator  # noqa: F401

@@ -1,0 +1,124 @@
+"""MultiSlot data generators (parity: python/paddle/fluid/incubate/
+data_generator/__init__.py — DataGenerator base with generate_sample/
+generate_batch hooks, run_from_memory/run_from_stdin drivers, and the
+MultiSlot line serializers). The emitted text is exactly what the C++
+MultiSlot feed parser (native/data_feed.cc) ingests: per sample, for each
+slot, "<name>:<num> v..." in the string variant or "<num> v..." in the
+id/float variant."""
+
+import sys
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
+
+
+class DataGenerator:
+    """Subclass and implement generate_sample(line) returning an iterator
+    of (slot_name, [values]) lists; optionally generate_batch(samples)."""
+
+    def __init__(self):
+        self._proto_info = None
+        self.batch_size_ = 32
+        self._line_limit = None
+
+    def _set_line_limit(self, line_limit):
+        self._line_limit = int(line_limit)
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    # -- user hooks ---------------------------------------------------------
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "implement generate_sample(self, line) in the subclass")
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for sample in samples:
+                yield sample
+
+        return local_iter
+
+    # -- drivers ------------------------------------------------------------
+    def run_from_memory(self, out=None):
+        """Drive generate_sample(None) until exhausted, writing serialized
+        lines (run_from_memory parity)."""
+        out = out or sys.stdout
+        batch_samples = []
+        fn = self.generate_sample(None)
+        for sample in fn():
+            batch_samples.append(sample)
+            if len(batch_samples) == self.batch_size_:
+                for s in self.generate_batch(batch_samples)():
+                    out.write(self._gen_str(s))
+                batch_samples = []
+        if batch_samples:
+            for s in self.generate_batch(batch_samples)():
+                out.write(self._gen_str(s))
+
+    def run_from_stdin(self, inp=None, out=None):
+        """One serialized output line per input line (run_from_stdin
+        parity — the hadoop-streaming entry point)."""
+        inp = inp or sys.stdin
+        out = out or sys.stdout
+        batch_samples = []
+        n = 0
+        for line in inp:
+            fn = self.generate_sample(line)
+            for sample in fn():
+                batch_samples.append(sample)
+                if len(batch_samples) == self.batch_size_:
+                    for s in self.generate_batch(batch_samples)():
+                        out.write(self._gen_str(s))
+                    batch_samples = []
+            n += 1
+            if self._line_limit and n >= self._line_limit:
+                break
+        if batch_samples:
+            for s in self.generate_batch(batch_samples)():
+                out.write(self._gen_str(s))
+
+    def _gen_str(self, line):
+        raise NotImplementedError
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Serializes [(slot_name, [v, ...]), ...] samples as
+    "<num> v ... <num> v ...\\n" in first-sample slot order, validating
+    slot names/arity stay consistent across samples (the reference's
+    proto_info check)."""
+
+    def _gen_str(self, line):
+        if not isinstance(line, list) and not isinstance(line, tuple):
+            raise ValueError(
+                "the output of process() must be in list or tuple type")
+        if self._proto_info is None:
+            self._proto_info = [name for name, _ in line]
+        elif len(line) != len(self._proto_info):
+            raise ValueError(
+                "the complete field set of two samples are inconsistent.")
+        parts = []
+        for i, (name, elements) in enumerate(line):
+            if self._proto_info[i] != name:
+                raise ValueError(
+                    "the field name of two samples are not match: expect "
+                    "%s, but got %s" % (self._proto_info[i], name))
+            parts.append(str(len(elements)))
+            parts.extend(str(e) for e in elements)
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """Same line format as MultiSlotDataGenerator but values pass through
+    as strings with no numeric validation (the fast hadoop-streaming path;
+    a later-paddle convenience kept for forward compatibility)."""
+
+    def _gen_str(self, line):
+        if not isinstance(line, list) and not isinstance(line, tuple):
+            raise ValueError(
+                "the output of process() must be in list or tuple type")
+        parts = []
+        for _name, elements in line:
+            parts.append(str(len(elements)))
+            parts.extend(str(e) for e in elements)
+        return " ".join(parts) + "\n"
