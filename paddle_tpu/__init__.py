@@ -66,6 +66,7 @@ from .flags import set_flags, get_flags  # noqa: F401
 from .core.tensor import LoDTensor, LoDTensorArray  # noqa: F401
 from . import debugger  # noqa: F401
 from . import install_check  # noqa: F401
+from . import checkpoint  # noqa: F401
 from .reader import batch  # noqa: F401  (top-level paddle.batch parity)
 
 

@@ -1,0 +1,131 @@
+"""Sharded training-state checkpointing (SURVEY §5.4 design mapping:
+"orbax-style checkpoint of a param pytree + serialization versioning";
+reference counterpart: save/load_persistables io.py:460 + the distributed
+snapshot flow §5.3).
+
+Unlike the Fluid-parity io.py (whole-array save of scope persistables),
+this module checkpoints an arbitrary jax pytree — including
+NamedSharding'd arrays from an SPMD mesh — via orbax, so every host writes
+only its shards and restore re-shards onto the current mesh. Works for
+single-chip state too.
+"""
+
+import os
+
+__all__ = ["save_checkpoint", "restore_checkpoint", "latest_checkpoint",
+           "CheckpointManager"]
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save_checkpoint(directory, state, step):
+    """Write `state` (any jax pytree, sharded arrays included) under
+    directory/step_N. Returns the checkpoint path."""
+    path = os.path.join(os.path.abspath(directory), "step_%d" % int(step))
+    _checkpointer().save(path, state, force=True)
+    return path
+
+
+def latest_checkpoint(directory):
+    """Most recent step_N path under directory, or None."""
+    directory = os.path.abspath(directory)
+    if not os.path.isdir(directory):
+        return None
+    steps = []
+    for name in os.listdir(directory):
+        if name.startswith("step_"):
+            try:
+                steps.append(int(name.split("_", 1)[1]))
+            except ValueError:
+                continue
+    if not steps:
+        return None
+    return os.path.join(directory, "step_%d" % max(steps))
+
+
+def restore_checkpoint(directory_or_path, target_state=None):
+    """Restore a pytree checkpoint. With `target_state` (an abstract or
+    concrete pytree of the expected structure/shardings — e.g. the fresh
+    `trainer.init()` output) the restored arrays are placed to match it;
+    without, the stored structure is returned as saved. `directory_or_path`
+    may be the checkpoint dir (latest step is used) or a step path."""
+    path = directory_or_path
+    if not os.path.basename(path).startswith("step_"):
+        latest = latest_checkpoint(path)
+        if latest is None:
+            raise FileNotFoundError("no step_N checkpoints under %r" % path)
+        path = latest
+    ckpt = _checkpointer()
+    raw = ckpt.restore(path)
+    if target_state is None:
+        return raw
+    import jax
+    import numpy as np
+
+    # orbax round-trips containers loosely (tuples come back as lists), so
+    # match by LEAF ORDER — stable across that transformation — and place
+    # each leaf onto the target's sharding (device_put with a NamedSharding
+    # re-shards onto the current mesh)
+    raw_leaves = jax.tree.leaves(raw)
+    t_leaves, treedef = jax.tree.flatten(target_state)
+    if len(raw_leaves) != len(t_leaves):
+        raise ValueError(
+            "checkpoint has %d leaves but target_state has %d"
+            % (len(raw_leaves), len(t_leaves)))
+    placed = []
+    for r, t in zip(raw_leaves, t_leaves):
+        arr = np.asarray(r)
+        if hasattr(t, "shape") and tuple(t.shape) != arr.shape:
+            raise ValueError("leaf shape mismatch: checkpoint %s vs target "
+                             "%s" % (arr.shape, tuple(t.shape)))
+        sharding = getattr(t, "sharding", None)
+        if isinstance(sharding, jax.sharding.NamedSharding):
+            placed.append(jax.device_put(arr, sharding))
+        else:
+            # leave non-mesh leaves UNcommitted (a committed single-device
+            # scalar could not be mixed with mesh-sharded args under jit)
+            placed.append(jax.numpy.asarray(arr, dtype=getattr(
+                t, "dtype", None)))
+    return jax.tree.unflatten(treedef, placed)
+
+
+class CheckpointManager:
+    """Rolling checkpoint manager (keep the newest `max_to_keep`) — the
+    coordinated-snapshot shape of §5.3's checkpoint_notify flow, minus the
+    pserver RPC: under jax.distributed every process participates in the
+    same orbax save."""
+
+    def __init__(self, directory, max_to_keep=3):
+        self.directory = os.path.abspath(directory)
+        self.max_to_keep = max_to_keep
+        os.makedirs(self.directory, exist_ok=True)
+
+    def save(self, state, step):
+        path = save_checkpoint(self.directory, state, step)
+        self._gc()
+        return path
+
+    def restore(self, target_state=None):
+        return restore_checkpoint(self.directory, target_state)
+
+    def all_steps(self):
+        steps = []
+        for name in os.listdir(self.directory):
+            if name.startswith("step_"):
+                try:
+                    steps.append(int(name.split("_", 1)[1]))
+                except ValueError:
+                    continue
+        return sorted(steps)
+
+    def _gc(self):
+        import shutil
+
+        steps = self.all_steps()
+        for step in steps[:-self.max_to_keep] if self.max_to_keep else []:
+            shutil.rmtree(os.path.join(self.directory, "step_%d" % step),
+                          ignore_errors=True)
