@@ -90,11 +90,23 @@ def scaled_dot_product_attention(queries, keys, values, num_heads=1,
     q = _split_heads(queries)
     k = _split_heads(keys)
     v = _split_heads(values)
-    scaled_q = layers.scale(q, scale=dk**-0.5)
-    product = layers.matmul(scaled_q, k, transpose_y=True)
-    weights = layers.softmax(product)
-    if dropout_rate:
+    if not dropout_rate:
+        # fused path: the flash_attention op dispatches to the tuned
+        # Pallas kernel when shapes tile, the naive fused softmax when not
+        from .layer_helper import LayerHelper
+
+        helper = LayerHelper("flash_attention")
+        ctx = helper.create_variable_for_type_inference(queries.dtype)
+        helper.append_op(type="flash_attention",
+                         inputs={"Q": [q], "K": [k], "V": [v]},
+                         outputs={"Out": [ctx]},
+                         attrs={"causal": False, "sm_scale": dk ** -0.5})
+        ctx.shape = q.shape
+    else:
+        scaled_q = layers.scale(q, scale=dk**-0.5)
+        product = layers.matmul(scaled_q, k, transpose_y=True)
+        weights = layers.softmax(product)
         weights = layers.dropout(weights, dropout_prob=dropout_rate)
-    ctx = layers.matmul(weights, v)
+        ctx = layers.matmul(weights, v)
     ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
     return layers.reshape(ctx, shape=[0, 0, d])

@@ -460,3 +460,31 @@ def _alloc_continuous_space(ctx, ins, attrs):
     else:
         outs = list(xs)
     return {"Output": outs, "FusedOutput": [flat]}
+
+
+@register("flash_attention")
+def _flash_attention_op(ctx, ins, attrs):
+    """Fused attention exposed as a graph op: Q/K/V [B, H, T, Dh] -> Out.
+    Dispatches to the tuned TPU flash kernel / portable Pallas kernel
+    (ops/pallas_kernels.flash_attention); differentiable through the
+    kernels' own VJPs."""
+    from .pallas_kernels import flash_attention
+
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    causal = attrs.get("causal", False)
+    scale = attrs.get("sm_scale", None)
+    Dh = q.shape[-1]
+    T = q.shape[2]
+    if T % 128 == 0 and Dh >= 64 and q.shape == k.shape:
+        out = flash_attention(q, k, v, causal, scale)
+    else:  # shapes the blocked kernels can't tile: plain fused softmax
+        s = scale if scale is not None else Dh ** -0.5
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                            preferred_element_type=jnp.float32) * s
+        if causal:
+            Tq, Tk = logits.shape[-2], logits.shape[-1]
+            mask = jnp.tril(jnp.ones((Tq, Tk), bool), Tk - Tq)
+            logits = jnp.where(mask, logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        out = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return {"Out": [out]}

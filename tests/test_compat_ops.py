@@ -235,3 +235,32 @@ def test_alloc_continuous_space_flattens():
     out = run_op("alloc_continuous_space", {"Input": [a, b]},
                  {"set_constant": True, "constant": 0.5})
     np.testing.assert_allclose(out["Output"][0], 0.5)
+
+
+def test_flash_attention_op_and_nets_path():
+    """The flash_attention graph op matches naive attention, and
+    nets.scaled_dot_product_attention trains through it."""
+    rng = np.random.RandomState(0)
+    B, H, T, D = 2, 2, 8, 16  # small T -> naive fused branch
+    q = rng.rand(B, H, T, D).astype(np.float32)
+    k = rng.rand(B, H, T, D).astype(np.float32)
+    v = rng.rand(B, H, T, D).astype(np.float32)
+    out = run_op("flash_attention", {"Q": [q], "K": [k], "V": [v]},
+                 {"causal": False, "sm_scale": D ** -0.5})["Out"][0]
+    s = np.einsum("bhqd,bhkd->bhqk", q, k) * (D ** -0.5)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    want = np.einsum("bhqk,bhkd->bhqd", p, v)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4, atol=1e-5)
+
+    import paddle_tpu as fluid
+
+    x = fluid.layers.data(name="fa_x", shape=[8, 32], dtype="float32")
+    ctx_out = fluid.nets.scaled_dot_product_attention(x, x, x, num_heads=4)
+    loss = fluid.layers.mean(ctx_out)
+    fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    feed = {"fa_x": rng.rand(2, 8, 32).astype(np.float32)}
+    l1, = exe.run(feed=feed, fetch_list=[loss])
+    assert np.isfinite(np.asarray(l1)).all()
