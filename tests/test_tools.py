@@ -10,15 +10,19 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_api_spec_frozen():
-    """The committed API.spec must match the live package exactly."""
-    sys.path.insert(0, os.path.join(REPO, "tools"))
-    try:
-        from gen_api_spec import spec_lines
-    finally:
-        sys.path.pop(0)
+    """The committed API.spec must match the live package exactly.
+
+    Generated in a FRESH subprocess: modules without __all__ are listed
+    via dir(), which inside the test process grows with whatever
+    submodules other tests happened to import (order-dependent flake)."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "gen_api_spec.py")],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert out.returncode == 0, out.stderr[-2000:]
+    live = out.stdout.splitlines()
     with open(os.path.join(REPO, "API.spec")) as f:
         pinned = f.read().splitlines()
-    live = spec_lines()
     assert pinned == live, (
         "public API surface drifted; regenerate deliberately with "
         "`python tools/gen_api_spec.py > API.spec`")
