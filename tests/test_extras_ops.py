@@ -124,7 +124,7 @@ def test_linear_chain_crf_trains():
         out, = exe.run(fluid.default_main_program(),
                        feed={"em": em_np, "lab": lab_np},
                        fetch_list=[loss.name])
-        losses.append(float(np.asarray(out)))
+        losses.append(float(np.asarray(out).reshape(-1)[0]))
     assert losses[-1] < losses[0]
 
 
@@ -216,7 +216,7 @@ def test_nce_finite_and_trains():
     exe.run(fluid.default_startup_program())
     losses = [float(np.asarray(exe.run(
         fluid.default_main_program(), feed={"x": x_np, "lab": lab_np},
-        fetch_list=[loss.name])[0])) for _ in range(20)]
+        fetch_list=[loss.name])[0]).reshape(-1)[0]) for _ in range(20)]
     assert all(np.isfinite(l) for l in losses)
     # noise resampling makes per-step loss noisy; compare window means
     assert np.mean(losses[-5:]) < np.mean(losses[:5])
@@ -236,7 +236,7 @@ def test_hsigmoid_finite_and_trains():
     exe.run(fluid.default_startup_program())
     losses = [float(np.asarray(exe.run(
         fluid.default_main_program(), feed={"x": x_np, "lab": lab_np},
-        fetch_list=[loss.name])[0])) for _ in range(6)]
+        fetch_list=[loss.name])[0]).reshape(-1)[0]) for _ in range(6)]
     assert all(np.isfinite(l) for l in losses)
     assert losses[-1] < losses[0]
 
@@ -430,7 +430,7 @@ def test_py_reader_pipeline():
     for feed in reader:
         res, = exe.run(fluid.default_main_program(), feed=feed,
                        fetch_list=[out.name])
-        vals.append(float(np.asarray(res)))
+        vals.append(float(np.asarray(res).reshape(-1)[0]))
     np.testing.assert_allclose(vals, [0.0, 1.0, 2.0])
 
 
