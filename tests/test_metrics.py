@@ -1,0 +1,65 @@
+"""fluid.metrics class tests (parity: metrics.py — Accuracy, Precision,
+Recall, Auc, EditDistance, ChunkEvaluator, CompositeMetric, DetectionMAP
+counterparts of the reference's python metric classes)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import metrics
+
+
+def test_accuracy_weighted_mean():
+    m = metrics.Accuracy()
+    m.update(value=0.5, weight=10)
+    m.update(value=1.0, weight=30)
+    assert abs(m.eval() - (0.5 * 10 + 1.0 * 30) / 40) < 1e-9
+
+
+def test_precision_recall_counts():
+    preds = [1, 1, 0, 1, 0]
+    labels = [1, 0, 0, 1, 1]
+    p = metrics.Precision()
+    p.update(preds, labels)
+    assert abs(p.eval() - 2 / 3) < 1e-9  # tp=2, fp=1
+    r = metrics.Recall()
+    r.update(preds, labels)
+    assert abs(r.eval() - 2 / 3) < 1e-9  # tp=2, fn=1
+
+
+def test_auc_perfect_and_random():
+    m = metrics.Auc()
+    m.update(np.array([0.9, 0.8, 0.2, 0.1]), np.array([1, 1, 0, 0]))
+    assert abs(m.eval() - 1.0) < 1e-6  # perfectly separable
+    m2 = metrics.Auc()
+    m2.update(np.array([0.9, 0.8, 0.2, 0.1]), np.array([0, 0, 1, 1]))
+    assert m2.eval() < 0.1  # perfectly wrong
+
+
+def test_edit_distance_stats():
+    m = metrics.EditDistance()
+    m.update(np.array([0.0, 2.0, 1.0]), 3)
+    avg, err = m.eval()
+    assert abs(avg - 1.0) < 1e-9 and abs(err - 2 / 3) < 1e-9
+    empty = metrics.EditDistance()
+    with pytest.raises(ValueError):
+        empty.eval()
+
+
+def test_chunk_evaluator_f1():
+    m = metrics.ChunkEvaluator()
+    m.update(num_infer_chunks=10, num_label_chunks=8, num_correct_chunks=6)
+    precision, recall, f1 = m.eval()
+    assert abs(precision - 0.6) < 1e-9
+    assert abs(recall - 0.75) < 1e-9
+    assert abs(f1 - 2 * 0.6 * 0.75 / 1.35) < 1e-9
+
+
+def test_composite_metric_aggregates():
+    c = metrics.CompositeMetric()
+    c.add_metric(metrics.Precision())
+    c.add_metric(metrics.Recall())
+    c.update([1, 0, 1], [1, 1, 1])
+    vals = c.eval()
+    assert abs(vals[0] - 1.0) < 1e-9       # precision: tp=2, fp=0
+    assert abs(vals[1] - 2 / 3) < 1e-9     # recall: tp=2, fn=1
