@@ -1,0 +1,64 @@
+"""Python-level profiler tests (parity: fluid.profiler — SURVEY §5.1):
+record_event aggregation, start/stop summary, chrome-trace export, the
+context-manager API, and reset."""
+
+import json
+import os
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import profiler
+
+
+def test_record_event_aggregates_and_dumps_chrome_trace(tmp_path, capsys):
+    profiler.reset_profiler()
+    profiler.start_profiler("All")
+    for _ in range(3):
+        with profiler.record_event("my_span"):
+            np.dot(np.ones((64, 64)), np.ones((64, 64)))
+    profiler.stop_profiler(sorted_key="calls")
+    out = capsys.readouterr().out
+    assert "my_span" in out and "Calls" in out
+    # per-event stats: 3 calls recorded
+    line = [l for l in out.splitlines() if l.startswith("my_span")][0]
+    assert line.split()[1] == "3"
+
+    path = str(tmp_path / "trace.json")
+    n = profiler.dump_chrome_trace(path)
+    with open(path) as f:
+        trace = json.load(f)
+    assert "traceEvents" in trace
+    if n:  # native collector present: spans must be in the trace
+        names = {e.get("name") for e in trace["traceEvents"]}
+        assert "my_span" in names
+
+
+def test_profiler_context_trains_and_writes_trace(tmp_path):
+    x = fluid.layers.data(name="px", shape=[4], dtype="float32")
+    loss = fluid.layers.mean(fluid.layers.fc(input=x, size=2))
+    fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    trace_dir = str(tmp_path / "jax_trace")
+    with profiler.profiler("All", "total", trace_dir):
+        for _ in range(2):
+            exe.run(feed={"px": np.ones((2, 4), np.float32)},
+                    fetch_list=[loss])
+    # the jax trace dir gets XPlane artifacts (plugins/profile/...)
+    found = []
+    for root, _dirs, files in os.walk(trace_dir):
+        found.extend(files)
+    assert found, "jax.profiler produced no trace artifacts"
+
+
+def test_reset_clears_stats(capsys):
+    profiler.reset_profiler()
+    profiler.start_profiler("All")
+    with profiler.record_event("gone"):
+        pass
+    profiler.reset_profiler()
+    profiler.stop_profiler()
+    out = capsys.readouterr().out
+    assert "gone" not in out
