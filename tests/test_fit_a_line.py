@@ -81,3 +81,38 @@ def test_param_values_update():
     for name in before:
         assert not np.allclose(before[name], after[name]), \
             "param %s did not update" % name
+
+
+def test_program_cache_reuse_and_invalidation():
+    """SURVEY §7 hard-part: cache keyed on (program, shapes, fetches) —
+    same signature reuses the compiled step (no retrace storm), a new
+    batch size adds an entry, and mutating the program recompiles."""
+    import numpy as np
+    import paddle_tpu as fluid
+
+    x = fluid.layers.data(name="cx", shape=[4], dtype="float32")
+    y = fluid.layers.fc(input=x, size=2)
+    loss = fluid.layers.mean(y)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    base_entries = len(exe._cache)
+
+    feed8 = {"cx": np.ones((8, 4), np.float32)}
+    exe.run(feed=feed8, fetch_list=[loss])
+    n1 = len(exe._cache)
+    exe.run(feed=feed8, fetch_list=[loss])     # same signature: reuse
+    assert len(exe._cache) == n1
+
+    exe.run(feed={"cx": np.ones((16, 4), np.float32)},
+            fetch_list=[loss])                 # new shape: new entry
+    assert len(exe._cache) == n1 + 1
+
+    # mutate the program: version bump must invalidate (new entry, and the
+    # new op's semantics take effect)
+    prog = fluid.default_main_program()
+    with fluid.program_guard(prog):
+        loss2 = fluid.layers.scale(loss, scale=2.0)
+    r1, r2 = exe.run(feed=feed8, fetch_list=[loss, loss2])
+    np.testing.assert_allclose(np.asarray(r2), np.asarray(r1) * 2.0,
+                               rtol=1e-6)
+    assert len(exe._cache) > n1 + 1
