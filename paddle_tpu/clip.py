@@ -87,19 +87,27 @@ class GradientClipByGlobalNorm(BaseGradientClipAttr):
         return param, new_grad
 
 
-_gradient_clip_attr = [None]
-
-
 def set_gradient_clip(clip, param_list=None, program=None):
+    """Attach a clip attr to params (or as the program-wide default).
+    Scoped to the PROGRAM like the reference (clip.py set_gradient_clip
+    walks the program's parameters) — never process-global, so one
+    program's clip cannot leak into another."""
     from . import framework
 
-    if param_list is None:
-        _gradient_clip_attr[0] = clip
-        return
     program = program or framework.default_main_program()
+    if param_list is None:
+        program._gradient_clip_attr = clip
+        return
     for p in param_list:
         name = p if isinstance(p, str) else p.name
         program.global_block().var(name).gradient_clip_attr = clip
+
+
+def _clip_attr_for(p):
+    attr = getattr(p, "gradient_clip_attr", None)
+    if attr is not None:
+        return attr
+    return getattr(p.block.program, "_gradient_clip_attr", None)
 
 
 def append_gradient_clip_ops(param_grads):
@@ -108,8 +116,7 @@ def append_gradient_clip_ops(param_grads):
     for p, g in param_grads:
         if g is None:
             continue
-        clip_attr = getattr(p, "gradient_clip_attr", None) or \
-            _gradient_clip_attr[0]
+        clip_attr = _clip_attr_for(p)
         if clip_attr is None:
             continue
         any_clip = True
@@ -121,8 +128,7 @@ def append_gradient_clip_ops(param_grads):
         if g is None:
             out.append((p, g))
             continue
-        clip_attr = getattr(p, "gradient_clip_attr", None) or \
-            _gradient_clip_attr[0]
+        clip_attr = _clip_attr_for(p)
         if clip_attr is None:
             out.append((p, g))
             continue

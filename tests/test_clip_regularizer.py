@@ -1,0 +1,76 @@
+"""Gradient clipping + weight decay static-graph tests (parity:
+clip.py GradientClipBy{Value,Norm,GlobalNorm} / set_gradient_clip and
+regularizer.py L1/L2Decay — SURVEY Appendix B pinned classes)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import framework
+from paddle_tpu.core.scope import global_scope
+
+
+def _one_step_param_delta(clip=None, regularization=None, lr=1.0):
+    """Train one SGD step on loss = sum(w * x) with fixed x; returns
+    (w_before, w_after). d loss/d w = x exactly, so the applied update
+    exposes the clip/decay transformation."""
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32",
+                              append_batch_size=False)
+        w = fluid.layers.create_parameter(
+            shape=[4], dtype="float32", name="cw",
+            default_initializer=fluid.initializer.Constant(2.0))
+        loss = fluid.layers.reduce_sum(fluid.layers.elementwise_mul(w, x))
+        if clip is not None:
+            fluid.clip.set_gradient_clip(clip, program=main)
+        fluid.optimizer.SGD(learning_rate=lr,
+                            regularization=regularization).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    x_np = np.array([3.0, -4.0, 0.5, 0.0], np.float32)
+    w0 = np.asarray(global_scope().get("cw")).copy()
+    exe.run(main, feed={"x": x_np}, fetch_list=[loss])
+    w1 = np.asarray(global_scope().get("cw"))
+    return w0, w1, x_np
+
+
+def test_no_clip_baseline():
+    w0, w1, x = _one_step_param_delta()
+    np.testing.assert_allclose(w1, w0 - x, rtol=1e-6)
+
+
+def test_gradient_clip_by_value():
+    w0, w1, x = _one_step_param_delta(
+        clip=fluid.clip.GradientClipByValue(max=1.0, min=-1.0))
+    np.testing.assert_allclose(w1, w0 - np.clip(x, -1.0, 1.0), rtol=1e-6)
+
+
+def test_gradient_clip_by_norm():
+    w0, w1, x = _one_step_param_delta(
+        clip=fluid.clip.GradientClipByNorm(clip_norm=1.0))
+    expect = x / np.linalg.norm(x)  # ||x|| = 5.02 > 1 -> scaled to norm 1
+    np.testing.assert_allclose(w1, w0 - expect, rtol=1e-5, atol=1e-6)
+
+
+def test_gradient_clip_by_global_norm():
+    w0, w1, x = _one_step_param_delta(
+        clip=fluid.clip.GradientClipByGlobalNorm(clip_norm=2.0))
+    gn = np.linalg.norm(x)
+    np.testing.assert_allclose(w1, w0 - x * (2.0 / gn), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_l2_decay_adds_coeff_times_param():
+    from paddle_tpu.regularizer import L2Decay
+
+    w0, w1, x = _one_step_param_delta(regularization=L2Decay(0.1))
+    np.testing.assert_allclose(w1, w0 - (x + 0.1 * w0), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_l1_decay_adds_coeff_times_sign():
+    from paddle_tpu.regularizer import L1Decay
+
+    w0, w1, x = _one_step_param_delta(regularization=L1Decay(0.05))
+    np.testing.assert_allclose(w1, w0 - (x + 0.05 * np.sign(w0)),
+                               rtol=1e-5, atol=1e-6)
