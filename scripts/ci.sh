@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# CI driver (parity: paddle/scripts/paddle_build.sh — cmake_gen/build :55/:290,
+# run_test :320, API-diff check). Stages:
+#   build      - compile the C++ runtime spine + its gtest binary
+#   test       - native tests, then the python suite on the 8-dev CPU mesh
+#   api_check  - enforce the frozen public API surface (API.spec)
+#   bench      - headline benchmark (single JSON line; runs on the default
+#                backend — real TPU when attached)
+# Usage: scripts/ci.sh [build|test|api_check|bench|all]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+stage="${1:-all}"
+
+do_build() {
+  make -C native -s
+  make -C native -s native_test
+}
+
+do_test() {
+  make -C native -s test
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q
+}
+
+do_api_check() {
+  python tools/diff_api.py
+}
+
+do_bench() {
+  python bench.py
+}
+
+case "$stage" in
+  build) do_build ;;
+  test) do_build; do_test ;;
+  api_check) do_api_check ;;
+  bench) do_bench ;;
+  all) do_build; do_test; do_api_check; do_bench ;;
+  *) echo "unknown stage: $stage" >&2; exit 2 ;;
+esac
