@@ -32,7 +32,8 @@ def parse_args():
     p = argparse.ArgumentParser("paddle_tpu benchmark harness")
     p.add_argument("--model", default="mnist",
                    choices=["mnist", "resnet", "vgg", "stacked_dynamic_lstm",
-                            "machine_translation", "deepfm", "transformer"])
+                            "machine_translation", "deepfm", "se_resnext",
+                            "transformer"])
     p.add_argument("--batch_size", type=int, default=None,
                    help="per-step global batch (model default if unset)")
     p.add_argument("--iterations", type=int, default=30)
@@ -55,7 +56,8 @@ def parse_args():
 
 _DEFAULT_BATCH = {
     "mnist": 128, "resnet": 64, "vgg": 64, "stacked_dynamic_lstm": 32,
-    "machine_translation": 16, "deepfm": 256, "transformer": 16,
+    "machine_translation": 16, "deepfm": 256, "se_resnext": 32,
+    "transformer": 16,
 }
 
 
@@ -65,7 +67,7 @@ def _feeds(model, batch, rng):
     if model == "mnist":
         return {"img": rng.rand(batch, 784).astype(np.float32),
                 "label": rng.randint(0, 10, (batch, 1)).astype(np.int64)}
-    if model in ("resnet", "vgg"):
+    if model in ("resnet", "vgg", "se_resnext"):
         return {"img": rng.rand(batch, 3, 32, 32).astype(np.float32),
                 "label": rng.randint(0, 10, (batch, 1)).astype(np.int64)}
     if model == "stacked_dynamic_lstm":
@@ -100,6 +102,8 @@ def _build(model):
         _, _, loss = models.machine_translation.build()
     elif model == "deepfm":
         _, _, loss, _auc = models.deepfm.build()
+    elif model == "se_resnext":
+        *_, loss, _acc = models.se_resnext.build(class_dim=10)
     else:
         raise ValueError(model)
     return loss
