@@ -1,0 +1,99 @@
+"""ParallelExecutor loss-parity tests (parity: SURVEY §4.5 —
+parallel_executor_test_base.py runs a model single-device and multi-device
+and compares losses; here the 8-device CPU mesh stands in for multi-GPU)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import framework
+from paddle_tpu.core import scope as scope_mod
+
+
+def _build(seed):
+    x = fluid.layers.data(name="img", shape=[16], dtype="float32")
+    y = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(input=x, size=32, act="relu",
+                        param_attr=fluid.ParamAttr(name="pw1"),
+                        bias_attr=fluid.ParamAttr(name="pb1"))
+    pred = fluid.layers.fc(input=h, size=4, act="softmax",
+                           param_attr=fluid.ParamAttr(name="pw2"),
+                           bias_attr=fluid.ParamAttr(name="pb2"))
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(input=pred,
+                                                        label=y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    rng = np.random.RandomState(seed)
+    xs = rng.rand(32, 16).astype(np.float32)
+    ys = rng.randint(0, 4, size=(32, 1)).astype(np.int64)
+    return loss, xs, ys
+
+
+def _snapshot_params():
+    sc = scope_mod.global_scope()
+    return {n: np.asarray(sc.get(n)).copy()
+            for n in ("pw1", "pb1", "pw2", "pb2")}
+
+
+def _restore_params(snap):
+    sc = scope_mod.global_scope()
+    for n, v in snap.items():
+        sc.set(n, v.copy())
+
+
+def test_parallel_losses_match_single_device():
+    """Same init, same global batch: the PE (data-parallel over 8 devices,
+    pmean grads) must track the single-device trajectory."""
+    loss, xs, ys = _build(seed=0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    init = _snapshot_params()
+
+    single = []
+    for _ in range(5):
+        lv, = exe.run(feed={"img": xs, "label": ys}, fetch_list=[loss])
+        single.append(float(np.asarray(lv).reshape(-1)[0]))
+
+    _restore_params(init)
+    pe = fluid.ParallelExecutor(loss_name=loss.name)
+    assert pe.device_count == 8
+    multi = []
+    for _ in range(5):
+        lv, = pe.run(feed={"img": xs, "label": ys},
+                     fetch_list=[loss.name])
+        multi.append(float(np.asarray(lv).mean()))
+
+    np.testing.assert_allclose(multi, single, rtol=1e-4, atol=1e-6)
+
+
+def test_parallel_executor_share_vars_from():
+    """The test-program PE built with share_vars_from reads the training
+    PE's parameters (reference ParallelExecutor eval pattern)."""
+    loss, xs, ys = _build(seed=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    train_pe = fluid.ParallelExecutor(loss_name=loss.name)
+    for _ in range(3):
+        train_pe.run(feed={"img": xs, "label": ys},
+                     fetch_list=[loss.name])
+
+    test_prog = fluid.default_main_program().clone(for_test=True)
+    test_pe = fluid.ParallelExecutor(main_program=test_prog,
+                                     share_vars_from=train_pe,
+                                     loss_name=loss.name)
+    lv, = test_pe.run(feed={"img": xs, "label": ys},
+                      fetch_list=[loss.name])
+    lv2, = exe.run(test_prog, feed={"img": xs, "label": ys},
+                   fetch_list=[loss])
+    np.testing.assert_allclose(float(np.asarray(lv).mean()),
+                               float(np.asarray(lv2).reshape(-1)[0]),
+                               rtol=1e-5)
+
+
+def test_batch_not_divisible_by_devices_errors_clearly():
+    loss, xs, ys = _build(seed=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    pe = fluid.ParallelExecutor(loss_name=loss.name)
+    with pytest.raises(Exception):
+        pe.run(feed={"img": xs[:5], "label": ys[:5]},
+               fetch_list=[loss.name])
