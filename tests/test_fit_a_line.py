@@ -116,3 +116,28 @@ def test_program_cache_reuse_and_invalidation():
     np.testing.assert_allclose(np.asarray(r2), np.asarray(r1) * 2.0,
                                rtol=1e-6)
     assert len(exe._cache) > n1 + 1
+
+
+def test_error_paths_are_clear():
+    """Operational error quality: run-before-startup and missing feed keys
+    fail with actionable messages, not garbage or tracer errors."""
+    import numpy as np
+    import pytest
+    import paddle_tpu as fluid
+
+    x = fluid.layers.data(name="ex", shape=[4], dtype="float32")
+    loss = fluid.layers.mean(fluid.layers.fc(input=x, size=2))
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    with pytest.raises(RuntimeError, match="startup"):
+        exe.run(feed={"ex": np.ones((2, 4), np.float32)},
+                fetch_list=[loss])
+
+    exe.run(fluid.default_startup_program())
+    with pytest.raises((KeyError, RuntimeError, ValueError)):
+        exe.run(feed={}, fetch_list=[loss])  # missing feed
+
+    # int feed for a float slot auto-casts rather than crashing
+    out, = exe.run(feed={"ex": np.ones((2, 4), np.int64)},
+                   fetch_list=[loss])
+    assert np.isfinite(np.asarray(out)).all()
