@@ -91,3 +91,36 @@ def test_lookup_shape_and_purity():
     out = host_embedding_lookup("t5", jnp.asarray(ids))
     assert out.shape == (2, 2, 3)
     np.testing.assert_allclose(np.asarray(out)[0, 0], t.pull([0])[0])
+
+
+def test_pslib_fleet_api_shape(tmp_path, monkeypatch):
+    """The pslib-shaped fleet surface (P7 parity): init, DownpourSGD
+    distributed_optimizer, sparse-table persistables roundtrip."""
+    import paddle_tpu as fluid
+    from paddle_tpu.incubate.fleet.parameter_server.pslib import fleet
+
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "1")
+    fleet.init()
+    fleet.init_worker()
+    fleet.init_server()
+
+    t = HostEmbeddingTable("ps_table", num_rows=20, dim=4, num_shards=2,
+                           learning_rate=0.1, init_scale=0.05, seed=3)
+    before = t.pull(np.arange(20))
+
+    x = fluid.layers.data(name="psx", shape=[4], dtype="float32")
+    loss = fluid.layers.mean(fluid.layers.fc(input=x, size=1))
+    opt = fleet.distributed_optimizer(fluid.optimizer.SGD(0.1))
+    opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    exe.run(feed={"psx": np.ones((4, 4), np.float32)}, fetch_list=[loss])
+
+    d = str(tmp_path / "ps_ckpt")
+    fleet.save_persistables(exe, d)
+    t.push(np.array([1], np.int64), np.ones((1, 4), np.float32))
+    moved = t.pull(np.array([1], np.int64)).copy()
+    fleet.load_persistables(exe, d)
+    np.testing.assert_allclose(t.pull(np.arange(20)), before, atol=1e-6)
+    assert not np.allclose(moved, before[1], atol=1e-6)
