@@ -1,0 +1,143 @@
+"""Forward smoke tests for the less-traveled fluid.layers surface: each
+case builds through the DSL, runs through the executor, and checks output
+shape/finiteness (reference: each of these has a dedicated test_*_op.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import framework
+
+RNG = np.random.RandomState(3)
+
+
+def run_layer(build, feeds):
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        vs = {}
+        for name, arr in feeds.items():
+            vs[name] = fluid.layers.data(
+                name=name, shape=list(arr.shape), dtype=str(arr.dtype),
+                append_batch_size=False)
+        out = build(vs)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    outs = exe.run(main, feed=feeds,
+                   fetch_list=[out] if not isinstance(out, (list, tuple))
+                   else list(out))
+    return [np.asarray(o) for o in outs]
+
+
+X4 = RNG.rand(2, 4, 8, 8).astype(np.float32)
+
+
+@pytest.mark.parametrize("case", [
+    ("pixel_shuffle", lambda vs: fluid.layers.pixel_shuffle(
+        vs["x"], upscale_factor=2), {"x": X4}, (2, 1, 16, 16)),
+    ("space_to_depth", lambda vs: fluid.layers.space_to_depth(
+        vs["x"], blocksize=2), {"x": X4}, (2, 16, 4, 4)),
+    ("shuffle_channel", lambda vs: fluid.layers.shuffle_channel(
+        vs["x"], group=2), {"x": X4}, (2, 4, 8, 8)),
+    ("temporal_shift", lambda vs: fluid.layers.temporal_shift(
+        vs["x"], seg_num=2, shift_ratio=0.25), {"x": X4}, (2, 4, 8, 8)),
+    ("maxout", lambda vs: fluid.layers.maxout(vs["x"], groups=2),
+     {"x": X4}, (2, 2, 8, 8)),
+    ("lrn", lambda vs: fluid.layers.lrn(vs["x"], n=3),
+     {"x": X4}, (2, 4, 8, 8)),
+    ("grid_sampler", lambda vs: fluid.layers.grid_sampler(
+        vs["x"], fluid.layers.affine_grid(
+            vs["theta"], out_shape=[2, 4, 8, 8])),
+     {"x": X4, "theta": RNG.rand(2, 2, 3).astype(np.float32)},
+     (2, 4, 8, 8)),
+    ("im2sequence", lambda vs: fluid.layers.im2sequence(
+        vs["x"], filter_size=2, stride=2), {"x": X4}, None),
+    ("add_position_encoding", lambda vs: fluid.layers.add_position_encoding(
+        vs["s"], alpha=1.0, beta=1.0),
+     {"s": RNG.rand(2, 6, 8).astype(np.float32)}, (2, 6, 8)),
+    ("similarity_focus", lambda vs: fluid.layers.similarity_focus(
+        vs["x"], axis=1, indexes=[0]), {"x": X4}, (2, 4, 8, 8)),
+], ids=lambda c: c[0])
+def test_rare_vision_layers(case):
+    name, build, feeds, want_shape = case
+    outs = run_layer(build, feeds)
+    assert np.isfinite(outs[0]).all(), name
+    if want_shape is not None:
+        assert tuple(outs[0].shape) == want_shape, (name, outs[0].shape)
+
+
+@pytest.mark.parametrize("case", [
+    ("dice_loss", lambda vs: fluid.layers.dice_loss(
+        vs["p"], vs["lab_i"]),
+     {"p": RNG.rand(4, 1).astype(np.float32),
+      "lab_i": RNG.randint(0, 1, (4, 1)).astype(np.int64)}),
+    ("npair_loss", lambda vs: fluid.layers.npair_loss(
+        vs["a"], vs["p"], vs["lab_f"]),
+     {"a": RNG.rand(4, 8).astype(np.float32),
+      "p": RNG.rand(4, 8).astype(np.float32),
+      "lab_f": RNG.rand(4).astype(np.float32)}),
+    ("bpr_loss", lambda vs: fluid.layers.bpr_loss(
+        fluid.layers.softmax(vs["a"]), vs["lab_i"]),
+     {"a": RNG.rand(4, 5).astype(np.float32),
+      "lab_i": RNG.randint(0, 5, (4, 1)).astype(np.int64)}),
+    ("rank_loss", lambda vs: fluid.layers.rank_loss(
+        vs["lab01"], vs["l"], vs["r"]),
+     {"lab01": RNG.randint(0, 2, (4, 1)).astype(np.float32),
+      "l": RNG.rand(4, 1).astype(np.float32),
+      "r": RNG.rand(4, 1).astype(np.float32)}),
+    ("hinge_loss", lambda vs: fluid.layers.hinge_loss(
+        vs["l"], vs["lab01"]),
+     {"l": RNG.rand(4, 1).astype(np.float32),
+      "lab01": RNG.randint(0, 2, (4, 1)).astype(np.float32)}),
+    ("teacher_student", lambda vs:
+     fluid.layers.teacher_student_sigmoid_loss(vs["l"], vs["lab01"]),
+     {"l": RNG.rand(4, 1).astype(np.float32),
+      "lab01": RNG.randint(0, 2, (4, 1)).astype(np.float32)}),
+], ids=lambda c: c[0])
+def test_rare_loss_layers(case):
+    name, build, feeds = case
+    outs = run_layer(build, feeds)
+    assert np.isfinite(outs[0]).all(), name
+
+
+def test_sampled_softmax_and_sampling_id():
+    logits = RNG.rand(4, 32).astype(np.float32)
+    labels = RNG.randint(0, 32, (4, 1)).astype(np.int64)
+
+    def build(vs):
+        return fluid.layers.sampled_softmax_with_cross_entropy(
+            vs["logits"], vs["labels"], num_samples=8)
+
+    outs = run_layer(build, {"logits": logits, "labels": labels})
+    assert outs[0].shape[0] == 4 and np.isfinite(outs[0]).all()
+
+    def build2(vs):
+        return fluid.layers.sampling_id(fluid.layers.softmax(vs["logits"]))
+
+    outs = run_layer(build2, {"logits": logits})
+    assert ((0 <= outs[0]) & (outs[0] < 32)).all()
+
+
+def test_hash_cvm_data_norm():
+    ids = RNG.randint(0, 1000, (4, 3)).astype(np.int64)
+
+    def build(vs):
+        return fluid.layers.hash(vs["ids"], hash_size=64)
+
+    outs = run_layer(build, {"ids": ids})
+    assert ((0 <= outs[0]) & (outs[0] < 64)).all()
+
+    x = RNG.rand(4, 5).astype(np.float32) + 1.0
+
+    def build2(vs):
+        return fluid.layers.continuous_value_model(
+            vs["x"], vs["cvm"], use_cvm=True)
+
+    outs = run_layer(build2, {"x": x,
+                              "cvm": np.ones((4, 2), np.float32)})
+    assert np.isfinite(outs[0]).all()
+
+    def build3(vs):
+        return fluid.layers.data_norm(vs["x"])
+
+    outs = run_layer(build3, {"x": x})
+    assert np.isfinite(outs[0]).all()
