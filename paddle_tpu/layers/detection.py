@@ -35,6 +35,19 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=[1.],
                "variances": list(variance), "flip": flip, "clip": clip,
                "step_w": steps[0], "step_h": steps[1], "offset": offset},
     )
+    # build-time shape: [H, W, nb, 4] with nb from the kernel's exact
+    # prior-count rule (1.0 + unique ars (+ flip reciprocals)) per min
+    # size, plus one sqrt box per max size
+    if input.shape is not None and len(input.shape) == 4:
+        ars = [1.0]
+        for ar in aspect_ratios:
+            if not any(abs(ar - e) < 1e-6 for e in ars):
+                ars.append(ar)
+                if flip:
+                    ars.append(1.0 / ar)
+        nb = len(list(min_sizes)) * len(ars) + len(list(max_sizes or []))
+        boxes.shape = (input.shape[2], input.shape[3], nb, 4)
+        var.shape = boxes.shape
     return boxes, var
 
 
@@ -180,25 +193,26 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
              conf_loss_weight=1.0, match_type="per_prediction",
              mining_type="max_negative", normalize=True,
              sample_size=None):
-    """SSD multibox loss composed from matching + target assign + smooth_l1
-    + softmax CE (layers/detection.py ssd_loss). Works on padded gt arrays
-    (invalid gt rows have label < 0)."""
-    from . import nn
-
-    iou = iou_similarity(gt_box, prior_box)  # [G, M] per batch? padded form
-    matched, match_dist = bipartite_match(iou, match_type, neg_overlap)
-    # conf targets
-    conf_target, conf_w = target_assign(gt_label, matched,
-                                        mismatch_value=background_label)
-    loc_target, loc_w = target_assign(gt_box, matched, mismatch_value=0)
-    enc = box_coder(prior_box, prior_box_var, loc_target) \
-        if prior_box_var is not None else loc_target
-    loc_loss = nn.smooth_l1(location, enc)
-    conf_loss = nn.softmax_with_cross_entropy(confidence, conf_target)
-    total = nn.elementwise_add(
-        nn.scale(nn.reduce_mean(loc_loss), scale=loc_loss_weight),
-        nn.scale(nn.reduce_mean(conf_loss), scale=conf_loss_weight))
-    return total
+    """SSD multibox loss (layers/detection.py ssd_loss): per-prediction
+    matching + encoded smooth-L1 + softmax CE with hard-negative mining,
+    as ONE dense op over padded gt arrays (invalid gt rows have label < 0).
+    Returns the per-prior weighted loss [B, M]; sum it for the total."""
+    helper = LayerHelper("ssd_loss", **locals())
+    out = helper.create_variable_for_type_inference(location.dtype)
+    ins = {"Loc": [location], "Conf": [confidence], "GTBox": [gt_box],
+           "GTLabel": [gt_label], "PriorBox": [prior_box]}
+    if prior_box_var is not None:
+        ins["PriorBoxVar"] = [prior_box_var]
+    helper.append_op(
+        type="ssd_loss", inputs=ins, outputs={"Out": [out]},
+        attrs={"background_label": background_label,
+               "overlap_threshold": overlap_threshold,
+               "neg_pos_ratio": neg_pos_ratio,
+               "loc_loss_weight": loc_loss_weight,
+               "conf_loss_weight": conf_loss_weight,
+               "normalize": normalize})
+    out.shape = tuple(location.shape[:2]) if location.shape else None
+    return out
 
 
 def yolo_box(x, img_size, anchors, class_num, conf_thresh,
@@ -340,6 +354,11 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
 
     if min_sizes is None:
         num_layer = len(inputs)
+        if num_layer < 3:
+            raise ValueError(
+                "multi_box_head: auto min/max sizes from min_ratio/"
+                "max_ratio need at least 3 input feature maps (got %d); "
+                "pass min_sizes/max_sizes explicitly" % num_layer)
         min_sizes = []
         max_sizes = []
         step = int(np.floor((max_ratio - min_ratio) / (num_layer - 2)))
@@ -364,11 +383,9 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
                 [steps[i], steps[i]] if steps else [0.0, 0.0])
         box, var = prior_box(inp, image, ms, Ms, ar, variance, flip, clip,
                              step_, offset)
-        num_boxes = 1
-        n_ar = len(ar) * 2 - 1 if flip else len(ar)
-        num_boxes = len(ms) * (1 + (1 if flip else 0)) + n_ar - 1 + (
-            len(Ms) if Ms else 0)
-        # prior_box returns [H, W, nb, 4]; count from its shape
+        # prior_box returns [H, W, nb, 4]: take the per-cell prior count
+        # from its actual shape so the conv head always agrees with it
+        num_boxes = box.shape[2]
         num_loc = num_boxes * 4
         mbox_loc = nn.conv2d(input=inp, num_filters=num_loc,
                              filter_size=kernel_size, padding=pad,

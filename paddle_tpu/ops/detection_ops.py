@@ -996,3 +996,85 @@ def _roi_perspective_transform(ctx, ins, attrs):
     mask = jnp.ones((rois.shape[0], 1, oh, ow), jnp.int32)
     return {"Out": [out], "Mask": [mask],
             "TransformMatrix": [jnp.zeros((rois.shape[0], 9), x.dtype)]}
+
+
+@register("ssd_loss", nondiff_inputs=("GTBox", "GTLabel", "PriorBox",
+                                      "PriorBoxVar"))
+def _ssd_loss(ctx, ins, attrs):
+    """SSD multibox loss (ssd_loss in layers/detection.py of the
+    reference): per-prediction matching of priors to ground truth,
+    smooth-L1 on encoded location offsets of the positives, softmax CE on
+    classes with hard-negative mining at neg_pos_ratio, normalized by the
+    positive count. Padded gt rows carry label < 0.
+
+    Loc [B, M, 4], Conf [B, M, C], GTBox [B, G, 4] (xyxy), GTLabel
+    [B, G] or [B, G, 1], PriorBox [M, 4], PriorBoxVar [M, 4].
+    Out: [B, M] per-prior weighted loss whose sum is the total loss.
+    """
+    loc = ins["Loc"][0].astype(jnp.float32)
+    conf = ins["Conf"][0].astype(jnp.float32)
+    gt_box = ins["GTBox"][0].astype(jnp.float32)
+    gt_label = ins["GTLabel"][0].reshape(gt_box.shape[0], -1)
+    prior = ins["PriorBox"][0].astype(jnp.float32)
+    pvar = (ins["PriorBoxVar"][0].astype(jnp.float32)
+            if ins.get("PriorBoxVar") else None)
+    background = attrs.get("background_label", 0)
+    overlap_threshold = attrs.get("overlap_threshold", 0.5)
+    neg_pos_ratio = attrs.get("neg_pos_ratio", 3.0)
+    loc_w = attrs.get("loc_loss_weight", 1.0)
+    conf_w = attrs.get("conf_loss_weight", 1.0)
+    normalize = attrs.get("normalize", True)
+
+    B, M, _ = loc.shape
+    valid_gt = gt_label >= 0                                    # [B, G]
+
+    iou = jax.vmap(lambda g: _iou_matrix(g, prior))(gt_box)     # [B, G, M]
+    iou = jnp.where(valid_gt[..., None], iou, -1.0)
+    best_iou = iou.max(axis=1)                                  # [B, M]
+    best_g = iou.argmax(axis=1)                                 # [B, M]
+    pos = best_iou >= overlap_threshold                         # [B, M]
+
+    tgt_label = jnp.take_along_axis(
+        jnp.where(valid_gt, gt_label, background), best_g, axis=1)
+    tgt_label = jnp.where(pos, tgt_label, background).astype(jnp.int32)
+
+    # SSD box encoding of the matched gt against each prior
+    matched = jnp.take_along_axis(gt_box, best_g[..., None], axis=1)
+    pw = jnp.maximum(prior[:, 2] - prior[:, 0], 1e-6)
+    ph = jnp.maximum(prior[:, 3] - prior[:, 1], 1e-6)
+    pcx = (prior[:, 0] + prior[:, 2]) / 2
+    pcy = (prior[:, 1] + prior[:, 3]) / 2
+    gw = jnp.maximum(matched[..., 2] - matched[..., 0], 1e-6)
+    gh = jnp.maximum(matched[..., 3] - matched[..., 1], 1e-6)
+    gcx = (matched[..., 0] + matched[..., 2]) / 2
+    gcy = (matched[..., 1] + matched[..., 3]) / 2
+    enc = jnp.stack([(gcx - pcx) / pw, (gcy - pcy) / ph,
+                     jnp.log(gw / pw), jnp.log(gh / ph)], axis=-1)
+    if pvar is not None:
+        enc = enc / jnp.maximum(pvar, 1e-6)
+
+    diff = loc - enc
+    ad = jnp.abs(diff)
+    smooth_l1 = jnp.where(ad < 1.0, 0.5 * diff * diff, ad - 0.5).sum(-1)
+    loc_loss = smooth_l1 * pos.astype(jnp.float32)              # [B, M]
+
+    logp = jax.nn.log_softmax(conf, axis=-1)
+    ce = -jnp.take_along_axis(logp, tgt_label[..., None],
+                              axis=-1)[..., 0]                  # [B, M]
+
+    # hard negative mining: per image keep the neg_pos_ratio * npos
+    # highest-CE negatives (mine_hard_examples semantics)
+    is_neg = ~pos
+    npos = pos.sum(axis=1, keepdims=True)
+    nneg = jnp.minimum((npos * neg_pos_ratio).astype(jnp.int32),
+                       is_neg.sum(axis=1, keepdims=True))
+    neg_ce = jnp.where(is_neg, ce, -jnp.inf)
+    order = jnp.argsort(-neg_ce, axis=1)
+    rank = jnp.argsort(order, axis=1)
+    selected_neg = is_neg & (rank < nneg)
+
+    conf_loss = ce * (pos | selected_neg).astype(jnp.float32)
+    total = loc_w * loc_loss + conf_w * conf_loss               # [B, M]
+    if normalize:
+        total = total / jnp.maximum(npos.astype(jnp.float32), 1.0)
+    return {"Out": [total]}
