@@ -109,3 +109,55 @@ def test_export_and_serve_stablehlo_artifact(tmp_path):
     # fresh process serves on the real TPU, whose fp32 matmul differs from
     # CPU at ~1e-3 — a cross-platform serving check, not bit-exactness
     np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-3)
+
+
+def test_batch_norm_inference_through_save_predict_serve(tmp_path):
+    """BN must use running stats (not batch stats) identically across
+    clone(for_test), AnalysisPredictor, the StableHLO serving artifact,
+    and any batch size."""
+    x = fluid.layers.data(name="x", shape=[3, 8, 8], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+    h = fluid.layers.conv2d(input=x, num_filters=4, filter_size=3,
+                            padding=1)
+    h = fluid.layers.batch_norm(input=h, act="relu")
+    pool = fluid.layers.pool2d(input=h, global_pooling=True,
+                               pool_type="avg")
+    pred = fluid.layers.fc(input=pool, size=3, act="softmax")
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(input=pred,
+                                                        label=y))
+    fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    for _ in range(5):
+        exe.run(feed={"x": rng.rand(8, 3, 8, 8).astype(np.float32) * 3,
+                      "y": rng.randint(0, 3, (8, 1)).astype(np.int64)},
+                fetch_list=[loss])
+
+    xd = rng.rand(4, 3, 8, 8).astype(np.float32)
+    test_prog = fluid.default_main_program().clone(for_test=True)
+    want, = exe.run(test_prog,
+                    feed={"x": xd, "y": np.zeros((4, 1), np.int64)},
+                    fetch_list=[pred])
+    want = np.asarray(want)
+
+    d = str(tmp_path / "bn_model")
+    fluid.io.save_inference_model(d, ["x"], [pred], exe)
+
+    from paddle_tpu.inference import (export_serving_model,
+                                      load_serving_model)
+
+    cfg = AnalysisConfig(d)
+    cfg.disable_gpu()
+    p = create_paddle_predictor(cfg)
+    got = p.run([PaddleTensor(xd, name="x")])[0].as_ndarray()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    export_serving_model(d, p, {"x": (4, 3, 8, 8)})
+    sp = load_serving_model(d)
+    got2 = np.asarray(sp.run_dict({"x": xd})[0])
+    np.testing.assert_allclose(got2, want, rtol=1e-4, atol=1e-5)
+
+    # batch-size independence: a single sample equals its batch-run row
+    got3 = p.run([PaddleTensor(xd[:1], name="x")])[0].as_ndarray()
+    np.testing.assert_allclose(got3[0], want[0], rtol=1e-4, atol=1e-5)
