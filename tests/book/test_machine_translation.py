@@ -35,3 +35,63 @@ def test_nmt_attention_trains_on_copy_task():
             lv, = exe.run(feed=feed, fetch_list=[avg_cost])
         losses.append(float(lv[0]))
     assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_nmt_greedy_decode_reproduces_copy():
+    """Inference half of the book test: after training on the copy task,
+    autoregressive greedy decoding (feeding the model its own prefix)
+    reconstructs the source sequence."""
+    V, T = 30, 8
+    inputs, logits, avg_cost = machine_translation.build(
+        src_dict_size=V, trg_dict_size=V, embed_dim=32, hidden_dim=32,
+        max_len=T)
+    fluid.optimizer.Adam(learning_rate=2e-2).minimize(avg_cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    rng = np.random.RandomState(7)
+    n = 128
+    full = np.full((n, 1), T, np.int64)
+    src = rng.randint(2, V, size=(n, T)).astype(np.int64)
+    trg = np.zeros((n, T), np.int64)
+    trg[:, 0] = 1
+    trg[:, 1:] = src[:, :-1]
+    for epoch in range(60):
+        for i in range(0, n, 32):
+            lv, = exe.run(feed={
+                "src_word": src[i:i+32], "src_len": full[i:i+32],
+                "trg_word": trg[i:i+32], "trg_next": src[i:i+32],
+                "trg_len": full[i:i+32]}, fetch_list=[avg_cost])
+    final = float(np.asarray(lv).reshape(-1)[0])
+
+    # the model must have LEARNED the task (teacher-forced accuracy)
+    lg, = exe.run(feed={
+        "src_word": src[:32], "src_len": full[:32], "trg_word": trg[:32],
+        "trg_next": src[:32], "trg_len": full[:32]}, fetch_list=[logits])
+    tf_acc = (np.asarray(lg).reshape(32, T, V).argmax(-1)
+              == src[:32]).mean()
+    assert tf_acc > 0.9, (tf_acc, final)
+
+    # greedy decode 8 TRAINING sequences: tests the autoregressive
+    # inference mechanics (the tiny model memorizes rather than
+    # generalizes, matching the reference book test's scale)
+    m = 8
+    test_src = src[:m]
+    dec = np.zeros((m, T), np.int64)
+    dec[:, 0] = 1
+    lens_m = np.full((m, 1), T, np.int64)
+    for t in range(T):
+        lg, = exe.run(feed={
+            "src_word": test_src, "src_len": lens_m, "trg_word": dec,
+            "trg_next": np.zeros((m, T), np.int64), "trg_len": lens_m},
+            fetch_list=[logits])
+        nxt = np.asarray(lg).reshape(m, T, V)[:, t].argmax(-1)
+        if t + 1 < T:
+            dec[:, t + 1] = nxt
+        last = nxt
+    decoded = np.concatenate([dec[:, 1:], last[:, None]], axis=1)
+    # free-running decode suffers exposure bias at this scale (the tiny
+    # reference book model does too); require it to be far above the
+    # 1/(V-2) ~ 3.6% chance floor, proving the autoregressive loop works
+    token_acc = (decoded == test_src).mean()
+    assert token_acc > 0.3, (token_acc, final)
