@@ -64,8 +64,13 @@ def test_nmt_greedy_decode_reproduces_copy():
                 "trg_len": full[i:i+32]}, fetch_list=[avg_cost])
     final = float(np.asarray(lv).reshape(-1)[0])
 
+    # inference program: clone(for_test) prunes backward + optimizer ops —
+    # running the TRAINING program here would apply Adam updates against
+    # the dummy labels on every decode step, corrupting the model
+    infer_prog = fluid.default_main_program().clone(for_test=True)
+
     # the model must have LEARNED the task (teacher-forced accuracy)
-    lg, = exe.run(feed={
+    lg, = exe.run(infer_prog, feed={
         "src_word": src[:32], "src_len": full[:32], "trg_word": trg[:32],
         "trg_next": src[:32], "trg_len": full[:32]}, fetch_list=[logits])
     tf_acc = (np.asarray(lg).reshape(32, T, V).argmax(-1)
@@ -81,7 +86,7 @@ def test_nmt_greedy_decode_reproduces_copy():
     dec[:, 0] = 1
     lens_m = np.full((m, 1), T, np.int64)
     for t in range(T):
-        lg, = exe.run(feed={
+        lg, = exe.run(infer_prog, feed={
             "src_word": test_src, "src_len": lens_m, "trg_word": dec,
             "trg_next": np.zeros((m, T), np.int64), "trg_len": lens_m},
             fetch_list=[logits])
