@@ -129,6 +129,17 @@ class Fleet:
     def is_first_worker(self):
         return self.worker_index() == 0
 
+    def is_worker(self):
+        return (self._role_maker.is_worker() if self._role_maker else True)
+
+    def is_server(self):
+        return (self._role_maker.is_server() if self._role_maker else False)
+
+    def server_num(self):
+        return (self._role_maker.server_num()
+                if self._role_maker and hasattr(self._role_maker,
+                                                "server_num") else 0)
+
     def worker_endpoints(self):
         return (self._role_maker.get_trainer_endpoints()
                 if self._role_maker else [])
