@@ -23,7 +23,8 @@ class AutoMixedPrecisionLists:
 
     def __init__(self, custom_white_list=None, custom_black_list=None):
         self.white_list = {"matmul", "mul", "conv2d", "conv3d",
-                           "depthwise_conv2d"} | set(custom_white_list or ())
+                           "depthwise_conv2d",
+                           "flash_attention"} | set(custom_white_list or ())
         self.black_list = {"softmax", "softmax_with_cross_entropy",
                            "cross_entropy", "cross_entropy2", "mean",
                            "layer_norm", "batch_norm",

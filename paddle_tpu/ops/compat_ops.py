@@ -471,12 +471,17 @@ def _flash_attention_op(ctx, ins, attrs):
     from .pallas_kernels import flash_attention
 
     q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    out_dtype = q.dtype
+    if attrs.get("__amp_bf16__") and q.dtype == jnp.float32:
+        # AMP white-list marking: bf16 QKV matmuls (softmax stays fp32
+        # inside the kernels), output cast back to fp32
+        q, k, v = (t.astype(jnp.bfloat16) for t in (q, k, v))
     causal = attrs.get("causal", False)
     scale = attrs.get("sm_scale", None)
     Dh = q.shape[-1]
     T = q.shape[2]
     if T % 128 == 0 and Dh >= 64 and q.shape == k.shape:
-        out = flash_attention(q, k, v, causal, scale)
+        out = flash_attention(q, k, v, causal, scale).astype(out_dtype)
     else:  # shapes the blocked kernels can't tile: plain fused softmax
         s = scale if scale is not None else Dh ** -0.5
         logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
@@ -487,4 +492,4 @@ def _flash_attention_op(ctx, ins, attrs):
             logits = jnp.where(mask, logits, -1e30)
         p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
         out = jnp.einsum("bhqk,bhkd->bhqd", p, v)
-    return {"Out": [out]}
+    return {"Out": [out.astype(out_dtype)]}
