@@ -67,15 +67,44 @@ def restore_checkpoint(directory_or_path, target_state=None):
     import numpy as np
 
     # orbax round-trips containers loosely (tuples come back as lists), so
-    # match by LEAF ORDER — stable across that transformation — and place
-    # each leaf onto the target's sharding (device_put with a NamedSharding
-    # re-shards onto the current mesh)
-    raw_leaves = jax.tree.leaves(raw)
-    t_leaves, treedef = jax.tree.flatten(target_state)
-    if len(raw_leaves) != len(t_leaves):
+    # match by keypath — with sequence indices and dict/attr keys
+    # normalized to plain strings, stable across that transformation — and
+    # place each leaf onto the target's sharding (device_put with a
+    # NamedSharding re-shards onto the current mesh). Shape alone is not
+    # enough: many transformer weights share a shape, and a silent
+    # order-based match would restore renamed/reordered keys into the
+    # wrong slots.
+    raw_paths = jax.tree_util.tree_flatten_with_path(raw)[0]
+    t_paths, treedef = jax.tree_util.tree_flatten_with_path(target_state)
+    if len(raw_paths) != len(t_paths):
         raise ValueError(
             "checkpoint has %d leaves but target_state has %d"
-            % (len(raw_leaves), len(t_leaves)))
+            % (len(raw_paths), len(t_paths)))
+
+    def _norm(path):
+        out = []
+        for k in path:
+            if hasattr(k, "idx"):
+                out.append(str(k.idx))
+            elif hasattr(k, "key"):
+                out.append(str(k.key))
+            elif hasattr(k, "name"):
+                out.append(str(k.name))
+            else:
+                out.append(str(k))
+        return tuple(out)
+
+    raw_by_key = {_norm(p): leaf for p, leaf in raw_paths}
+    raw_leaves, t_leaves = [], []
+    for p, t in t_paths:
+        key = _norm(p)
+        if key not in raw_by_key:
+            raise ValueError(
+                "target_state leaf %r not found in checkpoint (checkpoint "
+                "keys: %s...)" % ("/".join(key),
+                                  sorted(raw_by_key)[:8]))
+        raw_leaves.append(raw_by_key[key])
+        t_leaves.append(t)
     placed = []
     for r, t in zip(raw_leaves, t_leaves):
         arr = np.asarray(r)

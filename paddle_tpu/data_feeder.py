@@ -93,11 +93,13 @@ class DataFeedDesc:
             self.batch_size = int(bs.group(1))
             self._batch_size_set = True
 
-    def add_slot(self, name, dtype="float", shape=None, is_dense=False):
+    def add_slot(self, name, dtype="float", shape=None, is_dense=False,
+                 pad_value=0):
         self._slot_index[name] = len(self.slots)
         self.slots.append({"name": name, "type": dtype,
                            "shape": list(shape or []),
-                           "is_dense": is_dense, "is_used": True})
+                           "is_dense": is_dense, "is_used": True,
+                           "pad_value": pad_value})
         return self
 
     def set_batch_size(self, batch_size):
@@ -107,6 +109,14 @@ class DataFeedDesc:
     def set_dense_slots(self, dense_slots_name):
         for n in dense_slots_name:
             self.slots[self._slot_index[n]]["is_dense"] = True
+
+    def set_pad_value(self, pad_values):
+        """Per-slot batch pad value, `{slot_name: value}`. Ragged id slots
+        batch padded-dense; padding with the embedding's declared
+        padding_idx keeps pad rows out of sum-pooled lookups (the
+        reference's LoD batching has no pad contributions at all)."""
+        for n, v in pad_values.items():
+            self.slots[self._slot_index[n]]["pad_value"] = v
 
     def set_use_slots(self, use_slots_name):
         for s in self.slots:

@@ -75,30 +75,47 @@ class DatasetBase:
             return reader
         return recordio_writer.recordio_reader_creator(self._filelist)
 
+    def _pad_values(self):
+        """Per-used-slot batch pad value (positional, matching the order
+        `_sample_reader` yields). Declared via DataFeedDesc
+        `set_pad_value` — pad ids with the embedding's padding_idx so
+        sum-pooled lookups exclude pad rows (reference LoD batching has no
+        pad contributions)."""
+        if self._feed_desc is None:
+            return None
+        return [s.get("pad_value", 0) for s in self._feed_desc.slots
+                if s.get("is_used", True)]
+
     def _batches(self):
         feed_names = [v.name for v in self._use_var]
+        pads = self._pad_values()
         batch = []
         for sample in self._iter_samples():
             batch.append(sample)
             if len(batch) == self._batch_size:
-                yield self._to_feed(feed_names, batch)
+                yield self._to_feed(feed_names, batch, pads)
                 batch = []
         if batch:
-            yield self._to_feed(feed_names, batch)
+            yield self._to_feed(feed_names, batch, pads)
 
     @staticmethod
-    def _to_feed(feed_names, batch):
+    def _to_feed(feed_names, batch, pad_values=None):
         cols = list(zip(*batch))
         feed = {}
-        for name, col in zip(feed_names, cols):
+        for i, (name, col) in enumerate(zip(feed_names, cols)):
             arrs = [np.asarray(c) for c in col]
             # variable-length sparse slots (the MultiSlot norm) batch
-            # padded-dense: pad 1-D id/value lists with 0 to the batch max
-            # (the LoD -> padded+lengths bridge, SURVEY §5.7)
+            # padded-dense: pad 1-D id/value lists to the batch max with the
+            # slot's declared pad value (the LoD -> padded+lengths bridge,
+            # SURVEY §5.7)
             if (arrs[0].ndim == 1
                     and len({a.shape[0] for a in arrs}) > 1):
+                pad = 0
+                if pad_values is not None and i < len(pad_values):
+                    pad = pad_values[i]
                 maxlen = max(a.shape[0] for a in arrs)
-                arrs = [np.pad(a, (0, maxlen - a.shape[0])) for a in arrs]
+                arrs = [np.pad(a, (0, maxlen - a.shape[0]),
+                               constant_values=pad) for a in arrs]
             stacked = np.stack(arrs)
             if stacked.ndim == 1:  # scalar fields batch to [N, 1] (labels)
                 stacked = stacked.reshape(-1, 1)

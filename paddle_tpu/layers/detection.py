@@ -193,10 +193,22 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
              conf_loss_weight=1.0, match_type="per_prediction",
              mining_type="max_negative", normalize=True,
              sample_size=None):
-    """SSD multibox loss (layers/detection.py ssd_loss): per-prediction
-    matching + encoded smooth-L1 + softmax CE with hard-negative mining,
-    as ONE dense op over padded gt arrays (invalid gt rows have label < 0).
-    Returns the per-prior weighted loss [B, M]; sum it for the total."""
+    """SSD multibox loss (layers/detection.py ssd_loss): greedy bipartite
+    matching (every gt gets its argmax prior) + per-prediction
+    augmentation, encoded smooth-L1 + softmax CE with max-negative hard
+    mining, as ONE dense op over padded gt arrays (invalid gt rows have
+    label < 0). Returns the per-prior weighted loss [B, M]; sum it for the
+    total."""
+    if match_type not in ("per_prediction", "bipartite"):
+        raise NotImplementedError(
+            "ssd_loss match_type must be 'per_prediction' or 'bipartite', "
+            "got %r" % (match_type,))
+    if mining_type != "max_negative":
+        # the reference only implements max_negative too
+        # (layers/detection.py ssd_loss raises on 'hard_example')
+        raise NotImplementedError(
+            "ssd_loss mining_type only supports 'max_negative', got %r"
+            % (mining_type,))
     helper = LayerHelper("ssd_loss", **locals())
     out = helper.create_variable_for_type_inference(location.dtype)
     ins = {"Loc": [location], "Conf": [confidence], "GTBox": [gt_box],
@@ -208,8 +220,10 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
         attrs={"background_label": background_label,
                "overlap_threshold": overlap_threshold,
                "neg_pos_ratio": neg_pos_ratio,
+               "neg_overlap": neg_overlap,
                "loc_loss_weight": loc_loss_weight,
                "conf_loss_weight": conf_loss_weight,
+               "match_type": match_type,
                "normalize": normalize})
     out.shape = tuple(location.shape[:2]) if location.shape else None
     return out

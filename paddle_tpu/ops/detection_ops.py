@@ -1020,19 +1020,48 @@ def _ssd_loss(ctx, ins, attrs):
             if ins.get("PriorBoxVar") else None)
     background = attrs.get("background_label", 0)
     overlap_threshold = attrs.get("overlap_threshold", 0.5)
+    neg_overlap = attrs.get("neg_overlap", 0.5)
     neg_pos_ratio = attrs.get("neg_pos_ratio", 3.0)
     loc_w = attrs.get("loc_loss_weight", 1.0)
     conf_w = attrs.get("conf_loss_weight", 1.0)
+    match_type = attrs.get("match_type", "per_prediction")
     normalize = attrs.get("normalize", True)
 
     B, M, _ = loc.shape
     valid_gt = gt_label >= 0                                    # [B, G]
+    G = gt_box.shape[1]
 
     iou = jax.vmap(lambda g: _iou_matrix(g, prior))(gt_box)     # [B, G, M]
     iou = jnp.where(valid_gt[..., None], iou, -1.0)
     best_iou = iou.max(axis=1)                                  # [B, M]
+
+    # Stage 1 — greedy bipartite matching (bipartite_match_op.cc): every
+    # valid gt gets its argmax prior even below overlap_threshold, priors
+    # consumed one per gt in global-max order.
+    def match_one(d):                                           # [G, M]
+        def body(carry, _):
+            dd, midx = carry
+            flat = jnp.argmax(dd)
+            i, j = flat // M, flat % M
+            do = dd[i, j] > 0.0  # skip invalid (-1) and zero-IoU gts
+            midx = jnp.where(do, midx.at[j].set(i), midx)
+            dd = jnp.where(do, dd.at[i, :].set(-1e10).at[:, j].set(-1e10),
+                           dd)
+            return (dd, midx), None
+
+        init = (d, -jnp.ones((M,), jnp.int32))
+        (_, midx), _ = jax.lax.scan(body, init, None, length=min(G, M))
+        return midx
+
+    bip_g = jax.vmap(match_one)(iou)                            # [B, M]
+    pos = bip_g >= 0
+
+    # Stage 2 — per-prediction augmentation: unmatched priors whose best
+    # overlap clears the threshold also become positives.
     best_g = iou.argmax(axis=1)                                 # [B, M]
-    pos = best_iou >= overlap_threshold                         # [B, M]
+    if match_type == "per_prediction":
+        pos = pos | (best_iou >= overlap_threshold)
+    best_g = jnp.where(bip_g >= 0, bip_g, best_g)
 
     tgt_label = jnp.take_along_axis(
         jnp.where(valid_gt, gt_label, background), best_g, axis=1)
@@ -1063,8 +1092,9 @@ def _ssd_loss(ctx, ins, attrs):
                               axis=-1)[..., 0]                  # [B, M]
 
     # hard negative mining: per image keep the neg_pos_ratio * npos
-    # highest-CE negatives (mine_hard_examples semantics)
-    is_neg = ~pos
+    # highest-CE negatives among priors whose overlap is below neg_overlap
+    # (mine_hard_examples max_negative semantics)
+    is_neg = (~pos) & (best_iou < neg_overlap)
     npos = pos.sum(axis=1, keepdims=True)
     nneg = jnp.minimum((npos * neg_pos_ratio).astype(jnp.int32),
                        is_neg.sum(axis=1, keepdims=True))

@@ -200,13 +200,19 @@ class Optimizer:
         from .dygraph import base as dy_base
 
         t = dy_base._current_tracer()
+        import weakref
+
         if not hasattr(self, "_eager_params"):
             self._eager_params = []
             self._eager_seen = set()
-            self._tape_key = None
+            self._tape_ref = None
             self._tape_pos = 0
-        if self._tape_key != id(t.tape):
-            self._tape_key = id(t.tape)
+        # weakref to the tape, not id(): a GC'd tape's address can be
+        # reused by a fresh Tape (silently skipping its entries), and a
+        # strong ref would pin a whole step's activations after the
+        # tracer drops the tape
+        if self._tape_ref is None or self._tape_ref() is not t.tape:
+            self._tape_ref = weakref.ref(t.tape)
             self._tape_pos = 0
         entries = t.tape.entries
         for _op, ins, _attrs, vouts, _ctx in entries[self._tape_pos:]:

@@ -10,6 +10,7 @@ def np_array(x):
     def reader():
         if x.ndim < 1:
             yield x
+            return
         for e in x:
             yield e
 

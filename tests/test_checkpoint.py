@@ -57,3 +57,24 @@ def test_manager_rolls_old_checkpoints(tmp_path):
     assert mgr.all_steps() == [3, 4]
     got = mgr.restore()
     assert float(np.asarray(got["x"])) == 4.0
+
+
+def test_restore_rejects_renamed_keys(tmp_path):
+    """Keypath-validated restore: two same-shaped leaves under renamed
+    container keys must fail loudly, not restore into the wrong slots."""
+    import numpy as np
+    import pytest
+    from paddle_tpu.checkpoint import restore_checkpoint, save_checkpoint
+
+    state = {"w_q": np.ones((4, 4), np.float32),
+             "w_k": np.full((4, 4), 2.0, np.float32)}
+    save_checkpoint(str(tmp_path), state, step=0)
+    target = {"w_query": np.zeros((4, 4), np.float32),
+              "w_key": np.zeros((4, 4), np.float32)}
+    with pytest.raises(ValueError):
+        restore_checkpoint(str(tmp_path), target_state=target)
+    # matching keys restore fine (and tuple/list looseness is tolerated)
+    ok = restore_checkpoint(str(tmp_path), target_state={
+        "w_q": np.zeros((4, 4), np.float32),
+        "w_k": np.zeros((4, 4), np.float32)})
+    assert float(np.asarray(ok["w_k"])[0, 0]) == 2.0

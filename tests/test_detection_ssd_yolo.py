@@ -129,3 +129,50 @@ def test_ssd_loss_decreases_when_predictions_match_gt():
     conf_bad[0, 1, 1] = 6.0
     bad, = run(make_build(6.0), dict(feeds, loc=loc_bad, conf=conf_bad))
     assert float(good.reshape(-1)[0]) < float(bad.reshape(-1)[0])
+
+
+def test_ssd_loss_bipartite_matches_low_iou_gt():
+    """A gt whose best prior IoU is below overlap_threshold must still
+    produce one positive via the bipartite (per-gt argmax) stage — the
+    reference's per_prediction matching runs bipartite first
+    (ssd_loss in layers/detection.py of the reference)."""
+    # prior barely overlaps the gt: IoU ~ 0.14, well under 0.5
+    prior = np.array([[0.0, 0.0, 0.2, 0.2], [0.7, 0.7, 0.9, 0.9]],
+                     np.float32)
+    gt_box = np.array([[[0.1, 0.1, 0.45, 0.45]]], np.float32)
+    gt_label = np.array([[[1]]], np.int64)
+    loc = np.zeros((1, 2, 4), np.float32)
+    # confident background everywhere: if the gt were unmatched, conf loss
+    # would be ~0; a bipartite positive forces a real class-1 CE loss
+    conf = np.zeros((1, 2, 3), np.float32)
+    conf[:, :, 0] = 6.0
+
+    def build(vs):
+        return fluid.layers.reduce_sum(fluid.layers.ssd_loss(
+            vs["loc"], vs["conf"], vs["gt_box"], vs["gt_label"],
+            vs["prior"], overlap_threshold=0.5))
+
+    loss, = run(build, {"loc": loc, "conf": conf, "gt_box": gt_box,
+                        "gt_label": gt_label, "prior": prior})
+    assert float(loss.reshape(-1)[0]) > 3.0  # ≈ CE of 6-logit wrong class
+
+
+def test_ssd_loss_rejects_unsupported_modes():
+    prior = np.array([[0.1, 0.1, 0.4, 0.4]], np.float32)
+    gt_box = np.array([[[0.1, 0.1, 0.4, 0.4]]], np.float32)
+    gt_label = np.array([[[1]]], np.int64)
+
+    def build_with(**kw):
+        def build(vs):
+            return fluid.layers.ssd_loss(
+                vs["loc"], vs["conf"], vs["gt_box"], vs["gt_label"],
+                vs["prior"], **kw)
+        return build
+
+    feeds = {"loc": np.zeros((1, 1, 4), np.float32),
+             "conf": np.zeros((1, 1, 3), np.float32),
+             "gt_box": gt_box, "gt_label": gt_label, "prior": prior}
+    with pytest.raises(NotImplementedError):
+        run(build_with(mining_type="hard_example"), feeds)
+    with pytest.raises(NotImplementedError):
+        run(build_with(match_type="nonsense"), feeds)
