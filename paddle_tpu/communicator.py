@@ -1,0 +1,139 @@
+"""Async Communicator (parity: operators/distributed/communicator.cc —
+SendThread :100 merges+sends gradients on a background thread, RecvThread
+:196 pulls params continuously, Start :273; python communicator.py).
+
+TPU-native shape: the "send" leg is the sparse push into host-RAM embedding
+tables (parallel/host_embedding.py) — while attached, `table.push` enqueues
+and returns immediately, and a per-table background thread drains the
+queue through the table's optimizer, so gradient transport is decoupled
+from the jitted compute step exactly like the reference's async mode. The
+"recv" leg needs no thread: lookups read the live host table, which is
+always at least as fresh as the reference's periodically-pulled param
+cache. Dense params never leave HBM (they are donated jit state), so only
+the sparse path communicates.
+"""
+
+import queue
+import threading
+
+import numpy as np
+
+__all__ = ["Communicator"]
+
+
+class _AsyncPusher:
+    """SendThread parity: bounded queue + one drain thread per table.
+    Consecutive queued (ids, grads) pairs are merged before applying —
+    the reference's merge-before-send (communicator.cc MergeVars)."""
+
+    def __init__(self, table, max_queue=64, merge_size=4):
+        self._table = table
+        self._q = queue.Queue(maxsize=max_queue)
+        self._merge_size = merge_size
+        self._stop = threading.Event()
+        self._idle = threading.Event()
+        self._idle.set()
+        self._error = None
+        self._thread = threading.Thread(
+            target=self._run, name="communicator-send-%s" % table.name,
+            daemon=True)
+        self._thread.start()
+
+    def enqueue(self, ids, grads):
+        self._raise_if_failed()
+        self._idle.clear()
+        self._q.put((ids, grads))
+
+    def _raise_if_failed(self):
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError(
+                "Communicator send thread for table %r died"
+                % self._table.name) from err
+
+    def _run(self):
+        while not self._stop.is_set() or not self._q.empty():
+            try:
+                ids, grads = self._q.get(timeout=0.05)
+            except queue.Empty:
+                if self._q.empty():
+                    self._idle.set()
+                continue
+            batch = [(ids, grads)]
+            # merge whatever else is already queued (bounded)
+            for _ in range(self._merge_size - 1):
+                try:
+                    batch.append(self._q.get_nowait())
+                except queue.Empty:
+                    break
+            try:
+                batch_i = [i.reshape(-1) for i, _ in batch]
+                batch_g = [np.asarray(g).reshape(i.size, -1)
+                           for i, g in batch]
+                self._table._apply_push(np.concatenate(batch_i),
+                                        np.concatenate(batch_g))
+            except BaseException as e:  # surface on the training thread:
+                # a dead thread with items stuck on the queue would
+                # deadlock flush()/push() with no error ever shown
+                self._error = e
+                self._stop.set()
+            finally:
+                for _ in batch:
+                    self._q.task_done()
+            if self._q.empty():
+                self._idle.set()
+
+    def flush(self):
+        """Block until every queued push has been applied (the reference's
+        send_barrier). Re-raises any error the send thread hit."""
+        self._q.join()
+        self._idle.wait()
+        self._raise_if_failed()
+
+    def stop(self):
+        self.flush()
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+class Communicator:
+    """fluid.communicator.Communicator parity. `start()` switches every
+    registered host embedding table (or the ones named) to async push;
+    `stop()` drains and detaches. Use `flush()` as the barrier before
+    reading table state (checkpointing, eval)."""
+
+    def __init__(self, program=None, table_names=None):
+        self._table_names = table_names
+        self._pushers = {}
+        self._started = False
+
+    def start(self):
+        from .parallel.host_embedding import _TABLES
+
+        if self._started:
+            return
+        names = (self._table_names if self._table_names is not None
+                 else list(_TABLES))
+        for n in names:
+            table = _TABLES[n]
+            p = _AsyncPusher(table)
+            table._pusher = p
+            self._pushers[n] = p
+        self._started = True
+
+    def flush(self):
+        for p in self._pushers.values():
+            p.flush()
+
+    def stop(self):
+        from .parallel.host_embedding import _TABLES
+
+        for n, p in self._pushers.items():
+            p.stop()
+            if n in _TABLES:
+                _TABLES[n]._pusher = None
+        self._pushers.clear()
+        self._started = False
+
+    def is_running(self):
+        return self._started

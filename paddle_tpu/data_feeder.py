@@ -110,6 +110,15 @@ class DataFeedDesc:
         for n in dense_slots_name:
             self.slots[self._slot_index[n]]["is_dense"] = True
 
+    def set_hash_mod(self, hash_mods):
+        """Per-slot host-side id folding, `{slot_name: mod}`. Raw uint64
+        feature hashes are reduced `id % mod` on the HOST while parsing —
+        the device graph never carries 64-bit ids (JAX canonicalizes
+        int64 device arrays to int32, which would silently truncate ids
+        above 2^31). `mod` is normally the embedding table's num_rows."""
+        for n, v in hash_mods.items():
+            self.slots[self._slot_index[n]]["hash_mod"] = int(v)
+
     def set_pad_value(self, pad_values):
         """Per-slot batch pad value, `{slot_name: value}`. Ragged id slots
         batch padded-dense; padding with the embedding's declared

@@ -59,6 +59,18 @@ class DatasetBase:
             types = [s["type"] for s in desc.slots]
             used = [i for i, s in enumerate(desc.slots)
                     if s.get("is_used", True)]
+            mods = [desc.slots[i].get("hash_mod") for i in used]
+
+            from .parallel.host_embedding import fold_ids
+
+            def fold(v, mod):
+                # host-side id folding (set_hash_mod): raw uint64 hashes
+                # never reach the device as 64-bit values; same rule as
+                # HostEmbeddingTable(hash_ids=True) so serving-time
+                # pull(raw_ids) agrees with training-time folds
+                if mod is None:
+                    return v
+                return fold_ids(v, mod)
 
             def reader():
                 for path in self._filelist:
@@ -70,7 +82,8 @@ class DatasetBase:
                             "MultiSlot file %s: skipped %d malformed "
                             "line(s)", path, bad)
                     for rec in records:
-                        yield tuple(rec[i] for i in used)
+                        yield tuple(fold(rec[i], m)
+                                    for i, m in zip(used, mods))
 
             return reader
         return recordio_writer.recordio_reader_creator(self._filelist)

@@ -132,6 +132,20 @@ class _CompiledStep:
                 want = dtype_to_np(v.dtype)
                 if arr.dtype != want:
                     arr = arr.astype(want)
+            # JAX canonicalizes int64 device inputs to int32; an id above
+            # 2^31 would truncate SILENTLY. Fail loudly instead — raw
+            # feature hashes belong on the host side (DataFeedDesc slot
+            # hash_mod / HostEmbeddingTable(hash_ids=True)).
+            if (isinstance(arr, np.ndarray) and arr.size
+                    and arr.dtype in (np.int64, np.uint64)
+                    and (arr.max() > np.iinfo(np.int32).max
+                         or arr.min() < np.iinfo(np.int32).min)):
+                raise ValueError(
+                    "feed %r holds int64 ids above int32 range; JAX would "
+                    "silently truncate them on device. Hash them on the "
+                    "host first (DataFeedDesc.set_hash_mod, or "
+                    "HostEmbeddingTable(hash_ids=True) for direct "
+                    "pull/push)" % name)
             feeds[name] = arr
         step_counter = np.uint32(scope.get("__step_counter__", 0) or 0)
         fetches, new_state, finite = self._jitted(

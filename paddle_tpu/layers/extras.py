@@ -399,7 +399,8 @@ def beam_search_decode(ids, scores, parents, beam_size=None, end_id=0,
 
 
 def distributed_embedding(input, table_name=None, size=None, num_shards=1,
-                          optimizer="sgd", learning_rate=0.1, name=None):
+                          optimizer="sgd", learning_rate=0.1, name=None,
+                          hash_ids=False):
     """Embedding served from a host-RAM sharded table with sparse
     push-on-backward (parity: the distributed lookup table, P6/P7 —
     transpiler/distribute_lookup_table.py + fleet pull/push; SURVEY §7
@@ -416,7 +417,7 @@ def distributed_embedding(input, table_name=None, size=None, num_shards=1,
             raise ValueError("size=[num_rows, dim] required for a new table")
         HostEmbeddingTable(table_name, size[0], size[1],
                            num_shards=num_shards, optimizer=optimizer,
-                           learning_rate=learning_rate)
+                           learning_rate=learning_rate, hash_ids=hash_ids)
     dim = _TABLES[table_name].dim
     # float anchor: the hook the gradient machinery differentiates so the
     # backward sparse push fires (ids are integers)

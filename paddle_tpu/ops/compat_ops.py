@@ -194,14 +194,14 @@ def _sample_logits(ctx, ins, attrs):
         samples = jnp.concatenate([labels.reshape(b, -1), neg], axis=1)
     sampled = jnp.take_along_axis(logits, samples, axis=1)
     n_true = labels.reshape(b, -1).shape[1]
-    sampled_labels = jnp.arange(n_true, dtype=jnp.int64)[None, :].repeat(
+    sampled_labels = jnp.arange(n_true, dtype=jnp.int32)[None, :].repeat(
         b, axis=0)
     return {"SampledLogits": [sampled], "Samples": [samples],
             "SampledLabels": [sampled_labels],
             "Probabilities": [jnp.full(samples.shape,
                                        1.0 / n_classes, jnp.float32)],
-            "LogitsDim": [jnp.asarray(logits.shape, jnp.int64)],
-            "LabelsDim": [jnp.asarray(labels.shape, jnp.int64)]}
+            "LogitsDim": [jnp.asarray(logits.shape, jnp.int32)],
+            "LabelsDim": [jnp.asarray(labels.shape, jnp.int32)]}
 
 
 # ---- pserver sharding helpers --------------------------------------------

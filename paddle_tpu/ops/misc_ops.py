@@ -203,7 +203,7 @@ def _edit_distance(ctx, ins, attrs):
     dist = jnp.take_along_axis(row, hlens[:, None], axis=1)[:, 0]
     if attrs.get("normalized", True):
         dist = dist / jnp.maximum(rlens.astype(jnp.float32), 1.0)
-    seq_num = jnp.array([B], jnp.int64)
+    seq_num = jnp.array([B], jnp.int32)
     return {"Out": [dist[:, None]], "SequenceNum": [seq_num]}
 
 

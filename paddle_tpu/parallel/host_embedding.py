@@ -20,18 +20,36 @@ __all__ = ["HostEmbeddingTable", "host_embedding_lookup"]
 _TABLES = {}
 
 
+def fold_ids(ids, mod):
+    """THE id-folding rule, shared by every host-side path (table
+    hash_ids, DataFeedDesc.set_hash_mod): reinterpret signed ids as
+    uint64 (bit-pattern wraparound, the convention for feature hashes)
+    and reduce modulo `mod`. One definition so training-time folds and
+    serving-time pull(raw_ids) always agree."""
+    ids = np.asarray(ids)
+    u = ids.astype(np.uint64) if ids.dtype != np.uint64 else ids
+    return (u % np.uint64(mod)).astype(np.int64)
+
+
 class HostEmbeddingTable:
     """Sharded host-RAM embedding with built-in sparse SGD/Adagrad update
     (the pserver's optimizer block, distribute_lookup_table.py parity)."""
 
     def __init__(self, name, num_rows, dim, num_shards=1, optimizer="sgd",
                  learning_rate=0.1, init_scale=0.01, seed=0,
-                 dtype=np.float32):
+                 dtype=np.float32, hash_ids=False):
         if name in _TABLES:
             raise ValueError("embedding table %r already exists" % name)
         self.name = name
         self.num_rows = num_rows
         self.dim = dim
+        # raw ids outside [0, num_rows) (e.g. uint64 feature hashes) are
+        # folded into the row space on the HOST — the device graph never
+        # carries 64-bit ids (JAX canonicalizes int64 device arrays to
+        # int32; lookup_sparse_table's auto-growth becomes fixed-size
+        # modulo hashing)
+        self.hash_ids = hash_ids
+        self._pusher = None
         self.num_shards = num_shards
         self.optimizer = optimizer
         self.learning_rate = learning_rate
@@ -51,7 +69,19 @@ class HostEmbeddingTable:
     # -- shard addressing -------------------------------------------------
 
     def _locate(self, ids):
-        ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        ids = np.asarray(ids).reshape(-1)
+        # keep unsigned 64-bit hashes exact until the fold (a plain int64
+        # cast of a uint64 above 2^63 would go negative)
+        ids = ids.astype(np.uint64 if ids.dtype == np.uint64 else np.int64)
+        if self.hash_ids:
+            ids = fold_ids(ids, self.num_rows)
+        else:
+            ids = ids.astype(np.int64)
+            if ids.size and (ids.min() < 0 or ids.max() >= self.num_rows):
+                raise ValueError(
+                    "table %r: id out of range [0, %d) — construct the "
+                    "table with hash_ids=True to fold raw feature hashes "
+                    "into the row space" % (self.name, self.num_rows))
         shard = ids % self.num_shards
         local = ids // self.num_shards
         return shard, local
@@ -70,7 +100,20 @@ class HostEmbeddingTable:
         return out
 
     def push(self, ids, grads):
-        """Sparse update: scatter row grads back through the optimizer."""
+        """Sparse update: scatter row grads back through the optimizer.
+        With a Communicator attached the (ids, grads) pair is queued and
+        applied by the background send thread (communicator.cc:100
+        SendThread parity); otherwise applied inline."""
+        pusher = self._pusher
+        if pusher is not None:
+            pusher.enqueue(np.asarray(ids).copy(), np.asarray(grads).copy())
+            return
+        self._apply_push(ids, grads)
+
+    def _apply_push(self, ids, grads):
+        """O(touched rows) work and memory: grads for duplicate ids are
+        segment-summed into a [n_touched, dim] buffer — never a dense
+        full-shard array (the 1e8-row use case this module exists for)."""
         shard, local = self._locate(ids)
         grads = np.asarray(grads).reshape(len(shard), self.dim)
         lr = self.learning_rate
@@ -80,15 +123,17 @@ class HostEmbeddingTable:
                 if not m.any():
                     continue
                 rows = local[m]
-                g = np.zeros_like(self._shards[s])
-                np.add.at(g, rows, grads[m])  # duplicate ids accumulate
-                touched = np.unique(rows)
+                touched, inv = np.unique(rows, return_inverse=True)
+                g = np.zeros((len(touched), self.dim),
+                             self._shards[s].dtype)
+                np.add.at(g, inv, grads[m])  # duplicate ids accumulate
                 if self.optimizer == "adagrad":
-                    self._accum[s][touched] += g[touched] ** 2
-                    self._shards[s][touched] -= lr * g[touched] / (
-                        np.sqrt(self._accum[s][touched]) + 1e-6)
+                    acc = self._accum[s][touched] + g * g
+                    self._accum[s][touched] = acc
+                    self._shards[s][touched] -= lr * g / (np.sqrt(acc)
+                                                          + 1e-6)
                 else:  # sgd
-                    self._shards[s][touched] -= lr * g[touched]
+                    self._shards[s][touched] -= lr * g
 
     # -- whole-table io (checkpoint parity io.py:280) ---------------------
 

@@ -124,3 +124,174 @@ def test_pslib_fleet_api_shape(tmp_path, monkeypatch):
     fleet.load_persistables(exe, d)
     np.testing.assert_allclose(t.pull(np.arange(20)), before, atol=1e-6)
     assert not np.allclose(moved, before[1], atol=1e-6)
+
+
+def test_push_cost_is_o_touched_rows():
+    """VERDICT r1 weak-3: push must do O(touched rows) work, never
+    materialize a dense full-shard array. With 1e7 rows x dim 8 the old
+    zeros_like path allocated 320 MB per push; 20 pushes must now be
+    near-instant."""
+    import time
+
+    t = HostEmbeddingTable("big", num_rows=10_000_000, dim=8, num_shards=4,
+                           learning_rate=0.1, init_scale=0.0)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 10_000_000, size=512).astype(np.int64)
+    g = np.ones((512, 8), np.float32)
+    t.push(ids, g)  # warm
+    t0 = time.perf_counter()
+    for _ in range(20):
+        t.push(ids, g)
+    dt = time.perf_counter() - t0
+    assert dt < 2.0, "push took %.2fs for 20x512 rows — not O(touched)" % dt
+    # correctness at scale: exactly the touched rows moved
+    touched = np.unique(ids)
+    assert np.all(t.pull(touched) != 0.0)
+    untouched = np.setdiff1d(np.arange(0, 1000), touched)[:10]
+    np.testing.assert_allclose(t.pull(untouched), 0.0)
+
+
+def test_hash_ids_folds_big_ids_on_host():
+    """Ids >= 2^31 (raw uint64 feature hashes) fold into the row space on
+    the host — exact, no int32 truncation (VERDICT r1 weak-7)."""
+    t = HostEmbeddingTable("hashed", num_rows=1000, dim=4, num_shards=3,
+                           learning_rate=1.0, init_scale=0.0,
+                           hash_ids=True)
+    big = np.array([2**33 + 5, 2**31 + 1, 2**63 + 7], np.uint64)
+    rows = [int(i % 1000) for i in big.tolist()]
+    assert len(set(rows)) == 3
+    t.push(big, np.ones((3, 4), np.float32))
+    np.testing.assert_allclose(t.pull(big), -1.0)
+    np.testing.assert_allclose(
+        t.pull(np.asarray(rows, np.int64)), -1.0)  # same rows, small ids
+    # truncated-int32 aliases of those ids must NOT have moved
+    aliased = np.array([(i & 0x7FFFFFFF) % 1000 for i in big.tolist()])
+    aliased = np.setdiff1d(aliased, np.asarray(rows))
+    if aliased.size:
+        np.testing.assert_allclose(t.pull(aliased), 0.0)
+
+
+def test_out_of_range_ids_raise_without_hashing():
+    t = HostEmbeddingTable("strict", num_rows=10, dim=2)
+    with pytest.raises(ValueError, match="hash_ids"):
+        t.pull(np.array([2**31 + 1], np.int64))
+
+
+def test_communicator_async_push_matches_sync():
+    """P5 parity: with the Communicator started, push() enqueues and a
+    background SendThread applies — final state equals the synchronous
+    result after flush (communicator.cc:100/:273)."""
+    from paddle_tpu.communicator import Communicator
+
+    t_async = HostEmbeddingTable("ca", num_rows=100, dim=4, num_shards=2,
+                                 learning_rate=0.5, init_scale=0.0)
+    t_sync = HostEmbeddingTable("cs", num_rows=100, dim=4, num_shards=2,
+                                learning_rate=0.5, init_scale=0.0)
+    comm = Communicator(table_names=["ca"])
+    comm.start()
+    assert comm.is_running()
+    rng = np.random.RandomState(3)
+    for step in range(10):
+        ids = rng.randint(0, 100, size=32).astype(np.int64)
+        g = rng.randn(32, 4).astype(np.float32)
+        t_async.push(ids, g)
+        t_sync.push(ids, g)
+    comm.flush()
+    all_ids = np.arange(100, dtype=np.int64)
+    np.testing.assert_allclose(t_async.pull(all_ids), t_sync.pull(all_ids),
+                               atol=1e-5)
+    comm.stop()
+    assert not comm.is_running()
+    # after stop, push applies inline again
+    t_async.push(np.array([0], np.int64), np.ones((1, 4), np.float32))
+    assert not np.allclose(t_async.pull(np.array([0], np.int64)),
+                           t_sync.pull(np.array([0], np.int64)))
+
+
+def test_executor_rejects_truncating_int64_feed():
+    import paddle_tpu as fluid
+    from paddle_tpu import framework
+
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        ids = fluid.layers.data(name="bigids", shape=[3], dtype="int64")
+        out = fluid.layers.cast(ids, "float32")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    with pytest.raises(ValueError, match="int32 range"):
+        exe.run(main, feed={"bigids": np.array([[1, 2, 2**31 + 7]],
+                                               np.int64)},
+                fetch_list=[out])
+
+
+def test_ctr_big_id_pipeline_with_communicator(tmp_path):
+    """End-to-end CTR path: raw uint64 ids (> 2^31) in MultiSlot text are
+    folded on the host (set_hash_mod), looked up through
+    distributed_embedding, and trained with the async Communicator
+    running — the full P5+P6 capability in one flow."""
+    import paddle_tpu as fluid
+    from paddle_tpu import framework
+    from paddle_tpu.communicator import Communicator
+
+    p = str(tmp_path / "part-0.txt")
+    rng = np.random.RandomState(0)
+    with open(p, "w") as f:
+        for _ in range(64):
+            raw = [str(int(x)) for x in
+                   rng.randint(2**31, 2**62, size=3, dtype=np.int64)]
+            label = str(rng.randint(0, 2))
+            f.write("3 " + " ".join(raw) + " 1 " + label + "\n")
+
+    desc = fluid.DataFeedDesc()
+    desc.add_slot("ids", "uint64")
+    desc.add_slot("label", "float")
+    desc.set_hash_mod({"ids": 500})
+    ds = fluid.DatasetFactory().create_dataset("QueueDataset")
+    ds.set_data_feed_desc(desc)
+    ds.set_batch_size(16)
+    ds.set_filelist([p])
+
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        ids = fluid.layers.data(name="ids", shape=[3], dtype="int64",
+                                append_batch_size=False)
+        label = fluid.layers.data(name="label", shape=[1], dtype="float32")
+        ds.set_use_var([ids, label])
+        emb = fluid.layers.distributed_embedding(
+            ids, table_name="ctr_tab", size=[500, 8], num_shards=2,
+            learning_rate=0.2)
+        pred = fluid.layers.fc(input=fluid.layers.reshape(emb, [-1, 24]),
+                               size=1, act="sigmoid")
+        loss = fluid.layers.mean(
+            fluid.layers.log_loss(pred, label, epsilon=1e-6))
+        fluid.optimizer.SGD(0.2).minimize(loss)
+
+    comm = Communicator(table_names=["ctr_tab"])
+    comm.start()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for _epoch in range(8):
+        out = exe.train_from_dataset(program=main, dataset=ds,
+                                     fetch_list=[loss])
+        losses.append(float(np.asarray(out[0]).ravel()[0]))
+    comm.flush()
+    comm.stop()
+    assert losses[-1] < losses[0], losses
+    tab = HostEmbeddingTable.get("ctr_tab")
+    moved = tab.pull(np.arange(500, dtype=np.int64))
+    assert np.abs(moved).max() > 0  # sparse pushes actually landed
+
+
+def test_communicator_surfaces_send_thread_errors():
+    """A failing push must not silently kill the send thread and deadlock
+    flush(); the error re-raises on the training thread."""
+    from paddle_tpu.communicator import Communicator
+
+    t = HostEmbeddingTable("err_tab", num_rows=10, dim=2)  # strict ids
+    comm = Communicator(table_names=["err_tab"])
+    comm.start()
+    t.push(np.array([2**31 + 1], np.int64), np.ones((1, 2), np.float32))
+    with pytest.raises(RuntimeError, match="send thread"):
+        comm.flush()
+    comm.stop()
