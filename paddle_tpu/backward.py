@@ -133,6 +133,26 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
             gout_map[slot] = names
         if not any_gout:
             continue
+        if op.type == "while" and not op.attrs.get("max_trip_count"):
+            raise RuntimeError(
+                "gradient demanded through a While loop with no "
+                "max_trip_count: a fully-dynamic lax.while_loop has no "
+                "reverse-mode rule. Build it as "
+                "fluid.layers.While(cond, max_trip_count=N) (lax.scan of "
+                "N masked steps), or use StaticRNN/DynamicRNN for "
+                "recurrences.")
+        # vars whose upstream cotangent THIS op consumes (it appears as an
+        # output with a live grad). When such a var is ALSO an input under
+        # the same name (in-place ops: While carries, increment, assign-
+        # into), the vjp-computed input grad must REPLACE the grad var —
+        # accumulating would double-count the cotangent the op just
+        # consumed.
+        consumed = set()
+        for slot, vs in op.outputs.items():
+            for i, v in enumerate(vs):
+                if i < len(gout_map[slot]) and gout_map[slot][i] is not None:
+                    consumed.add(v.name)
+
         # inputs that require grads
         gin_map = {}
         accumulate = {}
@@ -149,7 +169,7 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
                     continue
                 gname = grad_var_name(v.name)
                 gv = _create_grad_var(block, v, gname)
-                if v.name in grad_map:
+                if v.name in grad_map and v.name not in consumed:
                     # a later consumer already produced this grad: accumulate
                     accumulate[gname] = True
                 else:

@@ -42,6 +42,13 @@ class LoweringContext:
         self.check_nan_inf = check_nan_inf
         self.nan_reports = []   # list of (label, bool scalar tracer)
         self._nan_suppress = 0
+        # forward input values per op, captured at forward-execution time.
+        # Grad ops recompute their forward under jax.vjp; reading inputs
+        # from the *current* env would be wrong whenever a var was
+        # overwritten after the op ran (in-place writes — While carries,
+        # increment, assign-into). Holding tracer refs costs nothing in
+        # the jaxpr unless a grad op actually uses them.
+        self.fwd_snapshots = {}
 
     @contextmanager
     def inner_trace(self):
@@ -66,9 +73,11 @@ class LoweringContext:
 # ops that are pure program structure — no runtime kernel
 _STRUCTURAL = {"feed", "fetch", "read", "double_buffer", "create_py_reader",
                "data", "depend",
-               # pserver RPC ops (transpiler/distribute_transpiler.py): in
-               # local/single-process lowering these are no-ops — params keep
-               # their scope values, the pserver applies updates remotely
+               # pserver RPC ops (transpiler/distribute_transpiler.py) are
+               # invisible to the jitted step: the EXECUTOR runs them
+               # host-side after each step (executor._run_rpc_plan over
+               # distributed_runtime.ParameterServerClient), and a program
+               # holding listen_and_serv blocks in run_pserver
                "send", "recv", "send_barrier", "fetch_barrier",
                "listen_and_serv", "checkpoint_notify", "gen_nccl_id"}
 
@@ -105,6 +114,8 @@ def execute_op(op, env, ctx):
     ins = {
         slot: [env[v.name] for v in vs] for slot, vs in op.inputs.items() if vs
     }
+    if opdef.differentiable:
+        ctx.fwd_snapshots[id(op)] = ins
     outs = opdef.impl(ctx, ins, op.attrs)
     _bind_outputs(op, outs, env, ctx)
 
@@ -176,9 +187,12 @@ def _execute_grad_op(op, env, ctx):
     gin_map = op.attrs["__grad_in_map__"]
     opdef = registry.get(fwd.type)
 
-    fwd_ins = {
-        slot: [env[v.name] for v in vs] for slot, vs in fwd.inputs.items() if vs
-    }
+    fwd_ins = ctx.fwd_snapshots.get(id(fwd))
+    if fwd_ins is None:
+        fwd_ins = {
+            slot: [env[v.name] for v in vs]
+            for slot, vs in fwd.inputs.items() if vs
+        }
     diff_slots = [
         s
         for s in fwd_ins

@@ -1,0 +1,384 @@
+"""Parameter-server runtime (parity: listen_and_serv_op.cc:109 RunSyncLoop,
+operators/distributed/grpc/grpc_client.h:181-195 AsyncSendVar/AsyncGetVar,
+request_handler_impl.cc barrier logic).
+
+The reference serves parameters over gRPC from dedicated pserver processes.
+Here the same *capability* runs over a compact framed-TCP protocol:
+
+  trainer step (one jitted XLA call, grads fetched)
+    -> SEND grad vars to each owning endpoint        (send op)
+    -> SEND_BARRIER: blocks until the server has heard from all Fanin
+       trainers and run its optimizer sub-blocks     (send_barrier op)
+    -> GET param vars                                (recv op)
+    -> FETCH_BARRIER: round bookkeeping              (fetch_barrier op)
+
+The server executes the transpiled pserver program's optimize sub-blocks
+(whole-var optimizer ops) through the SAME op registry the trainer uses —
+one kernel corpus, two roles. Wire format: 16-byte header (magic, type,
+meta length) + JSON meta + raw tensor bytes — no pickling of incoming
+payloads.
+"""
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+
+import numpy as np
+
+__all__ = ["ParameterServerClient", "run_pserver", "shutdown_pservers"]
+
+_MAGIC = b"PTPU"
+_HDR = struct.Struct("!4sBI")  # magic, msg type, meta length
+
+MSG_SEND = 1
+MSG_SEND_BARRIER = 2
+MSG_GET = 3
+MSG_FETCH_BARRIER = 4
+MSG_SHUTDOWN = 5
+MSG_OK = 6
+MSG_VAR = 7
+MSG_ERR = 8
+MSG_COMPLETE = 9  # trainer finished (rpc_server DecreaseClientNum parity)
+
+
+def _write_msg(sock, mtype, meta, payload=b""):
+    meta_b = json.dumps(meta).encode()
+    sock.sendall(_HDR.pack(_MAGIC, mtype, len(meta_b)) + meta_b + payload)
+
+
+def _read_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _read_msg(sock):
+    magic, mtype, mlen = _HDR.unpack(_read_exact(sock, _HDR.size))
+    if magic != _MAGIC:
+        raise ConnectionError("bad magic %r" % magic)
+    meta = json.loads(_read_exact(sock, mlen)) if mlen else {}
+    payload = b""
+    nbytes = meta.get("nbytes", 0)
+    if nbytes:
+        payload = _read_exact(sock, nbytes)
+    return mtype, meta, payload
+
+
+def _tensor_meta(name, arr):
+    return {"name": name, "dtype": str(arr.dtype),
+            "shape": list(arr.shape), "nbytes": arr.nbytes}
+
+
+def _tensor_from(meta, payload):
+    return np.frombuffer(payload, dtype=np.dtype(meta["dtype"])).reshape(
+        meta["shape"]).copy()
+
+
+# ---------------------------------------------------------------------------
+# client (the send/recv/*_barrier op runtime — grpc_client.h parity)
+# ---------------------------------------------------------------------------
+
+
+class ParameterServerClient:
+    """One persistent connection per endpoint, thread-safe per instance
+    (each trainer process owns one)."""
+
+    def __init__(self, trainer_id=0, timeout=120.0):
+        self.trainer_id = trainer_id
+        self.timeout = timeout
+        self._socks = {}
+        self._lock = threading.Lock()
+
+    def _sock(self, endpoint):
+        s = self._socks.get(endpoint)
+        if s is None:
+            host, port = endpoint.rsplit(":", 1)
+            s = socket.create_connection((host, int(port)),
+                                         timeout=self.timeout)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._socks[endpoint] = s
+        return s
+
+    def _rpc(self, endpoint, mtype, meta, payload=b""):
+        with self._lock:
+            s = self._sock(endpoint)
+            _write_msg(s, mtype, meta, payload)
+            rtype, rmeta, rpayload = _read_msg(s)
+        if rtype == MSG_ERR:
+            raise RuntimeError("pserver %s: %s" % (endpoint,
+                                                   rmeta.get("error")))
+        return rtype, rmeta, rpayload
+
+    def send_var(self, endpoint, name, value):
+        value = np.ascontiguousarray(value)
+        meta = _tensor_meta(name, value)
+        meta["trainer_id"] = self.trainer_id
+        self._rpc(endpoint, MSG_SEND, meta, value.tobytes())
+
+    def send_barrier(self, endpoint):
+        """Blocks until the server has aggregated this round and run its
+        optimizer sub-blocks (RunSyncLoop's kRequestSend barrier)."""
+        self._rpc(endpoint, MSG_SEND_BARRIER,
+                  {"trainer_id": self.trainer_id})
+
+    def get_var(self, endpoint, name):
+        _, meta, payload = self._rpc(endpoint, MSG_GET,
+                                     {"name": name,
+                                      "trainer_id": self.trainer_id})
+        return _tensor_from(meta, payload)
+
+    def fetch_barrier(self, endpoint):
+        self._rpc(endpoint, MSG_FETCH_BARRIER,
+                  {"trainer_id": self.trainer_id})
+
+    def complete(self, endpoint):
+        """Notify the server this trainer is done (Executor.close parity,
+        executor.py:453): the server drops it from the barrier fanin and
+        exits once every trainer has completed."""
+        try:
+            self._rpc(endpoint, MSG_COMPLETE,
+                      {"trainer_id": self.trainer_id})
+        except (ConnectionError, OSError):
+            pass
+
+    def shutdown(self, endpoint):
+        try:
+            self._rpc(endpoint, MSG_SHUTDOWN, {})
+        except (ConnectionError, OSError):
+            pass
+
+    def close(self):
+        for s in self._socks.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._socks.clear()
+
+
+def shutdown_pservers(endpoints, trainer_id=0):
+    """Executor.close() parity (executor.py:453): notify pservers to exit."""
+    c = ParameterServerClient(trainer_id)
+    for ep in endpoints:
+        try:
+            c.shutdown(ep)
+        except (ConnectionError, OSError):
+            pass
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# server (listen_and_serv_op.cc RunSyncLoop / RunAsyncLoop parity)
+# ---------------------------------------------------------------------------
+
+
+class _ServerState:
+    def __init__(self, fanin, sync_mode, apply_update):
+        self.fanin = fanin
+        self.sync_mode = sync_mode
+        self.apply_update = apply_update  # fn(grad_means: {name: np}) -> None
+        self.cv = threading.Condition()
+        self.grads = {}          # name -> {trainer_id: array}
+        self.barrier_set = set()  # trainer ids that sent send_barrier
+        self.fetch_set = set()
+        self.completed = set()    # trainers done for good (MSG_COMPLETE)
+        self.round_id = 0
+        self.stopping = False
+
+    def live_fanin(self):
+        return max(1, self.fanin - len(self.completed))
+
+    def on_send(self, name, trainer_id, value):
+        if not self.sync_mode:
+            # async loop: apply each trainer's grad immediately, no
+            # barriers (RunAsyncLoop) — staleness is the contract
+            self.apply_update({name: value})
+            return
+        with self.cv:
+            self.grads.setdefault(name, {})[trainer_id] = value
+
+    def _maybe_fire_round(self):
+        """Holding cv: if every live trainer has hit the barrier,
+        aggregate (mean over trainers — the reference sums per-trainer
+        grad splits then the trainer graph pre-scales; with whole grads
+        the mean IS the local-equivalent gradient) and update."""
+        if len(self.barrier_set) < self.live_fanin():
+            return
+        means = {
+            name: (np.mean(list(per.values()), axis=0)
+                   if len(per) > 1 else next(iter(per.values())))
+            for name, per in self.grads.items()}
+        self.apply_update(means)
+        self.grads.clear()
+        self.barrier_set.clear()
+        self.round_id += 1
+        self.cv.notify_all()
+
+    def on_send_barrier(self, trainer_id):
+        """Returns True once the round's optimizer pass completed. A
+        timeout (lost peer with no MSG_COMPLETE) returns False so the
+        trainer gets MSG_ERR instead of silently training on stale
+        params."""
+        if not self.sync_mode:
+            return True
+        with self.cv:
+            my_round = self.round_id
+            self.barrier_set.add(trainer_id)
+            self._maybe_fire_round()
+            if self.round_id != my_round:
+                return True
+            return self.cv.wait_for(
+                lambda: self.round_id != my_round or self.stopping,
+                timeout=300.0)
+
+    def on_fetch_barrier(self, trainer_id):
+        if not self.sync_mode:
+            return
+        with self.cv:
+            self.fetch_set.add(trainer_id)
+            if len(self.fetch_set) >= self.live_fanin():
+                self.fetch_set.clear()
+
+    def on_complete(self, trainer_id):
+        """rpc_server.cc DecreaseClientNum parity. Returns True when every
+        trainer has completed (server should exit)."""
+        with self.cv:
+            self.completed.add(trainer_id)
+            # a waiting barrier may now be satisfiable with fewer peers
+            self._maybe_fire_round()
+            self.cv.notify_all()
+            return len(self.completed) >= self.fanin
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        server = self.server
+        while True:
+            try:
+                mtype, meta, payload = _read_msg(self.request)
+            except (ConnectionError, OSError):
+                return
+            try:
+                if mtype == MSG_SEND:
+                    server.state.on_send(meta["name"],
+                                         meta.get("trainer_id", 0),
+                                         _tensor_from(meta, payload))
+                    _write_msg(self.request, MSG_OK, {})
+                elif mtype == MSG_SEND_BARRIER:
+                    ok = server.state.on_send_barrier(
+                        meta.get("trainer_id", 0))
+                    if ok:
+                        _write_msg(self.request, MSG_OK, {})
+                    else:
+                        _write_msg(self.request, MSG_ERR, {
+                            "error": "send_barrier timed out waiting for "
+                                     "peer trainers (lost trainer with no "
+                                     "completion notify?)"})
+                elif mtype == MSG_GET:
+                    val = server.scope_get(meta["name"])
+                    m = _tensor_meta(meta["name"], val)
+                    _write_msg(self.request, MSG_VAR, m, val.tobytes())
+                elif mtype == MSG_FETCH_BARRIER:
+                    server.state.on_fetch_barrier(meta.get("trainer_id", 0))
+                    _write_msg(self.request, MSG_OK, {})
+                elif mtype == MSG_COMPLETE:
+                    all_done = server.state.on_complete(
+                        meta.get("trainer_id", 0))
+                    _write_msg(self.request, MSG_OK, {})
+                    if all_done:
+                        threading.Thread(target=server.shutdown,
+                                         daemon=True).start()
+                        with server.state.cv:
+                            server.state.stopping = True
+                            server.state.cv.notify_all()
+                        return
+                elif mtype == MSG_SHUTDOWN:
+                    _write_msg(self.request, MSG_OK, {})
+                    threading.Thread(target=server.shutdown,
+                                     daemon=True).start()
+                    with server.state.cv:
+                        server.state.stopping = True
+                        server.state.cv.notify_all()
+                    return
+                else:
+                    _write_msg(self.request, MSG_ERR,
+                               {"error": "bad msg type %d" % mtype})
+            except Exception as e:  # surface server-side errors to client
+                try:
+                    _write_msg(self.request, MSG_ERR, {"error": repr(e)})
+                except OSError:
+                    return
+
+
+class _PServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def run_pserver(program, scope, endpoint, executor_place=None):
+    """Execute a transpiled pserver program: serve until SHUTDOWN.
+
+    `program`'s global block must hold one listen_and_serv op; its
+    optimize sub-blocks run through the op registry against `scope`
+    (startup-program-initialized values). Called by Executor.run when it
+    meets a listen_and_serv op — the reference's blocking
+    ListenAndServOp::RunImpl."""
+    lsv = next(op for op in program.global_block().ops
+               if op.type == "listen_and_serv")
+    fanin = int(lsv.attrs.get("Fanin", 1))
+    sync_mode = bool(lsv.attrs.get("sync_mode", True))
+    opt_blocks = [program.blocks[i]
+                  for i in lsv.attrs.get("optimize_blocks", [])]
+
+    lock = threading.Lock()
+
+    def scope_np(name):
+        v = scope.get(name)
+        if v is None:
+            raise KeyError("pserver scope has no var %r (did the pserver "
+                           "startup program run?)" % name)
+        return np.asarray(v)
+
+    def apply_update(grad_values):
+        """Run every optimize sub-block whose Grad var just arrived."""
+        from .core.lowering import LoweringContext, execute_block
+        import jax
+
+        with lock:
+            for blk in opt_blocks:
+                op = blk.ops[0]
+                gname = op.inputs.get("Grad", [None])[0]
+                if gname is None or gname.name not in grad_values:
+                    continue
+                env = {}
+                for slot, vs in op.inputs.items():
+                    for v in vs:
+                        env[v.name] = (grad_values[v.name]
+                                       if v.name in grad_values
+                                       else scope_np(v.name))
+                ctx = LoweringContext(base_key=jax.random.PRNGKey(0))
+                execute_block(blk, env, ctx)
+                for slot, vs in op.outputs.items():
+                    for v in vs:
+                        if v.name in env:
+                            scope.set(v.name, np.asarray(env[v.name]))
+
+    host, port = endpoint.rsplit(":", 1)
+    srv = _PServer((host, int(port)), _Handler)
+    srv.state = _ServerState(fanin, sync_mode, apply_update)
+
+    def scope_get(name):
+        with lock:
+            return np.ascontiguousarray(scope_np(name))
+
+    srv.scope_get = scope_get
+    try:
+        srv.serve_forever(poll_interval=0.05)
+    finally:
+        srv.server_close()

@@ -60,8 +60,28 @@ class _CompiledStep:
                 v = block._find_var_recursive(name)
                 if v is not None and v.persistable:
                     state_out.add(name)
+        # pserver-mode RPC ops (transpiled trainer program) run host-side
+        # after the jitted step: send needs the step's grad values fetched
+        self._rpc_ops = [op for op in block.ops if op.type in
+                         ("send", "recv", "send_barrier", "fetch_barrier")]
+        self._rpc_client = None
+        self._rpc_endpoints = []
+        for op in self._rpc_ops:
+            for ep in [op.attrs.get("endpoint")] + list(
+                    op.attrs.get("endpoints", [])):
+                if ep and ep not in self._rpc_endpoints:
+                    self._rpc_endpoints.append(ep)
+        rpc_fetches = []
+        for op in self._rpc_ops:
+            if op.type == "send":
+                for v in op.inputs.get("X", []):
+                    if v.name not in rpc_fetches \
+                            and v.name not in self.fetch_names:
+                        rpc_fetches.append(v.name)
+        self._all_fetch_names = self.fetch_names + rpc_fetches
+
         # fetched persistables must also come from state
-        for name in self.fetch_names:
+        for name in self._all_fetch_names:
             v = block._find_var_recursive(name)
             if v is not None and v.persistable and name not in produced \
                     and name not in state_in:
@@ -90,7 +110,7 @@ class _CompiledStep:
             env.update(mut_state)
             env.update(feeds)
             execute_block(block, env, ctx)
-            fetches = [env[n] for n in self.fetch_names]
+            fetches = [env[n] for n in self._all_fetch_names]
             new_state = {n: env[n] for n in self.state_out if n in env}
             # FLAGS_check_nan_inf parity: one fused bool per op output;
             # labels are trace-static, flags come back as a packed array
@@ -158,7 +178,37 @@ class _CompiledStep:
         for name, val in new_state.items():
             scope.set(name, val)
         scope.set("__step_counter__", int(step_counter) + 1)
-        return fetches
+        if self._rpc_ops:
+            self._run_rpc_plan(scope, dict(zip(self._all_fetch_names,
+                                               fetches)))
+        return fetches[: len(self.fetch_names)]
+
+    def _run_rpc_plan(self, scope, fetched):
+        """Host-side pserver round (grpc_client.h parity): send grads,
+        barrier on the server's optimizer pass, pull fresh params into the
+        scope for the next step."""
+        from .distributed_runtime import ParameterServerClient
+
+        if self._rpc_client is None:
+            tid = next((op.attrs.get("trainer_id", 0)
+                        for op in self._rpc_ops), 0)
+            self._rpc_client = ParameterServerClient(trainer_id=tid or 0)
+        c = self._rpc_client
+        for op in self._rpc_ops:
+            a = op.attrs
+            if op.type == "send":
+                for v in op.inputs.get("X", []):
+                    c.send_var(a["endpoint"], v.name,
+                               np.asarray(fetched[v.name]))
+            elif op.type == "send_barrier":
+                for ep in a.get("endpoints", []):
+                    c.send_barrier(ep)
+            elif op.type == "recv":
+                for v in op.outputs.get("Out", []):
+                    scope.set(v.name, c.get_var(a["endpoint"], v.name))
+            elif op.type == "fetch_barrier":
+                for ep in a.get("endpoints", []):
+                    c.fetch_barrier(ep)
 
 
 class Executor:
@@ -169,6 +219,14 @@ class Executor:
         self._cache = {}
 
     def close(self):
+        """Notify pservers this trainer is done (executor.py:453 parity —
+        the server exits once every trainer completed), then drop caches."""
+        for compiled in self._cache.values():
+            client = getattr(compiled, "_rpc_client", None)
+            if client is not None:
+                for ep in getattr(compiled, "_rpc_endpoints", ()):
+                    client.complete(ep)
+                client.close()
         self._cache.clear()
 
     def run(
@@ -191,6 +249,16 @@ class Executor:
         feed = dict(feed or {})
         fetch_list = list(fetch_list or [])
         scope = scope if scope is not None else global_scope()
+
+        # a transpiled pserver program: block serving (the reference's
+        # ListenAndServOp::RunImpl never returns until shutdown)
+        lsv = next((op for op in program.global_block().ops
+                    if op.type == "listen_and_serv"), None)
+        if lsv is not None:
+            from .distributed_runtime import run_pserver
+
+            run_pserver(program, scope, lsv.attrs["endpoint"])
+            return []
 
         fetch_names = [
             v.name if isinstance(v, framework.Variable) else str(v)

@@ -144,11 +144,18 @@ class While:
         loop = layers.While(cond)
         with loop.block():
             ...                       # ops writing i / cond in place
-    Forward-only under XLA (lax.while_loop); use StaticRNN/DynamicRNN for
-    differentiable recurrences."""
 
-    def __init__(self, cond, is_test=False, name=None):
+    Pass `max_trip_count=N` (TPU-native extension) to make the loop
+    reverse-differentiable: it lowers to a lax.scan of N condition-masked
+    steps, so trainable compute inside the body gets gradients (parity
+    with while_op.cc:43's registered grad). Without it the loop is a
+    fully-dynamic lax.while_loop — forward-only, and append_backward
+    raises if a gradient is demanded through it."""
+
+    def __init__(self, cond, is_test=False, name=None,
+                 max_trip_count=None):
         self.cond_var = cond
+        self.max_trip_count = max_trip_count
         self.helper = LayerHelper("while", name=name)
 
     @contextlib.contextmanager
@@ -178,7 +185,8 @@ class While:
             outputs={"Out": [parent.var(n) for n in out_names]},
             attrs={"sub_block": blk, "x_names": x_names,
                    "out_names": out_names, "carry_names": carry_names,
-                   "cond_name": cond_name},
+                   "cond_name": cond_name,
+                   "max_trip_count": self.max_trip_count},
         )
 
 

@@ -93,19 +93,24 @@ def _env_of(ins, attrs):
     return dict(zip(attrs["x_names"], ins.get("X", [])))
 
 
-@register("while", differentiable=False, nondiff_inputs=("X", "Condition"))
+@register("while", nondiff_inputs=("Condition",))
 def _while(ctx, ins, attrs):
     """while_op.cc:43 — iterate sub_block until Condition goes false.
     Carried state = attr `carry_names` (sub-block writes that are
-    parent-visible, incl. the condition)."""
+    parent-visible, incl. the condition).
+
+    Two lowerings (SURVEY §7 hard-part "backward of While"):
+    - `max_trip_count` set: lax.scan over that static length, each step
+      masked by the live condition (lax.cond with an identity false
+      branch) — reverse-differentiable, so while_grad (the reference's
+      while_op.cc:43 grad maker) comes for free from the generic vjp.
+    - unset: lax.while_loop — fully dynamic trip count, forward-only
+      (append_backward raises a loud error rather than silently skipping)."""
     block = attrs["sub_block"]
     carry_names = list(attrs["carry_names"])
     env = _env_of(ins, attrs)
     env[attrs["cond_name"]] = ins["Condition"][0]
     cond_idx = carry_names.index(attrs["cond_name"])
-
-    def cond_fn(carry):
-        return jnp.reshape(carry[cond_idx], ()).astype(bool)
 
     def body_fn(carry):
         local = dict(env)
@@ -115,7 +120,20 @@ def _while(ctx, ins, attrs):
         return tuple(local[n] for n in carry_names)
 
     init = tuple(env[n] for n in carry_names)
-    final = jax.lax.while_loop(cond_fn, body_fn, init)
+    max_trip = attrs.get("max_trip_count")
+    if max_trip:
+        def scan_step(carry, _):
+            pred = jnp.reshape(carry[cond_idx], ()).astype(bool)
+            new = jax.lax.cond(pred, body_fn, lambda c: c, carry)
+            return new, None
+
+        final, _ = jax.lax.scan(scan_step, init, None,
+                                length=int(max_trip))
+    else:
+        def cond_fn(carry):
+            return jnp.reshape(carry[cond_idx], ()).astype(bool)
+
+        final = jax.lax.while_loop(cond_fn, body_fn, init)
     out_names = attrs["out_names"]
     final_env = dict(zip(carry_names, final))
     return {"Out": [final_env[n] for n in out_names]}

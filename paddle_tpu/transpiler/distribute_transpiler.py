@@ -247,15 +247,25 @@ class DistributeTranspiler:
         startup_program = startup_program or self.startup_program
         my_params = {pb.varname for pb in self.param_block_map
                      if pb.endpoint == endpoint}
+        # the server's optimize sub-blocks also read/write LR and
+        # accumulator vars (velocity, moments, beta pows) — their
+        # initializers must run on the pserver too
+        needed = set(my_params)
+        psprog = self.get_pserver_program(endpoint)
+        for blk in psprog.blocks[1:]:
+            for op in blk.ops:
+                for vs in list(op.inputs.values()) + list(
+                        op.outputs.values()):
+                    needed.update(v.name for v in vs)
         prog = framework.Program()
         gb = prog.global_block()
         for name in sorted(my_params):
             src = self.origin_program.global_block().var(name)
             self._mirror_var(prog, src)
-        # copy initializer ops whose outputs are this endpoint's params
+        # copy initializer ops whose outputs this endpoint needs
         for op in startup_program.global_block().ops:
             outs = op.output_names()
-            if outs and all(n in my_params for n in outs):
+            if outs and all(n in needed for n in outs):
                 gb.append_op(
                     type=op.type,
                     inputs={k: [self._mirror_var(prog, v) for v in vs]

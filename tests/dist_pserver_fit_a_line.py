@@ -1,0 +1,102 @@
+"""Runnable pserver-mode worker (parity: the reference's TestDistBase model
+scripts + env contract, test_dist_base.py:305-452 / test_fit_a_line.py:75-93).
+
+Roles via env:
+  PADDLE_TRAINING_ROLE = PSERVER | TRAINER | LOCAL
+  PADDLE_PSERVER_ENDPOINTS = ip:port,ip:port
+  PADDLE_CURRENT_ENDPOINT  = ip:port          (pserver only)
+  PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM
+
+Every role builds the identical program with the same seed, so the pserver
+initializes the same parameter values the trainers hold locally. Trainers
+print `loss:<v>` per step; the parent averages the two trainers'
+half-batch losses and compares against the LOCAL full-batch run.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as fluid  # noqa: E402
+
+SEED = 7
+STEPS = 8
+GLOBAL_BATCH = 32
+
+
+def build():
+    fluid.default_main_program().random_seed = SEED
+    fluid.default_startup_program().random_seed = SEED
+    x = fluid.layers.data(name="x", shape=[13])
+    y = fluid.layers.data(name="y", shape=[1])
+    pred = fluid.layers.fc(input=x, size=1,
+                           param_attr=fluid.ParamAttr(name="fc_w"),
+                           bias_attr=fluid.ParamAttr(name="fc_b"))
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    return loss
+
+
+def batches():
+    rng = np.random.RandomState(0)
+    w = np.arange(13, dtype=np.float32)[:, None] * 0.1
+    for _ in range(STEPS):
+        xb = rng.rand(GLOBAL_BATCH, 13).astype(np.float32)
+        yb = xb @ w + 0.5
+        yield xb, yb
+
+
+def main():
+    role = os.environ.get("PADDLE_TRAINING_ROLE", "LOCAL")
+    eplist = os.environ.get("PADDLE_PSERVER_ENDPOINTS", "")
+    trainers = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    tid = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+
+    loss = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    if role == "PSERVER":
+        cur = os.environ["PADDLE_CURRENT_ENDPOINT"]
+        t = fluid.DistributeTranspiler()
+        t.transpile(trainer_id=0, pservers=eplist, trainers=trainers,
+                    sync_mode=True)
+        psprog = t.get_pserver_program(cur)
+        psstartup = t.get_startup_program(cur, psprog)
+        psstartup.random_seed = SEED
+        exe.run(psstartup)
+        print("pserver_ready", flush=True)
+        exe.run(psprog)  # serves until SHUTDOWN
+        return
+
+    if role == "TRAINER":
+        t = fluid.DistributeTranspiler()
+        t.transpile(trainer_id=tid, pservers=eplist, trainers=trainers,
+                    sync_mode=True)
+        prog = t.get_trainer_program()
+        exe.run(fluid.default_startup_program())
+        shard = GLOBAL_BATCH // trainers
+        for xb, yb in batches():
+            xs = xb[tid * shard:(tid + 1) * shard]
+            ys = yb[tid * shard:(tid + 1) * shard]
+            l, = exe.run(prog, feed={"x": xs, "y": ys}, fetch_list=[loss])
+            print("loss:%.8f" % float(np.asarray(l).ravel()[0]),
+                  flush=True)
+        exe.close()
+        return
+
+    # LOCAL baseline: full batch, plain minimize
+    exe.run(fluid.default_startup_program())
+    for xb, yb in batches():
+        l, = exe.run(feed={"x": xb, "y": yb}, fetch_list=[loss])
+        print("loss:%.8f" % float(np.asarray(l).ravel()[0]), flush=True)
+
+
+if __name__ == "__main__":
+    main()
