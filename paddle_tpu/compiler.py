@@ -18,7 +18,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import framework
-from .core.lowering import (LoweringContext, execute_block, pack_nan_reports,
+from .core.lowering import (LoweringContext, execute_block,
+                            pack_nan_reports, pack_warn_reports,
                             raise_if_nonfinite)
 from .framework import dtype_to_np
 
@@ -176,6 +177,8 @@ class _DataParallelStep:
 
         self._check_nan_inf = bool(flag("check_nan_inf"))
         self._nan_labels = []
+        self._warn_labels = []
+        self._warned = set()
 
         def step(mut_state, const_state, feeds, step_counter):
             base_key = jax.random.fold_in(
@@ -190,7 +193,8 @@ class _DataParallelStep:
             fetches = [env[n] for n in self.fetch_names]
             new_state = {n: env[n] for n in self.state_out if n in env}
             self._nan_labels, finite = pack_nan_reports(ctx)
-            return fetches, new_state, finite
+            self._warn_labels, warns = pack_warn_reports(ctx)
+            return fetches, new_state, finite, warns
 
         # params/state replicated; feeds sharded on batch dim. XLA sharding
         # propagation turns the param-grad reductions into ICI all-reduces.
@@ -201,7 +205,7 @@ class _DataParallelStep:
             step,
             donate_argnums=donate,
             in_shardings=(repl, repl, batch, None),
-            out_shardings=(repl, repl, repl),
+            out_shardings=(repl, repl, repl, repl),
         )
 
     def run(self, scope, feed):
@@ -244,7 +248,16 @@ class _DataParallelStep:
                     store[name] = jax.make_array_from_callback(
                         v.shape, self._repl, lambda idx, a=v: a[idx])
         ctr = np.uint32(scope.get("__step_counter__", 0) or 0)
-        fetches, new_state, finite = self._jitted(mut, const, feeds, ctr)
+        fetches, new_state, finite, warns = self._jitted(mut, const,
+                                                         feeds, ctr)
+        if self._warn_labels and warns.size:
+            import warnings
+
+            for label, flagged in zip(self._warn_labels,
+                                      np.asarray(warns)):
+                if flagged and label not in self._warned:
+                    self._warned.add(label)
+                    warnings.warn(label, RuntimeWarning)
         if self._check_nan_inf and finite.size:
             # state was NOT donated under the debug flag: raising here leaves
             # the scope at its pre-step values, so the poisoned update is

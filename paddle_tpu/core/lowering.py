@@ -41,6 +41,11 @@ class LoweringContext:
         # raises host-side naming the first offending op/var
         self.check_nan_inf = check_nan_inf
         self.nan_reports = []   # list of (label, bool scalar tracer)
+        # always-on runtime warnings: (message, bool tracer) where True
+        # means "warn" — e.g. a While whose max_trip_count truncated the
+        # loop with the condition still live. Packed alongside fetches;
+        # the executor warns host-side (once per site).
+        self.warn_reports = []
         self._nan_suppress = 0
         # forward input values per op, captured at forward-execution time.
         # Grad ops recompute their forward under jax.vjp; reading inputs
@@ -129,6 +134,14 @@ def _nan_check(ctx, label, val):
         return
     if jnp.issubdtype(dt, jnp.inexact):
         ctx.nan_reports.append((label, jnp.isfinite(val).all()))
+
+
+def pack_warn_reports(ctx):
+    """(static labels, packed bool tracer) for runtime warnings."""
+    labels = [label for label, _ in ctx.warn_reports]
+    flags = (jnp.stack([f for _, f in ctx.warn_reports])
+             if ctx.warn_reports else jnp.zeros((0,), bool))
+    return labels, flags
 
 
 def pack_nan_reports(ctx):

@@ -273,12 +273,11 @@ class RecordIOScanner:
             self._s = None
 
 
-def parse_multislot_file(path, slot_types):
-    """Parse a MultiSlot text file with the C++ feed parser (data_feed.cc
-    MultiSlotDataFeed parity). slot_types: list of "int64"/"uint64" or
-    "float". Returns (records, bad_lines) where records is a list of
-    per-record tuples of np arrays (one per slot). Falls back to a pure-
-    Python parser when the native library is unavailable."""
+def parse_multislot_columns(path, slot_types):
+    """Columnar MultiSlot parse (data_feed.cc MultiSlotDataFeed parity):
+    returns (slots, n_rec, bad_lines) where slots is a list of
+    (values [total], offsets [n_rec+1]) per slot — NO per-record python
+    objects, so batching stays vectorized numpy end to end."""
     import numpy as np
 
     type_codes = [0 if str(t).startswith(("int", "uint")) else 1
@@ -286,7 +285,17 @@ def parse_multislot_file(path, slot_types):
     n_slots = len(type_codes)
     l = lib()
     if l is None:
-        return _parse_multislot_py(path, type_codes)
+        records, bad = _parse_multislot_py(path, type_codes)
+        slots = []
+        for s in range(n_slots):
+            per = [np.asarray(r[s]).reshape(-1) for r in records]
+            offs = np.zeros(len(records) + 1, np.int64)
+            np.cumsum([p.shape[0] for p in per], out=offs[1:])
+            vals = (np.concatenate(per) if per
+                    else np.zeros(0, np.int64 if type_codes[s] == 0
+                                  else np.float32))
+            slots.append((vals, offs))
+        return slots, len(records), bad
 
     arr = (ctypes.c_int * n_slots)(*type_codes)
     h = l.ptpu_mslot_parse_file(path.encode(), n_slots, arr)
@@ -310,13 +319,23 @@ def parse_multislot_file(path, slot_types):
                 l.ptpu_mslot_copy_float(h, s, vals.ctypes.data_as(
                     ctypes.c_void_p))
             slots.append((vals, offs))
-        records = []
-        for r in range(n_rec):
-            records.append(tuple(
-                vals[offs[r]:offs[r + 1]] for vals, offs in slots))
-        return records, int(bad)
+        return slots, n_rec, int(bad)
     finally:
         l.ptpu_mslot_free(h)
+
+
+def parse_multislot_file(path, slot_types):
+    """Parse a MultiSlot text file with the C++ feed parser (data_feed.cc
+    MultiSlotDataFeed parity). slot_types: list of "int64"/"uint64" or
+    "float". Returns (records, bad_lines) where records is a list of
+    per-record tuples of np arrays (one per slot). Falls back to a pure-
+    Python parser when the native library is unavailable."""
+    slots, n_rec, bad = parse_multislot_columns(path, slot_types)
+    records = []
+    for r in range(n_rec):
+        records.append(tuple(
+            vals[offs[r]:offs[r + 1]] for vals, offs in slots))
+    return records, int(bad)
 
 
 def _parse_multislot_py(path, type_codes):

@@ -3,8 +3,10 @@
 python dataset.py DatasetFactory/InMemoryDataset/QueueDataset).
 
 TPU-native: file lists hold recordio shards (native/recordio.cc). The
-Hogwild thread-per-core consumption model (C15) collapses into the single
-jitted step fed batch-by-batch — `Executor.train_from_dataset` drives it.
+Hogwild thread-per-core consumption model (C15) becomes a reader thread
+pool over the file shards (`set_thread`) feeding the single jitted step —
+host parsing overlaps device compute; `Executor.train_from_dataset`
+drives it, and FLAGS_cpu_deterministic pins emission to filelist order.
 GlobalShuffle's cross-node RPC exchange becomes a deterministic
 shard-reassignment by hash (same sample redistribution capability, no RPC:
 every worker reads the shards whose hash maps to it).
@@ -48,7 +50,10 @@ class DatasetBase:
     def set_use_var(self, var_list):
         self._use_var = list(var_list)
 
-    def _sample_reader(self):
+    def _file_samples(self, path):
+        """Parse ONE shard file into its sample list — the unit of work a
+        Hogwild-style reader thread owns (device_worker.h:135: each
+        worker consumes its own DataFeed shard)."""
         if self._feed_desc is not None:
             from .core import native
 
@@ -72,21 +77,204 @@ class DatasetBase:
                     return v
                 return fold_ids(v, mod)
 
-            def reader():
-                for path in self._filelist:
-                    records, bad = native.parse_multislot_file(path, types)
-                    if bad:
-                        import logging
+            records, bad = native.parse_multislot_file(path, types)
+            if bad:
+                import logging
 
-                        logging.warning(
-                            "MultiSlot file %s: skipped %d malformed "
+                logging.warning("MultiSlot file %s: skipped %d malformed "
+                                "line(s)", path, bad)
+            if used == list(range(len(types))) and not any(
+                    m is not None for m in mods):
+                return records  # all slots used verbatim: no rebuild
+            return [tuple(fold(rec[i], m) for i, m in zip(used, mods))
+                    for rec in records]
+        reader = recordio_writer.recordio_reader_creator([path])
+        return list(reader())
+
+    def _sample_reader(self):
+        def reader():
+            for path in self._filelist:
+                yield from self._file_samples(path)
+
+        return reader
+
+    def _pool_map(self, fn):
+        """Thread-pool over file shards (C15 Hogwild parity, TPU-native
+        reading: worker threads parse on the host while the single jitted
+        step owns the device). Submission is WINDOWED — at most
+        n_workers+2 shards outstanding — so a streaming dataset never
+        buffers the whole filelist in RAM. FLAGS_cpu_deterministic keeps
+        emission in filelist order so losses reproduce the serial run
+        exactly; off = completion order for max overlap."""
+        from concurrent.futures import (FIRST_COMPLETED,
+                                        ThreadPoolExecutor, wait)
+
+        from .flags import flag
+
+        n = max(1, min(self._thread, len(self._filelist)))
+        window = n + 2
+        deterministic = flag("cpu_deterministic")
+        with ThreadPoolExecutor(max_workers=n) as ex:
+            it = iter(self._filelist)
+            pending = []
+            for path in it:
+                pending.append(ex.submit(fn, path))
+                if len(pending) >= window:
+                    break
+            while pending:
+                if deterministic:
+                    done = pending.pop(0)  # filelist order
+                else:
+                    wait(pending, return_when=FIRST_COMPLETED)
+                    done = next(f for f in pending if f.done())
+                    pending.remove(done)
+                result = done.result()
+                nxt = next(it, None)
+                if nxt is not None:
+                    pending.append(ex.submit(fn, nxt))
+                yield result
+
+    def _iter_samples_threaded(self):
+        for samples in self._pool_map(self._file_samples):
+            yield from samples
+
+    def _file_columns(self, path):
+        """Columnar parse of one shard: ((vals, offs) per USED slot,
+        n_rec) with set_hash_mod folds applied vectorized over the whole
+        value column — no per-record python objects anywhere."""
+        from .core import native
+        from .parallel.host_embedding import fold_ids
+
+        desc = self._feed_desc
+        types = [s["type"] for s in desc.slots]
+        used = [i for i, s in enumerate(desc.slots)
+                if s.get("is_used", True)]
+        mods = [desc.slots[i].get("hash_mod") for i in used]
+        slots, n_rec, bad = native.parse_multislot_columns(path, types)
+        if bad:
+            import logging
+
+            logging.warning("MultiSlot file %s: skipped %d malformed "
                             "line(s)", path, bad)
-                    for rec in records:
-                        yield tuple(fold(rec[i], m)
-                                    for i, m in zip(used, mods))
+        out = []
+        for i, m in zip(used, mods):
+            vals, offs = slots[i]
+            if m is not None:
+                vals = fold_ids(vals, m)
+            out.append((vals, offs))
+        return out, n_rec
 
-            return reader
-        return recordio_writer.recordio_reader_creator(self._filelist)
+    def _iter_file_columns(self):
+        if self._thread > 1 and len(self._filelist) > 1:
+            yield from self._pool_map(self._file_columns)
+        else:
+            for path in self._filelist:
+                yield self._file_columns(path)
+
+    @staticmethod
+    def _concat_columns(a, b):
+        """Append column block b after a (batching crosses file
+        boundaries, like the serial record stream)."""
+        (sa, na), (sb, nb) = a, b
+        merged = []
+        for (va, oa), (vb, ob) in zip(sa, sb):
+            merged.append((np.concatenate([va, vb]),
+                           np.concatenate([oa, oa[-1] + ob[1:]])))
+        return merged, na + nb
+
+    def _emit_columnar(self, slots, r0, r1, feed_names, pads):
+        feed = {}
+        n = r1 - r0
+        for i, (name, (vals, offs)) in enumerate(zip(feed_names, slots)):
+            lens = offs[r0 + 1:r1 + 1] - offs[r0:r1]
+            seg = vals[offs[r0]:offs[r1]]
+            lmax = int(lens.max()) if n else 0
+            if n and int(lens.min()) == lmax:
+                arr = seg.reshape(n, lmax)
+            else:
+                pad = 0
+                if pads is not None and i < len(pads):
+                    pad = pads[i]
+                arr = np.full((n, lmax), pad, seg.dtype)
+                arr[np.arange(lmax)[None, :] < lens[:, None]] = seg
+            feed[name] = arr
+        return feed
+
+    def _batches_columnar(self):
+        """Vectorized batcher over columnar shards: numpy slicing and a
+        mask-scatter pad replace the reference's per-record DataFeed loop
+        (data_feed.cc AddInstanceToInsVec) — host cost is O(bytes), not
+        O(records) of python objects."""
+        feed_names = [v.name for v in self._use_var]
+        pads = self._pad_values()
+        bs = self._batch_size
+        acc = None
+        for block in self._iter_file_columns():
+            acc = block if acc is None else self._concat_columns(acc,
+                                                                 block)
+            slots, n = acc
+            r = 0
+            while n - r >= bs:
+                yield self._emit_columnar(slots, r, r + bs, feed_names,
+                                          pads)
+                r += bs
+            if r:
+                slots = [(v[o[r]:o[-1]], o[r:] - o[r]) for v, o in slots]
+                acc = (slots, n - r)
+        if acc is not None and acc[1]:
+            yield self._emit_columnar(acc[0], 0, acc[1], feed_names, pads)
+
+    def _batches_prefetched(self, depth=4):
+        """Producer-thread batch prefetch: host parsing/batching overlaps
+        the device step (the BufferedReader/double-buffer shape, C17)."""
+        import queue
+        import threading
+
+        q = queue.Queue(maxsize=depth)
+        sentinel = object()
+        stop = threading.Event()
+        err = []
+
+        def produce():
+            try:
+                for b in self._batches():
+                    # bounded put that notices an abandoned consumer, so
+                    # a mid-epoch exception in the training loop doesn't
+                    # leave this thread blocked forever holding batches
+                    while not stop.is_set():
+                        try:
+                            q.put(b, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+            except BaseException as e:
+                err.append(e)
+            finally:
+                # the sentinel must LAND (a dropped one strands the
+                # consumer on q.get forever); keep trying unless the
+                # consumer already abandoned us
+                while not stop.is_set():
+                    try:
+                        q.put(sentinel, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        try:
+            while True:
+                b = q.get()
+                if b is sentinel:
+                    break
+                yield b
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        if err:
+            raise err[0]
 
     def _pad_values(self):
         """Per-used-slot batch pad value (positional, matching the order
@@ -100,6 +288,11 @@ class DatasetBase:
                 if s.get("is_used", True)]
 
     def _batches(self):
+        # streaming desc-driven datasets batch columnar (InMemoryDataset
+        # keeps the per-record path: shuffle permutes record objects)
+        if self._feed_desc is not None and not hasattr(self, "_samples"):
+            yield from self._batches_columnar()
+            return
         feed_names = [v.name for v in self._use_var]
         pads = self._pad_values()
         batch = []
@@ -141,9 +334,12 @@ class DatasetBase:
 
 class QueueDataset(DatasetBase):
     """Streaming dataset: shards are read on the fly (data_set.h
-    QueueDataset — no in-memory shuffle)."""
+    QueueDataset — no in-memory shuffle); `set_thread(N)` parses shards
+    on N reader threads."""
 
     def _iter_samples(self):
+        if self._thread > 1 and len(self._filelist) > 1:
+            return self._iter_samples_threaded()
         return self._sample_reader()()
 
 
@@ -157,7 +353,10 @@ class InMemoryDataset(DatasetBase):
         self._world = 1
 
     def load_into_memory(self):
-        self._samples = list(self._sample_reader()())
+        if self._thread > 1 and len(self._filelist) > 1:
+            self._samples = list(self._iter_samples_threaded())
+        else:
+            self._samples = list(self._sample_reader()())
 
     def local_shuffle(self, seed=None):
         assert self._samples is not None, "call load_into_memory first"

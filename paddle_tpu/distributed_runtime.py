@@ -105,9 +105,15 @@ class ParameterServerClient:
             self._socks[endpoint] = s
         return s
 
-    def _rpc(self, endpoint, mtype, meta, payload=b""):
+    # the server tolerates stragglers for up to 300 s before failing a
+    # sync barrier (_ServerState.on_send_barrier); the client must wait
+    # longer than that so the grace period actually applies
+    BARRIER_TIMEOUT = 330.0
+
+    def _rpc(self, endpoint, mtype, meta, payload=b"", timeout=None):
         with self._lock:
             s = self._sock(endpoint)
+            s.settimeout(timeout if timeout is not None else self.timeout)
             _write_msg(s, mtype, meta, payload)
             rtype, rmeta, rpayload = _read_msg(s)
         if rtype == MSG_ERR:
@@ -125,7 +131,8 @@ class ParameterServerClient:
         """Blocks until the server has aggregated this round and run its
         optimizer sub-blocks (RunSyncLoop's kRequestSend barrier)."""
         self._rpc(endpoint, MSG_SEND_BARRIER,
-                  {"trainer_id": self.trainer_id})
+                  {"trainer_id": self.trainer_id},
+                  timeout=self.BARRIER_TIMEOUT)
 
     def get_var(self, endpoint, name):
         _, meta, payload = self._rpc(endpoint, MSG_GET,
@@ -346,28 +353,40 @@ def run_pserver(program, scope, endpoint, executor_place=None):
         return np.asarray(v)
 
     def apply_update(grad_values):
-        """Run every optimize sub-block whose Grad var just arrived."""
+        """Run every optimize sub-block whose Grad var just arrived. A
+        block may hold several ops (lr decay, clip, regularizer + the
+        optimizer, as the reference emits) — env is seeded from EVERY
+        op's inputs and every op's outputs persist back to the scope."""
         from .core.lowering import LoweringContext, execute_block
         import jax
 
         with lock:
             for blk in opt_blocks:
-                op = blk.ops[0]
-                gname = op.inputs.get("Grad", [None])[0]
-                if gname is None or gname.name not in grad_values:
+                grads_in_block = {
+                    v.name
+                    for op in blk.ops
+                    for v in op.inputs.get("Grad", [])}
+                if not grads_in_block & set(grad_values):
                     continue
                 env = {}
-                for slot, vs in op.inputs.items():
-                    for v in vs:
-                        env[v.name] = (grad_values[v.name]
-                                       if v.name in grad_values
-                                       else scope_np(v.name))
+                produced = set()
+                for op in blk.ops:
+                    for vs in op.inputs.values():
+                        for v in vs:
+                            if v.name in env or v.name in produced:
+                                continue
+                            env[v.name] = (grad_values[v.name]
+                                           if v.name in grad_values
+                                           else scope_np(v.name))
+                    for vs in op.outputs.values():
+                        produced.update(v.name for v in vs)
                 ctx = LoweringContext(base_key=jax.random.PRNGKey(0))
                 execute_block(blk, env, ctx)
-                for slot, vs in op.outputs.items():
-                    for v in vs:
-                        if v.name in env:
-                            scope.set(v.name, np.asarray(env[v.name]))
+                for op in blk.ops:
+                    for vs in op.outputs.values():
+                        for v in vs:
+                            if v.name in env:
+                                scope.set(v.name, np.asarray(env[v.name]))
 
     host, port = endpoint.rsplit(":", 1)
     srv = _PServer((host, int(port)), _Handler)

@@ -17,7 +17,8 @@ import jax
 import jax.numpy as jnp
 
 from . import framework
-from .core.lowering import (LoweringContext, execute_block, pack_nan_reports,
+from .core.lowering import (LoweringContext, execute_block,
+                            pack_nan_reports, pack_warn_reports,
                             raise_if_nonfinite)
 from .core.place import CPUPlace, TPUPlace, default_place
 from .core.scope import Scope, global_scope, scope_guard  # noqa: F401
@@ -98,6 +99,8 @@ class _CompiledStep:
 
         self._check_nan_inf = bool(flag("check_nan_inf"))
         self._nan_labels = []
+        self._warn_labels = []
+        self._warned = set()
 
         def step(mut_state, const_state, feeds, step_counter):
             base_key = jax.random.fold_in(
@@ -115,7 +118,8 @@ class _CompiledStep:
             # FLAGS_check_nan_inf parity: one fused bool per op output;
             # labels are trace-static, flags come back as a packed array
             self._nan_labels, finite = pack_nan_reports(ctx)
-            return fetches, new_state, finite
+            self._warn_labels, warns = pack_warn_reports(ctx)
+            return fetches, new_state, finite, warns
 
         # under the debug flag, keep state undonated so a nan raise can
         # leave the scope at its pre-step values (catch-and-continue safe)
@@ -168,8 +172,16 @@ class _CompiledStep:
                     "pull/push)" % name)
             feeds[name] = arr
         step_counter = np.uint32(scope.get("__step_counter__", 0) or 0)
-        fetches, new_state, finite = self._jitted(
+        fetches, new_state, finite, warns = self._jitted(
             mut, const, feeds, step_counter)
+        if self._warn_labels and warns.size:
+            import warnings
+
+            for label, flagged in zip(self._warn_labels,
+                                      np.asarray(warns)):
+                if flagged and label not in self._warned:
+                    self._warned.add(label)
+                    warnings.warn(label, RuntimeWarning)
         if self._check_nan_inf and finite.size:
             # state was NOT donated under the debug flag: raising here leaves
             # the scope at its pre-step values, so the poisoned update is
@@ -290,17 +302,24 @@ class Executor:
                            fetch_info=None, print_period=100):
         """Drive a whole Dataset through the program (parity: executor.py:851
         → C++ MultiTrainer/HogwildWorker trainer.h:71/C15). The reference's
-        thread-per-core Hogwild collapses into the single jitted step: the
-        dataset iterator feeds batches, XLA owns the parallelism."""
+        thread-per-core Hogwild becomes a reader thread pool over file
+        shards (thread= here or dataset.set_thread) parsing on the host
+        while the single jitted step owns the device;
+        FLAGS_cpu_deterministic serializes emission to filelist order."""
         if dataset is None:
             raise ValueError("train_from_dataset needs a dataset")
+        if thread:
+            dataset.set_thread(thread)
         program = program or framework.default_main_program()
         fetch_list = list(fetch_list or [])
         fetch_names = [v.name if isinstance(v, framework.Variable) else str(v)
                        for v in fetch_list]
         step = 0
         last = None
-        for feed in dataset._batches():
+        batches = (dataset._batches_prefetched()
+                   if getattr(dataset, "_thread", 1) > 1
+                   else dataset._batches())
+        for feed in batches:
             last = self.run(program, feed=feed, fetch_list=fetch_list,
                             scope=scope)
             step += 1

@@ -129,6 +129,14 @@ def _while(ctx, ins, attrs):
 
         final, _ = jax.lax.scan(scan_step, init, None,
                                 length=int(max_trip))
+        if not ctx._nan_suppress:
+            # condition still live after N masked steps = the loop was
+            # TRUNCATED (the dynamic while_loop would have kept going);
+            # surface it instead of silently returning early carries
+            ctx.warn_reports.append((
+                "While loop truncated: condition still true after "
+                "max_trip_count=%d steps" % int(max_trip),
+                jnp.reshape(final[cond_idx], ()).astype(bool)))
     else:
         def cond_fn(carry):
             return jnp.reshape(carry[cond_idx], ()).astype(bool)

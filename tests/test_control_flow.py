@@ -318,3 +318,26 @@ def test_while_carry_produced_by_trainable_ops_no_double_count():
     g, = exe.run(feed={"x": xd}, fetch_list=[grads[0]])
     np.testing.assert_allclose(np.asarray(g).reshape(-1),
                                0.125 * xd.reshape(-1), rtol=1e-5)
+
+
+def test_while_truncation_warns():
+    """A While whose condition is still live after max_trip_count steps
+    warns instead of silently returning early carries."""
+    import warnings
+
+    i = layers.fill_constant(shape=[1], dtype="int64", value=0)
+    n = layers.fill_constant(shape=[1], dtype="int64", value=10)
+    acc = layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+    cond = layers.less_than(x=i, y=n)
+    loop = layers.While(cond=cond, max_trip_count=3)  # needs 10
+    with loop.block():
+        layers.increment(x=acc, value=1.0, in_place=True)
+        layers.increment(x=i, value=1, in_place=True)
+        layers.less_than(x=i, y=n, cond=cond)
+    exe = _exe()
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        acc_v, = exe.run(feed={}, fetch_list=[acc])
+    assert float(np.asarray(acc_v)[0]) == 3.0  # truncated at 3
+    assert any("truncated" in str(w.message) for w in caught), [
+        str(w.message) for w in caught]
