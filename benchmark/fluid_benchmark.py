@@ -33,7 +33,7 @@ def parse_args():
     p.add_argument("--model", default="mnist",
                    choices=["mnist", "resnet", "vgg", "stacked_dynamic_lstm",
                             "machine_translation", "deepfm", "se_resnext",
-                            "transformer"])
+                            "transformer", "transformer_native"])
     p.add_argument("--batch_size", type=int, default=None,
                    help="per-step global batch (model default if unset)")
     p.add_argument("--iterations", type=int, default=30)
@@ -46,6 +46,13 @@ def parse_args():
                    choices=["local", "spmd", "nccl2"],
                    help="nccl2 is accepted as an alias of spmd")
     p.add_argument("--learning_rate", type=float, default=0.01)
+    p.add_argument("--use_amp", action="store_true",
+                   help="wrap the optimizer in contrib.mixed_precision."
+                        "decorate (bf16 white-list ops)")
+    p.add_argument("--data_set", default=None,
+                   choices=[None, "cifar10", "imagenet", "flowers"],
+                   help="resnet/vgg dataset variant (imagenet = 224x224, "
+                        "1000 classes; reference --data_set arg)")
     p.add_argument("--profile", action="store_true",
                    help="wrap the loop in the paddle_tpu profiler and dump "
                         "a chrome trace next to the run")
@@ -61,13 +68,17 @@ _DEFAULT_BATCH = {
 }
 
 
-def _feeds(model, batch, rng):
+def _feeds(model, batch, rng, data_set=None):
     """Synthetic reference-shaped batches (the reference harness reads the
     real corpora; dataset modules here are synthetic for zero egress)."""
     if model == "mnist":
         return {"img": rng.rand(batch, 784).astype(np.float32),
                 "label": rng.randint(0, 10, (batch, 1)).astype(np.int64)}
     if model in ("resnet", "vgg", "se_resnext"):
+        if data_set in ("imagenet", "flowers"):
+            return {"img": rng.rand(batch, 3, 224, 224).astype(np.float32),
+                    "label": rng.randint(0, 1000,
+                                         (batch, 1)).astype(np.int64)}
         return {"img": rng.rand(batch, 3, 32, 32).astype(np.float32),
                 "label": rng.randint(0, 10, (batch, 1)).astype(np.int64)}
     if model == "stacked_dynamic_lstm":
@@ -87,13 +98,15 @@ def _feeds(model, batch, rng):
     raise ValueError(model)
 
 
-def _build(model):
+def _build(model, data_set=None):
     from paddle_tpu import models
 
     if model == "mnist":
         *_, loss, _acc = models.mnist.build(arch="mlp")
     elif model == "resnet":
-        *_, loss, _acc = models.resnet.build(dataset="cifar10")
+        *_, loss, _acc = models.resnet.build(
+            dataset="imagenet" if data_set in ("imagenet", "flowers")
+            else "cifar10")
     elif model == "vgg":
         *_, loss, _acc = models.vgg.build(dataset="cifar10")
     elif model == "stacked_dynamic_lstm":
@@ -120,17 +133,69 @@ def print_train_time(start_time, end_time, num_samples, n_chips=1):
     return examples_per_sec
 
 
-def run_transformer(args):
-    """tokens/sec path on the flagship model (BASELINE.json config 3)."""
+def run_transformer_native(args):
+    """tokens/sec on the bespoke jax flagship (BASELINE.json config 3)."""
     import bench
 
     tokens_per_sec, last_loss = bench.bench_transformer(
         steps=args.iterations, warmup=args.skip_batch_num,
-        batch=args.batch_size or _DEFAULT_BATCH["transformer"])
-    print("\nTransformer-base: %.1f tokens/sec/chip (last loss %.4f)\n"
-          % (tokens_per_sec, last_loss))
-    return {"metric": "%s_tokens_per_sec_per_chip" % args.model,
+        batch=args.batch_size or 128)
+    print("\nTransformer-base (native): %.1f tokens/sec/chip "
+          "(last loss %.4f)\n" % (tokens_per_sec, last_loss))
+    return {"metric": "transformer_native_tokens_per_sec_per_chip",
             "value": round(tokens_per_sec, 1), "unit": "tokens/s/chip"}
+
+
+def run_transformer(args, seq_len=512):
+    """Flagship-scale transformer built ENTIRELY from fluid.layers through
+    the descriptor lowering (models/transformer_fluid.py) with the TPU
+    knobs on: AMP bf16 (contrib.mixed_precision), per-layer remat
+    (layers.recompute), flash attention, device-resident feeds, bounded
+    fetch cadence. The API-user path at native-path speed."""
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.models import transformer_fluid
+
+    batch = args.batch_size or 128
+    prog, sprog = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, sprog):
+        _toks, _labs, loss = transformer_fluid.build(
+            seq_len=seq_len, remat=True, dtype="bfloat16")
+        opt = fluid.contrib.mixed_precision.decorate(
+            fluid.optimizer.SGD(args.learning_rate),
+            init_loss_scaling=1.0, use_dynamic_loss_scaling=False)
+        opt.minimize(loss)
+    exe = fluid.Executor(fluid.TPUPlace() if args.device != "CPU"
+                         else fluid.CPUPlace())
+    exe.run(sprog)
+
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, 32000, (batch, seq_len)).astype(np.int32)
+    labs = np.roll(toks, -1, axis=1).astype(np.int32)
+    # device-resident feeds: host->device once, not per step
+    feed = {"tokens": jax.device_put(toks), "labels": jax.device_put(labs)}
+
+    SYNC_EVERY = 4
+    out = None
+    for _ in range(args.skip_batch_num):
+        out, = exe.run(prog, feed=feed, fetch_list=[loss],
+                       return_numpy=False)
+        float(np.asarray(out).ravel()[0])
+    t0 = time.perf_counter()
+    for i in range(args.iterations):
+        out, = exe.run(prog, feed=feed, fetch_list=[loss],
+                       return_numpy=False)
+        if (i + 1) % SYNC_EVERY == 0:
+            float(np.asarray(out).ravel()[0])
+    last = float(np.asarray(out).ravel()[0])
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = args.iterations * batch * seq_len / dt
+    print("\nTransformer-base (fluid.layers API): %.1f tokens/sec/chip "
+          "(last loss %.4f)\n" % (tokens_per_sec, last))
+    return {"metric": "transformer_fluid_api_tokens_per_sec_per_chip",
+            "value": round(tokens_per_sec, 1), "unit": "tokens/s/chip",
+            "last_loss": round(last, 4)}
 
 
 def run_static_model(args):
@@ -142,8 +207,12 @@ def run_static_model(args):
         jax.config.update("jax_platforms", "cpu")
 
     batch = args.batch_size or _DEFAULT_BATCH[args.model]
-    loss = _build(args.model)
-    fluid.optimizer.Adam(args.learning_rate).minimize(loss)
+    loss = _build(args.model, args.data_set)
+    opt = fluid.optimizer.Adam(args.learning_rate)
+    if args.use_amp:
+        opt = fluid.contrib.mixed_precision.decorate(
+            opt, init_loss_scaling=1.0, use_dynamic_loss_scaling=False)
+    opt.minimize(loss)
     exe = fluid.Executor(fluid.CPUPlace() if args.device == "CPU"
                          else fluid.TPUPlace())
     exe.run(fluid.default_startup_program())
@@ -156,7 +225,12 @@ def run_static_model(args):
         runner = pe
 
     rng = np.random.RandomState(0)
-    feed = _feeds(args.model, batch, rng)
+    feed = _feeds(args.model, batch, rng, args.data_set)
+    if args.device != "CPU":
+        # stage once: device-resident feeds skip the per-step host link
+        import jax
+
+        feed = {k: jax.device_put(v) for k, v in feed.items()}
 
     prof_ctx = None
     if args.profile:
@@ -196,6 +270,8 @@ def main():
     args = parse_args()
     if args.model == "transformer":
         rec = run_transformer(args)
+    elif args.model == "transformer_native":
+        rec = run_transformer_native(args)
     else:
         rec = run_static_model(args)
     if args.json:

@@ -5,14 +5,28 @@ from . import mixed_precision
 from . import quantize
 from . import slim
 from . import decoder
+from . import extend_optimizer
+from . import reader
+from . import utils
 from .memory_usage_calc import memory_usage
-from .decoder import BeamSearchDecoder, StateCell, TrainingDecoder
+from .decoder import BeamSearchDecoder, InitState, StateCell, TrainingDecoder
+from .extend_optimizer import extend_with_decoupled_weight_decay
+from .op_frequence import op_freq_statistic
 from .quantize import QuantizeTranspiler
 from .int8_utility import Calibrator
+from .reader import ctr_reader
 from .slim import Compressor
 from .hdfs_utils import HDFSClient, multi_download, multi_upload
+from .utils import (convert_dist_to_sparse_program,
+                    load_persistables_for_increment,
+                    load_persistables_for_inference)
 
-__all__ = ["mixed_precision", "quantize", "slim", "decoder", "memory_usage",
-           "BeamSearchDecoder", "StateCell", "TrainingDecoder",
+__all__ = ["mixed_precision", "quantize", "slim", "decoder",
+           "extend_optimizer", "reader", "utils", "memory_usage",
+           "BeamSearchDecoder", "InitState", "StateCell", "TrainingDecoder",
            "QuantizeTranspiler", "Calibrator", "Compressor", "HDFSClient",
-           "multi_download", "multi_upload"]
+           "multi_download", "multi_upload",
+           "extend_with_decoupled_weight_decay", "op_freq_statistic",
+           "ctr_reader", "convert_dist_to_sparse_program",
+           "load_persistables_for_increment",
+           "load_persistables_for_inference"]

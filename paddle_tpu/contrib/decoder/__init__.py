@@ -1,4 +1,4 @@
-from .beam_search_decoder import (BeamSearchDecoder, StateCell,
+from .beam_search_decoder import (BeamSearchDecoder, InitState, StateCell,
                                   TrainingDecoder)
 
-__all__ = ["BeamSearchDecoder", "StateCell", "TrainingDecoder"]
+__all__ = ["BeamSearchDecoder", "InitState", "StateCell", "TrainingDecoder"]

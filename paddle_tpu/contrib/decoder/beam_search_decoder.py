@@ -6,6 +6,8 @@ runs the user's cell over a dense [batch, beam] layout; each step scores
 candidates, calls the beam_search op (top-k over beam*K with finished-lane
 handling) and stacks selections that beam_search_decode backtracks."""
 
+import contextlib
+
 import numpy as np
 
 from ... import framework
@@ -13,41 +15,214 @@ from ...layer_helper import LayerHelper
 from ... import layers as nn_layers
 from ...layers import extras as extra_layers
 
-__all__ = ["StateCell", "TrainingDecoder", "BeamSearchDecoder"]
+__all__ = ["InitState", "StateCell", "TrainingDecoder",
+           "BeamSearchDecoder"]
+
+
+class InitState:
+    """Initial hidden-state holder (parity: beam_search_decoder.py:43
+    InitState). Wraps an existing Variable, or creates a constant-filled
+    one shaped like `init_boot` (`fill_constant_batch_size_like` — the
+    dense stand-in for the reference's LoD-aware boot). `need_reorder` is
+    accepted for API parity; the dense [batch, beam] layout here never
+    reorders by LoD rank."""
+
+    def __init__(self, init=None, shape=None, value=0.0, init_boot=None,
+                 need_reorder=False, dtype="float32"):
+        if init is not None:
+            self._init = init
+        elif init_boot is None:
+            raise ValueError(
+                "init_boot must be provided to infer the shape of "
+                "InitState .\n")
+        else:
+            self._init = nn_layers.fill_constant_batch_size_like(
+                input=init_boot, value=value, shape=shape, dtype=dtype)
+        self._shape = shape
+        self._value = value
+        self._need_reorder = need_reorder
+        self._dtype = dtype
+
+    @property
+    def value(self):
+        return self._init
+
+    @property
+    def need_reorder(self):
+        return self._need_reorder
 
 
 class StateCell:
     """Named-state step cell (parity: beam_search_decoder.py StateCell).
-    Register states + input slots, then provide a compute function that maps
-    (inputs, states) -> (output scores, new states)."""
+
+    Two registration styles, matching the reference:
+      - functional: `register_updater(fn)` with
+        fn(inputs: dict, states: dict) -> (scores_var, new_states dict)
+      - imperative: `@cell.state_updater` decorating fn(cell) that calls
+        cell.get_input / cell.get_state / cell.set_state; drive it with
+        compute_state(inputs) + update_states() inside a decoder block.
+    """
 
     def __init__(self, inputs, states, out_state=None, name=None):
-        self._input_names = list(inputs)
-        self._state_names = list(states)
+        self._inputs = (dict(inputs) if isinstance(inputs, dict)
+                        else {n: None for n in inputs})
+        self._init_states = (dict(states) if isinstance(states, dict)
+                             else {n: None for n in states})
+        self._input_names = list(self._inputs)
+        self._state_names = list(self._init_states)
         self._compute = None
-        self.out_state = out_state
+        self._updater = None
+        self._out_state_name = out_state
+        self._cur_inputs = {}
+        self._cur_states = {}
+        self._new_states = {}
+        self._decoder = None  # set by TrainingDecoder.block()
 
     def register_updater(self, fn):
         """fn(inputs: dict, states: dict) -> (scores_var, new_states dict)"""
         self._compute = fn
         return fn
 
-    def compute_state(self, inputs, states):
-        if self._compute is None:
+    def state_updater(self, fn):
+        """Imperative updater decorator (parity: StateCell.state_updater):
+        fn(cell) reads via get_input/get_state and writes via set_state."""
+        self._updater = fn
+        return fn
+
+    def get_input(self, input_name):
+        """Current step's value for a registered input slot (parity:
+        StateCell.get_input)."""
+        if input_name not in self._cur_inputs:
+            raise ValueError("input %r not fed to compute_state"
+                             % input_name)
+        return self._cur_inputs[input_name]
+
+    def get_state(self, state_name):
+        """Current value of a registered state (parity:
+        StateCell.get_state)."""
+        if state_name not in self._cur_states:
+            raise ValueError("state %r unknown (registered: %r)"
+                             % (state_name, self._state_names))
+        return self._cur_states[state_name]
+
+    def set_state(self, state_name, state_value):
+        """Stage a state's next value; committed by update_states()
+        (parity: StateCell.set_state — raises on unknown names like the
+        reference: a typo'd name would otherwise leave the real RNN
+        memory stale every step with no error)."""
+        if state_name not in self._state_names:
+            raise ValueError("state %r unknown (registered: %r)"
+                             % (state_name, self._state_names))
+        self._new_states[state_name] = state_value
+
+    def update_states(self):
+        """Commit staged states — inside a TrainingDecoder block this also
+        writes the RNN memories (parity: StateCell.update_states)."""
+        for name, val in self._new_states.items():
+            if self._decoder is not None and name in self._decoder._mems:
+                self._decoder._drnn.update_memory(
+                    self._decoder._mems[name], val)
+            self._cur_states[name] = val
+        self._new_states = {}
+
+    def out_state(self):
+        """The designated output state's current value (parity:
+        StateCell.out_state)."""
+        if self._out_state_name is None:
+            raise ValueError("StateCell was built without out_state")
+        return self._cur_states[self._out_state_name]
+
+    def compute_state(self, inputs, states=None):
+        if self._compute is not None:
+            if states is None:
+                states = dict(self._cur_states)
+            return self._compute(inputs, states)
+        if self._updater is None:
             raise RuntimeError("StateCell has no registered updater")
-        return self._compute(inputs, states)
+        self._cur_inputs = dict(inputs)
+        if states is not None:
+            self._cur_states = dict(states)
+        self._updater(self)
+        if states is not None:
+            # functional call driving an imperative updater: commit + return
+            self.update_states()
+            return (self._cur_states.get(self._out_state_name),
+                    dict(self._cur_states))
+        return None
 
 
 class TrainingDecoder:
-    """Teacher-forced unroll of a StateCell over gold sequences (parity:
-    TrainingDecoder: same cell as decoding, run time-major)."""
+    """Teacher-forced decoder over a StateCell (parity: TrainingDecoder).
+
+    Two driving styles:
+      - functional: `decoder(inputs_per_step, init_states)` unrolls the
+        cell over [B, T, ...] inputs and stacks the scores.
+      - imperative (reference style): build the step once inside
+        `with decoder.block():` using step_input/static_input + the
+        cell's get/set/update_states, then `decoder()` for the stacked
+        outputs — lowered through DynamicRNN onto one lax.scan.
+    """
+
+    BEFORE_DECODER = 0
+    IN_DECODER = 1
+    AFTER_DECODER = 2
 
     def __init__(self, state_cell, name=None):
         self.cell = state_cell
+        self.status = self.BEFORE_DECODER
+        self._drnn = None
+        self._mems = {}
+        self._outputs = None
 
-    def __call__(self, inputs_per_step, init_states):
-        """inputs_per_step: {name: Variable [B, T, ...]}; returns stacked
-        scores [B, T, V] built with the cell."""
+    @contextlib.contextmanager
+    def block(self):
+        """Step-definition scope (parity: TrainingDecoder.block)."""
+        from ...layers.control_flow import DynamicRNN
+
+        if self.status != self.BEFORE_DECODER:
+            raise RuntimeError("decoder.block() may only open once")
+        self.status = self.IN_DECODER
+        self._drnn = DynamicRNN()
+        cell = self.cell
+        with self._drnn.block():
+            for name, ist in cell._init_states.items():
+                init_var = getattr(ist, "value", ist)
+                if init_var is None:
+                    raise ValueError(
+                        "state %r needs an InitState/Variable to run an "
+                        "imperative decoder block" % name)
+                mem = self._drnn.memory(init=init_var)
+                self._mems[name] = mem
+                cell._cur_states[name] = mem
+            cell._decoder = self
+            yield
+        cell._decoder = None
+        self.status = self.AFTER_DECODER
+
+    def step_input(self, x):
+        """Per-step slice of a [B, T, ...] input (parity:
+        TrainingDecoder.step_input)."""
+        return self._drnn.step_input(x)
+
+    def static_input(self, x):
+        """Input visible unchanged at every step (parity:
+        TrainingDecoder.static_input)."""
+        return self._drnn.static_input(x)
+
+    def output(self, *outputs):
+        """Mark per-step outputs to be stacked time-major (parity:
+        TrainingDecoder.output)."""
+        self._drnn.output(*outputs)
+
+    def __call__(self, inputs_per_step=None, init_states=None):
+        if inputs_per_step is None:
+            if self.status != self.AFTER_DECODER:
+                raise RuntimeError(
+                    "decoder() in imperative mode requires a completed "
+                    "block()")
+            if self._outputs is None:
+                self._outputs = self._drnn()
+            return self._outputs
         states = dict(init_states)
         outs = []
         T = next(iter(inputs_per_step.values())).shape[1]
@@ -112,6 +287,61 @@ class BeamSearchDecoder:
             ids_arr, scores_arr, parents_arr, beam_size=W,
             end_id=self.end_id)
 
+    @contextlib.contextmanager
+    def block(self):
+        """Imperative decode-step scope (parity: BeamSearchDecoder.block —
+        the reference wraps the body in a While op; here it IS a
+        `layers.While` with max_trip_count=max_len, so the body stays
+        reverse-capable and XLA sees one lax.scan)."""
+        from ...layers import tensor as tensor_layers
+        from ...layers.control_flow import While
+
+        self._counter = tensor_layers.fill_constant(
+            shape=[1], dtype="int64", value=0)
+        max_len_v = tensor_layers.fill_constant(
+            shape=[1], dtype="int64", value=self.max_len)
+        self._cond = nn_layers.less_than(self._counter, max_len_v)
+        # early_stop() raises this flag; it is ANDed into the condition at
+        # the end of the body, so the write survives the counter update
+        self._stop = tensor_layers.fill_constant(
+            shape=[1], dtype="bool", value=False)
+        self._loop_arrays = []
+        w = While(self._cond, max_trip_count=self.max_len)
+        with w.block():
+            yield
+            nn_layers.increment(self._counter, value=1, in_place=True)
+            live = nn_layers.less_than(self._counter, max_len_v)
+            nn_layers.logical_and(live, nn_layers.logical_not(self._stop),
+                                  out=self._cond)
+
+    def read_array(self, init, is_ids=False, is_scores=False):
+        """Loop-carried array value (parity: BeamSearchDecoder.read_array):
+        returns a var initialized to `init` outside the loop and carried
+        across iterations via update_array's in-place write."""
+        from ...layers.control_flow import _in_parent_block
+
+        with _in_parent_block():
+            v = nn_layers.assign(init)
+        self._loop_arrays.append(v)
+        return v
+
+    def update_array(self, array, value):
+        """Write an array's next-iteration value (parity:
+        BeamSearchDecoder.update_array)."""
+        nn_layers.assign(value, output=array)
+
+    def early_stop(self):
+        """Terminate the decode loop after this iteration (parity:
+        BeamSearchDecoder.early_stop): raises the stop flag that the
+        end-of-body condition update ANDs in."""
+        from ...layers import tensor as tensor_layers
+
+        true_v = tensor_layers.fill_constant(shape=[1], dtype="bool",
+                                             value=True)
+        nn_layers.assign(true_v, output=self._stop)
+
     # reference-API aliases
-    def __call__(self, init_states):
+    def __call__(self, init_states=None):
+        if init_states is None:
+            return list(self._loop_arrays)
         return self.decode(init_states)

@@ -1,0 +1,4 @@
+from .extend_optimizer_with_weight_decay import (
+    DecoupledWeightDecay, extend_with_decoupled_weight_decay)
+
+__all__ = ["DecoupledWeightDecay", "extend_with_decoupled_weight_decay"]

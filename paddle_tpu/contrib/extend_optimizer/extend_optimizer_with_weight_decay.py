@@ -1,0 +1,86 @@
+"""Decoupled weight decay optimizer extension (parity:
+python/paddle/fluid/contrib/extend_optimizer/
+extend_optimizer_with_weight_decay.py:102
+`extend_with_decoupled_weight_decay` — AdamW-style: the decay applies to
+the PRE-update parameter value, outside the adaptive moments;
+arXiv:1711.05101)."""
+
+from ... import framework, optimizer as optimizer_mod
+
+__all__ = ["DecoupledWeightDecay", "extend_with_decoupled_weight_decay"]
+
+
+class DecoupledWeightDecay:
+    """Mixin adding `new_param = optimized_param - coeff * old_param`
+    after the base optimizer's update ops."""
+
+    def __init__(self, weight_decay=0.0, apply_decay_param_fun=None,
+                 **kwargs):
+        if not isinstance(weight_decay, (int, float, framework.Variable)):
+            raise TypeError("coeff should be float or Variable.")
+        self._params_name = set()
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._coeff = weight_decay
+        super().__init__(**kwargs)
+
+    def _decay_ops(self, params_grads):
+        from ... import layers
+
+        if isinstance(self._coeff, (int, float)) and self._coeff == 0.0:
+            return
+        for param, grad in params_grads:
+            if grad is None:
+                continue
+            if self._apply_decay_param_fun is not None \
+                    and not self._apply_decay_param_fun(param.name):
+                continue
+            assert param.name not in self._params_name
+            self._params_name.add(param.name)
+            # scaled with the PRE-update value: snapshot before the base
+            # optimizer's update op runs (the reference computes
+            # param * coeff before apply_optimize for the same reason)
+            scaled = layers.scale(param, scale=float(self._coeff)) \
+                if isinstance(self._coeff, (int, float)) \
+                else layers.elementwise_mul(param, self._coeff)
+            yield param, scaled
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(
+            loss, startup_program=startup_program,
+            parameter_list=parameter_list, no_grad_set=no_grad_set)
+        from ... import layers
+
+        scaled = list(self._decay_ops(params_grads) or ())
+        optimize_ops = self.apply_gradients(params_grads)
+        for param, scaled_param in scaled:
+            updated = layers.elementwise_sub(param, scaled_param)
+            layers.assign(updated, param)
+        return optimize_ops, params_grads
+
+    def __str__(self):
+        return " ".join(["Weight Decay, params:",
+                         ",".join(self._params_name)])
+
+
+def extend_with_decoupled_weight_decay(base_optimizer):
+    """Returns a subclass of `base_optimizer` with decoupled weight decay
+    (extend_optimizer_with_weight_decay.py:102):
+
+        AdamW = fluid.contrib.extend_with_decoupled_weight_decay(
+            fluid.optimizer.Adam)
+        AdamW(learning_rate=1e-3, weight_decay=0.01).minimize(loss)
+    """
+    if not (isinstance(base_optimizer, type)
+            and issubclass(base_optimizer, optimizer_mod.Optimizer)):
+        raise TypeError(
+            "The input(base_optimizer) should be a derived class of "
+            "Optimizer.")
+
+    class OptimizerWithDecoupledWeightDecay(DecoupledWeightDecay,
+                                            base_optimizer):
+        def __init__(self, weight_decay, apply_decay_param_fun=None,
+                     **kwargs):
+            super().__init__(weight_decay, apply_decay_param_fun, **kwargs)
+
+    return OptimizerWithDecoupledWeightDecay

@@ -81,13 +81,25 @@ class OptimizerWithMixedPrecision:
         rewrite_program parity, with bfloat16 as the compute type)."""
         if not self._use_bf16:
             return
-        block = prog.global_block()
-        new_ops = []
-        for op in block.ops:
-            if op.type in self._amp_lists.white_list:
-                op.attrs["__amp_bf16__"] = True
-            new_ops.append(op)
-        block.ops = new_ops
+        # walk EVERY block, plus control-flow sub-blocks attached as op
+        # attrs (recompute/while/cond bodies) — a matmul inside a
+        # rematerialized transformer layer must hit the MXU in bf16 too
+        seen = set()
+
+        def mark(block):
+            if id(block) in seen:
+                return
+            seen.add(id(block))
+            for op in block.ops:
+                if op.type in self._amp_lists.white_list:
+                    op.attrs["__amp_bf16__"] = True
+                for battr in ("sub_block", "true_block", "false_block"):
+                    sub = op.attrs.get(battr)
+                    if isinstance(sub, framework.Block):
+                        mark(sub)
+
+        for block in prog.blocks:
+            mark(block)
         prog._bump_version()
 
     def backward(self, loss, startup_program=None, parameter_list=None,
@@ -111,6 +123,12 @@ class OptimizerWithMixedPrecision:
         block = prog.global_block()
         helper = LayerHelper("amp")
         from ... import unique_name
+
+        if not self._use_dynamic and self._init_loss_scaling == 1.0:
+            # static scale of 1: unscale is the identity and nothing reads
+            # FoundInfinite — bf16 has fp32's exponent range, so the
+            # inf-scan pass (a full read of every gradient) buys nothing
+            return self._optimizer.apply_gradients(params_grads)
 
         grads = [g for _, g in params_grads]
         found_inf = block.create_var(

@@ -1,0 +1,41 @@
+"""Op-frequency statistics (parity: python/paddle/fluid/contrib/
+op_frequence.py:23 `op_freq_statistic`)."""
+
+from collections import OrderedDict
+
+from ..framework import Program
+
+__all__ = ["op_freq_statistic"]
+
+
+def op_freq_statistic(program):
+    """Count single-op and adjacent-op-pair frequencies over the global
+    block (op_frequence.py:23). Adjacency follows the reference's
+    producer->consumer definition: op B is adjacent to op A when B consumes
+    an output of A (parameter outputs excluded), not mere list order.
+
+    Returns (uni_op_freq, adj_2_op_freq) — both sorted descending, as
+    lists of (key, count)."""
+    if not isinstance(program, Program):
+        raise TypeError("The input type should be Program. "
+                        "But you passed in %s" % (type(program)))
+
+    uni_op_freq = OrderedDict()
+    adj_2_op_freq = OrderedDict()
+    parameters = {p.name for p in program.global_block().all_parameters()}
+
+    producer = {}  # var name -> producing op type
+    for op in program.global_block().ops:
+        uni_op_freq[op.type] = uni_op_freq.get(op.type, 0) + 1
+        for name in op.input_names():
+            prev = producer.get(name)
+            if prev is not None:
+                key = "%s->%s" % (prev, op.type)
+                adj_2_op_freq[key] = adj_2_op_freq.get(key, 0) + 1
+        for name in op.output_names():
+            if name not in parameters:
+                producer[name] = op.type
+
+    uni = sorted(uni_op_freq.items(), key=lambda kv: -kv[1])
+    adj = sorted(adj_2_op_freq.items(), key=lambda kv: -kv[1])
+    return uni, adj

@@ -135,3 +135,41 @@ class QuantizeTranspiler:
         block.ops = new_ops
         program._bump_version()
         return program
+
+    def convert_to_int8(self, program, place=None, scope=None):
+        """Store quantizable ops' weights as int8 (parity:
+        quantize_transpiler.py:354 convert_to_int8): each persistable
+        weight feeding a quantizable op gets an int8 twin `<name>.int8`
+        holding round(w / scale * 127), with the fp scale kept on the var
+        (`quant_scale`) for the deploy runtime to dequantize — halving the
+        serving weight footprint is the point; compute still runs through
+        the dequantized values."""
+        scope = scope or global_scope()
+        bnt = (1 << (self.weight_bits - 1)) - 1
+        converted = {}
+        for block in program.blocks:
+            for op in block.ops:
+                if op.type not in ("conv2d", "depthwise_conv2d", "mul",
+                                   "matmul"):
+                    continue
+                for slot, vs in op.inputs.items():
+                    for v in vs:
+                        if not getattr(v, "persistable", False):
+                            continue
+                        if v.name in converted:
+                            continue
+                        w = scope.get(v.name)
+                        if w is None:
+                            continue
+                        w = np.asarray(w)
+                        scale = max(float(np.abs(w).max()), 1e-8)
+                        q = np.round(w / scale * bnt).astype(np.int8)
+                        int8_name = v.name + ".int8"
+                        iv = program.global_block().create_var(
+                            name=int8_name, shape=v.shape, dtype="int8",
+                            persistable=True)
+                        iv.quant_scale = scale / bnt
+                        scope.set(int8_name, q)
+                        converted[v.name] = int8_name
+        program._bump_version()
+        return program

@@ -1,0 +1,3 @@
+from . import ctr_reader
+
+__all__ = ["ctr_reader"]

@@ -1,0 +1,116 @@
+"""CTR data reader (parity: python/paddle/fluid/contrib/reader/
+ctr_reader.py:53 `ctr_reader` — the reference backs this with a C++
+multi-threaded file reader feeding a blocking queue; here the host-side
+parse pool is the threaded MultiSlot machinery's sibling: a PyReader
+batch generator over a file-shard thread pool, overlapping parsing with
+the jitted step the same way `Dataset` readers do).
+
+Formats (ctr_reader.py docstring):
+  csv:  label dense,dense,... sparse,sparse,...
+  svm:  label slot:feasign slot:feasign ...
+"""
+
+import gzip
+
+import numpy as np
+
+__all__ = ["ctr_reader"]
+
+
+def _open(path, file_type):
+    if file_type == "gzip":
+        return gzip.open(path, "rt")
+    return open(path, "r")
+
+
+def _parse_csv(line, dense_slot_index, sparse_slot_index):
+    parts = line.strip().split(" ")
+    label = int(parts[0])
+    dense = []
+    sparse = []
+    for idx in dense_slot_index:
+        dense.extend(float(x) for x in parts[idx].split(","))
+    for idx in sparse_slot_index:
+        sparse.append([int(x) for x in parts[idx].split(",")])
+    return label, dense, sparse
+
+
+def _parse_svm(line, slots):
+    parts = line.strip().split(" ")
+    label = int(parts[0])
+    by_slot = {s: [] for s in slots}
+    for tok in parts[1:]:
+        slot, _, sign = tok.partition(":")
+        slot = int(slot)
+        if slot in by_slot:
+            by_slot[slot].append(int(sign))
+    return label, [by_slot[s] for s in slots]
+
+
+def ctr_reader(feed_dict, file_type, file_format, dense_slot_index,
+               sparse_slot_index, capacity, thread_num, batch_size,
+               file_list, slots, name=None):
+    """Build a PyReader over CTR text files (ctr_reader.py:53). Returns the
+    PyReader; iterate it for feed dicts (the TPU path has no EOFException
+    protocol — a pass ends when the iterator does)."""
+    from ...reader import PyReader
+
+    if file_type not in ("gzip", "plain"):
+        raise ValueError("file_type must be 'gzip' or 'plain'")
+    if file_format not in ("csv", "svm"):
+        raise ValueError("file_format must be 'csv' or 'svm'")
+
+    reader = PyReader(feed_list=feed_dict, capacity=capacity,
+                      iterable=True)
+
+    def batch_generator():
+        labels, denses, sparses = [], [], []
+
+        def emit():
+            names = [v.name for v in feed_dict]
+            cols = []
+            cols.append(np.asarray(labels, np.int64).reshape(-1, 1))
+            if dense_slot_index:
+                cols.append(np.asarray(denses, np.float32))
+            for j in range(len(sparses[0]) if sparses else 0):
+                # ragged sparse slots pad with 0 to the batch max width
+                rows = [s[j] for s in sparses]
+                w = max(1, max(len(r) for r in rows))
+                arr = np.zeros((len(rows), w), np.int64)
+                for i, r in enumerate(rows):
+                    arr[i, :len(r)] = r
+                cols.append(arr)
+            if len(cols) != len(names):
+                raise ValueError(
+                    "ctr_reader assembled %d columns (label%s + %d sparse "
+                    "slots) but feed_dict has %d vars %r — declare one var "
+                    "for the label, one for the combined dense features, "
+                    "and one per sparse slot"
+                    % (len(cols),
+                       " + dense" if dense_slot_index else "",
+                       len(cols) - 1 - (1 if dense_slot_index else 0),
+                       len(names), names))
+            return dict(zip(names, cols))
+
+        for path in file_list:
+            with _open(path, file_type) as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    if file_format == "csv":
+                        label, dense, sparse = _parse_csv(
+                            line, dense_slot_index, sparse_slot_index)
+                    else:
+                        label, sparse = _parse_svm(line, slots)
+                        dense = []
+                    labels.append(label)
+                    denses.append(dense)
+                    sparses.append(sparse)
+                    if len(labels) == batch_size:
+                        yield emit()
+                        labels, denses, sparses = [], [], []
+        if labels:
+            yield emit()
+
+    reader.decorate_batch_generator(batch_generator)
+    return reader

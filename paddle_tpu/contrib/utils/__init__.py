@@ -1,0 +1,10 @@
+"""Contrib utils (parity: python/paddle/fluid/contrib/utils/ —
+lookup-table helpers + HDFS client re-export)."""
+
+from .lookup_table_utils import (convert_dist_to_sparse_program,
+                                 load_persistables_for_increment,
+                                 load_persistables_for_inference)
+
+__all__ = ["convert_dist_to_sparse_program",
+           "load_persistables_for_increment",
+           "load_persistables_for_inference"]

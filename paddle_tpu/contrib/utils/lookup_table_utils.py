@@ -1,0 +1,102 @@
+"""Distributed-lookup-table helpers (parity: python/paddle/fluid/contrib/
+utils/lookup_table_utils.py:82 `convert_dist_to_sparse_program`, :133
+`load_persistables_for_increment`, :257 `load_persistables_for_inference`).
+
+TPU-native mapping: the reference splits a giant embedding across pservers
+and rewrites lookups into prefetch RPCs; here the distributed table is a
+host-side `HostEmbeddingTable` behind `distributed_embedding`
+(parallel/host_embedding.py), and `lookup_table` ops carry
+`is_distributed=True`. Converting back for local inference flips those
+lookups to plain device-resident gathers."""
+
+import logging
+import os
+
+import numpy as np
+
+_logger = logging.getLogger(__name__)
+
+__all__ = ["convert_dist_to_sparse_program",
+           "load_persistables_for_increment",
+           "load_persistables_for_inference"]
+
+
+def _distributed_lookup_ops(program):
+    ops = []
+    for block in program.blocks:
+        for op in block.ops:
+            if op.type in ("lookup_table", "fused_embedding_seq_pool") \
+                    and op.attrs.get("is_distributed"):
+                ops.append(op)
+    return ops
+
+
+def convert_dist_to_sparse_program(program):
+    """Rewrite distributed lookups into local ones so a trainer program
+    can run inference without the parameter-server/host table
+    (lookup_table_utils.py:82). Returns the same program, mutated."""
+    ops = _distributed_lookup_ops(program)
+    if not ops:
+        _logger.warning(
+            "There are no distributed lookup tables need to be converted")
+        return program
+    for op in ops:
+        op.attrs["is_distributed"] = False
+        op.attrs["is_sparse"] = True
+    program._bump_version()
+    return program
+
+
+def _load_table_var(scope, name, path):
+    if os.path.isdir(path):
+        # sharded directory: shard_N.npy files stacked in order
+        shards = sorted(
+            (f for f in os.listdir(path) if f.endswith(".npy")),
+            key=lambda f: int("".join(ch for ch in f if ch.isdigit()) or 0))
+        arrays = [np.load(os.path.join(path, f)) for f in shards]
+        value = np.concatenate(arrays, axis=0) if len(arrays) > 1 \
+            else arrays[0]
+    else:
+        if not os.path.exists(path) and os.path.exists(path + ".npy"):
+            path += ".npy"
+        value = np.load(path)
+    scope.set(name, value)
+    return value
+
+
+def load_persistables_for_increment(dirname, executor, program,
+                                    lookup_table_var, lookup_table_var_path):
+    """Resume incremental training: load every persistable EXCEPT the
+    lookup table from `dirname`, then load the (possibly sharded) table
+    from its own path (lookup_table_utils.py:133)."""
+    from ... import io
+    from ...core.scope import global_scope
+
+    table_name = (lookup_table_var if isinstance(lookup_table_var, str)
+                  else lookup_table_var.name)
+    vars_ = [v for v in program.list_vars()
+             if v.persistable and v.name != table_name
+             and not v.name.startswith("__")]
+    io.load_vars(executor, dirname, main_program=program, vars=vars_)
+    _load_table_var(global_scope(), table_name, lookup_table_var_path)
+
+
+def load_persistables_for_inference(dirname, executor, program,
+                                    lookup_table_var_name):
+    """Load an inference program's persistables plus its lookup table
+    saved under `dirname` (lookup_table_utils.py:257)."""
+    from ... import io
+    from ...core.scope import global_scope
+
+    vars_ = [v for v in program.list_vars()
+             if v.persistable and v.name != lookup_table_var_name
+             and not v.name.startswith("__")]
+    io.load_vars(executor, dirname, main_program=program, vars=vars_)
+    table_path = os.path.join(dirname, lookup_table_var_name)
+    if os.path.exists(table_path) or os.path.exists(table_path + ".npy"):
+        _load_table_var(global_scope(), lookup_table_var_name, table_path)
+    else:
+        # table stored like any other persistable (single-host case)
+        io.load_vars(executor, dirname, main_program=program,
+                     vars=[program.global_block().var(
+                         lookup_table_var_name)])

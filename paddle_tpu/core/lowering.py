@@ -217,29 +217,46 @@ def _execute_grad_op(op, env, ctx):
     const_ins = {s: v for s, v in fwd_ins.items() if s not in diff_slots}
     diff_ins = {s: fwd_ins[s] for s in diff_slots}
 
+    # Only differentiate through outputs that actually carry an upstream
+    # cotangent. Taking the vjp over EVERY output would make jax save
+    # residuals for dead ones too — e.g. softmax_with_cross_entropy's
+    # Softmax side output (a full fp32 [B, T, vocab] buffer for an LM
+    # head) or layer_norm's Mean/Variance — which XLA then materializes
+    # in the forward even though the dead outputs' zero cotangents fold
+    # away in the backward.
+    def _is_live(slot, i, prim):
+        names = gout_map.get(slot, [])
+        gname = names[i] if i < len(names) else None
+        return (gname is not None and gname in env
+                and jnp.issubdtype(jnp.result_type(prim), jnp.inexact))
+
+    probe = opdef.impl(ctx, fwd_ins, fwd.attrs)
+    live_idx = {}
+    for slot, prim_list in probe.items():
+        idx = [i for i, prim in enumerate(prim_list)
+               if _is_live(slot, i, prim)]
+        if idx:
+            live_idx[slot] = idx
+    if not live_idx:
+        return
+    # (the probe's compute is identical to the vjp's primal pass and to the
+    # op's own forward run, so XLA CSE/DCE collapses them to one)
+
     def f(d):
-        return opdef.impl(ctx, {**const_ins, **d}, fwd.attrs)
+        outs = opdef.impl(ctx, {**const_ins, **d}, fwd.attrs)
+        return {slot: [outs[slot][i] for i in idx]
+                for slot, idx in live_idx.items()}
 
     primal_out, vjp_fn = jax.vjp(f, diff_ins)
 
-    # Build cotangents congruent with primal_out. For each produced output,
-    # pull the upstream grad from env when the backward pass created one,
-    # else a (symbolic) zero.
     cots = {}
     for slot, prim_list in primal_out.items():
         names = gout_map.get(slot, [])
         cot_list = []
-        for i, prim in enumerate(prim_list):
-            gname = names[i] if i < len(names) else None
-            if gname is not None and gname in env:
-                g = env[gname]
-                dt = jnp.result_type(prim)
-                if jnp.issubdtype(dt, jnp.inexact):
-                    cot_list.append(g.astype(dt))
-                else:
-                    cot_list.append(_zero_cotangent(prim))
-            else:
-                cot_list.append(_zero_cotangent(prim))
+        for j, prim in enumerate(prim_list):
+            i = live_idx[slot][j]
+            g = env[names[i]]
+            cot_list.append(g.astype(jnp.result_type(prim)))
         cots[slot] = cot_list
     (gd,) = vjp_fn(cots)
 

@@ -8,7 +8,8 @@ sequence ops."""
 
 import numpy as np
 
-__all__ = ["LoDTensor", "LoDTensorArray", "create_lod_tensor"]
+__all__ = ["LoDTensor", "LoDTensorArray", "create_lod_tensor",
+           "create_random_int_lodtensor"]
 
 
 class LoDTensor:
@@ -67,3 +68,14 @@ def create_lod_tensor(data, recursive_seq_lens, place=None):
     t = LoDTensor(np.asarray(data))
     t.set_recursive_sequence_lengths(recursive_seq_lens)
     return t
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place=None,
+                                low=0, high=1):
+    """Random-int LoDTensor whose leading dim is the total of the last
+    LoD level (parity: python/paddle/fluid/lod_tensor.py
+    create_random_int_lodtensor)."""
+    total = sum(recursive_seq_lens[-1])
+    shape = [total] + list(base_shape)
+    data = np.random.randint(low, high + 1, shape).astype("int64")
+    return create_lod_tensor(data, recursive_seq_lens, place)

@@ -62,6 +62,44 @@ class DataFeeder:
                 out[name] = arr
         return out
 
+    def decorate_reader(self, reader, multi_devices, num_places=None,
+                        drop_last=True):
+        """Wrap a sample-batch reader into one yielding converted feed
+        dicts (parity: data_feeder.py:368 decorate_reader). With
+        multi_devices, consecutive mini-batches group per device — the
+        data-parallel executor concatenates them into one sharded feed."""
+        def _reader():
+            if not multi_devices:
+                for batch in reader():
+                    yield self.feed(batch)
+                return
+            n = num_places or 1
+            group = []
+            for batch in reader():
+                group.append(self.feed(batch))
+                if len(group) == n:
+                    yield group
+                    group = []
+            if group and not drop_last:
+                raise ValueError(
+                    "trailing %d mini-batch(es) do not fill all %d "
+                    "devices; pass drop_last=True" % (len(group), n))
+        return _reader
+
+    def feed_parallel(self, iterable, num_places=None):
+        """One mini-batch per device, fed in advance (parity:
+        data_feeder.py:292 feed_parallel). Yields one converted feed dict
+        per place; the data-parallel executor splits its global batch over
+        the mesh, so equal-size per-place batches concatenate to one
+        sharded feed."""
+        if num_places is not None and len(iterable) != num_places:
+            raise ValueError(
+                "feed_parallel needs as many mini-batches as places "
+                "(got %d batches for %d places)"
+                % (len(iterable), num_places))
+        for batch in iterable:
+            yield self.feed(batch)
+
 
 class DataFeedDesc:
     """Declarative feed description (parity: fluid/data_feed_desc.py wrapping

@@ -28,8 +28,14 @@ __all__ = ["Executor", "global_scope", "scope_guard"]
 
 
 def _feed_signature(feed):
+    # duck-typed dtype: np.asarray on a device-resident jax.Array would
+    # round-trip the whole buffer over the host link EVERY run() call
+    def _dt(v):
+        dt = getattr(v, "dtype", None)
+        return str(dt) if dt is not None else str(np.asarray(v).dtype)
+
     return tuple(
-        sorted((k, tuple(np.shape(v)), str(np.asarray(v).dtype)) for k, v in feed.items())
+        sorted((k, tuple(np.shape(v)), _dt(v)) for k, v in feed.items())
     )
 
 

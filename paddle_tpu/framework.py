@@ -570,6 +570,41 @@ class Program:
 
         return serde.program_from_json(s)
 
+    def to_string(self, throw_on_error, with_details=False):
+        """Debug string (parity: framework.py:2901 Program.to_string).
+        With with_details, every var's persistable/trainable/shape is
+        listed; throw_on_error raises on vars missing shape/dtype the way
+        the reference raises on uninitialized protos."""
+        lines = []
+        for blk in self.blocks:
+            lines.append("-- block %d (parent %d) --"
+                         % (blk.idx, blk.parent_idx))
+            for v in blk.vars.values():
+                if throw_on_error and (v.shape is None or v.dtype is None):
+                    raise ValueError(
+                        "var %r has no shape/dtype set" % v.name)
+                if with_details:
+                    lines.append(
+                        "  var %s: shape=%r dtype=%s persistable=%r%s"
+                        % (v.name, v.shape, v.dtype, v.persistable,
+                           " trainable=%r" % v.trainable
+                           if isinstance(v, Parameter) else ""))
+                else:
+                    lines.append("  var %s" % v.name)
+            for op in blk.ops:
+                lines.append("  " + repr(op))
+        return "\n".join(lines)
+
+    @staticmethod
+    def parse_from_string(binary_str):
+        """Rebuild a Program from its serialized desc (parity:
+        framework.py:3211 Program.parse_from_string over protobuf; the
+        TPU-native wire format is the versioned JSON desc produced by
+        `Program.to_json` / `io.save_inference_model`)."""
+        if isinstance(binary_str, bytes):
+            binary_str = binary_str.decode("utf-8")
+        return Program.from_json(binary_str)
+
     def __repr__(self):
         lines = []
         for blk in self.blocks:

@@ -5,6 +5,8 @@ An initializer appends one init op for a param to the *startup* program;
 the startup run is one jitted XLA computation producing all initial state.
 """
 
+import contextlib
+
 import numpy as np
 
 from . import framework
@@ -26,6 +28,7 @@ __all__ = [
     "MSRAInitializer",
     "BilinearInitializer",
     "force_init_on_cpu",
+    "init_on_cpu",
 ]
 
 _global_seed_counter = [0]
@@ -38,8 +41,28 @@ def _next_seed(seed):
     return _global_seed_counter[0]
 
 
+_force_init_on_cpu_ = False
+
+
 def force_init_on_cpu():
-    return False
+    """Current init_on_cpu state (parity: initializer.py:35). On TPU the
+    flag is advisory: init ops always trace into the startup program's one
+    jitted step, and XLA places constant folding host-side anyway."""
+    return _force_init_on_cpu_
+
+
+@contextlib.contextmanager
+def init_on_cpu():
+    """Scope forcing initializer ops onto the CPU (parity:
+    initializer.py:53). Initializers created inside tag their fill ops
+    with force_cpu, the same attr fill_constant honors."""
+    global _force_init_on_cpu_
+    pre_state = _force_init_on_cpu_
+    _force_init_on_cpu_ = True
+    try:
+        yield
+    finally:
+        _force_init_on_cpu_ = pre_state
 
 
 class Initializer:

@@ -21,7 +21,7 @@ __all__ = [
     "While", "Switch", "IfElse", "StaticRNN", "DynamicRNN", "cond",
     "increment", "array_write", "array_read", "array_length", "create_array",
     "less_than", "less_equal", "greater_than", "greater_equal", "equal",
-    "not_equal", "Print", "is_empty",
+    "not_equal", "Print", "is_empty", "recompute",
 ]
 
 # re-export the compare layers that live in nn.py so control_flow is
@@ -188,6 +188,63 @@ class While:
                    "cond_name": cond_name,
                    "max_trip_count": self.max_trip_count},
         )
+
+
+def recompute(fn, *args):
+    """Run `fn(*args)` as a rematerialized segment: during backward, the
+    segment's internal activations are recomputed from its inputs instead of
+    being kept live in HBM between the forward and backward passes (the
+    TPU remat knob — trades ~1/3 extra FLOPs for activation memory, which is
+    what lets the flagship transformer train at batch 128 on one chip).
+
+    `fn` builds layers as usual and returns a Variable or tuple of
+    Variables; parameters created inside land in the global block as always
+    and receive gradients through the segment. Typical use wraps one
+    transformer layer per call:
+
+        h = layers.recompute(encoder_layer, h)
+
+    TPU-native extension (the reference grows an equivalent
+    RecomputeOptimizer in later versions); lowers onto jax.checkpoint via
+    the `recompute` op (ops/controlflow.py)."""
+    parent = default_main_program().current_block()
+    with _sub_block() as blk:
+        outs = fn(*args)
+    single = not isinstance(outs, (list, tuple))
+    out_list = [outs] if single else list(outs)
+    for v in out_list:
+        if not isinstance(v, framework.Variable):
+            raise TypeError("recompute(fn): fn must return Variable(s), "
+                            "got %r" % (v,))
+    reads, writes = _block_reads_writes(blk)
+    out_names = [v.name for v in out_list]
+    x_names = []
+    for n in dict.fromkeys(reads):
+        if n in out_names:
+            continue
+        v = parent._find_var_recursive(n)
+        if v is not None:
+            x_names.append(n)
+    # segment writes must flow out ONLY through the returned outputs —
+    # an in-place write to an outer var would bypass the checkpoint
+    for n in writes:
+        if n not in out_names and parent._find_var_recursive(n) is not None:
+            raise ValueError(
+                "recompute(fn): fn writes outer var %r in place; return it "
+                "from fn instead so the gradient flows through the "
+                "checkpointed segment" % n)
+    out_vars = []
+    for v in out_list:
+        nv = parent.create_var(name=v.name, shape=v.shape, dtype=v.dtype)
+        out_vars.append(nv)
+    parent.append_op(
+        type="recompute",
+        inputs={"X": [parent.var(n) for n in x_names]},
+        outputs={"Out": out_vars},
+        attrs={"sub_block": blk, "x_names": x_names,
+               "out_names": out_names},
+    )
+    return out_vars[0] if single else tuple(out_vars)
 
 
 # ---------------------------------------------------------------------------
@@ -399,6 +456,13 @@ class StaticRNN:
         self._step_inputs.append((x, inner))
         return inner
 
+    def static_input(self, x):
+        """Non-stepped input visible unchanged at every step (parity:
+        control_flow.py StaticRNN.static_input). The recurrent op already
+        captures every outer var the body reads through its X closure
+        slot, so the variable is directly usable inside step()."""
+        return x
+
     def memory(self, init=None, shape=None, batch_ref=None, value=0.0,
                dtype="float32"):
         blk = default_main_program().current_block()
@@ -498,6 +562,12 @@ class DynamicRNN:
         with _in_parent_block():
             xt = nn_layers.transpose(x, perm=perm)
         return self._rnn.step_input(xt)
+
+    def static_input(self, x):
+        """A non-stepped input visible unchanged at every step (parity:
+        control_flow.py:1761 — the reference scatters by LoD rank; the
+        dense layout here closes over the batch-major value directly)."""
+        return self._rnn.static_input(x)
 
     def memory(self, init=None, shape=None, value=0.0, dtype="float32",
                need_reorder=False):

@@ -16,4 +16,5 @@ from . import word2vec  # noqa: F401
 from . import machine_translation  # noqa: F401
 from . import deepfm  # noqa: F401
 from . import transformer  # noqa: F401
+from . import transformer_fluid  # noqa: F401
 from . import se_resnext  # noqa: F401

@@ -1,0 +1,115 @@
+"""Flagship transformer LM built ENTIRELY from the Fluid layers API
+(`fluid.layers` + `nets.scaled_dot_product_attention`) — the proof that
+API users get native-TPU speed through the descriptor lowering
+(executor.py `_CompiledStep`: the whole program becomes ONE jitted XLA
+step), not just users of the bespoke jax model in models/transformer.py.
+
+Same architecture and scale as the native flagship (models/transformer.py,
+cross-checked by tests): pre-LN decoder-only LM, vocab 32000, d_model 512,
+8 heads, 6 layers, d_ff 2048 (~65M params). The TPU knobs the VERDICT asked
+to surface through the API path are all exercised here:
+  - AMP bf16: contrib.mixed_precision.decorate marks matmul/mul/
+    flash_attention white-list ops (MXU-native bf16 operands, fp32
+    accumulation), including inside recompute sub-blocks
+  - remat: each encoder layer runs under layers.recompute — activation
+    memory per layer collapses to the segment boundary, enabling batch 128
+    on one 16G chip exactly like the native path
+  - flash attention: nets.scaled_dot_product_attention(dropout=0) lowers
+    to the fused Pallas flash kernel with causal masking
+
+Reference parity anchor: the model zoo transformer
+(/root/reference/benchmark/fluid/models/transformer.py) built on
+fluid.layers; this one is decoder-only to match BASELINE.json config 3.
+"""
+
+from .. import layers, nets
+from ..param_attr import ParamAttr
+
+__all__ = ["build"]
+
+
+def build(vocab_size=32000, d_model=512, n_heads=8, n_layers=6, d_ff=2048,
+          seq_len=512, dropout_rate=0.0, remat=True, dtype="float32"):
+    """Build the LM graph; returns (tokens, labels, mean_loss) Variables.
+
+    Feeds: tokens int32 [B, seq_len], labels int32 [B, seq_len] (next-token
+    ids). Loss = mean token cross-entropy in fp32 (matches
+    models/transformer.py token_cross_entropy).
+
+    dtype="bfloat16" stores params AND the residual stream in bf16 — the
+    native flagship's precision scheme. Kernels that need fp32 keep it
+    internally regardless (layer_norm stats, softmax_with_cross_entropy
+    logsumexp + fp32 loss, sgd update math)."""
+    tokens = layers.data(name="tokens", shape=[seq_len], dtype="int32")
+    labels = layers.data(name="labels", shape=[seq_len], dtype="int32")
+
+    h = layers.embedding(tokens, size=[vocab_size, d_model], dtype=dtype)
+    h = layers.scale(h, scale=float(d_model) ** 0.5)
+    h = layers.add_position_encoding(h, alpha=1.0, beta=1.0)
+
+    def encoder_layer(x):
+        a = layers.layer_norm(x, begin_norm_axis=2)
+        qkv = layers.fc(a, 3 * d_model, num_flatten_dims=2)
+        q, k, v = layers.split(qkv, num_or_sections=3, dim=-1)
+        attn = nets.scaled_dot_product_attention(
+            q, k, v, num_heads=n_heads, dropout_rate=dropout_rate,
+            causal=True)
+        proj = layers.fc(attn, d_model, num_flatten_dims=2)
+        if dropout_rate:
+            proj = layers.dropout(proj, dropout_prob=dropout_rate)
+        x = layers.elementwise_add(x, proj)
+        b = layers.layer_norm(x, begin_norm_axis=2)
+        f = layers.fc(b, d_ff, num_flatten_dims=2, act="gelu")
+        f = layers.fc(f, d_model, num_flatten_dims=2)
+        if dropout_rate:
+            f = layers.dropout(f, dropout_prob=dropout_rate)
+        return layers.elementwise_add(x, f)
+
+    def layer_pair(x):
+        return encoder_layer(encoder_layer(x))
+
+    # remat two layers per segment: same activation-memory class, half the
+    # checkpoint boundaries (each boundary costs layout/staging copies)
+    i = 0
+    while i < n_layers:
+        if remat and i + 1 < n_layers:
+            h = layers.recompute(layer_pair, h)
+            i += 2
+        elif remat:
+            h = layers.recompute(encoder_layer, h)
+            i += 1
+        else:
+            h = encoder_layer(h)
+            i += 1
+
+    h = layers.layer_norm(h, begin_norm_axis=2)
+
+    def lm_head_sum(x, y):
+        """Vocab projection -> summed CE for one sequence chunk. No remat
+        here: softmax_with_cross_entropy's custom vjp keeps only the
+        (bf16) logits as residuals and recomputes the softmax elementwise
+        in backward, so the expensive vocab matmul runs exactly once.
+        Chunking the sequence bounds the fp32 log-softmax TRANSIENT to
+        [B, chunk, vocab] (full-sequence fp32 temps peak over a 16G
+        chip's HBM at batch 128)."""
+        logits = layers.fc(x, vocab_size, num_flatten_dims=2,
+                           bias_attr=False,
+                           param_attr=ParamAttr(name="lm_head_w"))
+        y3 = layers.reshape(y, shape=[0, 0, 1])
+        ce = layers.softmax_with_cross_entropy(logits, y3)
+        return layers.reduce_sum(ce)
+
+    head_chunk = min(seq_len, 256)
+    parts = []
+    for s in range(0, seq_len, head_chunk):
+        hs = layers.slice(h, axes=[1], starts=[s], ends=[s + head_chunk])
+        ys = layers.slice(labels, axes=[1], starts=[s], ends=[s + head_chunk])
+        parts.append(lm_head_sum(hs, ys))
+    total = parts[0] if len(parts) == 1 else layers.sums(parts)
+    # mean over tokens; -1 batch dim is static at trace time, so divide by
+    # the runtime token count via shape-free scale at lowering: B*T comes
+    # from the label tensor itself
+    numel = layers.cast(layers.reduce_prod(
+        layers.shape(labels)), "float32")
+    loss = layers.elementwise_div(total, numel)
+    return tokens, labels, loss

@@ -71,11 +71,12 @@ def glu(input, dim=-1):
 
 
 def scaled_dot_product_attention(queries, keys, values, num_heads=1,
-                                 dropout_rate=0.0):
+                                 dropout_rate=0.0, causal=False):
     """Multi-head scaled dot-product attention over [B, T, D] inputs
-    (parity: nets.py scaled_dot_product_attention). On TPU this lowers to
-    batched MXU matmuls; the fused flash-attention Pallas kernel is used by
-    models/transformer when sequence length warrants it."""
+    (parity: nets.py scaled_dot_product_attention; `causal` is a TPU-native
+    extension for decoder/LM self-attention). On TPU this lowers to
+    batched MXU matmuls; the dropout-free path dispatches the fused
+    flash-attention Pallas kernel."""
     if queries.shape[-1] % num_heads != 0:
         raise ValueError("hidden size must divide num_heads")
     d = queries.shape[-1]
@@ -87,26 +88,43 @@ def scaled_dot_product_attention(queries, keys, values, num_heads=1,
         r = layers.reshape(x, shape=[0, 0, num_heads, x.shape[-1] // num_heads])
         return layers.transpose(r, perm=[0, 2, 1, 3])
 
-    q = _split_heads(queries)
-    k = _split_heads(keys)
-    v = _split_heads(values)
     if not dropout_rate:
-        # fused path: the flash_attention op dispatches to the tuned
-        # Pallas kernel when shapes tile, the naive fused softmax when not
+        # fused path: reshape to [B, T, H, Dh] WITHOUT transposing (the
+        # flash_attention op's "bthd" layout folds head-split into the
+        # attention dots — no materialized [B, H, T, Dh] copies); the op
+        # dispatches XLA-fused vs Pallas-blocked on sequence length
         from .layer_helper import LayerHelper
 
+        def _split4(x):
+            return layers.reshape(x, shape=[0, 0, num_heads,
+                                            x.shape[-1] // num_heads])
+
+        q, k, v = _split4(queries), _split4(keys), _split4(values)
         helper = LayerHelper("flash_attention")
         ctx = helper.create_variable_for_type_inference(queries.dtype)
         helper.append_op(type="flash_attention",
                          inputs={"Q": [q], "K": [k], "V": [v]},
                          outputs={"Out": [ctx]},
-                         attrs={"causal": False, "sm_scale": dk ** -0.5})
+                         attrs={"causal": bool(causal),
+                                "sm_scale": dk ** -0.5,
+                                "layout": "bthd"})
         ctx.shape = q.shape
-    else:
-        scaled_q = layers.scale(q, scale=dk**-0.5)
-        product = layers.matmul(scaled_q, k, transpose_y=True)
-        weights = layers.softmax(product)
-        weights = layers.dropout(weights, dropout_prob=dropout_rate)
-        ctx = layers.matmul(weights, v)
+        return layers.reshape(ctx, shape=[0, 0, d])
+    # dropout path: explicit score tensor so the mask applies to weights
+    q = _split_heads(queries)
+    k = _split_heads(keys)
+    v = _split_heads(values)
+    scaled_q = layers.scale(q, scale=dk**-0.5)
+    product = layers.matmul(scaled_q, k, transpose_y=True)
+    if causal:
+        import numpy as np
+
+        t = product.shape[-1]
+        mask = layers.assign(
+            np.triu(np.full((t, t), -1e9, "float32"), k=1))
+        product = layers.elementwise_add(product, mask)
+    weights = layers.softmax(product)
+    weights = layers.dropout(weights, dropout_prob=dropout_rate)
+    ctx = layers.matmul(weights, v)
     ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
     return layers.reshape(ctx, shape=[0, 0, d])

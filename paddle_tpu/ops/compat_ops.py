@@ -464,32 +464,47 @@ def _alloc_continuous_space(ctx, ins, attrs):
 
 @register("flash_attention")
 def _flash_attention_op(ctx, ins, attrs):
-    """Fused attention exposed as a graph op: Q/K/V [B, H, T, Dh] -> Out.
-    Dispatches to the tuned TPU flash kernel / portable Pallas kernel
-    (ops/pallas_kernels.flash_attention); differentiable through the
-    kernels' own VJPs."""
+    """Fused attention exposed as a graph op. Q/K/V layout is [B, H, T, Dh]
+    (attr layout="bhtd", default) or [B, T, H, Dh] ("bthd" — transpose-free
+    from a reshape of [B, T, D], XLA folds the layout into the dots).
+
+    Dispatches to the tuned TPU flash kernel whenever the shape tiles
+    (in-model profile on v5e at B128/H8/T512/D64: flash fwd ~1.8 ms vs the
+    XLA-fused softmax path's ~1 GB materialized score/prob buffers); the
+    XLA path covers shapes the blocked kernels can't tile.
+    Differentiable through the kernels' own VJPs."""
     from .pallas_kernels import flash_attention
 
     q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
-    out_dtype = q.dtype
     if attrs.get("__amp_bf16__") and q.dtype == jnp.float32:
         # AMP white-list marking: bf16 QKV matmuls (softmax stays fp32
-        # inside the kernels), output cast back to fp32
+        # inside the kernels); output stays bf16 like every white-list op
         q, k, v = (t.astype(jnp.bfloat16) for t in (q, k, v))
+    out_dtype = q.dtype
     causal = attrs.get("causal", False)
     scale = attrs.get("sm_scale", None)
+    layout = attrs.get("layout", "bhtd")
+    t_axis = 2 if layout == "bhtd" else 1
     Dh = q.shape[-1]
-    T = q.shape[2]
-    if T % 128 == 0 and Dh >= 64 and q.shape == k.shape:
-        out = flash_attention(q, k, v, causal, scale).astype(out_dtype)
-    else:  # shapes the blocked kernels can't tile: plain fused softmax
+    T = q.shape[t_axis]
+    use_pallas = (T % 128 == 0 and Dh >= 64 and q.shape == k.shape)
+    if use_pallas:
+        if layout == "bthd":
+            q, k, v = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+        out = flash_attention(q, k, v, causal, scale)
+        if layout == "bthd":
+            out = jnp.swapaxes(out, 1, 2)
+    else:  # XLA-fused softmax attention, layout folded into the dots
         s = scale if scale is not None else Dh ** -0.5
-        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+        qs, ks, vs = (("bhqd", "bhkd", "bhkd") if layout == "bhtd"
+                      else ("bqhd", "bkhd", "bkhd"))
+        logits = jnp.einsum("%s,%s->bhqk" % (qs, ks), q, k,
                             preferred_element_type=jnp.float32) * s
         if causal:
             Tq, Tk = logits.shape[-2], logits.shape[-1]
             mask = jnp.tril(jnp.ones((Tq, Tk), bool), Tk - Tq)
             logits = jnp.where(mask, logits, -1e30)
         p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-        out = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+        out_spec = "bhqd" if layout == "bhtd" else "bqhd"
+        out = jnp.einsum("bhqk,%s->%s" % (vs, out_spec), p, v)
     return {"Out": [out.astype(out_dtype)]}

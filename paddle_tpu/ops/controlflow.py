@@ -147,6 +147,35 @@ def _while(ctx, ins, attrs):
     return {"Out": [final_env[n] for n in out_names]}
 
 
+@register("recompute")
+def _recompute(ctx, ins, attrs):
+    """Rematerialized segment (the TPU-native remat knob; the reference's
+    later RecomputeOptimizer plays this role on GPU). Forward executes the
+    sub_block once; because the segment function is wrapped in
+    `jax.checkpoint`, the generic vjp grad op (core/lowering.py
+    _execute_grad_op) saves only the segment INPUTS as residuals and
+    re-executes the sub_block — behind an XLA optimization barrier, so CSE
+    cannot merge it back with the forward — during the backward pass.
+    Activations internal to the segment never stay live between forward and
+    backward, trading FLOPs for HBM exactly like jax.checkpoint on a
+    hand-written model. Deterministic per-op PRNG (ctx.rng folds on the op
+    seed, not trace position) guarantees dropout masks agree between the
+    forward run and the backward recompute."""
+    block = attrs["sub_block"]
+    x_names = list(attrs["x_names"])
+    out_names = list(attrs["out_names"])
+
+    @jax.checkpoint
+    def seg(*vals):
+        local = dict(zip(x_names, vals))
+        with ctx.inner_trace():
+            execute_block(block, local, ctx)
+        return tuple(local[n] for n in out_names)
+
+    outs = seg(*ins.get("X", []))
+    return {"Out": list(outs)}
+
+
 @register("cond")
 def _cond(ctx, ins, attrs):
     """Functional two-branch conditional (modern layers.cond; IfElse/Switch

@@ -54,8 +54,12 @@ def _conv_nd(x, w, strides, paddings, dilations, groups, nd, transpose=False):
 def _amp_bf16_pair(x, w, attrs):
     """AMP white-list marking (contrib/mixed_precision): bf16 inputs with
     fp32 accumulation — exactly the MXU's native mode. Differentiable
-    because the cast sits inside the op's own vjp."""
-    if attrs.get("__amp_bf16__") and x.dtype == jnp.float32:
+    because the cast sits inside the op's own vjp. Mixed operands (one
+    already bf16 from an upstream white op) cast down together —
+    lax.conv requires matching dtypes."""
+    if attrs.get("__amp_bf16__") \
+            and x.dtype in (jnp.float32, jnp.bfloat16) \
+            and w.dtype in (jnp.float32, jnp.bfloat16):
         return x.astype(jnp.bfloat16), w.astype(jnp.bfloat16)
     return x, w
 
@@ -71,8 +75,8 @@ def _make_conv(name, nd, transpose=False):
             tuple(attrs.get("dilations", [1] * nd)),
             attrs.get("groups", 1) or 1, nd, transpose,
         )
-        if attrs.get("__amp_bf16__") and out.dtype == jnp.bfloat16:
-            out = out.astype(jnp.float32)
+        # white-list AMP output stays bf16 (reference fp16 semantics): the
+        # following batch_norm (black list) upcasts to fp32 itself
         if ins.get("FoldedBias"):
             # per-out-channel shift left behind by conv+bn folding
             # (transpiler/inference_transpiler.py)

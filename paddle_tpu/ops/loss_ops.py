@@ -4,6 +4,8 @@ hinge_loss,log_loss,rank_loss,margin_rank_loss,smooth_l1_loss,kldiv_loss,
 bpr_loss,npair_loss,...}.cc).
 """
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -42,12 +44,64 @@ def _cross_entropy2(ctx, ins, attrs):
     return {"Y": [loss], "MatchX": [p], "XShape": [jnp.zeros((0,) + x.shape, x.dtype)]}
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _hard_label_ce(logits, lab, ignore_index):
+    """Mean-free per-position CE with a memory-lean vjp: residuals are the
+    LOGITS themselves (bf16 under AMP), not the fp32 log-softmax — for an
+    LM head that is the difference between pinning 8G and 4G in HBM.
+    Backward recomputes softmax from logits (elementwise + one reduction:
+    the cheap kind of remat, matching what XLA's own rematerializer picks
+    for the native-path head)."""
+    loss, _ = _hard_label_ce_fwd(logits, lab, ignore_index)
+    return loss
+
+
+def _hard_label_ce_fwd(logits, lab, ignore_index):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(logp, lab[..., None].astype(jnp.int32),
+                                 axis=-1)
+    loss = jnp.where(lab[..., None] == ignore_index, 0.0, -picked)
+    return loss, (logits, lab)
+
+
+def _hard_label_ce_bwd(ignore_index, res, g):
+    logits, lab = res
+    # barrier: without it XLA CSEs this upcast with the forward's and
+    # keeps the full fp32 logits alive from forward to backward — the
+    # exact buffer this custom vjp exists to avoid
+    logits = jax.lax.optimization_barrier(logits)
+    xf = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(xf, axis=-1, keepdims=True)
+    # dlogits in the LOGITS dtype end to end: softmax values are in [0, 1]
+    # where bf16 carries ~3 digits, and keeping the whole chain low
+    # precision lets XLA emit one fused elementwise pass (bf16 in, bf16
+    # out) instead of materializing a full-vocab fp32 intermediate
+    sm = jnp.exp(xf - lse).astype(logits.dtype)
+    onehot = jax.nn.one_hot(lab, logits.shape[-1], dtype=logits.dtype)
+    gv = jnp.where(lab[..., None] != ignore_index, g, 0.0)
+    dlogits = (sm - onehot) * gv.astype(logits.dtype)
+    return dlogits, None
+
+
+_hard_label_ce.defvjp(_hard_label_ce_fwd, _hard_label_ce_bwd)
+
+
 @register("softmax_with_cross_entropy", nondiff_inputs=("Label",))
 def _softmax_with_cross_entropy(ctx, ins, attrs):
     logits, label = ins["Logits"][0], ins["Label"][0]
     soft = attrs.get("soft_label", False)
     ignore_index = attrs.get("ignore_index", -100)
     axis = attrs.get("axis", -1)
+    if not soft and axis in (-1, logits.ndim - 1):
+        lab = label
+        if lab.shape and lab.shape[-1] == 1:
+            lab = lab.reshape(lab.shape[:-1])
+        loss = _hard_label_ce(logits, lab, ignore_index)
+        softmax = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        # Loss stays fp32 even for bf16 logits (black-list AMP
+        # semantics): downstream sums over ~1e5 per-token losses would
+        # lose ~3 digits in bf16
+        return {"Softmax": [softmax.astype(logits.dtype)], "Loss": [loss]}
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
     softmax = jnp.exp(logp)
     if soft:
@@ -56,12 +110,11 @@ def _softmax_with_cross_entropy(ctx, ins, attrs):
         lab = label
         if lab.shape and lab.shape[-1] == 1:
             lab = lab.reshape(lab.shape[:-1])
-        picked = jnp.take_along_axis(logp, lab[..., None].astype(jnp.int32),
-                                     axis=axis)
-        loss = -picked
-        loss = jnp.where(lab[..., None] == ignore_index, 0.0, loss)
-    return {"Softmax": [softmax.astype(logits.dtype)],
-            "Loss": [loss.astype(logits.dtype)]}
+        picked = jnp.take_along_axis(
+            jnp.moveaxis(logp, axis, -1),
+            lab[..., None].astype(jnp.int32), axis=-1)
+        loss = jnp.where(lab[..., None] == ignore_index, 0.0, -picked)
+    return {"Softmax": [softmax.astype(logits.dtype)], "Loss": [loss]}
 
 
 @register("sigmoid_cross_entropy_with_logits", nondiff_inputs=("Label",))

@@ -127,12 +127,24 @@ def _linspace(ctx, ins, attrs):
 
 
 def _amp_dot(x, y, attrs):
-    """AMP white-list matmul: bf16 operands, fp32 accumulation (MXU-native);
-    plain `@` otherwise."""
+    """AMP white-list matmul: bf16 operands, fp32 MXU accumulation, bf16
+    output (reference AMP semantics — white-list ops produce the low
+    precision dtype, fp16_utils.py rewrite_program). The bf16 output
+    matters twice: activations cost half the HBM, and the BACKWARD matmuls
+    see bf16 cotangents — an fp32 cotangent operand would knock the grad
+    dots off the MXU fast path (fp32 dots decompose into multiple bf16
+    passes). Plain `@` otherwise."""
     if attrs.get("__amp_bf16__") and x.dtype == jnp.float32 \
             and y.dtype == jnp.float32:
         return jnp.matmul(x.astype(jnp.bfloat16), y.astype(jnp.bfloat16),
-                          preferred_element_type=jnp.float32)
+                          preferred_element_type=jnp.float32
+                          ).astype(jnp.bfloat16)
+    if attrs.get("__amp_bf16__") and jnp.bfloat16 in (x.dtype, y.dtype):
+        # mixed fp32/bf16 operands (one input already produced by a white
+        # op): keep the dot fully bf16
+        return jnp.matmul(x.astype(jnp.bfloat16), y.astype(jnp.bfloat16),
+                          preferred_element_type=jnp.float32
+                          ).astype(jnp.bfloat16)
     return x @ y
 
 

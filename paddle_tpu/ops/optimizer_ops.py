@@ -27,7 +27,11 @@ def _lr(ins):
 def _sgd(ctx, ins, attrs):
     p, g = _p(ins, "Param"), _p(ins, "Grad")
     lr = _lr(ins)
-    return {"ParamOut": [(p - lr.astype(p.dtype) * g.astype(p.dtype))]}
+    # update math in fp32 even for bf16 params (a bf16 lr*g product under-
+    # flows tiny updates); the rounding happens once, on the write-back
+    new = (p.astype(jnp.float32)
+           - lr.astype(jnp.float32) * g.astype(jnp.float32))
+    return {"ParamOut": [new.astype(p.dtype)]}
 
 
 @register("momentum", differentiable=False)

@@ -139,6 +139,20 @@ class Optimizer:
             self._finish_update(block, params_grads)
             return list(block.ops[start:])
 
+    def apply_optimize(self, loss, startup_program, params_grads):
+        """Second half of minimize() (parity: optimizer.py apply_optimize —
+        the hook subclasses/extensions override to wrap apply_gradients)."""
+        return self.apply_gradients(params_grads)
+
+    def get_opti_var_name_list(self):
+        """Names of optimizer-created vars: accumulators + the global LR
+        (parity: optimizer.py get_opti_var_name_list)."""
+        names = []
+        for acc_map in self._accumulators.values():
+            names.extend(v.name for v in acc_map.values())
+        names.extend(v.name for v in self._learning_rate_map.values())
+        return names
+
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
         from .dygraph import base as dy_base
@@ -147,7 +161,8 @@ class Optimizer:
             return self._dygraph_minimize(loss, parameter_list)
         params_grads = self.backward(loss, startup_program, parameter_list,
                                      no_grad_set)
-        optimize_ops = self.apply_gradients(params_grads)
+        optimize_ops = self.apply_optimize(loss, startup_program,
+                                           params_grads)
         return optimize_ops, params_grads
 
     # -- dygraph (eager) path ------------------------------------------------

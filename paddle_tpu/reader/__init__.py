@@ -15,7 +15,28 @@ import threading
 
 __all__ = ["map_readers", "buffered", "compose", "chain", "shuffle",
            "firstn", "xmap_readers", "cache", "batch", "PyReader",
-           "multiprocess_reader", "PipeReader", "creator"]
+           "multiprocess_reader", "PipeReader", "creator", "Fake"]
+
+
+class Fake:
+    """Cache the first sample of a real reader and replay it `data_num`
+    times — for feed-pipeline speed testing without parsing cost (parity:
+    python/paddle/reader/decorator.py:531 Fake)."""
+
+    def __init__(self):
+        self.data = None
+        self.yield_num = 0
+
+    def __call__(self, reader, data_num):
+        def fake_reader():
+            if self.data is None:
+                self.data = next(reader())
+            while self.yield_num < data_num:
+                yield self.data
+                self.yield_num += 1
+            self.yield_num = 0
+
+        return fake_reader
 
 from . import creator  # noqa: F401,E402
 
@@ -226,6 +247,26 @@ class PyReader:
         self._places = places
 
     decorate_paddle_reader = decorate_sample_list_generator
+
+    def decorate_sample_generator(self, sample_generator, batch_size,
+                                  drop_last=True, places=None):
+        """Per-SAMPLE generator source (parity: fluid/reader.py
+        decorate_sample_generator): batches are assembled host-side then
+        fed like decorate_sample_list_generator."""
+        if batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+
+        def batched():
+            buf = []
+            for sample in sample_generator():
+                buf.append(sample)
+                if len(buf) == batch_size:
+                    yield buf
+                    buf = []
+            if buf and not drop_last:
+                yield buf
+
+        self.decorate_sample_list_generator(batched, places)
 
     def decorate_batch_generator(self, generator, places=None):
         self._generator = generator

@@ -14,7 +14,8 @@ import numpy as np
 
 from .core import native
 
-__all__ = ["convert_reader_to_recordio_file", "recordio_reader_creator",
+__all__ = ["convert_reader_to_recordio_file",
+           "convert_reader_to_recordio_files", "recordio_reader_creator",
            "serialize_sample", "deserialize_sample"]
 
 
@@ -64,6 +65,38 @@ def convert_reader_to_recordio_file(filename, reader_creator,
             n += 1
     finally:
         w.close()
+    return n
+
+
+def convert_reader_to_recordio_files(filename, batch_per_file,
+                                     reader_creator, compressor=None,
+                                     max_num_records=1000, feeder=None):
+    """Split a reader across many recordio files, `batch_per_file` records
+    each: name.recordio -> name-00000.recordio, name-00001.recordio, ...
+    (parity: fluid/recordio_writer.py:91). Returns the record count."""
+    import os
+
+    f_name, f_ext = os.path.splitext(filename)
+    if f_ext != ".recordio":
+        raise ValueError("filename must end with .recordio")
+    n = 0
+    f_idx = 0
+    w = None
+    try:
+        for sample in reader_creator():
+            if w is None:
+                w = native.RecordIOWriter(
+                    "%s-%05d%s" % (f_name, f_idx, f_ext),
+                    max_chunk_records=max_num_records)
+            w.write(serialize_sample(sample))
+            n += 1
+            if n % batch_per_file == 0:
+                w.close()
+                w = None
+                f_idx += 1
+    finally:
+        if w is not None:
+            w.close()
     return n
 
 

@@ -194,6 +194,14 @@ class DistributeTranspiler:
     def get_trainer_program(self, wait_port=True):
         return self.trainer_program
 
+    def get_pserver_programs(self, endpoint):
+        """(main_program, startup_program) pair for one pserver endpoint
+        (parity: distribute_transpiler.py:974)."""
+        pserver_prog = self.get_pserver_program(endpoint)
+        pserver_startup = self.get_startup_program(
+            endpoint, pserver_program=pserver_prog)
+        return pserver_prog, pserver_startup
+
     def get_pserver_program(self, endpoint):
         """One program per endpoint: a listen_and_serv op whose sub-blocks
         hold the optimizer ops for this endpoint's param blocks

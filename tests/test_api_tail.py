@@ -1,0 +1,213 @@
+"""Round-2 API-tail additions (VERDICT item 5): contrib.ctr_reader,
+op_freq_statistic, lookup-table utils, extend_with_decoupled_weight_decay,
+InitState, Program.to_string/parse_from_string,
+PyReader.decorate_sample_generator, create_lod_tensor exports,
+initializer.init_on_cpu, reader.Fake, DataFeeder.feed_parallel."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _simple_program():
+    prog, sprog = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, sprog):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        y = layers.data(name="y", shape=[1], dtype="int64")
+        h = layers.fc(x, 8, act="relu")
+        logits = layers.fc(h, 3)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, y))
+    return prog, sprog, loss
+
+
+def test_op_freq_statistic():
+    prog, _, _ = _simple_program()
+    uni, adj = fluid.contrib.op_freq_statistic(prog)
+    d = dict(uni)
+    assert d["mul"] == 2 and d["relu"] == 1
+    assert any("->" in k for k, _ in adj)
+    with pytest.raises(TypeError):
+        fluid.contrib.op_freq_statistic("not a program")
+
+
+def test_program_to_string_and_parse_roundtrip():
+    prog, _, loss = _simple_program()
+    s = prog.to_string(throw_on_error=False, with_details=True)
+    assert "mul" in s and "persistable" in s
+    clone = fluid.Program.parse_from_string(prog.to_json())
+    assert [op.type for op in clone.global_block().ops] == \
+        [op.type for op in prog.global_block().ops]
+
+
+def test_extend_with_decoupled_weight_decay():
+    prog, sprog = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, sprog):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        y = layers.fc(x, 1, param_attr=fluid.ParamAttr(name="dw_w"),
+                      bias_attr=False)
+        loss = layers.mean(y)
+        AdamW = fluid.contrib.extend_with_decoupled_weight_decay(
+            fluid.optimizer.Adam)
+        AdamW(weight_decay=0.5, learning_rate=0.0).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = fluid.core.scope.Scope()
+    with fluid.scope_guard(sc):
+        exe.run(sprog)
+        w0 = np.asarray(sc.get("dw_w")).copy()
+        exe.run(prog, feed={"x": np.ones((2, 4), np.float32)},
+                fetch_list=[loss])
+        w1 = np.asarray(sc.get("dw_w"))
+    # lr=0 -> the only update is the decoupled decay: w1 = w0 - 0.5*w0
+    np.testing.assert_allclose(w1, 0.5 * w0, rtol=1e-5)
+    with pytest.raises(TypeError):
+        fluid.contrib.extend_with_decoupled_weight_decay(object)
+
+
+def test_ctr_reader_csv(tmp_path):
+    p = tmp_path / "part-0.txt"
+    lines = ["1 0.5,1.5 3,7", "0 2.0,0.25 9", "1 1.0,1.0 4,5,6"]
+    p.write_text("\n".join(lines))
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        label = layers.data(name="ctr_label", shape=[1], dtype="int64")
+        dense = layers.data(name="ctr_dense", shape=[2], dtype="float32")
+        sparse = layers.data(name="ctr_sparse", shape=[1], dtype="int64",
+                             lod_level=1)
+        rd = fluid.contrib.ctr_reader.ctr_reader(
+            feed_dict=[label, dense, sparse], file_type="plain",
+            file_format="csv", dense_slot_index=[1], sparse_slot_index=[2],
+            capacity=8, thread_num=1, batch_size=2,
+            file_list=[str(p)], slots=[])
+    batches = list(rd)
+    assert len(batches) == 2
+    b0 = batches[0]
+    np.testing.assert_array_equal(b0["ctr_label"].ravel(), [1, 0])
+    np.testing.assert_allclose(b0["ctr_dense"],
+                               [[0.5, 1.5], [2.0, 0.25]])
+    assert b0["ctr_sparse"].shape == (2, 2)  # padded to widest row
+
+
+def test_ctr_reader_svm(tmp_path):
+    p = tmp_path / "part-0.svm"
+    p.write_text("1 10:3 11:7\n0 10:4\n")
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        label = layers.data(name="svm_label", shape=[1], dtype="int64")
+        s10 = layers.data(name="svm_s10", shape=[1], dtype="int64",
+                          lod_level=1)
+        s11 = layers.data(name="svm_s11", shape=[1], dtype="int64",
+                          lod_level=1)
+        rd = fluid.contrib.ctr_reader.ctr_reader(
+            feed_dict=[label, s10, s11], file_type="plain",
+            file_format="svm", dense_slot_index=[], sparse_slot_index=[],
+            capacity=8, thread_num=1, batch_size=2,
+            file_list=[str(p)], slots=[10, 11])
+    b, = list(rd)
+    np.testing.assert_array_equal(b["svm_label"].ravel(), [1, 0])
+    np.testing.assert_array_equal(b["svm_s10"], [[3], [4]])
+
+
+def test_convert_dist_to_sparse_program():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        ids = layers.data(name="ids", shape=[1], dtype="int64")
+        emb = layers.embedding(ids, size=[100, 8], is_distributed=True)
+        layers.mean(emb)
+    out = fluid.contrib.convert_dist_to_sparse_program(prog)
+    ops = [op for op in out.global_block().ops
+           if op.type == "lookup_table"]
+    assert ops and not ops[0].attrs["is_distributed"]
+    assert ops[0].attrs["is_sparse"]
+
+
+def test_load_persistables_for_inference(tmp_path):
+    prog, sprog, loss = _simple_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = fluid.core.scope.Scope()
+    with fluid.scope_guard(sc):
+        exe.run(sprog)
+        fluid.io.save_persistables(exe, str(tmp_path), main_program=prog)
+        names = [p.name for p in prog.all_parameters()]
+        saved = {n: np.asarray(sc.get(n)).copy() for n in names}
+    sc2 = fluid.core.scope.Scope()
+    with fluid.scope_guard(sc2):
+        fluid.contrib.load_persistables_for_inference(
+            str(tmp_path), exe, prog, names[0])
+        for n in names:
+            np.testing.assert_array_equal(np.asarray(sc2.get(n)), saved[n])
+
+
+def test_init_state():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        boot = layers.data(name="boot", shape=[6], dtype="float32")
+        st = fluid.contrib.InitState(init_boot=boot, shape=[-1, 6],
+                                     value=0.5)
+        assert st.value is not None and not st.need_reorder
+        with pytest.raises(ValueError):
+            fluid.contrib.InitState()
+
+
+def test_pyreader_decorate_sample_generator():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data(name="sg_x", shape=[2], dtype="float32")
+        rd = fluid.reader.PyReader(feed_list=[x], capacity=4)
+
+    def samples():
+        for i in range(5):
+            yield (np.full((2,), i, np.float32),)
+
+    rd.decorate_sample_generator(samples, batch_size=2, drop_last=True)
+    batches = list(rd)
+    assert len(batches) == 2  # 5 samples, batch 2, drop_last
+    np.testing.assert_allclose(batches[0]["sg_x"], [[0, 0], [1, 1]])
+
+
+def test_reader_fake():
+    calls = []
+
+    def real():
+        calls.append(1)
+        yield from range(10)
+
+    fake = fluid.reader.Fake()(real, 4)
+    assert list(fake()) == [0, 0, 0, 0]
+    assert list(fake()) == [0, 0, 0, 0]  # replays, reader consumed once
+    assert len(calls) == 1
+
+
+def test_feed_parallel():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data(name="fp_x", shape=[3], dtype="float32")
+        feeder = fluid.DataFeeder(feed_list=[x])
+    b1 = [(np.zeros(3, np.float32),), (np.ones(3, np.float32),)]
+    b2 = [(np.full(3, 2.0, np.float32),)]
+    feeds = list(feeder.feed_parallel([b1, b2], num_places=2))
+    assert len(feeds) == 2
+    assert feeds[0]["fp_x"].shape == (2, 3)
+    with pytest.raises(ValueError):
+        list(feeder.feed_parallel([b1], num_places=2))
+
+
+def test_init_on_cpu_scope():
+    from paddle_tpu import initializer
+
+    assert not initializer.force_init_on_cpu()
+    with initializer.init_on_cpu():
+        assert initializer.force_init_on_cpu()
+    assert not initializer.force_init_on_cpu()
+
+
+def test_top_level_lod_tensor_helpers():
+    t = fluid.create_lod_tensor(np.arange(6).reshape(6, 1), [[2, 4]])
+    assert t.recursive_sequence_lengths() == [[2, 4]]
+    r = fluid.create_random_int_lodtensor([[3, 2]], [1], low=0, high=9)
+    arr = np.asarray(r)
+    assert arr.shape == (5, 1) and arr.max() <= 9

@@ -1,0 +1,238 @@
+"""Method-granularity API-tail additions (VERDICT item 5 second half):
+optimizer apply_optimize/get_opti_var_name_list, DataFeeder.decorate_reader,
+DistributeTranspiler.get_pserver_programs, StaticRNN/DynamicRNN
+static_input, the imperative StateCell/TrainingDecoder/BeamSearchDecoder
+surfaces, QuantizeTranspiler.convert_to_int8, and
+convert_reader_to_recordio_files — plus the `--against-reference` API
+audit itself."""
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def test_optimizer_apply_optimize_and_var_names():
+    prog, sprog = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, sprog):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        loss = layers.mean(layers.fc(x, 2))
+        opt = fluid.optimizer.Adam(1e-3)
+        opt.minimize(loss)
+    names = opt.get_opti_var_name_list()
+    # Adam: 2 params (w, b) x 2 moments + 2 beta-pows (impl-dependent) + lr
+    assert any("moment" in n for n in names)
+    assert len(names) >= 5
+
+
+def test_datafeeder_decorate_reader():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data(name="dr_x", shape=[2], dtype="float32")
+        feeder = fluid.DataFeeder(feed_list=[x])
+
+    def rd():
+        for i in range(4):
+            yield [(np.full((2,), i, np.float32),)]
+
+    single = list(feeder.decorate_reader(rd, multi_devices=False)())
+    assert len(single) == 4 and single[0]["dr_x"].shape == (1, 2)
+    grouped = list(feeder.decorate_reader(rd, multi_devices=True,
+                                          num_places=2)())
+    assert len(grouped) == 2 and len(grouped[0]) == 2
+    with pytest.raises(ValueError):
+        list(feeder.decorate_reader(
+            lambda: iter([[(np.zeros(2, np.float32),)]] * 3),
+            multi_devices=True, num_places=2, drop_last=False)())
+
+
+def test_get_pserver_programs():
+    prog, sprog = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, sprog):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        loss = layers.mean(layers.fc(x, 2))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        t = fluid.DistributeTranspiler()
+        t.transpile(trainer_id=0, pservers="127.0.0.1:6174", trainers=1)
+        main, startup = t.get_pserver_programs("127.0.0.1:6174")
+    assert any(op.type == "listen_and_serv"
+               for op in main.global_block().ops)
+    assert len(startup.global_block().ops) > 0
+
+
+def test_training_decoder_imperative_block():
+    from paddle_tpu.contrib import InitState, StateCell, TrainingDecoder
+
+    prog, sprog = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, sprog):
+        src = layers.data(name="td_src", shape=[5, 3], dtype="float32")
+        boot = layers.data(name="td_boot", shape=[4], dtype="float32")
+        cell = StateCell(inputs={"x": None},
+                         states={"h": InitState(init=boot)},
+                         out_state="h")
+
+        @cell.state_updater
+        def updater(c):
+            x = c.get_input("x")
+            h = c.get_state("h")
+            c.set_state("h", layers.fc(layers.concat([x, h], axis=1), 4,
+                                       act="tanh",
+                                       param_attr=fluid.ParamAttr(
+                                           name="td_w"),
+                                       bias_attr=False))
+
+        decoder = TrainingDecoder(cell)
+        with decoder.block():
+            cur = decoder.step_input(src)
+            cell.compute_state(inputs={"x": cur})
+            cell.update_states()
+            decoder.output(cell.get_state("h"))
+        out = decoder()
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = fluid.core.scope.Scope()
+    with fluid.scope_guard(sc):
+        exe.run(sprog)
+        res, = exe.run(prog, feed={
+            "td_src": np.random.rand(2, 5, 3).astype(np.float32),
+            "td_boot": np.zeros((2, 4), np.float32)},
+            fetch_list=[out])
+    assert np.asarray(res).shape == (2, 5, 4)
+
+
+def test_beam_search_decoder_imperative_block():
+    from paddle_tpu.contrib import StateCell, BeamSearchDecoder
+
+    prog, sprog = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, sprog):
+        ids0 = layers.data(name="bs_ids", shape=[2], dtype="int64")
+        sc0 = layers.data(name="bs_sc", shape=[2], dtype="float32")
+        cell = StateCell(inputs=["ids"], states=[], out_state=None)
+        dec = BeamSearchDecoder(cell, ids0, sc0, target_dict_dim=7,
+                                beam_size=2, end_id=0, max_len=3)
+        with dec.block():
+            prev = dec.read_array(init=sc0, is_scores=True)
+            dec.update_array(prev, layers.scale(prev, scale=2.0))
+        final_scores, = dec()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scpe = fluid.core.scope.Scope()
+    with fluid.scope_guard(scpe):
+        exe.run(sprog)
+        res, = exe.run(prog, feed={
+            "bs_ids": np.zeros((1, 2), np.int64),
+            "bs_sc": np.ones((1, 2), np.float32)},
+            fetch_list=[final_scores])
+    # 3 iterations of doubling: 1 -> 8
+    np.testing.assert_allclose(np.asarray(res), 8.0 * np.ones((1, 2)))
+
+
+def test_beam_search_decoder_early_stop():
+    """early_stop must terminate the loop even though the end-of-body
+    condition update runs after it (regression: the stop flag is ANDed
+    into the condition, not overwritten by it)."""
+    from paddle_tpu.contrib import StateCell, BeamSearchDecoder
+
+    prog, sprog = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, sprog):
+        ids0 = layers.data(name="es_ids", shape=[2], dtype="int64")
+        sc0 = layers.data(name="es_sc", shape=[2], dtype="float32")
+        cell = StateCell(inputs=["ids"], states=[], out_state=None)
+        dec = BeamSearchDecoder(cell, ids0, sc0, target_dict_dim=7,
+                                beam_size=2, end_id=0, max_len=5)
+        with dec.block():
+            prev = dec.read_array(init=sc0, is_scores=True)
+            dec.update_array(prev, layers.scale(prev, scale=2.0))
+            dec.early_stop()
+        final, = dec()
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = fluid.core.scope.Scope()
+    with fluid.scope_guard(sc):
+        exe.run(sprog)
+        res, = exe.run(prog, feed={"es_ids": np.zeros((1, 2), np.int64),
+                                   "es_sc": np.ones((1, 2), np.float32)},
+                       fetch_list=[final])
+    np.testing.assert_allclose(np.asarray(res), 2.0 * np.ones((1, 2)))
+
+
+def test_state_cell_set_state_rejects_unknown():
+    from paddle_tpu.contrib import StateCell
+
+    cell = StateCell(inputs=["x"], states=["h"], out_state="h")
+    with pytest.raises(ValueError, match="unknown"):
+        cell.set_state("hh", None)
+
+
+def test_static_input_methods_exist_and_flow():
+    prog, sprog = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, sprog):
+        seq = layers.data(name="si_seq", shape=[4, 3], dtype="float32")
+        ctx = layers.data(name="si_ctx", shape=[3], dtype="float32")
+        drnn = fluid.layers.DynamicRNN()
+        with drnn.block():
+            x = drnn.step_input(seq)
+            c = drnn.static_input(ctx)
+            h = layers.elementwise_add(x, c)
+            drnn.output(h)
+        out = drnn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = fluid.core.scope.Scope()
+    with fluid.scope_guard(sc):
+        exe.run(sprog)
+        res, = exe.run(prog, feed={
+            "si_seq": np.ones((2, 4, 3), np.float32),
+            "si_ctx": np.full((2, 3), 5.0, np.float32)},
+            fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(res), 6.0 * np.ones((2, 4, 3)))
+
+
+def test_quantize_convert_to_int8():
+    prog, sprog = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, sprog):
+        x = layers.data(name="q_x", shape=[4], dtype="float32")
+        layers.fc(x, 3, param_attr=fluid.ParamAttr(name="q_w"),
+                  bias_attr=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = fluid.core.scope.Scope()
+    with fluid.scope_guard(sc):
+        exe.run(sprog)
+        w = np.asarray(sc.get("q_w"))
+        t = fluid.contrib.QuantizeTranspiler()
+        t.convert_to_int8(prog, scope=sc)
+        q = np.asarray(sc.get("q_w.int8"))
+        assert q.dtype == np.int8
+        iv = prog.global_block().var("q_w.int8")
+        np.testing.assert_allclose(q.astype(np.float32) * iv.quant_scale,
+                                   w, atol=iv.quant_scale)
+
+
+def test_convert_reader_to_recordio_files(tmp_path):
+    fn = str(tmp_path / "data.recordio")
+
+    def rd():
+        for i in range(5):
+            yield [np.full((2,), i, np.float32)]
+
+    n = fluid.recordio_writer.convert_reader_to_recordio_files(
+        fn, batch_per_file=2, reader_creator=rd)
+    assert n == 5
+    import os
+    files = sorted(f for f in os.listdir(tmp_path) if "data-" in f)
+    assert len(files) == 3  # 2 + 2 + 1
+
+
+def test_api_audit_against_reference_spec():
+    """The VERDICT item-5 'done' check: zero unexplained absences vs the
+    reference's 579-line API.spec."""
+    import os
+    ref = "/root/reference/paddle/fluid/API.spec"
+    if not os.path.exists(ref):
+        pytest.skip("reference API.spec not present")
+    out = subprocess.run(
+        [sys.executable, "tools/diff_api.py", "--against-reference", ref],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "zero unexplained absences" in out.stdout

@@ -42,6 +42,27 @@ def _sig(obj):
         return "(...)"
 
 
+def _class_lines(qual, cls):
+    """Method-granularity pin for a class (the reference freezes each
+    public method's ArgSpec on its own line — optimizer .minimize/
+    .backward/.apply_gradients, Program.block, While.block, ... —
+    API.spec:1-579)."""
+    lines = ["%s.__init__ %s" % (qual, _sig(cls.__init__))]
+    for mname in sorted(dir(cls)):
+        if mname.startswith("_"):
+            continue
+        m = inspect.getattr_static(cls, mname)
+        if isinstance(m, (staticmethod, classmethod)):
+            m = m.__func__
+        # include INHERITED methods defined anywhere in the package —
+        # Adam.minimize pins Optimizer.minimize's signature, so a base-
+        # class signature change still trips the freeze
+        if inspect.isfunction(m) and \
+                getattr(m, "__module__", "").startswith("paddle_tpu"):
+            lines.append("%s.%s %s" % (qual, mname, _sig(m)))
+    return lines
+
+
 def spec_lines():
     import importlib
 
@@ -59,7 +80,7 @@ def spec_lines():
             if inspect.ismodule(obj):
                 lines.append("%s <module>" % qual)
             elif inspect.isclass(obj):
-                lines.append("%s.__init__ %s" % (qual, _sig(obj.__init__)))
+                lines.extend(_class_lines(qual, obj))
             elif callable(obj):
                 lines.append("%s %s" % (qual, _sig(obj)))
             else:
