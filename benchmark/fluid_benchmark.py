@@ -101,14 +101,18 @@ def _feeds(model, batch, rng, data_set=None):
 def _build(model, data_set=None):
     from paddle_tpu import models
 
+    big = data_set in ("imagenet", "flowers")
     if model == "mnist":
         *_, loss, _acc = models.mnist.build(arch="mlp")
     elif model == "resnet":
         *_, loss, _acc = models.resnet.build(
-            dataset="imagenet" if data_set in ("imagenet", "flowers")
-            else "cifar10")
+            dataset="imagenet" if big else "cifar10")
     elif model == "vgg":
-        *_, loss, _acc = models.vgg.build(dataset="cifar10")
+        *_, loss, _acc = models.vgg.build(
+            dataset="imagenet" if big else "cifar10")
+    elif model == "se_resnext" and big:
+        *_, loss, _acc = models.se_resnext.build(
+            class_dim=1000, img_shape=(3, 224, 224))
     elif model == "stacked_dynamic_lstm":
         *_, loss, _acc = models.stacked_lstm.build()
     elif model == "machine_translation":
@@ -156,7 +160,7 @@ def run_transformer(args, seq_len=512):
     import paddle_tpu as fluid
     from paddle_tpu.models import transformer_fluid
 
-    batch = args.batch_size or 128
+    batch = args.batch_size or 160  # measured single-chip optimum (v5e-1)
     prog, sprog = fluid.Program(), fluid.Program()
     with fluid.program_guard(prog, sprog):
         _toks, _labs, loss = transformer_fluid.build(

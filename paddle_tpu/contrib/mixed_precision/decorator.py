@@ -81,25 +81,13 @@ class OptimizerWithMixedPrecision:
         rewrite_program parity, with bfloat16 as the compute type)."""
         if not self._use_bf16:
             return
-        # walk EVERY block, plus control-flow sub-blocks attached as op
-        # attrs (recompute/while/cond bodies) — a matmul inside a
-        # rematerialized transformer layer must hit the MXU in bf16 too
-        seen = set()
-
-        def mark(block):
-            if id(block) in seen:
-                return
-            seen.add(id(block))
+        # prog.blocks already enumerates every control-flow sub-block
+        # (recompute/while/cond bodies are created via _create_block) — a
+        # matmul inside a rematerialized transformer layer gets marked too
+        for block in prog.blocks:
             for op in block.ops:
                 if op.type in self._amp_lists.white_list:
                     op.attrs["__amp_bf16__"] = True
-                for battr in ("sub_block", "true_block", "false_block"):
-                    sub = op.attrs.get(battr)
-                    if isinstance(sub, framework.Block):
-                        mark(sub)
-
-        for block in prog.blocks:
-            mark(block)
         prog._bump_version()
 
     def backward(self, loss, startup_program=None, parameter_list=None,

@@ -230,7 +230,14 @@ def _execute_grad_op(op, env, ctx):
         return (gname is not None and gname in env
                 and jnp.issubdtype(jnp.result_type(prim), jnp.inexact))
 
-    probe = opdef.impl(ctx, fwd_ins, fwd.attrs)
+    # Probe output structure ABSTRACTLY (eval_shape emits no HLO): a real
+    # re-execution would duplicate the forward — for control-flow ops a
+    # whole second lax.scan/while that XLA cannot CSE across loop
+    # boundaries. inner_trace suppresses warn/nan collection, which would
+    # otherwise capture the probe's abstract tracers.
+    with ctx.inner_trace():
+        probe = jax.eval_shape(
+            lambda d: opdef.impl(ctx, d, fwd.attrs), fwd_ins)
     live_idx = {}
     for slot, prim_list in probe.items():
         idx = [i for i, prim in enumerate(prim_list)
@@ -239,8 +246,6 @@ def _execute_grad_op(op, env, ctx):
             live_idx[slot] = idx
     if not live_idx:
         return
-    # (the probe's compute is identical to the vjp's primal pass and to the
-    # op's own forward run, so XLA CSE/DCE collapses them to one)
 
     def f(d):
         outs = opdef.impl(ctx, {**const_ins, **d}, fwd.attrs)

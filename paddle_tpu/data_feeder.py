@@ -92,13 +92,13 @@ class DataFeeder:
         per place; the data-parallel executor splits its global batch over
         the mesh, so equal-size per-place batches concatenate to one
         sharded feed."""
-        if num_places is not None and len(iterable) != num_places:
+        batches = list(iterable)
+        if num_places is not None and len(batches) != num_places:
             raise ValueError(
                 "feed_parallel needs as many mini-batches as places "
                 "(got %d batches for %d places)"
-                % (len(iterable), num_places))
-        for batch in iterable:
-            yield self.feed(batch)
+                % (len(batches), num_places))
+        return (self.feed(b) for b in batches)
 
 
 class DataFeedDesc:

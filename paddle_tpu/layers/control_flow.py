@@ -431,9 +431,14 @@ class IfElse:
 
 class StaticRNN:
     """Time-major recurrence (control_flow.py:272): step inputs are sliced
-    on axis 0, memories carry across steps, outputs stack on axis 0."""
+    on axis 0, memories carry across steps, outputs stack on axis 0.
 
-    def __init__(self, name=None):
+    remat=True (TPU-native extension) rematerializes the step body in
+    backward — with stacked per-layer weights as step inputs this is the
+    native flagship's layers-under-lax.scan structure, through the API."""
+
+    def __init__(self, name=None, remat=False):
+        self.remat = remat
         self.helper = LayerHelper("static_rnn", name=name)
         self._step_inputs = []   # (outer var, inner var)
         self._memories = []      # (pre var, boot var); post filled by update
@@ -521,6 +526,9 @@ class StaticRNN:
             if post is None:
                 raise ValueError("memory %s never updated" % pre.name)
             mem_pairs.append((pre.name, post.name))
+        # expose final memory values (recurrent's FinalMemories output):
+        # final_memories[i] corresponds to the i-th memory() call
+        self.final_memories = finals
 
         parent.append_op(
             type="recurrent",
@@ -532,7 +540,8 @@ class StaticRNN:
                    "step_input_names": [i.name for _, i in self._step_inputs],
                    "memory_names": mem_pairs,
                    "step_output_names": [o.name for o in self._step_outputs],
-                   "x_names": x_names, "max_len": T},
+                   "x_names": x_names, "max_len": T,
+                   "remat": self.remat},
         )
         return outs if len(outs) != 1 else outs[0]
 

@@ -25,7 +25,7 @@ fluid.layers; this one is decoder-only to match BASELINE.json config 3.
 from .. import layers, nets
 from ..param_attr import ParamAttr
 
-__all__ = ["build"]
+__all__ = ["build", "build_stacked"]
 
 
 def build(vocab_size=32000, d_model=512, n_heads=8, n_layers=6, d_ff=2048,
@@ -83,15 +83,20 @@ def build(vocab_size=32000, d_model=512, n_heads=8, n_layers=6, d_ff=2048,
             i += 1
 
     h = layers.layer_norm(h, begin_norm_axis=2)
+    loss = _chunked_lm_head(h, labels, vocab_size, seq_len)
+    return tokens, labels, loss
 
+
+def _chunked_lm_head(h, labels, vocab_size, seq_len):
+    """Vocab projection -> mean CE, chunked along the sequence. No remat
+    here: softmax_with_cross_entropy's custom vjp keeps only the (bf16)
+    logits as residuals and recomputes the softmax elementwise in
+    backward, so the expensive vocab matmul runs exactly once. Chunking
+    bounds the fp32 log-softmax TRANSIENT to [B, chunk, vocab]
+    (full-sequence fp32 temps peak over a 16G chip's HBM at batch 128).
+    The mean divides by the RUNTIME token count (labels' shape) so the -1
+    batch dim needs no trace-time value."""
     def lm_head_sum(x, y):
-        """Vocab projection -> summed CE for one sequence chunk. No remat
-        here: softmax_with_cross_entropy's custom vjp keeps only the
-        (bf16) logits as residuals and recomputes the softmax elementwise
-        in backward, so the expensive vocab matmul runs exactly once.
-        Chunking the sequence bounds the fp32 log-softmax TRANSIENT to
-        [B, chunk, vocab] (full-sequence fp32 temps peak over a 16G
-        chip's HBM at batch 128)."""
         logits = layers.fc(x, vocab_size, num_flatten_dims=2,
                            bias_attr=False,
                            param_attr=ParamAttr(name="lm_head_w"))
@@ -103,13 +108,100 @@ def build(vocab_size=32000, d_model=512, n_heads=8, n_layers=6, d_ff=2048,
     parts = []
     for s in range(0, seq_len, head_chunk):
         hs = layers.slice(h, axes=[1], starts=[s], ends=[s + head_chunk])
-        ys = layers.slice(labels, axes=[1], starts=[s], ends=[s + head_chunk])
+        ys = layers.slice(labels, axes=[1], starts=[s],
+                          ends=[s + head_chunk])
         parts.append(lm_head_sum(hs, ys))
     total = parts[0] if len(parts) == 1 else layers.sums(parts)
-    # mean over tokens; -1 batch dim is static at trace time, so divide by
-    # the runtime token count via shape-free scale at lowering: B*T comes
-    # from the label tensor itself
-    numel = layers.cast(layers.reduce_prod(
-        layers.shape(labels)), "float32")
-    loss = layers.elementwise_div(total, numel)
+    numel = layers.cast(layers.reduce_prod(layers.shape(labels)),
+                        "float32")
+    return layers.elementwise_div(total, numel)
+
+
+def build_stacked(vocab_size=32000, d_model=512, n_heads=8, n_layers=6,
+                  d_ff=2048, seq_len=512, dtype="bfloat16"):
+    """The same flagship LM with the layer stack expressed as ONE
+    StaticRNN(remat=True) over STACKED per-layer weights — the structure
+    the bespoke native model uses (lax.scan over a jax.checkpoint body,
+    models/transformer.py single_chip_forward), available through the
+    Fluid layers API. One compiled layer body instead of n_layers unrolled
+    copies: XLA optimizes a single step and the scan re-runs it, which
+    collapses the per-layer boundary/staging overhead of the unrolled
+    build(). Weights live as [n_layers, ...] stacked parameters (scanned
+    on axis 0 via StaticRNN.step_input)."""
+    from ..initializer import Constant, Normal
+
+    tokens = layers.data(name="tokens", shape=[seq_len], dtype="int32")
+    labels = layers.data(name="labels", shape=[seq_len], dtype="int32")
+
+    h = layers.embedding(tokens, size=[vocab_size, d_model], dtype=dtype)
+    h = layers.scale(h, scale=float(d_model) ** 0.5)
+    h = layers.add_position_encoding(h, alpha=1.0, beta=1.0)
+
+    L, D, F = n_layers, d_model, d_ff
+
+    def P(name, shape, init_std=0.02, const=None):
+        init = (Constant(const) if const is not None
+                else Normal(0.0, init_std))
+        return layers.create_parameter(shape=shape, dtype=dtype, name=name,
+                                       default_initializer=init)
+
+    wqkv = P("st_wqkv", [L, D, 3 * D])
+    bqkv = P("st_bqkv", [L, 3 * D], const=0.0)
+    wproj = P("st_wproj", [L, D, D])
+    bproj = P("st_bproj", [L, D], const=0.0)
+    ln1_s = P("st_ln1_s", [L, D], const=1.0)
+    ln1_b = P("st_ln1_b", [L, D], const=0.0)
+    ln2_s = P("st_ln2_s", [L, D], const=1.0)
+    ln2_b = P("st_ln2_b", [L, D], const=0.0)
+    wff1 = P("st_wff1", [L, D, F])
+    bff1 = P("st_bff1", [L, F], const=0.0)
+    wff2 = P("st_wff2", [L, F, D])
+    bff2 = P("st_bff2", [L, D], const=0.0)
+
+    def ln(x, scale, shift):
+        # fp32 stats, stream dtype out (layer_norm-kernel semantics, built
+        # from primitives because the scanned params come in as step vars)
+        xf = layers.cast(x, "float32")
+        mu = layers.reduce_mean(xf, dim=-1, keep_dim=True)
+        d = layers.elementwise_sub(xf, mu)
+        var = layers.reduce_mean(layers.elementwise_mul(d, d), dim=-1,
+                                 keep_dim=True)
+        inv = layers.rsqrt(layers.scale(var, scale=1.0, bias=1e-5))
+        y = layers.cast(layers.elementwise_mul(d, inv), dtype)
+        return layers.elementwise_add(
+            layers.elementwise_mul(y, scale), shift)
+
+    rnn = layers.StaticRNN(remat=True)
+    with rnn.step():
+        w1 = rnn.step_input(wqkv)
+        b1 = rnn.step_input(bqkv)
+        w2 = rnn.step_input(wproj)
+        b2 = rnn.step_input(bproj)
+        s1 = rnn.step_input(ln1_s)
+        c1 = rnn.step_input(ln1_b)
+        s2 = rnn.step_input(ln2_s)
+        c2 = rnn.step_input(ln2_b)
+        w3 = rnn.step_input(wff1)
+        b3 = rnn.step_input(bff1)
+        w4 = rnn.step_input(wff2)
+        b4_ = rnn.step_input(bff2)
+        xm = rnn.memory(init=h)
+        a = ln(xm, s1, c1)
+        qkv = layers.elementwise_add(layers.matmul(a, w1), b1)
+        q, k, v = layers.split(qkv, num_or_sections=3, dim=-1)
+        att3 = nets.scaled_dot_product_attention(
+            q, k, v, num_heads=n_heads, causal=True)
+        x = layers.elementwise_add(
+            xm, layers.elementwise_add(layers.matmul(att3, w2), b2))
+        bnorm = ln(x, s2, c2)
+        f = layers.gelu(layers.elementwise_add(layers.matmul(bnorm, w3),
+                                               b3))
+        x_new = layers.elementwise_add(
+            x, layers.elementwise_add(layers.matmul(f, w4), b4_))
+        rnn.update_memory(xm, x_new)
+    rnn()
+    h = rnn.final_memories[0]
+
+    h_f32 = layers.layer_norm(h, begin_norm_axis=2)
+    loss = _chunked_lm_head(h_f32, labels, vocab_size, seq_len)
     return tokens, labels, loss

@@ -244,6 +244,12 @@ def _recurrent(ctx, ins, attrs):
             ys = tuple(local[n] for n in step_out_names)
         return tuple(new), ys
 
+    if attrs.get("remat"):
+        # rematerialized scan body (StaticRNN(remat=True)): the backward
+        # through lax.scan recomputes each step from its carry instead of
+        # storing the body's internals — the native flagship's
+        # layers-under-scan memory profile, available to API users
+        step = jax.checkpoint(step)
     T = xs[0].shape[0] if xs else attrs["max_len"]
     ts = jnp.arange(T)
     final_carry, ys = jax.lax.scan(step, init, (ts, xs), reverse=reverse)

@@ -569,21 +569,21 @@ def _roi_align(ctx, ins, attrs):
         x0 = jnp.clip(jnp.floor(xx), 0, W - 1).astype(jnp.int32)
         y1i = jnp.clip(y0 + 1, 0, H - 1)
         x1i = jnp.clip(x0 + 1, 0, W - 1)
-        wy = jnp.clip(yy - y0, 0, 1)
-        wx = jnp.clip(xx - x0, 0, 1)
+        wy = jnp.clip(yy - y0, 0, 1)   # [ph*s]
+        wx = jnp.clip(xx - x0, 0, 1)   # [pw*s]
         img = x[bid]  # [C, H, W]
-        v = (img[:, y0][:, :, x0] * 0)  # placeholder to get shape right
 
-        def bilinear(yi, xi, wyy, wxx):
-            return img[:, yi, :][:, :, xi] * 0
+        # full sample grid = OUTER product of the y samples and x samples:
+        # gather rows then columns -> [C, ph*s, pw*s] per corner
+        def grid(yi, xi):
+            return img[:, yi, :][:, :, xi]
 
-        # vectorized gather: [C, len(yy)] per corner at matching (y, x)
-        g00 = img[:, y0, x0]
-        g01 = img[:, y0, x1i]
-        g10 = img[:, y1i, x0]
-        g11 = img[:, y1i, x1i]
-        val = (g00 * (1 - wy) * (1 - wx) + g01 * (1 - wy) * wx
-               + g10 * wy * (1 - wx) + g11 * wy * wx)  # [C, ph*s*pw*s]
+        wy_ = wy[None, :, None]
+        wx_ = wx[None, None, :]
+        val = (grid(y0, x0) * (1 - wy_) * (1 - wx_)
+               + grid(y0, x1i) * (1 - wy_) * wx_
+               + grid(y1i, x0) * wy_ * (1 - wx_)
+               + grid(y1i, x1i) * wy_ * wx_)  # [C, ph*s, pw*s]
         val = val.reshape(C, pooled_h, s, pooled_w, s).mean(axis=(2, 4))
         return val
 
@@ -591,8 +591,16 @@ def _roi_align(ctx, ins, attrs):
     return {"Out": [out]}
 
 
-@register("roi_pool", differentiable=False)
+@register("roi_pool", nondiff_inputs=("ROIs", "BatchId"))
 def _roi_pool(ctx, ins, attrs):
+    """Differentiable like the reference's roi_pool (CPU/CUDA grad kernels
+    scatter through the argmax): the gather+max formulation below gets its
+    max-pool subgradient from jax; ROIs take no gradient (reference
+    parity)."""
+    return _roi_pool_impl(ctx, ins, attrs)
+
+
+def _roi_pool_impl(ctx, ins, attrs):
     x = ins["X"][0]
     rois = ins["ROIs"][0]
     pooled_h = attrs.get("pooled_height", 1)

@@ -134,17 +134,14 @@ def _amp_dot(x, y, attrs):
     see bf16 cotangents — an fp32 cotangent operand would knock the grad
     dots off the MXU fast path (fp32 dots decompose into multiple bf16
     passes). Plain `@` otherwise."""
-    if attrs.get("__amp_bf16__") and x.dtype == jnp.float32 \
-            and y.dtype == jnp.float32:
-        return jnp.matmul(x.astype(jnp.bfloat16), y.astype(jnp.bfloat16),
-                          preferred_element_type=jnp.float32
-                          ).astype(jnp.bfloat16)
-    if attrs.get("__amp_bf16__") and jnp.bfloat16 in (x.dtype, y.dtype):
-        # mixed fp32/bf16 operands (one input already produced by a white
-        # op): keep the dot fully bf16
-        return jnp.matmul(x.astype(jnp.bfloat16), y.astype(jnp.bfloat16),
-                          preferred_element_type=jnp.float32
-                          ).astype(jnp.bfloat16)
+    if attrs.get("__amp_bf16__") and jnp.float32 in (x.dtype, y.dtype) \
+            and x.dtype in (jnp.float32, jnp.bfloat16) \
+            and y.dtype in (jnp.float32, jnp.bfloat16):
+        # fp32 (or mixed) operands: cast down and emit a PLAIN bf16 dot —
+        # the MXU accumulates bf16 dots in fp32 internally either way,
+        # while preferred_element_type=f32 + convert would materialize a
+        # full fp32 output buffer just to round it down again
+        return jnp.matmul(x.astype(jnp.bfloat16), y.astype(jnp.bfloat16))
     return x @ y
 
 

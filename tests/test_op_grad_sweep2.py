@@ -1,0 +1,247 @@
+"""Grad-sweep deepening (VERDICT round-1 item 10): a bf16 tolerance tier
+and the detection / normalization / conv tails not covered by
+test_op_grad_sweep.py / test_sequence_grad_sweep.py.
+
+bf16 tier: central-difference numerics are meaningless at bf16 (the
+difference quotient loses every significant bit), so the check is
+analytic-vs-analytic — the bf16 program's gradients must track the SAME
+program run in fp32 within bf16's ~2^-8 relative precision budget. This is
+the tolerance discipline the reference's OpTest applies for fp16 kernels
+(op_test.py dtype-dependent max_relative_error)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import framework
+from test_op_grad_sweep import check_layer_grad
+
+RNG = np.random.RandomState(11)
+
+
+# ---------------------------------------------------------------------------
+# bf16 tier
+# ---------------------------------------------------------------------------
+
+
+def _grads_at_dtype(build, feeds, dtype, params=None):
+    import jax.numpy as jnp
+
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        in_vars = {}
+        for name, arr in feeds.items():
+            v = fluid.layers.data(
+                name=name, shape=list(arr.shape), dtype=dtype,
+                append_batch_size=False, stop_gradient=False)
+            in_vars[name] = v
+        out = build(in_vars)
+        loss = fluid.layers.reduce_sum(fluid.layers.cast(out, "float32"))
+        grads = fluid.gradients(loss, list(in_vars.values()))
+        grads = [g for g in grads if g is not None]
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = fluid.core.scope.Scope()
+    with fluid.scope_guard(sc):
+        exe.run(startup)
+        # identical weights in both programs (random init draws differ
+        # across builds: the op-seed counter is process-global)
+        for pname, parr in (params or {}).items():
+            sc.set(pname, np.asarray(
+                jnp.asarray(parr, jnp.bfloat16)) if dtype == "bfloat16"
+                else parr.copy())
+        feed = {k: v.astype(np.float32) for k, v in feeds.items()}
+        if dtype == "bfloat16":
+            feed = {k: np.asarray(jnp.asarray(v, jnp.bfloat16))
+                    for k, v in feed.items()}
+        vals = exe.run(main, feed=feed, fetch_list=list(grads))
+    return [np.asarray(v, np.float32) for v in vals]
+
+
+_BF16_CASES = [
+    ("matmul", lambda vs: fluid.layers.matmul(vs["x"], vs["y"]),
+     {"x": RNG.randn(4, 8).astype(np.float32),
+      "y": RNG.randn(8, 4).astype(np.float32)}),
+    ("fc_gelu", lambda vs: fluid.layers.fc(
+        vs["x"], 8, act="gelu", param_attr=fluid.ParamAttr(name="bf_w"),
+        bias_attr=False),
+     {"x": RNG.randn(4, 8).astype(np.float32)},
+     {"bf_w": RNG.randn(8, 8).astype(np.float32) * 0.3}),
+    ("layer_norm", lambda vs: fluid.layers.layer_norm(
+        vs["x"], begin_norm_axis=1,
+        param_attr=fluid.ParamAttr(name="bf_s"),
+        bias_attr=fluid.ParamAttr(name="bf_b")),
+     {"x": RNG.randn(4, 8).astype(np.float32)}),
+    ("softmax_ce", lambda vs: fluid.layers.softmax_with_cross_entropy(
+        vs["x"], _const_label()),
+     {"x": RNG.randn(4, 6).astype(np.float32)}),
+    ("elementwise_chain", lambda vs: fluid.layers.elementwise_mul(
+        fluid.layers.tanh(vs["x"]), fluid.layers.sigmoid(vs["x"])),
+     {"x": RNG.randn(4, 8).astype(np.float32)}),
+]
+
+
+def _const_label():
+    return fluid.layers.assign(np.array([[1], [3], [0], [2]], np.int64))
+
+
+@pytest.mark.parametrize("case", _BF16_CASES, ids=lambda c: c[0])
+def test_bf16_grad_tracks_fp32(case):
+    name, build, feeds = case[0], case[1], case[2]
+    params = case[3] if len(case) > 3 else None
+    g32 = _grads_at_dtype(build, feeds, "float32", params)
+    g16 = _grads_at_dtype(build, feeds, "bfloat16", params)
+    assert len(g32) == len(g16)
+    for a, b in zip(g32, g16):
+        scale = max(float(np.abs(a).max()), 1e-3)
+        rel = np.abs(a - b).max() / scale
+        # bf16 mantissa is 8 bits; a short chain should stay within ~2%
+        assert rel < 5e-2, "%s: bf16 grad rel err %.4f" % (name, rel)
+
+
+# ---------------------------------------------------------------------------
+# detection / normalization tails (fp32 numeric checks)
+# ---------------------------------------------------------------------------
+
+
+def test_roi_align_grad():
+    x = RNG.rand(1, 3, 8, 8).astype(np.float32)
+    rois = np.array([[0.5, 0.5, 6.0, 6.0], [1.0, 2.0, 5.0, 7.0]],
+                    np.float32)
+
+    def build(vs):
+        return fluid.layers.roi_align(
+            vs["x"], fluid.layers.assign(rois), pooled_height=2,
+            pooled_width=2, spatial_scale=1.0)
+
+    check_layer_grad(build, {"x": x})
+
+
+def test_roi_pool_smoke_grad():
+    # max-pool selection: gradient is a scatter of ones — verify it runs
+    # and is nonzero (numeric diff is unstable at the argmax boundary)
+    x = RNG.rand(1, 2, 6, 6).astype(np.float32)
+    rois = np.array([[0.0, 0.0, 5.0, 5.0]], np.float32)
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=list(x.shape),
+                               dtype="float32", append_batch_size=False,
+                               stop_gradient=False)
+        out = fluid.layers.roi_pool(xv, fluid.layers.assign(rois),
+                                    pooled_height=2, pooled_width=2)
+        loss = fluid.layers.reduce_sum(out)
+        g, = fluid.gradients(loss, [xv])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    gv, = exe.run(main, feed={"x": x}, fetch_list=[g])
+    assert np.asarray(gv).sum() > 0
+
+
+def test_yolov3_loss_grad_nonzero():
+    x = RNG.rand(1, 18, 4, 4).astype(np.float32)  # 3 anchors * (5+1cls)
+    gt_box = np.array([[[0.3, 0.4, 0.2, 0.2]]], np.float32)
+    gt_label = np.array([[0]], np.int32)
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=list(x.shape),
+                               dtype="float32", append_batch_size=False,
+                               stop_gradient=False)
+        loss = fluid.layers.yolov3_loss(
+            xv, fluid.layers.assign(gt_box),
+            fluid.layers.assign(gt_label),
+            anchors=[10, 13, 16, 30, 33, 23], anchor_mask=[0, 1, 2],
+            class_num=1, ignore_thresh=0.7, downsample_ratio=32)
+        total = fluid.layers.reduce_sum(loss)
+        g, = fluid.gradients(total, [xv])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    gv, = exe.run(main, feed={"x": x}, fetch_list=[g])
+    assert np.isfinite(np.asarray(gv)).all()
+    assert np.abs(np.asarray(gv)).sum() > 0
+
+
+@pytest.mark.parametrize("case", [
+    ("group_norm", lambda vs: fluid.layers.group_norm(
+        vs["x"], groups=2, param_attr=fluid.ParamAttr(name="gn_s"),
+        bias_attr=fluid.ParamAttr(name="gn_b"))),
+    ("instance_norm_path", lambda vs: fluid.layers.group_norm(
+        vs["x"], groups=4)),
+    ("prelu", lambda vs: fluid.layers.prelu(
+        vs["x"], mode="all", param_attr=fluid.ParamAttr(name="pr_a"))),
+    ("maxout", lambda vs: fluid.layers.maxout(vs["x"], groups=2)),
+], ids=lambda c: c[0])
+def test_norm_tail_grad(case):
+    _, build = case
+    x = (RNG.rand(2, 4, 3, 3).astype(np.float32) * 0.8 + 0.1)
+    check_layer_grad(build, {"x": x})
+
+
+@pytest.mark.parametrize("case", [
+    ("kldiv_loss", lambda vs: fluid.layers.kldiv_loss(
+        fluid.layers.log(fluid.layers.softmax(vs["x"])),
+        fluid.layers.softmax(vs["y"]), reduction="mean")),
+    ("npair_loss", lambda vs: fluid.layers.npair_loss(
+        vs["x"], vs["y"], fluid.layers.assign(
+            np.array([0, 1], np.int64)))),
+    ("dice_loss", lambda vs: fluid.layers.dice_loss(
+        fluid.layers.softmax(vs["x"]),
+        fluid.layers.assign(np.array([[1], [0]], np.int64)))),
+    ("bpr_loss", lambda vs: fluid.layers.bpr_loss(
+        fluid.layers.softmax(vs["x"]),
+        fluid.layers.assign(np.array([[1], [0]], np.int64)))),
+    ("teacher_student", lambda vs:
+        fluid.layers.teacher_student_sigmoid_loss(
+            fluid.layers.slice(vs["x"], axes=[1], starts=[0], ends=[1]),
+            fluid.layers.assign(np.array([[0.3], [1.2]], np.float32)))),
+], ids=lambda c: c[0])
+def test_loss_tail_grad(case):
+    _, build = case
+    x = RNG.randn(2, 3).astype(np.float32)
+    y = RNG.randn(2, 3).astype(np.float32)
+    check_layer_grad(build, {"x": x, "y": y}, max_rel_err=8e-2)
+
+
+@pytest.mark.parametrize("case", [
+    ("conv2d_transpose", lambda vs: fluid.layers.conv2d_transpose(
+        vs["x"], num_filters=3, filter_size=3,
+        param_attr=fluid.ParamAttr(name="ct_w"), bias_attr=False)),
+    ("depthwise_conv2d", lambda vs: fluid.layers.conv2d(
+        vs["x"], num_filters=4, filter_size=3, groups=4, padding=1,
+        param_attr=fluid.ParamAttr(name="dw_w"), bias_attr=False)),
+    ("conv3d", lambda vs: fluid.layers.conv3d(
+        fluid.layers.unsqueeze(vs["x"], axes=[2]), num_filters=2,
+        filter_size=1, param_attr=fluid.ParamAttr(name="c3_w"),
+        bias_attr=False)),
+    ("pool2d_avg", lambda vs: fluid.layers.pool2d(
+        vs["x"], pool_size=2, pool_type="avg", pool_stride=2)),
+    ("pixel_shuffle", lambda vs: fluid.layers.pixel_shuffle(vs["x"], 2)),
+], ids=lambda c: c[0])
+def test_conv_tail_grad(case):
+    _, build = case
+    x = RNG.rand(1, 4, 4, 4).astype(np.float32)
+    check_layer_grad(build, {"x": x})
+
+
+# ---------------------------------------------------------------------------
+# sequence tail (beyond test_sequence_grad_sweep.py)
+# ---------------------------------------------------------------------------
+
+
+def test_row_conv_grad():
+    x = RNG.rand(2, 5, 4).astype(np.float32)
+
+    def build(vs):
+        return fluid.layers.row_conv(
+            vs["x"], future_context_size=2,
+            param_attr=fluid.ParamAttr(name="rc_w"))
+
+    check_layer_grad(build, {"x": x})
+
+
+def test_im2sequence_grad():
+    x = RNG.rand(1, 2, 6, 6).astype(np.float32)
+
+    def build(vs):
+        return fluid.layers.im2sequence(
+            vs["x"], filter_size=[2, 2], stride=[2, 2])
+
+    check_layer_grad(build, {"x": x})
