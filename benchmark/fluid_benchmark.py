@@ -143,7 +143,7 @@ def run_transformer_native(args):
 
     tokens_per_sec, last_loss = bench.bench_transformer(
         steps=args.iterations, warmup=args.skip_batch_num,
-        batch=args.batch_size or 128)
+        batch=args.batch_size or 192)
     print("\nTransformer-base (native): %.1f tokens/sec/chip "
           "(last loss %.4f)\n" % (tokens_per_sec, last_loss))
     return {"metric": "transformer_native_tokens_per_sec_per_chip",

@@ -163,9 +163,10 @@ def lm_logits(params, h, cfg):
                       preferred_element_type=jnp.float32)
 
 
-def single_chip_forward(params, tokens, cfg: TransformerConfig):
-    """Plain (unsharded) forward — the graft `entry()` path and single-chip
-    bench. Layers run under lax.scan for one compiled block body."""
+def single_chip_hidden(params, tokens, cfg: TransformerConfig):
+    """Embed -> layers under lax.scan (one compiled block body, optionally
+    rematerialized) -> final LN. Shared by the forward (graft `entry()`)
+    and the training loss so architecture changes cannot diverge."""
     h = embed_tokens(params, tokens, cfg)
 
     def body(h, lp):
@@ -179,8 +180,13 @@ def single_chip_forward(params, tokens, cfg: TransformerConfig):
     if cfg.remat:
         body = jax.checkpoint(body)
     h, _ = jax.lax.scan(body, h, params["layers"])
-    h = layer_norm(h, params["final_ln_scale"], params["final_ln_bias"])
-    return lm_logits(params, h, cfg)
+    return layer_norm(h, params["final_ln_scale"], params["final_ln_bias"])
+
+
+def single_chip_forward(params, tokens, cfg: TransformerConfig):
+    """Plain (unsharded) forward — the graft `entry()` path and single-chip
+    bench."""
+    return lm_logits(params, single_chip_hidden(params, tokens, cfg), cfg)
 
 
 def token_cross_entropy(logits, labels):
@@ -191,8 +197,26 @@ def token_cross_entropy(logits, labels):
 
 
 def single_chip_loss(params, tokens, labels, cfg):
-    return token_cross_entropy(single_chip_forward(params, tokens, cfg),
-                               labels)
+    """Forward + chunked memory-lean CE head. The vocab head is computed
+    per sequence chunk through the same custom-vjp CE the Fluid path uses
+    (ops/loss_ops._hard_label_ce: residual = bf16 logits, backward
+    recomputes the softmax elementwise behind a barrier) — the full-seq
+    fp32 logits + log-softmax residual otherwise pin ~16G at batch 128,
+    capping the batch below the MXU's preferred operating point."""
+    from ..ops.loss_ops import _hard_label_ce
+
+    h = single_chip_hidden(params, tokens, cfg)
+    T = h.shape[1]
+    # ~4 chunks caps the transient while keeping each vocab dot large
+    # (over-chunking long sequences serializes many small dots)
+    chunk = T if T <= 256 else max(256, T // 4)
+    total = 0.0
+    for s in range(0, T, chunk):
+        logits = lm_logits(params, h[:, s:s + chunk], cfg)
+        logits = logits.astype(cfg.dtype)
+        total = total + _hard_label_ce(
+            logits, labels[:, s:s + chunk], -100).sum()
+    return total / (labels.shape[0] * labels.shape[1])
 
 
 def param_count(params):

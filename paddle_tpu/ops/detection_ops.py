@@ -597,10 +597,6 @@ def _roi_pool(ctx, ins, attrs):
     scatter through the argmax): the gather+max formulation below gets its
     max-pool subgradient from jax; ROIs take no gradient (reference
     parity)."""
-    return _roi_pool_impl(ctx, ins, attrs)
-
-
-def _roi_pool_impl(ctx, ins, attrs):
     x = ins["X"][0]
     rois = ins["ROIs"][0]
     pooled_h = attrs.get("pooled_height", 1)
