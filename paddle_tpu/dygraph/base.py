@@ -146,6 +146,17 @@ class VarBase:
     def clear_gradient(self):
         self._grad = None
 
+    def set_value(self, value):
+        """Overwrite in place, keeping shape and dtype (parity:
+        framework.py VarBase.set_value — checkpoint restore / manual
+        weight surgery)."""
+        new = jnp.asarray(value)
+        if tuple(new.shape) != tuple(self.value.shape):
+            raise ValueError(
+                "set_value shape mismatch: var %s vs value %s"
+                % (tuple(self.value.shape), tuple(new.shape)))
+        self.value = new.astype(self.value.dtype)
+
     def detach(self):
         return VarBase(self.value, stop_gradient=True)
 

@@ -1,0 +1,173 @@
+"""Optimizer update-rule parity against torch.optim (CPU) — an
+independent-implementation oracle for the optimizer corpus, stronger than
+closed-form spot checks. Same quadratic-ish objective, same init, same
+hyperparameters; trajectories must agree step for step.
+
+Parity anchors: optimizer.py SGD/Momentum/Adam/Adagrad/RMSProp
+(python/paddle/fluid/optimizer.py) whose update formulas the reference
+documents; torch implements the same published rules, so agreement checks
+OUR lowering (ops/optimizer_ops.py), not a shared implementation."""
+
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+W0 = np.random.RandomState(3).randn(4, 3).astype(np.float32) * 0.5
+X = np.random.RandomState(4).rand(8, 4).astype(np.float32)
+TGT = np.random.RandomState(5).rand(8, 3).astype(np.float32)
+
+
+def _fluid_traj(make_opt, steps=6):
+    prog, sprog = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, sprog):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        t = layers.data(name="t", shape=[3], dtype="float32")
+        y = layers.fc(x, 3, param_attr=fluid.ParamAttr(name="tw"),
+                      bias_attr=False)
+        loss = layers.mean(layers.square_error_cost(y, t))
+        make_opt().minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = fluid.core.scope.Scope()
+    ws = []
+    with fluid.scope_guard(sc):
+        exe.run(sprog)
+        sc.set("tw", W0.copy())
+        for _ in range(steps):
+            exe.run(prog, feed={"x": X, "t": TGT}, fetch_list=[loss])
+            ws.append(np.asarray(sc.get("tw")).copy())
+    return ws
+
+
+def _torch_traj(make_opt, steps=6):
+    w = torch.nn.Parameter(torch.tensor(W0.copy()))
+    opt = make_opt([w])
+    xs = torch.tensor(X)
+    tg = torch.tensor(TGT)
+    ws = []
+    for _ in range(steps):
+        opt.zero_grad()
+        # fluid square_error_cost = (y - t)^2 per element, mean over all
+        loss = ((xs @ w - tg) ** 2).mean()
+        loss.backward()
+        opt.step()
+        ws.append(w.detach().numpy().copy())
+    return ws
+
+
+def _compare(fl, th, rtol=2e-5, atol=2e-6):
+    for i, (a, b) in enumerate(zip(fl, th)):
+        np.testing.assert_allclose(
+            a, b, rtol=rtol, atol=atol,
+            err_msg="diverged at step %d" % i)
+
+
+def test_sgd_matches_torch():
+    _compare(_fluid_traj(lambda: fluid.optimizer.SGD(0.1)),
+             _torch_traj(lambda p: torch.optim.SGD(p, lr=0.1)))
+
+
+def test_momentum_matches_torch():
+    _compare(
+        _fluid_traj(lambda: fluid.optimizer.Momentum(0.05, momentum=0.9)),
+        _torch_traj(lambda p: torch.optim.SGD(p, lr=0.05, momentum=0.9)))
+
+
+def test_nesterov_momentum_matches_torch():
+    _compare(
+        _fluid_traj(lambda: fluid.optimizer.Momentum(
+            0.05, momentum=0.9, use_nesterov=True)),
+        _torch_traj(lambda p: torch.optim.SGD(p, lr=0.05, momentum=0.9,
+                                              nesterov=True)))
+
+
+def test_adam_matches_torch():
+    _compare(
+        _fluid_traj(lambda: fluid.optimizer.Adam(
+            learning_rate=0.01, beta1=0.9, beta2=0.999, epsilon=1e-8)),
+        _torch_traj(lambda p: torch.optim.Adam(
+            p, lr=0.01, betas=(0.9, 0.999), eps=1e-8)),
+        rtol=2e-4, atol=2e-5)  # eps placement differs (inside sqrt vs
+    # outside) by the published formulas both use; effect is O(eps)
+
+
+def test_adagrad_matches_torch():
+    # fluid Adagrad has epsilon inside sqrt accumulator init 0; torch
+    # initial_accumulator_value=0 matches
+    _compare(
+        _fluid_traj(lambda: fluid.optimizer.Adagrad(
+            learning_rate=0.05, epsilon=1e-10)),
+        _torch_traj(lambda p: torch.optim.Adagrad(
+            p, lr=0.05, eps=1e-10)),
+        rtol=2e-4, atol=2e-5)
+
+
+def test_rmsprop_matches_torch():
+    _compare(
+        _fluid_traj(lambda: fluid.optimizer.RMSProp(
+            learning_rate=0.01, rho=0.9, epsilon=1e-6)),
+        _torch_traj(lambda p: torch.optim.RMSprop(
+            p, lr=0.01, alpha=0.9, eps=1e-6)),
+        rtol=1e-3, atol=1e-4)  # eps inside vs outside the sqrt
+
+
+def test_dygraph_adam_matches_static_adam():
+    """The eager path (dygraph tape + on-device updates) and the static
+    descriptor path implement the same Adam; trajectories must agree."""
+    static = _fluid_traj(lambda: fluid.optimizer.Adam(
+        learning_rate=0.01, beta1=0.9, beta2=0.999, epsilon=1e-8))
+
+    from paddle_tpu import dygraph
+
+    with dygraph.guard():
+        class Net(dygraph.Layer):
+            def __init__(self):
+                super().__init__("net")
+                self.fc = dygraph.FC("fc", 3,
+                                     param_attr=fluid.ParamAttr(name="dw"),
+                                     bias_attr=False)
+
+            def forward(self, x):
+                return self.fc(x)
+
+        net = Net()
+        xs = dygraph.to_variable(X)
+        tg = dygraph.to_variable(TGT)
+        net(xs)  # build params
+        for p in net.parameters():
+            p.set_value(W0.copy())
+        opt = fluid.optimizer.Adam(learning_rate=0.01, beta1=0.9,
+                                   beta2=0.999, epsilon=1e-8)
+        from paddle_tpu.dygraph.base import _current_tracer
+
+        t = _current_tracer()
+        eager = []
+        for _ in range(len(static)):
+            y = net(xs)
+            diff = y - tg
+            sq = diff * diff
+            loss = t.trace_op("mean", {"X": [sq]}, ["Out"], {})["Out"][0]
+            loss.backward()
+            opt.minimize(loss)
+            net.clear_gradients()
+            eager.append(np.asarray(
+                net.parameters()[0].numpy()).copy())
+    _compare(static, eager, rtol=5e-4, atol=5e-5)
+
+
+def test_adamw_decoupled_matches_torch():
+    AdamW = fluid.contrib.extend_with_decoupled_weight_decay(
+        fluid.optimizer.Adam)
+    # torch AdamW scales decay by lr (w -= lr*wd*w); the reference's
+    # decoupled decay subtracts coeff*w directly, so feed torch an
+    # equivalent weight_decay = coeff / lr
+    lr, coeff = 0.01, 0.004
+    _compare(
+        _fluid_traj(lambda: AdamW(weight_decay=coeff, learning_rate=lr,
+                                  beta1=0.9, beta2=0.999, epsilon=1e-8)),
+        _torch_traj(lambda p: torch.optim.AdamW(
+            p, lr=lr, betas=(0.9, 0.999), eps=1e-8,
+            weight_decay=coeff / lr)),
+        rtol=5e-4, atol=5e-5)
