@@ -17,6 +17,18 @@ from .ops import registry
 __all__ = ["append_backward", "gradients"]
 
 
+def _is_grad_op(op):
+    return "__fwd_op__" in op.attrs
+
+
+def _base_fwd(op):
+    """Peel grad-of-grad chains down to the primitive forward op (shared
+    with the lowering — one definition, core/lowering.py)."""
+    from .core.lowering import _base_fwd as impl
+
+    return impl(op)
+
+
 def _collect_need_grad(block, params, no_grad_set, extra_leaves=()):
     """Forward pass: which vars lie on a differentiable path from trainables
     (or from `extra_leaves` — arbitrary vars the caller wants grads for)."""
@@ -28,6 +40,24 @@ def _collect_need_grad(block, params, no_grad_set, extra_leaves=()):
         if name not in no_grad_set:
             need.add(name)
     for op in block.ops:
+        if _is_grad_op(op):
+            # grad ops ARE differentiable (their kernel is jax.vjp of the
+            # forward, itself built from traced primitives) — this is what
+            # makes fluid.gradients-of-a-gradient flow. Their outputs are
+            # created stop_gradient=True (they're leaves of pass N), so
+            # bypass that flag here: pass N+1 may differentiate through.
+            nondiff = registry.get(_base_fwd(op).type).nondiff_inputs
+            hit = any(
+                v.name in need
+                for slot, vs in op.inputs.items()
+                if slot not in nondiff
+                for v in vs)
+            if hit:
+                for vs in op.outputs.values():
+                    for v in vs:
+                        if v.name not in no_grad_set:
+                            need.add(v.name)
+            continue
         if not registry.has(op.type):
             continue
         opdef = registry.get(op.type)
@@ -61,7 +91,7 @@ def _create_grad_var(block, primal, gname):
 
 def append_backward(loss, parameter_list=None, no_grad_set=None,
                     callbacks=None, checkpoints=None, _extra_leaves=(),
-                    _target_gradients=None):
+                    _target_gradients=None, _update_param_map=True):
     """Append grad ops computing d loss / d param for every trainable param.
 
     Returns list of (param Variable, grad Variable).
@@ -90,9 +120,20 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
         raise ValueError("loss var %r is not produced by any op" % loss.name)
 
     program._appending_grad_times += 1
+    # Repeated backward passes (fluid.gradients of a gradient, or minimize
+    # after a gradient-penalty gradients() call) must NOT reuse pass-1's
+    # @GRAD names — resolving x@GRAD to the stale first-order var is how
+    # the reference's calc_gradient rename machinery (backward.py
+    # _rename_grad_) avoids silent wrong answers; here a per-pass suffix
+    # does the same.
+    _suffix = ("" if program._appending_grad_times <= 1
+               else "@%d" % program._appending_grad_times)
+
+    def _g(name):
+        return grad_var_name(name) + _suffix
 
     # seed gradient: d loss / d loss = 1 (or the caller-supplied cotangent)
-    loss_grad_name = grad_var_name(loss.name)
+    loss_grad_name = _g(loss.name)
     loss_grad = _create_grad_var(block, loss, loss_grad_name)
     if _target_gradients is not None:
         block.append_op(
@@ -104,22 +145,35 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     else:
         # fill_any_like (not fill_constant) so targets with symbolic -1
         # batch dims get their cotangent shape from the runtime value
+        # __loss_seed__ marks ONLY the executor-level training seed (the
+        # one ScaleLossGradOpHandle scales in the reference) — gradients()
+        # passes _update_param_map=False and its seeds must NOT pick up
+        # GradientScaleStrategy scaling, or in-program fluid.gradients
+        # values would change under `One`
         block.append_op(
             type="fill_any_like",
             inputs={"X": [loss]},
             outputs={"Out": [loss_grad]},
-            attrs={"value": 1.0, "__op_role__": "backward"},
+            attrs={"value": 1.0, "__op_role__": "backward",
+                   "__loss_seed__": bool(_update_param_map)},
         )
 
     grad_map = {loss.name: loss_grad_name}  # primal name -> grad var name
 
     fwd_ops = list(block.ops[: loss_idx + 1])
     for op in reversed(fwd_ops):
-        if not registry.has(op.type):
+        if _is_grad_op(op):
+            # differentiate a grad op appended by an earlier backward pass:
+            # generic like any primitive — lowering executes it via
+            # vjp-of-vjp (reference registers bespoke *_grad_grad ops,
+            # elementwise_add_op.cc:23-72; here every op composes at once)
+            opdef = registry.get(_base_fwd(op).type)
+        elif not registry.has(op.type):
             continue
-        opdef = registry.get(op.type)
-        if not opdef.differentiable:
-            continue
+        else:
+            opdef = registry.get(op.type)
+            if not opdef.differentiable:
+                continue
         # upstream grads available for any output?
         gout_map = {}
         any_gout = False
@@ -157,6 +211,7 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
         gin_map = {}
         accumulate = {}
         grad_out_vars = []
+        grad_out_seen = set()
         any_gin = False
         for slot, vs in op.inputs.items():
             if slot in opdef.nondiff_inputs:
@@ -167,7 +222,7 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
                 if v.name not in need_grad or v.name in no_grad_set:
                     names.append(None)
                     continue
-                gname = grad_var_name(v.name)
+                gname = _g(v.name)
                 gv = _create_grad_var(block, v, gname)
                 if v.name in grad_map and v.name not in consumed:
                     # a later consumer already produced this grad: accumulate
@@ -175,7 +230,9 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
                 else:
                     grad_map[v.name] = gname
                 names.append(gname)
-                grad_out_vars.append(gv)
+                if gname not in grad_out_seen:
+                    grad_out_seen.add(gname)
+                    grad_out_vars.append(gv)
                 any_gin = True
             gin_map[slot] = names
         if not any_gin:
@@ -183,10 +240,15 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
 
         grad_inputs = dict(op.inputs)
         gout_vars = {}
+        cot_slots = {}
         for slot, vs in op.outputs.items():
             gvs = [block.var(g) for g in gout_map[slot] if g is not None]
             if gvs:
-                gout_vars[slot + "@GRAD"] = gvs
+                key = slot + "@GRAD"
+                while key in grad_inputs or key in gout_vars:
+                    key += "_"   # grad-of-grad: "InputGrads@GRAD" may recur
+                gout_vars[key] = gvs
+                cot_slots[slot] = key
         grad_inputs = {**grad_inputs, **gout_vars}
 
         block.append_op(
@@ -198,6 +260,7 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
                 "__grad_out_map__": gout_map,
                 "__grad_in_map__": gin_map,
                 "__accumulate__": accumulate,
+                "__cot_slots__": cot_slots,
                 "__op_role__": "backward",
             },
         )
@@ -209,9 +272,11 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
             continue
         g = block.var(gname)
         params_and_grads.append((p, g))
-    program.param_grad_map.update(
-        {p.name: g.name for p, g in params_and_grads}
-    )
+    program._last_grad_map = dict(grad_map)
+    if _update_param_map:
+        program.param_grad_map.update(
+            {p.name: g.name for p, g in params_and_grads}
+        )
     return params_and_grads
 
 
@@ -229,7 +294,8 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     leaves = tuple(v.name for v in inputs)
     if len(targets) == 1 and target_gradients is None:
         append_backward(targets[0], parameter_list=None,
-                        no_grad_set=no_grad_set, _extra_leaves=leaves)
+                        no_grad_set=no_grad_set, _extra_leaves=leaves,
+                        _update_param_map=False)
     else:
         # multiple targets / explicit cotangents: differentiate the scalar
         # L = Σ_i sum(y_i ⊙ tg_i), whose gradient is the accumulated
@@ -246,10 +312,16 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
                 parts.append(layers.reduce_sum(term))
             total = parts[0] if len(parts) == 1 else layers.sums(parts)
             append_backward(total, parameter_list=None,
-                            no_grad_set=no_grad_set, _extra_leaves=leaves)
+                            no_grad_set=no_grad_set, _extra_leaves=leaves,
+                            _update_param_map=False)
     block = targets[0].block
+    program = block.program
+    # read THIS pass's grad names (suffixed on repeated passes) — never the
+    # plain @GRAD lookup, which on a second call resolves to pass 1's var
+    grad_map = getattr(program, "_last_grad_map", {})
     outs = []
     for v in inputs:
-        gname = grad_var_name(v.name)
-        outs.append(block.var(gname) if block.has_var(gname) else None)
+        gname = grad_map.get(v.name)
+        outs.append(block.var(gname) if gname is not None
+                    and block.has_var(gname) else None)
     return outs

@@ -61,6 +61,14 @@ class BuildStrategy:
         self.sync_batch_norm = False
         self.num_trainers = 1
         self.trainer_id = 0
+        # TPU-native extensions (the reference's multi-device builder only
+        # does dp; here ANY program shards over a dp×tp mesh):
+        #   tensor_parallel_degree — tp axis size; fc/embedding params get
+        #     Megatron column/row specs from parallel/planner.py
+        #   sharding_specs — {param name: partition-spec tuple} explicit
+        #     overrides, e.g. {"fc_w": (None, "tp")}
+        self.tensor_parallel_degree = 1
+        self.sharding_specs = {}
 
 
 class CompiledProgram:
@@ -94,7 +102,17 @@ class CompiledProgram:
     def _get_mesh(self):
         if self._mesh is None:
             devs = np.array(jax.devices())
-            self._mesh = Mesh(devs, axis_names=("dp",))
+            tp = int(getattr(self._build_strategy,
+                             "tensor_parallel_degree", 1) or 1)
+            if tp > 1:
+                if len(devs) % tp:
+                    raise ValueError(
+                        "tensor_parallel_degree=%d does not divide the "
+                        "%d-device mesh" % (tp, len(devs)))
+                self._mesh = Mesh(devs.reshape(len(devs) // tp, tp),
+                                  axis_names=("dp", "tp"))
+            else:
+                self._mesh = Mesh(devs, axis_names=("dp",))
         return self._mesh
 
     def _run(self, executor, feed, fetch_list, scope, return_numpy):
@@ -128,7 +146,14 @@ class CompiledProgram:
 
 
 class _DataParallelStep:
-    """One jitted SPMD step over the data mesh."""
+    """One jitted SPMD step over the dp(×tp) mesh.
+
+    The reference builds a per-device op graph and inserts collectives by
+    hand (multi_devices_graph_pass.cc:165); here the SAME program is jitted
+    once with per-var NamedShardings from `parallel.planner.plan_program`
+    and GSPMD inserts them. ReduceStrategy.Reduce shards optimizer state
+    over dp (ZeRO-1, reduce_op_handle.cc parity); tensor_parallel_degree>1
+    adds a tp mesh axis with Megatron param specs for ANY program."""
 
     def __init__(self, program, feed_names, fetch_names, mesh, build_strategy):
         self.program = program
@@ -166,6 +191,39 @@ class _DataParallelStep:
         batch = NamedSharding(mesh, P("dp"))
         self._repl = repl
         self._batch = batch
+
+        bs = build_strategy or BuildStrategy()
+        zero_mode = (getattr(bs, "reduce_strategy",
+                             BuildStrategy.ReduceStrategy.AllReduce)
+                     == BuildStrategy.ReduceStrategy.Reduce)
+        gss = getattr(bs, "gradient_scale_strategy",
+                      BuildStrategy.GradientScaleStrategy.CoeffNumDevice)
+        if gss == BuildStrategy.GradientScaleStrategy.Customized:
+            raise NotImplementedError(
+                "GradientScaleStrategy.Customized is not supported: the "
+                "TPU lowering computes exact global-batch gradients in one "
+                "program, so there is no per-device seed var to customize. "
+                "Scale the loss in the program instead (CoeffNumDevice = "
+                "exact mean semantics, One = gradients scaled by "
+                "num-devices).")
+        # `One` sums per-REPLICA mean gradients: replicas = dp size only
+        # (tp shards computation, it does not add replicas)
+        n_repl = int(dict(mesh.shape).get("dp", 1))
+        self._grad_seed_scale = (
+            float(n_repl)
+            if gss == BuildStrategy.GradientScaleStrategy.One else 1.0)
+
+        from .parallel.planner import plan_program
+
+        self._plan = plan_program(program, mesh, build_strategy=bs,
+                                  zero_sharding=zero_mode)
+        self._state_shardings = {
+            n: NamedSharding(mesh, self._plan.spec_of(n))
+            for n in set(self.mut_names) | set(self.const_names)
+            | set(self.state_out)}
+        self._act_constraints = {
+            n: NamedSharding(mesh, spec)
+            for n, spec in self._plan.constraints.items()}
         # mesh spanning several processes (DCN): numpy feeds must become
         # global jax.Arrays — every worker feeds the identical global batch
         # and each process materializes only its addressable shards
@@ -185,27 +243,43 @@ class _DataParallelStep:
                 jax.random.PRNGKey(self._seed), step_counter)
             ctx = LoweringContext(base_key=base_key, mesh=mesh,
                                   check_nan_inf=self._check_nan_inf)
+            ctx.grad_seed_scale = self._grad_seed_scale
+            ctx.act_constraints = self._act_constraints
             env = {}
             env.update(const_state)
             env.update(mut_state)
             env.update(feeds)
             execute_block(block, env, ctx)
-            fetches = [env[n] for n in self.fetch_names]
-            new_state = {n: env[n] for n in self.state_out if n in env}
+            # fetches + debug flags leave the step fully replicated so
+            # multi-process (DCN) meshes can np.asarray them host-side;
+            # state outputs pin to their planned sharding (per-leaf —
+            # out_shardings can't express the data-dependent key set)
+            fetches = [jax.lax.with_sharding_constraint(env[n], repl)
+                       for n in self.fetch_names]
+            new_state = {
+                n: jax.lax.with_sharding_constraint(
+                    env[n], self._state_shardings[n])
+                for n in self.state_out if n in env}
             self._nan_labels, finite = pack_nan_reports(ctx)
             self._warn_labels, warns = pack_warn_reports(ctx)
-            return fetches, new_state, finite, warns
+            return (fetches, new_state,
+                    jax.lax.with_sharding_constraint(finite, repl),
+                    jax.lax.with_sharding_constraint(warns, repl))
 
-        # params/state replicated; feeds sharded on batch dim. XLA sharding
-        # propagation turns the param-grad reductions into ICI all-reduces.
+        # state enters with its planned sharding (replicated by default; tp
+        # column/row for planner-assigned params; dp-sharded optimizer state
+        # in Reduce mode); feeds shard on the batch dim. XLA sharding
+        # propagation inserts the grad all-reduces / reduce-scatters.
         # under the debug flag, keep state undonated so a nan raise can
         # leave the scope at its pre-step values (catch-and-continue safe)
         donate = () if self._check_nan_inf else (0,)
+        mut_sh = {n: self._state_shardings[n] for n in self.mut_names}
+        const_sh = {n: self._state_shardings[n] for n in self.const_names}
+        feed_sh = {n: batch for n in self.feed_names}
         self._jitted = jax.jit(
             step,
             donate_argnums=donate,
-            in_shardings=(repl, repl, batch, None),
-            out_shardings=(repl, repl, repl, repl),
+            in_shardings=(mut_sh, const_sh, feed_sh, None),
         )
 
     def run(self, scope, feed):
@@ -237,16 +311,17 @@ class _DataParallelStep:
             for store in (mut, const):
                 for name, val in store.items():
                     # only host values need lifting to global arrays; after
-                    # step 1 the scope already holds repl-sharded jax.Arrays
-                    # (out_shardings) — re-lifting would round-trip all
-                    # params device->host->device every step
+                    # step 1 the scope already holds planned-sharded
+                    # jax.Arrays — re-lifting would round-trip all params
+                    # device->host->device every step
+                    want = self._state_shardings.get(name, self._repl)
                     if isinstance(val, jax.Array) and \
-                            val.sharding.is_equivalent_to(self._repl,
+                            val.sharding.is_equivalent_to(want,
                                                           np.ndim(val)):
                         continue
                     v = np.asarray(val)
                     store[name] = jax.make_array_from_callback(
-                        v.shape, self._repl, lambda idx, a=v: a[idx])
+                        v.shape, want, lambda idx, a=v: a[idx])
         ctr = np.uint32(scope.get("__step_counter__", 0) or 0)
         fetches, new_state, finite, warns = self._jitted(mut, const,
                                                          feeds, ctr)

@@ -139,16 +139,20 @@ class QuantizeTranspiler:
     def convert_to_int8(self, program, place=None, scope=None):
         """Store quantizable ops' weights as int8 (parity:
         quantize_transpiler.py:354 convert_to_int8): each persistable
-        weight feeding a quantizable op gets an int8 twin `<name>.int8`
-        holding round(w / scale * 127), with the fp scale kept on the var
-        (`quant_scale`) for the deploy runtime to dequantize — halving the
-        serving weight footprint is the point; compute still runs through
-        the dequantized values."""
+        weight feeding a quantizable op is REPLACED by an int8 twin
+        `<name>.int8` holding round(w / scale * 127) — the fp var loses
+        persistable status and its scope copy, and a prepended `dequantize`
+        op reconstructs it from the int8 values at run time (halving the
+        serving weight footprint is the point; the runtime genuinely
+        computes from the int8 store, unlike a side-car copy). The fp
+        scale is kept on the int8 var (`quant_scale`)."""
         scope = scope or global_scope()
         bnt = (1 << (self.weight_bits - 1)) - 1
         converted = {}
+        pending = []  # (var, int8 var, scale): prepend AFTER the scan —
+        # prepend_op mid-iteration would mutate the list being walked
         for block in program.blocks:
-            for op in block.ops:
+            for op in list(block.ops):
                 if op.type not in ("conv2d", "depthwise_conv2d", "mul",
                                    "matmul"):
                     continue
@@ -170,6 +174,18 @@ class QuantizeTranspiler:
                             persistable=True)
                         iv.quant_scale = scale / bnt
                         scope.set(int8_name, q)
+                        # the int8 twin is now the stored weight: demote
+                        # the fp var to a runtime-computed value
+                        v.persistable = False
+                        scope.erase(v.name)
+                        pending.append((v, iv, scale))
                         converted[v.name] = int8_name
+        for v, iv, scale in pending:
+            program.global_block().prepend_op(
+                type="dequantize",
+                inputs={"Input": [iv]},
+                outputs={"Output": [v]},
+                attrs={"Scale": bnt / scale},
+            )
         program._bump_version()
         return program

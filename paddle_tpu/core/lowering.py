@@ -47,6 +47,12 @@ class LoweringContext:
         # the executor warns host-side (once per site).
         self.warn_reports = []
         self._nan_suppress = 0
+        # sharding-planner hooks (parallel/planner.py): activation seams
+        # {var name: NamedSharding} applied via with_sharding_constraint
+        # where the var is produced, and the GradientScaleStrategy factor
+        # folded into the backward seed (ops/math.py fill_any_like)
+        self.act_constraints = {}
+        self.grad_seed_scale = 1.0
         # forward input values per op, captured at forward-execution time.
         # Grad ops recompute their forward under jax.vjp; reading inputs
         # from the *current* env would be wrong whenever a var was
@@ -175,6 +181,10 @@ def _bind_outputs(op, outs, env, ctx=None):
         if produced is None:
             continue
         for v, val in zip(vs, produced):
+            if ctx is not None and ctx.act_constraints:
+                sh = ctx.act_constraints.get(v.name)
+                if sh is not None:
+                    val = jax.lax.with_sharding_constraint(val, sh)
             env[v.name] = val
             if ctx is not None and ctx.check_nan_inf:
                 _nan_check(ctx, "%s -> %s" % (op.type, v.name), val)
@@ -187,35 +197,106 @@ def _zero_cotangent(primal):
     return np.zeros(np.shape(primal), dtype=jax.dtypes.float0)
 
 
-def _execute_grad_op(op, env, ctx):
-    """Generic gradient kernel: vjp of the forward op's impl.
+def _base_fwd(op):
+    while "__fwd_op__" in op.attrs:
+        op = op.attrs["__fwd_op__"]
+    return op
 
-    op.attrs carries:
-      __fwd_op__       : the forward Operator object
+
+def _op_impl_fn(op, ctx):
+    """(impl, nondiff_inputs) for ANY op — primitive (registry kernel) or
+    gradient. A grad op's impl purely maps its inputs (forward inputs +
+    upstream cotangents) to its InputGrads outputs via `_grad_apply`; giving
+    grad ops the same impl(ctx, ins, attrs) signature as primitives is what
+    makes higher-order differentiation compose — append_backward
+    differentiates a grad op like any other op and JAX traces
+    reverse-over-reverse (the reference hand-registers *_grad_grad kernels
+    per op, elementwise_add_op.cc:23-72; here every op gets one at once)."""
+    if "__fwd_op__" not in op.attrs:
+        opdef = registry.get(op.type)
+        return opdef.impl, opdef.nondiff_inputs
+
+    out_vars = list(op.outputs.get("InputGrads", ()))
+
+    def impl(ctx2, ins, attrs):
+        produced = _grad_apply(op, ins, ctx2)
+        return {"InputGrads": [produced.get(v.name) for v in out_vars]}
+
+    return impl, registry.get(_base_fwd(op).type).nondiff_inputs
+
+
+def _cot_slot_map(op):
+    """{forward output slot: grad-op input slot carrying its cotangents}."""
+    m = op.attrs.get("__cot_slots__")
+    if m is not None:
+        return m
+    fwd = op.attrs["__fwd_op__"]
+    return {s[: -len("@GRAD")]: s for s in op.inputs
+            if s.endswith("@GRAD") and s not in fwd.inputs}
+
+
+def _gather_grad_ins(op, env, ctx):
+    """Collect a grad op's input values: forward-op inputs from the
+    forward-time snapshot (env values may have been overwritten by in-place
+    writes since), upstream cotangents from env (None = dead: that grad var
+    was never produced — e.g. its producer pruned all its outputs)."""
+    fwd = op.attrs["__fwd_op__"]
+    cot_slot_names = set(_cot_slot_map(op).values())
+    snap = ctx.fwd_snapshots.get(id(fwd))
+    ins = {}
+    for slot, vs in op.inputs.items():
+        if not vs:
+            continue
+        if slot in cot_slot_names:
+            ins[slot] = [env.get(v.name) for v in vs]
+        elif snap is not None and slot in snap:
+            ins[slot] = snap[slot]
+        else:
+            ins[slot] = [env[v.name] for v in vs]
+    return ins
+
+
+def _grad_apply(gop, ins, ctx):
+    """Pure generic gradient kernel: vjp of the forward op's impl.
+
+    gop.attrs carries:
+      __fwd_op__       : the forward Operator (possibly itself a grad op)
       __grad_out_map__ : {slot: [grad var name or None per output]}
       __grad_in_map__  : {slot: [grad var name or None per input]}
-    """
-    fwd = op.attrs["__fwd_op__"]
-    gout_map = op.attrs["__grad_out_map__"]
-    gin_map = op.attrs["__grad_in_map__"]
-    opdef = registry.get(fwd.type)
 
-    fwd_ins = ctx.fwd_snapshots.get(id(fwd))
-    if fwd_ins is None:
-        fwd_ins = {
-            slot: [env[v.name] for v in vs]
-            for slot, vs in fwd.inputs.items() if vs
-        }
+    `ins` is the grad op's full input dict (slot -> list of values; None
+    marks a dead cotangent). Returns {grad var name: value} with duplicate
+    contributions (a var feeding the op twice) pre-summed. Pure in `ins`,
+    so a grad op can itself be differentiated by an outer jax.vjp."""
+    fwd = gop.attrs["__fwd_op__"]
+    gout_map = gop.attrs["__grad_out_map__"]
+    gin_map = gop.attrs["__grad_in_map__"]
+    impl, nondiff = _op_impl_fn(fwd, ctx)
+    cot_slot_names = set(_cot_slot_map(gop).values())
+
+    fwd_ins = {s: v for s, v in ins.items() if s not in cot_slot_names}
     diff_slots = [
         s
         for s in fwd_ins
-        if s not in opdef.nondiff_inputs
+        if s not in nondiff
         and any(
-            jnp.issubdtype(jnp.result_type(x), jnp.inexact) for x in fwd_ins[s]
+            x is not None
+            and jnp.issubdtype(jnp.result_type(x), jnp.inexact)
+            for x in fwd_ins[s]
         )
     ]
     const_ins = {s: v for s, v in fwd_ins.items() if s not in diff_slots}
     diff_ins = {s: fwd_ins[s] for s in diff_slots}
+
+    # upstream cotangent values: ins[cot_slot] aligns with the non-None
+    # entries of gout_map[out_slot]
+    cot_by_idx = {}
+    for out_slot, cslot in _cot_slot_map(gop).items():
+        names = gout_map.get(out_slot, [])
+        idxs = [i for i, g in enumerate(names) if g is not None]
+        for i, val in zip(idxs, ins.get(cslot, [])):
+            if val is not None:
+                cot_by_idx.setdefault(out_slot, {})[i] = val
 
     # Only differentiate through outputs that actually carry an upstream
     # cotangent. Taking the vjp over EVERY output would make jax save
@@ -224,12 +305,7 @@ def _execute_grad_op(op, env, ctx):
     # head) or layer_norm's Mean/Variance — which XLA then materializes
     # in the forward even though the dead outputs' zero cotangents fold
     # away in the backward.
-    def _is_live(slot, i, prim):
-        names = gout_map.get(slot, [])
-        gname = names[i] if i < len(names) else None
-        return (gname is not None and gname in env
-                and jnp.issubdtype(jnp.result_type(prim), jnp.inexact))
-
+    #
     # Probe output structure ABSTRACTLY (eval_shape emits no HLO): a real
     # re-execution would duplicate the forward — for control-flow ops a
     # whole second lax.scan/while that XLA cannot CSE across loop
@@ -237,18 +313,20 @@ def _execute_grad_op(op, env, ctx):
     # otherwise capture the probe's abstract tracers.
     with ctx.inner_trace():
         probe = jax.eval_shape(
-            lambda d: opdef.impl(ctx, d, fwd.attrs), fwd_ins)
+            lambda d: impl(ctx, d, fwd.attrs), fwd_ins)
     live_idx = {}
     for slot, prim_list in probe.items():
         idx = [i for i, prim in enumerate(prim_list)
-               if _is_live(slot, i, prim)]
+               if prim is not None
+               and i in cot_by_idx.get(slot, ())
+               and jnp.issubdtype(jnp.result_type(prim), jnp.inexact)]
         if idx:
             live_idx[slot] = idx
     if not live_idx:
-        return
+        return {}
 
     def f(d):
-        outs = opdef.impl(ctx, {**const_ins, **d}, fwd.attrs)
+        outs = impl(ctx, {**const_ins, **d}, fwd.attrs)
         return {slot: [outs[slot][i] for i in idx]
                 for slot, idx in live_idx.items()}
 
@@ -256,29 +334,43 @@ def _execute_grad_op(op, env, ctx):
 
     cots = {}
     for slot, prim_list in primal_out.items():
-        names = gout_map.get(slot, [])
         cot_list = []
         for j, prim in enumerate(prim_list):
-            i = live_idx[slot][j]
-            g = env[names[i]]
+            g = cot_by_idx[slot][live_idx[slot][j]]
             cot_list.append(g.astype(jnp.result_type(prim)))
         cots[slot] = cot_list
     (gd,) = vjp_fn(cots)
 
-    # scatter input grads into env, accumulating on name collisions (a var
-    # feeding the same op twice)
+    produced = {}
     for slot in diff_slots:
         names = gin_map.get(slot, [])
         for i, g in enumerate(gd[slot]):
             gname = names[i] if i < len(names) else None
-            if gname is None:
+            if gname is None or g is None:
                 continue
             if isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0:
                 continue
-            if gname in env and op.attrs.get("__accumulate__", {}).get(gname):
-                env[gname] = env[gname] + g
+            if gname in produced:
+                produced[gname] = produced[gname] + g
             else:
-                env[gname] = g
-            if ctx.check_nan_inf:
-                _nan_check(ctx, "%s_grad -> %s" % (fwd.type, gname),
-                           env[gname])
+                produced[gname] = g
+    return produced
+
+
+def _execute_grad_op(op, env, ctx):
+    """Executor entry for grad ops: gather inputs, run the pure kernel,
+    scatter produced grads into env (accumulating on the __accumulate__
+    tags append_backward computed)."""
+    ins = _gather_grad_ins(op, env, ctx)
+    # snapshot so THIS grad op can itself be differentiated by a later
+    # backward pass (fluid.gradients of a gradient)
+    ctx.fwd_snapshots[id(op)] = ins
+    produced = _grad_apply(op, ins, ctx)
+    accumulate = op.attrs.get("__accumulate__", {})
+    for gname, g in produced.items():
+        if gname in env and accumulate.get(gname):
+            env[gname] = env[gname] + g
+        else:
+            env[gname] = g
+        if ctx.check_nan_inf:
+            _nan_check(ctx, "%s -> %s" % (op.type, gname), env[gname])

@@ -49,6 +49,14 @@ class Scope:
     def set(self, name, value):
         self._vars[name] = value
 
+    def erase(self, name):
+        """Drop a var's value wherever it lives in the chain (parity:
+        framework/scope.cc Scope::EraseVars)."""
+        s = self
+        while s is not None:
+            s._vars.pop(name, None)
+            s = s.parent
+
     def has(self, name):
         return self.get(name, _MISSING) is not _MISSING
 

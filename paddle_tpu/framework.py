@@ -227,6 +227,7 @@ class Parameter(Variable):
         self.gradient_clip_attr = kwargs.pop("gradient_clip_attr", None)
         self.do_model_average = kwargs.pop("do_model_average", None)
         self.is_distributed = kwargs.pop("is_distributed", False)
+        self.shard_spec = kwargs.pop("shard_spec", None)
         super().__init__(block, shape=shape, dtype=dtype, **kwargs)
 
 

@@ -217,7 +217,19 @@ def recompute(fn, *args):
             raise TypeError("recompute(fn): fn must return Variable(s), "
                             "got %r" % (v,))
     reads, writes = _block_reads_writes(blk)
-    out_names = [v.name for v in out_list]
+    # fn may return one of its inputs unchanged (an outer-block var the
+    # segment never produced): route it AROUND the op — creating a
+    # same-named parent output would silently clobber the outer var and
+    # the op could never produce it at runtime
+    produced = set(writes)
+    passthrough = {}
+    for i, v in enumerate(out_list):
+        if v.name not in produced:
+            outer = parent._find_var_recursive(v.name)
+            if outer is not None:
+                passthrough[i] = outer
+    routed = [v for i, v in enumerate(out_list) if i not in passthrough]
+    out_names = [v.name for v in routed]
     x_names = []
     for n in dict.fromkeys(reads):
         if n in out_names:
@@ -234,17 +246,21 @@ def recompute(fn, *args):
                 "from fn instead so the gradient flows through the "
                 "checkpointed segment" % n)
     out_vars = []
-    for v in out_list:
+    for v in routed:
         nv = parent.create_var(name=v.name, shape=v.shape, dtype=v.dtype)
         out_vars.append(nv)
-    parent.append_op(
-        type="recompute",
-        inputs={"X": [parent.var(n) for n in x_names]},
-        outputs={"Out": out_vars},
-        attrs={"sub_block": blk, "x_names": x_names,
-               "out_names": out_names},
-    )
-    return out_vars[0] if single else tuple(out_vars)
+    if routed:
+        parent.append_op(
+            type="recompute",
+            inputs={"X": [parent.var(n) for n in x_names]},
+            outputs={"Out": out_vars},
+            attrs={"sub_block": blk, "x_names": x_names,
+                   "out_names": out_names},
+        )
+    routed_iter = iter(out_vars)
+    final = [passthrough[i] if i in passthrough else next(routed_iter)
+             for i in range(len(out_list))]
+    return final[0] if single else tuple(final)
 
 
 # ---------------------------------------------------------------------------

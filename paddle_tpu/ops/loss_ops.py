@@ -108,12 +108,17 @@ def _softmax_with_cross_entropy(ctx, ins, attrs):
         loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
     else:
         lab = label
-        if lab.shape and lab.shape[-1] == 1:
-            lab = lab.reshape(lab.shape[:-1])
+        ax = axis % logits.ndim
+        # hard label carries its singleton class dim at `axis` (reference
+        # layout, softmax_with_cross_entropy_op.cc) — move it last to align
+        # with the moveaxis'd logp before take_along_axis
+        if lab.ndim == logits.ndim and lab.shape[ax] == 1:
+            lab = jnp.squeeze(jnp.moveaxis(lab, ax, -1), -1)
         picked = jnp.take_along_axis(
-            jnp.moveaxis(logp, axis, -1),
+            jnp.moveaxis(logp, ax, -1),
             lab[..., None].astype(jnp.int32), axis=-1)
         loss = jnp.where(lab[..., None] == ignore_index, 0.0, -picked)
+        loss = jnp.moveaxis(loss, -1, ax)
     return {"Softmax": [softmax.astype(logits.dtype)], "Loss": [loss]}
 
 

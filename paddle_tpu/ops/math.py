@@ -48,7 +48,13 @@ def _fill_zeros_like(ctx, ins, attrs):
 @register("fill_any_like", differentiable=False)
 def _fill_any_like(ctx, ins, attrs):
     x = ins["X"][0]
-    return {"Out": [jnp.full_like(x, attrs.get("value", 0.0))]}
+    val = attrs.get("value", 0.0)
+    if attrs.get("__loss_seed__"):
+        # BuildStrategy.GradientScaleStrategy hook: the backward seed
+        # d loss/d loss scales by num-devices under `One` (reference
+        # ScaleLossGradOpHandle semantics, details/scale_loss_grad_op_handle.cc)
+        val = val * getattr(ctx, "grad_seed_scale", 1.0)
+    return {"Out": [jnp.full_like(x, val)]}
 
 
 @register("uniform_random", differentiable=False, stateful=True)

@@ -15,6 +15,7 @@ class ParamAttr:
         trainable=True,
         gradient_clip=None,
         do_model_average=False,
+        shard_spec=None,
     ):
         self.name = name
         self.initializer = initializer
@@ -23,6 +24,10 @@ class ParamAttr:
         self.trainable = trainable
         self.gradient_clip = gradient_clip
         self.do_model_average = do_model_average
+        # TPU-native: explicit PartitionSpec dims over the step mesh, e.g.
+        # (None, "tp") column-shards an fc weight. Consumed by
+        # parallel/planner.py; None = let the planner auto-derive.
+        self.shard_spec = shard_spec
 
     @staticmethod
     def _to_attr(arg):
@@ -48,6 +53,7 @@ class ParamAttr:
             "trainable": self.trainable,
             "gradient_clip_attr": self.gradient_clip,
             "do_model_average": self.do_model_average,
+            "shard_spec": self.shard_spec,
         }
         if with_initializer:
             kw["initializer"] = self.initializer

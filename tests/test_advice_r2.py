@@ -1,0 +1,102 @@
+"""Regression tests for the round-2 advisor findings."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run(fetches, feed):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return exe.run(fluid.default_main_program(), feed=feed,
+                   fetch_list=fetches)
+
+
+def test_softmax_ce_hard_label_nonlast_axis():
+    """axis != -1 with the reference's label layout (singleton class dim at
+    `axis`) must compute and keep the reference Loss shape."""
+    rng = np.random.RandomState(0)
+    lg = rng.randn(2, 5, 3).astype(np.float32)
+    lb = rng.randint(0, 5, size=(2, 1, 3)).astype(np.int64)
+
+    logits = layers.data(name="lg", shape=[2, 5, 3], dtype="float32",
+                         append_batch_size=False)
+    label = layers.data(name="lb", shape=[2, 1, 3], dtype="int64",
+                        append_batch_size=False)
+    loss = layers.softmax_with_cross_entropy(logits, label, axis=1)
+    (got,) = _run([loss], {"lg": lg, "lb": lb})
+
+    # reference semantics: loss[b, 0, t] = -log_softmax(lg, axis=1)[b, lb, t]
+    m = lg - lg.max(axis=1, keepdims=True)
+    logp = m - np.log(np.exp(m).sum(axis=1, keepdims=True))
+    want = -np.take_along_axis(logp, lb, axis=1)
+    assert got.shape == (2, 1, 3)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_recompute_passthrough_input():
+    """fn returning one of its inputs unchanged must not clobber the outer
+    var (it used to KeyError at run time)."""
+    x = layers.data(name="rp_x", shape=[4], dtype="float32",
+                    append_batch_size=False)
+
+    def seg(t):
+        return layers.scale(t, scale=2.0), t
+
+    doubled, same = layers.recompute(seg, x)
+    assert same.name == x.name
+    d, s = _run([doubled, same], {"rp_x": np.arange(4, dtype=np.float32)})
+    np.testing.assert_allclose(d, 2.0 * np.arange(4))
+    np.testing.assert_allclose(s, np.arange(4, dtype=np.float32))
+
+
+def test_recompute_identity_only():
+    """Degenerate: fn returns its input directly — no op appended, value
+    flows through."""
+    x = layers.data(name="ri_x", shape=[3], dtype="float32",
+                    append_batch_size=False)
+    out = layers.recompute(lambda t: t, x)
+    assert out.name == x.name
+    (v,) = _run([out], {"ri_x": np.ones(3, np.float32)})
+    np.testing.assert_allclose(v, 1.0)
+
+
+def test_convert_to_int8_runtime_uses_int8_store():
+    """After convert_to_int8 the runtime must compute FROM the int8 twin:
+    perturbing the int8 values changes the output, and the fp weight is
+    gone from the scope."""
+    prog, sprog = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, sprog):
+        x = layers.data(name="i8_x", shape=[4], dtype="float32")
+        out = layers.fc(x, 3, param_attr=fluid.ParamAttr(name="i8_w"),
+                        bias_attr=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = fluid.core.scope.Scope()
+    xv = np.ones((2, 4), np.float32)
+    with fluid.scope_guard(sc):
+        exe.run(sprog)
+        w = np.asarray(sc.get("i8_w"))
+        t = fluid.contrib.QuantizeTranspiler()
+        t.convert_to_int8(prog, scope=sc)
+
+        assert sc.get("i8_w") is None, "fp weight must leave the scope"
+        assert not prog.global_block().var("i8_w").persistable
+        q = np.asarray(sc.get("i8_w.int8"))
+        assert q.dtype == np.int8
+        iv = prog.global_block().var("i8_w.int8")
+
+        (y1,) = exe.run(prog, feed={"i8_x": xv}, fetch_list=[out])
+        np.testing.assert_allclose(y1, xv @ (q.astype(np.float32)
+                                             * iv.quant_scale),
+                                   rtol=1e-5, atol=1e-5)
+        # quantization error vs the original fp weights stays within a grid
+        np.testing.assert_allclose(y1, xv @ w, atol=4 * 4 * iv.quant_scale)
+
+        # flip the int8 store; the output must follow (proves the runtime
+        # reads the int8 values, not a stale fp copy)
+        sc.set("i8_w.int8", (q // 2).astype(np.int8))
+        (y2,) = exe.run(prog, feed={"i8_x": xv}, fetch_list=[out])
+        np.testing.assert_allclose(
+            y2, xv @ ((q // 2).astype(np.float32) * iv.quant_scale),
+            rtol=1e-5, atol=1e-5)

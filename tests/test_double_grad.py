@@ -1,0 +1,178 @@
+"""Second-order autodiff: fluid.gradients of a gradient must be CORRECT
+(round-2 verdict item 2 — it used to silently return the first-order value).
+
+Reference registers bespoke double-grad kernels per op
+(paddle/fluid/operators/elementwise/elementwise_add_op.cc:23-72, also
+mul/div/sub/conv2d); here grad ops are generic vjp kernels, so reverse-over-
+reverse composes for every op at once. These tests check closed forms,
+numeric parity against jax.grad(jax.grad(...)), and a gradient-penalty
+training loop (the WGAN-GP pattern that exercises minimize-after-gradients).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run(fetches, feed):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return exe.run(fluid.default_main_program(), feed=feed,
+                   fetch_list=fetches)
+
+
+def test_double_grad_square_closed_form():
+    # y = sum(x^2); g = dy/dx = 2x; z = sum(g^2) = 4*sum(x^2); dz/dx = 8x
+    x = layers.data(name="x", shape=[4], dtype="float32",
+                    append_batch_size=False)
+    x.stop_gradient = False
+    y = layers.reduce_sum(layers.square(x))
+    (g,) = fluid.gradients(y, x)
+    assert g is not None
+    z = layers.reduce_sum(layers.square(g))
+    (gg,) = fluid.gradients(z, x)
+    assert gg is not None
+    assert gg.name != g.name, "second pass must not resolve to pass-1 var"
+    xv = np.array([1.0, -2.0, 3.0, 0.5], np.float32)
+    g_v, gg_v = _run([g, gg], {"x": xv})
+    np.testing.assert_allclose(g_v, 2 * xv, rtol=1e-5)
+    np.testing.assert_allclose(gg_v, 8 * xv, rtol=1e-5)
+
+
+@pytest.mark.parametrize("build", [
+    ("mul", lambda a, b: layers.elementwise_mul(a, b),
+     lambda a, b: a * b),
+    ("add", lambda a, b: layers.elementwise_add(layers.square(a), b),
+     lambda a, b: a ** 2 + b),
+    ("div", lambda a, b: layers.elementwise_div(layers.square(a),
+                                                layers.exp(b)),
+     lambda a, b: a ** 2 / jnp.exp(b)),
+    ("sub", lambda a, b: layers.elementwise_sub(layers.tanh(a),
+                                                layers.square(b)),
+     lambda a, b: jnp.tanh(a) - b ** 2),
+    ("matmul", lambda a, b: layers.matmul(a, b),
+     lambda a, b: a @ b),
+], ids=lambda t: t[0])
+def test_double_grad_matches_jax(build):
+    _, fluid_fn, jax_fn = build
+    rng = np.random.RandomState(0)
+    av = rng.randn(3, 3).astype(np.float32)
+    bv = rng.randn(3, 3).astype(np.float32)
+
+    a = layers.data(name="a", shape=[3, 3], dtype="float32",
+                    append_batch_size=False)
+    b = layers.data(name="b", shape=[3, 3], dtype="float32",
+                    append_batch_size=False)
+    a.stop_gradient = False
+    b.stop_gradient = False
+    y = layers.reduce_sum(fluid_fn(a, b))
+    (ga,) = fluid.gradients(y, a)
+    z = layers.reduce_sum(layers.square(ga))
+    gga, ggb = fluid.gradients(z, [a, b])
+
+    def jax_z(aa, bb):
+        ga_ = jax.grad(lambda q: jnp.sum(jax_fn(q, bb)))(aa)
+        return jnp.sum(ga_ ** 2)
+
+    want_a = jax.grad(jax_z, argnums=0)(av, bv)
+    want_b = jax.grad(jax_z, argnums=1)(av, bv)
+
+    fetches = [v for v in (gga, ggb) if v is not None]
+    got = _run(fetches, {"a": av, "b": bv})
+    it = iter(got)
+    if gga is not None:
+        np.testing.assert_allclose(next(it), want_a, rtol=2e-4, atol=1e-5)
+    else:
+        np.testing.assert_allclose(np.asarray(want_a), 0, atol=1e-6)
+    if ggb is not None:
+        np.testing.assert_allclose(next(it), want_b, rtol=2e-4, atol=1e-5)
+    else:
+        np.testing.assert_allclose(np.asarray(want_b), 0, atol=1e-6)
+
+
+def test_conv2d_double_grad_matches_jax():
+    rng = np.random.RandomState(1)
+    xv = rng.randn(2, 3, 8, 8).astype(np.float32)
+
+    x = layers.data(name="x", shape=[2, 3, 8, 8], dtype="float32",
+                    append_batch_size=False)
+    x.stop_gradient = False
+    y = layers.conv2d(x, num_filters=4, filter_size=3,
+                      param_attr=fluid.ParamAttr(
+                          name="cw",
+                          initializer=fluid.initializer.Constant(0.05)),
+                      bias_attr=False)
+    loss = layers.reduce_sum(layers.square(y))
+    (gx,) = fluid.gradients(loss, x)
+    z = layers.reduce_sum(layers.square(gx))
+    (ggx,) = fluid.gradients(z, x)
+    got = _run([ggx], {"x": xv})[0]
+
+    w = np.full((4, 3, 3, 3), 0.05, np.float32)
+
+    def f(xx):
+        out = jax.lax.conv_general_dilated(
+            xx, w, (1, 1), "VALID",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return jnp.sum(out ** 2)
+
+    def zfn(xx):
+        return jnp.sum(jax.grad(f)(xx) ** 2)
+
+    want = jax.grad(zfn)(xv)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-5)
+
+
+def test_minimize_after_gradients_gradient_penalty():
+    """WGAN-GP shape: loss includes ||d out/d x||^2; optimizer.minimize is
+    a THIRD backward pass that must differentiate through pass-1 grad ops."""
+    rng = np.random.RandomState(2)
+    xv = rng.randn(8, 4).astype(np.float32)
+
+    x = layers.data(name="x", shape=[8, 4], dtype="float32",
+                    append_batch_size=False)
+    x.stop_gradient = False
+    h = layers.fc(x, size=8, act="tanh",
+                  param_attr=fluid.ParamAttr(name="w1"),
+                  bias_attr=fluid.ParamAttr(name="b1"))
+    out = layers.fc(h, size=1,
+                    param_attr=fluid.ParamAttr(name="w2"),
+                    bias_attr=fluid.ParamAttr(name="b2"))
+    score = layers.reduce_sum(out)
+    (gx,) = fluid.gradients(score, x)
+    penalty = layers.reduce_mean(
+        layers.square(layers.reduce_sum(layers.square(gx), dim=1) - 1.0))
+    loss = layers.reduce_mean(layers.square(out)) + 0.1 * penalty
+
+    opt = fluid.optimizer.SGD(learning_rate=0.05)
+    opt.minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    losses = []
+    for _ in range(12):
+        (lv,) = exe.run(fluid.default_main_program(), feed={"x": xv},
+                        fetch_list=[loss])
+        losses.append(float(np.asarray(lv).ravel()[0]))
+    assert losses[-1] < losses[0] * 0.9, losses
+    assert np.isfinite(losses).all()
+
+
+def test_first_order_param_grad_map_not_clobbered():
+    """gradients() must not overwrite the param->grad mapping minimize uses."""
+    x = layers.data(name="x", shape=[4, 2], dtype="float32",
+                    append_batch_size=False)
+    x.stop_gradient = False
+    y = layers.fc(x, size=3, param_attr=fluid.ParamAttr(name="wq"),
+                  bias_attr=False)
+    loss = layers.reduce_mean(layers.square(y))
+    opt = fluid.optimizer.SGD(learning_rate=0.1)
+    opt.minimize(loss)
+    before = dict(fluid.default_main_program().param_grad_map)
+    fluid.gradients(loss, x)
+    after = dict(fluid.default_main_program().param_grad_map)
+    assert before == after

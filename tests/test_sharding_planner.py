@@ -1,0 +1,274 @@
+"""General-program model parallelism (round-2 verdict item 1): ANY Fluid
+program shards over a dp×tp mesh via the planner + GSPMD — the TPU-native
+equivalent of the reference's multi-device graph builder
+(multi_devices_graph_pass.cc:165), which transforms arbitrary programs.
+
+Also covers verdict item 3: ReduceStrategy.Reduce -> ZeRO-1 optimizer-state
+sharding (reduce_op_handle.cc parity) and GradientScaleStrategy semantics.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core import scope as scope_mod
+
+
+def _mlp(prefix="s", emb=False):
+    """A plain fluid.layers model a user might write — nothing bespoke."""
+    if emb:
+        ids = layers.data(name=prefix + "_ids", shape=[8], dtype="int64")
+        h = layers.embedding(ids, size=[64, 16],
+                             param_attr=fluid.ParamAttr(name=prefix + "_emb"))
+        h = layers.reduce_mean(h, dim=1)
+        feeds = [prefix + "_ids"]
+    else:
+        x = layers.data(name=prefix + "_x", shape=[16], dtype="float32")
+        h = x
+        feeds = [prefix + "_x"]
+    y = layers.data(name=prefix + "_y", shape=[1], dtype="int64")
+    h = layers.fc(h, size=32, act="relu",
+                  param_attr=fluid.ParamAttr(name=prefix + "_w1"),
+                  bias_attr=fluid.ParamAttr(name=prefix + "_b1"))
+    h = layers.fc(h, size=32, act="relu",
+                  param_attr=fluid.ParamAttr(name=prefix + "_w2"),
+                  bias_attr=fluid.ParamAttr(name=prefix + "_b2"))
+    pred = layers.fc(h, size=4, act="softmax",
+                     param_attr=fluid.ParamAttr(name=prefix + "_w3"),
+                     bias_attr=fluid.ParamAttr(name=prefix + "_b3"))
+    loss = layers.mean(layers.cross_entropy(input=pred, label=y))
+    return loss, feeds + [prefix + "_y"]
+
+
+def _feed_for(names, rng, batch=32):
+    feed = {}
+    for n in names:
+        if n.endswith("_ids"):
+            feed[n] = rng.randint(0, 64, size=(batch, 8)).astype(np.int64)
+        elif n.endswith("_x"):
+            feed[n] = rng.rand(batch, 16).astype(np.float32)
+        else:
+            feed[n] = rng.randint(0, 4, size=(batch, 1)).astype(np.int64)
+    return feed
+
+
+def _params():
+    sc = scope_mod.global_scope()
+    return {n: np.asarray(sc.get(n)).copy()
+            for n in list(sc.local_var_names())
+            if isinstance(sc.get(n), np.ndarray)
+            or hasattr(sc.get(n), "shape")}
+
+
+def _train(compiled, loss, feed, steps=5):
+    exe = fluid.Executor(fluid.CPUPlace())
+    out = []
+    for _ in range(steps):
+        (lv,) = exe.run(compiled, feed=feed, fetch_list=[loss])
+        out.append(float(np.asarray(lv).reshape(-1)[0]))
+    return out
+
+
+def _single_then_restore(loss, feed, steps=5):
+    """Run single-device steps, return losses, restore initial params."""
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    sc = scope_mod.global_scope()
+    init = {n: np.asarray(sc.get(n)).copy() for n in sc.local_var_names()
+            if sc.get(n) is not None and not n.startswith("__")}
+    single = []
+    for _ in range(steps):
+        (lv,) = exe.run(fluid.default_main_program(), feed=feed,
+                        fetch_list=[loss])
+        single.append(float(np.asarray(lv).reshape(-1)[0]))
+    for n, v in init.items():
+        sc.set(n, v.copy())
+    sc.set("__step_counter__", 0)
+    return single
+
+
+def test_tp_auto_plan_loss_parity():
+    """dp=4 × tp=2 over the virtual 8-device mesh, auto-derived Megatron
+    specs: losses must track the single-device trajectory."""
+    loss, feeds = _mlp("tp")
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    rng = np.random.RandomState(0)
+    feed = _feed_for(feeds, rng)
+    single = _single_then_restore(loss, feed)
+
+    bs = fluid.BuildStrategy()
+    bs.tensor_parallel_degree = 2
+    compiled = fluid.CompiledProgram(
+        fluid.default_main_program()).with_data_parallel(
+            loss_name=loss.name, build_strategy=bs)
+    multi = _train(compiled, loss, feed)
+    np.testing.assert_allclose(multi, single, rtol=1e-4, atol=1e-5)
+
+    # the plan actually tensor-shards weights (not a silent dp fallback)
+    step = next(iter(compiled._compiled_steps.values()))
+    specs = step._plan.summary()
+    assert any("tp" in str(s) for s in specs.values()), specs
+    # and the scope now holds tp-sharded parameter arrays
+    import jax
+    w1 = scope_mod.global_scope().get("tp_w1")
+    assert isinstance(w1, jax.Array)
+    nshards = {tuple(s.data.shape) for s in w1.addressable_shards}
+    assert (16, 16) in nshards, nshards  # [16,32] column-sharded over tp=2
+
+
+def test_tp_embedding_and_explicit_annotation():
+    """Vocab-row-sharded embedding via auto plan + an explicit ParamAttr
+    shard_spec override on one fc."""
+    ids = layers.data(name="e_ids", shape=[8], dtype="int64")
+    y = layers.data(name="e_y", shape=[1], dtype="int64")
+    h = layers.embedding(ids, size=[64, 16],
+                         param_attr=fluid.ParamAttr(name="e_emb"))
+    h = layers.reduce_mean(h, dim=1)
+    h = layers.fc(h, size=32, act="relu",
+                  param_attr=fluid.ParamAttr(name="e_w1",
+                                             shard_spec=(None, "tp")))
+    pred = layers.fc(h, size=4, act="softmax",
+                     param_attr=fluid.ParamAttr(name="e_w2"))
+    loss = layers.mean(layers.cross_entropy(input=pred, label=y))
+    fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+
+    rng = np.random.RandomState(1)
+    feed = {"e_ids": rng.randint(0, 64, (32, 8)).astype(np.int64),
+            "e_y": rng.randint(0, 4, (32, 1)).astype(np.int64)}
+    single = _single_then_restore(loss, feed)
+
+    bs = fluid.BuildStrategy()
+    bs.tensor_parallel_degree = 2
+    compiled = fluid.CompiledProgram(
+        fluid.default_main_program()).with_data_parallel(
+            loss_name=loss.name, build_strategy=bs)
+    multi = _train(compiled, loss, feed)
+    np.testing.assert_allclose(multi, single, rtol=1e-4, atol=1e-5)
+    step = next(iter(compiled._compiled_steps.values()))
+    specs = step._plan.summary()
+    assert specs.get("e_emb") == ("tp", None), specs
+    assert specs.get("e_w1") == (None, "tp"), specs
+
+
+def test_shard_spec_inert_without_tp_axis():
+    """Annotations referencing absent mesh axes must not break dp-only."""
+    loss, feeds = _mlp("in")
+    blk = fluid.default_main_program().global_block()
+    blk.var("in_w1").shard_spec = (None, "tp")
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    rng = np.random.RandomState(2)
+    feed = _feed_for(feeds, rng)
+    single = _single_then_restore(loss, feed, steps=3)
+    compiled = fluid.CompiledProgram(
+        fluid.default_main_program()).with_data_parallel(loss_name=loss.name)
+    multi = _train(compiled, loss, feed, steps=3)
+    np.testing.assert_allclose(multi, single, rtol=1e-4, atol=1e-5)
+
+
+def test_reduce_mode_shards_optimizer_state():
+    """ReduceStrategy.Reduce = ZeRO-1: per-device optimizer-state bytes
+    shrink ~1/dp with loss parity vs AllReduce mode."""
+    import jax
+
+    loss, feeds = _mlp("zr")
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    rng = np.random.RandomState(3)
+    feed = _feed_for(feeds, rng)
+    single = _single_then_restore(loss, feed)
+
+    bs = fluid.BuildStrategy()
+    bs.reduce_strategy = fluid.BuildStrategy.ReduceStrategy.Reduce
+    compiled = fluid.CompiledProgram(
+        fluid.default_main_program()).with_data_parallel(
+            loss_name=loss.name, build_strategy=bs)
+    multi = _train(compiled, loss, feed)
+    np.testing.assert_allclose(multi, single, rtol=1e-4, atol=1e-5)
+
+    step = next(iter(compiled._compiled_steps.values()))
+    specs = step._plan.summary()
+    moment_specs = {n: s for n, s in specs.items() if "_moment" in n}
+    assert moment_specs, specs
+    assert all(s[0] == "dp" for s in moment_specs.values()), moment_specs
+
+    sc = scope_mod.global_scope()
+    mname = next(n for n in moment_specs if "w1" in n)
+    m = sc.get(mname)
+    assert isinstance(m, jax.Array)
+    shard_rows = {s.data.shape[0] for s in m.addressable_shards}
+    assert max(shard_rows) <= m.shape[0] // 4, (m.shape, shard_rows)
+
+
+def test_gradient_scale_one_and_customized():
+    """One => gradients scaled by num devices (lr effectively ×8 for SGD);
+    Customized => loud rejection, never a silent no-op."""
+    loss, feeds = _mlp("gs")
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    rng = np.random.RandomState(4)
+    feed = _feed_for(feeds, rng)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    sc = scope_mod.global_scope()
+    init = {n: np.asarray(sc.get(n)).copy() for n in sc.local_var_names()
+            if sc.get(n) is not None and not n.startswith("__")}
+
+    w_before = np.asarray(sc.get("gs_w1")).copy()
+    bs = fluid.BuildStrategy()
+    bs.gradient_scale_strategy = \
+        fluid.BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+    compiled = fluid.CompiledProgram(
+        fluid.default_main_program()).with_data_parallel(
+            loss_name=loss.name, build_strategy=bs)
+    _train(compiled, loss, feed, steps=1)
+    delta_coeff = np.asarray(sc.get("gs_w1")) - w_before
+
+    for n, v in init.items():
+        sc.set(n, v.copy())
+    sc.set("__step_counter__", 0)
+    bs2 = fluid.BuildStrategy()
+    bs2.gradient_scale_strategy = \
+        fluid.BuildStrategy.GradientScaleStrategy.One
+    compiled2 = fluid.CompiledProgram(
+        fluid.default_main_program()).with_data_parallel(
+            loss_name=loss.name, build_strategy=bs2)
+    _train(compiled2, loss, feed, steps=1)
+    delta_one = np.asarray(sc.get("gs_w1")) - w_before
+    np.testing.assert_allclose(delta_one, 8.0 * delta_coeff,
+                               rtol=1e-3, atol=1e-6)
+
+    bs3 = fluid.BuildStrategy()
+    bs3.gradient_scale_strategy = \
+        fluid.BuildStrategy.GradientScaleStrategy.Customized
+    compiled3 = fluid.CompiledProgram(
+        fluid.default_main_program()).with_data_parallel(
+            loss_name=loss.name, build_strategy=bs3)
+    with pytest.raises(NotImplementedError):
+        _train(compiled3, loss, feed, steps=1)
+
+
+def test_fluid_transformer_tp_dp_mesh():
+    """The done-criterion model: models/transformer_fluid.py (pure
+    fluid.layers) trains on a dp=4 × tp=2 mesh with loss parity."""
+    from paddle_tpu.models import transformer_fluid
+
+    tokens, labels, loss = transformer_fluid.build(
+        vocab_size=128, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        seq_len=16, remat=True)
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    rng = np.random.RandomState(5)
+    feed = {"tokens": rng.randint(0, 128, (8, 16)).astype(np.int32),
+            "labels": rng.randint(0, 128, (8, 16)).astype(np.int32)}
+    single = _single_then_restore(loss, feed, steps=4)
+
+    bs = fluid.BuildStrategy()
+    bs.tensor_parallel_degree = 2
+    compiled = fluid.CompiledProgram(
+        fluid.default_main_program()).with_data_parallel(
+            loss_name=loss.name, build_strategy=bs)
+    multi = _train(compiled, loss, feed, steps=4)
+    np.testing.assert_allclose(multi, single, rtol=2e-3, atol=1e-4)
+
+    step = next(iter(compiled._compiled_steps.values()))
+    specs = step._plan.summary()
+    assert any("tp" in str(s) for s in specs.values()), specs
