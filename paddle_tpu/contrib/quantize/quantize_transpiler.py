@@ -185,7 +185,7 @@ class QuantizeTranspiler:
                 type="dequantize",
                 inputs={"Input": [iv]},
                 outputs={"Output": [v]},
-                attrs={"Scale": bnt / scale},
+                attrs={"Scale": bnt / scale, "out_dtype": v.dtype},
             )
         program._bump_version()
         return program

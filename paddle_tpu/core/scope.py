@@ -50,11 +50,15 @@ class Scope:
         self._vars[name] = value
 
     def erase(self, name):
-        """Drop a var's value wherever it lives in the chain (parity:
-        framework/scope.cc Scope::EraseVars)."""
+        """Drop the NEAREST binding of a var (the one `get` would return) —
+        matching lookup semantics, so a child-scope shadow never deletes an
+        unrelated ancestor binding (parity: framework/scope.cc
+        Scope::EraseVars erases only the scope's own binding)."""
         s = self
         while s is not None:
-            s._vars.pop(name, None)
+            if name in s._vars:
+                del s._vars[name]
+                return
             s = s.parent
 
     def has(self, name):

@@ -120,7 +120,13 @@ def _quantize(ctx, ins, attrs):
 def _dequantize(ctx, ins, attrs):
     x = ins["Input"][0]
     scale = attrs.get("Scale", 1.0)
-    return {"Output": [x.astype(jnp.float32) / scale]}
+    out = x.astype(jnp.float32) / scale
+    # out_dtype keeps a converted fp16/bf16 weight at its declared dtype
+    # (convert_to_int8 sets it; reference preserves the weight var dtype)
+    od = attrs.get("out_dtype")
+    if od is not None:
+        out = out.astype(od)
+    return {"Output": [out]}
 
 
 @register("requantize", differentiable=False)

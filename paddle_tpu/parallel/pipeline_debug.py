@@ -1,0 +1,109 @@
+"""Structural checks on the lowered 1F1B pipeline step.
+
+The schedule's claim — embedding only on stage 0, vocab head only on the
+last stage — is enforced by lax.cond, which lowers to stablehlo.case. These
+helpers parse the lowered module text and verify every vocab-sized
+dot_general / embedding gather executes only under a conditional (directly
+in a case/if region, or in an outlined private func reachable solely from
+one). Used by tests/test_pipeline_1f1b.py and the driver's
+dryrun_multichip per-stage FLOP assertion.
+"""
+
+import re
+
+__all__ = ["case_region_spans", "func_spans", "make_inside_checker",
+           "assert_stage_local_flops"]
+
+
+def case_region_spans(text):
+    """Line-index spans of stablehlo.case/if regions (inline in StableHLO)."""
+    lines = text.splitlines()
+    spans = []
+    open_cases = []  # (start line, depth before the op)
+    depth = 0
+    for i, line in enumerate(lines):
+        if "stablehlo.case" in line or "stablehlo.if" in line:
+            open_cases.append((i, depth))
+        depth += line.count("{") - line.count("}")
+        while open_cases and depth <= open_cases[-1][1]:
+            start, _ = open_cases.pop()
+            spans.append((start, i))
+    return spans
+
+
+def func_spans(text):
+    """[(name, start, end)] for every func.func in the module."""
+    lines = text.splitlines()
+    out = []
+    cur = None
+    depth = 0
+    for i, line in enumerate(lines):
+        m = re.search(r"func\.func.*?@([\w.]+)", line)
+        if m and cur is None:
+            cur = (m.group(1), i, depth)
+        depth += line.count("{") - line.count("}")
+        if cur is not None and depth <= cur[2]:
+            out.append((cur[0], cur[1], i))
+            cur = None
+    return out
+
+
+def make_inside_checker(text):
+    """inside(i): line i executes only under a conditional — directly in a
+    case/if region, or in an outlined private func whose every call site
+    is (transitively) inside one."""
+    lines = text.splitlines()
+    spans = case_region_spans(text)
+    funcs = func_spans(text)
+
+    def enclosing_func(i):
+        for name, a, b in funcs:
+            if a < i <= b:
+                return name
+        return None
+
+    memo = {}
+
+    def inside(i, depth=0):
+        if any(a < i < b for a, b in spans):
+            return True
+        if depth > 3:
+            return False
+        fn = enclosing_func(i)
+        if fn is None or fn in memo:
+            return memo.get(fn, False)
+        memo[fn] = False  # cycle guard
+        call_sites = [k for k, l in enumerate(lines)
+                      if ("call @%s(" % fn) in l or ("call @%s " % fn) in l]
+        ok = bool(call_sites) and all(
+            inside(k, depth + 1) for k in call_sites)
+        memo[fn] = ok
+        return ok
+
+    return inside, spans
+
+
+def assert_stage_local_flops(lowered_text, vocab_size):
+    """Raise if the vocab head or embedding gather appears in straight-line
+    code of the pipeline step (i.e. every pp stage would compute it)."""
+    inside, spans = make_inside_checker(lowered_text)
+    if not spans:
+        raise AssertionError(
+            "pipeline step has no conditional regions — stage-local "
+            "embed/head skipping is not in the lowering")
+    lines = lowered_text.splitlines()
+    dot_pat = re.compile(r"dot_general.*[<x]%d[x>]" % vocab_size)
+    bad_dots = [i for i, l in enumerate(lines)
+                if dot_pat.search(l) and not inside(i)]
+    if bad_dots:
+        raise AssertionError(
+            "vocab-head dot_general in straight-line pipeline code "
+            "(every stage would compute it): lines %r" % bad_dots[:5])
+    gather_pat = re.compile(r"(gather|take).*%d" % vocab_size)
+    bad_gathers = [i for i, l in enumerate(lines)
+                   if "stablehlo" in l and gather_pat.search(l)
+                   and not inside(i)]
+    if bad_gathers:
+        raise AssertionError(
+            "embedding gather in straight-line pipeline code (every stage "
+            "would embed): lines %r" % bad_gathers[:5])
