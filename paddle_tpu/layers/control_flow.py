@@ -651,6 +651,15 @@ def array_write(x, i, array=None):
         ins["ArrayIn"] = [array]
     helper.append_op(type="array_write", inputs=ins,
                      outputs={"Out": [array]})
+    # record the element shape for array_read shape inference — only while
+    # it is consistent; host-list arrays may legally hold ragged elements,
+    # in which case reads go back to shape-unknown
+    if getattr(x, "shape", None) is not None:
+        if getattr(array, "shape", None) in (None, x.shape):
+            array.shape = x.shape
+            array.dtype = x.dtype
+        else:
+            array.shape = None
     return array
 
 
@@ -659,6 +668,8 @@ def array_read(array, i):
     out = helper.create_variable_for_type_inference(array.dtype)
     helper.append_op(type="array_read", inputs={"X": [array], "I": [i]},
                      outputs={"Out": [out]})
+    if getattr(array, "shape", None) is not None:
+        out.shape = array.shape
     return out
 
 

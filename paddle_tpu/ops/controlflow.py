@@ -15,6 +15,8 @@ models needing a differentiable loop express it as `recurrent` (StaticRNN/
 DynamicRNN), same as the reference's preferred path.
 """
 
+import typing
+
 import jax
 import jax.numpy as jnp
 
@@ -45,47 +47,87 @@ def _select_rowwise(ctx, ins, attrs):
     return {"Out": [jnp.where(c, x, y)]}
 
 
+class TensorArrayBuf(typing.NamedTuple):
+    """In-graph LoDTensorArray: a fixed-capacity stacked buffer
+    [capacity, *elem] plus a live-length scalar. As a NamedTuple it is a
+    pytree, so it rides lax.while_loop/scan carries — this is what lets
+    the reference's While-loop beam decoder (the level-2-LoD workload,
+    book test decoder_decode) run INSIDE one jitted region with a traced
+    write index, instead of host-side between segments."""
+
+    buf: typing.Any
+    n: typing.Any
+
+
 @register("array_write", differentiable=False)
 def _array_write(ctx, ins, attrs):
-    """LoDTensorArray write (tensor_array_read_write.cc). Arrays are
-    host-side lists: usable between jitted program segments; inside a traced
-    loop the index would be abstract — StaticRNN/DynamicRNN stacking is the
-    in-graph path (SURVEY §7 LoD hard-part)."""
-    arr = ins.get("ArrayIn", [None])[0] or []
-    i = int(ins["I"][0].reshape(()))
-    arr = list(arr)
+    """LoDTensorArray write (tensor_array_read_write.cc). Two modes:
+    host-side python list (concrete index — between jitted segments, the
+    original representation), or TensorArrayBuf (inside a traced While:
+    dynamic_update at a traced index into the pre-stacked buffer; the
+    `while` lowering converts carried lists to buffers on loop entry)."""
+    arr = ins.get("ArrayIn", [None])[0]
+    i = ins["I"][0].reshape(())
+    x = ins["X"][0]
+    if isinstance(arr, TensorArrayBuf):
+        i32 = i.astype(jnp.int32)
+        buf = jax.lax.dynamic_update_index_in_dim(
+            arr.buf, x.astype(arr.buf.dtype), i32, axis=0)
+        n = jnp.maximum(arr.n, i32 + 1)
+        return {"Out": [TensorArrayBuf(buf, n)]}
+    if isinstance(i, jax.core.Tracer):
+        raise RuntimeError(
+            "array_write at a traced index outside a While carry: give the "
+            "enclosing While a max_trip_count so the lowering can size the "
+            "array buffer, or write between jitted segments")
+    arr = list(arr or [])
+    i = int(i)
     while len(arr) <= i:
         arr.append(None)
-    arr[i] = ins["X"][0]
+    arr[i] = x
     return {"Out": [arr]}
 
 
 @register("array_read", differentiable=False)
 def _array_read(ctx, ins, attrs):
     arr = ins["X"][0]
-    i = int(ins["I"][0].reshape(()))
-    return {"Out": [arr[i]]}
+    i = ins["I"][0].reshape(())
+    if isinstance(arr, TensorArrayBuf):
+        return {"Out": [jax.lax.dynamic_index_in_dim(
+            arr.buf, i.astype(jnp.int32), axis=0, keepdims=False)]}
+    return {"Out": [arr[int(i)]]}
 
 
 @register("array_length", differentiable=False)
 def _array_length(ctx, ins, attrs):
-    return {"Out": [jnp.asarray([len(ins["X"][0])], jnp.int32)]}
+    arr = ins["X"][0]
+    if isinstance(arr, TensorArrayBuf):
+        return {"Out": [arr.n.reshape((1,)).astype(jnp.int32)]}
+    return {"Out": [jnp.asarray([len(arr)], jnp.int32)]}
 
 
 @register("tensor_array_to_tensor", differentiable=False)
 def _tensor_array_to_tensor(ctx, ins, attrs):
-    """Concat a LoDTensorArray (host-side list of arrays) along `axis`
+    """Concat a LoDTensorArray along `axis`
     (tensor_array_to_tensor_op.cc). OutIndex records each element's size
-    along the axis, the dense stand-in for the output LoD."""
+    along the axis, the dense stand-in for the output LoD. For a
+    TensorArrayBuf (array carried through a While) the FULL static
+    capacity is emitted — the live length is dynamic (arr.n); slots past
+    it hold zeros. Slice by OutIndex/arr.n host-side if the loop can end
+    early."""
     arr = ins["X"][0]
     axis = attrs.get("axis", 1)
     use_stack = attrs.get("use_stack", False)
-    if use_stack:
-        out = jnp.stack(list(arr), axis=axis)
-        sizes = jnp.ones((len(arr),), jnp.int32)
+    if isinstance(arr, TensorArrayBuf):
+        elems = [arr.buf[k] for k in range(arr.buf.shape[0])]
     else:
-        out = jnp.concatenate(list(arr), axis=axis)
-        sizes = jnp.asarray([a.shape[axis] for a in arr], jnp.int32)
+        elems = list(arr)
+    if use_stack:
+        out = jnp.stack(elems, axis=axis)
+        sizes = jnp.ones((len(elems),), jnp.int32)
+    else:
+        out = jnp.concatenate(elems, axis=axis)
+        sizes = jnp.asarray([a.shape[axis] for a in elems], jnp.int32)
     return {"Out": [out], "OutIndex": [sizes]}
 
 
@@ -111,6 +153,45 @@ def _while(ctx, ins, attrs):
     env = _env_of(ins, attrs)
     env[attrs["cond_name"]] = ins["Condition"][0]
     cond_idx = carry_names.index(attrs["cond_name"])
+    max_trip = attrs.get("max_trip_count")
+
+    # tensor arrays (host lists) touched by the loop become fixed-capacity
+    # stacked buffers so in-loop array_read/array_write lower to dynamic
+    # index/update at the traced counter (the reference beam-decoder
+    # pattern, tensor_array_read_write.cc inside while_op.cc). Capacity =
+    # current length + max_trip_count * (writes to this array per trip);
+    # read-only arrays need no headroom.
+    def _writes_per_trip(blk, name):
+        count = 0
+        for op in blk.ops:
+            if op.type == "array_write" and any(
+                    v.name == name for v in op.outputs.get("Out", [])):
+                count += 1
+            for key in ("sub_block", "true_block", "false_block"):
+                sub = op.attrs.get(key) if op.attrs else None
+                if sub is not None and getattr(sub, "ops", None) is not None:
+                    count += _writes_per_trip(sub, name)
+        return count
+
+    for name in list(env):
+        val = env.get(name)
+        if isinstance(val, list) and val and all(
+                hasattr(e, "shape") for e in val if e is not None):
+            writes = _writes_per_trip(block, name)
+            if writes and not max_trip:
+                raise RuntimeError(
+                    "While writes tensor array %r but has no "
+                    "max_trip_count: the in-graph array buffer needs a "
+                    "static capacity. Build the loop as "
+                    "layers.While(cond, max_trip_count=N)" % name)
+            elems = [e for e in val if e is not None]
+            cap = len(val) + int(max_trip or 0) * writes
+            proto = jnp.zeros_like(elems[0])
+            padded = [e if e is not None else proto for e in val]
+            padded += [proto] * (cap - len(padded))
+            env[name] = TensorArrayBuf(
+                jnp.stack(padded, axis=0),
+                jnp.asarray(len(val), jnp.int32))
 
     def body_fn(carry):
         local = dict(env)
@@ -120,7 +201,6 @@ def _while(ctx, ins, attrs):
         return tuple(local[n] for n in carry_names)
 
     init = tuple(env[n] for n in carry_names)
-    max_trip = attrs.get("max_trip_count")
     if max_trip:
         def scan_step(carry, _):
             pred = jnp.reshape(carry[cond_idx], ()).astype(bool)

@@ -100,3 +100,121 @@ def test_nmt_greedy_decode_reproduces_copy():
     # 1/(V-2) ~ 3.6% chance floor, proving the autoregressive loop works
     token_acc = (decoded == test_src).mean()
     assert token_acc > 0.3, (token_acc, final)
+
+
+def test_beam_search_decode_level2_lod_parity():
+    """The reference's level-2 LoD workload end-to-end (reference
+    tests/book/test_machine_translation.py decoder_decode): init_ids /
+    init_scores arrive as lod_level=2 LoDTensors, the decoder runs a While
+    loop with array_read/array_write state, per-step embedding + fc,
+    beam_search pruning and beam_search_decode backtracking. Parity target:
+    an independent numpy beam search over the same trained weights — and
+    the output re-wrapped in the reference's level-2 structure
+    (source -> hypotheses -> tokens) must carry the same
+    recursive_sequence_lengths."""
+    from paddle_tpu.core import scope as scope_mod
+
+    V, word_dim, H = 50, 12, 24
+    batch, beam, maxlen, src_len = 3, 2, 6, 5
+    END = 1
+
+    inputs, sent_ids, sent_scores = machine_translation.build_beam_decoder(
+        dict_size=V, word_dim=word_dim, decoder_size=H, beam_size=beam,
+        max_length=maxlen, src_len=src_len, end_id=END)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    rng = np.random.RandomState(11)
+    src = rng.randint(2, V, size=(batch, src_len)).astype(np.int64)
+
+    # the reference's feed shape: level-2 LoDTensors, one bos row per
+    # source sentence ([[1]*batch, [1]*batch])
+    init_ids_lod = fluid.create_lod_tensor(
+        np.full((batch, 1), 2, np.int64), [[1] * batch, [1] * batch])
+    init_scores_lod = fluid.create_lod_tensor(
+        np.zeros((batch, 1), np.float32), [[1] * batch, [1] * batch])
+    assert init_ids_lod.recursive_sequence_lengths() == [[1] * batch,
+                                                         [1] * batch]
+
+    # documented bridge (docs/MIGRATING.md): outer LoD levels flatten
+    # host-side into the dense beam axis; lane 0 live, others -inf
+    ids_dense = np.tile(np.asarray(init_ids_lod), (1, beam))
+    scores_dense = np.full((batch, beam), -1e9, np.float32)
+    scores_dense[:, 0] = np.asarray(init_scores_lod)[:, 0]
+
+    got_ids, got_scores = exe.run(
+        feed={"bd_src": src, "bd_init_ids": ids_dense,
+              "bd_init_scores": scores_dense},
+        fetch_list=[sent_ids, sent_scores])
+    got_ids = np.asarray(got_ids)          # [batch, beam, maxlen]
+    got_scores = np.asarray(got_scores)    # [batch, beam]
+
+    # ---- independent numpy beam search over the same weights ----
+    sc = scope_mod.global_scope()
+    W = {n: np.asarray(sc.get(n)) for n in
+         ("bd_vemb", "bd_enc_w", "bd_enc_b", "bd_vemb_dec", "bd_dec_w",
+          "bd_dec_b", "bd_out_w", "bd_out_b")}
+
+    def np_softmax(x):
+        e = np.exp(x - x.max(-1, keepdims=True))
+        return e / e.sum(-1, keepdims=True)
+
+    ctx = np.tanh(W["bd_vemb"][src].mean(1) @ W["bd_enc_w"] + W["bd_enc_b"])
+    state = np.repeat(ctx[:, None, :], beam, axis=1)        # [B, beam, H]
+    ids = ids_dense.copy()
+    scores = scores_dense.copy()
+    steps_ids, steps_par = [], []
+    for _ in range(maxlen):
+        emb = W["bd_vemb_dec"][ids]                          # [B, beam, D]
+        cur = np.tanh(np.concatenate([state, emb], -1) @ W["bd_dec_w"]
+                      + W["bd_dec_b"])
+        prob = np_softmax(cur @ W["bd_out_w"] + W["bd_out_b"])
+        k_idx = np.argsort(-prob, axis=-1)[..., :beam]
+        k_sc = np.take_along_axis(prob, k_idx, axis=-1)
+        finished = ids == END
+        cand = scores[:, :, None] + np.log(np.maximum(k_sc, 1e-20))
+        keepfirst = np.arange(beam)[None, None, :] == 0
+        cand = np.where(finished[:, :, None],
+                        np.where(keepfirst, scores[:, :, None], -1e30), cand)
+        cand_ids = np.where(finished[:, :, None], END, k_idx)
+        flat = cand.reshape(batch, beam * beam)
+        top = np.argsort(-flat, kind="stable", axis=1)[:, :beam]
+        parent = top // beam
+        scores = np.take_along_axis(flat, top, axis=1).astype(np.float32)
+        ids = np.take_along_axis(cand_ids.reshape(batch, -1), top, axis=1)
+        state = np.take_along_axis(
+            cur, parent[:, :, None].repeat(H, axis=2), axis=1)
+        steps_ids.append(ids.copy())
+        steps_par.append(parent.copy())
+    # backtrack
+    want = np.zeros((batch, beam, maxlen), np.int64)
+    ptr = np.tile(np.arange(beam), (batch, 1))
+    for t in range(maxlen - 1, -1, -1):
+        want[:, :, t] = np.take_along_axis(steps_ids[t], ptr, axis=1)
+        ptr = np.take_along_axis(steps_par[t], ptr, axis=1)
+
+    np.testing.assert_array_equal(got_ids, want)
+    np.testing.assert_allclose(got_scores, scores, rtol=1e-4, atol=1e-5)
+
+    # ---- re-wrap as the reference's level-2 LoDTensor result ----
+    def trim(seq):
+        out = []
+        for tok in seq:
+            out.append(int(tok))
+            if tok == END:
+                break
+        return out
+
+    hyps = [[trim(got_ids[b, w]) for w in range(beam)]
+            for b in range(batch)]
+    flat = np.concatenate([np.asarray(h, np.int64)
+                           for hs in hyps for h in hs])
+    lv1 = [beam] * batch                      # hypotheses per source
+    lv2 = [len(h) for hs in hyps for h in hs]  # tokens per hypothesis
+    result = fluid.create_lod_tensor(flat.reshape(-1, 1), [lv1, lv2])
+    assert result.has_valid_recursive_sequence_lengths()
+    assert result.recursive_sequence_lengths() == [lv1, lv2]
+    # every hypothesis decodes some tokens; finished ones end with END
+    for hs in hyps:
+        for h in hs:
+            assert len(h) >= 1
