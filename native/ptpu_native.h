@@ -78,6 +78,19 @@ PTPU_API int64_t ptpu_program_seal(const char* payload, uint64_t len,
 PTPU_API int64_t ptpu_program_unseal(const char* buf, uint64_t len,
                                      char** out);
 
+// ---- tensor wire framing (sendrecvop_utils.cc / variable_response.cc
+// parity — the pserver transport's per-tensor serde hot path) ----
+// dtype_code is the caller's enumeration (opaque here). Caller frees *out
+// with ptpu_buf_free. Returns framed length, -1 on error.
+PTPU_API int64_t ptpu_tensor_frame(const char* payload, uint64_t len,
+                                   int dtype_code, const int64_t* shape,
+                                   int ndim, char** out);
+// shape must hold 16 entries. Returns payload length; -1 malformed,
+// -2 bad ndim, -3 CRC mismatch. Caller frees *payload_out.
+PTPU_API int64_t ptpu_tensor_unframe(const char* buf, uint64_t len,
+                                     int* dtype_code, int64_t* shape,
+                                     int* ndim, char** payload_out);
+
 // ---- MultiSlot text data feed (framework/data_feed.cc C16 parity) ----
 // slot_types: 0 = int64 ids, 1 = float32. Returns a handle (NULL on open
 // failure); malformed lines are counted and skipped (CheckFile behavior).

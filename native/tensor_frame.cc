@@ -1,0 +1,76 @@
+// Tensor wire framing (parity: the reference serializes LoDTensors for its
+// gRPC variable transport in operators/distributed/sendrecvop_utils.cc +
+// variable_response.cc — dtype/dims header ahead of raw bytes, integrity
+// checked on receipt). This is the hot serde path of the parameter-server
+// runtime (paddle_tpu/distributed_runtime.py): every send_var/get_var
+// payload passes through frame/unframe here.
+//
+// Frame: magic 'PTTF' u32 | dtype_code u8 | ndim u8 | reserved u16 |
+//        shape i64[ndim] | payload_len u64 | payload_crc32 u32 | payload.
+#include "ptpu_native.h"
+
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+constexpr uint32_t kMagic = 0x50545446;  // "PTTF"
+constexpr int kMaxNdim = 16;
+}  // namespace
+
+extern "C" {
+
+int64_t ptpu_tensor_frame(const char* payload, uint64_t len, int dtype_code,
+                          const int64_t* shape, int ndim, char** out) {
+  if (ndim < 0 || ndim > kMaxNdim) return -1;
+  uint64_t head = 4 + 1 + 1 + 2 + 8ull * ndim + 8 + 4;
+  char* buf = static_cast<char*>(malloc(head + len));
+  if (!buf) return -1;
+  uint32_t crc = ptpu_crc32(payload, len);
+  uint8_t dc = static_cast<uint8_t>(dtype_code);
+  uint8_t nd = static_cast<uint8_t>(ndim);
+  uint16_t reserved = 0;
+  char* p = buf;
+  memcpy(p, &kMagic, 4); p += 4;
+  memcpy(p, &dc, 1); p += 1;
+  memcpy(p, &nd, 1); p += 1;
+  memcpy(p, &reserved, 2); p += 2;
+  memcpy(p, shape, 8ull * ndim); p += 8ull * ndim;
+  memcpy(p, &len, 8); p += 8;
+  memcpy(p, &crc, 4); p += 4;
+  memcpy(p, payload, len);
+  *out = buf;
+  return static_cast<int64_t>(head + len);
+}
+
+// Returns payload length; fills dtype_code/ndim/shape (shape must hold 16).
+// -1 malformed/bad magic, -2 bad ndim, -3 CRC mismatch.
+int64_t ptpu_tensor_unframe(const char* buf, uint64_t len, int* dtype_code,
+                            int64_t* shape, int* ndim, char** payload_out) {
+  if (len < 20) return -1;
+  uint32_t magic;
+  memcpy(&magic, buf, 4);
+  if (magic != kMagic) return -1;
+  uint8_t dc, nd;
+  memcpy(&dc, buf + 4, 1);
+  memcpy(&nd, buf + 5, 1);
+  if (nd > kMaxNdim) return -2;
+  uint64_t head = 4 + 1 + 1 + 2 + 8ull * nd + 8 + 4;
+  if (len < head) return -1;
+  memcpy(shape, buf + 8, 8ull * nd);
+  uint64_t plen;
+  uint32_t crc;
+  memcpy(&plen, buf + 8 + 8ull * nd, 8);
+  memcpy(&crc, buf + 16 + 8ull * nd, 4);
+  // len >= head holds above; this form cannot wrap on hostile plen
+  if (plen > len - head) return -1;
+  if (ptpu_crc32(buf + head, plen) != crc) return -3;
+  char* payload = static_cast<char*>(malloc(plen ? plen : 1));
+  if (!payload) return -1;
+  memcpy(payload, buf + head, plen);
+  *dtype_code = dc;
+  *ndim = nd;
+  *payload_out = payload;
+  return static_cast<int64_t>(plen);
+}
+
+}  // extern "C"

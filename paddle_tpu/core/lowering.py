@@ -112,14 +112,30 @@ def execute_block(block, env, ctx):
     return env
 
 
+def _op_scope_name(op):
+    """Stable profiler identity for one descriptor op: type plus its first
+    output var (sanitized). jax.named_scope threads this through HLO
+    metadata, so device traces map back to Fluid op names (the reference
+    tags kernels via platform::RecordEvent in operator.cc:180-184)."""
+    out = ""
+    for vs in op.outputs.values():
+        if vs:
+            out = vs[0].name
+            break
+    name = "%s__%s" % (op.type, out) if out else op.type
+    return "".join(c if (c.isalnum() or c in "_.-") else "_" for c in name)
+
+
 def execute_op(op, env, ctx):
     if op.type in _STRUCTURAL:
         return
     if op.type in _SPECIAL:
-        _SPECIAL[op.type](op, env, ctx)
+        with jax.named_scope("fluid/" + _op_scope_name(op)):
+            _SPECIAL[op.type](op, env, ctx)
         return
     if "__fwd_op__" in op.attrs:
-        _execute_grad_op(op, env, ctx)
+        with jax.named_scope("fluid/" + _op_scope_name(op)):
+            _execute_grad_op(op, env, ctx)
         return
     opdef = registry.get(op.type)
     ins = {
@@ -127,7 +143,8 @@ def execute_op(op, env, ctx):
     }
     if opdef.differentiable:
         ctx.fwd_snapshots[id(op)] = ins
-    outs = opdef.impl(ctx, ins, op.attrs)
+    with jax.named_scope("fluid/" + _op_scope_name(op)):
+        outs = opdef.impl(ctx, ins, op.attrs)
     _bind_outputs(op, outs, env, ctx)
 
 

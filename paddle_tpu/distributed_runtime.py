@@ -70,14 +70,20 @@ def _read_msg(sock):
     return mtype, meta, payload
 
 
-def _tensor_meta(name, arr):
-    return {"name": name, "dtype": str(arr.dtype),
-            "shape": list(arr.shape), "nbytes": arr.nbytes}
+def _tensor_payload(name, arr):
+    """(meta, framed payload): the tensor's dtype/shape/CRC framing runs in
+    the C++ runtime (native/tensor_frame.cc, sendrecvop_utils.cc parity) —
+    the wire's per-tensor serde hot path; JSON meta carries only routing."""
+    from .core.native import tensor_frame
+
+    framed = tensor_frame(arr)
+    return {"name": name, "nbytes": len(framed)}, framed
 
 
-def _tensor_from(meta, payload):
-    return np.frombuffer(payload, dtype=np.dtype(meta["dtype"])).reshape(
-        meta["shape"]).copy()
+def _tensor_from(payload):
+    from .core.native import tensor_unframe
+
+    return tensor_unframe(payload).copy()
 
 
 # ---------------------------------------------------------------------------
@@ -123,9 +129,9 @@ class ParameterServerClient:
 
     def send_var(self, endpoint, name, value):
         value = np.ascontiguousarray(value)
-        meta = _tensor_meta(name, value)
+        meta, framed = _tensor_payload(name, value)
         meta["trainer_id"] = self.trainer_id
-        self._rpc(endpoint, MSG_SEND, meta, value.tobytes())
+        self._rpc(endpoint, MSG_SEND, meta, framed)
 
     def send_barrier(self, endpoint):
         """Blocks until the server has aggregated this round and run its
@@ -138,7 +144,7 @@ class ParameterServerClient:
         _, meta, payload = self._rpc(endpoint, MSG_GET,
                                      {"name": name,
                                       "trainer_id": self.trainer_id})
-        return _tensor_from(meta, payload)
+        return _tensor_from(payload)
 
     def fetch_barrier(self, endpoint):
         self._rpc(endpoint, MSG_FETCH_BARRIER,
@@ -275,7 +281,7 @@ class _Handler(socketserver.BaseRequestHandler):
                 if mtype == MSG_SEND:
                     server.state.on_send(meta["name"],
                                          meta.get("trainer_id", 0),
-                                         _tensor_from(meta, payload))
+                                         _tensor_from(payload))
                     _write_msg(self.request, MSG_OK, {})
                 elif mtype == MSG_SEND_BARRIER:
                     ok = server.state.on_send_barrier(
@@ -289,8 +295,9 @@ class _Handler(socketserver.BaseRequestHandler):
                                      "completion notify?)"})
                 elif mtype == MSG_GET:
                     val = server.scope_get(meta["name"])
-                    m = _tensor_meta(meta["name"], val)
-                    _write_msg(self.request, MSG_VAR, m, val.tobytes())
+                    m, framed = _tensor_payload(meta["name"],
+                                                np.ascontiguousarray(val))
+                    _write_msg(self.request, MSG_VAR, m, framed)
                 elif mtype == MSG_FETCH_BARRIER:
                     server.state.on_fetch_barrier(meta.get("trainer_id", 0))
                     _write_msg(self.request, MSG_OK, {})

@@ -238,6 +238,9 @@ class PyReader:
         self._places = None
         self._feeder = None
         self._use_double_buffer = use_double_buffer
+        # buddy-allocator staging pool (native/allocator.cc, C19): batches
+        # are copied into arena-backed buffers before the async device_put
+        self._arena = None
 
     def decorate_sample_list_generator(self, generator, places=None):
         from ..data_feeder import DataFeeder
@@ -316,10 +319,32 @@ class PyReader:
             import jax
 
             if isinstance(item, dict):
-                return {k: jax.device_put(v) for k, v in item.items()}
+                if self._arena is None:
+                    from ..core.native import StagingArena
+
+                    self._arena = StagingArena()
+                # copy into stable arena-owned host buffers (two rotating
+                # slots per feed name), then async H2D from them — the
+                # reference's pinned staging in buffered_reader.cc. The
+                # arena blocks on a slot's in-flight transfer before
+                # reusing its memory (note_transfer bookkeeping).
+                def _one(k, v):
+                    staged = self._arena.stage(k, v)
+                    dev = jax.device_put(staged)
+                    self._arena.note_transfer(staged, dev)
+                    return dev
+
+                return {k: _one(k, v) for k, v in item.items()}
         except Exception:
             pass
         return item
+
+    def staging_stats(self):
+        """Buddy-allocator stats for the staging arena (get_mem_usage
+        parity): {'in_use', 'peak', 'allocs', 'native'}."""
+        if self._arena is None:
+            return {"in_use": 0, "peak": 0, "allocs": 0, "native": False}
+        return self._arena.stats()
 
     def start(self):
         self._iter = iter(self)

@@ -136,3 +136,82 @@ def test_native_trainer_trains_from_saved_program(tmp_path):
                        capture_output=True, text=True)
     assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
     assert "TRAIN OK" in r.stdout
+
+
+def test_tensor_frame_roundtrip_and_corruption():
+    """C++ tensor wire framing (tensor_frame.cc): roundtrip every wire
+    dtype, reject corrupted payloads (the pserver transport integrity
+    check, sendrecvop_utils.cc parity)."""
+    from paddle_tpu.core import native
+
+    assert native.lib() is not None, "native lib must build in CI"
+    rng = np.random.RandomState(0)
+    for dt in ("float32", "float64", "int32", "int64", "uint8", "bool"):
+        arr = (rng.rand(3, 4, 2) * 100).astype(dt)
+        framed = native.tensor_frame(arr)
+        back = native.tensor_unframe(framed)
+        assert back.dtype == arr.dtype and back.shape == arr.shape
+        np.testing.assert_array_equal(back, arr)
+    # scalar / empty
+    for arr in (np.float32(3.5).reshape(()), np.zeros((0, 5), np.int64)):
+        back = native.tensor_unframe(native.tensor_frame(arr))
+        assert back.shape == arr.shape
+
+    arr = rng.rand(16).astype(np.float32)
+    framed = bytearray(native.tensor_frame(arr))
+    framed[-3] ^= 0xFF  # flip a payload bit
+    try:
+        native.tensor_unframe(bytes(framed))
+        assert False, "corrupted frame must not decode"
+    except ValueError as e:
+        assert "crc" in str(e).lower() or "frame" in str(e).lower()
+
+    # python fallback produces the identical bytes (mixed fleets agree)
+    import importlib
+    l = native.lib()
+    try:
+        native._lib_saved = l
+        native._lib = None
+        native._tried = True
+        py_framed = native.tensor_frame(arr)
+    finally:
+        native._lib = l
+    assert py_framed == native.tensor_frame(arr)
+
+
+def test_staging_arena_backs_pyreader_feed_path():
+    """The buddy allocator genuinely serves the PyReader double-buffer
+    path (C19): batches flow through arena-owned buffers (allocs > 0,
+    peak > 0) and values stay correct across many slot-rotation cycles."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    x = layers.data(name="sa_x", shape=[8], dtype="float32")
+    y = layers.fc(x, 4, bias_attr=False,
+                  param_attr=fluid.ParamAttr(
+                      name="sa_w",
+                      initializer=fluid.initializer.Constant(1.0)))
+    out = layers.reduce_sum(y, dim=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    reader = fluid.io.PyReader(feed_list=[x], capacity=4,
+                               use_double_buffer=True, iterable=True)
+    batches = [np.full((2, 8), float(i), np.float32) for i in range(8)]
+
+    def gen():
+        for b in batches:
+            yield [[row] for row in b]
+
+    reader.decorate_sample_list_generator(gen)
+    got = []
+    for feed in reader():
+        (v,) = exe.run(feed=feed, fetch_list=[out])
+        got.append(np.asarray(v).ravel())
+    # sum over 8 ones-weighted features * 4 outputs = 32 * i per row
+    for i, v in enumerate(got):
+        np.testing.assert_allclose(v, 32.0 * i, rtol=1e-5)
+
+    stats = reader.staging_stats()
+    if stats["native"]:
+        assert stats["allocs"] > 0 and stats["peak"] > 0, stats
