@@ -275,11 +275,12 @@ class _DataParallelStep:
         donate = () if self._check_nan_inf else (0,)
         mut_sh = {n: self._state_shardings[n] for n in self.mut_names}
         const_sh = {n: self._state_shardings[n] for n in self.const_names}
-        feed_sh = {n: batch for n in self.feed_names}
+        # feeds get their sharding at run time (device_put): a batch not
+        # divisible by dp falls back to replicated instead of erroring
         self._jitted = jax.jit(
             step,
             donate_argnums=donate,
-            in_shardings=(mut_sh, const_sh, feed_sh, None),
+            in_shardings=(mut_sh, const_sh, None, None),
         )
 
     def run(self, scope, feed):
@@ -293,19 +294,30 @@ class _DataParallelStep:
                         "persistable var %r is not initialized — run the "
                         "startup program first" % name)
                 store[name] = val
+        dp = int(dict(self.mesh.shape).get("dp", 1))
         feeds = {}
         for name in self.feed_names:
             v = self.block._find_var_recursive(name)
-            arr = np.asarray(feed[name])
+            arr = feed[name]
+            # device-resident feeds pass through without a host round-trip
+            # (PyReader double-buffer / user device_put)
+            if not isinstance(arr, jax.Array):
+                arr = np.asarray(arr)
             if v is not None and v.shape is not None:
                 want = dtype_to_np(v.dtype)
                 if arr.dtype != want:
                     arr = arr.astype(want)
+            if not self._multiprocess:
+                sh = (self._batch if arr.ndim and arr.shape[0] % dp == 0
+                      else self._repl)
+                arr = jax.device_put(arr, sh)
             feeds[name] = arr
         if self._multiprocess:
             feeds = {
                 name: jax.make_array_from_callback(
-                    arr.shape, self._batch,
+                    arr.shape,
+                    (self._batch if np.ndim(arr)
+                     and arr.shape[0] % dp == 0 else self._repl),
                     lambda idx, a=arr: a[idx])
                 for name, arr in feeds.items()}
             for store in (mut, const):

@@ -518,21 +518,39 @@ class StagingArena:
         with self._lock:
             pair = self._slots.get(k)
             if pair is None:
-                ptrs, views = [], []
-                for _ in range(2):
-                    ptr = self._lib.ptpu_alloc(self._h, max(arr.nbytes, 1))
-                    if not ptr:
-                        # arena full: free the partial pair and degrade
-                        for p in ptrs:
-                            self._lib.ptpu_free(self._h, p)
-                        return arr.copy()
-                    raw = (ctypes.c_char * max(arr.nbytes, 1)).from_address(
-                        ptr)
-                    views.append(np.frombuffer(
-                        raw, dtype=arr.dtype).reshape(arr.shape))
-                    ptrs.append(ptr)
-                # [views, ptrs, pending device arrays per slot]
-                pair = [views, ptrs, [None, None]]
+                # evict this feed key's stale shapes, keeping the most
+                # recent one as a spare (bucketed batches alternate a few
+                # shapes; unbounded retention would pin the arena until
+                # staging silently degraded to plain copies)
+                stale = [k2 for k2 in self._slots
+                         if k2[0] == key and k2 != k]
+                for k2 in stale[:-1]:
+                    self._release_slot(k2)
+                stale = stale[-1:]
+
+                def try_alloc():
+                    ptrs, views = [], []
+                    for _ in range(2):
+                        ptr = self._lib.ptpu_alloc(self._h,
+                                                   max(arr.nbytes, 1))
+                        if not ptr:
+                            for p in ptrs:
+                                self._lib.ptpu_free(self._h, p)
+                            return None
+                        raw = (ctypes.c_char
+                               * max(arr.nbytes, 1)).from_address(ptr)
+                        views.append(np.frombuffer(
+                            raw, dtype=arr.dtype).reshape(arr.shape))
+                        ptrs.append(ptr)
+                    return [views, ptrs, [None, None]]
+
+                pair = try_alloc()
+                if pair is None and stale:
+                    # arena full: drop the spare too and retry once
+                    self._release_slot(stale[0])
+                    pair = try_alloc()
+                if pair is None:
+                    return arr.copy()
                 self._slots[k] = pair
                 self._flip[k] = 0
             i = self._flip[k]
@@ -563,6 +581,22 @@ class StagingArena:
         if pair is not None and pair[0][i] is staged_view:
             pair[2][i] = device_array
 
+    def _release_slot(self, k):
+        """Free one slot pair (caller holds the lock): wait out in-flight
+        transfers, then return the buffers to the buddy arena."""
+        pair = self._slots.pop(k, None)
+        self._flip.pop(k, None)
+        if pair is None:
+            return
+        for dev in pair[2]:
+            if dev is not None:
+                try:
+                    dev.block_until_ready()
+                except Exception:
+                    pass
+        for p in pair[1]:
+            self._lib.ptpu_free(self._h, p)
+
     def stats(self):
         if self._h is None:
             return {"in_use": 0, "peak": 0, "allocs": 0, "native": False}
@@ -573,8 +607,12 @@ class StagingArena:
 
     def close(self):
         if self._h is not None:
-            # views into the arena must be dropped before the arena
-            self._slots.clear()
+            with self._lock:
+                # drain in-flight transfers BEFORE freeing their host
+                # buffers (PJRT reads them until the H2D copy lands),
+                # then drop the views and the arena
+                for k in list(self._slots):
+                    self._release_slot(k)
             self._lib.ptpu_allocator_destroy(self._h)
             self._h = None
 

@@ -926,7 +926,11 @@ def softmax_with_cross_entropy(logits, label, soft_label=False,
         inputs={"Logits": [logits], "Label": [label]},
         outputs={"Softmax": [softmax_out], "Loss": [loss]},
         attrs={"soft_label": soft_label, "ignore_index": ignore_index,
-               "axis": axis},
+               "axis": axis,
+               # kernel skips materializing the softmax side output when
+               # the caller discards it — for an LM head that output is a
+               # full fp32 [B, T, vocab] HBM write per step
+               "__need_softmax__": bool(return_softmax)},
     )
     if logits.shape is not None:
         s = list(logits.shape)

@@ -5,7 +5,7 @@ scaled_dot_product_attention)."""
 from . import layers
 
 __all__ = ["simple_img_conv_pool", "img_conv_group", "sequence_conv_pool",
-           "glu", "scaled_dot_product_attention"]
+           "glu", "scaled_dot_product_attention", "switch_moe"]
 
 
 def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
@@ -128,3 +128,46 @@ def scaled_dot_product_attention(queries, keys, values, num_heads=1,
     ctx = layers.matmul(weights, v)
     ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
     return layers.reshape(ctx, shape=[0, 0, d])
+
+
+def switch_moe(input, num_experts, d_ff, capacity_factor=1.25,
+               param_attr=None, name=None):
+    """Switch-transformer MoE FFN block with a residual connection
+    (beyond-reference; expert parallelism through the DESCRIPTOR path:
+    the expert weights carry shard_spec=("dp", None, None), so under
+    CompiledProgram.with_data_parallel the sharding planner places one
+    expert group per dp rank and GSPMD routes tokens — the any-program
+    analogue of parallel/transformer's hand-written shard_map MoE).
+
+    input [B, T, D] -> (out [B, T, D], aux_loss []): add
+    `aux_weight * aux_loss` to the training loss for load balancing."""
+    from .layer_helper import LayerHelper
+    from .param_attr import ParamAttr
+
+    helper = LayerHelper("switch_moe", **locals())
+    D = input.shape[-1]
+    base = name or helper.name
+
+    def _p(suffix, shape, shard_spec=None):
+        attr = ParamAttr(name="%s_%s" % (base, suffix),
+                         shard_spec=shard_spec)
+        if isinstance(param_attr, ParamAttr) and param_attr.initializer:
+            attr.initializer = param_attr.initializer
+        return helper.create_parameter(attr=attr, shape=shape,
+                                       dtype=input.dtype)
+
+    router = _p("router", [D, num_experts])
+    w1 = _p("w1", [num_experts, D, d_ff], shard_spec=("dp", None, None))
+    w2 = _p("w2", [num_experts, d_ff, D], shard_spec=("dp", None, None))
+
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    aux = helper.create_variable_for_type_inference(dtype="float32")
+    helper.append_op(
+        type="switch_moe",
+        inputs={"X": [input], "Router": [router], "W1": [w1], "W2": [w2]},
+        outputs={"Out": [out], "AuxLoss": [aux]},
+        attrs={"capacity_factor": capacity_factor},
+    )
+    out.shape = input.shape
+    aux.shape = ()
+    return out, aux

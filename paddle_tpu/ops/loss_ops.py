@@ -92,20 +92,26 @@ def _softmax_with_cross_entropy(ctx, ins, attrs):
     soft = attrs.get("soft_label", False)
     ignore_index = attrs.get("ignore_index", -100)
     axis = attrs.get("axis", -1)
+    need_softmax = attrs.get("__need_softmax__", True)
     if not soft and axis in (-1, logits.ndim - 1):
         lab = label
         if lab.shape and lab.shape[-1] == 1:
             lab = lab.reshape(lab.shape[:-1])
         loss = _hard_label_ce(logits, lab, ignore_index)
-        softmax = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
         # Loss stays fp32 even for bf16 logits (black-list AMP
         # semantics): downstream sums over ~1e5 per-token losses would
         # lose ~3 digits in bf16
+        if not need_softmax:
+            # skipping the discarded side output saves a full fp32
+            # [.., vocab] HBM round-trip per step on LM heads
+            return {"Loss": [loss]}
+        softmax = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
         return {"Softmax": [softmax.astype(logits.dtype)], "Loss": [loss]}
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
-    softmax = jnp.exp(logp)
     if soft:
         loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+        if not need_softmax:
+            return {"Loss": [loss]}
     else:
         lab = label
         ax = axis % logits.ndim
@@ -119,6 +125,9 @@ def _softmax_with_cross_entropy(ctx, ins, attrs):
             lab[..., None].astype(jnp.int32), axis=-1)
         loss = jnp.where(lab[..., None] == ignore_index, 0.0, -picked)
         loss = jnp.moveaxis(loss, -1, ax)
+        if not need_softmax:
+            return {"Loss": [loss]}
+    softmax = jnp.exp(logp)
     return {"Softmax": [softmax.astype(logits.dtype)], "Loss": [loss]}
 
 

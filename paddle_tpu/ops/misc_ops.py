@@ -702,3 +702,52 @@ def _lookup_table_host(ctx, ins, attrs):
     anchor = ins["Anchor"][0].reshape(())
     out = host_embedding_lookup(attrs["table_name"], ids, anchor)
     return {"Out": [out]}
+
+
+@register("switch_moe", nondiff_inputs=())
+def _switch_moe(ctx, ins, attrs):
+    """Top-1 switch mixture-of-experts FFN (beyond-reference, SURVEY §5.7
+    expert-parallel axis; same math as parallel/transformer._moe_block but
+    as a single-program kernel — under the sharding planner the expert
+    weights carry P("dp", ...) specs and GSPMD inserts the token
+    all-to-all the shard_map version writes by hand).
+
+    X [B, T, D], Router [D, E], W1 [E, D, F], W2 [E, F, D] -> Out
+    [B, T, D], AuxLoss [] (switch load-balance loss, fp32)."""
+    x = ins["X"][0]
+    router = ins["Router"][0]
+    w1, w2 = ins["W1"][0], ins["W2"][0]
+    cap_factor = float(attrs.get("capacity_factor", 1.25))
+    dtype = x.dtype
+    B, T, D = x.shape
+    E = router.shape[1]
+    N = B * T
+    xt = x.reshape(N, D)
+
+    gates = jax.nn.softmax(jnp.einsum(
+        "nd,de->ne", xt.astype(jnp.float32), router.astype(jnp.float32)))
+    expert = jnp.argmax(gates, axis=-1)
+    gate = jnp.take_along_axis(gates, expert[:, None], axis=-1)[:, 0]
+
+    cap = int(cap_factor * N / E) + 1
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1
+    pos1 = pos.max(axis=-1)
+    keep = pos1 < cap
+    idx_e = jnp.where(keep, expert, 0)
+    idx_c = jnp.where(keep, pos1, 0)
+    disp = jnp.zeros((E, cap, D), dtype).at[idx_e, idx_c].add(
+        jnp.where(keep[:, None], xt, 0).astype(dtype))
+
+    a = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", disp, w1.astype(dtype)))
+    out = jnp.einsum("ecf,efd->ecd", a, w2.astype(dtype))
+
+    y = out[idx_e, idx_c]
+    y = jnp.where(keep[:, None], y, 0).astype(jnp.float32) * gate[:, None]
+    y = (xt + y.astype(dtype)).reshape(B, T, D)
+
+    # switch aux loss: E * Σ_e fraction_e * mean_gate_e
+    frac = jnp.mean(onehot.astype(jnp.float32), axis=0)
+    mean_gate = jnp.mean(gates, axis=0)
+    aux = E * jnp.sum(frac * mean_gate)
+    return {"Out": [y], "AuxLoss": [aux]}

@@ -104,14 +104,36 @@ def plan_program(program, mesh, build_strategy=None, zero_sharding=False):
     dp = dict(mesh.shape).get("dp", 1)
     ops = list(_op_stream(block))
 
+    axis_sizes = dict(mesh.shape)
+
+    def _fit(var, spec):
+        """Demote spec dims the var's static shape can't divide — jit
+        in_shardings (unlike with_sharding_constraint) reject uneven
+        dimension sharding."""
+        shape = getattr(var, "shape", None)
+        if shape is None:
+            return spec
+        dims = []
+        for i, d in enumerate(tuple(spec)):
+            if d is None or i >= len(shape) or shape[i] is None \
+                    or shape[i] < 0:
+                dims.append(d)
+                continue
+            axes = d if isinstance(d, (tuple, list)) else (d,)
+            n = 1
+            for a in axes:
+                n *= axis_sizes.get(a, 1)
+            dims.append(d if n and shape[i] % n == 0 else None)
+        return P(*dims)
+
     def note(var, spec):
         if var.name not in plan.specs:
-            plan.specs[var.name] = spec
+            plan.specs[var.name] = _fit(var, spec)
 
     def explicit(var):
         s = _explicit_spec(var, build_strategy, mesh_axes)
         if s is not None:
-            plan.specs[var.name] = s
+            plan.specs[var.name] = _fit(var, s)
             return True
         return False
 
@@ -229,5 +251,5 @@ def plan_program(program, mesh, build_strategy=None, zero_sharding=False):
                     rest = tuple(base[1:]) if base else ()
                     rest = rest + (None,) * max(
                         0, len(v.shape) - 1 - len(rest))
-                    plan.specs[v.name] = P("dp", *rest)
+                    plan.specs[v.name] = _fit(v, P("dp", *rest))
     return plan

@@ -89,11 +89,22 @@ def test_parallel_executor_share_vars_from():
                                rtol=1e-5)
 
 
-def test_batch_not_divisible_by_devices_errors_clearly():
+def test_batch_not_divisible_by_devices_still_correct():
+    """A batch the dp axis cannot split (5 rows over 8 devices) must still
+    run with exact semantics — the feed falls back to replicated instead
+    of erroring (reference PE rejects this; graceful-correct beats both
+    erroring and silent truncation)."""
     loss, xs, ys = _build(seed=2)
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(fluid.default_startup_program())
+    init = _snapshot_params()
+    single, = exe.run(feed={"img": xs[:5], "label": ys[:5]},
+                      fetch_list=[loss])
+    _restore_params(init)
+    scope_mod.global_scope().set("__step_counter__", 0)
     pe = fluid.ParallelExecutor(loss_name=loss.name)
-    with pytest.raises(Exception):
-        pe.run(feed={"img": xs[:5], "label": ys[:5]},
-               fetch_list=[loss.name])
+    multi, = pe.run(feed={"img": xs[:5], "label": ys[:5]},
+                    fetch_list=[loss.name])
+    np.testing.assert_allclose(float(np.asarray(multi).mean()),
+                               float(np.asarray(single).reshape(-1)[0]),
+                               rtol=1e-4)

@@ -1,0 +1,117 @@
+"""Expert parallelism through the DESCRIPTOR path: nets.switch_moe built
+from a Fluid program, expert weights planner-sharded over dp, loss parity
+vs single device (the any-program analogue of the shard_map MoE in
+parallel/transformer.py, SURVEY §5.7 beyond-reference axis)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, nets
+from paddle_tpu.core import scope as scope_mod
+
+
+def _build(num_experts=8):
+    x = fluid.layers.data(name="moe_x", shape=[8, 16], dtype="float32",
+                          append_batch_size=False)
+    seq = layers.fc(x, 16, num_flatten_dims=1,
+                    param_attr=fluid.ParamAttr(name="moe_in_w"))
+    seq = layers.reshape(seq, shape=[4, 2, 16])
+    out, aux = nets.switch_moe(seq, num_experts=num_experts, d_ff=32,
+                               name="moe_blk")
+    y = fluid.layers.data(name="moe_y", shape=[4, 2, 16], dtype="float32",
+                          append_batch_size=False)
+    mse = layers.reduce_mean(layers.square(
+        layers.elementwise_sub(out, y)))
+    loss = layers.elementwise_add(mse, layers.scale(aux, scale=0.01))
+    return loss
+
+
+def test_switch_moe_trains_and_balances():
+    loss = _build()
+    fluid.optimizer.Adam(0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = {"moe_x": rng.randn(8, 16).astype(np.float32),
+            "moe_y": rng.randn(4, 2, 16).astype(np.float32)}
+    losses = []
+    for _ in range(25):
+        (lv,) = exe.run(feed=feed, fetch_list=[loss])
+        losses.append(float(np.asarray(lv).ravel()[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.9, losses[:3] + losses[-3:]
+
+
+def test_switch_moe_expert_parallel_parity():
+    """dp mesh: expert weights shard over dp (one expert group per rank)
+    with loss parity vs the single-device run."""
+    import jax
+
+    loss = _build()
+    fluid.optimizer.Adam(0.01).minimize(loss)
+    rng = np.random.RandomState(1)
+    feed = {"moe_x": rng.randn(8, 16).astype(np.float32),
+            "moe_y": rng.randn(4, 2, 16).astype(np.float32)}
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    sc = scope_mod.global_scope()
+    init = {n: np.asarray(sc.get(n)).copy() for n in sc.local_var_names()
+            if sc.get(n) is not None and not n.startswith("__")}
+    single = []
+    for _ in range(4):
+        (lv,) = exe.run(fluid.default_main_program(), feed=feed,
+                        fetch_list=[loss])
+        single.append(float(np.asarray(lv).ravel()[0]))
+    for n, v in init.items():
+        sc.set(n, v.copy())
+    sc.set("__step_counter__", 0)
+
+    compiled = fluid.CompiledProgram(
+        fluid.default_main_program()).with_data_parallel(
+            loss_name=loss.name)
+    multi = []
+    for _ in range(4):
+        (lv,) = exe.run(compiled, feed=feed, fetch_list=[loss])
+        multi.append(float(np.asarray(lv).ravel()[0]))
+    np.testing.assert_allclose(multi, single, rtol=2e-4, atol=1e-5)
+
+    step = next(iter(compiled._compiled_steps.values()))
+    specs = step._plan.summary()
+    assert specs.get("moe_blk_w1") == ("dp", None, None), specs
+    assert specs.get("moe_blk_w2") == ("dp", None, None), specs
+    w1 = sc.get("moe_blk_w1")
+    assert isinstance(w1, jax.Array)
+    shard_rows = {s.data.shape[0] for s in w1.addressable_shards}
+    assert max(shard_rows) == 1, shard_rows  # 8 experts over dp=8: 1 each
+
+
+def test_switch_moe_indivisible_experts_demote_to_replicated():
+    """4 experts on a dp=8 mesh: jit in_shardings cannot split 4 over 8,
+    so the planner demotes the expert dim to replicated and training still
+    matches single-device (graceful, never an error)."""
+    loss = _build(num_experts=4)
+    fluid.optimizer.SGD(0.05).minimize(loss)
+    rng = np.random.RandomState(2)
+    feed = {"moe_x": rng.randn(8, 16).astype(np.float32),
+            "moe_y": rng.randn(4, 2, 16).astype(np.float32)}
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    sc = scope_mod.global_scope()
+    init = {n: np.asarray(sc.get(n)).copy() for n in sc.local_var_names()
+            if sc.get(n) is not None and not n.startswith("__")}
+    single = [float(np.asarray(exe.run(fluid.default_main_program(),
+                                       feed=feed, fetch_list=[loss])[0]
+                               ).ravel()[0]) for _ in range(3)]
+    for n, v in init.items():
+        sc.set(n, v.copy())
+    sc.set("__step_counter__", 0)
+    compiled = fluid.CompiledProgram(
+        fluid.default_main_program()).with_data_parallel(
+            loss_name=loss.name)
+    multi = [float(np.asarray(exe.run(compiled, feed=feed,
+                                      fetch_list=[loss])[0]).ravel()[0])
+             for _ in range(3)]
+    np.testing.assert_allclose(multi, single, rtol=2e-4, atol=1e-5)
+    step = next(iter(compiled._compiled_steps.values()))
+    assert step._plan.summary().get("moe_blk_w1") == (None, None, None)
