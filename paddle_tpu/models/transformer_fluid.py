@@ -43,24 +43,33 @@ def build(vocab_size=32000, d_model=512, n_heads=8, n_layers=6, d_ff=2048,
     tokens = layers.data(name="tokens", shape=[seq_len], dtype="int32")
     labels = layers.data(name="labels", shape=[seq_len], dtype="int32")
 
-    h = layers.embedding(tokens, size=[vocab_size, d_model], dtype=dtype)
+    h = layers.embedding(tokens, size=[vocab_size, d_model], dtype=dtype,
+                         param_attr=ParamAttr(shard_spec=("tp", None)))
     h = layers.scale(h, scale=float(d_model) ** 0.5)
     h = layers.add_position_encoding(h, alpha=1.0, beta=1.0)
 
+    # Megatron tensor-parallel plan as explicit annotations (inert on a
+    # dp-only mesh — the planner drops axes the mesh lacks): qkv/fc1
+    # column-split, proj/fc2 row-split; GSPMD inserts the two psums per
+    # layer when CompiledProgram runs with tensor_parallel_degree > 1
     def encoder_layer(x):
         a = layers.layer_norm(x, begin_norm_axis=2)
-        qkv = layers.fc(a, 3 * d_model, num_flatten_dims=2)
+        qkv = layers.fc(a, 3 * d_model, num_flatten_dims=2,
+                        param_attr=ParamAttr(shard_spec=(None, "tp")))
         q, k, v = layers.split(qkv, num_or_sections=3, dim=-1)
         attn = nets.scaled_dot_product_attention(
             q, k, v, num_heads=n_heads, dropout_rate=dropout_rate,
             causal=True)
-        proj = layers.fc(attn, d_model, num_flatten_dims=2)
+        proj = layers.fc(attn, d_model, num_flatten_dims=2,
+                         param_attr=ParamAttr(shard_spec=("tp", None)))
         if dropout_rate:
             proj = layers.dropout(proj, dropout_prob=dropout_rate)
         x = layers.elementwise_add(x, proj)
         b = layers.layer_norm(x, begin_norm_axis=2)
-        f = layers.fc(b, d_ff, num_flatten_dims=2, act="gelu")
-        f = layers.fc(f, d_model, num_flatten_dims=2)
+        f = layers.fc(b, d_ff, num_flatten_dims=2, act="gelu",
+                      param_attr=ParamAttr(shard_spec=(None, "tp")))
+        f = layers.fc(f, d_model, num_flatten_dims=2,
+                      param_attr=ParamAttr(shard_spec=("tp", None)))
         if dropout_rate:
             f = layers.dropout(f, dropout_prob=dropout_rate)
         return layers.elementwise_add(x, f)
@@ -99,7 +108,8 @@ def _chunked_lm_head(h, labels, vocab_size, seq_len):
     def lm_head_sum(x, y):
         logits = layers.fc(x, vocab_size, num_flatten_dims=2,
                            bias_attr=False,
-                           param_attr=ParamAttr(name="lm_head_w"))
+                           param_attr=ParamAttr(name="lm_head_w",
+                                                shard_spec=(None, "tp")))
         y3 = layers.reshape(y, shape=[0, 0, 1])
         ce = layers.softmax_with_cross_entropy(logits, y3)
         return layers.reduce_sum(ce)

@@ -176,3 +176,22 @@ def test_first_order_param_grad_map_not_clobbered():
     fluid.gradients(loss, x)
     after = dict(fluid.default_main_program().param_grad_map)
     assert before == after
+
+
+def test_triple_grad_closed_form():
+    """Third order composes from the same generic machinery: y = sum(x^4);
+    g = 4x^3; gg = d sum(g^2)/dx = 96 x^5 ... chain each pass explicitly:
+    g1 = dy/dx = 4x^3, g2 = d sum(g1)/dx = 12x^2, g3 = d sum(g2)/dx = 24x."""
+    x = layers.data(name="t3_x", shape=[3], dtype="float32",
+                    append_batch_size=False)
+    x.stop_gradient = False
+    y = layers.reduce_sum(layers.square(layers.square(x)))  # sum(x^4)
+    (g1,) = fluid.gradients(y, x)                            # 4x^3
+    (g2,) = fluid.gradients(layers.reduce_sum(g1), x)        # 12x^2
+    (g3,) = fluid.gradients(layers.reduce_sum(g2), x)        # 24x
+    assert len({g1.name, g2.name, g3.name}) == 3
+    xv = np.array([1.0, -2.0, 0.5], np.float32)
+    v1, v2, v3 = _run([g1, g2, g3], {"t3_x": xv})
+    np.testing.assert_allclose(v1, 4 * xv ** 3, rtol=1e-5)
+    np.testing.assert_allclose(v2, 12 * xv ** 2, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(v3, 24 * xv, rtol=1e-4, atol=1e-4)
