@@ -120,3 +120,28 @@ def test_pp4_microbatch_count_exceeds_pp():
     base = run((1, 1, 1))
     got = run((1, 2, 1), num_microbatches=4)
     np.testing.assert_allclose(got, base, rtol=2e-3)
+
+
+def test_pp_parity_untied_embeddings():
+    """Untied lm_head exercises the head-grad path that does NOT merge
+    with the stage-0 embedding gradient via the pp psum."""
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                            d_ff=64, max_seq_len=16, n_experts=0,
+                            remat=False, dtype=jnp.float32,
+                            tie_embeddings=False)
+    rng = np.random.RandomState(3)
+    toks = rng.randint(0, 64, size=(8, 16)).astype(np.int32)
+    labs = np.roll(toks, -1, axis=1).astype(np.int32)
+
+    def run(shape, **kw):
+        tr = SPMDTrainer(cfg, mesh_shape=shape, learning_rate=1e-2, **kw)
+        state = tr.init(0)
+        out = []
+        for _ in range(3):
+            state, loss = tr.step(state, toks, labs)
+            out.append(float(loss))
+        return out
+
+    base = run((1, 1, 1))
+    got = run((2, 2, 1), num_microbatches=2)
+    np.testing.assert_allclose(got, base, rtol=2e-3)
