@@ -195,3 +195,43 @@ def test_triple_grad_closed_form():
     np.testing.assert_allclose(v1, 4 * xv ** 3, rtol=1e-5)
     np.testing.assert_allclose(v2, 12 * xv ** 2, rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(v3, 24 * xv, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("case", [
+    ("sigmoid", lambda a: layers.sigmoid(a), lambda a: jax.nn.sigmoid(a)),
+    ("exp", lambda a: layers.exp(a), lambda a: jnp.exp(a)),
+    ("log", lambda a: layers.log(layers.scale(a, scale=1.0, bias=3.0)),
+     lambda a: jnp.log(a + 3.0)),
+    ("sqrt", lambda a: layers.sqrt(layers.scale(a, scale=1.0, bias=3.0)),
+     lambda a: jnp.sqrt(a + 3.0)),
+    ("softmax", lambda a: layers.softmax(a), lambda a: jax.nn.softmax(a)),
+    ("layer_norm", lambda a: layers.layer_norm(a, begin_norm_axis=1),
+     lambda a: (a - a.mean(-1, keepdims=True))
+     / jnp.sqrt(a.var(-1, keepdims=True) + 1e-5)),
+    ("reduce_mean", lambda a: layers.reduce_mean(layers.square(a), dim=1,
+                                                 keep_dim=True),
+     lambda a: jnp.mean(a ** 2, axis=1, keepdims=True)),
+], ids=lambda c: c[0])
+def test_double_grad_sweep_more_ops(case):
+    """Second-order sweep across activation / normalization / reduction
+    families vs jax.grad(jax.grad) — the breadth version of the
+    elementwise/matmul/conv checks above."""
+    _, fluid_fn, jax_fn = case
+    rng = np.random.RandomState(5)
+    av = rng.rand(4, 6).astype(np.float32) * 0.8 + 0.1
+
+    a = layers.data(name="sw_a", shape=[4, 6], dtype="float32",
+                    append_batch_size=False)
+    a.stop_gradient = False
+    y = layers.reduce_sum(fluid_fn(a))
+    (ga,) = fluid.gradients(y, a)
+    z = layers.reduce_sum(layers.square(ga))
+    (gga,) = fluid.gradients(z, a)
+
+    def jax_z(aa):
+        g = jax.grad(lambda q: jnp.sum(jax_fn(q)))(aa)
+        return jnp.sum(g ** 2)
+
+    want = jax.grad(jax_z)(av)
+    got = _run([gga], {"sw_a": av})[0]
+    np.testing.assert_allclose(got, want, rtol=5e-3, atol=1e-5)
