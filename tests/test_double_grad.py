@@ -235,3 +235,48 @@ def test_double_grad_sweep_more_ops(case):
     want = jax.grad(jax_z)(av)
     got = _run([gga], {"sw_a": av})[0]
     np.testing.assert_allclose(got, want, rtol=5e-3, atol=1e-5)
+
+
+def test_double_grad_through_spmd_mesh():
+    """The gradient-penalty program (double grad inside the block) must
+    also run through CompiledProgram's SPMD lowering with parity vs the
+    single-device executor."""
+    from paddle_tpu.core import scope as scope_mod
+
+    rng = np.random.RandomState(9)
+    xv = rng.randn(16, 4).astype(np.float32)
+
+    x = layers.data(name="sg_x", shape=[16, 4], dtype="float32",
+                    append_batch_size=False)
+    x.stop_gradient = False
+    h = layers.fc(x, size=8, act="tanh",
+                  param_attr=fluid.ParamAttr(name="sg_w1"))
+    out = layers.fc(h, size=1, param_attr=fluid.ParamAttr(name="sg_w2"))
+    (gx,) = fluid.gradients(layers.reduce_sum(out), x)
+    penalty = layers.reduce_mean(
+        layers.square(layers.reduce_sum(layers.square(gx), dim=1) - 1.0))
+    loss = layers.reduce_mean(layers.square(out)) + 0.1 * penalty
+    fluid.optimizer.SGD(0.05).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    sc = scope_mod.global_scope()
+    init = {n: np.asarray(sc.get(n)).copy() for n in sc.local_var_names()
+            if sc.get(n) is not None and not n.startswith("__")}
+    single = []
+    for _ in range(4):
+        (lv,) = exe.run(fluid.default_main_program(), feed={"sg_x": xv},
+                        fetch_list=[loss])
+        single.append(float(np.asarray(lv).ravel()[0]))
+    for n, v in init.items():
+        sc.set(n, v.copy())
+    sc.set("__step_counter__", 0)
+
+    compiled = fluid.CompiledProgram(
+        fluid.default_main_program()).with_data_parallel(
+            loss_name=loss.name)
+    multi = []
+    for _ in range(4):
+        (lv,) = exe.run(compiled, feed={"sg_x": xv}, fetch_list=[loss])
+        multi.append(float(np.asarray(lv).ravel()[0]))
+    np.testing.assert_allclose(multi, single, rtol=1e-4, atol=1e-6)
