@@ -29,6 +29,7 @@ from .framework import (
     default_startup_program,
     program_guard,
     name_scope,
+    pipeline_stage,
     in_dygraph_mode,
     CPUPlace,
     TPUPlace,

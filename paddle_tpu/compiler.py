@@ -69,6 +69,91 @@ class BuildStrategy:
         #     overrides, e.g. {"fc_w": (None, "tp")}
         self.tensor_parallel_degree = 1
         self.sharding_specs = {}
+        #   pipeline_stages — pp axis size; the forward section is split
+        #     into stages (auto FLOP-balanced, or `fluid.pipeline_stage(i)`
+        #     annotations) and trained with a 1F1B microbatch schedule
+        #     (parallel/pipeline_program.py)
+        #   pipeline_microbatches — microbatches per step (default: pp)
+        self.pipeline_stages = 1
+        self.pipeline_microbatches = None
+
+
+def classify_persistable_state(block, fetch_names):
+    """(mut_names, const_names, state_out): the persistable vars a lowered
+    step reads — split into donated read/write vs read-only — and writes.
+    Shared by _DataParallelStep and parallel.pipeline_program so the
+    scope/caching contract cannot drift between the two."""
+    produced = set()
+    state_in = []
+    state_out = set()
+    for op in block.ops:
+        for name in op.input_names():
+            v = block._find_var_recursive(name)
+            if v is not None and v.persistable and name not in produced \
+                    and name not in state_in:
+                state_in.append(name)
+        for name in op.output_names():
+            produced.add(name)
+            v = block._find_var_recursive(name)
+            if v is not None and v.persistable:
+                state_out.add(name)
+    for name in fetch_names:
+        v = block._find_var_recursive(name)
+        if v is not None and v.persistable and name not in produced \
+                and name not in state_in:
+            state_in.append(name)
+    mut = [n for n in state_in if n in state_out]
+    const = [n for n in state_in if n not in state_out]
+    return mut, const, sorted(state_out)
+
+
+def read_persistable_state(scope, mut_names, const_names):
+    """(mut, const) value dicts for a step's persistable inputs, with the
+    standard not-initialized error. Shared by _DataParallelStep and
+    parallel.pipeline_program."""
+    mut, const = {}, {}
+    for names, store in ((mut_names, mut), (const_names, const)):
+        for name in names:
+            val = scope.get(name)
+            if val is None:
+                raise RuntimeError(
+                    "persistable var %r is not initialized — run the "
+                    "startup program first" % name)
+            store[name] = val
+    return mut, const
+
+
+def normalize_feed_value(block, name, arr):
+    """Feed normalization shared by the data-parallel and pipeline steps:
+    device-resident jax.Arrays pass through without a host round-trip
+    (PyReader double-buffer / user device_put); host values become numpy
+    cast to the var's declared dtype."""
+    v = block._find_var_recursive(name)
+    if not isinstance(arr, jax.Array):
+        arr = np.asarray(arr)
+    if v is not None and v.shape is not None:
+        want = dtype_to_np(v.dtype)
+        if arr.dtype != want:
+            arr = arr.astype(want)
+    return arr
+
+
+def grad_seed_scale_of(build_strategy, n_replicas):
+    """GradientScaleStrategy -> backward seed factor (shared contract:
+    CoeffNumDevice = exact global-mean gradients, One = gradients summed
+    over per-replica means, Customized = rejected loudly)."""
+    gss = getattr(build_strategy, "gradient_scale_strategy",
+                  BuildStrategy.GradientScaleStrategy.CoeffNumDevice)
+    if gss == BuildStrategy.GradientScaleStrategy.Customized:
+        raise NotImplementedError(
+            "GradientScaleStrategy.Customized is not supported: the "
+            "TPU lowering computes exact global-batch gradients in one "
+            "program, so there is no per-device seed var to customize. "
+            "Scale the loss in the program instead (CoeffNumDevice = "
+            "exact mean semantics, One = gradients scaled by "
+            "num-devices).")
+    return (float(n_replicas)
+            if gss == BuildStrategy.GradientScaleStrategy.One else 1.0)
 
 
 class CompiledProgram:
@@ -104,7 +189,18 @@ class CompiledProgram:
             devs = np.array(jax.devices())
             tp = int(getattr(self._build_strategy,
                              "tensor_parallel_degree", 1) or 1)
-            if tp > 1:
+            pp = int(getattr(self._build_strategy,
+                             "pipeline_stages", 1) or 1)
+            if pp > 1:
+                if len(devs) % (pp * tp):
+                    raise ValueError(
+                        "pipeline_stages*tensor_parallel_degree = %d*%d "
+                        "does not divide the %d-device mesh"
+                        % (pp, tp, len(devs)))
+                self._mesh = Mesh(
+                    devs.reshape(len(devs) // (pp * tp), pp, tp),
+                    axis_names=("dp", "pp", "tp"))
+            elif tp > 1:
                 if len(devs) % tp:
                     raise ValueError(
                         "tensor_parallel_degree=%d does not divide the "
@@ -135,9 +231,19 @@ class CompiledProgram:
                tuple(fetch_names), bool(flag("check_nan_inf")))
         step = self._compiled_steps.get(key)
         if step is None:
-            step = _DataParallelStep(self._program, feed.keys(), fetch_names,
-                                     self._get_mesh(),
-                                     self._build_strategy)
+            pp = int(getattr(self._build_strategy,
+                             "pipeline_stages", 1) or 1)
+            if pp > 1:
+                from .parallel.pipeline_program import PipelineProgramStep
+
+                step = PipelineProgramStep(
+                    self._program, feed.keys(), fetch_names,
+                    self._get_mesh(), self._build_strategy,
+                    self._loss_name)
+            else:
+                step = _DataParallelStep(self._program, feed.keys(),
+                                         fetch_names, self._get_mesh(),
+                                         self._build_strategy)
             self._compiled_steps[key] = step
         fetches = step.run(scope, feed)
         if return_numpy:
@@ -162,29 +268,8 @@ class _DataParallelStep:
         self.mesh = mesh
         block = program.global_block()
         self.block = block
-
-        produced = set()
-        state_in = []
-        state_out = set()
-        for op in block.ops:
-            for name in op.input_names():
-                v = block._find_var_recursive(name)
-                if v is not None and v.persistable and name not in produced \
-                        and name not in state_in:
-                    state_in.append(name)
-            for name in op.output_names():
-                produced.add(name)
-                v = block._find_var_recursive(name)
-                if v is not None and v.persistable:
-                    state_out.add(name)
-        for name in self.fetch_names:
-            v = block._find_var_recursive(name)
-            if v is not None and v.persistable and name not in produced \
-                    and name not in state_in:
-                state_in.append(name)
-        self.state_out = sorted(state_out)
-        self.mut_names = [n for n in state_in if n in state_out]
-        self.const_names = [n for n in state_in if n not in state_out]
+        self.mut_names, self.const_names, self.state_out = \
+            classify_persistable_state(block, self.fetch_names)
         self._seed = program.random_seed or 0
 
         repl = NamedSharding(mesh, P())
@@ -196,22 +281,10 @@ class _DataParallelStep:
         zero_mode = (getattr(bs, "reduce_strategy",
                              BuildStrategy.ReduceStrategy.AllReduce)
                      == BuildStrategy.ReduceStrategy.Reduce)
-        gss = getattr(bs, "gradient_scale_strategy",
-                      BuildStrategy.GradientScaleStrategy.CoeffNumDevice)
-        if gss == BuildStrategy.GradientScaleStrategy.Customized:
-            raise NotImplementedError(
-                "GradientScaleStrategy.Customized is not supported: the "
-                "TPU lowering computes exact global-batch gradients in one "
-                "program, so there is no per-device seed var to customize. "
-                "Scale the loss in the program instead (CoeffNumDevice = "
-                "exact mean semantics, One = gradients scaled by "
-                "num-devices).")
         # `One` sums per-REPLICA mean gradients: replicas = dp size only
         # (tp shards computation, it does not add replicas)
-        n_repl = int(dict(mesh.shape).get("dp", 1))
-        self._grad_seed_scale = (
-            float(n_repl)
-            if gss == BuildStrategy.GradientScaleStrategy.One else 1.0)
+        self._grad_seed_scale = grad_seed_scale_of(
+            bs, int(dict(mesh.shape).get("dp", 1)))
 
         from .parallel.planner import plan_program
 
@@ -284,29 +357,12 @@ class _DataParallelStep:
         )
 
     def run(self, scope, feed):
-        mut = {}
-        const = {}
-        for names, store in ((self.mut_names, mut), (self.const_names, const)):
-            for name in names:
-                val = scope.get(name)
-                if val is None:
-                    raise RuntimeError(
-                        "persistable var %r is not initialized — run the "
-                        "startup program first" % name)
-                store[name] = val
+        mut, const = read_persistable_state(scope, self.mut_names,
+                                            self.const_names)
         dp = int(dict(self.mesh.shape).get("dp", 1))
         feeds = {}
         for name in self.feed_names:
-            v = self.block._find_var_recursive(name)
-            arr = feed[name]
-            # device-resident feeds pass through without a host round-trip
-            # (PyReader double-buffer / user device_put)
-            if not isinstance(arr, jax.Array):
-                arr = np.asarray(arr)
-            if v is not None and v.shape is not None:
-                want = dtype_to_np(v.dtype)
-                if arr.dtype != want:
-                    arr = arr.astype(want)
+            arr = normalize_feed_value(self.block, name, feed[name])
             if not self._multiprocess:
                 sh = (self._batch if arr.ndim and arr.shape[0] % dp == 0
                       else self._repl)

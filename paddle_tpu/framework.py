@@ -379,6 +379,9 @@ class Block:
 
     def append_op(self, type, inputs=None, outputs=None, attrs=None):
         op = Operator(self, type, inputs, outputs, attrs)
+        if _current_pipeline_stage[0] is not None \
+                and "__pipeline_stage__" not in op.attrs:
+            op.attrs["__pipeline_stage__"] = _current_pipeline_stage[0]
         self.ops.append(op)
         for vs in op.outputs.values():
             for v in vs:
@@ -679,6 +682,29 @@ def program_guard(main_program, startup_program=None):
         switch_main_program(prev_main)
         if prev_startup is not None:
             switch_startup_program(prev_startup)
+
+
+_current_pipeline_stage = [None]
+
+
+@contextlib.contextmanager
+def pipeline_stage(idx):
+    """Annotate ops built in this scope with pipeline stage `idx` (used by
+    BuildStrategy.pipeline_stages — parallel/pipeline_program.py). The
+    TPU-native analogue of the reference's later device_guard/section
+    pipeline placement: stages must be non-decreasing in program order.
+
+        with fluid.pipeline_stage(0):
+            h = embed_and_first_layers(tokens)
+        with fluid.pipeline_stage(1):
+            loss = rest_of_model(h, labels)
+    """
+    prev = _current_pipeline_stage[0]
+    _current_pipeline_stage[0] = int(idx)
+    try:
+        yield
+    finally:
+        _current_pipeline_stage[0] = prev
 
 
 _name_scope_stack = []

@@ -1,0 +1,754 @@
+"""Any-program pipeline parallelism through the descriptor path.
+
+The reference's defining multi-device contract is "rewrite ANY user program
+for N devices" (framework/ir/multi_devices_graph_pass/
+multi_devices_graph_pass.cc:165) — but its builder only does data
+parallelism. Pipeline parallelism is a new-design axis (SURVEY §5.7);
+round 3 delivered it only inside the hand-written SPMD trainer
+(parallel/transformer.py). This module brings the SAME 1F1B schedule to an
+arbitrary Fluid program built from `fluid.layers`:
+
+    strategy = BuildStrategy()
+    strategy.pipeline_stages = 4            # pp axis size
+    strategy.pipeline_microbatches = 8      # defaults to pp
+    CompiledProgram(prog).with_data_parallel(loss_name=..., build_strategy=strategy)
+
+Design (TPU-native, no graph rewrite):
+ - The program's op list is [forward | backward | optimizer]; the forward
+   section is split into `pp` contiguous stages, either by explicit
+   `with fluid.pipeline_stage(i):` annotation or by a balanced-FLOP
+   auto-split. Backward ops are NOT executed — each stage's gradients come
+   from `jax.vjp` of its lowered forward (the same kernels the grad ops
+   would re-run, so results are identical); optimizer/clip/regularizer ops
+   then run unchanged on the accumulated grads.
+ - One `shard_map` over the ("dp", "pp", "tp") step mesh, MANUAL over dp/pp
+   and GSPMD-auto over tp: the 1F1B ring schedule (ppermute neighbor
+   exchange, O(pp) input stash, fwd fill while bwd drains) is hand-written
+   over the manual axes, while the planner's Megatron tp shardings keep
+   working untouched inside every stage body.
+ - Stage bodies become branches of one `lax.switch` on the pp rank index —
+   SPMD requires every rank to run the same traced program; the switch
+   executes only the resident stage's ops at run time.
+ - Activations cross stage cuts as packed wire buffers (one fp32 buffer +
+   one int32 buffer, padded to the widest cut) so heterogeneous cut
+   signatures ride a single fixed-shape ppermute ring. Packing is
+   reshape/cast/concat — exact for bf16/fp16/fp32 payloads and transparent
+   to reverse-mode AD.
+
+Semantics: microbatching requires the loss to be a MEAN over batch
+elements (the usual Fluid `mean(cross_entropy)` shape); gradients then
+equal the full-batch gradient exactly, which the parity test asserts
+against the single-device executor. Ops with cross-batch state (batch_norm
+running stats) are rejected with a clear error — use layer_norm or run BN
+under dp-only parallelism.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.lowering import LoweringContext, execute_op
+from ..framework import dtype_to_np
+
+__all__ = ["PipelineProgramStep", "split_sections", "assign_stages"]
+
+
+# ---------------------------------------------------------------------------
+# program analysis
+# ---------------------------------------------------------------------------
+
+
+def _is_backward_op(op):
+    return "__fwd_op__" in op.attrs or op.attrs.get("__op_role__") == "backward"
+
+
+def split_sections(block):
+    """(fwd_ops, post_ops): forward ops before the first backward op, and
+    the non-backward tail (optimizer / clip / regularizer / lr ops)."""
+    ops = block.ops
+    bwd = next((i for i, op in enumerate(ops) if _is_backward_op(op)), None)
+    if bwd is None:
+        return list(ops), []
+    return list(ops[:bwd]), [op for op in ops[bwd:] if not _is_backward_op(op)]
+
+
+def _numel(shape):
+    n = 1
+    for d in shape or ():
+        if d is not None and d > 0:
+            n *= d
+    return n
+
+
+def _op_cost(op):
+    """Relative FLOP estimate for stage balancing. Static shapes with the
+    batch dim as -1 are fine — only the ratio between ops matters."""
+    sub_cost = 0.0
+    for key in ("sub_block", "true_block", "false_block"):
+        sub = op.attrs.get(key) if op.attrs else None
+        if sub is not None and getattr(sub, "ops", None) is not None:
+            sub_cost += sum(_op_cost(o) for o in sub.ops)
+    out_n = sum(_numel(v.shape) for vs in op.outputs.values() for v in vs
+                if v.shape is not None)
+    t = op.type
+    if t in ("mul", "matmul"):
+        ys = op.inputs.get("Y", [])
+        k = 1
+        if ys and ys[0].shape and len(ys[0].shape) >= 2:
+            k = max(1, ys[0].shape[-2] or 1)
+        return sub_cost + 2.0 * out_n * k
+    if t in ("conv2d", "depthwise_conv2d", "conv3d"):
+        fs = op.inputs.get("Filter", [])
+        k = _numel(fs[0].shape[1:]) if fs and fs[0].shape else 1
+        return sub_cost + 2.0 * out_n * k
+    if t == "flash_attention":
+        qs = op.inputs.get("Q", [])
+        seq = 1
+        if qs and qs[0].shape and len(qs[0].shape) >= 2:
+            seq = max(1, qs[0].shape[1] or 1)
+        return sub_cost + 4.0 * out_n * seq
+    return sub_cost + float(out_n)
+
+
+def assign_stages(fwd_ops, pp):
+    """Stage id per forward op: honor `__pipeline_stage__` stamps from
+    `fluid.pipeline_stage(i)` when present (unstamped ops inherit the
+    previous stamp), else balanced cumulative-cost auto-split into pp
+    contiguous chunks."""
+    stamped = [op.attrs.get("__pipeline_stage__") for op in fwd_ops]
+    if any(s is not None for s in stamped):
+        stages, cur = [], 0
+        for i, s in enumerate(stamped):
+            if s is not None:
+                s = int(s)
+                if s < cur:
+                    raise ValueError(
+                        "pipeline_stage annotations must be non-decreasing "
+                        "in program order: op #%d (%s) is stage %d after "
+                        "stage %d" % (i, fwd_ops[i].type, s, cur))
+                cur = s
+            if cur >= pp:
+                raise ValueError(
+                    "pipeline_stage %d out of range for pipeline_stages=%d"
+                    % (cur, pp))
+            stages.append(cur)
+        return stages
+    costs = [_op_cost(op) for op in fwd_ops]
+    total = sum(costs) or 1.0
+    stages, acc, cur = [], 0.0, 0
+    for c in costs:
+        # cut when the op's midpoint crosses the next boundary
+        while cur < pp - 1 and acc + c / 2.0 > (cur + 1) * total / pp:
+            cur += 1
+        stages.append(cur)
+        acc += c
+    return stages
+
+
+# ---------------------------------------------------------------------------
+# wire packing: heterogeneous cut signatures over one fixed-shape ring
+# ---------------------------------------------------------------------------
+
+
+class _CutLayout:
+    """Ordered (name, shape, np dtype) entries for one stage cut, split
+    into float (fp32 wire, differentiable) and int (int32 wire) segments."""
+
+    def __init__(self, entries):
+        for n, _, d in entries:
+            # the wire is fp32/int32: exact for every dtype JAX produces
+            # with x64 disabled (the default); 64-bit payloads would be
+            # silently narrowed, so reject them instead
+            if np.dtype(d).itemsize > 4:
+                raise NotImplementedError(
+                    "activation %r crossing a pipeline stage cut has dtype "
+                    "%s; the stage wire is fp32/int32 and would narrow it "
+                    "(jax_enable_x64 programs are unsupported under "
+                    "pipeline_stages > 1)" % (n, d))
+        self.fent = [(n, s, d) for n, s, d in entries
+                     if np.issubdtype(d, np.inexact)]
+        self.ient = [(n, s, d) for n, s, d in entries
+                     if not np.issubdtype(d, np.inexact)]
+        self.nf = sum(_numel(s) for _, s, _ in self.fent)
+        self.ni = sum(_numel(s) for _, s, _ in self.ient)
+
+    def pack(self, env, nf_max, ni_max):
+        fparts = [env[n].astype(jnp.float32).reshape(-1)
+                  for n, _, _ in self.fent]
+        iparts = [env[n].astype(jnp.int32).reshape(-1)
+                  for n, _, _ in self.ient]
+        f = (jnp.concatenate(fparts) if fparts
+             else jnp.zeros((0,), jnp.float32))
+        i = (jnp.concatenate(iparts) if iparts
+             else jnp.zeros((0,), jnp.int32))
+        return (jnp.pad(f, (0, nf_max - f.shape[0])),
+                jnp.pad(i, (0, ni_max - i.shape[0])))
+
+    def unpack(self, env, f, i):
+        off = 0
+        for n, s, d in self.fent:
+            k = _numel(s)
+            env[n] = jax.lax.slice_in_dim(f, off, off + k).reshape(s) \
+                .astype(d)
+            off += k
+        off = 0
+        for n, s, d in self.ient:
+            k = _numel(s)
+            env[n] = jax.lax.slice_in_dim(i, off, off + k).reshape(s) \
+                .astype(d)
+            off += k
+
+
+# ---------------------------------------------------------------------------
+# the pipelined step
+# ---------------------------------------------------------------------------
+
+
+class PipelineProgramStep:
+    """One jitted dp×pp×tp step for an arbitrary Fluid training program.
+
+    Built lazily per feed signature by CompiledProgram (same caching
+    contract as _DataParallelStep)."""
+
+    def __init__(self, program, feed_names, fetch_names, mesh,
+                 build_strategy, loss_name):
+        from ..compiler import BuildStrategy
+
+        if loss_name is None:
+            raise ValueError(
+                "pipeline_stages > 1 needs with_data_parallel(loss_name=...) "
+                "so the 1F1B schedule knows which scalar to differentiate")
+        if any(d.process_index != jax.process_index()
+               for d in mesh.devices.flat):
+            raise NotImplementedError(
+                "descriptor-path pipeline parallelism currently targets a "
+                "single-process mesh (ICI); combine with jax.distributed "
+                "dp via fleet for multi-host")
+        from ..flags import flag as _flag
+
+        if bool(_flag("check_nan_inf")):
+            # per-op nan flags live inside the 1F1B scan's switch branches
+            # and cannot be packed out per-tick; refuse loudly rather than
+            # let a debugging user believe the checks are on
+            raise NotImplementedError(
+                "FLAGS_check_nan_inf is not supported under "
+                "pipeline_stages > 1 — reproduce on a dp/tp mesh (or "
+                "single device) to localize the NaN, then re-enable "
+                "pipelining")
+        self.program = program
+        self.feed_names = list(feed_names)
+        self.fetch_names = list(fetch_names)
+        self.mesh = mesh
+        self.loss_name = loss_name
+        block = program.global_block()
+        self.block = block
+        shape = dict(mesh.shape)
+        self.dp = int(shape.get("dp", 1))
+        self.pp = int(shape.get("pp", 1))
+        self.M = int(getattr(build_strategy, "pipeline_microbatches", None)
+                     or self.pp)
+        if self.M < self.pp:
+            raise ValueError(
+                "pipeline_microbatches (%d) must be >= pipeline_stages (%d)"
+                % (self.M, self.pp))
+        self._seed = program.random_seed or 0
+
+        self.fwd_ops, self.post_ops = split_sections(block)
+        if not any(_is_backward_op(op) for op in block.ops):
+            raise ValueError(
+                "pipeline_stages > 1 needs a training program (append "
+                "backward via optimizer.minimize); for inference use "
+                "dp/tp sharding instead")
+        self.stage_of = assign_stages(self.fwd_ops, self.pp)
+
+        # ---- dataflow over the forward section -------------------------
+        feed_set = set(self.feed_names)
+        produced_at = {}
+        last_use = {}
+        for op, s in zip(self.fwd_ops, self.stage_of):
+            for name in op.input_names():
+                v = block._find_var_recursive(name)
+                if name in feed_set or v is None or v.persistable:
+                    continue
+                if name in produced_at:
+                    last_use[name] = max(last_use.get(name, s), s)
+            for name in op.output_names():
+                v = block._find_var_recursive(name)
+                if v is not None and v.persistable:
+                    raise ValueError(
+                        "forward op %r writes persistable var %r — ops with "
+                        "cross-batch state (batch_norm running stats) don't "
+                        "commute with pipeline microbatching; use "
+                        "layer_norm, or dp/tp parallelism for this model"
+                        % (op.type, name))
+                if name in feed_set:
+                    # stage branches re-read feeds fresh each microbatch, so
+                    # a later stage would silently see the pre-write value
+                    raise ValueError(
+                        "forward op %r writes feed var %r in place — "
+                        "pipeline stages read feeds immutably; copy the "
+                        "feed into a new var (e.g. layers.assign) first"
+                        % (op.type, name))
+                prev = produced_at.get(name)
+                if prev is not None and prev != s:
+                    # the cut-crossing sets track one producing stage per
+                    # var; a rewrite in a later stage would make every
+                    # earlier consumer read the wrong (not-yet-computed)
+                    # value, so reject it up front
+                    raise ValueError(
+                        "var %r is rewritten in place at stage %d after "
+                        "being produced at stage %d — in-place rewrites "
+                        "across pipeline stages are unsupported; adjust "
+                        "pipeline_stage annotations so all writes to a var "
+                        "land in one stage" % (name, s, prev))
+                produced_at[name] = s
+        self.produced_at = produced_at
+        # crossing[c]: produced at stage <= c, still consumed after cut c
+        self.crossing = []
+        for c in range(self.pp - 1):
+            names = sorted(
+                n for n in produced_at
+                if produced_at[n] <= c and last_use.get(n, -1) > c)
+            self.crossing.append(names)
+
+        # ---- parameters ------------------------------------------------
+        fwd_reads = set()
+        for op in self.fwd_ops:
+            fwd_reads.update(op.input_names())
+        pg = dict(getattr(program, "param_grad_map", {}) or {})
+        self.dparam_names = sorted(
+            p for p, g in pg.items()
+            if p in fwd_reads and block._find_var_recursive(g) is not None)
+        self.grad_of = {p: pg[p] for p in self.dparam_names}
+        self.cparam_names = sorted(
+            n for n in fwd_reads
+            if n not in self.grad_of and n not in feed_set
+            and (lambda v: v is not None and v.persistable)(
+                block._find_var_recursive(n)))
+
+        # ---- persistable state classification (jit signature) ----------
+        from ..compiler import classify_persistable_state
+
+        self.mut_names, self.const_names, self.state_out = \
+            classify_persistable_state(block, self.fetch_names)
+
+        # ---- scalar forward fetches (loss, metrics) --------------------
+        post_produced = set()
+        for op in self.post_ops:
+            post_produced.update(op.output_names())
+        self.post_produced = post_produced
+        scalar = []
+        for name in dict.fromkeys([self.loss_name] + self.fetch_names):
+            if name in produced_at:
+                v = block._find_var_recursive(name)
+                if v is not None and v.shape is not None \
+                        and _numel(v.shape) == 1 and -1 not in v.shape:
+                    scalar.append(name)
+                elif name in self.fetch_names:
+                    raise ValueError(
+                        "fetch %r is a non-scalar forward activation; under "
+                        "pipeline parallelism activations live per-"
+                        "microbatch per-stage. Fetch scalars (loss/metrics) "
+                        "or persistables instead" % name)
+        if self.loss_name not in scalar:
+            raise ValueError(
+                "loss %r must be a scalar produced by the forward section"
+                % self.loss_name)
+        self.scalar_names = scalar
+        self.loss_idx = scalar.index(self.loss_name)
+        self.loss_stage = produced_at[self.loss_name]
+        for name in self.fetch_names:
+            if name in scalar or name in post_produced:
+                continue
+            v = block._find_var_recursive(name)
+            if v is None or not v.persistable:
+                raise ValueError(
+                    "fetch %r is neither a scalar forward var, an optimizer "
+                    "output, nor a persistable — not fetchable under "
+                    "pipeline parallelism" % name)
+
+        # validate post-section reads are resolvable
+        grad_names = set(self.grad_of.values())
+        resolvable = (set(self.mut_names) | set(self.const_names)
+                      | set(self.state_out) | grad_names
+                      | set(scalar) | feed_set | post_produced)
+        for op in self.post_ops:
+            for name in op.input_names():
+                if name not in resolvable:
+                    raise ValueError(
+                        "optimizer-section op %r reads %r, which the "
+                        "pipelined step cannot provide (it is a non-scalar "
+                        "forward activation)" % (op.type, name))
+
+        # ---- sharding plan (tp over the auto axis, ZeRO over dp) -------
+        from ..parallel.planner import plan_program
+
+        from ..compiler import grad_seed_scale_of
+
+        zero_mode = (getattr(build_strategy, "reduce_strategy", 0)
+                     == BuildStrategy.ReduceStrategy.Reduce)
+        self._grad_seed_scale = grad_seed_scale_of(build_strategy, self.dp)
+        self._plan = plan_program(program, mesh,
+                                  build_strategy=build_strategy,
+                                  zero_sharding=zero_mode)
+        self._state_shardings = {
+            n: NamedSharding(mesh, self._plan.spec_of(n))
+            for n in set(self.mut_names) | set(self.const_names)
+            | set(self.state_out)}
+        # activation seams, stored as bare PartitionSpecs: inside the
+        # manual dp/pp region they must bind to the CONTEXT abstract mesh
+        # (Manual axis types) — a concrete-mesh NamedSharding there poisons
+        # downstream avals with a mismatched all-Auto mesh
+        self._tp_constraint_specs = dict(self._plan.constraints)
+        # Inside a lax.switch branch only the resident stage's ranks run, so
+        # GSPMD may NOT emit collective-permute / all-to-all there (pair
+        # style collectives rendezvous across every device and deadlock;
+        # group-style all-reduce / all-gather are per-group and safe).
+        # Slicing a tp-sharded dim (split/slice/concat boundaries) is what
+        # GSPMD lowers with collective-permute, so pin those ops' INPUTS
+        # tp-replicated on the last dim: the column-parallel producer then
+        # all-gathers (legal) and the split becomes shard-local; the next
+        # row-parallel matmul re-shards by a local slice (no comm).
+        tp = int(dict(mesh.shape).get("tp", 1))
+        if tp > 1:
+            def _pin(v):
+                if v is None or v.shape is None or not len(v.shape) \
+                        or v.persistable or getattr(v, "is_data", False) \
+                        or v.name in self._tp_constraint_specs:
+                    return
+                spec = P(*([P.UNCONSTRAINED] * (len(v.shape) - 1) + [None]))
+                self._tp_constraint_specs[v.name] = spec
+
+            def _row_sharded(name):
+                spec = tuple(self._plan.specs.get(name, P()))
+                if not spec:
+                    return False
+                d0 = spec[0]
+                axes = d0 if isinstance(d0, (tuple, list)) else (d0,)
+                return "tp" in axes
+
+            def _walk(ops):
+                for op in ops:
+                    for key in ("sub_block", "true_block", "false_block"):
+                        sub = op.attrs.get(key) if op.attrs else None
+                        if sub is not None and getattr(sub, "ops", None) \
+                                is not None:
+                            _walk(sub.ops)
+                    if op.type in ("split", "concat", "slice", "stack"):
+                        # slicing a tp-sharded dim lowers to permutes; pin
+                        # the input so the producer all-gathers instead
+                        for vs in op.inputs.values():
+                            for v in vs:
+                                _pin(v)
+                    elif op.type in ("mul", "matmul"):
+                        # a row-parallel matmul pulls tp-last sharding
+                        # backward through its X chain (reshapes, attention
+                        # heads), which Shardy lowers with permutes: pin the
+                        # X input replicated so the transition is a local
+                        # slice, and the partial-sum output to a psum
+                        ys = op.inputs.get("Y", [])
+                        if ys and getattr(ys[0], "persistable", False) \
+                                and _row_sharded(ys[0].name):
+                            for v in op.inputs.get("X", []):
+                                _pin(v)
+                            for vs in op.outputs.values():
+                                for v in vs:
+                                    _pin(v)
+
+            _walk(self.fwd_ops)
+        self._repl = NamedSharding(mesh, P())
+
+        mut_sh = {n: self._state_shardings[n] for n in self.mut_names}
+        const_sh = {n: self._state_shardings[n] for n in self.const_names}
+        self._jitted = jax.jit(
+            self._step,
+            donate_argnums=(0,),
+            in_shardings=(mut_sh, const_sh, None, None),
+        )
+
+    # ------------------------------------------------------------------
+    # trace-time construction
+    # ------------------------------------------------------------------
+    def _probe_layouts(self, dstructs, cstructs, feed_structs):
+        """Chain jax.eval_shape through the forward section on microbatch
+        shapes to size every cut's wire layout."""
+        want = sorted({n for names in self.crossing for n in names})
+        constraints = self._context_constraints()
+
+        def run(dp_, cp_, fd_):
+            env = {}
+            env.update(cp_)
+            env.update(dp_)
+            env.update(fd_)
+            ctx = LoweringContext(base_key=jax.random.PRNGKey(0),
+                                  mesh=self.mesh)
+            ctx.act_constraints = constraints
+            for op in self.fwd_ops:
+                execute_op(op, env, ctx)
+            return {n: env[n] for n in want}
+
+        shapes = jax.eval_shape(run, dstructs, cstructs, feed_structs)
+        layouts = []
+        for names in self.crossing:
+            layouts.append(_CutLayout([
+                (n, tuple(shapes[n].shape), np.dtype(shapes[n].dtype))
+                for n in names]))
+        return layouts
+
+    def _context_constraints(self):
+        """NamedShardings for the activation seams, bound to the CURRENT
+        abstract mesh (Manual over dp/pp inside the 1F1B region)."""
+        cmesh = jax.sharding.get_abstract_mesh()
+        if cmesh is None or cmesh.empty:
+            cmesh = self.mesh
+        return {n: NamedSharding(cmesh, spec)
+                for n, spec in self._tp_constraint_specs.items()}
+
+    def _make_branches(self, cparams, layouts, nf, ni, n_scal):
+        """One lax.switch branch per stage: unpack wire -> run the stage's
+        ops -> pack outgoing wire + scalar-fetch vector."""
+        constraints = self._context_constraints()
+        branches = []
+        for s in range(self.pp):
+            in_lay = layouts[s - 1] if s > 0 else None
+            out_lay = layouts[s] if s < self.pp - 1 else None
+            stage_ops = [op for op, st in zip(self.fwd_ops, self.stage_of)
+                         if st == s]
+            scal_here = [(k, n) for k, n in enumerate(self.scalar_names)
+                         if self.produced_at.get(n) == s]
+
+            def branch(operand, _in=in_lay, _out=out_lay, _ops=stage_ops,
+                       _scal=scal_here):
+                dp_, f_in, i_in, feeds_mb, mb_key = operand
+                env = dict(cparams)
+                env.update(dp_)
+                env.update(feeds_mb)
+                if _in is not None:
+                    _in.unpack(env, f_in, i_in)
+                ctx = LoweringContext(base_key=mb_key, mesh=self.mesh)
+                ctx.act_constraints = constraints
+                for op in _ops:
+                    execute_op(op, env, ctx)
+                if _out is not None:
+                    f_out, i_out = _out.pack(env, nf, ni)
+                else:
+                    f_out = jnp.zeros((nf,), jnp.float32)
+                    i_out = jnp.zeros((ni,), jnp.int32)
+                scal = jnp.zeros((n_scal,), jnp.float32)
+                for k, name in _scal:
+                    scal = scal.at[k].set(
+                        env[name].astype(jnp.float32).reshape(()))
+                return f_out, i_out, scal
+
+            branches.append(branch)
+        return branches
+
+    # ------------------------------------------------------------------
+    # the traced step
+    # ------------------------------------------------------------------
+    def _step(self, mut_state, const_state, feeds, step_counter):
+        state = {}
+        state.update(const_state)
+        state.update(mut_state)
+        dparams = {n: state[n] for n in self.dparam_names}
+        cparams = {n: state[n] for n in self.cparam_names}
+        base_key = jax.random.fold_in(
+            jax.random.PRNGKey(self._seed), step_counter)
+
+        dp, pp, M = self.dp, self.pp, self.M
+        # feed classification: data feeds shard over dp and microbatch;
+        # everything else is replicated into every stage body
+        # only declared data vars (layers.data) microbatch-split: slicing a
+        # replicated auxiliary feed (a table, a mask) would silently change
+        # semantics, unlike _DataParallelStep where feed sharding is just a
+        # GSPMD layout choice
+        batched, repl_feeds = {}, {}
+        for name, arr in feeds.items():
+            v = self.block._find_var_recursive(name)
+            if v is not None and bool(getattr(v, "is_data", False)):
+                if np.ndim(arr) < 1 or arr.shape[0] % (dp * M) != 0:
+                    raise ValueError(
+                        "feed %r batch %s must divide dp*microbatches = %d "
+                        "for pipeline parallelism"
+                        % (name, np.shape(arr), dp * M))
+                batched[name] = arr
+            else:
+                repl_feeds[name] = arr
+
+        grads, scal = shard_map(
+            self._pipeline_1f1b, mesh=self.mesh,
+            in_specs=(P(), P(), P("dp"), P(), P()),
+            out_specs=(P(), P()),
+            axis_names={"dp", "pp"}, check_vma=False)(
+                dparams, cparams, batched, repl_feeds, base_key)
+
+        # ---- optimizer section on accumulated grads (GSPMD region) -----
+        env = dict(state)
+        env.update(feeds)
+        for k, name in enumerate(self.scalar_names):
+            v = self.block._find_var_recursive(name)
+            val = scal[k]
+            if v is not None and v.shape is not None:
+                val = val.reshape(tuple(v.shape)).astype(dtype_to_np(v.dtype))
+            env[name] = val
+        for p, gname in self.grad_of.items():
+            gv = self.block._find_var_recursive(gname)
+            g = grads[p]
+            if gv is not None and gv.dtype is not None:
+                g = g.astype(dtype_to_np(gv.dtype))
+            env[gname] = g
+        ctx = LoweringContext(base_key=base_key, mesh=self.mesh)
+        for op in self.post_ops:
+            execute_op(op, env, ctx)
+
+        fetches = [jax.lax.with_sharding_constraint(env[n], self._repl)
+                   for n in self.fetch_names]
+        new_state = {
+            n: jax.lax.with_sharding_constraint(
+                env[n], self._state_shardings[n])
+            for n in self.state_out if n in env}
+        return fetches, new_state
+
+    def _pipeline_1f1b(self, dparams, cparams, batched, repl_feeds,
+                       base_key):
+        """The manual-region 1F1B schedule: runs per (dp, pp) rank with tp
+        left to GSPMD. Returns (psummed grads pytree, mean scalar vector)."""
+        dp, pp, M = self.dp, self.pp, self.M
+        my_pp = jax.lax.axis_index("pp")
+        my_dp = jax.lax.axis_index("dp")
+
+        micro = {}
+        for name, arr in batched.items():
+            mb = arr.shape[0] // M
+            micro[name] = arr.reshape((M, mb) + arr.shape[1:])
+
+        # wire layouts from microbatch-shaped abstract values
+        feed_structs = {
+            n: jax.ShapeDtypeStruct(a.shape[1:], a.dtype)
+            for n, a in micro.items()}
+        feed_structs.update({
+            n: jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype
+                                    if not hasattr(a, "dtype") else a.dtype)
+            for n, a in repl_feeds.items()})
+        dstructs = {n: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                    for n, v in dparams.items()}
+        cstructs = {n: jax.ShapeDtypeStruct(np.shape(v), v.dtype)
+                    for n, v in cparams.items()}
+        layouts = self._probe_layouts(dstructs, cstructs, feed_structs)
+        nf = max([l.nf for l in layouts] + [1])
+        ni = max([l.ni for l in layouts] + [1])
+        n_scal = max(len(self.scalar_names), 1)
+
+        branches = self._make_branches(cparams, layouts, nf, ni, n_scal)
+
+        def feeds_at(i):
+            d = {n: jax.lax.dynamic_index_in_dim(a, i, axis=0,
+                                                 keepdims=False)
+                 for n, a in micro.items()}
+            d.update(repl_feeds)
+            return d
+
+        def key_at(i):
+            return jax.random.fold_in(base_key, my_dp * M + i)
+
+        def stage_apply(dp_, f_in, i_in, i):
+            return jax.lax.switch(
+                my_pp, branches, (dp_, f_in, i_in, feeds_at(i), key_at(i)))
+
+        seed = self._grad_seed_scale / float(M * dp)
+        loss_onehot = jnp.zeros((n_scal,), jnp.float32).at[
+            self.loss_idx].set(1.0)
+        S_ring = 2 * pp
+        K = M + 2 * pp - 2
+
+        def tick(carry, t):
+            (fwd_f, fwd_i, bwd_f, stash_f, stash_i, gacc, sacc) = carry
+
+            # ---- forward unit: microbatch i_f = t - my_pp ----
+            i_f = t - my_pp
+            valid_f = (i_f >= 0) & (i_f < M)
+            i_fc = jnp.clip(i_f, 0, M - 1)
+            f_out, i_out, scal_f = stage_apply(dparams, fwd_f, fwd_i, i_fc)
+            slot = jnp.mod(i_fc, S_ring)
+            stash_f = jnp.where(
+                valid_f,
+                jax.lax.dynamic_update_index_in_dim(stash_f, fwd_f, slot,
+                                                    axis=0),
+                stash_f)
+            stash_i = jnp.where(
+                valid_f,
+                jax.lax.dynamic_update_index_in_dim(stash_i, fwd_i, slot,
+                                                    axis=0),
+                stash_i)
+            sacc = sacc + jnp.where(valid_f, scal_f, 0.0)
+
+            # ---- backward unit: microbatch i_b = t - (2pp-2-my_pp) ----
+            i_b = t - (2 * pp - 2 - my_pp)
+            valid_b = (i_b >= 0) & (i_b < M)
+            i_bc = jnp.clip(i_b, 0, M - 1)
+            bslot = jnp.mod(i_bc, S_ring)
+            f_in_b = jax.lax.dynamic_index_in_dim(stash_f, bslot, axis=0,
+                                                  keepdims=False)
+            i_in_b = jax.lax.dynamic_index_in_dim(stash_i, bslot, axis=0,
+                                                  keepdims=False)
+
+            def g(dp_, f_in):
+                f_o, _, scal = stage_apply(dp_, f_in, i_in_b, i_bc)
+                return f_o, scal
+
+            _, svjp = jax.vjp(g, dparams, f_in_b)
+            # cotangent routing: the loss stage seeds; earlier stages relay
+            # the ring cotangent; later stages (post-loss metrics) send 0
+            wire_cot = jnp.where(my_pp < self.loss_stage, 1.0, 0.0) * bwd_f
+            scal_cot = loss_onehot * jnp.where(
+                my_pp == self.loss_stage, jnp.float32(seed), 0.0)
+            gP, g_in = svjp((wire_cot, scal_cot))
+            gacc = jax.tree.map(
+                lambda a, d: a + jnp.where(valid_b, d, 0.0).astype(
+                    jnp.float32), gacc, gP)
+
+            # ---- ring exchange (unconditional, all ranks) ----
+            fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
+            bwd_perm = [(i, (i - 1) % pp) for i in range(pp)]
+            fwd_f2 = jax.lax.ppermute(f_out, "pp", fwd_perm)
+            fwd_i2 = jax.lax.ppermute(i_out, "pp", fwd_perm)
+            bwd_f2 = jax.lax.ppermute(g_in, "pp", bwd_perm)
+            return (fwd_f2, fwd_i2, bwd_f2, stash_f, stash_i, gacc,
+                    sacc), None
+
+        zf = jnp.zeros((nf,), jnp.float32)
+        zi = jnp.zeros((ni,), jnp.int32)
+        init = (zf, zi, zf,
+                jnp.zeros((S_ring, nf), jnp.float32),
+                jnp.zeros((S_ring, ni), jnp.int32),
+                jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                             dparams),
+                jnp.zeros((n_scal,), jnp.float32))
+        (_, _, _, _, _, gacc, sacc), _ = jax.lax.scan(
+            tick, init, jnp.arange(K))
+
+        grads = jax.tree.map(lambda g: jax.lax.psum(g, ("dp", "pp")), gacc)
+        # each scalar is owned by exactly one stage: pp-psum recovers its
+        # M-microbatch sum, the dp-psum sums replicas -> mean over both
+        scal = jax.lax.psum(sacc, ("dp", "pp")) / float(M * dp)
+        return grads, scal
+
+    # ------------------------------------------------------------------
+    # host-side driver (same contract as _DataParallelStep.run)
+    # ------------------------------------------------------------------
+    def run(self, scope, feed):
+        from ..compiler import normalize_feed_value, read_persistable_state
+
+        mut, const = read_persistable_state(scope, self.mut_names,
+                                            self.const_names)
+        feeds = {name: normalize_feed_value(self.block, name, feed[name])
+                 for name in self.feed_names}
+        ctr = np.uint32(scope.get("__step_counter__", 0) or 0)
+        fetches, new_state = self._jitted(mut, const, feeds, ctr)
+        for name, val in new_state.items():
+            scope.set(name, val)
+        scope.set("__step_counter__", int(ctr) + 1)
+        return fetches

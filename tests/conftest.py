@@ -7,12 +7,12 @@ does not win against the plugin, so we must also jax.config.update.
 """
 
 import os
+import sys
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from xla_env import stage_host_mesh_flags  # noqa: E402
+
+stage_host_mesh_flags(8)
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax

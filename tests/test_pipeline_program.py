@@ -1,0 +1,251 @@
+"""Any-program pipeline parallelism through the descriptor path
+(parallel/pipeline_program.py).
+
+The reference's multi-device builder rewrites any program for N devices but
+only for data parallelism (multi_devices_graph_pass.cc:165); pipeline
+parallelism is the framework's new-design axis. These tests assert the 1F1B
+descriptor lowering reproduces the single-device loss trajectory EXACTLY
+(same params, same feeds) for dp×pp, dp×pp×tp, and annotated-stage runs on
+the virtual 8-device CPU mesh.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core import scope as scope_mod
+
+
+def _mlp(prefix, width=32, depth=3):
+    x = layers.data(name=prefix + "_x", shape=[16], dtype="float32")
+    y = layers.data(name=prefix + "_y", shape=[1], dtype="float32")
+    h = x
+    for _ in range(depth):
+        h = layers.fc(h, width, act="relu")
+    pred = layers.fc(h, 1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    return loss, x, y
+
+
+def _feed(prefix, batch=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return {prefix + "_x": rng.randn(batch, 16).astype(np.float32),
+            prefix + "_y": rng.randn(batch, 1).astype(np.float32)}
+
+
+def _single_then_restore(loss, feed, steps=4):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    sc = scope_mod.global_scope()
+    init = {n: np.asarray(sc.get(n)).copy() for n in sc.local_var_names()
+            if sc.get(n) is not None and not n.startswith("__")}
+    out = []
+    for _ in range(steps):
+        (lv,) = exe.run(fluid.default_main_program(), feed=feed,
+                        fetch_list=[loss])
+        out.append(float(np.asarray(lv).reshape(-1)[0]))
+    for n, v in init.items():
+        sc.set(n, v.copy())
+    sc.set("__step_counter__", 0)
+    return out
+
+
+def _train(compiled, loss, feed, steps=4):
+    exe = fluid.Executor(fluid.CPUPlace())
+    out = []
+    for _ in range(steps):
+        (lv,) = exe.run(compiled, feed=feed, fetch_list=[loss])
+        out.append(float(np.asarray(lv).reshape(-1)[0]))
+    return out
+
+
+def test_pp_dp_loss_parity():
+    """dp=4 × pp=2, auto FLOP-balanced split: exact trajectory parity."""
+    loss, _, _ = _mlp("pp1")
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    feed = _feed("pp1")
+    single = _single_then_restore(loss, feed)
+
+    bs = fluid.BuildStrategy()
+    bs.pipeline_stages = 2
+    bs.pipeline_microbatches = 4
+    compiled = fluid.CompiledProgram(
+        fluid.default_main_program()).with_data_parallel(
+            loss_name=loss.name, build_strategy=bs)
+    multi = _train(compiled, loss, feed)
+    np.testing.assert_allclose(multi, single, rtol=1e-5, atol=1e-6)
+
+    step = next(iter(compiled._compiled_steps.values()))
+    assert step.pp == 2 and step.M == 4
+    assert sorted(set(step.stage_of)) == [0, 1]
+
+
+def test_pp_tp_zero_combo_parity():
+    """dp=2 × pp=2 × tp=2 with ZeRO-1 Reduce mode: parity + the planner
+    really shards optimizer state over dp and fc weights over tp."""
+    loss, _, _ = _mlp("pp2", width=32)
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    feed = _feed("pp2")
+    single = _single_then_restore(loss, feed)
+
+    bs = fluid.BuildStrategy()
+    bs.pipeline_stages = 2
+    bs.pipeline_microbatches = 2
+    bs.tensor_parallel_degree = 2
+    bs.reduce_strategy = fluid.BuildStrategy.ReduceStrategy.Reduce
+    compiled = fluid.CompiledProgram(
+        fluid.default_main_program()).with_data_parallel(
+            loss_name=loss.name, build_strategy=bs)
+    multi = _train(compiled, loss, feed)
+    np.testing.assert_allclose(multi, single, rtol=1e-4, atol=1e-5)
+
+    step = next(iter(compiled._compiled_steps.values()))
+    specs = step._plan.summary()
+    assert any("tp" in str(s) for s in specs.values()), specs
+    assert any("dp" in str(s) for n, s in specs.items()
+               if "moment" in n or "beta" in n.lower()), specs
+
+
+def test_pipeline_stage_annotation():
+    """Explicit `with fluid.pipeline_stage(i)` placement is honored."""
+    x = layers.data(name="an_x", shape=[16], dtype="float32")
+    y = layers.data(name="an_y", shape=[1], dtype="float32")
+    with fluid.pipeline_stage(0):
+        h = layers.fc(x, 32, act="relu")
+    with fluid.pipeline_stage(1):
+        h = layers.fc(h, 32, act="relu")
+    with fluid.pipeline_stage(2):
+        h = layers.fc(h, 32, act="relu")
+    with fluid.pipeline_stage(3):
+        pred = layers.fc(h, 1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    feed = {"an_x": np.random.RandomState(1).randn(16, 16).astype(np.float32),
+            "an_y": np.random.RandomState(2).randn(16, 1).astype(np.float32)}
+    single = _single_then_restore(loss, feed)
+
+    bs = fluid.BuildStrategy()
+    bs.pipeline_stages = 4
+    compiled = fluid.CompiledProgram(
+        fluid.default_main_program()).with_data_parallel(
+            loss_name=loss.name, build_strategy=bs)
+    multi = _train(compiled, loss, feed)
+    np.testing.assert_allclose(multi, single, rtol=1e-5, atol=1e-6)
+
+    step = next(iter(compiled._compiled_steps.values()))
+    # every annotated stage is populated and ordered
+    assert sorted(set(step.stage_of)) == [0, 1, 2, 3]
+
+
+def test_pp_transformer_tp_parity():
+    """A plain fluid.layers transformer (recompute + flash attention +
+    chunked vocab head) trains dp=2 × pp=2 × tp=2 with exact loss parity —
+    the VERDICT round-3 'done' criterion for any-program pipelining."""
+    from paddle_tpu.models import transformer_fluid
+
+    tokens, labels, loss = transformer_fluid.build(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        seq_len=16, remat=True)
+    fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    rng = np.random.RandomState(0)
+    feed = {"tokens": rng.randint(0, 64, size=(8, 16)).astype(np.int32),
+            "labels": rng.randint(0, 64, size=(8, 16)).astype(np.int32)}
+    single = _single_then_restore(loss, feed, steps=3)
+
+    bs = fluid.BuildStrategy()
+    bs.pipeline_stages = 2
+    bs.pipeline_microbatches = 2
+    bs.tensor_parallel_degree = 2
+    compiled = fluid.CompiledProgram(
+        fluid.default_main_program()).with_data_parallel(
+            loss_name=loss.name, build_strategy=bs)
+    multi = _train(compiled, loss, feed, steps=3)
+    np.testing.assert_allclose(multi, single, rtol=1e-4, atol=1e-5)
+
+    step = next(iter(compiled._compiled_steps.values()))
+    specs = step._plan.summary()
+    assert any("tp" in str(s) for s in specs.values())
+
+
+def test_pp_rejects_nonscalar_fetch_and_bn():
+    loss, x, _ = _mlp("rej")
+    hidden_name = None
+    for op in fluid.default_main_program().global_block().ops:
+        if op.type == "relu":
+            hidden_name = op.output_names()[0]
+            break
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    bs = fluid.BuildStrategy()
+    bs.pipeline_stages = 2
+    compiled = fluid.CompiledProgram(
+        fluid.default_main_program()).with_data_parallel(
+            loss_name=loss.name, build_strategy=bs)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    feed = _feed("rej")
+    with pytest.raises(ValueError, match="non-scalar forward"):
+        exe.run(compiled, feed=feed, fetch_list=[hidden_name])
+
+    # batch_norm's running-stat writes don't commute with microbatching
+    prog2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog2, startup2):
+        img = layers.data(name="bn_x", shape=[8], dtype="float32")
+        yb = layers.data(name="bn_y", shape=[1], dtype="float32")
+        h = layers.fc(img, 16)
+        h = layers.batch_norm(h)
+        pred = layers.fc(h, 1)
+        loss2 = layers.mean(layers.square_error_cost(pred, yb))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss2)
+    bs2 = fluid.BuildStrategy()
+    bs2.pipeline_stages = 2
+    c2 = fluid.CompiledProgram(prog2).with_data_parallel(
+        loss_name=loss2.name, build_strategy=bs2)
+    exe.run(startup2)
+    rng = np.random.RandomState(3)
+    with pytest.raises(ValueError, match="persistable"):
+        exe.run(c2, feed={"bn_x": rng.randn(8, 8).astype(np.float32),
+                          "bn_y": rng.randn(8, 1).astype(np.float32)},
+                fetch_list=[loss2])
+
+
+def test_pp_rejects_cross_stage_inplace_rewrite():
+    """An in-place write to a stage-0 var from stage 1 must fail with the
+    dedicated error, not an opaque trace-time KeyError."""
+    x = layers.data(name="ip_x", shape=[8], dtype="float32")
+    y = layers.data(name="ip_y", shape=[1], dtype="float32")
+    with fluid.pipeline_stage(0):
+        h = layers.fc(x, 16, act="relu")
+    with fluid.pipeline_stage(1):
+        layers.increment(h, in_place=True)
+        pred = layers.fc(h, 1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    bs = fluid.BuildStrategy()
+    bs.pipeline_stages = 2
+    compiled = fluid.CompiledProgram(
+        fluid.default_main_program()).with_data_parallel(
+            loss_name=loss.name, build_strategy=bs)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(5)
+    with pytest.raises(ValueError, match="in.place"):
+        exe.run(compiled,
+                feed={"ip_x": rng.randn(8, 8).astype(np.float32),
+                      "ip_y": rng.randn(8, 1).astype(np.float32)},
+                fetch_list=[loss])
+
+
+def test_pp_microbatch_validation():
+    loss, _, _ = _mlp("val")
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    bs = fluid.BuildStrategy()
+    bs.pipeline_stages = 2
+    bs.pipeline_microbatches = 1  # < pp
+    compiled = fluid.CompiledProgram(
+        fluid.default_main_program()).with_data_parallel(
+            loss_name=loss.name, build_strategy=bs)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    with pytest.raises(ValueError, match="pipeline_microbatches"):
+        exe.run(compiled, feed=_feed("val"), fetch_list=[loss])

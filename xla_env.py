@@ -1,0 +1,36 @@
+"""XLA_FLAGS staging for the virtual host-device mesh.
+
+Must run BEFORE the first jax import (XLA reads the env var once at
+backend init). Shared by tests/conftest.py and __graft_entry__.py so the
+flag set cannot drift between the test suite and the driver's dryrun.
+"""
+
+import os
+import re
+
+
+def stage_host_mesh_flags(n_devices=8):
+    """Ensure XLA_FLAGS requests `n_devices` virtual CPU devices and
+    relaxes the CPU collective rendezvous deadline.
+
+    The virtual devices share however few physical cores the box has;
+    XLA:CPU's default 20s-warn / 40s-abort rendezvous deadline then fires
+    spuriously under scheduling pressure (observed on a 1-core runner with
+    the 1F1B pipeline step's collective-dense scan). 180s bounds a REAL
+    deadlock to a visible abort-with-stack instead of letting the harness
+    timeout kill the run with no diagnostic.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m is None:
+        flags = (flags +
+                 " --xla_force_host_platform_device_count=%d" % n_devices)
+    elif int(m.group(1)) < n_devices:
+        flags = (flags[:m.start()] +
+                 "--xla_force_host_platform_device_count=%d" % n_devices +
+                 flags[m.end():])
+    if "collective_call_warn_stuck_timeout" not in flags:
+        flags += " --xla_cpu_collective_call_warn_stuck_timeout_seconds=60"
+    if "collective_call_terminate_timeout" not in flags:
+        flags += " --xla_cpu_collective_call_terminate_timeout_seconds=180"
+    os.environ["XLA_FLAGS"] = flags.strip()
