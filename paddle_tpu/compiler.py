@@ -76,6 +76,12 @@ class BuildStrategy:
         #   pipeline_microbatches — microbatches per step (default: pp)
         self.pipeline_stages = 1
         self.pipeline_microbatches = None
+        #   sequence_parallel_degree — sp axis size; self-attention runs as
+        #     ring attention over sp ranks (K/V ppermute rotation, O(T/sp)
+        #     per-chip memory) and the residual stream seq-shards by GSPMD
+        #     propagation from the attention seams (ops/compat_ops.py
+        #     flash_attention; SURVEY §5.7 long-context axis)
+        self.sequence_parallel_degree = 1
 
 
 def classify_persistable_state(block, fetch_names):
@@ -191,7 +197,25 @@ class CompiledProgram:
                              "tensor_parallel_degree", 1) or 1)
             pp = int(getattr(self._build_strategy,
                              "pipeline_stages", 1) or 1)
-            if pp > 1:
+            sp = int(getattr(self._build_strategy,
+                             "sequence_parallel_degree", 1) or 1)
+            if sp > 1 and pp > 1:
+                raise NotImplementedError(
+                    "sequence_parallel_degree and pipeline_stages cannot "
+                    "combine on the descriptor path yet: ring attention's "
+                    "ppermute cannot live inside a pipeline stage branch "
+                    "(pair-style collectives deadlock when only one "
+                    "stage's ranks execute them)")
+            if sp > 1:
+                if len(devs) % (sp * tp):
+                    raise ValueError(
+                        "sequence_parallel_degree*tensor_parallel_degree ="
+                        " %d*%d does not divide the %d-device mesh"
+                        % (sp, tp, len(devs)))
+                self._mesh = Mesh(
+                    devs.reshape(len(devs) // (sp * tp), sp, tp),
+                    axis_names=("dp", "sp", "tp"))
+            elif pp > 1:
                 if len(devs) % (pp * tp):
                     raise ValueError(
                         "pipeline_stages*tensor_parallel_degree = %d*%d "
@@ -276,6 +300,10 @@ class _DataParallelStep:
         batch = NamedSharding(mesh, P("dp"))
         self._repl = repl
         self._batch = batch
+        # long-context feeds [B, T, ...] shard their seq dim over sp too
+        self._sp = int(dict(mesh.shape).get("sp", 1))
+        self._batch_seq = (NamedSharding(mesh, P("dp", "sp"))
+                           if self._sp > 1 else batch)
 
         bs = build_strategy or BuildStrategy()
         zero_mode = (getattr(bs, "reduce_strategy",
@@ -364,16 +392,27 @@ class _DataParallelStep:
         for name in self.feed_names:
             arr = normalize_feed_value(self.block, name, feed[name])
             if not self._multiprocess:
-                sh = (self._batch if arr.ndim and arr.shape[0] % dp == 0
-                      else self._repl)
+                if not arr.ndim or arr.shape[0] % dp:
+                    sh = self._repl
+                elif (self._sp > 1 and arr.ndim >= 2
+                        and arr.shape[1] % self._sp == 0):
+                    sh = self._batch_seq
+                else:
+                    sh = self._batch
                 arr = jax.device_put(arr, sh)
             feeds[name] = arr
         if self._multiprocess:
+            def _feed_sharding(arr):
+                if not np.ndim(arr) or arr.shape[0] % dp:
+                    return self._repl
+                if (self._sp > 1 and np.ndim(arr) >= 2
+                        and arr.shape[1] % self._sp == 0):
+                    return self._batch_seq
+                return self._batch
+
             feeds = {
                 name: jax.make_array_from_callback(
-                    arr.shape,
-                    (self._batch if np.ndim(arr)
-                     and arr.shape[0] % dp == 0 else self._repl),
+                    arr.shape, _feed_sharding(arr),
                     lambda idx, a=arr: a[idx])
                 for name, arr in feeds.items()}
             for store in (mut, const):

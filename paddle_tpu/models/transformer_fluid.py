@@ -29,7 +29,8 @@ __all__ = ["build", "build_stacked"]
 
 
 def build(vocab_size=32000, d_model=512, n_heads=8, n_layers=6, d_ff=2048,
-          seq_len=512, dropout_rate=0.0, remat=True, dtype="float32"):
+          seq_len=512, dropout_rate=0.0, remat=True, dtype="float32",
+          head_chunk=None):
     """Build the LM graph; returns (tokens, labels, mean_loss) Variables.
 
     Feeds: tokens int32 [B, seq_len], labels int32 [B, seq_len] (next-token
@@ -92,11 +93,11 @@ def build(vocab_size=32000, d_model=512, n_heads=8, n_layers=6, d_ff=2048,
             i += 1
 
     h = layers.layer_norm(h, begin_norm_axis=2)
-    loss = _chunked_lm_head(h, labels, vocab_size, seq_len)
+    loss = _chunked_lm_head(h, labels, vocab_size, seq_len, head_chunk)
     return tokens, labels, loss
 
 
-def _chunked_lm_head(h, labels, vocab_size, seq_len):
+def _chunked_lm_head(h, labels, vocab_size, seq_len, head_chunk=None):
     """Vocab projection -> mean CE, chunked along the sequence. No remat
     here: softmax_with_cross_entropy's custom vjp keeps only the (bf16)
     logits as residuals and recomputes the softmax elementwise in
@@ -114,7 +115,7 @@ def _chunked_lm_head(h, labels, vocab_size, seq_len):
         ce = layers.softmax_with_cross_entropy(logits, y3)
         return layers.reduce_sum(ce)
 
-    head_chunk = min(seq_len, 256)
+    head_chunk = min(seq_len, head_chunk or 256)
     parts = []
     for s in range(0, seq_len, head_chunk):
         hs = layers.slice(h, axes=[1], starts=[s], ends=[s + head_chunk])

@@ -487,6 +487,38 @@ def _flash_attention_op(ctx, ins, attrs):
     t_axis = 2 if layout == "bhtd" else 1
     Dh = q.shape[-1]
     T = q.shape[t_axis]
+
+    # Sequence parallelism through the descriptor path (SURVEY §5.7, the
+    # scale-sequence-length axis): when the step mesh carries an "sp" axis
+    # (BuildStrategy.sequence_parallel_degree), self-attention runs as
+    # RING attention — K/V blocks rotate over the sp ranks via ppermute
+    # while each rank accumulates its Q-shard online-softmax, so the full
+    # [T, T] score matrix never exists on any chip. The shard_map is
+    # manual over sp only; dp/tp stay GSPMD-auto, and its seq-sharded
+    # out_specs seed sharding propagation through the residual stream.
+    mesh = getattr(ctx, "mesh", None)
+    sp = dict(mesh.shape).get("sp", 1) if mesh is not None else 1
+    if sp > 1:
+        if T % sp == 0 and q.shape == k.shape:
+            from ..parallel.ring_attention import ring_attention_sharded
+
+            qb, kb, vb = ((jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+                          if layout == "bthd" else (q, k, v))
+            out = ring_attention_sharded(qb, kb, vb, mesh, causal=causal,
+                                         sm_scale=scale,
+                                         partial_manual=True)
+            if layout == "bthd":
+                out = jnp.swapaxes(out, 1, 2)
+            return {"Out": [out.astype(out_dtype)]}
+        import warnings
+
+        warnings.warn(
+            "sequence_parallel_degree=%d is set but ring attention cannot "
+            "engage for this op (seq %d %% sp != 0, or cross-attention "
+            "q/k shapes differ): falling back to per-chip full attention, "
+            "which materializes O(T^2/chip) scores" % (sp, T),
+            RuntimeWarning)
+
     use_pallas = (T % 128 == 0 and Dh >= 64 and q.shape == k.shape)
     if use_pallas:
         if layout == "bthd":

@@ -82,15 +82,22 @@ def ring_attention(q, k, v, axis_name, causal=True, sm_scale=None):
     return out.astype(q.dtype)
 
 
-def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=True):
+def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=True,
+                           sm_scale=None, partial_manual=False):
     """Convenience wrapper: shard_map ring_attention over `mesh` with the
-    sequence dimension of [B, H, T, D] partitioned on `axis_name`."""
+    sequence dimension of [B, H, T, D] partitioned on `axis_name`.
+
+    partial_manual=True makes only `axis_name` manual (other mesh axes
+    stay GSPMD-auto) — the form the descriptor-path flash_attention op
+    uses inside a jitted step whose dp/tp axes GSPMD manages."""
     from jax.sharding import PartitionSpec as P
     from jax import shard_map
 
     spec = P(None, None, axis_name, None)
+    kwargs = ({"axis_names": {axis_name}, "check_vma": False}
+              if partial_manual else {})
     fn = shard_map(
         functools.partial(ring_attention, axis_name=axis_name,
-                          causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+                          causal=causal, sm_scale=sm_scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, **kwargs)
     return fn(q, k, v)
