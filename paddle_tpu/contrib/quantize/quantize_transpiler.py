@@ -177,7 +177,17 @@ class QuantizeTranspiler:
                         # the int8 twin is now the stored weight: demote
                         # the fp var to a runtime-computed value
                         v.persistable = False
-                        scope.erase(v.name)
+                        # erase()
+                        # only drops a scope's OWN binding (scope.cc
+                        # EraseVars parity), so target the owning scope —
+                        # `scope` may be a descendant of where startup
+                        # placed the weight
+                        owner = scope
+                        while owner is not None \
+                                and v.name not in owner._vars:
+                            owner = owner.parent
+                        if owner is not None:
+                            owner.erase(v.name)
                         pending.append((v, iv, scale))
                         converted[v.name] = int8_name
         for v, iv, scale in pending:

@@ -138,8 +138,17 @@ def execute_op(op, env, ctx):
             _execute_grad_op(op, env, ctx)
         return
     opdef = registry.get(op.type)
+
+    def _val(v):
+        # a tensor array created empty (layers.create_array) has no
+        # producing op, so its first mention inside a loop finds no env
+        # binding — it IS the empty array
+        if v.name not in env and getattr(v, "is_tensor_array", False):
+            return []
+        return env[v.name]
+
     ins = {
-        slot: [env[v.name] for v in vs] for slot, vs in op.inputs.items() if vs
+        slot: [_val(v) for v in vs] for slot, vs in op.inputs.items() if vs
     }
     if opdef.differentiable:
         ctx.fwd_snapshots[id(op)] = ins

@@ -50,16 +50,10 @@ class Scope:
         self._vars[name] = value
 
     def erase(self, name):
-        """Drop the NEAREST binding of a var (the one `get` would return) —
-        matching lookup semantics, so a child-scope shadow never deletes an
-        unrelated ancestor binding (parity: framework/scope.cc
-        Scope::EraseVars erases only the scope's own binding)."""
-        s = self
-        while s is not None:
-            if name in s._vars:
-                del s._vars[name]
-                return
-            s = s.parent
+        """Drop this scope's OWN binding of `name` if present (parity:
+        framework/scope.cc Scope::EraseVars — ancestor bindings are never
+        touched, so a child scope can never delete a var it doesn't own)."""
+        self._vars.pop(name, None)
 
     def has(self, name):
         return self.get(name, _MISSING) is not _MISSING

@@ -120,6 +120,19 @@ def _tensor_array_to_tensor(ctx, ins, attrs):
     use_stack = attrs.get("use_stack", False)
     if isinstance(arr, TensorArrayBuf):
         elems = [arr.buf[k] for k in range(arr.buf.shape[0])]
+        cap = arr.buf.shape[0]
+        # surface the capacity-vs-live-length divergence at run time (the
+        # executor warns host-side once per site) instead of only in docs;
+        # skip inside control-flow sub-traces where arr.n is an inner
+        # tracer that may not leak into the outer step's reports
+        if not ctx._nan_suppress:
+            ctx.warn_reports.append((
+                "tensor_array_to_tensor on a While-carried array emitted "
+                "its full static capacity (%d elements) but the loop "
+                "exited with fewer live entries — the tail is zeros; "
+                "slice by OutIndex / array_length host-side "
+                "(docs/MIGRATING.md)" % cap,
+                arr.n < cap))
     else:
         elems = list(arr)
     if use_stack:
@@ -173,11 +186,31 @@ def _while(ctx, ins, attrs):
                     count += _writes_per_trip(sub, name)
         return count
 
+    def _writer_x_var(blk, name):
+        """The Variable written into array `name` by an in-loop
+        array_write — its static shape seeds the buffer element proto when
+        the array enters the loop empty (layers.create_array)."""
+        for op in blk.ops:
+            if op.type == "array_write" and any(
+                    v.name == name for v in op.outputs.get("Out", [])):
+                xs = op.inputs.get("X", [])
+                if xs:
+                    return xs[0]
+            for key in ("sub_block", "true_block", "false_block"):
+                sub = op.attrs.get(key) if op.attrs else None
+                if sub is not None and getattr(sub, "ops", None) is not None:
+                    found = _writer_x_var(sub, name)
+                    if found is not None:
+                        return found
+        return None
+
     for name in list(env):
         val = env.get(name)
-        if isinstance(val, list) and val and all(
+        if isinstance(val, list) and all(
                 hasattr(e, "shape") for e in val if e is not None):
             writes = _writes_per_trip(block, name)
+            if not val and not writes:
+                continue  # empty and untouched: not a tensor array in use
             if writes and not max_trip:
                 raise RuntimeError(
                     "While writes tensor array %r but has no "
@@ -186,7 +219,26 @@ def _while(ctx, ins, attrs):
                     "layers.While(cond, max_trip_count=N)" % name)
             elems = [e for e in val if e is not None]
             cap = len(val) + int(max_trip or 0) * writes
-            proto = jnp.zeros_like(elems[0])
+            if elems:
+                proto = jnp.zeros_like(elems[0])
+            else:
+                # array created empty (layers.create_array) and first
+                # written inside the loop: no seed element, so infer the
+                # element proto from the writer's static var shape
+                from ..framework import dtype_to_np
+
+                xvar = _writer_x_var(block, name)
+                shape = getattr(xvar, "shape", None)
+                if xvar is None or shape is None or any(
+                        d is None or d < 0 for d in shape):
+                    raise RuntimeError(
+                        "tensor array %r enters the While empty and its "
+                        "in-loop writes have no static shape to size the "
+                        "buffer element from — write one seed element "
+                        "before the loop (array_write at index 0), or "
+                        "give the written value a fully static shape"
+                        % name)
+                proto = jnp.zeros(tuple(shape), dtype_to_np(xvar.dtype))
             padded = [e if e is not None else proto for e in val]
             padded += [proto] * (cap - len(padded))
             env[name] = TensorArrayBuf(

@@ -109,14 +109,15 @@ def plan_program(program, mesh, build_strategy=None, zero_sharding=False):
     def _fit(var, spec):
         """Demote spec dims the var's static shape can't divide — jit
         in_shardings (unlike with_sharding_constraint) reject uneven
-        dimension sharding."""
+        dimension sharding. Specs longer than the var's rank truncate
+        (docs/PARALLEL.md contract: annotations demote, never error)."""
         shape = getattr(var, "shape", None)
         if shape is None:
             return spec
+        spec = P(*tuple(spec)[:len(shape)])
         dims = []
         for i, d in enumerate(tuple(spec)):
-            if d is None or i >= len(shape) or shape[i] is None \
-                    or shape[i] < 0:
+            if d is None or shape[i] is None or shape[i] < 0:
                 dims.append(d)
                 continue
             axes = d if isinstance(d, (tuple, list)) else (d,)
@@ -223,6 +224,25 @@ def plan_program(program, mesh, build_strategy=None, zero_sharding=False):
                 if getattr(v, "persistable", False) \
                         and v.name not in plan.specs:
                     explicit(v)
+
+    # 3.5 diagnose the silent no-op: a tp degree that shards NOTHING means
+    # the walk found no eligible fc/embedding chain and no annotation
+    # matched — the user pays a tp-sliced mesh (smaller dp) for zero
+    # model parallelism, so say so once, host-side
+    if tp > 1 and not any(
+            "tp" in (a for d in tuple(s) if d is not None
+                     for a in (d if isinstance(d, (tuple, list)) else (d,)))
+            for s in plan.specs.values()):
+        import warnings
+
+        warnings.warn(
+            "tensor_parallel_degree=%d produced no tp-sharded parameters: "
+            "no fc/embedding chain was auto-shardable (dims must divide "
+            "tp) and no shard_spec annotation matched. The program runs "
+            "correctly but fully replicated over the tp axis — annotate "
+            "params via ParamAttr(shard_spec=...) or "
+            "BuildStrategy.sharding_specs, or drop the tp degree." % tp,
+            RuntimeWarning, stacklevel=3)
 
     # 4. ZeRO-1 (Reduce mode): shard optimizer state over dp on dim 0.
     # State var = any persistable input of an op carrying a Param slot,

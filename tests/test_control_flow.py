@@ -341,3 +341,51 @@ def test_while_truncation_warns():
     assert float(np.asarray(acc_v)[0]) == 3.0  # truncated at 3
     assert any("truncated" in str(w.message) for w in caught), [
         str(w.message) for w in caught]
+
+
+def test_empty_array_written_inside_while():
+    """A tensor array created empty (layers.create_array) and first
+    written inside a While gets its buffer element proto from the writer's
+    static shape (round-3 ADVICE: the empty-list guard used to reject it
+    with a misleading max_trip_count error)."""
+    i = layers.fill_constant(shape=[1], dtype="int64", value=0)
+    n = layers.fill_constant(shape=[1], dtype="int64", value=4)
+    x = layers.fill_constant(shape=[2], dtype="float32", value=1.5)
+    arr = layers.create_array("float32")
+    cond = layers.less_than(x=i, y=n)
+    loop = layers.While(cond=cond, max_trip_count=4)
+    with loop.block():
+        layers.array_write(x=x, i=i, array=arr)
+        layers.increment(x=i, value=1, in_place=True)
+        layers.less_than(x=i, y=n, cond=cond)
+    out, _ = layers.tensor_array_to_tensor(arr, axis=0)
+    exe = _exe()
+    out_v, = exe.run(feed={}, fetch_list=[out])
+    got = np.asarray(out_v).reshape(-1, 2)
+    assert got.shape[0] == 4
+    np.testing.assert_allclose(got[:4], np.full((4, 2), 1.5), rtol=1e-6)
+
+
+def test_array_concat_capacity_warns_on_early_exit():
+    """tensor_array_to_tensor on a While-carried array warns at run time
+    when the loop exited before filling the static capacity."""
+    import warnings
+
+    i = layers.fill_constant(shape=[1], dtype="int64", value=0)
+    n = layers.fill_constant(shape=[1], dtype="int64", value=2)  # early
+    x = layers.fill_constant(shape=[2], dtype="float32", value=3.0)
+    arr = layers.create_array("float32")
+    cond = layers.less_than(x=i, y=n)
+    loop = layers.While(cond=cond, max_trip_count=5)  # capacity 5 > 2 live
+    with loop.block():
+        layers.array_write(x=x, i=i, array=arr)
+        layers.increment(x=i, value=1, in_place=True)
+        layers.less_than(x=i, y=n, cond=cond)
+    out, _ = layers.tensor_array_to_tensor(arr, axis=0)
+    exe = _exe()
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        out_v, = exe.run(feed={}, fetch_list=[out])
+    assert np.asarray(out_v).reshape(-1, 2).shape[0] == 5  # full capacity, zero tail
+    assert any("static capacity" in str(w.message) for w in caught), [
+        str(w.message) for w in caught]

@@ -272,3 +272,61 @@ def test_fluid_transformer_tp_dp_mesh():
     step = next(iter(compiled._compiled_steps.values()))
     specs = step._plan.summary()
     assert any("tp" in str(s) for s in specs.values()), specs
+
+
+def test_fit_truncates_rank_mismatched_specs():
+    """A shard_spec with more dims than the parameter's rank demotes by
+    truncation (docs/PARALLEL.md: annotations demote, never error) — e.g.
+    (None, 'tp') on a 1-D bias must not reach jit in_shardings."""
+    import paddle_tpu.layers as layers
+
+    x = layers.data(name="rm_x", shape=[16], dtype="float32")
+    h = layers.fc(x, 32, param_attr=fluid.ParamAttr(
+        name="rm_w", shard_spec=(None, "tp")),
+        bias_attr=fluid.ParamAttr(name="rm_b", shard_spec=(None, "tp")))
+    loss = layers.mean(h)
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    bs = fluid.BuildStrategy()
+    bs.tensor_parallel_degree = 2
+    compiled = fluid.CompiledProgram(
+        fluid.default_main_program()).with_data_parallel(
+            loss_name=loss.name, build_strategy=bs)
+    rng = np.random.RandomState(0)
+    feed = {"rm_x": rng.rand(8, 16).astype(np.float32)}
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    exe.run(compiled, feed=feed, fetch_list=[loss])  # must not raise
+    step = next(iter(compiled._compiled_steps.values()))
+    specs = step._plan.summary()
+    assert len(specs["rm_b"]) <= 1, specs["rm_b"]
+    assert specs["rm_w"] == (None, "tp")
+
+
+def test_tp_silent_noop_warns():
+    """tensor_parallel_degree > 1 that shards nothing must warn once (the
+    round-3 VERDICT's 'silent no-op')."""
+    import warnings
+
+    import paddle_tpu.layers as layers
+
+    x = layers.data(name="nw_x", shape=[7], dtype="float32")
+    # 7 -> 5: no dim divides tp=2, so the auto-walk shards nothing
+    h = layers.fc(x, 5, act="relu")
+    loss = layers.mean(h)
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    bs = fluid.BuildStrategy()
+    bs.tensor_parallel_degree = 2
+    compiled = fluid.CompiledProgram(
+        fluid.default_main_program()).with_data_parallel(
+            loss_name=loss.name, build_strategy=bs)
+    rng = np.random.RandomState(0)
+    feed = {"nw_x": rng.rand(8, 7).astype(np.float32)}
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        exe.run(compiled, feed=feed, fetch_list=[loss])
+    assert any("no tp-sharded parameters" in str(w.message)
+               for w in caught), [str(w.message) for w in caught]
