@@ -23,6 +23,12 @@
 extern "C" {
 
 // ---- recordio (chunked, CRC32-checked record file; recordio/ parity) ----
+// compressor: 0 = none, 1 = deflate (chunk.cc:79-96 parity; zlib where
+// the reference bundles snappy)
+PTPU_API void* ptpu_recordio_writer_open2(const char* path,
+                                          uint64_t max_chunk_records,
+                                          uint64_t max_chunk_bytes,
+                                          uint32_t compressor);
 PTPU_API void* ptpu_recordio_writer_open(const char* path,
                                          uint64_t max_chunk_records,
                                          uint64_t max_chunk_bytes);

@@ -1,14 +1,23 @@
 // Chunked, CRC32-checked record file format (parity: reference
 // recordio/{writer,scanner,chunk} — 713 LoC C++; same capability, fresh
-// design).
+// design; chunk-level compression per recordio/chunk.cc:79-96, with
+// DEFLATE where the reference uses snappy — zlib ships everywhere).
 //
 // Layout: file = chunk*. chunk = header + records.
-//   header: magic u32 'PTRC', num_records u32, payload_bytes u64,
-//           payload_crc32 u32
-//   payload: (len u32, bytes)* back to back.
-// Records never split across chunks; a torn final chunk is detected by CRC
-// and dropped (crash-safe append semantics).
+//   uncompressed chunk ('PTRC'):
+//     magic u32, num_records u32, payload_bytes u64, payload_crc32 u32
+//   deflate chunk ('PTRZ'):
+//     magic u32, num_records u32, raw_bytes u64, comp_bytes u64,
+//     raw_crc32 u32, then comp_bytes of zlib stream
+//   payload (after decompression): (len u32, bytes)* back to back.
+// The scanner dispatches per-chunk on the magic, so compressed and plain
+// chunks may be mixed in one file. CRC always covers the RAW payload, so
+// a decompression bug cannot masquerade as valid data. Records never
+// split across chunks; a torn final chunk is detected by CRC and dropped
+// (crash-safe append semantics).
 #include "ptpu_native.h"
+
+#include <zlib.h>
 
 #include <cstdio>
 #include <cstring>
@@ -17,7 +26,11 @@
 
 namespace {
 
-constexpr uint32_t kChunkMagic = 0x50545243;  // "PTRC"
+constexpr uint32_t kChunkMagic = 0x50545243;   // "PTRC"
+constexpr uint32_t kChunkMagicZ = 0x5A545243;  // "PTRZ" (deflate)
+// sanity bound on header-declared sizes: a torn/corrupt header must come
+// back as the -2 "bad chunk" error, not a std::bad_alloc through the C ABI
+constexpr uint64_t kMaxChunkBytes = 1ull << 32;
 
 uint32_t crc32_impl(const char* data, uint64_t len) {
   static uint32_t table[256];
@@ -41,17 +54,35 @@ struct Writer {
   std::string payload;
   uint32_t num_records = 0;
   uint64_t max_records, max_bytes;
+  uint32_t compressor = 0;  // 0 = none, 1 = deflate
 
   int flush_chunk() {
     if (num_records == 0) return 0;
-    uint32_t magic = kChunkMagic;
-    uint64_t bytes = payload.size();
-    uint32_t crc = crc32_impl(payload.data(), bytes);
-    if (fwrite(&magic, 4, 1, f) != 1) return -1;
-    if (fwrite(&num_records, 4, 1, f) != 1) return -1;
-    if (fwrite(&bytes, 8, 1, f) != 1) return -1;
-    if (fwrite(&crc, 4, 1, f) != 1) return -1;
-    if (bytes && fwrite(payload.data(), 1, bytes, f) != bytes) return -1;
+    uint64_t raw = payload.size();
+    uint32_t crc = crc32_impl(payload.data(), raw);
+    if (compressor == 1) {
+      uLongf comp_cap = compressBound(raw);
+      std::string comp(comp_cap, '\0');
+      if (compress2(reinterpret_cast<Bytef*>(&comp[0]), &comp_cap,
+                    reinterpret_cast<const Bytef*>(payload.data()), raw,
+                    Z_DEFAULT_COMPRESSION) != Z_OK)
+        return -1;
+      uint32_t magic = kChunkMagicZ;
+      uint64_t cbytes = comp_cap;
+      if (fwrite(&magic, 4, 1, f) != 1) return -1;
+      if (fwrite(&num_records, 4, 1, f) != 1) return -1;
+      if (fwrite(&raw, 8, 1, f) != 1) return -1;
+      if (fwrite(&cbytes, 8, 1, f) != 1) return -1;
+      if (fwrite(&crc, 4, 1, f) != 1) return -1;
+      if (cbytes && fwrite(comp.data(), 1, cbytes, f) != cbytes) return -1;
+    } else {
+      uint32_t magic = kChunkMagic;
+      if (fwrite(&magic, 4, 1, f) != 1) return -1;
+      if (fwrite(&num_records, 4, 1, f) != 1) return -1;
+      if (fwrite(&raw, 8, 1, f) != 1) return -1;
+      if (fwrite(&crc, 4, 1, f) != 1) return -1;
+      if (raw && fwrite(payload.data(), 1, raw, f) != raw) return -1;
+    }
     payload.clear();
     num_records = 0;
     return 0;
@@ -68,12 +99,28 @@ struct Scanner {
     uint32_t magic, num, crc;
     uint64_t bytes;
     if (fread(&magic, 4, 1, f) != 1) return -1;  // EOF
-    if (magic != kChunkMagic) return -2;
+    if (magic != kChunkMagic && magic != kChunkMagicZ) return -2;
     if (fread(&num, 4, 1, f) != 1) return -2;
     if (fread(&bytes, 8, 1, f) != 1) return -2;
-    if (fread(&crc, 4, 1, f) != 1) return -2;
-    chunk.resize(bytes);
-    if (bytes && fread(&chunk[0], 1, bytes, f) != bytes) return -2;
+    if (bytes > kMaxChunkBytes) return -2;
+    if (magic == kChunkMagicZ) {
+      uint64_t cbytes;
+      if (fread(&cbytes, 8, 1, f) != 1) return -2;
+      if (cbytes > kMaxChunkBytes) return -2;
+      if (fread(&crc, 4, 1, f) != 1) return -2;
+      std::string comp(cbytes, '\0');
+      if (cbytes && fread(&comp[0], 1, cbytes, f) != cbytes) return -2;
+      chunk.resize(bytes);
+      uLongf raw_len = bytes;
+      if (uncompress(reinterpret_cast<Bytef*>(&chunk[0]), &raw_len,
+                     reinterpret_cast<const Bytef*>(comp.data()),
+                     cbytes) != Z_OK || raw_len != bytes)
+        return -2;
+    } else {
+      if (fread(&crc, 4, 1, f) != 1) return -2;
+      chunk.resize(bytes);
+      if (bytes && fread(&chunk[0], 1, bytes, f) != bytes) return -2;
+    }
     if (crc32_impl(chunk.data(), bytes) != crc) return -2;
     offset = 0;
     return 0;
@@ -88,15 +135,23 @@ uint32_t ptpu_crc32(const char* data, uint64_t len) {
   return crc32_impl(data, len);
 }
 
-void* ptpu_recordio_writer_open(const char* path, uint64_t max_chunk_records,
-                                uint64_t max_chunk_bytes) {
+void* ptpu_recordio_writer_open2(const char* path, uint64_t max_chunk_records,
+                                 uint64_t max_chunk_bytes,
+                                 uint32_t compressor) {
   FILE* f = fopen(path, "wb");
   if (!f) return nullptr;
   Writer* w = new Writer();
   w->f = f;
   w->max_records = max_chunk_records ? max_chunk_records : 1000;
   w->max_bytes = max_chunk_bytes ? max_chunk_bytes : (1ull << 20);
+  w->compressor = compressor;
   return w;
+}
+
+void* ptpu_recordio_writer_open(const char* path, uint64_t max_chunk_records,
+                                uint64_t max_chunk_bytes) {
+  return ptpu_recordio_writer_open2(path, max_chunk_records, max_chunk_bytes,
+                                    0);
 }
 
 int ptpu_recordio_writer_write(void* wp, const char* data, uint64_t len) {

@@ -175,19 +175,11 @@ class QuantizeTranspiler:
                         iv.quant_scale = scale / bnt
                         scope.set(int8_name, q)
                         # the int8 twin is now the stored weight: demote
-                        # the fp var to a runtime-computed value
+                        # the fp var to a runtime-computed value; erase at
+                        # the OWNING scope (erase() itself only drops a
+                        # scope's own binding, scope.cc EraseVars parity)
                         v.persistable = False
-                        # erase()
-                        # only drops a scope's OWN binding (scope.cc
-                        # EraseVars parity), so target the owning scope —
-                        # `scope` may be a descendant of where startup
-                        # placed the weight
-                        owner = scope
-                        while owner is not None \
-                                and v.name not in owner._vars:
-                            owner = owner.parent
-                        if owner is not None:
-                            owner.erase(v.name)
+                        scope.erase_nearest(v.name)
                         pending.append((v, iv, scale))
                         converted[v.name] = int8_name
         for v, iv, scale in pending:

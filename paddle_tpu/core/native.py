@@ -25,6 +25,11 @@ def _configure(lib):
     lib.ptpu_recordio_writer_open.argtypes = [ctypes.c_char_p,
                                               ctypes.c_uint64,
                                               ctypes.c_uint64]
+    lib.ptpu_recordio_writer_open2.restype = ctypes.c_void_p
+    lib.ptpu_recordio_writer_open2.argtypes = [ctypes.c_char_p,
+                                               ctypes.c_uint64,
+                                               ctypes.c_uint64,
+                                               ctypes.c_uint32]
     lib.ptpu_recordio_writer_write.restype = ctypes.c_int
     lib.ptpu_recordio_writer_write.argtypes = [ctypes.c_void_p,
                                                ctypes.c_char_p,
@@ -235,15 +240,27 @@ class NativeQueue:
 
 
 class RecordIOWriter:
-    """Chunked CRC'd record file writer (recordio/ parity)."""
+    """Chunked CRC'd record file writer (recordio/ parity).
+
+    compressor: 0/None = plain, 1/'deflate' = zlib-compressed chunks
+    (chunk.cc:79-96 parity; 'snappy' accepted as an alias — the wire
+    format is ours, deflate is the bundled codec)."""
+
+    _COMPRESSORS = {None: 0, "": 0, 0: 0, "none": 0,
+                    1: 1, "deflate": 1, "snappy": 1}
 
     def __init__(self, path, max_chunk_records=1000,
-                 max_chunk_bytes=1 << 20):
+                 max_chunk_bytes=1 << 20, compressor=None):
         self._l = lib()
         if self._l is None:
             raise RuntimeError("native library unavailable for RecordIO")
-        self._w = self._l.ptpu_recordio_writer_open(
-            path.encode(), max_chunk_records, max_chunk_bytes)
+        key = compressor.lower() if isinstance(compressor, str) \
+            else compressor
+        if key not in self._COMPRESSORS:
+            raise ValueError("unknown recordio compressor %r" % compressor)
+        self._w = self._l.ptpu_recordio_writer_open2(
+            path.encode(), max_chunk_records, max_chunk_bytes,
+            self._COMPRESSORS[key])
         if not self._w:
             raise IOError("cannot open %s" % path)
 
@@ -254,8 +271,13 @@ class RecordIOWriter:
 
     def close(self):
         if self._w:
-            self._l.ptpu_recordio_writer_close(self._w)
+            rc = self._l.ptpu_recordio_writer_close(self._w)
             self._w = None
+            if rc != 0:
+                # the final partial chunk flushes inside close: swallowing
+                # a failure here would silently truncate the file's tail
+                raise IOError("recordio close failed flushing the final "
+                              "chunk (rc=%d)" % rc)
 
 
 class RecordIOScanner:

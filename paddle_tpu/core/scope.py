@@ -55,6 +55,17 @@ class Scope:
         touched, so a child scope can never delete a var it doesn't own)."""
         self._vars.pop(name, None)
 
+    def erase_nearest(self, name):
+        """Drop the binding `get` would return — walks ancestors to the
+        owning scope and erases there (for transforms that must retire a
+        var wherever startup placed it, e.g. the quantize transpiler)."""
+        s = self
+        while s is not None:
+            if name in s._vars:
+                del s._vars[name]
+                return
+            s = s.parent
+
     def has(self, name):
         return self.get(name, _MISSING) is not _MISSING
 

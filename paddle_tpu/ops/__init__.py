@@ -19,3 +19,4 @@ from . import controlflow  # noqa: F401
 from . import misc_ops  # noqa: F401
 from . import quant_ops  # noqa: F401
 from . import compat_ops  # noqa: F401
+from . import fused_tail_ops  # noqa: F401

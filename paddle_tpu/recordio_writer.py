@@ -56,8 +56,11 @@ def convert_reader_to_recordio_file(filename, reader_creator,
                                     compressor=None, max_num_records=1000,
                                     feeder=None):
     """Write every sample of a reader into a recordio file; returns the
-    record count (parity: fluid/recordio_writer.py:42)."""
-    w = native.RecordIOWriter(filename, max_chunk_records=max_num_records)
+    record count (parity: fluid/recordio_writer.py:42). compressor:
+    None/'none' plain, 'deflate' zlib chunks ('snappy' accepted as an
+    alias for reference-source compatibility)."""
+    w = native.RecordIOWriter(filename, max_chunk_records=max_num_records,
+                              compressor=compressor)
     n = 0
     try:
         for sample in reader_creator():
@@ -87,7 +90,8 @@ def convert_reader_to_recordio_files(filename, batch_per_file,
             if w is None:
                 w = native.RecordIOWriter(
                     "%s-%05d%s" % (f_name, f_idx, f_ext),
-                    max_chunk_records=max_num_records)
+                    max_chunk_records=max_num_records,
+                    compressor=compressor)
             w.write(serialize_sample(sample))
             n += 1
             if n % batch_per_file == 0:

@@ -20,22 +20,18 @@ do_build() {
 do_test() {
   make -C native -s test
   # Shard the python suite across workers (paddle_build.sh:637
-  # parallel_test parity) — one pytest-xdist process per spare core, file
-  # granularity so per-file compile caches stay together. A 1-core box
-  # runs serial: concurrent 8-device CPU meshes there only add collective
-  # rendezvous pressure, not wall-clock.
-  local n
+  # parallel_test parity) — pytest-xdist over spare cores (capped at 4),
+  # file granularity so per-file compile caches stay together. A 1-core
+  # box runs serial: concurrent 8-device CPU meshes there only add
+  # collective rendezvous pressure, not wall-clock.
+  local n extra=""
   n=$(python -c 'import os; print(max(1, min(4, (os.cpu_count() or 1) - 1)))')
   if ! python -c 'import xdist' 2>/dev/null; then
     n=1  # pytest-xdist not installed: run serial
   fi
-  if [ "$n" -gt 1 ]; then
-    XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
-      python -m pytest tests/ -q -n "$n" --dist loadfile
-  else
-    XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
-      python -m pytest tests/ -q
-  fi
+  [ "$n" -gt 1 ] && extra="-n $n --dist loadfile"
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q $extra
 }
 
 do_api_check() {

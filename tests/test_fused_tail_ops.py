@@ -1,0 +1,164 @@
+"""The nine fused/fusion registry-tail ops (round-3 VERDICT missing #4):
+each checked numerically against its unfused composition so a saved
+reference program holding these op types loads AND computes the right
+values."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu  # noqa: F401  (registers the op corpus)
+from paddle_tpu.core.lowering import LoweringContext
+from paddle_tpu.ops.registry import get
+
+
+def _ctx():
+    return LoweringContext(base_key=jax.random.PRNGKey(0))
+
+
+def test_conv2d_fusion_matches_unfused():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 3, 8, 8).astype(np.float32))
+    w = jnp.asarray(rng.randn(6, 3, 3, 3).astype(np.float32))
+    b = jnp.asarray(rng.randn(6).astype(np.float32))
+    res = jnp.asarray(rng.randn(2, 6, 8, 8).astype(np.float32))
+    attrs = {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+             "groups": 1, "activation": "relu"}
+    out = get("conv2d_fusion").impl(
+        _ctx(), {"Input": [x], "Filter": [w], "Bias": [b],
+                 "ResidualData": [res]}, attrs)["Output"][0]
+    ref = get("conv2d").impl(_ctx(), {"Input": [x], "Filter": [w]},
+                             attrs)["Output"][0]
+    ref = jax.nn.relu(ref + b.reshape(1, -1, 1, 1) + res)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)
+
+    # split_channels mode
+    outs = get("conv2d_fusion").impl(
+        _ctx(), {"Input": [x], "Filter": [w], "Bias": [b]},
+        {**attrs, "split_channels": [2, 4]})["Outputs"]
+    assert outs[0].shape[1] == 2 and outs[1].shape[1] == 4
+
+
+def test_conv2d_inception_fusion_branches():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 4, 6, 6).astype(np.float32))
+    filters = [jnp.asarray(rng.randn(c, 4, k, k).astype(np.float32))
+               for c, k in ((3, 1), (5, 1), (4, 3), (2, 5))]
+    biases = [jnp.asarray(rng.randn(f.shape[0]).astype(np.float32))
+              for f in filters]
+    out = get("conv2d_inception_fusion").impl(
+        _ctx(), {"Input": [x], "Filter": filters, "Bias": biases},
+        {"activation": "relu"})["Output"][0]
+    assert out.shape == (2, 3 + 5 + 4 + 2, 6, 6)
+    assert float(jnp.min(out)) >= 0.0  # relu applied to every branch
+
+
+def test_fused_embedding_fc_lstm_matches_lookup_plus_lstm():
+    rng = np.random.RandomState(2)
+    V, D, B, T = 11, 4, 2, 5
+    ids = jnp.asarray(rng.randint(0, V, (B, T, 1)).astype(np.int64))
+    emb = jnp.asarray(rng.randn(V, 4 * D).astype(np.float32) * 0.1)
+    wh = jnp.asarray(rng.randn(D, 4 * D).astype(np.float32) * 0.1)
+    bias = jnp.asarray(rng.randn(1, 4 * D).astype(np.float32) * 0.1)
+    out = get("fused_embedding_fc_lstm").impl(
+        _ctx(), {"Ids": [ids], "Embeddings": [emb], "WeightH": [wh],
+                 "Bias": [bias]}, {})
+    xx = jnp.take(emb, ids[..., 0].astype(jnp.int32), axis=0)
+    ref = get("lstm").impl(_ctx(), {"Input": [xx], "Weight": [wh],
+                                    "Bias": [bias]}, {})
+    np.testing.assert_allclose(np.asarray(out["Hidden"][0]),
+                               np.asarray(ref["Hidden"][0]), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out["XX"][0]), np.asarray(xx),
+                               rtol=1e-6)
+
+
+def test_fusion_repeated_fc_relu():
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(4, 6).astype(np.float32))
+    ws = [jnp.asarray(rng.randn(6, 5).astype(np.float32)),
+          jnp.asarray(rng.randn(5, 7).astype(np.float32)),
+          jnp.asarray(rng.randn(7, 3).astype(np.float32))]
+    bs = [jnp.asarray(rng.randn(w.shape[1]).astype(np.float32))
+          for w in ws]
+    got = get("fusion_repeated_fc_relu").impl(
+        _ctx(), {"X": [x], "W": ws, "Bias": bs}, {})
+    ref = x
+    for w, b in zip(ws, bs):
+        ref = jax.nn.relu(ref @ w + b)
+    np.testing.assert_allclose(np.asarray(got["Out"][0]), np.asarray(ref),
+                               rtol=1e-5)
+    assert len(got["ReluOut"]) == 2
+
+
+def test_fusion_seqconv_eltadd_relu():
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(2, 6, 3).astype(np.float32))
+    ctx_len = 3
+    w = jnp.asarray(rng.randn(ctx_len * 3, 5).astype(np.float32))
+    b = jnp.asarray(rng.randn(5).astype(np.float32))
+    attrs = {"contextLength": ctx_len, "contextStart": -1}
+    got = get("fusion_seqconv_eltadd_relu").impl(
+        _ctx(), {"X": [x], "Filter": [w], "Bias": [b]}, attrs)["Out"][0]
+    ref = get("sequence_conv").impl(
+        _ctx(), {"X": [x], "Filter": [w]}, attrs)["Out"][0]
+    ref = jax.nn.relu(ref + b)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5)
+
+
+def test_fusion_seqexpand_concat_fc():
+    rng = np.random.RandomState(5)
+    seq = jnp.asarray(rng.randn(2, 4, 3).astype(np.float32))
+    vec = jnp.asarray(rng.randn(2, 2).astype(np.float32))
+    w = jnp.asarray(rng.randn(5, 6).astype(np.float32))
+    b = jnp.asarray(rng.randn(6).astype(np.float32))
+    got = get("fusion_seqexpand_concat_fc").impl(
+        _ctx(), {"X": [seq, vec], "FCWeight": [w], "FCBias": [b]},
+        {"fc_activation": "relu"})["Out"][0]
+    cat = jnp.concatenate(
+        [seq, jnp.broadcast_to(vec[:, None, :], (2, 4, 2))], axis=-1)
+    ref = jax.nn.relu(cat @ w + b)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5)
+
+
+def test_fusion_seqpool_concat():
+    rng = np.random.RandomState(6)
+    xs = [jnp.asarray(rng.randn(2, 3, 4).astype(np.float32)),
+          jnp.asarray(rng.randn(2, 5, 4).astype(np.float32))]
+    got = get("fusion_seqpool_concat").impl(
+        _ctx(), {"X": xs}, {"pooltype": "SUM", "axis": 1})["Out"][0]
+    ref = jnp.concatenate([x.sum(axis=1) for x in xs], axis=1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5)
+
+
+def test_fusion_squared_mat_sub():
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(3, 4).astype(np.float32))
+    y = jnp.asarray(rng.randn(4, 5).astype(np.float32))
+    got = get("fusion_squared_mat_sub").impl(
+        _ctx(), {"X": [x], "Y": [y]}, {"scalar": 0.5})["Out"][0]
+    ref = 0.5 * ((x @ y) ** 2 - (x * x) @ (y * y))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-4)
+
+
+def test_fusion_transpose_flatten_concat():
+    rng = np.random.RandomState(8)
+    xs = [jnp.asarray(rng.randn(2, 3, 4).astype(np.float32)),
+          jnp.asarray(rng.randn(2, 3, 5).astype(np.float32))]
+    got = get("fusion_transpose_flatten_concat").impl(
+        _ctx(), {"X": xs},
+        {"trans_axis": [0, 2, 1], "flatten_axis": 1,
+         "concat_axis": 1})["Out"][0]
+    ref = jnp.concatenate(
+        [jnp.transpose(x, (0, 2, 1)).reshape(2, -1) for x in xs], axis=1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5)
+
+
+def test_registry_holds_all_nine():
+    names = ["conv2d_fusion", "conv2d_inception_fusion",
+             "fused_embedding_fc_lstm", "fusion_repeated_fc_relu",
+             "fusion_seqconv_eltadd_relu", "fusion_seqexpand_concat_fc",
+             "fusion_seqpool_concat", "fusion_squared_mat_sub",
+             "fusion_transpose_flatten_concat"]
+    for n in names:
+        assert get(n) is not None
