@@ -29,8 +29,11 @@ namespace {
 constexpr uint32_t kChunkMagic = 0x50545243;   // "PTRC"
 constexpr uint32_t kChunkMagicZ = 0x5A545243;  // "PTRZ" (deflate)
 // sanity bound on header-declared sizes: a torn/corrupt header must come
-// back as the -2 "bad chunk" error, not a std::bad_alloc through the C ABI
-constexpr uint64_t kMaxChunkBytes = 1ull << 32;
+// back as the -2 "bad chunk" error, not a std::bad_alloc through the C
+// ABI. Writers cap chunks at max_bytes (default 1 MiB) + one record, so
+// 1 GiB is far above any legitimate chunk while small enough that a
+// bounded allocation attempt cannot OOM-kill a loader worker.
+constexpr uint64_t kMaxChunkBytes = 1ull << 30;
 
 uint32_t crc32_impl(const char* data, uint64_t len) {
   static uint32_t table[256];
@@ -102,23 +105,31 @@ struct Scanner {
     if (magic != kChunkMagic && magic != kChunkMagicZ) return -2;
     if (fread(&num, 4, 1, f) != 1) return -2;
     if (fread(&bytes, 8, 1, f) != 1) return -2;
-    if (bytes > kMaxChunkBytes) return -2;
+    if (bytes >= kMaxChunkBytes) return -2;
     if (magic == kChunkMagicZ) {
       uint64_t cbytes;
       if (fread(&cbytes, 8, 1, f) != 1) return -2;
-      if (cbytes > kMaxChunkBytes) return -2;
+      if (cbytes >= kMaxChunkBytes) return -2;
       if (fread(&crc, 4, 1, f) != 1) return -2;
-      std::string comp(cbytes, '\0');
-      if (cbytes && fread(&comp[0], 1, cbytes, f) != cbytes) return -2;
-      chunk.resize(bytes);
-      uLongf raw_len = bytes;
-      if (uncompress(reinterpret_cast<Bytef*>(&chunk[0]), &raw_len,
-                     reinterpret_cast<const Bytef*>(comp.data()),
-                     cbytes) != Z_OK || raw_len != bytes)
-        return -2;
+      try {
+        std::string comp(cbytes, '\0');
+        if (cbytes && fread(&comp[0], 1, cbytes, f) != cbytes) return -2;
+        chunk.resize(bytes);
+        uLongf raw_len = bytes;
+        if (uncompress(reinterpret_cast<Bytef*>(&chunk[0]), &raw_len,
+                       reinterpret_cast<const Bytef*>(comp.data()),
+                       cbytes) != Z_OK || raw_len != bytes)
+          return -2;
+      } catch (const std::bad_alloc&) {
+        return -2;  // bounded, but never let bad_alloc cross the C ABI
+      }
     } else {
       if (fread(&crc, 4, 1, f) != 1) return -2;
-      chunk.resize(bytes);
+      try {
+        chunk.resize(bytes);
+      } catch (const std::bad_alloc&) {
+        return -2;
+      }
       if (bytes && fread(&chunk[0], 1, bytes, f) != bytes) return -2;
     }
     if (crc32_impl(chunk.data(), bytes) != crc) return -2;

@@ -1,0 +1,243 @@
+"""Program-pass infrastructure (parity: framework/ir/pass.h:34 Pass/
+REGISTER_PASS and ir/graph_pattern_detector.h:254 GraphPatternDetector).
+
+The reference rewrites a C++ graph IR through ~30 registered passes with a
+declarative pattern detector. TPU-native, HLO-level optimization belongs
+to XLA; what remains OURS is the PROGRAM level — algebraic folds that
+change what gets computed (conv+bn weight folding), op removal with
+rewiring (inference dropout), and analysis annotations (memory reuse
+plans). This module gives those transforms the reference's extensibility
+surface: a `Pass` base, a name registry any user can extend, and an
+op-CHAIN pattern matcher over a block's dataflow (the 90% case of
+GraphPatternDetector — producer feeds consumer, optionally
+single-consumer links).
+
+    @fluid.ir.register_pass("my_fold")
+    class MyFold(fluid.ir.Pass):
+        def apply(self, program, scope=None):
+            for conv, bn in fluid.ir.match_chain(
+                    program.global_block(), ("conv2d", "batch_norm")):
+                ...
+    fluid.ir.apply_passes(program, ["my_fold"], scope)
+
+The built-in inference passes (conv_bn_fold, dropout_remove,
+memory_optimize) are registered here and the transpilers now delegate to
+them, so user passes and builtins compose through one pipeline.
+"""
+
+from .core.scope import global_scope
+
+__all__ = ["Pass", "register_pass", "unregister_pass", "get_pass",
+           "apply_passes", "registered_passes", "match_chain"]
+
+
+class Pass:
+    """One program transform. Subclass and implement `apply(program,
+    scope=None)`; mutate the program in place (bump its version if you
+    change ops) and return it. `scope` carries materialized parameter
+    values for weight-editing passes (pass.h:34 Apply contract)."""
+
+    name = None
+
+    def apply(self, program, scope=None):
+        raise NotImplementedError
+
+    def __call__(self, program, scope=None):
+        return self.apply(program, scope)
+
+
+_REGISTRY = {}
+
+
+def register_pass(name):
+    """Decorator registering a Pass subclass (or a plain
+    `fn(program, scope)` function) under `name` — REGISTER_PASS parity.
+    Duplicate names raise (matching the op registry's convention);
+    `unregister_pass` frees a name deliberately."""
+    def deco(obj):
+        if name in _REGISTRY:
+            raise ValueError(
+                "pass %r already registered; unregister_pass(%r) first "
+                "to replace it deliberately" % (name, name))
+        if isinstance(obj, type) and issubclass(obj, Pass):
+            inst = obj()
+            inst.name = name
+        else:
+            fn = obj
+
+            class _FnPass(Pass):
+                def apply(self, program, scope=None):
+                    return fn(program, scope)
+
+            inst = _FnPass()
+            inst.name = name
+        _REGISTRY[name] = inst
+        return obj
+
+    return deco
+
+
+def unregister_pass(name):
+    """Remove a registered pass (tests / deliberate replacement)."""
+    _REGISTRY.pop(name, None)
+
+
+def get_pass(name):
+    if name not in _REGISTRY:
+        raise KeyError("no pass registered under %r (have: %s)"
+                       % (name, sorted(_REGISTRY)))
+    return _REGISTRY[name]
+
+
+def registered_passes():
+    return sorted(_REGISTRY)
+
+
+def apply_passes(program, names, scope=None):
+    """Run the named passes in order over `program` (PassBuilder parity)."""
+    scope = scope if scope is not None else global_scope()
+    for name in names:
+        get_pass(name).apply(program, scope)
+    return program
+
+
+# ---------------------------------------------------------------------------
+# op-chain pattern matching (graph_pattern_detector.h:254, the linear case)
+# ---------------------------------------------------------------------------
+
+
+def _consumers(block):
+    cons = {}
+    for op in block.ops:
+        for n in op.input_names():
+            cons.setdefault(n, []).append(op)
+    return cons
+
+
+def match_chain(block, types, single_consumer=True):
+    """Yield op lists [o1, ..., ok] with o1.type..ok.type == types, where
+    each o_{j+1} consumes an output var of o_j (dataflow adjacency, not
+    list adjacency). With single_consumer (the safe default for rewrites),
+    every linking var must have exactly one consuming op.
+
+    Matches are yielded in program order and never share an op. The op
+    list and consumer map are SNAPSHOTTED when iteration starts: a
+    handler may freely remove the yielded chain's own ops, but ops it
+    inserts (and consumer-count changes it causes) are only seen by a
+    fresh match_chain call — run the pass to a fixed point if rewrites
+    enable further matches."""
+    cons = _consumers(block)
+    order = {id(op): i for i, op in enumerate(block.ops)}
+    claimed = set()
+    for op in list(block.ops):
+        if op.type != types[0] or id(op) in claimed:
+            continue
+        chain = [op]
+        ok = True
+        for want in types[1:]:
+            cur = chain[-1]
+            nxt = None
+            for out_name in cur.output_names():
+                users = [u for u in cons.get(out_name, [])
+                         if id(u) in order]
+                if single_consumer and len(users) != 1:
+                    continue
+                for u in users:
+                    if (u.type == want and id(u) not in claimed
+                            and order[id(u)] > order[id(cur)]):
+                        nxt = u
+                        break
+                if nxt is not None:
+                    break
+            if nxt is None:
+                ok = False
+                break
+            chain.append(nxt)
+        if ok:
+            claimed.update(id(o) for o in chain)
+            yield chain
+
+
+# ---------------------------------------------------------------------------
+# built-in passes (the transpilers delegate here)
+# ---------------------------------------------------------------------------
+
+
+@register_pass("conv_bn_fold")
+class ConvBNFoldPass(Pass):
+    """Fold batch_norm into the preceding conv2d's weights — the algebraic
+    inference fold (inference_transpiler.py _fuse_bn). Patterns:
+    conv2d -> batch_norm and conv2d -> elementwise_add(bias) ->
+    batch_norm. Needs materialized params in `scope` (run startup first);
+    unmaterialized matches are skipped, not erred."""
+
+    def apply(self, program, scope=None):
+        from .transpiler.inference_transpiler import _fold_bn_weights
+
+        scope = scope if scope is not None else global_scope()
+        block = program.global_block()
+        changed = False
+        for conv, add, bn in match_chain(
+                block, ("conv2d", "elementwise_add", "batch_norm")):
+            if _fold_bn_weights(conv, bn, scope, add.input_names("Y")[0]):
+                add.outputs["Out"] = bn.outputs["Y"]
+                block.ops.remove(bn)
+                changed = True
+        for conv, bn in match_chain(block, ("conv2d", "batch_norm")):
+            if _fold_bn_weights(conv, bn, scope, None):
+                conv.outputs["Output"] = bn.outputs["Y"]
+                block.ops.remove(bn)
+                changed = True
+        if changed:
+            program._bump_version()
+        return program
+
+
+@register_pass("dropout_remove")
+class DropoutRemovePass(Pass):
+    """Remove inference-identity dropout ops, rewiring consumers; the
+    downgrade_in_infer variant becomes a scale op
+    (inference_transpiler.py _fuse_relu_dropout parity)."""
+
+    def apply(self, program, scope=None):
+        from .framework import Operator
+
+        block = program.global_block()
+        new_ops = []
+        rename = {}
+        changed = False
+        for op in block.ops:
+            if op.type == "dropout":
+                changed = True
+                src = op.inputs["X"][0]
+                src = rename.get(src.name, src)  # chained dropouts
+                impl = op.attrs.get("dropout_implementation",
+                                    "downgrade_in_infer")
+                if impl == "upscale_in_train":
+                    for outv in op.outputs.get("Out", []):
+                        rename[outv.name] = src
+                    continue
+                p = op.attrs.get("dropout_prob", 0.5)
+                new_ops.append(Operator(
+                    block, "scale", inputs={"X": [src]},
+                    outputs={"Out": [op.outputs["Out"][0]]},
+                    attrs={"scale": 1.0 - p}))
+                continue
+            for slot, vs in op.inputs.items():
+                op.inputs[slot] = [rename.get(v.name, v) for v in vs]
+            new_ops.append(op)
+        block.ops = new_ops
+        if changed:
+            program._bump_version()
+        return program
+
+
+@register_pass("memory_optimize")
+def _memory_optimize_pass(program, scope):
+    """Lifetime analysis + reuse-plan annotation
+    (memory_optimization_transpiler.memory_optimize as a registered
+    pass; XLA performs the actual buffer aliasing)."""
+    from .transpiler.memory_optimization_transpiler import memory_optimize
+
+    memory_optimize(program)
+    return program

@@ -136,12 +136,22 @@ def _fusion_seqconv_eltadd_relu(ctx, ins, attrs):
               **({"SeqLen": ins["SeqLen"]} if ins.get("SeqLen") else {})},
         attrs)["Out"][0]
     out = jax.nn.relu(conv + ins["Bias"][0].reshape(-1))
-    # ColMat = the unfolded im2col matrix; emit flattened conv input
-    # windows only as a shape-faithful intermediate
-    return {"Out": [out],
-            "ColMat": [jnp.zeros(
-                (out.shape[0] * out.shape[1],
-                 ins["Filter"][0].shape[0]), out.dtype)]}
+    # ColMat: the REAL im2col matrix [B*T, ctx_len*D] — context windows
+    # unfolded the same way sequence_conv consumes them (zero-padded at
+    # sequence edges)
+    x = ins["X"][0]
+    ctx_len = attrs.get("contextLength", 3)
+    ctx_start = attrs.get("contextStart", -(ctx_len // 2))
+    B, T, D = x.shape
+    cols = []
+    for i in range(ctx_len):
+        off = ctx_start + i
+        shifted = jnp.roll(x, -off, axis=1)
+        t_idx = jnp.arange(T)
+        valid = (t_idx + off >= 0) & (t_idx + off < T)
+        cols.append(jnp.where(valid[None, :, None], shifted, 0))
+    colmat = jnp.concatenate(cols, axis=-1).reshape(B * T, ctx_len * D)
+    return {"Out": [out], "ColMat": [colmat]}
 
 
 @register("fusion_seqexpand_concat_fc")

@@ -5,11 +5,16 @@ drop dropout ops for inference programs.
 XLA fuses elementwise chains on its own, so the payoff here is the
 *algebraic* fold — removing the BN op entirely and baking
 scale/sqrt(var+eps) into the conv filter, exactly what the reference's
-_fuse_bn does by editing weights in the scope."""
+_fuse_bn does by editing weights in the scope.
+
+Since round 4 the transforms live as REGISTERED PASSES (paddle_tpu.ir —
+pass.h:34 / graph_pattern_detector.h:254 parity): `conv_bn_fold` and
+`dropout_remove`. This class is the stable facade; user passes compose
+with the builtins through fluid.ir.apply_passes.
+"""
 
 import numpy as np
 
-from .. import framework
 from ..core.scope import global_scope
 
 __all__ = ["InferenceTranspiler"]
@@ -17,122 +22,44 @@ __all__ = ["InferenceTranspiler"]
 
 class InferenceTranspiler:
     def transpile(self, program, place=None, scope=None):
+        from .. import ir
+
         scope = scope or global_scope()
-        self._fuse_bn(program, scope)
-        self._remove_dropout(program)
+        ir.apply_passes(program, ["conv_bn_fold", "dropout_remove"], scope)
         return program
 
-    # -- conv2d + batch_norm -> conv2d with folded weights ----------------
 
-    def _fuse_bn(self, program, scope):
-        """Patterns: conv2d → bn, and conv2d → elementwise_add(bias) → bn
-        (the frontend emits conv bias as a separate add)."""
-        block = program.global_block()
-        ops = block.ops
-        consumers = {}
-        for op in ops:
-            for n in op.input_names():
-                consumers[n] = consumers.get(n, 0) + 1
-        new_ops = []
-        i = 0
-        while i < len(ops):
-            op = ops[i]
-            nxt = ops[i + 1] if i + 1 < len(ops) else None
-            nxt2 = ops[i + 2] if i + 2 < len(ops) else None
-            if (op.type == "conv2d" and nxt is not None
-                    and op.output_names("Output")
-                    and consumers.get(op.output_names("Output")[0], 0) == 1):
-                out0 = op.output_names("Output")
-                if (nxt.type == "batch_norm"
-                        and nxt.input_names("X") == out0
-                        and self._fold_weights(op, nxt, scope, None)):
-                    op.outputs["Output"] = nxt.outputs["Y"]
-                    new_ops.append(op)
-                    i += 2
-                    continue
-                if (nxt.type == "elementwise_add" and nxt2 is not None
-                        and nxt2.type == "batch_norm"
-                        and nxt.input_names("X") == out0
-                        and nxt2.input_names("X") == nxt.output_names("Out")
-                        and consumers.get(nxt.output_names("Out")[0], 0) == 1
-                        and self._fold_weights(
-                            op, nxt2, scope,
-                            nxt.input_names("Y")[0])):
-                    # bias add survives (with rescaled bias); bn vanishes
-                    nxt.outputs["Out"] = nxt2.outputs["Y"]
-                    new_ops.extend([op, nxt])
-                    i += 3
-                    continue
-            new_ops.append(op)
-            i += 1
-        block.ops = new_ops
-        program._bump_version()  # invalidate executor program cache
-
-    @staticmethod
-    def _fold_weights(conv_op, bn_op, scope, conv_bias_name):
-        """W' = W * gamma/std per out-channel. The per-channel shift
-        beta - mean*gamma/std merges into the conv bias when one exists
-        (conv_bias_name, which is also rescaled), else it becomes a
-        synthesized FoldedBias input the conv kernel adds post-conv."""
-        w_name = conv_op.input_names("Filter")[0]
-        scale_n = bn_op.input_names("Scale")[0]
-        bias_n = bn_op.input_names("Bias")[0]
-        mean_n = bn_op.input_names("Mean")[0]
-        var_n = bn_op.input_names("Variance")[0]
-        vals = [scope.get(n) for n in (w_name, scale_n, bias_n, mean_n, var_n)]
-        if any(v is None for v in vals):
-            return False  # params not materialized yet (startup not run)
-        b = None
-        if conv_bias_name is not None:
-            b = scope.get(conv_bias_name)
-            if b is None:
-                return False  # validate BEFORE mutating any weights
-        w, gamma, beta, mean, var = [np.asarray(v) for v in vals]
-        eps = bn_op.attrs.get("epsilon", 1e-5)
-        factor = gamma / np.sqrt(var + eps)
-        scope.set(w_name, w * factor.reshape((-1, 1, 1, 1)).astype(w.dtype))
-        shift = (beta - mean * factor).astype(w.dtype)
-        if conv_bias_name is not None:
-            scope.set(conv_bias_name,
-                      np.asarray(b) * factor.astype(w.dtype) + shift)
-        else:
-            block = conv_op.block
-            bias_name = w_name + ".bn_folded_bias"
-            bvar = block.create_var(name=bias_name, shape=(shift.shape[0],),
-                                    dtype=str(shift.dtype), persistable=True)
-            scope.set(bias_name, shift)
-            conv_op.inputs["FoldedBias"] = [bvar]
-        return True
-
-    # -- dropout removal --------------------------------------------------
-
-    def _remove_dropout(self, program):
-        """upscale_in_train dropout is identity at inference → removed;
-        downgrade_in_infer scales by (1-p) → replaced by a scale op
-        (inference_transpiler.py _fuse_relu_dropout parity)."""
-        from ..framework import Operator
-
-        block = program.global_block()
-        new_ops = []
-        rename = {}
-        for op in block.ops:
-            if op.type == "dropout":
-                src = op.inputs["X"][0]
-                src = rename.get(src.name, src)  # chained dropouts
-                impl = op.attrs.get("dropout_implementation",
-                                    "downgrade_in_infer")
-                if impl == "upscale_in_train":
-                    for outv in op.outputs.get("Out", []):
-                        rename[outv.name] = src
-                    continue
-                p = op.attrs.get("dropout_prob", 0.5)
-                new_ops.append(Operator(
-                    block, "scale", inputs={"X": [src]},
-                    outputs={"Out": [op.outputs["Out"][0]]},
-                    attrs={"scale": 1.0 - p}))
-                continue
-            for slot, vs in op.inputs.items():
-                op.inputs[slot] = [rename.get(v.name, v) for v in vs]
-            new_ops.append(op)
-        block.ops = new_ops
-        program._bump_version()
+def _fold_bn_weights(conv_op, bn_op, scope, conv_bias_name):
+    """W' = W * gamma/std per out-channel. The per-channel shift
+    beta - mean*gamma/std merges into the conv bias when one exists
+    (conv_bias_name, which is also rescaled), else it becomes a
+    synthesized FoldedBias input the conv kernel adds post-conv."""
+    w_name = conv_op.input_names("Filter")[0]
+    scale_n = bn_op.input_names("Scale")[0]
+    bias_n = bn_op.input_names("Bias")[0]
+    mean_n = bn_op.input_names("Mean")[0]
+    var_n = bn_op.input_names("Variance")[0]
+    vals = [scope.get(n) for n in (w_name, scale_n, bias_n, mean_n, var_n)]
+    if any(v is None for v in vals):
+        return False  # params not materialized yet (startup not run)
+    b = None
+    if conv_bias_name is not None:
+        b = scope.get(conv_bias_name)
+        if b is None:
+            return False  # validate BEFORE mutating any weights
+    w, gamma, beta, mean, var = [np.asarray(v) for v in vals]
+    eps = bn_op.attrs.get("epsilon", 1e-5)
+    factor = gamma / np.sqrt(var + eps)
+    scope.set(w_name, w * factor.reshape((-1, 1, 1, 1)).astype(w.dtype))
+    shift = (beta - mean * factor).astype(w.dtype)
+    if conv_bias_name is not None:
+        scope.set(conv_bias_name,
+                  np.asarray(b) * factor.astype(w.dtype) + shift)
+    else:
+        block = conv_op.block
+        bias_name = w_name + ".bn_folded_bias"
+        bvar = block.create_var(name=bias_name, shape=(shift.shape[0],),
+                                dtype=str(shift.dtype), persistable=True)
+        scope.set(bias_name, shift)
+        conv_op.inputs["FoldedBias"] = [bvar]
+    return True

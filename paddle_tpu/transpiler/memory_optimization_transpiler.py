@@ -58,7 +58,8 @@ class ControlFlowGraph:
 def memory_optimize(input_program, skip_opt_set=None, print_log=False,
                     level=0, skip_grads=False):
     """Attach the reuse plan to the program (XLA performs the actual buffer
-    aliasing; donation hints come from this annotation)."""
+    aliasing; donation hints come from this annotation). Also registered
+    as the `memory_optimize` pass in paddle_tpu.ir."""
     skip = set(skip_opt_set or ())
     cfg = ControlFlowGraph(input_program)
     if skip_grads:

@@ -98,12 +98,18 @@ def test_fusion_seqconv_eltadd_relu():
     w = jnp.asarray(rng.randn(ctx_len * 3, 5).astype(np.float32))
     b = jnp.asarray(rng.randn(5).astype(np.float32))
     attrs = {"contextLength": ctx_len, "contextStart": -1}
-    got = get("fusion_seqconv_eltadd_relu").impl(
-        _ctx(), {"X": [x], "Filter": [w], "Bias": [b]}, attrs)["Out"][0]
+    res = get("fusion_seqconv_eltadd_relu").impl(
+        _ctx(), {"X": [x], "Filter": [w], "Bias": [b]}, attrs)
+    got = res["Out"][0]
     ref = get("sequence_conv").impl(
         _ctx(), {"X": [x], "Filter": [w]}, attrs)["Out"][0]
     ref = jax.nn.relu(ref + b)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5)
+    # ColMat is the REAL im2col matrix: ColMat @ W + b, relu'd == Out
+    colmat = res["ColMat"][0]
+    via_col = jax.nn.relu((colmat @ w).reshape(2, 6, 5) + b)
+    np.testing.assert_allclose(np.asarray(via_col), np.asarray(got),
+                               rtol=1e-5)
 
 
 def test_fusion_seqexpand_concat_fc():
