@@ -167,6 +167,12 @@ void* ptpu_recordio_writer_open(const char* path, uint64_t max_chunk_records,
 
 int ptpu_recordio_writer_write(void* wp, const char* data, uint64_t len) {
   Writer* w = static_cast<Writer*>(wp);
+  // never produce a chunk the scanner's corruption bound would reject
+  if (len + 4 >= kMaxChunkBytes) return -1;
+  if (w->payload.size() + len + 4 >= kMaxChunkBytes) {
+    int rc = w->flush_chunk();
+    if (rc != 0) return rc;
+  }
   uint32_t len32 = static_cast<uint32_t>(len);
   w->payload.append(reinterpret_cast<const char*>(&len32), 4);
   w->payload.append(data, len);

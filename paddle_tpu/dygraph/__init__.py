@@ -8,12 +8,14 @@ from .layers import Layer
 from . import nn
 from .nn import *  # noqa: F401,F403
 from .checkpoint import save_dygraph, load_dygraph  # noqa: F401
+from .jit import TracedLayer  # noqa: F401
 from .tracer import Tracer  # noqa: F401
 from . import parallel  # noqa: F401
 from .parallel import (Env, ParallelEnv, prepare_context,  # noqa: F401
                        DataParallel)
 
 __all__ = ["guard", "to_variable", "no_grad", "enabled", "Layer",
+           "TracedLayer",
            "save_dygraph", "load_dygraph", "enable_dygraph",
            "disable_dygraph", "Env", "ParallelEnv", "prepare_context",
            "DataParallel"] + nn.__all__

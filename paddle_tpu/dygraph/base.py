@@ -37,6 +37,10 @@ class Tracer:
         self._op_counter = 0
         self._key = jax.random.PRNGKey(0)
         self.is_test = False
+        # TracedLayer program capture (dygraph/jit.py): when a list, EVERY
+        # traced op is appended — including stop-gradient ones the autograd
+        # tape skips — so the captured Program is the full forward
+        self.capture = None
 
     def ctx(self):
         self._op_counter += 1
@@ -68,6 +72,8 @@ class Tracer:
         if self.tape.recording and not stop:
             self.tape.entries.append(
                 (op_type, dict(ins), dict(attrs), vouts, ctx))
+        if self.capture is not None:
+            self.capture.append((op_type, dict(ins), dict(attrs), vouts))
         return vouts
 
 
