@@ -70,15 +70,25 @@ struct Writer {
                     reinterpret_cast<const Bytef*>(payload.data()), raw,
                     Z_DEFAULT_COMPRESSION) != Z_OK)
         return -1;
-      uint32_t magic = kChunkMagicZ;
-      uint64_t cbytes = comp_cap;
-      if (fwrite(&magic, 4, 1, f) != 1) return -1;
-      if (fwrite(&num_records, 4, 1, f) != 1) return -1;
-      if (fwrite(&raw, 8, 1, f) != 1) return -1;
-      if (fwrite(&cbytes, 8, 1, f) != 1) return -1;
-      if (fwrite(&crc, 4, 1, f) != 1) return -1;
-      if (cbytes && fwrite(comp.data(), 1, cbytes, f) != cbytes) return -1;
-    } else {
+      // incompressible data can exceed the scanner's corruption bound
+      // (stored-block overhead) — fall through to a plain chunk then;
+      // the scanner handles mixed chunk kinds per-magic
+      if (static_cast<uint64_t>(comp_cap) < kMaxChunkBytes) {
+        uint32_t magic = kChunkMagicZ;
+        uint64_t cbytes = comp_cap;
+        if (fwrite(&magic, 4, 1, f) != 1) return -1;
+        if (fwrite(&num_records, 4, 1, f) != 1) return -1;
+        if (fwrite(&raw, 8, 1, f) != 1) return -1;
+        if (fwrite(&cbytes, 8, 1, f) != 1) return -1;
+        if (fwrite(&crc, 4, 1, f) != 1) return -1;
+        if (cbytes && fwrite(comp.data(), 1, cbytes, f) != cbytes)
+          return -1;
+        payload.clear();
+        num_records = 0;
+        return 0;
+      }
+    }
+    {
       uint32_t magic = kChunkMagic;
       if (fwrite(&magic, 4, 1, f) != 1) return -1;
       if (fwrite(&num_records, 4, 1, f) != 1) return -1;

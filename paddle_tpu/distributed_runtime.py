@@ -93,13 +93,27 @@ def _tensor_from(payload):
 
 class ParameterServerClient:
     """One persistent connection per endpoint, thread-safe per instance
-    (each trainer process owns one)."""
+    (each trainer process owns one).
 
-    def __init__(self, trainer_id=0, timeout=120.0):
+    Fault tolerance (grpc_client.h:181-199 deadline/retry parity): every
+    RPC retries through reconnection with exponential backoff, bounded by
+    FLAGS_rpc_retry_times attempts and the FLAGS_rpc_deadline wall clock.
+    GET/FETCH_BARRIER are naturally idempotent; SEND (async mode applies
+    it immediately) and SEND_BARRIER/COMPLETE are made exactly-once by a
+    per-trainer sequence number — a retry of an already-processed request
+    replays the server's cached reply instead of re-executing."""
+
+    def __init__(self, trainer_id=0, timeout=None, retry_times=None):
+        from .flags import flag
+
         self.trainer_id = trainer_id
-        self.timeout = timeout
+        self.timeout = (timeout if timeout is not None
+                        else float(flag("rpc_deadline")))
+        self.retry_times = (retry_times if retry_times is not None
+                            else int(flag("rpc_retry_times")))
         self._socks = {}
         self._lock = threading.Lock()
+        self._seq = 0
 
     def _sock(self, endpoint):
         s = self._socks.get(endpoint)
@@ -111,21 +125,61 @@ class ParameterServerClient:
             self._socks[endpoint] = s
         return s
 
-    # the server tolerates stragglers for up to 300 s before failing a
-    # sync barrier (_ServerState.on_send_barrier); the client must wait
-    # longer than that so the grace period actually applies
-    BARRIER_TIMEOUT = 330.0
+    def _drop_sock(self, endpoint):
+        s = self._socks.pop(endpoint, None)
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _barrier_timeout(self):
+        # the server tolerates stragglers for FLAGS_rpc_barrier_grace
+        # before failing a sync barrier; the client must wait longer so
+        # the grace period actually applies
+        from .flags import flag
+
+        return float(flag("rpc_barrier_grace")) + 30.0
 
     def _rpc(self, endpoint, mtype, meta, payload=b"", timeout=None):
-        with self._lock:
-            s = self._sock(endpoint)
-            s.settimeout(timeout if timeout is not None else self.timeout)
-            _write_msg(s, mtype, meta, payload)
-            rtype, rmeta, rpayload = _read_msg(s)
-        if rtype == MSG_ERR:
-            raise RuntimeError("pserver %s: %s" % (endpoint,
-                                                   rmeta.get("error")))
-        return rtype, rmeta, rpayload
+        import time
+
+        if mtype in (MSG_SEND, MSG_SEND_BARRIER, MSG_COMPLETE):
+            # one seq per LOGICAL call; identical across retries so the
+            # server's exactly-once cache can recognize a resend
+            with self._lock:
+                self._seq += 1
+                meta = dict(meta, seq=self._seq)
+        deadline = time.monotonic() + (timeout if timeout is not None
+                                       else self.timeout)
+        attempts = max(1, self.retry_times + 1)
+        last_err = None
+        for attempt in range(attempts):
+            try:
+                with self._lock:
+                    s = self._sock(endpoint)
+                    s.settimeout(max(0.05, deadline - time.monotonic()))
+                    _write_msg(s, mtype, meta, payload)
+                    rtype, rmeta, rpayload = _read_msg(s)
+                if rtype == MSG_ERR:
+                    # an application error from a live server — retrying
+                    # cannot help, surface it
+                    raise RuntimeError(
+                        "pserver %s: %s" % (endpoint, rmeta.get("error")))
+                return rtype, rmeta, rpayload
+            except (ConnectionError, socket.timeout, OSError) as e:
+                last_err = e
+                self._drop_sock(endpoint)
+                remaining = deadline - time.monotonic()
+                if attempt + 1 >= attempts or remaining <= 0:
+                    break
+                time.sleep(min(0.2 * (2 ** attempt), 2.0, remaining))
+        raise ConnectionError(
+            "pserver %s unreachable after %d attempt(s) within the "
+            "%.0fs FLAGS_rpc_deadline: %r — if the server crashed, "
+            "restart it (restoring its params from the last checkpoint) "
+            "and the client will reconnect"
+            % (endpoint, attempts, self.timeout, last_err))
 
     def send_var(self, endpoint, name, value):
         value = np.ascontiguousarray(value)
@@ -138,7 +192,7 @@ class ParameterServerClient:
         optimizer sub-blocks (RunSyncLoop's kRequestSend barrier)."""
         self._rpc(endpoint, MSG_SEND_BARRIER,
                   {"trainer_id": self.trainer_id},
-                  timeout=self.BARRIER_TIMEOUT)
+                  timeout=self._barrier_timeout())
 
     def get_var(self, endpoint, name):
         _, meta, payload = self._rpc(endpoint, MSG_GET,
@@ -203,6 +257,27 @@ class _ServerState:
         self.completed = set()    # trainers done for good (MSG_COMPLETE)
         self.round_id = 0
         self.stopping = False
+        # exactly-once cache: trainer_id -> (seq, cached reply) for the
+        # non-idempotent messages (async SEND applies immediately; a
+        # barrier retry after a lost reply must NOT set-add into the NEXT
+        # round, which would fire an update missing this trainer's grads)
+        self._last_reply = {}
+
+    def seen(self, trainer_id, seq):
+        """Cached reply if (trainer_id, seq) was already processed."""
+        if seq is None:
+            return None
+        with self.cv:
+            last = self._last_reply.get(trainer_id)
+            if last is not None and last[0] == seq:
+                return last[1]
+        return None
+
+    def remember(self, trainer_id, seq, reply):
+        if seq is None:
+            return
+        with self.cv:
+            self._last_reply[trainer_id] = (seq, reply)
 
     def live_fanin(self):
         return max(1, self.fanin - len(self.completed))
@@ -241,6 +316,8 @@ class _ServerState:
         if not self.sync_mode:
             return True
         with self.cv:
+            from .flags import flag
+
             my_round = self.round_id
             self.barrier_set.add(trainer_id)
             self._maybe_fire_round()
@@ -248,7 +325,7 @@ class _ServerState:
                 return True
             return self.cv.wait_for(
                 lambda: self.round_id != my_round or self.stopping,
-                timeout=300.0)
+                timeout=float(flag("rpc_barrier_grace")))
 
     def on_fetch_barrier(self, trainer_id):
         if not self.sync_mode:
@@ -278,21 +355,29 @@ class _Handler(socketserver.BaseRequestHandler):
             except (ConnectionError, OSError):
                 return
             try:
+                tid = meta.get("trainer_id", 0)
+                seq = meta.get("seq")
+                if mtype in (MSG_SEND, MSG_SEND_BARRIER, MSG_COMPLETE):
+                    cached = server.state.seen(tid, seq)
+                    if cached is not None:
+                        _write_msg(self.request, cached[0], cached[1])
+                        continue
                 if mtype == MSG_SEND:
-                    server.state.on_send(meta["name"],
-                                         meta.get("trainer_id", 0),
+                    server.state.on_send(meta["name"], tid,
                                          _tensor_from(payload))
+                    server.state.remember(tid, seq, (MSG_OK, {}))
                     _write_msg(self.request, MSG_OK, {})
                 elif mtype == MSG_SEND_BARRIER:
-                    ok = server.state.on_send_barrier(
-                        meta.get("trainer_id", 0))
+                    ok = server.state.on_send_barrier(tid)
                     if ok:
+                        server.state.remember(tid, seq, (MSG_OK, {}))
                         _write_msg(self.request, MSG_OK, {})
                     else:
-                        _write_msg(self.request, MSG_ERR, {
-                            "error": "send_barrier timed out waiting for "
-                                     "peer trainers (lost trainer with no "
-                                     "completion notify?)"})
+                        err = {"error": "send_barrier timed out waiting "
+                                        "for peer trainers (lost trainer "
+                                        "with no completion notify?)"}
+                        server.state.remember(tid, seq, (MSG_ERR, err))
+                        _write_msg(self.request, MSG_ERR, err)
                 elif mtype == MSG_GET:
                     val = server.scope_get(meta["name"])
                     m, framed = _tensor_payload(meta["name"],
@@ -302,8 +387,8 @@ class _Handler(socketserver.BaseRequestHandler):
                     server.state.on_fetch_barrier(meta.get("trainer_id", 0))
                     _write_msg(self.request, MSG_OK, {})
                 elif mtype == MSG_COMPLETE:
-                    all_done = server.state.on_complete(
-                        meta.get("trainer_id", 0))
+                    all_done = server.state.on_complete(tid)
+                    server.state.remember(tid, seq, (MSG_OK, {}))
                     _write_msg(self.request, MSG_OK, {})
                     if all_done:
                         threading.Thread(target=server.shutdown,
@@ -342,7 +427,16 @@ def run_pserver(program, scope, endpoint, executor_place=None):
     optimize sub-blocks run through the op registry against `scope`
     (startup-program-initialized values). Called by Executor.run when it
     meets a listen_and_serv op — the reference's blocking
-    ListenAndServOp::RunImpl."""
+    ListenAndServOp::RunImpl.
+
+    Fault tolerance: when PADDLE_PSERVER_CKPT_DIR is set, the server
+    (a) restores its scope from the newest checkpoint there on startup —
+    so a crashed pserver restarts where it left off and retrying clients
+    reconnect seamlessly — and (b) atomically checkpoints the scope after
+    every PADDLE_PSERVER_CKPT_EVERY optimizer rounds (default 1), under
+    the same lock the optimizer holds, so snapshots are never torn
+    (checkpoint_notify / SURVEY §5.3 parity)."""
+    import os
     lsv = next(op for op in program.global_block().ops
                if op.type == "listen_and_serv")
     fanin = int(lsv.attrs.get("Fanin", 1))
@@ -394,6 +488,59 @@ def run_pserver(program, scope, endpoint, executor_place=None):
                         for v in vs:
                             if v.name in env:
                                 scope.set(v.name, np.asarray(env[v.name]))
+            if not ckpt_dir:
+                return
+            if sync_mode:
+                _rounds[0] += 1
+                if _rounds[0] % ckpt_every == 0:
+                    _save_checkpoint()
+            else:
+                # async mode has no rounds and apply_update runs per grad
+                # MESSAGE — a per-message full-scope save would serialize
+                # the hot path; rate-limit by wall clock instead
+                import time
+
+                now = time.monotonic()
+                if now - _last_ckpt[0] >= ckpt_secs:
+                    _last_ckpt[0] = now
+                    _save_checkpoint()
+
+    # ---- crash/restart support (SURVEY §5.3) -------------------------
+    ckpt_dir = os.environ.get("PADDLE_PSERVER_CKPT_DIR")
+    ckpt_every = max(1, int(os.environ.get("PADDLE_PSERVER_CKPT_EVERY",
+                                           "1")))
+    ckpt_secs = float(os.environ.get("PADDLE_PSERVER_CKPT_SECS", "5"))
+    _rounds = [0]
+    _last_ckpt = [0.0]
+
+    def _ckpt_path():
+        safe = endpoint.replace(":", "_").replace("/", "_")
+        return os.path.join(ckpt_dir, "pserver_%s.npz" % safe)
+
+    def _save_checkpoint():
+        """Holding `lock`: atomic scope snapshot (write + rename)."""
+        path = _ckpt_path()
+        tmp = path + ".tmp"
+        arrays = {}
+        for name in scope.local_var_names():
+            val = scope.get(name)
+            if val is None or name.startswith("__"):
+                continue
+            try:
+                arrays[name] = np.asarray(val)
+            except (TypeError, ValueError):
+                continue
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, path)
+
+    if ckpt_dir:
+        os.makedirs(ckpt_dir, exist_ok=True)
+        path = _ckpt_path()
+        if os.path.exists(path):
+            with np.load(path) as data:
+                for name in data.files:
+                    scope.set(name, data[name])
 
     host, port = endpoint.rsplit(":", 1)
     srv = _PServer((host, int(port)), _Handler)

@@ -22,6 +22,17 @@ _FLAGS = {
     "check_nan_inf": False,
     "cpu_deterministic": True,
     "eager_delete_tensor_gb": 0.0,
+    # pserver RPC robustness (grpc_client.h:181-199 parity):
+    #   rpc_deadline     — seconds one RPC (incl. reconnect attempts) may
+    #                      take before failing loudly (FLAGS_rpc_deadline
+    #                      is ms in the reference; seconds here)
+    #   rpc_retry_times  — reconnect+resend attempts per RPC
+    #                      (FLAGS_rpc_retry_times)
+    #   rpc_barrier_grace — how long the server waits on stragglers at a
+    #                      sync barrier before erring the round
+    "rpc_deadline": 120.0,
+    "rpc_retry_times": 3,
+    "rpc_barrier_grace": 300.0,
 }
 
 _ENV_ALLOWLIST = {
@@ -30,6 +41,9 @@ _ENV_ALLOWLIST = {
     "FLAGS_cpu_deterministic": ("cpu_deterministic", lambda s: s not in
                                 ("0", "false", "False", "")),
     "FLAGS_eager_delete_tensor_gb": ("eager_delete_tensor_gb", float),
+    "FLAGS_rpc_deadline": ("rpc_deadline", float),
+    "FLAGS_rpc_retry_times": ("rpc_retry_times", int),
+    "FLAGS_rpc_barrier_grace": ("rpc_barrier_grace", float),
 }
 
 

@@ -76,18 +76,31 @@ def main():
         return
 
     if role == "TRAINER":
+        # fault-injection knobs (tests/test_pserver_runtime.py):
+        #   PADDLE_STEP_DELAY      — sleep between steps so the parent can
+        #                            kill/restart a pserver mid-training
+        #   PADDLE_DIE_AFTER_STEP  — crash (os._exit, no complete()) after
+        #                            step N, simulating a lost trainer
+        import time
+
+        delay = float(os.environ.get("PADDLE_STEP_DELAY", "0") or 0)
+        die_after = int(os.environ.get("PADDLE_DIE_AFTER_STEP", "0") or 0)
         t = fluid.DistributeTranspiler()
         t.transpile(trainer_id=tid, pservers=eplist, trainers=trainers,
                     sync_mode=True)
         prog = t.get_trainer_program()
         exe.run(fluid.default_startup_program())
         shard = GLOBAL_BATCH // trainers
-        for xb, yb in batches():
+        for step, (xb, yb) in enumerate(batches()):
             xs = xb[tid * shard:(tid + 1) * shard]
             ys = yb[tid * shard:(tid + 1) * shard]
             l, = exe.run(prog, feed={"x": xs, "y": ys}, fetch_list=[loss])
             print("loss:%.8f" % float(np.asarray(l).ravel()[0]),
                   flush=True)
+            if die_after and step + 1 >= die_after:
+                os._exit(17)  # crash: no Executor.close / MSG_COMPLETE
+            if delay:
+                time.sleep(delay)
         exe.close()
         return
 

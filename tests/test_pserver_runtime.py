@@ -160,3 +160,110 @@ def test_async_mode_pserver_in_process():
         exe.close()
         shutdown_pservers([ep])
         server.join(timeout=10)
+
+
+def test_pserver_crash_restart_with_checkpoint():
+    """Kill the pserver mid-training (SIGKILL), restart it restoring from
+    its round checkpoints: the trainer's RPC retry/reconnect
+    (FLAGS_rpc_deadline / FLAGS_rpc_retry_times, grpc_client.h:181-199
+    parity) rides out the outage and training completes with a decreasing
+    loss tail."""
+    import signal
+
+    ep = "127.0.0.1:%d" % _free_port()
+    import tempfile
+
+    ckpt = tempfile.mkdtemp()
+
+    def start_pserver():
+        p = subprocess.Popen(
+            [sys.executable, _WORKER],
+            env=_clean_env(PADDLE_TRAINING_ROLE="PSERVER",
+                           PADDLE_PSERVER_ENDPOINTS=ep,
+                           PADDLE_CURRENT_ENDPOINT=ep,
+                           PADDLE_TRAINERS_NUM="1",
+                           PADDLE_PSERVER_CKPT_DIR=ckpt),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        line = p.stdout.readline()
+        assert "pserver_ready" in line, line
+        return p
+
+    ps = start_pserver()
+    trainer = subprocess.Popen(
+        [sys.executable, _WORKER],
+        env=_clean_env(PADDLE_TRAINING_ROLE="TRAINER",
+                       PADDLE_PSERVER_ENDPOINTS=ep,
+                       PADDLE_TRAINER_ID="0",
+                       PADDLE_TRAINERS_NUM="1",
+                       PADDLE_STEP_DELAY="0.5",
+                       FLAGS_rpc_deadline="30",
+                       FLAGS_rpc_retry_times="10"),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        # let a few rounds land, then hard-kill the server mid-run
+        for _ in range(3):
+            line = trainer.stdout.readline()
+            assert line.startswith("loss:"), line
+        ps.send_signal(signal.SIGKILL)
+        ps.wait(timeout=30)
+        time.sleep(1.0)  # trainer hits the dead socket and starts retrying
+        ps = start_pserver()  # restores params from the checkpoint
+
+        out, err = trainer.communicate(timeout=240)
+        assert trainer.returncode == 0, err[-3000:]
+        losses = _losses("loss:" + out.split("loss:", 1)[1]
+                         if "loss:" in out else out)
+        # first 3 already read off the pipe; the rest completed post-crash
+        assert len(losses) == 5, (losses, err[-2000:])
+        assert losses[-1] < losses[0]
+    finally:
+        for p in (trainer, ps):
+            if p.poll() is None:
+                p.kill()
+
+
+def test_lost_trainer_fails_barrier_loudly():
+    """A trainer that dies without MSG_COMPLETE must surface as a LOUD
+    barrier error on the survivor within FLAGS_rpc_barrier_grace — never
+    a silent hang or silent training on stale params."""
+    ep = "127.0.0.1:%d" % _free_port()
+    ps = subprocess.Popen(
+        [sys.executable, _WORKER],
+        env=_clean_env(PADDLE_TRAINING_ROLE="PSERVER",
+                       PADDLE_PSERVER_ENDPOINTS=ep,
+                       PADDLE_CURRENT_ENDPOINT=ep,
+                       PADDLE_TRAINERS_NUM="2",
+                       FLAGS_rpc_barrier_grace="4"),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    line = ps.stdout.readline()
+    assert "pserver_ready" in line, line
+
+    def start_trainer(tid, die_after=0):
+        extra = {"PADDLE_DIE_AFTER_STEP": str(die_after)} if die_after \
+            else {}
+        return subprocess.Popen(
+            [sys.executable, _WORKER],
+            env=_clean_env(PADDLE_TRAINING_ROLE="TRAINER",
+                           PADDLE_PSERVER_ENDPOINTS=ep,
+                           PADDLE_TRAINER_ID=str(tid),
+                           PADDLE_TRAINERS_NUM="2",
+                           FLAGS_rpc_barrier_grace="4",
+                           FLAGS_rpc_deadline="20",
+                           FLAGS_rpc_retry_times="0",
+                           **extra),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+    survivor = start_trainer(0)
+    victim = start_trainer(1, die_after=2)
+    try:
+        v_out, _ = victim.communicate(timeout=120)
+        assert victim.returncode == 17  # crashed as injected
+        out, err = survivor.communicate(timeout=120)
+        assert survivor.returncode != 0, \
+            "survivor should fail loudly, got rc=0:\n" + out
+        assert "send_barrier timed out" in err or "unreachable" in err, \
+            err[-3000:]
+    finally:
+        for p in (survivor, victim, ps):
+            if p.poll() is None:
+                p.kill()
