@@ -58,6 +58,14 @@ def parse_args():
                         "a chrome trace next to the run")
     p.add_argument("--json", action="store_true",
                    help="also print one machine-readable JSON line")
+    p.add_argument("--blocking_fetch", action="store_true",
+                   help="convert the fetched loss to float EVERY step "
+                        "inside the timed loop — the reference harness's "
+                        "literal behavior. The default defers conversion "
+                        "past the timed loop (identical loss series); "
+                        "through the axon tunnel each blocking conversion "
+                        "pays a ~95 ms RTT a local PCIe host doesn't, so "
+                        "BASELINE.md reports BOTH numbers")
     return p.parse_args()
 
 
@@ -264,6 +272,8 @@ def run_static_model(args):
         else:
             out, = runner.run(feed=feed, fetch_list=[loss.name],
                               return_numpy=False)
+        if args.blocking_fetch:
+            out = np.asarray(out)  # per-step host conversion, timed
         raw.append(out)
         num_samples += batch
     np.asarray(raw[-1])  # execution is in-order: last done => all done

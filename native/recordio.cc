@@ -62,6 +62,9 @@ struct Writer {
   int flush_chunk() {
     if (num_records == 0) return 0;
     uint64_t raw = payload.size();
+    // invariant from writer_write's bound; never emit a chunk the
+    // scanner's corruption check would reject
+    if (raw >= kMaxChunkBytes) return -1;
     uint32_t crc = crc32_impl(payload.data(), raw);
     if (compressor == 1) {
       uLongf comp_cap = compressBound(raw);

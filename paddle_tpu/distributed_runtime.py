@@ -150,8 +150,8 @@ class ParameterServerClient:
             with self._lock:
                 self._seq += 1
                 meta = dict(meta, seq=self._seq)
-        deadline = time.monotonic() + (timeout if timeout is not None
-                                       else self.timeout)
+        effective = timeout if timeout is not None else self.timeout
+        deadline = time.monotonic() + effective
         attempts = max(1, self.retry_times + 1)
         last_err = None
         for attempt in range(attempts):
@@ -175,11 +175,11 @@ class ParameterServerClient:
                     break
                 time.sleep(min(0.2 * (2 ** attempt), 2.0, remaining))
         raise ConnectionError(
-            "pserver %s unreachable after %d attempt(s) within the "
-            "%.0fs FLAGS_rpc_deadline: %r — if the server crashed, "
-            "restart it (restoring its params from the last checkpoint) "
-            "and the client will reconnect"
-            % (endpoint, attempts, self.timeout, last_err))
+            "pserver %s unreachable after %d attempt(s) within this "
+            "call's %.0fs deadline: %r — if the server crashed, restart "
+            "it (restoring its params from the last checkpoint) and the "
+            "client will reconnect" % (endpoint, attempts, effective,
+                                       last_err))
 
     def send_var(self, endpoint, name, value):
         value = np.ascontiguousarray(value)
@@ -257,27 +257,41 @@ class _ServerState:
         self.completed = set()    # trainers done for good (MSG_COMPLETE)
         self.round_id = 0
         self.stopping = False
-        # exactly-once cache: trainer_id -> (seq, cached reply) for the
+        # exactly-once cache: trainer_id -> (seq, reply-or-None) for the
         # non-idempotent messages (async SEND applies immediately; a
         # barrier retry after a lost reply must NOT set-add into the NEXT
-        # round, which would fire an update missing this trainer's grads)
+        # round, which would fire an update missing this trainer's grads).
+        # The seq is CLAIMED before processing: a retry racing a slow
+        # first attempt (reply still None) waits for that attempt's
+        # result instead of re-executing. Seqs ride the scope checkpoint
+        # (run_pserver) so a crash-restart keeps the dedup window for
+        # everything up to the last checkpoint; async-mode applies after
+        # the last checkpoint are at-least-once across a crash (docs).
         self._last_reply = {}
 
-    def seen(self, trainer_id, seq):
-        """Cached reply if (trainer_id, seq) was already processed."""
+    def claim(self, trainer_id, seq):
+        """None -> process it (seq claimed); otherwise the cached reply —
+        waiting for a concurrent first attempt to finish if needed."""
         if seq is None:
             return None
         with self.cv:
             last = self._last_reply.get(trainer_id)
-            if last is not None and last[0] == seq:
-                return last[1]
-        return None
+            if last is None or last[0] != seq:
+                self._last_reply[trainer_id] = (seq, None)  # claimed
+                return None
+            self.cv.wait_for(
+                lambda: self._last_reply.get(trainer_id, (None, None))[1]
+                is not None or self.stopping)
+            reply = self._last_reply.get(trainer_id, (None, None))[1]
+            return reply if reply is not None else (MSG_ERR, {
+                "error": "server stopping mid-request"})
 
     def remember(self, trainer_id, seq, reply):
         if seq is None:
             return
         with self.cv:
             self._last_reply[trainer_id] = (seq, reply)
+            self.cv.notify_all()
 
     def live_fanin(self):
         return max(1, self.fanin - len(self.completed))
@@ -358,7 +372,7 @@ class _Handler(socketserver.BaseRequestHandler):
                 tid = meta.get("trainer_id", 0)
                 seq = meta.get("seq")
                 if mtype in (MSG_SEND, MSG_SEND_BARRIER, MSG_COMPLETE):
-                    cached = server.state.seen(tid, seq)
+                    cached = server.state.claim(tid, seq)
                     if cached is not None:
                         _write_msg(self.request, cached[0], cached[1])
                         continue
@@ -409,8 +423,13 @@ class _Handler(socketserver.BaseRequestHandler):
                     _write_msg(self.request, MSG_ERR,
                                {"error": "bad msg type %d" % mtype})
             except Exception as e:  # surface server-side errors to client
+                err = {"error": repr(e)}
+                if mtype in (MSG_SEND, MSG_SEND_BARRIER, MSG_COMPLETE):
+                    # release any waiter parked on our claimed seq
+                    server.state.remember(meta.get("trainer_id", 0),
+                                          meta.get("seq"), (MSG_ERR, err))
                 try:
-                    _write_msg(self.request, MSG_ERR, {"error": repr(e)})
+                    _write_msg(self.request, MSG_ERR, err)
                 except OSError:
                     return
 
@@ -517,34 +536,66 @@ def run_pserver(program, scope, endpoint, executor_place=None):
         safe = endpoint.replace(":", "_").replace("/", "_")
         return os.path.join(ckpt_dir, "pserver_%s.npz" % safe)
 
+    _ckpt_write_lock = threading.Lock()
+
     def _save_checkpoint():
-        """Holding `lock`: atomic scope snapshot (write + rename)."""
-        path = _ckpt_path()
-        tmp = path + ".tmp"
+        """Called holding the optimizer `lock` (and, in sync rounds, the
+        barrier cv): only the in-memory SNAPSHOT happens here — array
+        copies, cheap — and the file write runs on a background thread so
+        a round never stalls on disk. The exactly-once seq cache rides
+        along so a restart keeps the dedup window."""
         arrays = {}
         for name in scope.local_var_names():
             val = scope.get(name)
             if val is None or name.startswith("__"):
                 continue
             try:
-                arrays[name] = np.asarray(val)
+                arrays[name] = np.array(val, copy=True)
             except (TypeError, ValueError):
                 continue
-        with open(tmp, "wb") as f:
-            np.savez(f, **arrays)
-        os.replace(tmp, path)
+        seqs = {}
+        if _state_box[0] is not None:
+            with _state_box[0].cv:
+                seqs = {str(tid): s for tid, (s, r)
+                        in _state_box[0]._last_reply.items()
+                        if r is not None}
+        arrays["__rpc_seqs__"] = np.asarray(
+            [[int(t), int(s)] for t, s in seqs.items()],
+            np.int64).reshape(-1, 2)
 
+        def _write():
+            with _ckpt_write_lock:  # serialize writers; rename is atomic
+                path = _ckpt_path()
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    np.savez(f, **arrays)
+                os.replace(tmp, path)
+
+        threading.Thread(target=_write, daemon=True).start()
+
+    _state_box = [None]
+    _restored_seqs = {}
     if ckpt_dir:
         os.makedirs(ckpt_dir, exist_ok=True)
         path = _ckpt_path()
         if os.path.exists(path):
             with np.load(path) as data:
                 for name in data.files:
+                    if name == "__rpc_seqs__":
+                        for t, s in data[name].reshape(-1, 2):
+                            _restored_seqs[int(t)] = int(s)
+                        continue
                     scope.set(name, data[name])
 
     host, port = endpoint.rsplit(":", 1)
     srv = _PServer((host, int(port)), _Handler)
     srv.state = _ServerState(fanin, sync_mode, apply_update)
+    _state_box[0] = srv.state
+    # restart: re-arm the exactly-once cache from the checkpointed seqs —
+    # a retry of anything processed before the checkpoint replays OK
+    # instead of re-executing (replies for these are always plain OK)
+    for tid_r, seq_r in _restored_seqs.items():
+        srv.state._last_reply[tid_r] = (seq_r, (MSG_OK, {}))
 
     def scope_get(name):
         with lock:
