@@ -1,0 +1,30 @@
+"""Shared example bootstrap: stage the virtual-mesh XLA flag BEFORE jax
+initializes, then fall back to the CPU mesh when the attached accelerator
+has fewer devices than the example wants.
+
+Why this exists (and must be imported FIRST): the axon TPU plugin ignores
+JAX_PLATFORMS=cpu, so the env var alone does not win — the fallback must
+call jax.config.update + clear_backends after checking the device count,
+and XLA only reads --xla_force_host_platform_device_count at backend init.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def ensure_devices(n=8):
+    if "xla_force_host_platform_device_count" not in os.environ.get(
+            "XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=%d" % n).strip()
+    import jax
+
+    if len(jax.devices()) < n:
+        jax.config.update("jax_platforms", "cpu")
+        import jax.extend.backend
+
+        jax.extend.backend.clear_backends()
+    return jax
