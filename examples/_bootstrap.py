@@ -13,13 +13,13 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from xla_env import stage_host_mesh_flags  # noqa: E402
+
 
 def ensure_devices(n=8):
-    if "xla_force_host_platform_device_count" not in os.environ.get(
-            "XLA_FLAGS", ""):
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "")
-            + " --xla_force_host_platform_device_count=%d" % n).strip()
+    # raises a pre-existing smaller device-count flag to n (xla_env parses
+    # the flag value; a bare substring check would skip the upgrade)
+    stage_host_mesh_flags(n)
     import jax
 
     if len(jax.devices()) < n:

@@ -140,8 +140,13 @@ def plan_program(program, mesh, build_strategy=None, zero_sharding=False):
 
     # 2. Megatron auto-walk: alternate column / row splits along each
     # matmul chain; elementwise ops propagate the "tp-sharded last dim"
-    # mark, reductions over the feature dim clear it.
+    # mark, reductions over the feature dim clear it. Conv chains get the
+    # channel-wise analogue: out-channel (dim 0 of OIHW) column split,
+    # then in-channel row split with a psum seam — NCHW activations carry
+    # a "channel-sharded" mark through elementwise/BN/pool ops (channels
+    # are disjoint per rank, so BN's per-channel stats need no collective).
     sharded_last = set()
+    ch_sharded = set()  # NCHW activations sharded on dim 1 (channels)
     for op in ops:
         t = op.type
         if t in ("mul", "matmul"):
@@ -174,6 +179,83 @@ def plan_program(program, mesh, build_strategy=None, zero_sharding=False):
                     nd = len(out.shape)
                     plan.constraints[out.name] = P(
                         *([P.UNCONSTRAINED] * (nd - 1) + [None]))
+        elif t == "conv2d":
+            # (depthwise/grouped convs are left replicated: their filter
+            # layout couples both channel dims, no clean column/row split)
+            xs = op.inputs.get("Input", [])
+            ws = op.inputs.get("Filter", [])
+            out = op.outputs.get("Output", [None])[0]
+            if not xs or not ws:
+                continue
+            x, w = xs[0], ws[0]
+            if not getattr(w, "persistable", False) or w.shape is None \
+                    or len(w.shape) != 4:
+                continue
+            if explicit(w):
+                spec = tuple(plan.specs[w.name])
+                if spec[:1] == ("tp",) and out is not None:
+                    ch_sharded.add(out.name)
+                continue
+            if tp <= 1 or (op.attrs or {}).get("groups", 1) not in (1, None):
+                continue
+            if x.name not in ch_sharded:
+                if _divisible(w.shape[0], tp):
+                    note(w, P("tp", None, None, None))
+                    if out is not None:
+                        ch_sharded.add(out.name)
+            else:
+                if _divisible(w.shape[1], tp):
+                    note(w, P(None, "tp", None, None))
+                # row-parallel conv output psums back to channel-replicated
+                if out is not None and out.shape is not None:
+                    nd = len(out.shape)
+                    plan.constraints[out.name] = P(
+                        *([P.UNCONSTRAINED, None]
+                          + [P.UNCONSTRAINED] * (nd - 2)))
+        elif t == "batch_norm":
+            xs = op.inputs.get("X", [])
+            out = op.outputs.get("Y", [None])[0]
+            if not xs or out is None or xs[0].name not in ch_sharded:
+                continue
+            # per-channel params follow the sharded channel axis; channel
+            # stats are rank-local because channels are disjoint
+            for slot in ("Scale", "Bias", "Mean", "Variance"):
+                for v in op.inputs.get(slot, []):
+                    if getattr(v, "persistable", False) \
+                            and v.shape is not None and len(v.shape) == 1 \
+                            and not explicit(v) and _divisible(v.shape[0],
+                                                               tp):
+                        note(v, P("tp"))
+            for vs in op.outputs.values():
+                for v in vs:
+                    if getattr(v, "persistable", False) \
+                            and v.shape is not None and len(v.shape) == 1 \
+                            and _divisible(v.shape[0], tp):
+                        note(v, P("tp"))
+            ch_sharded.add(out.name)
+        elif (t == "pool2d" or t in _ELEMENTWISE_FWD) \
+                and op.inputs.get("X") \
+                and op.inputs["X"][0].name in ch_sharded \
+                and t != "elementwise_add":
+            for vs in op.outputs.values():
+                for v in vs:
+                    ch_sharded.add(v.name)
+        elif t == "elementwise_add" and op.inputs.get("X") \
+                and op.inputs.get("Y") \
+                and op.inputs["X"][0].name in ch_sharded:
+            # conv bias (1-D persistable [C]) follows the sharded channel
+            # axis; two ch-sharded operands (residual add) keep the mark
+            y_in = op.inputs["Y"][0]
+            out = op.outputs.get("Out", [None])[0]
+            if getattr(y_in, "persistable", False) \
+                    and y_in.shape is not None and len(y_in.shape) == 1:
+                if not explicit(y_in) and tp > 1 \
+                        and _divisible(y_in.shape[0], tp):
+                    note(y_in, P("tp"))
+                if out is not None:
+                    ch_sharded.add(out.name)
+            elif y_in.name in ch_sharded and out is not None:
+                ch_sharded.add(out.name)
         elif t in ("lookup_table", "lookup_table_v2"):
             ws = op.inputs.get("W", [])
             if not ws:

@@ -330,3 +330,44 @@ def test_tp_silent_noop_warns():
         exe.run(compiled, feed=feed, fetch_list=[loss])
     assert any("no tp-sharded parameters" in str(w.message)
                for w in caught), [str(w.message) for w in caught]
+
+
+def test_conv_chain_auto_tp_parity():
+    """Round-4 weak-item closure: conv chains auto-derive channel-wise
+    Megatron specs (out-channel column, in-channel row with a psum seam;
+    BN per-channel params follow) — a plain CNN gets tensor parallelism
+    with loss parity and NO explicit shard_spec."""
+    import paddle_tpu.layers as layers
+
+    img = layers.data(name="cv_img", shape=[4, 8, 8], dtype="float32")
+    y = layers.data(name="cv_y", shape=[1], dtype="int64")
+    h = layers.conv2d(img, num_filters=8, filter_size=3, padding=1)  # column (+bias follows)
+    h = layers.batch_norm(h)             # TRAINING mode: stat updates too
+    h = layers.relu(h)                                    # mark propagates
+    h = layers.conv2d(h, num_filters=8, filter_size=3, padding=1,
+                      bias_attr=False)                    # auto: row+psum
+    h = layers.pool2d(h, pool_size=2, pool_type="max", pool_stride=2)
+    h = layers.reshape(h, shape=[0, 8 * 4 * 4])
+    logits = layers.fc(h, 4)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    feed = {"cv_img": rng.rand(16, 4, 8, 8).astype(np.float32),
+            "cv_y": rng.randint(0, 4, (16, 1)).astype(np.int64)}
+    single = _single_then_restore(loss, feed)
+
+    bs = fluid.BuildStrategy()
+    bs.tensor_parallel_degree = 2
+    compiled = fluid.CompiledProgram(
+        fluid.default_main_program()).with_data_parallel(
+            loss_name=loss.name, build_strategy=bs)
+    multi = _train(compiled, loss, feed)
+    np.testing.assert_allclose(multi, single, rtol=1e-4, atol=1e-5)
+
+    step = next(iter(compiled._compiled_steps.values()))
+    specs = step._plan.summary()
+    conv_specs = [s for n, s in specs.items()
+                  if n.startswith("conv2d") and len(s) == 4]
+    assert (("tp", None, None, None) in conv_specs
+            and (None, "tp", None, None) in conv_specs), specs
