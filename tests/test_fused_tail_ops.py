@@ -168,3 +168,48 @@ def test_registry_holds_all_nine():
              "fusion_transpose_flatten_concat"]
     for n in names:
         assert get(n) is not None
+
+
+def test_fused_tail_grads_numeric():
+    """The fused composites are differentiable through the generic vjp —
+    pin analytic grads against central differences via the OpTest
+    harness (SURVEY §4 tier-1 strategy) for the two matmul-bearing ones."""
+    from op_test import OpTest
+
+    rng = np.random.RandomState(0)
+    x_np = rng.randn(3, 4).astype(np.float64)
+    y_np = rng.randn(4, 5).astype(np.float64)
+
+    class TestSquaredMatSubGrad(OpTest):
+        op_type = "fusion_squared_mat_sub"
+        inputs = {"X": [("x", x_np)], "Y": [("y", y_np)]}
+        attrs = {"scalar": 0.5}
+        outputs = {"Out": [("out", 0.5 * ((x_np @ y_np) ** 2
+                                          - (x_np ** 2) @ (y_np ** 2)))],
+                   "SquaredX": [("sx", x_np ** 2)],
+                   "SquaredY": [("sy", y_np ** 2)],
+                   "SquaredXY": [("sxy", (x_np @ y_np) ** 2)]}
+
+    t = TestSquaredMatSubGrad()
+    t.check_output(atol=1e-4, rtol=1e-4)
+    t.check_grad(["x", "y"], "out", max_relative_error=0.01)
+
+    x2 = rng.randn(4, 6).astype(np.float64) + 0.5
+    w0 = rng.randn(6, 5).astype(np.float64)
+    w1 = rng.randn(5, 3).astype(np.float64)
+    b0 = rng.randn(5).astype(np.float64)
+    b1 = rng.randn(3).astype(np.float64)
+    r0 = np.maximum(x2 @ w0 + b0, 0)
+    out = np.maximum(r0 @ w1 + b1, 0)
+
+    class TestRepeatedFcReluGrad(OpTest):
+        op_type = "fusion_repeated_fc_relu"
+        inputs = {"X": [("x", x2)],
+                  "W": [("w0", w0), ("w1", w1)],
+                  "Bias": [("b0", b0), ("b1", b1)]}
+        attrs = {}
+        outputs = {"Out": [("out", out)], "ReluOut": [("r0", r0)]}
+
+    t2 = TestRepeatedFcReluGrad()
+    t2.check_output(atol=1e-4, rtol=1e-4)
+    t2.check_grad(["x", "w0", "w1"], "out", max_relative_error=0.02)

@@ -249,3 +249,41 @@ def test_pp_microbatch_validation():
     exe.run(fluid.default_startup_program())
     with pytest.raises(ValueError, match="pipeline_microbatches"):
         exe.run(compiled, feed=_feed("val"), fetch_list=[loss])
+
+
+def test_pp_scalar_metric_fetch():
+    """Scalar forward metrics (not just the loss) fetch correctly under
+    pipelining: each is accumulated as the mean over microbatches on its
+    owning stage and matches the single-device value."""
+    x = layers.data(name="sm_x", shape=[16], dtype="float32")
+    y = layers.data(name="sm_y", shape=[1], dtype="float32")
+    h = layers.fc(x, 32, act="relu")
+    pred = layers.fc(h, 1)
+    err = layers.square_error_cost(pred, y)
+    loss = layers.mean(err)
+    mae = layers.reduce_mean(layers.abs(layers.elementwise_sub(pred, y)))
+    fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    feed = _feed("sm")
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    sc = scope_mod.global_scope()
+    init = {n: np.asarray(sc.get(n)).copy() for n in sc.local_var_names()
+            if sc.get(n) is not None and not n.startswith("__")}
+    sl, sm = exe.run(fluid.default_main_program(), feed=feed,
+                     fetch_list=[loss, mae])
+    for n, v in init.items():
+        sc.set(n, v.copy())
+    sc.set("__step_counter__", 0)
+
+    bs = fluid.BuildStrategy()
+    bs.pipeline_stages = 2
+    bs.pipeline_microbatches = 4
+    compiled = fluid.CompiledProgram(
+        fluid.default_main_program()).with_data_parallel(
+            loss_name=loss.name, build_strategy=bs)
+    pl, pm = exe.run(compiled, feed=feed, fetch_list=[loss, mae])
+    np.testing.assert_allclose(np.asarray(pl).ravel(),
+                               np.asarray(sl).ravel(), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(pm).ravel(),
+                               np.asarray(sm).ravel(), rtol=1e-5)
