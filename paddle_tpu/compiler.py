@@ -200,13 +200,18 @@ class CompiledProgram:
             sp = int(getattr(self._build_strategy,
                              "sequence_parallel_degree", 1) or 1)
             if sp > 1 and pp > 1:
-                raise NotImplementedError(
-                    "sequence_parallel_degree and pipeline_stages cannot "
-                    "combine on the descriptor path yet: ring attention's "
-                    "ppermute cannot live inside a pipeline stage branch "
-                    "(pair-style collectives deadlock when only one "
-                    "stage's ranks execute them)")
-            if sp > 1:
+                # pp x sp: attention switches from the ring (ppermute —
+                # pair collectives cannot live in a stage branch) to the
+                # all-gather sequence-parallel formulation inside stages
+                if len(devs) % (pp * sp * tp):
+                    raise ValueError(
+                        "pipeline_stages*sequence_parallel_degree*"
+                        "tensor_parallel_degree = %d*%d*%d does not divide "
+                        "the %d-device mesh" % (pp, sp, tp, len(devs)))
+                self._mesh = Mesh(
+                    devs.reshape(len(devs) // (pp * sp * tp), pp, sp, tp),
+                    axis_names=("dp", "pp", "sp", "tp"))
+            elif sp > 1:
                 if len(devs) % (sp * tp):
                     raise ValueError(
                         "sequence_parallel_degree*tensor_parallel_degree ="

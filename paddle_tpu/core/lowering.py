@@ -53,6 +53,13 @@ class LoweringContext:
         # folded into the backward seed (ops/math.py fill_any_like)
         self.act_constraints = {}
         self.grad_seed_scale = 1.0
+        # True inside a pipeline stage branch (parallel/pipeline_program):
+        # only the resident stage's ranks execute there, so op lowerings
+        # must avoid PAIR-style collectives (ppermute/all-to-all — their
+        # rendezvous spans every device); group-style psum/all_gather are
+        # per-group and safe. The flash_attention op switches its
+        # sequence-parallel lowering from ring to all-gather on this flag.
+        self.no_pair_collectives = False
         # forward input values per op, captured at forward-execution time.
         # Grad ops recompute their forward under jax.vjp; reading inputs
         # from the *current* env would be wrong whenever a var was

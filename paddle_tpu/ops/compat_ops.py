@@ -499,7 +499,8 @@ def _flash_attention_op(ctx, ins, attrs):
     mesh = getattr(ctx, "mesh", None)
     sp = dict(mesh.shape).get("sp", 1) if mesh is not None else 1
     if sp > 1:
-        if T % sp == 0 and q.shape == k.shape:
+        if T % sp == 0 and q.shape == k.shape \
+                and not getattr(ctx, "no_pair_collectives", False):
             from ..parallel.ring_attention import ring_attention_sharded
 
             qb, kb, vb = ((jnp.swapaxes(t, 1, 2) for t in (q, k, v))
@@ -509,6 +510,31 @@ def _flash_attention_op(ctx, ins, attrs):
                                          partial_manual=True)
             if layout == "bthd":
                 out = jnp.swapaxes(out, 1, 2)
+            return {"Out": [out.astype(out_dtype)]}
+        if T % sp == 0 and q.shape == k.shape:
+            # inside a pipeline stage branch: the ring's ppermute would
+            # deadlock (pair collectives rendezvous across all devices),
+            # so use the ALL-GATHER sequence-parallel formulation — Q and
+            # the output stay seq-sharded over sp (scores O(T^2/sp) per
+            # chip), K/V gather to replicated (group-safe) — expressed
+            # purely through GSPMD constraints around the shared XLA
+            # attention math, no manual collectives
+            from jax.sharding import NamedSharding as _NS
+            from jax.sharding import PartitionSpec as _P
+
+            from ..parallel.mesh import current_abstract_mesh
+
+            cmesh = current_abstract_mesh(mesh)
+            U = _P.UNCONSTRAINED
+            seq_spec = (_P(U, "sp", U, U) if layout == "bthd"
+                        else _P(U, U, "sp", U))
+            repl_spec = (_P(U, None, U, U) if layout == "bthd"
+                         else _P(U, U, None, U))
+            q = jax.lax.with_sharding_constraint(q, _NS(cmesh, seq_spec))
+            k = jax.lax.with_sharding_constraint(k, _NS(cmesh, repl_spec))
+            v = jax.lax.with_sharding_constraint(v, _NS(cmesh, repl_spec))
+            out = _xla_softmax_attention(q, k, v, layout, causal, scale, Dh)
+            out = jax.lax.with_sharding_constraint(out, _NS(cmesh, seq_spec))
             return {"Out": [out.astype(out_dtype)]}
         import warnings
 
@@ -526,17 +552,24 @@ def _flash_attention_op(ctx, ins, attrs):
         out = flash_attention(q, k, v, causal, scale)
         if layout == "bthd":
             out = jnp.swapaxes(out, 1, 2)
-    else:  # XLA-fused softmax attention, layout folded into the dots
-        s = scale if scale is not None else Dh ** -0.5
-        qs, ks, vs = (("bhqd", "bhkd", "bhkd") if layout == "bhtd"
-                      else ("bqhd", "bkhd", "bkhd"))
-        logits = jnp.einsum("%s,%s->bhqk" % (qs, ks), q, k,
-                            preferred_element_type=jnp.float32) * s
-        if causal:
-            Tq, Tk = logits.shape[-2], logits.shape[-1]
-            mask = jnp.tril(jnp.ones((Tq, Tk), bool), Tk - Tq)
-            logits = jnp.where(mask, logits, -1e30)
-        p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-        out_spec = "bhqd" if layout == "bhtd" else "bqhd"
-        out = jnp.einsum("bhqk,%s->%s" % (vs, out_spec), p, v)
+    else:
+        out = _xla_softmax_attention(q, k, v, layout, causal, scale, Dh)
     return {"Out": [out.astype(out_dtype)]}
+
+
+def _xla_softmax_attention(q, k, v, layout, causal, scale, Dh):
+    """XLA-fused softmax attention with the head layout folded into the
+    dots — shared by the non-Pallas fallback and the pipeline-safe
+    all-gather sequence-parallel path."""
+    s = scale if scale is not None else Dh ** -0.5
+    qs, ks, vs = (("bhqd", "bhkd", "bhkd") if layout == "bhtd"
+                  else ("bqhd", "bkhd", "bkhd"))
+    logits = jnp.einsum("%s,%s->bhqk" % (qs, ks), q, k,
+                        preferred_element_type=jnp.float32) * s
+    if causal:
+        Tq, Tk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((Tq, Tk), bool), Tk - Tq)
+        logits = jnp.where(mask, logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out_spec = "bhqd" if layout == "bhtd" else "bqhd"
+    return jnp.einsum("bhqk,%s->%s" % (vs, out_spec), p, v)

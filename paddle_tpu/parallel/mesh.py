@@ -33,3 +33,12 @@ def set_default_mesh(mesh):
 
 def mesh_axis_sizes(mesh):
     return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def current_abstract_mesh(fallback):
+    """The mesh shardings must bind to INSIDE a (partial-)manual
+    shard_map region: the context abstract mesh carries the Manual axis
+    types — a concrete-mesh NamedSharding there poisons downstream avals
+    with a mismatched all-Auto mesh. Outside any region, `fallback`."""
+    cmesh = jax.sharding.get_abstract_mesh()
+    return fallback if cmesh is None or cmesh.empty else cmesh

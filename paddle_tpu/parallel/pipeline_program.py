@@ -486,6 +486,7 @@ class PipelineProgramStep:
             ctx = LoweringContext(base_key=jax.random.PRNGKey(0),
                                   mesh=self.mesh)
             ctx.act_constraints = constraints
+            ctx.no_pair_collectives = True
             for op in self.fwd_ops:
                 execute_op(op, env, ctx)
             return {n: env[n] for n in want}
@@ -501,9 +502,9 @@ class PipelineProgramStep:
     def _context_constraints(self):
         """NamedShardings for the activation seams, bound to the CURRENT
         abstract mesh (Manual over dp/pp inside the 1F1B region)."""
-        cmesh = jax.sharding.get_abstract_mesh()
-        if cmesh is None or cmesh.empty:
-            cmesh = self.mesh
+        from .mesh import current_abstract_mesh
+
+        cmesh = current_abstract_mesh(self.mesh)
         return {n: NamedSharding(cmesh, spec)
                 for n, spec in self._tp_constraint_specs.items()}
 
@@ -530,6 +531,7 @@ class PipelineProgramStep:
                     _in.unpack(env, f_in, i_in)
                 ctx = LoweringContext(base_key=mb_key, mesh=self.mesh)
                 ctx.act_constraints = constraints
+                ctx.no_pair_collectives = True
                 for op in _ops:
                     execute_op(op, env, ctx)
                 if _out is not None:

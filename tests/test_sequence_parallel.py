@@ -113,15 +113,39 @@ def test_sp_long_context_8192():
     _assert_ring_engaged(compiled, feed)
 
 
-def test_sp_pp_combination_rejected():
+def test_sp_pp_combination_parity():
+    """pp x sp composes: inside pipeline stage branches the attention
+    switches from the ring (ppermute — pair collectives cannot live in a
+    partially-taken branch) to the ALL-GATHER sequence-parallel
+    formulation (Q/out seq-sharded, K/V gathered — group-safe only), with
+    exact loss parity on a dp=2 x pp=2 x sp=2 mesh."""
     loss = _build(seq=64)
+    feed = _feed(64, batch=8)
+    single = _single_then_restore(loss, feed, steps=3)
+
     bs = fluid.BuildStrategy()
     bs.sequence_parallel_degree = 2
     bs.pipeline_stages = 2
+    bs.pipeline_microbatches = 2
     compiled = fluid.CompiledProgram(
         fluid.default_main_program()).with_data_parallel(
             loss_name=loss.name, build_strategy=bs)
     exe = fluid.Executor(fluid.CPUPlace())
-    exe.run(fluid.default_startup_program())
-    with pytest.raises(NotImplementedError, match="sequence_parallel"):
-        exe.run(compiled, feed=_feed(64, batch=4), fetch_list=[loss])
+    multi = []
+    for _ in range(3):
+        (lv,) = exe.run(compiled, feed=feed, fetch_list=[loss])
+        multi.append(float(np.asarray(lv).reshape(-1)[0]))
+    np.testing.assert_allclose(multi, single, rtol=1e-4, atol=1e-5)
+    step = next(iter(compiled._compiled_steps.values()))
+    assert dict(step.mesh.shape) == {"dp": 2, "pp": 2, "sp": 2, "tp": 1}
+    # branch-safety proof: the all-gather formulation engaged — NO
+    # collective-permute may live inside a stage branch (only the 1F1B
+    # ring's own permutes outside the lax.switch are allowed)
+    sc = scope_mod.global_scope()
+    mut = {n: sc.get(n) for n in step.mut_names}
+    const = {n: sc.get(n) for n in step.const_names}
+    txt = step._jitted.lower(mut, const, dict(feed),
+                             np.uint32(0)).compile().as_text()
+    bad = [l for l in txt.splitlines()
+           if "collective-permute" in l and "branch_" in l]
+    assert not bad, bad[:2]

@@ -16,9 +16,12 @@ def stage_host_mesh_flags(n_devices=8):
     The virtual devices share however few physical cores the box has;
     XLA:CPU's default 20s-warn / 40s-abort rendezvous deadline then fires
     spuriously under scheduling pressure (observed on a 1-core runner with
-    the 1F1B pipeline step's collective-dense scan). 180s bounds a REAL
-    deadlock to a visible abort-with-stack instead of letting the harness
-    timeout kill the run with no diagnostic.
+    the 1F1B pipeline step's collective-dense scan — and still observed,
+    rarely, at a 180s bound when background load coincides with the
+    longest steps). The 60s warning keeps stuck collectives visible in
+    the log; 600s makes a REAL deadlock abort with stacks well before any
+    harness-level timeout, without spuriously killing a loaded-but-live
+    suite.
     """
     flags = os.environ.get("XLA_FLAGS", "")
     m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
@@ -32,5 +35,5 @@ def stage_host_mesh_flags(n_devices=8):
     if "collective_call_warn_stuck_timeout" not in flags:
         flags += " --xla_cpu_collective_call_warn_stuck_timeout_seconds=60"
     if "collective_call_terminate_timeout" not in flags:
-        flags += " --xla_cpu_collective_call_terminate_timeout_seconds=180"
+        flags += " --xla_cpu_collective_call_terminate_timeout_seconds=600"
     os.environ["XLA_FLAGS"] = flags.strip()
