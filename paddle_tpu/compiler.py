@@ -191,53 +191,38 @@ class CompiledProgram:
 
     # ------------------------------------------------------------------
     def _get_mesh(self):
+        """Mesh = leading dp axis + one axis per model-parallel degree > 1
+        (pp, sp, tp in that fixed order). Any combination composes — e.g.
+        pp×sp switches attention to the all-gather sequence-parallel
+        formulation inside stage branches (ops/compat_ops.py); a size-1
+        degree simply contributes no axis (planner annotations naming an
+        absent axis are sanitized to inert)."""
         if self._mesh is None:
             devs = np.array(jax.devices())
-            tp = int(getattr(self._build_strategy,
-                             "tensor_parallel_degree", 1) or 1)
-            pp = int(getattr(self._build_strategy,
-                             "pipeline_stages", 1) or 1)
-            sp = int(getattr(self._build_strategy,
-                             "sequence_parallel_degree", 1) or 1)
-            if sp > 1 and pp > 1:
-                # pp x sp: attention switches from the ring (ppermute —
-                # pair collectives cannot live in a stage branch) to the
-                # all-gather sequence-parallel formulation inside stages
-                if len(devs) % (pp * sp * tp):
-                    raise ValueError(
-                        "pipeline_stages*sequence_parallel_degree*"
-                        "tensor_parallel_degree = %d*%d*%d does not divide "
-                        "the %d-device mesh" % (pp, sp, tp, len(devs)))
-                self._mesh = Mesh(
-                    devs.reshape(len(devs) // (pp * sp * tp), pp, sp, tp),
-                    axis_names=("dp", "pp", "sp", "tp"))
-            elif sp > 1:
-                if len(devs) % (sp * tp):
-                    raise ValueError(
-                        "sequence_parallel_degree*tensor_parallel_degree ="
-                        " %d*%d does not divide the %d-device mesh"
-                        % (sp, tp, len(devs)))
-                self._mesh = Mesh(
-                    devs.reshape(len(devs) // (sp * tp), sp, tp),
-                    axis_names=("dp", "sp", "tp"))
-            elif pp > 1:
-                if len(devs) % (pp * tp):
-                    raise ValueError(
-                        "pipeline_stages*tensor_parallel_degree = %d*%d "
-                        "does not divide the %d-device mesh"
-                        % (pp, tp, len(devs)))
-                self._mesh = Mesh(
-                    devs.reshape(len(devs) // (pp * tp), pp, tp),
-                    axis_names=("dp", "pp", "tp"))
-            elif tp > 1:
-                if len(devs) % tp:
-                    raise ValueError(
-                        "tensor_parallel_degree=%d does not divide the "
-                        "%d-device mesh" % (tp, len(devs)))
-                self._mesh = Mesh(devs.reshape(len(devs) // tp, tp),
-                                  axis_names=("dp", "tp"))
-            else:
-                self._mesh = Mesh(devs, axis_names=("dp",))
+            bs = self._build_strategy
+            degrees = [
+                ("pp", "pipeline_stages",
+                 int(getattr(bs, "pipeline_stages", 1) or 1)),
+                ("sp", "sequence_parallel_degree",
+                 int(getattr(bs, "sequence_parallel_degree", 1) or 1)),
+                ("tp", "tensor_parallel_degree",
+                 int(getattr(bs, "tensor_parallel_degree", 1) or 1)),
+            ]
+            extra = [(axis, knob, d) for axis, knob, d in degrees if d > 1]
+            prod = 1
+            for _, _, d in extra:
+                prod *= d
+            if len(devs) % prod:
+                raise ValueError(
+                    "%s = %s does not divide the %d-device mesh" % (
+                        " * ".join(k for _, k, _ in extra),
+                        " * ".join(str(d) for _, _, d in extra),
+                        len(devs)))
+            extra = [(axis, d) for axis, _, d in extra]
+            self._mesh = Mesh(
+                devs.reshape((len(devs) // prod,)
+                             + tuple(d for _, d in extra)),
+                axis_names=("dp",) + tuple(n for n, _ in extra))
         return self._mesh
 
     def _run(self, executor, feed, fetch_list, scope, return_numpy):

@@ -538,11 +538,15 @@ def _flash_attention_op(ctx, ins, attrs):
             return {"Out": [out.astype(out_dtype)]}
         import warnings
 
+        form = ("all-gather sequence parallelism (pipeline-stage form)"
+                if getattr(ctx, "no_pair_collectives", False)
+                else "ring attention")
         warnings.warn(
-            "sequence_parallel_degree=%d is set but ring attention cannot "
-            "engage for this op (seq %d %% sp != 0, or cross-attention "
-            "q/k shapes differ): falling back to per-chip full attention, "
-            "which materializes O(T^2/chip) scores" % (sp, T),
+            "sequence_parallel_degree=%d is set but %s cannot engage for "
+            "this op (seq %d %% sp != 0, or cross-attention q/k shapes "
+            "differ): falling back to per-chip full attention — the sp "
+            "mesh ranks replicate this work and the [T, T] scores "
+            "materialize per chip" % (sp, form, T),
             RuntimeWarning)
 
     use_pallas = (T % 128 == 0 and Dh >= 64 and q.shape == k.shape)

@@ -9,7 +9,6 @@ rotation, parallel/ring_attention.py) actually engages.
 """
 
 import numpy as np
-import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu.core import scope as scope_mod
@@ -64,14 +63,19 @@ def _train_sp(loss, feed, steps, sp, tp=1):
     return out, compiled
 
 
-def _assert_ring_engaged(compiled, feed):
-    """The compiled HLO must contain collective-permutes — the ring's K/V
-    rotation. (GSPMD alone would all-gather, not permute.)"""
+def _hlo_text(compiled, feed):
+    """Compiled-step HLO for a (compiled, feed) pair."""
     step = next(iter(compiled._compiled_steps.values()))
     mut = {n: scope_mod.global_scope().get(n) for n in step.mut_names}
     const = {n: scope_mod.global_scope().get(n) for n in step.const_names}
-    txt = step._jitted.lower(mut, const, dict(feed),
-                             np.uint32(0)).compile().as_text()
+    return step._jitted.lower(mut, const, dict(feed),
+                              np.uint32(0)).compile().as_text()
+
+
+def _assert_ring_engaged(compiled, feed):
+    """The compiled HLO must contain collective-permutes — the ring's K/V
+    rotation. (GSPMD alone would all-gather, not permute.)"""
+    txt = _hlo_text(compiled, feed)
     n_perm = sum("collective-permute" in l for l in txt.splitlines())
     assert n_perm > 0, "ring attention did not engage"
 
@@ -137,15 +141,12 @@ def test_sp_pp_combination_parity():
         multi.append(float(np.asarray(lv).reshape(-1)[0]))
     np.testing.assert_allclose(multi, single, rtol=1e-4, atol=1e-5)
     step = next(iter(compiled._compiled_steps.values()))
-    assert dict(step.mesh.shape) == {"dp": 2, "pp": 2, "sp": 2, "tp": 1}
+    # degree-1 axes contribute no mesh dimension (generic _get_mesh)
+    assert dict(step.mesh.shape) == {"dp": 2, "pp": 2, "sp": 2}
     # branch-safety proof: the all-gather formulation engaged — NO
     # collective-permute may live inside a stage branch (only the 1F1B
     # ring's own permutes outside the lax.switch are allowed)
-    sc = scope_mod.global_scope()
-    mut = {n: sc.get(n) for n in step.mut_names}
-    const = {n: sc.get(n) for n in step.const_names}
-    txt = step._jitted.lower(mut, const, dict(feed),
-                             np.uint32(0)).compile().as_text()
+    txt = _hlo_text(compiled, feed)
     bad = [l for l in txt.splitlines()
            if "collective-permute" in l and "branch_" in l]
     assert not bad, bad[:2]
