@@ -257,40 +257,70 @@ class _ServerState:
         self.completed = set()    # trainers done for good (MSG_COMPLETE)
         self.round_id = 0
         self.stopping = False
-        # exactly-once cache: trainer_id -> (seq, reply-or-None) for the
+        # exactly-once cache: trainer_id -> {seq: reply-or-None} for the
         # non-idempotent messages (async SEND applies immediately; a
         # barrier retry after a lost reply must NOT set-add into the NEXT
         # round, which would fire an update missing this trainer's grads).
         # The seq is CLAIMED before processing: a retry racing a slow
         # first attempt (reply still None) waits for that attempt's
-        # result instead of re-executing. Seqs ride the scope checkpoint
+        # result instead of re-executing. A BOUNDED WINDOW of recent seqs
+        # is kept per trainer (not a single slot): the client is
+        # thread-safe per instance, so seqs N and N+1 can be in flight
+        # concurrently and N's retry must still find its cached reply
+        # after N+1 completes. Seqs ride the scope checkpoint
         # (run_pserver) so a crash-restart keeps the dedup window for
         # everything up to the last checkpoint; async-mode applies after
         # the last checkpoint are at-least-once across a crash (docs).
-        self._last_reply = {}
+        self._last_reply = {}  # tid -> {seq: [reply-or-None, done_ts]}
+
+    def _dedup_ttl(self):
+        """A completed entry may be evicted once no legitimate retry can
+        still arrive: the client stops retrying a logical call once its
+        rpc_deadline wall clock expires (barriers use grace+30), so
+        anything completed 2x that long ago is safe to drop. Count-based
+        eviction would be wrong — the number of newer RPCs completed
+        during one retry's backoff is unbounded."""
+        from .flags import flag
+
+        return 2.0 * max(float(flag("rpc_deadline")),
+                         float(flag("rpc_barrier_grace")) + 30.0)
 
     def claim(self, trainer_id, seq):
         """None -> process it (seq claimed); otherwise the cached reply —
         waiting for a concurrent first attempt to finish if needed."""
+        import time
+
         if seq is None:
             return None
         with self.cv:
-            last = self._last_reply.get(trainer_id)
-            if last is None or last[0] != seq:
-                self._last_reply[trainer_id] = (seq, None)  # claimed
+            window = self._last_reply.setdefault(trainer_id, {})
+            if seq not in window:
+                window[seq] = [None, None]  # claimed, in flight
+                # evict COMPLETED entries past the retry-deadline TTL; an
+                # in-flight claim (ts None) is never evicted
+                cutoff = time.monotonic() - self._dedup_ttl()
+                for s in [s for s, (r, ts) in window.items()
+                          if ts is not None and ts < cutoff]:
+                    del window[s]
                 return None
             self.cv.wait_for(
-                lambda: self._last_reply.get(trainer_id, (None, None))[1]
-                is not None or self.stopping)
-            reply = self._last_reply.get(trainer_id, (None, None))[1]
-            return reply if reply is not None else (MSG_ERR, {
-                "error": "server stopping mid-request"})
+                lambda: window.get(seq, (None, None))[0] is not None
+                or seq not in window or self.stopping)
+            entry = window.get(seq)
+            if entry is not None and entry[0] is not None:
+                return entry[0]
+            return (MSG_ERR, {
+                "error": "server stopping mid-request" if self.stopping
+                else "exactly-once cache entry lost for seq %d" % seq})
 
     def remember(self, trainer_id, seq, reply):
+        import time
+
         if seq is None:
             return
         with self.cv:
-            self._last_reply[trainer_id] = (seq, reply)
+            self._last_reply.setdefault(
+                trainer_id, {})[seq] = [reply, time.monotonic()]
             self.cv.notify_all()
 
     def live_fanin(self):
@@ -537,6 +567,8 @@ def run_pserver(program, scope, endpoint, executor_place=None):
         return os.path.join(ckpt_dir, "pserver_%s.npz" % safe)
 
     _ckpt_write_lock = threading.Lock()
+    _ckpt_seq = [0]        # allocated under the optimizer lock
+    _ckpt_committed = [0]  # last seq whose file write landed (write lock)
 
     def _save_checkpoint():
         """Called holding the optimizer `lock` (and, in sync rounds, the
@@ -553,23 +585,32 @@ def run_pserver(program, scope, endpoint, executor_place=None):
                 arrays[name] = np.array(val, copy=True)
             except (TypeError, ValueError):
                 continue
-        seqs = {}
+        # persist only seqs whose reply was MSG_OK: replaying a cached
+        # MSG_ERR (e.g. a timed-out barrier) as OK after restart would
+        # convert a loud lost-trainer failure into silent success
+        seq_rows = []
         if _state_box[0] is not None:
             with _state_box[0].cv:
-                seqs = {str(tid): s for tid, (s, r)
-                        in _state_box[0]._last_reply.items()
-                        if r is not None}
-        arrays["__rpc_seqs__"] = np.asarray(
-            [[int(t), int(s)] for t, s in seqs.items()],
-            np.int64).reshape(-1, 2)
+                for tid, window in _state_box[0]._last_reply.items():
+                    for s, (r, _ts) in window.items():
+                        if r is not None and r[0] == MSG_OK:
+                            seq_rows.append([int(tid), int(s)])
+        arrays["__rpc_seqs__"] = np.asarray(seq_rows,
+                                            np.int64).reshape(-1, 2)
+        _ckpt_seq[0] += 1  # holding the optimizer lock — safe
+        my_seq = _ckpt_seq[0]
 
         def _write():
             with _ckpt_write_lock:  # serialize writers; rename is atomic
+                if my_seq <= _ckpt_committed[0]:
+                    return  # a newer snapshot already committed — the
+                    # daemon threads are not FIFO; never regress the file
                 path = _ckpt_path()
-                tmp = path + ".tmp"
+                tmp = path + ".tmp.%d" % my_seq
                 with open(tmp, "wb") as f:
                     np.savez(f, **arrays)
                 os.replace(tmp, path)
+                _ckpt_committed[0] = my_seq
 
         threading.Thread(target=_write, daemon=True).start()
 
@@ -583,7 +624,8 @@ def run_pserver(program, scope, endpoint, executor_place=None):
                 for name in data.files:
                     if name == "__rpc_seqs__":
                         for t, s in data[name].reshape(-1, 2):
-                            _restored_seqs[int(t)] = int(s)
+                            _restored_seqs.setdefault(int(t),
+                                                      set()).add(int(s))
                         continue
                     scope.set(name, data[name])
 
@@ -593,9 +635,12 @@ def run_pserver(program, scope, endpoint, executor_place=None):
     _state_box[0] = srv.state
     # restart: re-arm the exactly-once cache from the checkpointed seqs —
     # a retry of anything processed before the checkpoint replays OK
-    # instead of re-executing (replies for these are always plain OK)
-    for tid_r, seq_r in _restored_seqs.items():
-        srv.state._last_reply[tid_r] = (seq_r, (MSG_OK, {}))
+    # instead of re-executing (only MSG_OK replies were persisted)
+    import time as _time
+    _now = _time.monotonic()
+    for tid_r, seqs_r in _restored_seqs.items():
+        srv.state._last_reply[tid_r] = {s: [(MSG_OK, {}), _now]
+                                        for s in seqs_r}
 
     def scope_get(name):
         with lock:

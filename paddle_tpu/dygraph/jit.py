@@ -30,12 +30,22 @@ class TracedLayer:
     """A static Program recorded from one eager forward, plus the scope
     holding the layer's parameter values. Construct via `trace`."""
 
-    def __init__(self, program, feed_vars, fetch_vars, scope):
+    def __init__(self, program, feed_vars, fetch_vars, scope,
+                 param_sources=()):
         self.program = program
         self._feed_vars = feed_vars
         self._fetch_vars = fetch_vars
         self._scope = scope
+        # (scope name, live VarBase) pairs: the traced program SHARES the
+        # dygraph parameter storage — continued eager training is visible
+        # to later __call__/save (reference TracedLayer semantics)
+        self._param_sources = list(param_sources)
         self._exe = None
+
+    def _refresh_params(self):
+        for name, vb in self._param_sources:
+            if self._scope.get(name) is not vb.value:
+                self._scope.set(name, vb.value)
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -67,6 +77,7 @@ class TracedLayer:
         block = program.global_block()
         scope = Scope()
         var_of = {}  # id(VarBase) -> program Variable
+        param_sources = []  # (scope name, VarBase) for live params
 
         def _var_for(v):
             """Map an eager value to a program Variable, creating inputs/
@@ -82,6 +93,7 @@ class TracedLayer:
                         name=name, shape=tuple(v.value.shape),
                         dtype=str(v.value.dtype), persistable=True)
                     scope.set(name, v.value)
+                    param_sources.append((name, v))
                 else:
                     # an eager value born OUTSIDE the traced call (e.g. a
                     # to_variable constant): bake it in as a persistable
@@ -132,7 +144,8 @@ class TracedLayer:
                     "traced output was not produced by a recorded op — "
                     "return values must flow through layer ops")
             fetch_vars.append(var_of[id(v)])
-        return outs, TracedLayer(program, feed_vars, fetch_vars, scope)
+        return outs, TracedLayer(program, feed_vars, fetch_vars, scope,
+                                 param_sources)
 
     # ------------------------------------------------------------------
     def __call__(self, inputs):
@@ -143,6 +156,7 @@ class TracedLayer:
 
         if self._exe is None:
             self._exe = Executor(default_place())
+        self._refresh_params()
         feed = {}
         for pv, v in zip(self._feed_vars, inputs):
             feed[pv.name] = v.value if isinstance(v, VarBase) \
@@ -166,6 +180,7 @@ class TracedLayer:
         fetch_vars = (self._fetch_vars if fetch is None
                       else [self._fetch_vars[i] for i in fetch])
         exe = Executor(default_place())
+        self._refresh_params()
         with scope_guard(self._scope):
             io.save_inference_model(
                 dirname, [v.name for v in feed_vars], fetch_vars, exe,

@@ -60,32 +60,64 @@ def _conv2d_fusion(ctx, ins, attrs):
 
 @register("conv2d_inception_fusion")
 def _conv2d_inception_fusion(ctx, ins, attrs):
-    """Inception module: 4 conv branches (branch 0 = 3x3 avg-pool then
-    1x1 conv; branches 1-3 conv the input directly), each with bias +
-    activation, channel-concatenated (fusion_conv_inception_op.cu — the
-    cuDNN kernel's in-place stride tricks are an implementation detail;
-    the module semantics are branch-concat)."""
+    """Inception module with the reference kernel's exact dataflow
+    (fusion_conv_inception_op.cc InferShape:40-49, .cu kernel): all convs
+    stride 1; branch 0 = 3x3 pool (``pooling_type``/``exclusive`` attrs,
+    pad 1) then 1x1 conv; branch 1 = 1x1 conv of the input whose FIRST
+    oc1 = w1[0]-2*w2[1] output channels join the result and whose last
+    2*w2[1] channels feed branch 2 — a 3x3 conv with groups=2 (.cu:159);
+    branch 2's first oc2 = w2[0]-w3[1] channels join the result and its
+    last w3[1] feed branch 3 (3x3 conv). Bias+activation applies to every
+    conv's FULL output (ConvolutionBiasActivationForward), including the
+    pass-through channels. TempOutput = [pool output, branch-2 full
+    output] — the kernel's scratch-tensor contract (.cu:61,:208).
+
+    The kernel hardcodes pads {0,0,1,1} for the four convs, which is
+    same-spatial only for kernel sizes {1,1,3,3} (InferShape asserts the
+    output is N,C,H,W) — other shapes are rejected rather than silently
+    computed differently."""
     x = ins["Input"][0]
-    filters = ins["Filter"]
-    biases = ins.get("Bias", [None] * len(filters))
+    w0, w1, w2, w3 = ins["Filter"]
+    biases = ins.get("Bias") or [None] * 4
     act = _act(attrs.get("activation", "relu"))
-    outs = []
-    for i, w in enumerate(filters):
-        if i == 0:
-            inp = get("pool2d").impl(ctx, {"X": [x]}, {
-                "pooling_type": "avg", "ksize": [3, 3], "strides": [1, 1],
-                "paddings": [1, 1]})["Out"][0]
-        else:
-            inp = x
-        k = w.shape[-1]
-        o = get("conv2d").impl(ctx, {"Input": [inp], "Filter": [w]}, {
-            "strides": [1, 1], "paddings": [k // 2, k // 2],
-            "dilations": [1, 1], "groups": 1})["Output"][0]
-        if biases[i] is not None:
-            o = o + biases[i].reshape(1, -1, 1, 1).astype(o.dtype)
-        outs.append(act(o))
-    out = jnp.concatenate(outs, axis=1)
-    return {"Output": [out], "TempOutput": outs[:2]}
+    ks = tuple(tuple(int(s) for s in w.shape[-2:])
+               for w in (w0, w1, w2, w3))
+    if ks != ((1, 1), (1, 1), (3, 3), (3, 3)):
+        raise ValueError(
+            "conv2d_inception_fusion models the reference kernel's fixed "
+            "1x1/1x1/3x3/3x3 branch shapes (fusion_conv_inception_op.cu "
+            "pads {0,0,1,1}); got kernel sizes %r" % (ks,))
+    ic2 = int(w2.shape[1])          # per-group in-channels, groups=2
+    oc1 = int(w1.shape[0]) - 2 * ic2
+    oc2 = int(w2.shape[0]) - int(w3.shape[1])
+    if oc1 < 0 or oc2 < 0:
+        raise ValueError(
+            "conv2d_inception_fusion channel contract violated: need "
+            "w1[0] >= 2*w2[1] and w2[0] >= w3[1] (InferShape:45-47); got "
+            "filters %r" % ([tuple(w.shape) for w in (w0, w1, w2, w3)],))
+
+    def conv(inp, w, pad, groups=1):
+        return get("conv2d").impl(ctx, {"Input": [inp], "Filter": [w]}, {
+            "strides": [1, 1], "paddings": [pad, pad],
+            "dilations": [1, 1], "groups": groups})["Output"][0]
+
+    def bias_act(o, b):
+        if b is not None:
+            o = o + b.reshape(1, -1, 1, 1).astype(o.dtype)
+        return act(o)
+
+    pool_out = get("pool2d").impl(ctx, {"X": [x]}, {
+        "pooling_type": attrs.get("pooling_type", "avg"),
+        "ksize": [3, 3], "strides": [1, 1], "paddings": [1, 1],
+        "exclusive": bool(attrs.get("exclusive", True))})["Out"][0]
+    b0 = bias_act(conv(pool_out, w0, pad=0), biases[0])
+    t1 = bias_act(conv(x, w1, pad=0), biases[1])
+    b1, u = t1[:, :oc1], t1[:, oc1:]
+    t2 = bias_act(conv(u, w2, pad=1, groups=2), biases[2])
+    b2, v = t2[:, :oc2], t2[:, oc2:]
+    b3 = bias_act(conv(v, w3, pad=1), biases[3])
+    out = jnp.concatenate([b0, b1, b2, b3], axis=1)
+    return {"Output": [out], "TempOutput": [pool_out, t2]}
 
 
 @register("fused_embedding_fc_lstm")

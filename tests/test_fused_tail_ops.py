@@ -40,18 +40,55 @@ def test_conv2d_fusion_matches_unfused():
     assert outs[0].shape[1] == 2 and outs[1].shape[1] == 4
 
 
-def test_conv2d_inception_fusion_branches():
+def test_conv2d_inception_fusion_matches_torch_composition():
+    """The kernel's chained dataflow (InferShape:40-49, .cu:203-217):
+    b1's tail channels feed the groups=2 conv, whose tail feeds b3 —
+    checked numerically against an INDEPENDENT torch composition."""
+    import pytest
+    import torch
+    import torch.nn.functional as F
+
     rng = np.random.RandomState(1)
-    x = jnp.asarray(rng.randn(2, 4, 6, 6).astype(np.float32))
-    filters = [jnp.asarray(rng.randn(c, 4, k, k).astype(np.float32))
-               for c, k in ((3, 1), (5, 1), (4, 3), (2, 5))]
-    biases = [jnp.asarray(rng.randn(f.shape[0]).astype(np.float32))
-              for f in filters]
+    oc0, oc1, ic2, oc2, ic3, oc3 = 3, 5, 2, 4, 2, 2
+    C = 4
+    x_np = rng.randn(2, C, 6, 6).astype(np.float32)
+    w0 = rng.randn(oc0, C, 1, 1).astype(np.float32)
+    w1 = rng.randn(oc1 + 2 * ic2, C, 1, 1).astype(np.float32)
+    w2 = rng.randn(oc2 + ic3, ic2, 3, 3).astype(np.float32)  # groups=2
+    w3 = rng.randn(oc3, ic3, 3, 3).astype(np.float32)
+    filters = [jnp.asarray(w) for w in (w0, w1, w2, w3)]
+    biases_np = [rng.randn(w.shape[0]).astype(np.float32)
+                 for w in (w0, w1, w2, w3)]
+    biases = [jnp.asarray(b) for b in biases_np]
     out = get("conv2d_inception_fusion").impl(
-        _ctx(), {"Input": [x], "Filter": filters, "Bias": biases},
-        {"activation": "relu"})["Output"][0]
-    assert out.shape == (2, 3 + 5 + 4 + 2, 6, 6)
-    assert float(jnp.min(out)) >= 0.0  # relu applied to every branch
+        _ctx(), {"Input": [jnp.asarray(x_np)], "Filter": filters,
+                 "Bias": biases},
+        {"activation": "relu", "pooling_type": "avg",
+         "exclusive": True})["Output"][0]
+    assert out.shape == (2, oc0 + oc1 + oc2 + oc3, 6, 6)
+
+    xt = torch.from_numpy(x_np)
+    ws = [torch.from_numpy(w) for w in (w0, w1, w2, w3)]
+    bs = [torch.from_numpy(b) for b in biases_np]
+    pool = F.avg_pool2d(xt, 3, stride=1, padding=1,
+                        count_include_pad=False)  # exclusive avg
+    b0 = F.relu(F.conv2d(pool, ws[0], bs[0]))
+    t1 = F.relu(F.conv2d(xt, ws[1], bs[1]))
+    b1, u = t1[:, :oc1], t1[:, oc1:]
+    t2 = F.relu(F.conv2d(u, ws[2], bs[2], padding=1, groups=2))
+    b2, v = t2[:, :oc2], t2[:, oc2:]
+    b3 = F.relu(F.conv2d(v, ws[3], bs[3], padding=1))
+    ref = torch.cat([b0, b1, b2, b3], dim=1).numpy()
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-5)
+
+    # shapes the cuDNN kernel does not model are rejected, not silently
+    # computed differently
+    bad = [filters[0], filters[1], filters[2],
+           jnp.asarray(rng.randn(2, ic3, 5, 5).astype(np.float32))]
+    with pytest.raises(ValueError, match="1x1/1x1/3x3/3x3"):
+        get("conv2d_inception_fusion").impl(
+            _ctx(), {"Input": [jnp.asarray(x_np)], "Filter": bad,
+                     "Bias": biases}, {"activation": "relu"})
 
 
 def test_fused_embedding_fc_lstm_matches_lookup_plus_lstm():

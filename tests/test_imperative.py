@@ -180,3 +180,26 @@ def test_traced_layer_requires_guard_and_varbase():
         with pytest.raises(TypeError, match="VarBase"):
             dygraph.TracedLayer.trace(
                 model, [np.zeros((1, 1, 8, 8), np.float32)])
+
+
+def test_traced_layer_tracks_continued_eager_training():
+    """The traced program SHARES the dygraph parameter storage (reference
+    TracedLayer semantics; round-4 advisor): eager updates to the layer
+    after tracing are visible to later traced calls, not frozen at the
+    trace-time snapshot."""
+    rng = np.random.RandomState(3)
+    x = rng.rand(4, 1, 8, 8).astype(np.float32)
+    with dygraph.guard():
+        model = SmallConvNet()
+        model.eval()
+        _, traced = dygraph.TracedLayer.trace(
+            model, [dygraph.to_variable(x)])
+        before, = traced([x])
+        # continued "training": shift every parameter in place
+        for p in model.parameters():
+            p.set_value(p.numpy() + 0.05)
+        eager_after = model(dygraph.to_variable(x)).numpy()
+        after, = traced([x])
+    assert not np.allclose(np.asarray(after), np.asarray(before))
+    np.testing.assert_allclose(np.asarray(after), eager_after,
+                               rtol=1e-5, atol=1e-6)

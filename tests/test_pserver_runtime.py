@@ -267,3 +267,42 @@ def test_lost_trainer_fails_barrier_loudly():
         for p in (survivor, victim, ps):
             if p.poll() is None:
                 p.kill()
+
+
+def test_exactly_once_window_keeps_concurrent_seqs():
+    """Bounded dedup WINDOW, not a single slot (round-4 advisor): with
+    seqs N and N+1 in flight concurrently from one thread-safe client,
+    N+1 completing must not evict N's claim — N's retry replays the
+    cached reply instead of re-executing the non-idempotent send."""
+    from paddle_tpu.distributed_runtime import MSG_OK, _ServerState
+
+    applied = []
+    st = _ServerState(fanin=1, sync_mode=False,
+                      apply_update=lambda g: applied.append(sorted(g)))
+
+    # first attempts of seqs 1 and 2 interleave: both claimed, 2 finishes
+    # first, then 1 finishes
+    assert st.claim(0, 1) is None
+    assert st.claim(0, 2) is None
+    st.on_send("w", 0, np.ones(2))
+    st.remember(0, 2, (MSG_OK, {}))
+    st.on_send("b", 0, np.ones(2))
+    st.remember(0, 1, (MSG_OK, {}))
+    # the retry of seq 1 (reply was lost) must find the cached reply —
+    # NOT re-apply the gradient
+    assert st.claim(0, 1) == (MSG_OK, {})
+    assert st.claim(0, 2) == (MSG_OK, {})
+    assert len(applied) == 2  # each send applied exactly once
+
+    # many newer completed RPCs must NOT evict an older completed entry
+    # (count-based eviction would re-execute a slow retry's send) — only
+    # the retry-deadline TTL may
+    for seq in range(3, 200):
+        assert st.claim(0, seq) is None
+        st.remember(0, seq, (MSG_OK, {}))
+    assert st.claim(0, 1) == (MSG_OK, {})
+
+    # past the TTL, completed entries are reclaimed at the next claim
+    st._dedup_ttl = lambda: 0.0
+    assert st.claim(0, 200) is None
+    assert len(st._last_reply[0]) == 1  # only the fresh in-flight claim
