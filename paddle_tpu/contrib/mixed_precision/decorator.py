@@ -24,7 +24,9 @@ class AutoMixedPrecisionLists:
     def __init__(self, custom_white_list=None, custom_black_list=None):
         self.white_list = {"matmul", "mul", "conv2d", "conv3d",
                            "depthwise_conv2d",
-                           "flash_attention"} | set(custom_white_list or ())
+                           "flash_attention",
+                           "fused_multihead_attention"} \
+            | set(custom_white_list or ())
         self.black_list = {"softmax", "softmax_with_cross_entropy",
                            "cross_entropy", "cross_entropy2", "mean",
                            "layer_norm", "batch_norm",

@@ -113,7 +113,16 @@ class ParameterServerClient:
                             else int(flag("rpc_retry_times")))
         self._socks = {}
         self._lock = threading.Lock()
-        self._seq = 0
+        # incarnation nonce: a restarted trainer process must not reuse
+        # seqs its previous life already registered in the server's
+        # exactly-once window (a collision silently replays the cached
+        # reply instead of applying the new send). A random 48-bit base
+        # per client instance makes cross-incarnation collision
+        # probability negligible while staying within int64 for the
+        # checkpointed seq table.
+        import random
+
+        self._seq = random.SystemRandom().randrange(1 << 48)
 
     def _sock(self, endpoint):
         s = self._socks.get(endpoint)

@@ -561,6 +561,54 @@ def _flash_attention_op(ctx, ins, attrs):
     return {"Out": [out.astype(out_dtype)]}
 
 
+@register("fused_multihead_attention")
+def _fused_multihead_attention(ctx, ins, attrs):
+    """The whole self-attention sublayer as ONE op: per-head q/k/v
+    projections, (flash) attention, and the output projection. TPU-native
+    analogue of the reference's fused attention inference kernels
+    (multihead_matmul_op.cu, fused/multihead_matmul_fuse_pass semantics)
+    — but used in TRAINING too, because on TPU the fusion is a layout
+    property, not just an op-count one: the projections are einsums
+    `btd,dhx->bthx` whose output keeps heads as real dot dimensions, so
+    the [B,H,T,Dh] operand order the flash kernel needs folds into the
+    dot's output layout. The unfused fc+split formulation flattens the
+    projection to a 2D dot, the head permutation cannot be a bitcast of
+    any 2D layout, and every q/k/v materializes an HBM copy — measured
+    ~34 ms/step (10% of device time) at flagship scale.
+
+    Inputs: X [B,T,D]; WQ/WK/WV [D,H,Dh]; WO [H,Dh,D]; optional BQ/BK/BV
+    [H,Dh] and BO [D]. Attrs: causal, sm_scale (default Dh^-0.5).
+    Output: [B,T,D]. Attention itself (ring-sp dispatch, Pallas/XLA
+    fallback) is delegated to the flash_attention op in bthd layout."""
+    x = ins["X"][0]
+    wq, wk, wv = ins["WQ"][0], ins["WK"][0], ins["WV"][0]
+    wo = ins["WO"][0]
+    if attrs.get("__amp_bf16__") and x.dtype == jnp.float32:
+        x = x.astype(jnp.bfloat16)
+    cdt = x.dtype
+    Dh = wq.shape[-1]
+
+    def proj(w, b):
+        y = jnp.einsum("btd,dhx->bthx", x, w.astype(cdt))
+        if b is not None:
+            y = y + b.astype(cdt)
+        return y
+
+    q = proj(wq, (ins.get("BQ") or [None])[0])
+    k = proj(wk, (ins.get("BK") or [None])[0])
+    v = proj(wv, (ins.get("BV") or [None])[0])
+    ctx_out = get("flash_attention").impl(ctx, {"Q": [q], "K": [k],
+                                               "V": [v]}, {
+        "causal": bool(attrs.get("causal", False)),
+        "sm_scale": attrs.get("sm_scale") or Dh ** -0.5,
+        "layout": "bthd"})["Out"][0]
+    out = jnp.einsum("bthx,hxd->btd", ctx_out, wo.astype(cdt))
+    bo = (ins.get("BO") or [None])[0]
+    if bo is not None:
+        out = out + bo.astype(cdt)
+    return {"Out": [out]}
+
+
 def _xla_softmax_attention(q, k, v, layout, causal, scale, Dh):
     """XLA-fused softmax attention with the head layout folded into the
     dots — shared by the non-Pallas fallback and the pipeline-safe
