@@ -144,6 +144,24 @@ def normalize_feed_value(block, name, arr):
     return arr
 
 
+def mesh_spans_processes(mesh):
+    """True when the mesh has devices owned by other processes (DCN case:
+    jax.distributed multi-host). Steps then must lift host values to global
+    jax.Arrays via `lift_to_global` before calling into jit."""
+    return any(d.process_index != jax.process_index()
+               for d in mesh.devices.flat)
+
+
+def lift_to_global(value, sharding):
+    """Host value -> global jax.Array on a multi-process mesh. Every
+    process holds the identical full value (the SPMD single-controller
+    contract: same global batch, same state) and materializes only its
+    addressable shards."""
+    v = np.asarray(value)
+    return jax.make_array_from_callback(v.shape, sharding,
+                                        lambda idx, a=v: a[idx])
+
+
 def grad_seed_scale_of(build_strategy, n_replicas):
     """GradientScaleStrategy -> backward seed factor (shared contract:
     CoeffNumDevice = exact global-mean gradients, One = gradients summed
@@ -318,9 +336,7 @@ class _DataParallelStep:
         # mesh spanning several processes (DCN): numpy feeds must become
         # global jax.Arrays — every worker feeds the identical global batch
         # and each process materializes only its addressable shards
-        self._multiprocess = any(
-            d.process_index != jax.process_index()
-            for d in mesh.devices.flat)
+        self._multiprocess = mesh_spans_processes(mesh)
 
         from .flags import flag
 
@@ -400,11 +416,8 @@ class _DataParallelStep:
                     return self._batch_seq
                 return self._batch
 
-            feeds = {
-                name: jax.make_array_from_callback(
-                    arr.shape, _feed_sharding(arr),
-                    lambda idx, a=arr: a[idx])
-                for name, arr in feeds.items()}
+            feeds = {name: lift_to_global(arr, _feed_sharding(arr))
+                     for name, arr in feeds.items()}
             for store in (mut, const):
                 for name, val in store.items():
                     # only host values need lifting to global arrays; after
@@ -416,9 +429,7 @@ class _DataParallelStep:
                             val.sharding.is_equivalent_to(want,
                                                           np.ndim(val)):
                         continue
-                    v = np.asarray(val)
-                    store[name] = jax.make_array_from_callback(
-                        v.shape, want, lambda idx, a=v: a[idx])
+                    store[name] = lift_to_global(val, want)
         ctr = np.uint32(scope.get("__step_counter__", 0) or 0)
         fetches, new_state, finite, warns = self._jitted(mut, const,
                                                          feeds, ctr)

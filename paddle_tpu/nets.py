@@ -79,7 +79,7 @@ def scaled_dot_product_attention(queries, keys, values, num_heads=1,
     batched MXU matmuls; the dropout-free path dispatches the fused
     flash-attention Pallas kernel."""
     if queries.shape[-1] % num_heads != 0:
-        raise ValueError("hidden size must divide num_heads")
+        raise ValueError("num_heads must divide the hidden size")
     d = queries.shape[-1]
     dk = d // num_heads
 
@@ -154,8 +154,8 @@ def fused_multihead_attention(input, num_heads, causal=False,
 
     d = input.shape[-1]
     if d % num_heads:
-        raise ValueError("hidden size %d must divide num_heads %d"
-                         % (d, num_heads))
+        raise ValueError("num_heads %d must divide the hidden size %d"
+                         % (num_heads, d))
     dh = d // num_heads
     helper = LayerHelper("fused_multihead_attention", **locals())
     base = name or helper.name

@@ -221,12 +221,29 @@ class PipelineProgramStep:
             raise ValueError(
                 "pipeline_stages > 1 needs with_data_parallel(loss_name=...) "
                 "so the 1F1B schedule knows which scalar to differentiate")
-        if any(d.process_index != jax.process_index()
-               for d in mesh.devices.flat):
-            raise NotImplementedError(
-                "descriptor-path pipeline parallelism currently targets a "
-                "single-process mesh (ICI); combine with jax.distributed "
-                "dp via fleet for multi-host")
+        # Multi-process (DCN) meshes are allowed when the pp axis stays
+        # within a process: the 1F1B ring's ppermute then rides local
+        # devices (ICI on TPU pods) and only the dp gradient psum crosses
+        # processes — the reference's multi-NODE shape (nccl_helper.h:130
+        # multi-node ncclCommInitRank; dp between nodes, model parallel
+        # within). A pp axis that itself spans processes needs
+        # cross-process collective-permute, which XLA:CPU's Gloo backend
+        # does not provide — on TPU (DCN ppermute exists) it is untested
+        # here for lack of multi-host hardware, so refuse off-TPU.
+        ax = mesh.axis_names.index("pp") if "pp" in mesh.axis_names else None
+        if ax is not None:
+            cols = np.moveaxis(mesh.devices, ax, 0)
+            cols = cols.reshape(cols.shape[0], -1)
+            pp_crosses = any(
+                len({d.process_index for d in cols[:, j]}) > 1
+                for j in range(cols.shape[1]))
+            if pp_crosses and mesh.devices.flat[0].platform == "cpu":
+                raise NotImplementedError(
+                    "the pipeline axis spans processes, which needs "
+                    "cross-process collective-permute (unavailable on "
+                    "XLA:CPU). Lay out the mesh so pp is within a "
+                    "process — dp over processes, pp/tp/sp within — or "
+                    "run on a TPU pod slice.")
         from ..flags import flag as _flag
 
         if bool(_flag("check_nan_inf")):
@@ -242,6 +259,10 @@ class PipelineProgramStep:
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
         self.mesh = mesh
+        from ..compiler import mesh_spans_processes
+
+        self._multiprocess = mesh_spans_processes(mesh)
+        self._mesh_devs = set(mesh.devices.flat)
         self.loss_name = loss_name
         block = program.global_block()
         self.block = block
@@ -742,12 +763,35 @@ class PipelineProgramStep:
     # host-side driver (same contract as _DataParallelStep.run)
     # ------------------------------------------------------------------
     def run(self, scope, feed):
-        from ..compiler import normalize_feed_value, read_persistable_state
+        from ..compiler import (lift_to_global, normalize_feed_value,
+                                read_persistable_state)
 
         mut, const = read_persistable_state(scope, self.mut_names,
                                             self.const_names)
         feeds = {name: normalize_feed_value(self.block, name, feed[name])
                  for name in self.feed_names}
+        if self._multiprocess:
+            # DCN case: jit on a multi-process mesh takes only global
+            # jax.Arrays. Feeds lift replicated (every worker feeds the
+            # identical global batch; the shard_map in_specs reshard the
+            # data feeds over dp), state lifts to its planned sharding
+            # unless the scope already holds a correctly-sharded array
+            # from the previous step.
+            def _is_global(a):
+                return (isinstance(a, jax.Array)
+                        and set(a.sharding.device_set) == self._mesh_devs)
+
+            feeds = {n: (a if _is_global(a)
+                         else lift_to_global(a, self._repl))
+                     for n, a in feeds.items()}
+            for store in (mut, const):
+                for name, val in store.items():
+                    want = self._state_shardings.get(name, self._repl)
+                    if isinstance(val, jax.Array) and \
+                            val.sharding.is_equivalent_to(want,
+                                                          np.ndim(val)):
+                        continue
+                    store[name] = lift_to_global(val, want)
         ctr = np.uint32(scope.get("__step_counter__", 0) or 0)
         fetches, new_state = self._jitted(mut, const, feeds, ctr)
         for name, val in new_state.items():

@@ -1,0 +1,76 @@
+"""Runnable multi-process MODEL-parallel worker (parity: the reference's
+multi-node NCCL training — platform/nccl_helper.h:130 multi-node
+ncclCommInitRank, transpiler/distribute_transpiler.py:247 nccl2 mode —
+recast TPU-native: dp over processes via jax.distributed (DCN), tp/sp/pp
+within each process (ICI), one SPMD program over the global mesh).
+
+Env contract: PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+PADDLE_COORDINATOR_ADDR (PaddleCloudRoleMaker) select the distributed
+run; PADDLE_MP_MODE in {tp, sp, pp} picks the model-parallel axis;
+PADDLE_MP_LOCAL_DEVICES virtual CPU devices per process. Run with no
+distributed env and PADDLE_MP_LOCAL_DEVICES=4 for the single-process
+baseline on the identical 4-device mesh.
+
+Prints per-step `loss:<float>` lines for the parent test to compare.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from xla_env import stage_host_mesh_flags  # noqa: E402
+
+stage_host_mesh_flags(int(os.environ.get("PADDLE_MP_LOCAL_DEVICES", "2")))
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu.models import transformer_fluid  # noqa: E402
+from paddle_tpu.parallel.fleet import fleet  # noqa: E402
+
+
+def main(steps=5, batch=8, seq=64, vocab=64):
+    mode = os.environ.get("PADDLE_MP_MODE", "tp")
+    fleet.init()
+
+    tokens, labels, loss = transformer_fluid.build(
+        vocab_size=vocab, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        seq_len=seq, remat=True)
+    opt = fluid.optimizer.Adam(learning_rate=1e-3)
+    if fleet.worker_num() > 1:
+        opt = fleet.distributed_optimizer(opt)
+    opt.minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    bs = fluid.BuildStrategy()
+    if mode == "tp":
+        bs.tensor_parallel_degree = 2
+    elif mode == "sp":
+        bs.sequence_parallel_degree = 2
+    elif mode == "pp":
+        bs.pipeline_stages = 2
+    else:
+        raise SystemExit("unknown PADDLE_MP_MODE %r" % mode)
+    prog = fluid.CompiledProgram(fluid.default_main_program()) \
+        .with_data_parallel(loss_name=loss.name, build_strategy=bs)
+
+    rng = np.random.RandomState(0)  # same global batch on every worker
+    for _ in range(steps):
+        xb = rng.randint(0, vocab, (batch, seq)).astype(np.int32)
+        yb = rng.randint(0, vocab, (batch, seq)).astype(np.int32)
+        (lv,) = exe.run(prog, feed={"tokens": xb, "labels": yb},
+                        fetch_list=[loss.name])
+        print("loss:%.8f" % float(np.asarray(lv).reshape(-1)[0]),
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()
