@@ -201,12 +201,30 @@ def save_train_model(dirname, feeded_var_names, target_vars, executor,
 
 
 def load_inference_model(dirname, executor, model_filename=None,
-                         params_filename=None, pserver_endpoints=None):
+                         params_filename=None, pserver_endpoints=None,
+                         reference_format=None):
+    """reference_format: True forces parsing `__model__` as the
+    reference's framework.proto ProgramDesc binary (+ save/save_combine
+    LoDTensor param files); False forces this package's sealed-JSON
+    format; None (default) sniffs the bytes (reference_format.py)."""
     from .core import native, serde
 
     model_path = os.path.join(dirname, model_filename or "__model__")
     with open(model_path, "rb") as f:
         raw = f.read()
+    if reference_format is None:
+        from .reference_format import is_reference_program_bytes
+
+        reference_format = is_reference_program_bytes(raw)
+    if reference_format:
+        from . import reference_format as refmt
+
+        program, feed_names, fetch_names = \
+            refmt.program_from_reference_bytes(raw)
+        refmt.load_reference_persistables(dirname, program,
+                                          filename=params_filename)
+        fetch_vars = [program.global_block().var(n) for n in fetch_names]
+        return [program, feed_names, fetch_vars]
     try:
         meta = json.loads(native.program_unseal(raw).decode("utf-8"))
     except ValueError:

