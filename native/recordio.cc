@@ -28,6 +28,12 @@ namespace {
 
 constexpr uint32_t kChunkMagic = 0x50545243;   // "PTRC"
 constexpr uint32_t kChunkMagicZ = 0x5A545243;  // "PTRZ" (deflate)
+// the reference's chunk magic (recordio/header.h kMagicNumber): files the
+// reference wrote — header u32x5 {magic, num_records, crc32-of-stored-
+// payload, compressor, compress_size}, payload (u32 len + bytes)* behind
+// optional snappy FRAMING-format compression (chunk.cc:79-96) — are
+// accepted on READ so reference datasets migrate without rewriting
+constexpr uint32_t kRefMagic = 0x01020304;
 // sanity bound on header-declared sizes: a torn/corrupt header must come
 // back as the -2 "bad chunk" error, not a std::bad_alloc through the C
 // ABI. Writers cap chunks at max_bytes (default 1 MiB) + one record, so
@@ -49,6 +55,141 @@ uint32_t crc32_impl(const char* data, uint64_t len) {
     len -= n;
   }
   return static_cast<uint32_t>(c);
+}
+
+// ---- snappy decode (read-side compat with reference kSnappy chunks) ----
+// Raw snappy block format + the snappy framing format, implemented from
+// the public format spec; write-side stays DEFLATE (zlib ships
+// everywhere, snappy does not).
+
+struct Crc32cTable {
+  uint32_t t[256];
+  Crc32cTable() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++)
+        c = (c & 1) ? 0x82F63B78u ^ (c >> 1) : c >> 1;
+      t[i] = c;
+    }
+  }
+};
+
+uint32_t crc32c_impl(const uint8_t* p, size_t n) {
+  static const Crc32cTable tab;  // CRC-32C (Castagnoli) — the framing
+                                 // format's per-chunk checksum
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++) c = tab.t[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+inline uint32_t le32(const uint8_t* p) {
+  return static_cast<uint32_t>(p[0]) | (static_cast<uint32_t>(p[1]) << 8) |
+         (static_cast<uint32_t>(p[2]) << 16) |
+         (static_cast<uint32_t>(p[3]) << 24);
+}
+
+// one raw snappy block: varint uncompressed length, then literal/copy
+// elements. Returns false on any malformed input (bounds, bad offsets,
+// length mismatch) — the caller maps that to the -2 bad-chunk error.
+bool snappy_block_uncompress(const uint8_t* src, size_t n,
+                             std::string* out) {
+  size_t pos = 0;
+  uint64_t ulen = 0;
+  int shift = 0;
+  while (true) {
+    if (pos >= n || shift > 35) return false;
+    uint8_t b = src[pos++];
+    ulen |= static_cast<uint64_t>(b & 0x7F) << shift;
+    if (!(b & 0x80)) break;
+    shift += 7;
+  }
+  if (ulen >= kMaxChunkBytes) return false;
+  out->clear();
+  out->reserve(ulen);
+  while (pos < n) {
+    uint8_t tag = src[pos++];
+    uint32_t kind = tag & 3;
+    if (kind == 0) {  // literal
+      uint64_t len = tag >> 2;
+      if (len >= 60) {
+        uint32_t nb = static_cast<uint32_t>(len) - 59;  // 1..4 bytes
+        if (pos + nb > n) return false;
+        len = 0;
+        for (uint32_t i = 0; i < nb; i++)
+          len |= static_cast<uint64_t>(src[pos + i]) << (8 * i);
+        pos += nb;
+      }
+      len += 1;
+      if (pos + len > n || out->size() + len > ulen) return false;
+      out->append(reinterpret_cast<const char*>(src + pos), len);
+      pos += len;
+    } else {  // copy
+      uint64_t len, offset;
+      if (kind == 1) {
+        if (pos + 1 > n) return false;
+        len = ((tag >> 2) & 0x7) + 4;
+        offset = (static_cast<uint32_t>(tag >> 5) << 8) | src[pos];
+        pos += 1;
+      } else if (kind == 2) {
+        if (pos + 2 > n) return false;
+        len = (tag >> 2) + 1;
+        offset = src[pos] | (static_cast<uint32_t>(src[pos + 1]) << 8);
+        pos += 2;
+      } else {
+        if (pos + 4 > n) return false;
+        len = (tag >> 2) + 1;
+        offset = le32(src + pos);
+        pos += 4;
+      }
+      if (offset == 0 || offset > out->size() ||
+          out->size() + len > ulen)
+        return false;
+      size_t from = out->size() - offset;  // may overlap: byte-wise
+      for (uint64_t i = 0; i < len; i++) out->push_back((*out)[from + i]);
+    }
+  }
+  return out->size() == ulen;
+}
+
+// snappy framing format: (type u8, len u24le, body)*; 0xff stream id
+// "sNaPpY", 0x00 compressed / 0x01 uncompressed data chunks carry a
+// masked CRC-32C of the UNCOMPRESSED content, 0xfe/0x80-0xfd skippable.
+bool snappy_framed_uncompress(const std::string& in, std::string* out) {
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(in.data());
+  size_t n = in.size(), pos = 0;
+  out->clear();
+  std::string piece;
+  while (pos < n) {
+    if (pos + 4 > n) return false;
+    uint8_t type = p[pos];
+    uint32_t len = p[pos + 1] | (static_cast<uint32_t>(p[pos + 2]) << 8) |
+                   (static_cast<uint32_t>(p[pos + 3]) << 16);
+    pos += 4;
+    if (pos + len > n) return false;
+    const uint8_t* body = p + pos;
+    if (type == 0xFF) {
+      if (len != 6 || memcmp(body, "sNaPpY", 6) != 0) return false;
+    } else if (type == 0x00 || type == 0x01) {
+      if (len < 4) return false;
+      uint32_t masked = le32(body);
+      if (type == 0x00) {
+        if (!snappy_block_uncompress(body + 4, len - 4, &piece))
+          return false;
+      } else {
+        piece.assign(reinterpret_cast<const char*>(body + 4), len - 4);
+      }
+      uint32_t crc = crc32c_impl(
+          reinterpret_cast<const uint8_t*>(piece.data()), piece.size());
+      uint32_t want = ((crc >> 15) | (crc << 17)) + 0xa282ead8u;
+      if (want != masked) return false;
+      if (out->size() + piece.size() >= kMaxChunkBytes) return false;
+      out->append(piece);
+    } else if (type >= 0x02 && type <= 0x7F) {
+      return false;  // reserved unskippable
+    }  // 0x80-0xfd reserved skippable, 0xfe padding: skip
+    pos += len;
+  }
+  return true;
 }
 
 struct Writer {
@@ -114,6 +255,7 @@ struct Scanner {
     uint32_t magic, num, crc;
     uint64_t bytes;
     if (fread(&magic, 4, 1, f) != 1) return -1;  // EOF
+    if (magic == kRefMagic) return load_reference_chunk();
     if (magic != kChunkMagic && magic != kChunkMagicZ) return -2;
     if (fread(&num, 4, 1, f) != 1) return -2;
     if (fread(&bytes, 8, 1, f) != 1) return -2;
@@ -145,6 +287,34 @@ struct Scanner {
       if (bytes && fread(&chunk[0], 1, bytes, f) != bytes) return -2;
     }
     if (crc32_impl(chunk.data(), bytes) != crc) return -2;
+    offset = 0;
+    return 0;
+  }
+
+  int load_reference_chunk() {
+    // header tail after the magic: num_records, checksum (zlib crc32 of
+    // the payload AS STORED, i.e. post-compression — chunk.cc:108),
+    // compressor, compress_size
+    uint32_t num, checksum, compressor, csize;
+    if (fread(&num, 4, 1, f) != 1) return -2;
+    if (fread(&checksum, 4, 1, f) != 1) return -2;
+    if (fread(&compressor, 4, 1, f) != 1) return -2;
+    if (fread(&csize, 4, 1, f) != 1) return -2;
+    if (csize >= kMaxChunkBytes) return -2;
+    try {
+      std::string stored(csize, '\0');
+      if (csize && fread(&stored[0], 1, csize, f) != csize) return -2;
+      if (crc32_impl(stored.data(), csize) != checksum) return -2;
+      if (compressor == 0) {  // kNoCompress
+        chunk = std::move(stored);
+      } else if (compressor == 1) {  // kSnappy
+        if (!snappy_framed_uncompress(stored, &chunk)) return -2;
+      } else {
+        return -2;  // kGzip is unimplemented in the reference too
+      }
+    } catch (const std::bad_alloc&) {
+      return -2;
+    }
     offset = 0;
     return 0;
   }
