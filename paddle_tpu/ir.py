@@ -28,7 +28,7 @@ them, so user passes and builtins compose through one pipeline.
 from .core.scope import global_scope
 
 __all__ = ["Pass", "register_pass", "unregister_pass", "get_pass",
-           "apply_passes", "registered_passes", "match_chain"]
+           "apply_passes", "registered_passes", "match_chain", "Pattern"]
 
 
 class Pass:
@@ -159,6 +159,155 @@ def match_chain(block, types, single_consumer=True):
 
 
 # ---------------------------------------------------------------------------
+# DAG pattern matching (graph_pattern_detector.h:254 PDNode/PDPattern —
+# the general case match_chain cannot express: multi-input consumers,
+# slot-pinned edges, shared producers)
+# ---------------------------------------------------------------------------
+
+
+class _Edge:
+    __slots__ = ("src", "dst", "dst_slot", "src_slot", "single_consumer")
+
+    def __init__(self, src, dst, dst_slot, src_slot, single_consumer):
+        self.src, self.dst = src, dst
+        self.dst_slot, self.src_slot = dst_slot, src_slot
+        self.single_consumer = single_consumer
+
+
+class Pattern:
+    """Declarative op-DAG pattern: named nodes + dataflow edges.
+
+        p = fluid.ir.Pattern()
+        p.op("convA", "conv2d")
+        p.op("convB", "conv2d")
+        p.op("add", "elementwise_add")
+        p.edge("convA", "add", dst_slot="X")
+        p.edge("convB", "add", dst_slot="Y")
+        for m in p.match(block):            # {"convA": op, ...}
+            ...
+
+    Node `type` is one op type or a tuple of alternatives; `pred(op)`
+    adds an arbitrary per-node test. An edge means: some output var of
+    `src` (restricted to `src_slot` if given) is an input var of `dst`
+    (restricted to `dst_slot` if given); with single_consumer (the safe
+    default for rewrites) that linking var must have exactly ONE
+    consuming op in the block, so deleting the matched interior never
+    orphans an outside reader. Matches are maximal assignments yielded
+    in program order of the first-declared node, never share an op, and
+    see a SNAPSHOT of the op list (same contract as match_chain)."""
+
+    def __init__(self):
+        self._nodes = {}   # name -> (types tuple or None, pred or None)
+        self._order = []
+        self._edges = []
+
+    def op(self, name, type=None, pred=None):
+        if name in self._nodes:
+            raise ValueError("pattern node %r already defined" % name)
+        types = (type,) if isinstance(type, str) else \
+            (tuple(type) if type is not None else None)
+        self._nodes[name] = (types, pred)
+        self._order.append(name)
+        return name
+
+    def edge(self, src, dst, dst_slot=None, src_slot=None,
+             single_consumer=True):
+        for n in (src, dst):
+            if n not in self._nodes:
+                raise ValueError("pattern node %r not defined" % n)
+        self._edges.append(_Edge(src, dst, dst_slot, src_slot,
+                                 single_consumer))
+        return self
+
+    # -- matching ----------------------------------------------------------
+    def _topo(self):
+        """Pattern nodes in dependency order (edge sources first),
+        insertion order as the tie-break; cycles are an error."""
+        indeg = {n: 0 for n in self._order}
+        for e in self._edges:
+            indeg[e.dst] += 1
+        out = []
+        ready = [n for n in self._order if indeg[n] == 0]
+        while ready:
+            n = ready.pop(0)
+            out.append(n)
+            for e in self._edges:
+                if e.src == n:
+                    indeg[e.dst] -= 1
+                    if indeg[e.dst] == 0:
+                        ready.append(e.dst)
+        if len(out) != len(self._order):
+            raise ValueError("pattern has a cycle")
+        return out
+
+    def match(self, block):
+        ops = list(block.ops)
+        order = {id(op): i for i, op in enumerate(ops)}
+        cons = _consumers(block)
+        topo = self._topo()
+        claimed = set()
+
+        def link_ok(e, src_op, dst_op):
+            outs = src_op.output_names(e.src_slot) if e.src_slot \
+                else src_op.output_names()
+            ins = dst_op.input_names(e.dst_slot) if e.dst_slot \
+                else dst_op.input_names()
+            link = set(outs) & set(ins)
+            if e.single_consumer:
+                link = {n for n in link
+                        if len([u for u in cons.get(n, [])
+                                if id(u) in order]) == 1}
+            return bool(link)
+
+        def node_ok(name, op, assign):
+            types, pred = self._nodes[name]
+            if types is not None and op.type not in types:
+                return False
+            if id(op) in claimed:
+                return False
+            if any(o is op for o in assign.values()):
+                return False  # injective
+            if pred is not None and not pred(op):
+                return False
+            return all(link_ok(e, assign[e.src], op)
+                       for e in self._edges
+                       if e.dst == name and e.src in assign)
+
+        def extend(assign, k):
+            if k == len(topo):
+                yield dict(assign)
+                return
+            name = topo[k]
+            in_edges = [e for e in self._edges
+                        if e.dst == name and e.src in assign]
+            if in_edges:
+                e0 = in_edges[0]
+                src_op = assign[e0.src]
+                outs = src_op.output_names(e0.src_slot) if e0.src_slot \
+                    else src_op.output_names()
+                seen, cands = set(), []
+                for vn in outs:
+                    for u in cons.get(vn, []):
+                        if id(u) in order and id(u) not in seen:
+                            seen.add(id(u))
+                            cands.append(u)
+            else:
+                cands = ops
+            for op in sorted(cands, key=lambda o: order[id(o)]):
+                if not node_ok(name, op, assign):
+                    continue
+                assign[name] = op
+                yield from extend(assign, k + 1)
+                del assign[name]
+
+        for m in extend({}, 0):
+            if any(id(op) in claimed for op in m.values()):
+                continue
+            claimed.update(id(op) for op in m.values())
+            yield m
+
+
+# ---------------------------------------------------------------------------
 # built-in passes (the transpilers delegate here)
 # ---------------------------------------------------------------------------
 
@@ -177,8 +326,18 @@ class ConvBNFoldPass(Pass):
         scope = scope if scope is not None else global_scope()
         block = program.global_block()
         changed = False
-        for conv, add, bn in match_chain(
-                block, ("conv2d", "elementwise_add", "batch_norm")):
+        # the add variant is a DAG shape: conv feeds the add's X slot
+        # specifically (the bias rides Y), and bn consumes the add —
+        # expressed declaratively on Pattern (conv_bn_fuse_pass.cc's
+        # conv->elementwise_add->batch_norm PDPattern)
+        p = Pattern()
+        p.op("conv", "conv2d")
+        p.op("add", "elementwise_add")
+        p.op("bn", "batch_norm")
+        p.edge("conv", "add", dst_slot="X")
+        p.edge("add", "bn", dst_slot="X")
+        for m in p.match(block):
+            conv, add, bn = m["conv"], m["add"], m["bn"]
             if _fold_bn_weights(conv, bn, scope, add.input_names("Y")[0]):
                 add.outputs["Out"] = bn.outputs["Y"]
                 block.ops.remove(bn)
@@ -227,6 +386,54 @@ class DropoutRemovePass(Pass):
                 op.inputs[slot] = [rename.get(v.name, v) for v in vs]
             new_ops.append(op)
         block.ops = new_ops
+        if changed:
+            program._bump_version()
+        return program
+
+
+@register_pass("conv_elementwise_add_fuse")
+class ConvResidualAddFusePass(Pass):
+    """conv2d + same-shape elementwise_add(residual) [+ relu] ->
+    conv2d_fusion carrying ResidualData (the reference's
+    conv_elementwise_add_fuse_pass.cc / conv_elementwise_add_act_fuse —
+    multi-input PDPatterns the linear matcher cannot express: the
+    residual operand comes from OUTSIDE the chain). Bias-style adds
+    (axis=1 with a 1-D operand) are left for conv_bn_fold."""
+
+    def apply(self, program, scope=None):
+        from .framework import Operator
+
+        block = program.global_block()
+        changed = False
+        for with_act in (True, False):  # longest pattern first
+            p = Pattern()
+            p.op("conv", "conv2d")
+            p.op("add", "elementwise_add",
+                 pred=lambda op: int(op.attrs.get("axis", -1)) in (-1, 0)
+                 and len(op.input_names("Y")) == 1)
+            p.edge("conv", "add", dst_slot="X")
+            if with_act:
+                p.op("act", "relu")
+                p.edge("add", "act", dst_slot="X")
+            for m in p.match(block):
+                conv, add = m["conv"], m["add"]
+                last = m["act"] if with_act else add
+                fused = Operator(
+                    block, "conv2d_fusion",
+                    inputs={"Input": conv.inputs["Input"],
+                            "Filter": conv.inputs["Filter"],
+                            "ResidualData": add.inputs["Y"]},
+                    outputs={"Output": last.outputs["Out"]},
+                    attrs=dict(conv.attrs,
+                               activation="relu" if with_act
+                               else "identity"))
+                # splice at the LAST op's position: every input
+                # (conv operands + the residual) is produced by then
+                block.ops[block.ops.index(last)] = fused
+                for o in (conv, add):
+                    if o is not last:
+                        block.ops.remove(o)
+                changed = True
         if changed:
             program._bump_version()
         return program
