@@ -74,8 +74,19 @@ class BuildStrategy:
         #     annotations) and trained with a 1F1B microbatch schedule
         #     (parallel/pipeline_program.py)
         #   pipeline_microbatches — microbatches per step (default: pp)
+        #   pipeline_virtual_stages — Megatron-style interleaving: each
+        #     rank hosts this many non-contiguous layer chunks (virtual
+        #     stage s lives on rank s % pp), shrinking the fill/drain
+        #     bubble (schedule + accounting: parallel/pipeline_schedule.py,
+        #     measured table in docs/PARALLEL.md)
+        #   pipeline_activation_stash — backward units consume residuals
+        #     stashed at forward time instead of rematerializing the
+        #     chunk forward: ~one forward less compute per microbatch,
+        #     O(in-flight) x chunk-activations more HBM (docs/PARALLEL.md)
         self.pipeline_stages = 1
         self.pipeline_microbatches = None
+        self.pipeline_virtual_stages = 1
+        self.pipeline_activation_stash = False
         #   sequence_parallel_degree — sp axis size; self-attention runs as
         #     ring attention over sp ranks (K/V ppermute rotation, O(T/sp)
         #     per-chip memory) and the residual stream seq-shards by GSPMD

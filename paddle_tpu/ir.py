@@ -407,10 +407,21 @@ class ConvResidualAddFusePass(Pass):
         changed = False
         for with_act in (True, False):  # longest pattern first
             p = Pattern()
+            def _same_shape_residual(op):
+                # Fluid's axis-broadcast add (a [N,C] Y at axis=0, a bias
+                # at axis=1) is NOT a residual: conv2d_fusion's
+                # ResidualData adds element-wise, so only a Y of exactly
+                # the conv output's rank+shape may fuse
+                xs, ys = op.inputs.get("X", []), op.inputs.get("Y", [])
+                if len(ys) != 1 or not xs:
+                    return False
+                xshape = getattr(xs[0], "shape", None)
+                yshape = getattr(ys[0], "shape", None)
+                return (xshape is not None and yshape is not None
+                        and tuple(xshape) == tuple(yshape))
+
             p.op("conv", "conv2d")
-            p.op("add", "elementwise_add",
-                 pred=lambda op: int(op.attrs.get("axis", -1)) in (-1, 0)
-                 and len(op.input_names("Y")) == 1)
+            p.op("add", "elementwise_add", pred=_same_shape_residual)
             p.edge("conv", "add", dst_slot="X")
             if with_act:
                 p.op("act", "relu")

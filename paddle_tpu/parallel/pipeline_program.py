@@ -202,6 +202,88 @@ class _CutLayout:
             off += k
 
 
+class _ResidLayout:
+    """Packed layout for one stage's vjp residual leaves (activation-
+    stash mode): inexact leaves ride the fp32 buffer (bf16/f16/f32 cast
+    is exact), 4-byte integer kinds bitcast onto the int32 buffer
+    (uint32 RNG keys round-trip bit-exactly), narrower ints/bool ride
+    int32 by value. The treedef is captured from an eval_shape probe of
+    the SAME vjp the real trace runs, so unflattening stashed leaves at
+    backward time reconstructs an identical vjp function."""
+
+    def __init__(self, treedef, avals, rebind):
+        self.treedef = treedef
+        self.records = []  # (kind, shape, dtype, rebind_ref)
+        for (shape, dtype), ref in zip(avals, rebind):
+            d = np.dtype(dtype)
+            if ref is not None:
+                # this residual IS a live param/constant (identity-
+                # matched at probe time): rebind at backward instead of
+                # stashing N in-flight fp32 copies of the weights
+                self.records.append(("rebind", tuple(shape), d, ref))
+                continue
+            if np.issubdtype(d, np.inexact) or d == jnp.bfloat16:
+                kind = "f"
+            elif d.kind in "iub" and d.itemsize == 4:
+                kind = "bitcast"
+            elif d.kind in "iub" and d.itemsize < 4:
+                kind = "i"
+            else:
+                raise NotImplementedError(
+                    "pipeline_activation_stash cannot pack a residual of "
+                    "dtype %s — use the default recompute mode" % d)
+            self.records.append((kind, tuple(shape), d, None))
+        self.nf = sum(_numel(s) for k, s, _, _ in self.records
+                      if k == "f")
+        self.ni = sum(_numel(s) for k, s, _, _ in self.records
+                      if k in ("bitcast", "i"))
+
+    def pack(self, leaves, nf_max, ni_max):
+        fparts, iparts = [], []
+        for leaf, (kind, s, d, _) in zip(leaves, self.records):
+            if kind == "rebind":
+                continue
+            if kind == "f":
+                fparts.append(leaf.astype(jnp.float32).reshape(-1))
+            elif kind == "bitcast":
+                iparts.append(jax.lax.bitcast_convert_type(
+                    leaf, jnp.int32).reshape(-1))
+            else:
+                iparts.append(leaf.astype(jnp.int32).reshape(-1))
+        f = (jnp.concatenate(fparts) if fparts
+             else jnp.zeros((0,), jnp.float32))
+        i = (jnp.concatenate(iparts) if iparts
+             else jnp.zeros((0,), jnp.int32))
+        return (jnp.pad(f, (0, nf_max - f.shape[0])),
+                jnp.pad(i, (0, ni_max - i.shape[0])))
+
+    def unpack(self, f, i, sources):
+        """sources: {"d": dparam leaves, "c": cparam leaves} — the LIVE
+        values rebound into their residual positions (constant within a
+        step, so value-identical to what a stash would return)."""
+        leaves = []
+        foff = ioff = 0
+        for kind, s, d, ref in self.records:
+            if kind == "rebind":
+                leaves.append(sources[ref[0]][ref[1]])
+                continue
+            k = _numel(s)
+            if kind == "f":
+                leaves.append(jax.lax.slice_in_dim(f, foff, foff + k)
+                              .reshape(s).astype(d))
+                foff += k
+            elif kind == "bitcast":
+                leaves.append(jax.lax.bitcast_convert_type(
+                    jax.lax.slice_in_dim(i, ioff, ioff + k).reshape(s),
+                    d))
+                ioff += k
+            else:
+                leaves.append(jax.lax.slice_in_dim(i, ioff, ioff + k)
+                              .reshape(s).astype(d))
+                ioff += k
+        return leaves
+
+
 # ---------------------------------------------------------------------------
 # the pipelined step
 # ---------------------------------------------------------------------------
@@ -275,7 +357,15 @@ class PipelineProgramStep:
             raise ValueError(
                 "pipeline_microbatches (%d) must be >= pipeline_stages (%d)"
                 % (self.M, self.pp))
+        self.v = int(getattr(build_strategy, "pipeline_virtual_stages", 1)
+                     or 1)
+        self.S = self.v * self.pp  # virtual stages; stage s on rank s%pp
+        self.stash_activations = bool(getattr(
+            build_strategy, "pipeline_activation_stash", False))
         self._seed = program.random_seed or 0
+        from .pipeline_schedule import build_schedule
+
+        self.schedule = build_schedule(self.pp, self.M, self.v)
 
         self.fwd_ops, self.post_ops = split_sections(block)
         if not any(_is_backward_op(op) for op in block.ops):
@@ -283,7 +373,20 @@ class PipelineProgramStep:
                 "pipeline_stages > 1 needs a training program (append "
                 "backward via optimizer.minimize); for inference use "
                 "dp/tp sharding instead")
-        self.stage_of = assign_stages(self.fwd_ops, self.pp)
+        if self.v > 1 and any(
+                op.attrs.get("__pipeline_stage__") is not None
+                for op in self.fwd_ops):
+            # explicit stamps mean PHYSICAL stages 0..pp-1; silently
+            # reinterpreting them as virtual-stage ids would leave v-1
+            # chunks empty (all of K's extra ticks, none of the win)
+            raise NotImplementedError(
+                "fluid.pipeline_stage(i) annotations name physical "
+                "stages and do not compose with "
+                "pipeline_virtual_stages > 1 — drop the annotations "
+                "(the balanced auto-split spreads ops over all %d "
+                "virtual chunks) or set pipeline_virtual_stages=1"
+                % self.S)
+        self.stage_of = assign_stages(self.fwd_ops, self.S)
 
         # ---- dataflow over the forward section -------------------------
         feed_set = set(self.feed_names)
@@ -329,7 +432,7 @@ class PipelineProgramStep:
         self.produced_at = produced_at
         # crossing[c]: produced at stage <= c, still consumed after cut c
         self.crossing = []
-        for c in range(self.pp - 1):
+        for c in range(self.S - 1):
             names = sorted(
                 n for n in produced_at
                 if produced_at[n] <= c and last_use.get(n, -1) > c)
@@ -520,6 +623,62 @@ class PipelineProgramStep:
                 for n in names]))
         return layouts
 
+    def _probe_residuals(self, branches, cparams, dstructs, micro,
+                         repl_feeds, base_key, nf, ni):
+        """Per-virtual-stage vjp residual layouts for activation-stash
+        mode: eval_shape the SAME vjp the real trace runs and capture
+        (treedef, leaf avals) by side effect — deterministic tracing
+        makes the probe's treedef identical to the real one, so
+        unflattening stashed leaves reconstructs the vjp exactly.
+        Residual leaves that ARE the live params/constants (tracer
+        identity) are marked for rebinding instead of stashing — the
+        stash then holds only genuine per-microbatch activations."""
+        feed_structs = {n: jax.ShapeDtypeStruct(a.shape[1:], a.dtype)
+                        for n, a in micro.items()}
+        feed_structs.update({
+            n: jax.ShapeDtypeStruct(np.shape(a), a.dtype)
+            for n, a in repl_feeds.items()})
+        key_struct = jax.ShapeDtypeStruct(np.shape(base_key),
+                                          base_key.dtype)
+        f_struct = jax.ShapeDtypeStruct((nf,), np.float32)
+        i_struct = jax.ShapeDtypeStruct((ni,), np.int32)
+        c_leaves = jax.tree.leaves(cparams)
+        layouts = []
+        for br in branches:
+            cap = {}
+
+            def probe(dp_, f_in, i_in, feeds_mb, key, _br=br, _cap=cap):
+                def g(dpp, fi):
+                    f_o, i_o, scal = _br((dpp, fi, i_in, feeds_mb, key))
+                    return (f_o, scal), i_o
+
+                out, vjp_fn, _aux = jax.vjp(g, dp_, f_in, has_aux=True)
+                leaves, treedef = jax.tree.flatten(vjp_fn)
+                dp_leaves = jax.tree.leaves(dp_)
+                rebind = []
+                for leaf in leaves:
+                    ref = None
+                    for j, p in enumerate(dp_leaves):
+                        if leaf is p:
+                            ref = ("d", j)
+                            break
+                    if ref is None:
+                        for j, p in enumerate(c_leaves):
+                            if leaf is p:
+                                ref = ("c", j)
+                                break
+                    rebind.append(ref)
+                _cap["treedef"] = treedef
+                _cap["avals"] = [(l.shape, l.dtype) for l in leaves]
+                _cap["rebind"] = rebind
+                return out
+
+            jax.eval_shape(probe, dstructs, f_struct, i_struct,
+                           feed_structs, key_struct)
+            layouts.append(_ResidLayout(cap["treedef"], cap["avals"],
+                                        cap["rebind"]))
+        return layouts
+
     def _context_constraints(self):
         """NamedShardings for the activation seams, bound to the CURRENT
         abstract mesh (Manual over dp/pp inside the 1F1B region)."""
@@ -534,9 +693,9 @@ class PipelineProgramStep:
         ops -> pack outgoing wire + scalar-fetch vector."""
         constraints = self._context_constraints()
         branches = []
-        for s in range(self.pp):
+        for s in range(self.S):
             in_lay = layouts[s - 1] if s > 0 else None
-            out_lay = layouts[s] if s < self.pp - 1 else None
+            out_lay = layouts[s] if s < self.S - 1 else None
             stage_ops = [op for op, st in zip(self.fwd_ops, self.stage_of)
                          if st == s]
             scal_here = [(k, n) for k, n in enumerate(self.scalar_names)
@@ -637,9 +796,15 @@ class PipelineProgramStep:
 
     def _pipeline_1f1b(self, dparams, cparams, batched, repl_feeds,
                        base_key):
-        """The manual-region 1F1B schedule: runs per (dp, pp) rank with tp
-        left to GSPMD. Returns (psummed grads pytree, mean scalar vector)."""
-        dp, pp, M = self.dp, self.pp, self.M
+        """The manual-region (interleaved) 1F1B schedule: runs per
+        (dp, pp) rank with tp left to GSPMD, driven by the host-built
+        schedule tables (pipeline_schedule.py) — each tick looks up its
+        units/stash slots instead of computing index arithmetic, which
+        makes virtual-stage interleaving (v>1) the same code path as
+        classic 1F1B (v=1). Returns (psummed grads pytree, mean scalar
+        vector)."""
+        dp, pp, M, v = self.dp, self.pp, self.M, self.v
+        sched = self.schedule
         my_pp = jax.lax.axis_index("pp")
         my_dp = jax.lax.axis_index("dp")
 
@@ -656,10 +821,10 @@ class PipelineProgramStep:
             n: jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype
                                     if not hasattr(a, "dtype") else a.dtype)
             for n, a in repl_feeds.items()})
-        dstructs = {n: jax.ShapeDtypeStruct(v.shape, v.dtype)
-                    for n, v in dparams.items()}
-        cstructs = {n: jax.ShapeDtypeStruct(np.shape(v), v.dtype)
-                    for n, v in cparams.items()}
+        dstructs = {n: jax.ShapeDtypeStruct(v_.shape, v_.dtype)
+                    for n, v_ in dparams.items()}
+        cstructs = {n: jax.ShapeDtypeStruct(np.shape(v_), v_.dtype)
+                    for n, v_ in cparams.items()}
         layouts = self._probe_layouts(dstructs, cstructs, feed_structs)
         nf = max([l.nf for l in layouts] + [1])
         ni = max([l.ni for l in layouts] + [1])
@@ -677,58 +842,157 @@ class PipelineProgramStep:
         def key_at(i):
             return jax.random.fold_in(base_key, my_dp * M + i)
 
-        def stage_apply(dp_, f_in, i_in, i):
+        def stage_apply(vs, dp_, f_in, i_in, i):
+            # vs = chunk*pp + my_pp: the virtual stage resident here
             return jax.lax.switch(
-                my_pp, branches, (dp_, f_in, i_in, feeds_at(i), key_at(i)))
+                vs, branches, (dp_, f_in, i_in, feeds_at(i), key_at(i)))
+
+        # ---- activation stash mode: vjp at FORWARD time, packed
+        # residual leaves ride the input-stash slots (identical
+        # lifetime); the backward unit unflattens and applies — no
+        # chunk-forward rematerialization ----
+        if self.stash_activations:
+            resid_layouts = self._probe_residuals(
+                branches, cparams, dstructs, micro, repl_feeds, base_key,
+                nf, ni)
+            nfr = max([l.nf for l in resid_layouts] + [1])
+            nir = max([l.ni for l in resid_layouts] + [1])
+
+            def _fwd_branch(s):
+                br, lay = branches[s], resid_layouts[s]
+
+                def b(operand):
+                    dp_, f_in, i_in, feeds_mb, key = operand
+
+                    def g(dpp, fi):
+                        f_o, i_o, scal = br((dpp, fi, i_in, feeds_mb,
+                                             key))
+                        return (f_o, scal), i_o
+
+                    (f_o, scal), vjp_fn, i_o = jax.vjp(
+                        g, dp_, f_in, has_aux=True)
+                    fr, ir = lay.pack(jax.tree.leaves(vjp_fn), nfr, nir)
+                    return f_o, i_o, scal, fr, ir
+
+                return b
+
+            def _bwd_branch(s):
+                lay = resid_layouts[s]
+
+                def b(operand):
+                    fr, ir, wire_cot, scal_cot = operand
+                    sources = {"d": jax.tree.leaves(dparams),
+                               "c": jax.tree.leaves(cparams)}
+                    vjp_fn = jax.tree.unflatten(
+                        lay.treedef, lay.unpack(fr, ir, sources))
+                    return vjp_fn((wire_cot, scal_cot))
+
+                return b
+
+            fwd_branches = [_fwd_branch(s) for s in range(self.S)]
+            bwd_branches = [_bwd_branch(s) for s in range(self.S)]
+        else:
+            nfr, nir = nf, ni  # input-wire stash doubles as "residual"
 
         seed = self._grad_seed_scale / float(M * dp)
         loss_onehot = jnp.zeros((n_scal,), jnp.float32).at[
             self.loss_idx].set(1.0)
-        S_ring = 2 * pp
-        K = M + 2 * pp - 2
+        loss_vs = self.loss_stage  # virtual-stage index of the loss
+        A, B, C = (sched.arrive_slots, sched.input_slots,
+                   sched.cot_slots)
+        zf = jnp.zeros((nf,), jnp.float32)
+        zi = jnp.zeros((ni,), jnp.int32)
 
-        def tick(carry, t):
-            (fwd_f, fwd_i, bwd_f, stash_f, stash_i, gacc, sacc) = carry
+        xs = {k: jnp.asarray(getattr(sched, k)) for k in (
+            "fwd_mb", "fwd_chunk", "fwd_read", "fwd_save", "fwd_recv",
+            "bwd_mb", "bwd_chunk", "bwd_read", "cot_read", "cot_recv")}
 
-            # ---- forward unit: microbatch i_f = t - my_pp ----
-            i_f = t - my_pp
-            valid_f = (i_f >= 0) & (i_f < M)
-            i_fc = jnp.clip(i_f, 0, M - 1)
-            f_out, i_out, scal_f = stage_apply(dparams, fwd_f, fwd_i, i_fc)
-            slot = jnp.mod(i_fc, S_ring)
-            stash_f = jnp.where(
+        def tick(carry, row):
+            (fwd_f, fwd_i, bwd_f, arr_f, arr_i, in_f, in_i, cot_f,
+             gacc, sacc) = carry
+            at = {k: jnp.take(r_, my_pp) for k, r_ in row.items()}
+
+            # ---- land last tick's ring wires into the stashes ----
+            arr_f = jnp.where(
+                at["fwd_recv"] >= 0,
+                jax.lax.dynamic_update_index_in_dim(
+                    arr_f, fwd_f, jnp.clip(at["fwd_recv"], 0, A - 1), 0),
+                arr_f)
+            arr_i = jnp.where(
+                at["fwd_recv"] >= 0,
+                jax.lax.dynamic_update_index_in_dim(
+                    arr_i, fwd_i, jnp.clip(at["fwd_recv"], 0, A - 1), 0),
+                arr_i)
+            cot_f = jnp.where(
+                at["cot_recv"] >= 0,
+                jax.lax.dynamic_update_index_in_dim(
+                    cot_f, bwd_f, jnp.clip(at["cot_recv"], 0, C - 1), 0),
+                cot_f)
+
+            # ---- forward unit ----
+            valid_f = at["fwd_mb"] >= 0
+            i_fc = jnp.clip(at["fwd_mb"], 0, M - 1)
+            vs_f = jnp.clip(at["fwd_chunk"], 0, v - 1) * pp + my_pp
+            rd = jnp.clip(at["fwd_read"], 0, A - 1)
+            f_in = jnp.where(
+                at["fwd_read"] >= 0,
+                jax.lax.dynamic_index_in_dim(arr_f, rd, 0, keepdims=False),
+                zf)
+            i_in = jnp.where(
+                at["fwd_read"] >= 0,
+                jax.lax.dynamic_index_in_dim(arr_i, rd, 0, keepdims=False),
+                zi)
+            if self.stash_activations:
+                f_out, i_out, scal_f, save_f, save_i = jax.lax.switch(
+                    vs_f, fwd_branches,
+                    (dparams, f_in, i_in, feeds_at(i_fc), key_at(i_fc)))
+            else:
+                f_out, i_out, scal_f = stage_apply(vs_f, dparams, f_in,
+                                                   i_in, i_fc)
+                save_f, save_i = f_in, i_in
+            sv = jnp.clip(at["fwd_save"], 0, B - 1)
+            in_f = jnp.where(
                 valid_f,
-                jax.lax.dynamic_update_index_in_dim(stash_f, fwd_f, slot,
-                                                    axis=0),
-                stash_f)
-            stash_i = jnp.where(
+                jax.lax.dynamic_update_index_in_dim(in_f, save_f, sv, 0),
+                in_f)
+            in_i = jnp.where(
                 valid_f,
-                jax.lax.dynamic_update_index_in_dim(stash_i, fwd_i, slot,
-                                                    axis=0),
-                stash_i)
+                jax.lax.dynamic_update_index_in_dim(in_i, save_i, sv, 0),
+                in_i)
             sacc = sacc + jnp.where(valid_f, scal_f, 0.0)
 
-            # ---- backward unit: microbatch i_b = t - (2pp-2-my_pp) ----
-            i_b = t - (2 * pp - 2 - my_pp)
-            valid_b = (i_b >= 0) & (i_b < M)
-            i_bc = jnp.clip(i_b, 0, M - 1)
-            bslot = jnp.mod(i_bc, S_ring)
-            f_in_b = jax.lax.dynamic_index_in_dim(stash_f, bslot, axis=0,
+            # ---- backward unit (vjp re-runs the chunk forward) ----
+            valid_b = at["bwd_mb"] >= 0
+            i_bc = jnp.clip(at["bwd_mb"], 0, M - 1)
+            vs_b = jnp.clip(at["bwd_chunk"], 0, v - 1) * pp + my_pp
+            br = jnp.clip(at["bwd_read"], 0, B - 1)
+            f_in_b = jax.lax.dynamic_index_in_dim(in_f, br, 0,
                                                   keepdims=False)
-            i_in_b = jax.lax.dynamic_index_in_dim(stash_i, bslot, axis=0,
+            i_in_b = jax.lax.dynamic_index_in_dim(in_i, br, 0,
                                                   keepdims=False)
-
-            def g(dp_, f_in):
-                f_o, _, scal = stage_apply(dp_, f_in, i_in_b, i_bc)
-                return f_o, scal
-
-            _, svjp = jax.vjp(g, dparams, f_in_b)
-            # cotangent routing: the loss stage seeds; earlier stages relay
-            # the ring cotangent; later stages (post-loss metrics) send 0
-            wire_cot = jnp.where(my_pp < self.loss_stage, 1.0, 0.0) * bwd_f
+            cr = jnp.clip(at["cot_read"], 0, C - 1)
+            cot_in = jnp.where(
+                at["cot_read"] >= 0,
+                jax.lax.dynamic_index_in_dim(cot_f, cr, 0, keepdims=False),
+                zf)
+            # cotangent routing: the loss stage seeds; earlier stages
+            # relay the ring cotangent; later (post-loss metric) stages
+            # send 0
+            wire_cot = jnp.where(vs_b < loss_vs, 1.0, 0.0) * cot_in
             scal_cot = loss_onehot * jnp.where(
-                my_pp == self.loss_stage, jnp.float32(seed), 0.0)
-            gP, g_in = svjp((wire_cot, scal_cot))
+                vs_b == loss_vs, jnp.float32(seed), 0.0)
+            if self.stash_activations:
+                gP, g_in = jax.lax.switch(
+                    vs_b, bwd_branches, (f_in_b, i_in_b, wire_cot,
+                                         scal_cot))
+            else:
+                def g(dp_, f_in_):
+                    f_o, _, scal = stage_apply(vs_b, dp_, f_in_, i_in_b,
+                                               i_bc)
+                    return f_o, scal
+
+                _, svjp = jax.vjp(g, dparams, f_in_b)
+                gP, g_in = svjp((wire_cot, scal_cot))
             gacc = jax.tree.map(
                 lambda a, d: a + jnp.where(valid_b, d, 0.0).astype(
                     jnp.float32), gacc, gP)
@@ -739,19 +1003,20 @@ class PipelineProgramStep:
             fwd_f2 = jax.lax.ppermute(f_out, "pp", fwd_perm)
             fwd_i2 = jax.lax.ppermute(i_out, "pp", fwd_perm)
             bwd_f2 = jax.lax.ppermute(g_in, "pp", bwd_perm)
-            return (fwd_f2, fwd_i2, bwd_f2, stash_f, stash_i, gacc,
-                    sacc), None
+            return (fwd_f2, fwd_i2, bwd_f2, arr_f, arr_i, in_f, in_i,
+                    cot_f, gacc, sacc), None
 
-        zf = jnp.zeros((nf,), jnp.float32)
-        zi = jnp.zeros((ni,), jnp.int32)
         init = (zf, zi, zf,
-                jnp.zeros((S_ring, nf), jnp.float32),
-                jnp.zeros((S_ring, ni), jnp.int32),
+                jnp.zeros((A, nf), jnp.float32),
+                jnp.zeros((A, ni), jnp.int32),
+                jnp.zeros((B, nfr), jnp.float32),
+                jnp.zeros((B, nir), jnp.int32),
+                jnp.zeros((C, nf), jnp.float32),
                 jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
                              dparams),
                 jnp.zeros((n_scal,), jnp.float32))
-        (_, _, _, _, _, gacc, sacc), _ = jax.lax.scan(
-            tick, init, jnp.arange(K))
+        carry, _ = jax.lax.scan(tick, init, xs)
+        gacc, sacc = carry[-2], carry[-1]
 
         grads = jax.tree.map(lambda g: jax.lax.psum(g, ("dp", "pp")), gacc)
         # each scalar is owned by exactly one stage: pp-psum recovers its
