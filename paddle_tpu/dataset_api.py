@@ -363,14 +363,46 @@ class InMemoryDataset(DatasetBase):
         random.Random(seed).shuffle(self._samples)
 
     def global_shuffle(self, fleet=None, seed=0):
-        """Cross-worker sample redistribution (data_set.h GlobalShuffle).
-        Single-process: a seeded full shuffle. Multi-worker (fleet set):
-        keep the samples whose hash maps to this worker — all workers
-        together see every sample exactly once, shuffled."""
+        """Cross-worker sample redistribution (data_set.h:77-83
+        GlobalShuffle). Single-process: a seeded full shuffle.
+
+        Multi-worker STREAMING path (fleet with trainer endpoints —
+        PADDLE_TRAINER_ENDPOINTS): each worker loads only ITS OWN
+        filelist shard, then samples are exchanged worker-to-worker over
+        the framed-TCP runtime: destination = content-hash % world, so
+        every sample lands on exactly one worker no matter who loaded it
+        and per-worker memory stays ~N/world — the reference's RPC
+        redistribution, not a full local copy.
+
+        Fallback (world > 1 but no endpoints): hash-keep over a full
+        local load — every worker must then hold the ENTIRE dataset
+        before discarding its complement; kept only for endpoint-less
+        setups and documented as the memory-unbounded mode."""
         assert self._samples is not None, "call load_into_memory first"
+        endpoints = []
         if fleet is not None:
             self._rank = fleet.worker_index()
             self._world = fleet.worker_num()
+            get_eps = getattr(fleet, "worker_endpoints", None)
+            endpoints = list(get_eps() or []) if get_eps else []
+        if self._world > 1 and len(endpoints) == self._world:
+            import zlib
+
+            from .distributed_runtime import exchange_samples
+            from .recordio_writer import (deserialize_sample,
+                                          serialize_sample)
+
+            salt = (b"%d" % seed)
+            outgoing = [[] for _ in range(self._world)]
+            for s in self._samples:
+                rec = serialize_sample(s)
+                outgoing[zlib.crc32(rec + salt) % self._world].append(rec)
+            self._samples = None  # free the pre-exchange copy
+            records = exchange_samples(endpoints, self._rank, outgoing)
+            samples = [deserialize_sample(r) for r in records]
+            random.Random(seed * 1000003 + self._rank).shuffle(samples)
+            self._samples = samples
+            return
         rng = random.Random(seed)
         order = list(range(len(self._samples)))
         rng.shuffle(order)

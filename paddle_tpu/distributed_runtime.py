@@ -660,3 +660,114 @@ def run_pserver(program, scope, endpoint, executor_place=None):
         srv.serve_forever(poll_interval=0.05)
     finally:
         srv.server_close()
+
+
+# ---------------------------------------------------------------------------
+# all-to-all sample exchange (data_set.h:77-83 GlobalShuffle: nodes
+# redistribute samples over RPC so each only ever loads its own shard)
+# ---------------------------------------------------------------------------
+
+MSG_SAMPLES = 10
+
+
+def exchange_samples(endpoints, rank, outgoing, timeout=300.0):
+    """All-to-all redistribution of serialized sample records over the
+    framed-TCP protocol: worker w ends up with every record of every
+    worker's ``outgoing[w]``. Each worker listens on endpoints[rank] and
+    pushes one MSG_SAMPLES frame per peer (length-prefixed record pack);
+    the reply is the delivery ack. Returns this worker's records — its
+    own outgoing[rank] plus everything received — ordered by
+    (source rank, position), so callers get a deterministic base order
+    to seed their local shuffle from.
+
+    Trust model: same as the pserver runtime (private training network;
+    the framed protocol carries no code, only length-prefixed bytes)."""
+    import socket
+    import struct as _struct
+    import threading
+    import time as _time
+
+    world = len(endpoints)
+    if world == 1:
+        return list(outgoing[0])
+    received = {}
+    recv_lock = threading.Lock()
+    all_in = threading.Event()
+
+    def _pack(records):
+        return b"".join(_struct.pack("<I", len(r)) + r for r in records)
+
+    def _unpack(buf):
+        out, off = [], 0
+        while off < len(buf):
+            (n,) = _struct.unpack_from("<I", buf, off)
+            off += 4
+            out.append(bytes(buf[off:off + n]))
+            off += n
+        return out
+
+    host, port = endpoints[rank].rsplit(":", 1)
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind((host, int(port)))
+    srv.listen(world)
+
+    def _serve():
+        pending = world - 1
+        while pending:
+            conn, _ = srv.accept()
+            try:
+                mtype, meta, payload = _read_msg(conn)
+                if mtype != MSG_SAMPLES:
+                    raise ConnectionError("unexpected msg %d" % mtype)
+                with recv_lock:
+                    received[int(meta["src"])] = _unpack(payload)
+                    if len(received) == world - 1:
+                        all_in.set()
+                _write_msg(conn, MSG_OK, {})
+                pending -= 1
+            finally:
+                conn.close()
+
+    server = threading.Thread(target=_serve, daemon=True)
+    server.start()
+
+    deadline = _time.monotonic() + timeout
+    try:
+        for dst in range(world):
+            if dst == rank:
+                continue
+            payload = _pack(outgoing[dst])
+            dhost, dport = endpoints[dst].rsplit(":", 1)
+            while True:  # the peer's listener may not be up yet
+                try:
+                    s = socket.create_connection((dhost, int(dport)),
+                                                 timeout=10.0)
+                    break
+                except OSError:
+                    if _time.monotonic() > deadline:
+                        raise TimeoutError(
+                            "global_shuffle: worker %d unreachable at %s"
+                            % (dst, endpoints[dst]))
+                    _time.sleep(0.2)
+            try:
+                _write_msg(s, MSG_SAMPLES,
+                           {"src": rank, "nbytes": len(payload)}, payload)
+                mtype, _, _ = _read_msg(s)
+                if mtype != MSG_OK:
+                    raise ConnectionError("exchange not acked")
+            finally:
+                s.close()
+        if not all_in.wait(max(0.0, deadline - _time.monotonic())):
+            missing = sorted(set(range(world)) - {rank}
+                             - set(received))
+            raise TimeoutError(
+                "global_shuffle: no samples received from workers %s"
+                % missing)
+    finally:
+        srv.close()
+    out = []
+    for src in range(world):
+        out.extend(outgoing[rank] if src == rank
+                   else received.get(src, []))
+    return out

@@ -1,0 +1,35 @@
+"""Streaming global-shuffle worker (data_set.h:77-83 GlobalShuffle
+parity test): loads ONLY its own half of the recordio filelist, then
+global_shuffle exchanges samples worker-to-worker over framed TCP.
+Prints `loaded:<n>` (pre-exchange count — proves the worker never held
+the full dataset) and `own:<sorted sample ids>` after the shuffle.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu.parallel.fleet import fleet  # noqa: E402
+
+
+def main():
+    files = os.environ["SHUFFLE_FILES"].split(",")
+    fleet.init()
+    rank, world = fleet.worker_index(), fleet.worker_num()
+
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_filelist(files[rank::world])   # each worker: ITS shard only
+    ds.load_into_memory()
+    print("loaded:%d" % len(ds._samples), flush=True)
+    ds.global_shuffle(fleet=fleet, seed=7)
+    ids = sorted(int(np.asarray(s[0]).reshape(-1)[0])
+                 for s in ds._samples)
+    print("own:%s" % ",".join(map(str, ids)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

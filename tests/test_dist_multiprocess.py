@@ -129,3 +129,64 @@ def test_two_process_dcn_training_matches_local():
         assert len(dist_losses) == 8
         np.testing.assert_allclose(dist_losses, base_losses,
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_streaming_global_shuffle_exactly_once(tmp_path):
+    """VERDICT r4 #7: each of 2 workers loads HALF the recordio files
+    (never the full dataset) and after the framed-TCP exchange every
+    sample appears exactly once globally, with both workers holding a
+    nontrivial share."""
+    from paddle_tpu import recordio_writer
+
+    n_files, per_file = 4, 25
+    files = []
+    for f in range(n_files):
+        path = str(tmp_path / ("shard-%d.rec" % f))
+
+        def reader(base=f * per_file):
+            for i in range(per_file):
+                yield (np.array([base + i], dtype=np.int64),
+                       np.arange(3, dtype=np.float32) + base + i)
+
+        recordio_writer.convert_reader_to_recordio_file(
+            path, lambda base=f * per_file: reader(base))
+        files.append(path)
+
+    eps = ["127.0.0.1:%d" % _free_port() for _ in range(2)]
+    procs = []
+    for rank in range(2):
+        env = _clean_env(PADDLE_TRAINER_ID=str(rank),
+                         PADDLE_TRAINERS_NUM="2",
+                         PADDLE_TRAINER_ENDPOINTS=",".join(eps),
+                         SHUFFLE_FILES=",".join(files))
+        procs.append(subprocess.Popen(
+            [sys.executable,
+             os.path.join(_ROOT, "tests", "dist_shuffle_worker.py")],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True))
+    outs = []
+    try:
+        for p in procs:
+            try:
+                out, err = p.communicate(timeout=300)
+            except subprocess.TimeoutExpired:
+                pytest.fail("shuffle worker timed out")
+            assert p.returncode == 0, err[-2000:]
+            outs.append(out)
+    finally:
+        for q in procs:
+            q.kill()
+
+    total = n_files * per_file
+    owned = []
+    for out in outs:
+        loaded = int([l for l in out.splitlines()
+                      if l.startswith("loaded:")][0].split(":")[1])
+        assert loaded == total // 2  # never held the full dataset
+        ids = [l for l in out.splitlines() if l.startswith("own:")][0]
+        owned.append([int(x) for x in ids.split(":")[1].split(",")])
+    flat = sorted(owned[0] + owned[1])
+    assert flat == list(range(total))          # exactly once globally
+    assert not (set(owned[0]) & set(owned[1]))  # disjoint
+    for ids in owned:
+        assert total // 4 <= len(ids) <= 3 * total // 4  # hash balance
