@@ -219,6 +219,27 @@ def export_serving_model(dirname, predictor, feed_shapes,
     }
     with open(os.path.join(dirname, _SERVING_META), "w") as f:
         json.dump(meta, f)
+
+    # ---- Python-free companion artifact (native/serve.cc) ----------
+    # One RAW StableHLO module per platform (a multi-platform jax.export
+    # module takes a platform-index argument — a per-platform export
+    # keeps the PJRT calling convention plain), plus a line-based
+    # manifest so the C++ loader needs no JSON/protobuf. Arguments ride
+    # in jax's dict-flatten order (sorted feed names).
+    lines = []
+    for p in platforms:
+        single = jexport.export(jax.jit(fn), platforms=[p])(arg_spec)
+        mod_name = "__serving__.%s.mlirbc" % p
+        with open(os.path.join(dirname, mod_name), "wb") as f:
+            f.write(single.mlir_module_serialized)
+        lines.append("module %s %s" % (p, mod_name))
+    for name in sorted(feed_names):
+        lines.append("input %s %s" % (name, np.dtype(
+            arg_spec[name].dtype).str))
+    for name in fetch_names:
+        lines.append("output %s" % name)
+    with open(os.path.join(dirname, "__serving_native__.txt"), "w") as f:
+        f.write("\n".join(lines) + "\n")
     return os.path.join(dirname, _SERVING_BIN)
 
 
