@@ -137,14 +137,38 @@ def assign_stages(fwd_ops, pp):
             stages.append(cur)
         return stages
     costs = [_op_cost(op) for op in fwd_ops]
-    total = sum(costs) or 1.0
-    stages, acc, cur = [], 0.0, 0
+    n = len(costs)
+    if n < pp:
+        raise ValueError(
+            "cannot split %d forward ops into %d pipeline stages — "
+            "reduce pipeline_stages/pipeline_virtual_stages" % (n, pp))
+    # minimax contiguous partition into EXACTLY pp non-empty segments
+    # (DP): unlike a greedy midpoint walk, one dominant op can never
+    # leave an interior stage empty, and the bottleneck stage cost —
+    # which sets the pipeline's tick time — is provably minimal
+    prefix = [0.0]
     for c in costs:
-        # cut when the op's midpoint crosses the next boundary
-        while cur < pp - 1 and acc + c / 2.0 > (cur + 1) * total / pp:
-            cur += 1
-        stages.append(cur)
-        acc += c
+        prefix.append(prefix[-1] + c)
+    inf = float("inf")
+    best = [[inf] * (n + 1) for _ in range(pp + 1)]
+    cut = [[0] * (n + 1) for _ in range(pp + 1)]
+    best[0][0] = 0.0
+    for k in range(1, pp + 1):
+        for j in range(k, n - (pp - k) + 1):
+            for i in range(k - 1, j):
+                v = max(best[k - 1][i], prefix[j] - prefix[i])
+                if v < best[k][j]:
+                    best[k][j] = v
+                    cut[k][j] = i
+    bounds = [n]
+    j = n
+    for k in range(pp, 0, -1):
+        j = cut[k][j]
+        bounds.append(j)
+    bounds.reverse()
+    stages = []
+    for s in range(pp):
+        stages.extend([s] * (bounds[s + 1] - bounds[s]))
     return stages
 
 
