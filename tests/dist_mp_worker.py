@@ -57,6 +57,11 @@ def main(steps=5, batch=8, seq=64, vocab=64):
         bs.sequence_parallel_degree = 2
     elif mode == "pp":
         bs.pipeline_stages = 2
+    elif mode == "pptp":
+        # three axes at once: dp over processes, pp AND tp within each
+        # (needs PADDLE_MP_LOCAL_DEVICES=4)
+        bs.pipeline_stages = 2
+        bs.tensor_parallel_degree = 2
     else:
         raise SystemExit("unknown PADDLE_MP_MODE %r" % mode)
     prog = fluid.CompiledProgram(fluid.default_main_program()) \
