@@ -780,6 +780,20 @@ class PipelineProgramStep:
                         "feed %r batch %s must divide dp*microbatches = %d "
                         "for pipeline parallelism"
                         % (name, np.shape(arr), dp * M))
+                sp_tp = dict(self.mesh.shape)
+                if (arr.shape[0] // (dp * M) < 2
+                        and int(sp_tp.get("sp", 1)) > 1
+                        and int(sp_tp.get("tp", 1)) > 1):
+                    # XLA:CPU's SPMD partitioner CHECK-aborts (not
+                    # raises) subgrouping a size-1 batch dim under
+                    # sp x tp — turn the process-killing abort into an
+                    # actionable error (docs/PARALLEL.md caveat)
+                    raise ValueError(
+                        "feed %r microbatch size %d is 1 under combined "
+                        "sequence AND tensor parallelism — the SPMD "
+                        "partitioner cannot subgroup a size-1 batch dim;"
+                        " use batch >= %d" % (
+                            name, arr.shape[0] // (dp * M), 2 * dp * M))
                 batched[name] = arr
             else:
                 repl_feeds[name] = arr
