@@ -294,13 +294,56 @@ def is_reference_program_bytes(raw):
     return bool(raw) and raw[0] == 0x0A
 
 
+# ops whose reference sub-block wiring this importer knows how to map
+# onto the native lowering's attr conventions
+_BLOCK_OP_MAPPERS = {}
+
+
+def _map_while_op(op):
+    """Reference while op (while_op.cc:43: inputs X/Condition, outputs
+    Out/StepScopes, attr sub_block) -> the native lowering's derived
+    attrs (ops/controlflow.py `while`): carry/cond/x name lists, no
+    step-scope bookkeeping (XLA carries state functionally), dynamic
+    trip count (lax.while_loop — forward-only, which is what an
+    inference export needs)."""
+    cond_vars = op.inputs.get("Condition", [])
+    if len(cond_vars) != 1:
+        raise ValueError("reference while op needs exactly one Condition")
+    cond_name = cond_vars[0].name
+    # the native carry needs an initial value for every Out var, so
+    # write-before-read loop vars join X (the reference lists only reads
+    # there; a var present in both stays deduped)
+    x_vars = {v.name: v for v in op.inputs.get("X", [])}
+    for v in op.outputs.get("Out", []):
+        x_vars.setdefault(v.name, v)
+    x_vars.pop(cond_name, None)
+    x_names = list(x_vars)
+    out_names = [v.name for v in op.outputs.get("Out", [])
+                 if v.name != cond_name]
+    op.inputs = {"Condition": cond_vars, "X": list(x_vars.values())}
+    op.outputs = {"Out": [v for v in op.outputs.get("Out", [])
+                          if v.name != cond_name]}
+    carry = list(out_names)
+    if cond_name not in carry:
+        carry.append(cond_name)
+    op.attrs.update({
+        "x_names": x_names, "out_names": out_names,
+        "carry_names": carry, "cond_name": cond_name,
+        "max_trip_count": op.attrs.get("max_trip_count"),
+    })
+
+
+_BLOCK_OP_MAPPERS["while"] = _map_while_op
+
+
 def program_from_reference_bytes(raw):
     """ProgramDesc protobuf bytes -> (Program, feed_names, fetch_names).
 
     `feed`/`fetch` ops (appended by the reference's save_inference_model,
     io.py:880-897) are stripped into the returned name lists, keyed by
     their `col` attr; the FEED_MINIBATCH / FETCH_LIST holder vars are
-    dropped."""
+    dropped. Multi-block programs import when every block-carrying op
+    has a registered mapper (`while`); others reject loudly."""
     blocks = _parse_program_desc(raw)
     if not blocks:
         raise ValueError("no blocks in ProgramDesc")
@@ -327,18 +370,32 @@ def program_from_reference_bytes(raw):
             blk.vars[v.name] = v
 
     feeds, fetches = {}, {}
+    block_ops = []  # ops needing post-construction attr mapping
     for bd, blk in zip(blocks, p.blocks):
         for od in bd["ops"]:
             attrs = {}
+            has_block_attr = False
             for name, atype, value in od["attrs"]:
-                if atype in (_A_BLOCK, _A_BLOCKS):
-                    raise NotImplementedError(
-                        "reference op %r carries a sub-block attr %r — "
-                        "multi-block control-flow import is not supported;"
-                        " export the model without while/conditional ops "
-                        "or rebuild it with paddle_tpu.layers.While/cond"
-                        % (od["type"], name))
-                attrs[name] = value
+                if atype == _A_BLOCK:
+                    has_block_attr = True
+                    attrs[name] = p.blocks[value[1]]
+                elif atype == _A_BLOCKS:
+                    has_block_attr = True
+                    attrs[name] = [p.blocks[i] for i in value[2]]
+                elif name in ("dtype", "out_dtype", "in_dtype") \
+                        and isinstance(value, int):
+                    # the reference stores dtype attrs as VarType enum
+                    # ints (framework.proto:106); the native ops take
+                    # numpy dtype names
+                    attrs[name] = _DTYPE_OF.get(value, "float32")
+                else:
+                    attrs[name] = value
+            if has_block_attr and od["type"] not in _BLOCK_OP_MAPPERS:
+                raise NotImplementedError(
+                    "reference op %r carries a sub-block attr — only %s "
+                    "import; rebuild other control flow with "
+                    "paddle_tpu.layers" % (
+                        od["type"], sorted(_BLOCK_OP_MAPPERS)))
             if od["type"] == "feed":
                 for arg in od["outputs"].get("Out", []):
                     feeds[int(attrs.get("col", len(feeds)))] = arg
@@ -348,24 +405,30 @@ def program_from_reference_bytes(raw):
                     fetches[int(attrs.get("col", len(fetches)))] = arg
                 continue
 
-            def _vars(names):
+            def _vars(names, _blk=blk):
                 out = []
                 for n in names:
-                    try:
-                        out.append(blk.var(n))
-                    except Exception:
+                    v = _blk._find_var_recursive(n)
+                    if v is None:
                         # reference programs may reference vars declared
                         # with no tensor desc; materialize shapeless
-                        v = framework.Variable(blk, name=n, shape=None)
-                        blk.vars[n] = v
-                        out.append(v)
+                        v = framework.Variable(_blk, name=n, shape=None)
+                        _blk.vars[n] = v
+                    out.append(v)
                 return out
 
-            blk.append_op(
+            # step-scope bookkeeping outputs have no tensor meaning here
+            outs = {k: ns for k, ns in od["outputs"].items()
+                    if k not in ("StepScopes", "Scope")}
+            op = blk.append_op(
                 type=od["type"],
                 inputs={k: _vars(ns) for k, ns in od["inputs"].items()},
-                outputs={k: _vars(ns) for k, ns in od["outputs"].items()},
+                outputs={k: _vars(ns) for k, ns in outs.items()},
                 attrs=attrs)
+            if has_block_attr:
+                block_ops.append(op)
+    for op in block_ops:
+        _BLOCK_OP_MAPPERS[op.type](op)
     p.current_block_idx = 0
     feed_names = [feeds[k] for k in sorted(feeds)]
     fetch_names = [fetches[k] for k in sorted(fetches)]

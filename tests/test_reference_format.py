@@ -291,3 +291,72 @@ def test_sniffer_keeps_native_format(tmp_path):
     xb = np.random.RandomState(0).randn(3, 4).astype(np.float32)
     out, = exe.run(program, feed={"x": xb}, fetch_list=fetch_vars)
     assert np.asarray(out).shape == (3, 2)
+
+
+def attr_block(name, idx):
+    """OpDesc.Attr BLOCK (type 8): block_idx=12."""
+    return (_string_field(1, name) + _varint_field(2, 8)
+            + _varint_field(12, idx))
+
+
+def test_while_loop_model_imports(tmp_path):
+    """Multi-block import: a reference-style while program (while_op.cc
+    shape — inputs X/Condition, outputs Out/StepScopes, attr sub_block)
+    counting i from 0 to its limit. The importer derives the native
+    lowering's carry/cond attrs and drops the step-scope bookkeeping."""
+    BOOL = 0
+    vars0 = [
+        var_desc("feed", FEED_MINIBATCH),
+        var_desc("fetch", FETCH_LIST),
+        var_desc("start", LOD_TENSOR, dims=[1]),
+        var_desc("i", LOD_TENSOR, dims=[1]),
+        var_desc("limit", LOD_TENSOR, dims=[1]),
+        var_desc("cond", LOD_TENSOR, data_type=BOOL, dims=[1]),
+        var_desc("step_scopes", 11),  # STEP_SCOPES holder
+    ]
+    ops0 = [
+        op_desc("feed", [("X", ["feed"])], [("Out", ["start"])],
+                [attr_int("col", 0)]),
+        op_desc("assign", [("X", ["start"])], [("Out", ["i"])]),
+        op_desc("fill_constant", [], [("Out", ["limit"])],
+                [attr_float("value", 5.0), attr_ints("shape", [1]),
+                 attr_int("dtype", 5)]),
+        op_desc("less_than", [("X", ["i"]), ("Y", ["limit"])],
+                [("Out", ["cond"])]),
+        op_desc("while",
+                [("X", ["i", "limit"]), ("Condition", ["cond"])],
+                [("Out", ["i", "cond"]),
+                 ("StepScopes", ["step_scopes"])],
+                [attr_block("sub_block", 1)]),
+        op_desc("fetch", [("X", ["i"])], [("Out", ["fetch"])],
+                [attr_int("col", 0)]),
+    ]
+    ops1 = [
+        op_desc("increment", [("X", ["i"])], [("Out", ["i"])],
+                [attr_float("step", 1.0)]),
+        op_desc("less_than", [("X", ["i"]), ("Y", ["limit"])],
+                [("Out", ["cond"])]),
+    ]
+    model = program_desc(block_desc(0, -1, vars0, ops0),
+                         block_desc(1, 0, [], ops1))
+    d = str(tmp_path)
+    with open(os.path.join(d, "__model__"), "wb") as f:
+        f.write(model)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    program, feed_names, fetch_vars = fluid.io.load_inference_model(
+        d, exe)
+    assert feed_names == ["start"]
+    wop = next(op for op in program.global_block().ops
+               if op.type == "while")
+    assert "StepScopes" not in wop.outputs
+    assert wop.attrs["cond_name"] == "cond"
+    out, = exe.run(program,
+                   feed={"start": np.zeros((1,), np.float32)},
+                   fetch_list=fetch_vars)
+    np.testing.assert_allclose(np.asarray(out), [5.0])
+    # a different start reuses the same loaded program
+    out, = exe.run(program,
+                   feed={"start": np.asarray([2.5], np.float32)},
+                   fetch_list=fetch_vars)
+    np.testing.assert_allclose(np.asarray(out), [5.5])
