@@ -372,13 +372,218 @@ Manifest read_manifest(const std::string& dir, const std::string& platform) {
   return m;
 }
 
+struct TrainManifest {
+  std::vector<std::string> state;
+  std::vector<std::string> state_descr;
+  std::vector<std::string> inputs;
+  std::vector<std::string> in_descr;
+  std::vector<std::string> outputs;
+  std::string module_file;
+};
+
+TrainManifest read_train_manifest(const std::string& dir,
+                                  const std::string& platform) {
+  TrainManifest m;
+  std::ifstream f(dir + "/__train_native__.txt");
+  if (!f)
+    die("no __train_native__.txt in " + dir +
+        " — export with paddle_tpu.inference.export_native_train_step");
+  std::string line;
+  while (std::getline(f, line)) {
+    std::istringstream ls(line);
+    std::string kind;
+    ls >> kind;
+    if (kind == "state") {
+      std::string name, descr;
+      ls >> name >> descr;
+      m.state.push_back(name);
+      m.state_descr.push_back(descr);
+    } else if (kind == "input") {
+      std::string name, descr;
+      ls >> name >> descr;
+      m.inputs.push_back(name);
+      m.in_descr.push_back(descr);
+    } else if (kind == "output") {
+      std::string name;
+      ls >> name;
+      m.outputs.push_back(name);
+    } else if (kind == "module") {
+      std::string plat, file;
+      ls >> plat >> file;
+      if (plat == platform) m.module_file = file;
+    }
+  }
+  if (m.module_file.empty())
+    die("train manifest has no module for platform '" + platform + "'");
+  return m;
+}
+
+PJRT_Buffer* host_to_device(PJRT_Client* client, PJRT_Device* device,
+                            const Tensor& t) {
+  DtypeInfo di = dtype_of(t.descr);
+  PJRT_Client_BufferFromHostBuffer_Args a;
+  memset(&a, 0, sizeof(a));
+  a.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+  a.client = client;
+  a.data = t.data.data();
+  a.type = di.type;
+  a.dims = t.dims.data();
+  a.num_dims = t.dims.size();
+  a.host_buffer_semantics =
+      PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+  a.device = device;
+  check(g_api->PJRT_Client_BufferFromHostBuffer(&a), "host->device");
+  await_event(a.done_with_host_buffer, "transfer");
+  return a.buffer;
+}
+
+Tensor device_to_host(PJRT_Buffer* buf) {
+  Tensor t;
+  {
+    PJRT_Buffer_ElementType_Args a;
+    memset(&a, 0, sizeof(a));
+    a.struct_size = PJRT_Buffer_ElementType_Args_STRUCT_SIZE;
+    a.buffer = buf;
+    check(g_api->PJRT_Buffer_ElementType(&a), "elem type");
+    t.descr = descr_of(a.type);
+  }
+  {
+    PJRT_Buffer_Dimensions_Args a;
+    memset(&a, 0, sizeof(a));
+    a.struct_size = PJRT_Buffer_Dimensions_Args_STRUCT_SIZE;
+    a.buffer = buf;
+    check(g_api->PJRT_Buffer_Dimensions(&a), "dims");
+    t.dims.assign(a.dims, a.dims + a.num_dims);
+  }
+  PJRT_Buffer_ToHostBuffer_Args a;
+  memset(&a, 0, sizeof(a));
+  a.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+  a.src = buf;
+  check(g_api->PJRT_Buffer_ToHostBuffer(&a), "query host size");
+  t.data.resize(a.dst_size);
+  a.dst = &t.data[0];
+  check(g_api->PJRT_Buffer_ToHostBuffer(&a), "device->host");
+  await_event(a.event, "readback");
+  return t;
+}
+
+void destroy_buffer(PJRT_Buffer* buf) {
+  PJRT_Buffer_Destroy_Args a;
+  memset(&a, 0, sizeof(a));
+  a.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+  a.buffer = buf;
+  g_api->PJRT_Buffer_Destroy(&a);
+}
+
+// The Python-free TRAINING loop (train/demo_trainer.cc parity without
+// the CPython embed): each iteration's state results feed the next
+// iteration's state arguments positionally; the uint32 step counter
+// rides along as one more state slot (the exported step returns
+// counter+1), so the loop body is pure buffer recycling.
+int train_loop(PJRT_Client* client, PJRT_Device* device,
+               const std::string& artifact, const std::string& platform,
+               const std::string& input, const std::string& state_path,
+               const std::string& output, int iterations) {
+  TrainManifest mf = read_train_manifest(artifact, platform);
+  std::string module = read_file(artifact + "/" + mf.module_file);
+  PJRT_LoadedExecutable* exec;
+  {
+    PJRT_Program prog;
+    memset(&prog, 0, sizeof(prog));
+    prog.struct_size = PJRT_Program_STRUCT_SIZE;
+    prog.code = const_cast<char*>(module.data());
+    prog.code_size = module.size();
+    static const char kFmt[] = "mlir";
+    prog.format = kFmt;
+    prog.format_size = sizeof(kFmt) - 1;
+    PJRT_Client_Compile_Args a;
+    memset(&a, 0, sizeof(a));
+    a.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
+    a.client = client;
+    a.program = &prog;
+    static const char kOpts[] = "";
+    a.compile_options = kOpts;
+    a.compile_options_size = 0;
+    check(g_api->PJRT_Client_Compile(&a), "compile train step");
+    exec = a.executable;
+  }
+
+  auto state_npz = read_npz(state_path.empty()
+                            ? artifact + "/state0.npz" : state_path);
+  auto feeds = read_npz(input);
+  size_t k = mf.state.size();
+  std::vector<PJRT_Buffer*> args;
+  for (size_t i = 0; i < k; ++i) {
+    auto it = state_npz.find(mf.state[i]);
+    if (it == state_npz.end()) die("state npz missing " + mf.state[i]);
+    if (it->second.descr != mf.state_descr[i])
+      die("state " + mf.state[i] + " dtype mismatch");
+    args.push_back(host_to_device(client, device, it->second));
+  }
+  {
+    Tensor counter;
+    counter.descr = "<u4";
+    counter.data.assign(4, '\0');
+    args.push_back(host_to_device(client, device, counter));
+  }
+  for (size_t i = 0; i < mf.inputs.size(); ++i) {
+    auto it = feeds.find(mf.inputs[i]);
+    if (it == feeds.end()) die("input npz missing " + mf.inputs[i]);
+    args.push_back(host_to_device(client, device, it->second));
+  }
+
+  size_t n_results = k + 1 + mf.outputs.size();
+  std::vector<PJRT_Buffer*> results(n_results);
+  for (int it = 0; it < iterations; ++it) {
+    PJRT_ExecuteOptions opts;
+    memset(&opts, 0, sizeof(opts));
+    opts.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
+    PJRT_Buffer* const* arg_list = args.data();
+    PJRT_Buffer** out_list = results.data();
+    PJRT_Event* done = nullptr;
+    PJRT_LoadedExecutable_Execute_Args a;
+    memset(&a, 0, sizeof(a));
+    a.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+    a.executable = exec;
+    a.options = &opts;
+    a.argument_lists = &arg_list;
+    a.num_devices = 1;
+    a.num_args = args.size();
+    a.output_lists = &out_list;
+    a.device_complete_events = &done;
+    check(g_api->PJRT_LoadedExecutable_Execute(&a), "train step");
+    if (done) await_event(done, "train step");
+    // recycle: state results (incl. counter) become next-step args
+    for (size_t i = 0; i <= k; ++i) {
+      destroy_buffer(args[i]);
+      args[i] = results[i];
+    }
+    if (it + 1 < iterations)  // fetches of non-final steps are dropped
+      for (size_t i = k + 1; i < n_results; ++i)
+        destroy_buffer(results[i]);
+  }
+
+  std::vector<std::pair<std::string, Tensor>> out;
+  for (size_t i = 0; i < k; ++i)
+    out.emplace_back(mf.state[i], device_to_host(args[i]));
+  for (size_t i = 0; i < mf.outputs.size(); ++i)
+    out.emplace_back(mf.outputs[i], device_to_host(results[k + 1 + i]));
+  write_npz(output, out);
+  std::fprintf(stderr,
+               "native_serve: %d training steps done; state + %zu "
+               "fetches -> %s\n", iterations, mf.outputs.size(),
+               output.c_str());
+  return 0;
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
-  std::string artifact, input, output, platform = "cpu";
+  std::string artifact, input, output, platform = "cpu", state_path;
   const char* env_plugin = getenv("PJRT_PLUGIN_LIBRARY");
   std::string plugin = env_plugin ? env_plugin : "";
   bool probe_only = false;
+  int loop_iters = 0;
   for (int i = 1; i < argc; ++i) {
     std::string a = argv[i];
     auto next = [&]() -> std::string {
@@ -390,6 +595,8 @@ int main(int argc, char** argv) {
     else if (a == "--output") output = next();
     else if (a == "--plugin") plugin = next();
     else if (a == "--platform") platform = next();
+    else if (a == "--train-loop") loop_iters = std::stoi(next());
+    else if (a == "--state") state_path = next();
     else if (a == "--probe") probe_only = true;
     else if (a == "--npz-roundtrip") {
       // test hook: exercise the C++ npy/npz codec against numpy
@@ -451,6 +658,10 @@ int main(int argc, char** argv) {
     if (a.num_addressable_devices == 0) die("no addressable devices");
     device = a.addressable_devices[0];
   }
+
+  if (loop_iters > 0)
+    return train_loop(client, device, artifact, platform, input,
+                      state_path, output, loop_iters);
 
   Manifest mf = read_manifest(artifact, platform);
   std::string module = read_file(artifact + "/" + mf.module_file);

@@ -243,6 +243,118 @@ def export_serving_model(dirname, predictor, feed_shapes,
     return os.path.join(dirname, _SERVING_BIN)
 
 
+def export_native_train_step(dirname, program, feed_shapes, scope=None,
+                             fetch_names=(), platforms=("cpu", "tpu")):
+    """Export one full TRAINING step (forward + backward + optimizer) as
+    a raw StableHLO module `native_serve --train-loop` can iterate with
+    NO Python in the process (train/demo_trainer.cc parity with XLA as
+    the engine; closes the CPython embed native/trainer.cc carries).
+
+    Calling convention (written to __train_native__.txt): arguments =
+    [state_0..state_{k-1}, counter, feeds...(sorted)], results =
+    [new_state_0..new_state_{k-1}, counter+1, fetches...] — state slots
+    pair positionally, so the C++ loop just feeds each iteration's state
+    outputs back in. State = the program's mutable persistables (params,
+    optimizer accumulators), captured from `scope`; read-only
+    persistables bake in as constants."""
+    import json as _json
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jexport
+
+    from .compiler import classify_persistable_state
+    from .core.lowering import LoweringContext, execute_block
+    from .core.scope import global_scope
+
+    scope = scope if scope is not None else global_scope()
+    block = program.global_block()
+    fetch_names = list(fetch_names)
+    mut_names, const_names, state_out = classify_persistable_state(
+        block, fetch_names)
+    # every written persistable is carried (a write-only accumulator
+    # still needs a slot for the next iteration to read)
+    state_names = sorted(set(mut_names) | set(state_out))
+    consts = {}
+    for name in const_names:
+        val = scope.get(name)
+        if val is None:
+            raise RuntimeError(
+                "persistable %r has no value — run the startup program"
+                % name)
+        consts[name] = jnp.asarray(val)
+    state0 = {}
+    for name in state_names:
+        val = scope.get(name)
+        if val is None:
+            raise RuntimeError(
+                "state var %r has no value — run the startup program"
+                % name)
+        state0[name] = jnp.asarray(val)
+
+    feed_names = sorted(feed_shapes)
+    seed = program.random_seed or 0
+
+    def train_step(*flat):
+        k = len(state_names)
+        env = dict(consts)
+        env.update(zip(state_names, flat[:k]))
+        counter = flat[k]
+        env.update(zip(feed_names, flat[k + 1:]))
+        ctx = LoweringContext(base_key=jax.random.fold_in(
+            jax.random.PRNGKey(seed), counter))
+        execute_block(block, env, ctx)
+        outs = [env[n] for n in state_names]
+        outs.append(counter + jnp.uint32(1))
+        outs.extend(env[n] for n in fetch_names)
+        return tuple(outs)
+
+    arg_specs = [jax.ShapeDtypeStruct(state0[n].shape, state0[n].dtype)
+                 for n in state_names]
+    arg_specs.append(jax.ShapeDtypeStruct((), jnp.uint32))
+    feed_dtypes = {}
+    for name in feed_names:
+        v = block._find_var_recursive(name)
+        dt = framework.dtype_to_np(v.dtype if v is not None else "float32")
+        feed_dtypes[name] = np.dtype(dt)
+        arg_specs.append(jax.ShapeDtypeStruct(
+            tuple(feed_shapes[name]), dt))
+
+    os.makedirs(dirname, exist_ok=True)
+    lines = []
+    for i, p in enumerate(platforms):
+        exported = jexport.export(jax.jit(train_step),
+                                  platforms=[p])(*arg_specs)
+        mod = "__train__.%s.mlirbc" % p
+        with open(os.path.join(dirname, mod), "wb") as f:
+            f.write(exported.mlir_module_serialized)
+        if i == 0:
+            # full jax.export blob: lets a Python host (or a test)
+            # validate the module's loop-carried semantics without PJRT
+            with open(os.path.join(dirname, "__train__.jaxexport"),
+                      "wb") as f:
+                f.write(bytes(exported.serialize()))
+        lines.append("module %s %s" % (p, mod))
+    for name in state_names:
+        lines.append("state %s %s" % (name,
+                                      np.dtype(state0[name].dtype).str))
+    for name in feed_names:
+        lines.append("input %s %s" % (name, feed_dtypes[name].str))
+    for name in fetch_names:
+        lines.append("output %s" % name)
+    with open(os.path.join(dirname, "__train_native__.txt"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+    # initial state as a stored npz the C++ loop can read
+    np.savez(os.path.join(dirname, "state0.npz"),
+             **{n: np.asarray(v) for n, v in state0.items()})
+    meta = {"state": state_names, "feeds": feed_names,
+            "fetches": fetch_names}
+    with open(os.path.join(dirname, "__train_meta__.json"), "w") as f:
+        _json.dump(meta, f)
+    return state_names
+
+
 class ServingPredictor:
     """Runs an exported serving artifact (see export_serving_model)."""
 

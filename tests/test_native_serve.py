@@ -134,3 +134,99 @@ def test_full_serve_reaches_device_boundary(tmp_path):
     assert rc.returncode == 1
     assert "client create" in rc.stderr  # died AT the device boundary,
     # not in manifest/module/npz handling
+
+
+def test_native_train_artifact_semantics(tmp_path):
+    """export_native_train_step: the exported module's loop-carried
+    semantics (state out -> state in, counter as a state slot) must
+    reproduce the Executor's training trajectory EXACTLY — validated by
+    deserializing the jax.export blob and iterating it the same way the
+    C++ --train-loop does."""
+    import jax
+    from jax import export as jexport
+
+    import paddle_tpu as fluid
+    from paddle_tpu import inference, layers
+
+    x = layers.data(name="x", shape=[8])
+    y = layers.data(name="y", shape=[1])
+    pred = layers.fc(input=x, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    rng = np.random.RandomState(0)
+    xb = rng.randn(16, 8).astype(np.float32)
+    yb = rng.randn(16, 1).astype(np.float32)
+
+    from paddle_tpu.core import scope as scope_mod
+
+    sc = scope_mod.global_scope()
+    init = {n: np.asarray(sc.get(n)).copy() for n in sc.local_var_names()
+            if sc.get(n) is not None and not n.startswith("__")}
+
+    golden = []
+    for _ in range(4):
+        (lv,) = exe.run(fluid.default_main_program(),
+                        feed={"x": xb, "y": yb}, fetch_list=[loss])
+        golden.append(float(np.asarray(lv).reshape(-1)[0]))
+    for n, v in init.items():
+        sc.set(n, v.copy())
+    sc.set("__step_counter__", 0)
+
+    art = str(tmp_path / "train_art")
+    state_names = inference.export_native_train_step(
+        art, fluid.default_main_program(), {"x": (16, 8), "y": (16, 1)},
+        fetch_names=[loss.name], platforms=("cpu",))
+    manifest = open(os.path.join(art, "__train_native__.txt")).read()
+    assert "module cpu __train__.cpu.mlirbc" in manifest
+    blob = open(os.path.join(art, "__train__.cpu.mlirbc"), "rb").read()
+    assert blob[:4] == b"ML\xefR"
+
+    with open(os.path.join(art, "__train__.jaxexport"), "rb") as f:
+        exported = jexport.deserialize(bytearray(f.read()))
+    with np.load(os.path.join(art, "state0.npz")) as data:
+        state = [data[n] for n in state_names]
+    counter = np.uint32(0)
+    feeds = [xb, yb]  # sorted feed names: x < y
+    losses = []
+    for _ in range(4):  # exactly what the C++ loop does
+        outs = exported.call(*state, counter, *feeds)
+        k = len(state)
+        state, counter = list(outs[:k]), outs[k]
+        losses.append(float(np.asarray(outs[k + 1]).reshape(-1)[0]))
+    np.testing.assert_allclose(losses, golden, rtol=1e-6, atol=1e-7)
+
+
+def test_native_train_loop_reaches_device_boundary(tmp_path):
+    """--train-loop proceeds through manifest/module/state/npz handling
+    to PJRT client creation (no local chip here; on a TPU host the same
+    invocation trains)."""
+    _need_bin()
+    if not os.path.exists(_LIBTPU):
+        pytest.skip("no libtpu.so in image")
+    import paddle_tpu as fluid
+    from paddle_tpu import inference, layers
+
+    x = layers.data(name="x", shape=[4])
+    y = layers.data(name="y", shape=[1])
+    loss = layers.mean(layers.square_error_cost(
+        layers.fc(input=x, size=1), y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    art = str(tmp_path / "art")
+    inference.export_native_train_step(
+        art, fluid.default_main_program(), {"x": (8, 4), "y": (8, 1)},
+        fetch_names=[loss.name], platforms=("cpu",))
+    np.savez(str(tmp_path / "in.npz"),
+             x=np.ones((8, 4), np.float32), y=np.ones((8, 1), np.float32))
+    rc = subprocess.run(
+        [_BIN, "--artifact", art, "--train-loop", "3",
+         "--input", str(tmp_path / "in.npz"),
+         "--output", str(tmp_path / "out.npz"), "--plugin", _LIBTPU,
+         "--platform", "cpu"],
+        capture_output=True, text=True, timeout=300)
+    assert rc.returncode == 1
+    assert "client create" in rc.stderr
