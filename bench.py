@@ -154,8 +154,35 @@ def bench_transformer_fluid(steps=24, warmup=3, batch=160, seq=512):
     return steps * batch * seq / dt, last
 
 
-def main():
-    tokens_per_sec, last_loss = bench_transformer_fluid()
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--metrics-out", metavar="bench_metrics.json",
+                    default=None,
+                    help="also write the result through the observability "
+                         "metrics registry as a JSON dump (the BENCH_*.json "
+                         "trajectory becomes reproducible from the "
+                         "framework's own telemetry)")
+    ap.add_argument("--steps", type=int, default=24)
+    ap.add_argument("--warmup", type=int, default=3)
+    args = ap.parse_args(argv)
+
+    tokens_per_sec, last_loss = bench_transformer_fluid(
+        steps=args.steps, warmup=args.warmup)
+    if args.metrics_out:
+        # explicit registry use is an opt-in — no PTPU_METRICS needed;
+        # the executor's own step/compile telemetry (when enabled) shares
+        # the same process-wide registry and lands in the same dump
+        from paddle_tpu.observability import metrics as obs_metrics
+
+        reg = obs_metrics.registry()
+        reg.gauge("bench/tokens_per_sec_per_chip").set(tokens_per_sec)
+        reg.gauge("bench/vs_baseline").set(
+            tokens_per_sec / BASELINE_TOKENS_PER_SEC)
+        reg.gauge("bench/last_loss").set(last_loss)
+        reg.counter("bench/steps").inc(args.steps)
+        reg.dump_json(args.metrics_out)
     print(json.dumps({
         "metric": "transformer_base_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),

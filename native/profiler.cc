@@ -22,9 +22,17 @@ struct Event {
   uint64_t tid;
 };
 
+struct Stat {
+  int64_t count = 0;
+  double sum = 0.0;
+  double min = 0.0;
+  double max = 0.0;
+};
+
 std::atomic<int> g_enabled{0};
 std::mutex g_mu;
 std::vector<Event> g_events;
+std::unordered_map<std::string, Stat> g_stats;
 thread_local std::vector<std::pair<std::string, int64_t>> t_stack;
 
 int64_t now_us() {
@@ -100,6 +108,45 @@ int64_t ptpu_prof_dump_chrome(const char* path) {
 void ptpu_prof_reset(void) {
   std::lock_guard<std::mutex> lk(g_mu);
   g_events.clear();
+  g_stats.clear();
+}
+
+void ptpu_prof_stat_record(const char* name, double value) {
+  if (!g_enabled.load()) return;
+  std::lock_guard<std::mutex> lk(g_mu);
+  Stat& s = g_stats[name];
+  if (s.count == 0 || value < s.min) s.min = value;
+  if (s.count == 0 || value > s.max) s.max = value;
+  s.count++;
+  s.sum += value;
+}
+
+int64_t ptpu_prof_stat_count(const char* name) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_stats.find(name);
+  return it == g_stats.end() ? 0 : it->second.count;
+}
+
+int64_t ptpu_prof_stats_dump_json(const char* path) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  FILE* f = fopen(path, "wb");
+  if (!f) return -1;
+  fputs("{\"stats\":{", f);
+  size_t i = 0;
+  for (const auto& kv : g_stats) {
+    std::string name;
+    json_escape(kv.first, &name);
+    const Stat& s = kv.second;
+    fprintf(f,
+            "%s\"%s\":{\"count\":%lld,\"sum\":%.9g,\"min\":%.9g,"
+            "\"max\":%.9g,\"avg\":%.9g}",
+            i++ ? "," : "", name.c_str(),
+            static_cast<long long>(s.count), s.sum, s.min, s.max,
+            s.count ? s.sum / s.count : 0.0);
+  }
+  fputs("}}", f);
+  fclose(f);
+  return static_cast<int64_t>(g_stats.size());
 }
 
 const char* ptpu_version(void) { return "paddle-tpu-native 0.1.0"; }

@@ -74,6 +74,17 @@ PTPU_API void ptpu_prof_mark(const char* name, int64_t us_start,
 PTPU_API int64_t ptpu_prof_dump_chrome(const char* path);
 PTPU_API void ptpu_prof_reset(void);
 
+// named value-stats accumulator (count/sum/min/max per name), gated by
+// ptpu_prof_enable like the span collector — the native_serve train loop
+// records per-step latencies here and dumps them as JSON the Python
+// telemetry layer parses (observability parity for the Python-free path)
+PTPU_API void ptpu_prof_stat_record(const char* name, double value);
+// returns count for the name (0 if absent) — cheap introspection for tests
+PTPU_API int64_t ptpu_prof_stat_count(const char* name);
+// writes {"stats": {name: {count,sum,min,max,avg}}} JSON; returns the
+// number of stat names written, -1 on IO error
+PTPU_API int64_t ptpu_prof_stats_dump_json(const char* path);
+
 // ---- program serialization (framework/version.h compat checks) ----
 // payload (any bytes, e.g. the program JSON) -> framed binary with magic,
 // format version and CRC32. Caller frees *out with ptpu_buf_free.

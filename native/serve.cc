@@ -22,6 +22,7 @@
 
 #include <zlib.h>
 
+#include <chrono>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -32,6 +33,7 @@
 #include <string>
 #include <vector>
 
+#include "ptpu_native.h"  // profiler stats accumulator (profiler.cc)
 #include "third_party/pjrt/pjrt_c_api.h"
 
 namespace {
@@ -483,7 +485,13 @@ void destroy_buffer(PJRT_Buffer* buf) {
 int train_loop(PJRT_Client* client, PJRT_Device* device,
                const std::string& artifact, const std::string& platform,
                const std::string& input, const std::string& state_path,
-               const std::string& output, int iterations) {
+               const std::string& output, int iterations,
+               const std::string& metrics_out) {
+  // step-latency telemetry (observability parity for the Python-free
+  // path): per-iteration wall time lands in the profiler.cc stats
+  // accumulator behind the ptpu_prof_enable hook, dumped as JSON the
+  // Python side parses (tools/ptpu_stats.py renders the same file)
+  if (!metrics_out.empty()) ptpu_prof_enable(1);
   TrainManifest mf = read_train_manifest(artifact, platform);
   std::string module = read_file(artifact + "/" + mf.module_file);
   PJRT_LoadedExecutable* exec;
@@ -535,6 +543,7 @@ int train_loop(PJRT_Client* client, PJRT_Device* device,
   size_t n_results = k + 1 + mf.outputs.size();
   std::vector<PJRT_Buffer*> results(n_results);
   for (int it = 0; it < iterations; ++it) {
+    auto t0 = std::chrono::steady_clock::now();
     PJRT_ExecuteOptions opts;
     memset(&opts, 0, sizeof(opts));
     opts.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
@@ -561,6 +570,16 @@ int train_loop(PJRT_Client* client, PJRT_Device* device,
     if (it + 1 < iterations)  // fetches of non-final steps are dropped
       for (size_t i = k + 1; i < n_results; ++i)
         destroy_buffer(results[i]);
+    ptpu_prof_stat_record(
+        "train_loop/step_time_us",
+        std::chrono::duration_cast<std::chrono::microseconds>(
+            std::chrono::steady_clock::now() - t0)
+            .count());
+  }
+  if (!metrics_out.empty()) {
+    if (ptpu_prof_stats_dump_json(metrics_out.c_str()) < 0)
+      std::fprintf(stderr, "native_serve: cannot write metrics to %s\n",
+                   metrics_out.c_str());
   }
 
   std::vector<std::pair<std::string, Tensor>> out;
@@ -580,6 +599,7 @@ int train_loop(PJRT_Client* client, PJRT_Device* device,
 
 int main(int argc, char** argv) {
   std::string artifact, input, output, platform = "cpu", state_path;
+  std::string metrics_out;
   const char* env_plugin = getenv("PJRT_PLUGIN_LIBRARY");
   std::string plugin = env_plugin ? env_plugin : "";
   bool probe_only = false;
@@ -597,7 +617,22 @@ int main(int argc, char** argv) {
     else if (a == "--platform") platform = next();
     else if (a == "--train-loop") loop_iters = std::stoi(next());
     else if (a == "--state") state_path = next();
+    else if (a == "--metrics-out") metrics_out = next();
     else if (a == "--probe") probe_only = true;
+    else if (a == "--stats-selftest") {
+      // test hook (like --npz-roundtrip): exercise the step-latency
+      // stats accumulator + JSON dump without needing a PJRT device
+      std::string out = next();
+      ptpu_prof_enable(1);
+      ptpu_prof_stat_record("train_loop/step_time_us", 120.0);
+      ptpu_prof_stat_record("train_loop/step_time_us", 80.0);
+      ptpu_prof_stat_record("train_loop/step_time_us", 100.0);
+      if (ptpu_prof_stats_dump_json(out.c_str()) < 0)
+        die("cannot write " + out);
+      std::fprintf(stderr, "native_serve: stats selftest -> %s\n",
+                   out.c_str());
+      return 0;
+    }
     else if (a == "--npz-roundtrip") {
       // test hook: exercise the C++ npy/npz codec against numpy
       // without needing a usable PJRT device in the environment
@@ -661,13 +696,18 @@ int main(int argc, char** argv) {
 
   if (loop_iters > 0)
     return train_loop(client, device, artifact, platform, input,
-                      state_path, output, loop_iters);
+                      state_path, output, loop_iters, metrics_out);
 
+  // inference-mode telemetry: same accumulator + JSON schema as the
+  // train loop, so --metrics-out is honored (not silently ignored) in
+  // every mode that reaches execution
+  if (!metrics_out.empty()) ptpu_prof_enable(1);
   Manifest mf = read_manifest(artifact, platform);
   std::string module = read_file(artifact + "/" + mf.module_file);
 
   PJRT_LoadedExecutable* exec;
   {
+    auto t0 = std::chrono::steady_clock::now();
     PJRT_Program prog;
     memset(&prog, 0, sizeof(prog));
     prog.struct_size = PJRT_Program_STRUCT_SIZE;
@@ -686,6 +726,11 @@ int main(int argc, char** argv) {
     a.compile_options_size = 0;
     check(g_api->PJRT_Client_Compile(&a), "compile");
     exec = a.executable;
+    ptpu_prof_stat_record(
+        "serve/compile_time_us",
+        std::chrono::duration_cast<std::chrono::microseconds>(
+            std::chrono::steady_clock::now() - t0)
+            .count());
   }
 
   auto feeds = read_npz(input);
@@ -733,6 +778,7 @@ int main(int argc, char** argv) {
 
   std::vector<PJRT_Buffer*> outbufs(num_outputs);
   {
+    auto t0 = std::chrono::steady_clock::now();
     PJRT_ExecuteOptions opts;
     memset(&opts, 0, sizeof(opts));
     opts.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
@@ -751,6 +797,11 @@ int main(int argc, char** argv) {
     a.device_complete_events = &done;
     check(g_api->PJRT_LoadedExecutable_Execute(&a), "execute");
     if (done) await_event(done, "execution");
+    ptpu_prof_stat_record(
+        "serve/execute_time_us",
+        std::chrono::duration_cast<std::chrono::microseconds>(
+            std::chrono::steady_clock::now() - t0)
+            .count());
   }
 
   std::vector<std::pair<std::string, Tensor>> results;
@@ -784,6 +835,11 @@ int main(int argc, char** argv) {
     results.emplace_back(mf.outputs[i], std::move(t));
   }
   write_npz(output, results);
+  if (!metrics_out.empty()) {
+    if (ptpu_prof_stats_dump_json(metrics_out.c_str()) < 0)
+      std::fprintf(stderr, "native_serve: cannot write metrics to %s\n",
+                   metrics_out.c_str());
+  }
   std::fprintf(stderr, "native_serve: wrote %zu outputs to %s\n",
                results.size(), output.c_str());
   return 0;

@@ -51,6 +51,7 @@ from . import io
 from .io import save_inference_model, load_inference_model  # noqa: F401
 from . import metrics
 from . import nets
+from . import observability
 from . import profiler
 from . import reader
 from . import dataset

@@ -18,6 +18,9 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import framework
+from . import observability as _observability
+from .observability import metrics as _metrics
+from .observability import tracing as _tracing
 from .core.lowering import (LoweringContext, execute_block,
                             pack_nan_reports, pack_warn_reports,
                             raise_if_nonfinite)
@@ -272,23 +275,38 @@ class CompiledProgram:
 
         key = (self._program.version, _feed_signature(feed),
                tuple(fetch_names), bool(flag("check_nan_inf")))
-        step = self._compiled_steps.get(key)
-        if step is None:
-            pp = int(getattr(self._build_strategy,
-                             "pipeline_stages", 1) or 1)
-            if pp > 1:
-                from .parallel.pipeline_program import PipelineProgramStep
+        rec = _metrics.enabled()
+        with _observability.step_scope():
+            step = self._compiled_steps.get(key)
+            if step is None:
+                if rec:
+                    _metrics.counter("compile_cache/miss").inc()
+                pp = int(getattr(self._build_strategy,
+                                 "pipeline_stages", 1) or 1)
+                with _tracing.span("lower"):
+                    if pp > 1:
+                        from .parallel.pipeline_program import \
+                            PipelineProgramStep
 
-                step = PipelineProgramStep(
-                    self._program, feed.keys(), fetch_names,
-                    self._get_mesh(), self._build_strategy,
-                    self._loss_name)
-            else:
-                step = _DataParallelStep(self._program, feed.keys(),
-                                         fetch_names, self._get_mesh(),
-                                         self._build_strategy)
-            self._compiled_steps[key] = step
-        fetches = step.run(scope, feed)
+                        step = PipelineProgramStep(
+                            self._program, feed.keys(), fetch_names,
+                            self._get_mesh(), self._build_strategy,
+                            self._loss_name)
+                    else:
+                        step = _DataParallelStep(
+                            self._program, feed.keys(), fetch_names,
+                            self._get_mesh(), self._build_strategy)
+                self._compiled_steps[key] = step
+            elif rec:
+                _metrics.counter("compile_cache/hit").inc()
+            with _tracing.span("execute"):
+                fetches = step.run(scope, feed)
+        if rec:
+            from .executor import _nbytes
+
+            _metrics.counter("executor/feed_bytes").inc(
+                _nbytes(feed.values()))
+            _metrics.counter("executor/fetch_bytes").inc(_nbytes(fetches))
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return fetches

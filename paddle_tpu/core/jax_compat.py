@@ -1,0 +1,59 @@
+"""Version-bridging shims over jax.
+
+The codebase targets the current jax API; the runtime image may carry an
+older release. Each shim degrades to the old spelling with identical
+semantics so the parallel paths run on both.
+
+shard_map: `jax.shard_map` (top-level since jax 0.6) vs
+`jax.experimental.shard_map.shard_map`. Keyword drift handled:
+  check_vma=...      -> check_rep=...   (the replication/varying-manual-
+                                         axes check was renamed)
+  axis_names={...}   -> auto=mesh axes - axis_names  (partial-manual:
+                        the new API names the MANUAL axes, the old one
+                        names the AUTO remainder)
+"""
+
+try:  # jax >= 0.6
+    from jax import shard_map as _shard_map
+
+    _NEW_API = True
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _NEW_API = False
+
+__all__ = ["shard_map", "optimization_barrier"]
+
+
+def _make_optimization_barrier():
+    """jax.lax.optimization_barrier has no differentiation rule before
+    jax 0.5. The barrier is semantically identity and exists only as a
+    fusion hint, so on old jax it degrades to identity — every op
+    (including double-grad, which custom_vjp cannot express) stays
+    differentiable at the cost of the fusion break."""
+    import jax
+    import numpy as np
+
+    bar = jax.lax.optimization_barrier
+    try:
+        jax.eval_shape(jax.grad(lambda x: bar(x)), np.zeros((), np.float32))
+        return bar
+    except NotImplementedError:
+        return lambda x: x
+
+
+optimization_barrier = _make_optimization_barrier()
+
+
+def shard_map(f, mesh=None, in_specs=None, out_specs=None, **kwargs):
+    if _NEW_API:
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kwargs)
+    kw = dict(kwargs)
+    if "check_vma" in kw:
+        kw["check_rep"] = kw.pop("check_vma")
+    axis_names = kw.pop("axis_names", None)
+    if axis_names is not None:
+        kw["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)

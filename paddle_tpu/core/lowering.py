@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability import metrics as _obs_metrics
 from ..ops import registry
 
 
@@ -114,6 +115,12 @@ def register_special(op_type):
 
 def execute_block(block, env, ctx):
     """Symbolically execute every op of `block` over env (name -> tracer)."""
+    if _obs_metrics.enabled():
+        # trace-time (not per-step) cost: these count how much program
+        # structure each retrace lowers, the denominator for compile-time
+        # histograms in the compile cache telemetry
+        _obs_metrics.counter("lowering/blocks_traced").inc()
+        _obs_metrics.counter("lowering/ops_traced").inc(len(block.ops))
     for op in block.ops:
         execute_op(op, env, ctx)
     return env

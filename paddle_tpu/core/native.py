@@ -75,6 +75,11 @@ def _configure(lib):
                                    ctypes.c_int64]
     lib.ptpu_prof_dump_chrome.restype = ctypes.c_int64
     lib.ptpu_prof_dump_chrome.argtypes = [ctypes.c_char_p]
+    lib.ptpu_prof_stat_record.argtypes = [ctypes.c_char_p, ctypes.c_double]
+    lib.ptpu_prof_stat_count.restype = ctypes.c_int64
+    lib.ptpu_prof_stat_count.argtypes = [ctypes.c_char_p]
+    lib.ptpu_prof_stats_dump_json.restype = ctypes.c_int64
+    lib.ptpu_prof_stats_dump_json.argtypes = [ctypes.c_char_p]
 
     lib.ptpu_program_seal.restype = ctypes.c_int64
     lib.ptpu_program_seal.argtypes = [

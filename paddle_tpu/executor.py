@@ -11,12 +11,17 @@ signature, fetch names) — the analogue of Fluid's `_get_strong_program_cache_k
 (executor.py:250), but a cache hit here skips XLA retracing entirely.
 """
 
+import time
+
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
 from . import framework
+from . import observability as _observability
+from .observability import metrics as _metrics
+from .observability import tracing as _tracing
 from .core.lowering import (LoweringContext, execute_block,
                             pack_nan_reports, pack_warn_reports,
                             raise_if_nonfinite)
@@ -41,6 +46,21 @@ def _feed_signature(feed):
 
 def as_numpy(x):
     return np.asarray(x)
+
+
+def _nbytes(vals):
+    """Total buffer bytes across feed/fetch values without touching device
+    memory (jax.Array.nbytes is shape metadata, not a transfer)."""
+    total = 0
+    for v in vals:
+        nb = getattr(v, "nbytes", None)
+        if nb is not None:
+            total += int(nb)
+    return total
+
+
+# byte-scale buckets for module-size histograms (1KiB .. 1GiB)
+_BYTE_BUCKETS = tuple(float(1 << s) for s in range(10, 31, 2))
 
 
 class _CompiledStep:
@@ -131,6 +151,14 @@ class _CompiledStep:
         # leave the scope at its pre-step values (catch-and-continue safe)
         donate = () if self._check_nan_inf else (0,)
         self._jitted = jax.jit(step, donate_argnums=donate)
+        # AOT-compiled executable, built on FIRST run when telemetry is on
+        # so compile time and module size are measured separately from
+        # execute time (the plain jit dispatch hides both in call #1).
+        # Once a step has executed via the jit path its executable is
+        # already cached — AOT-compiling then would duplicate the whole
+        # XLA compile just to measure it, so _ran_jit pins the jit path.
+        self._aot = None
+        self._ran_jit = False
 
     def _read_state(self, scope, names):
         state = {}
@@ -178,8 +206,21 @@ class _CompiledStep:
                     "pull/push)" % name)
             feeds[name] = arr
         step_counter = np.uint32(scope.get("__step_counter__", 0) or 0)
-        fetches, new_state, finite, warns = self._jitted(
-            mut, const, feeds, step_counter)
+        fn = self._aot
+        if fn is None:
+            # tracing alone also takes the AOT path: without it the first
+            # "execute" span would swallow the whole trace+compile and
+            # point a Perfetto reader at the device for host-side cost
+            if ((_metrics.enabled() or _tracing.enabled())
+                    and not self._ran_jit):
+                fn = self._compile_instrumented(mut, const, feeds,
+                                                step_counter)
+            else:
+                fn = self._jitted
+                self._ran_jit = True
+        with _tracing.span("execute"):
+            fetches, new_state, finite, warns = fn(
+                mut, const, feeds, step_counter)
         if self._warn_labels and warns.size:
             import warnings
 
@@ -200,6 +241,37 @@ class _CompiledStep:
             self._run_rpc_plan(scope, dict(zip(self._all_fetch_names,
                                                fetches)))
         return fetches[: len(self.fetch_names)]
+
+    def _compile_instrumented(self, mut, const, feeds, step_counter):
+        """Trace+lower+compile ahead of time (jax AOT), recording the
+        compile-vs-execute split and the StableHLO module size. The
+        compiled executable replaces the jit dispatch for this step's
+        remaining runs, so the telemetry shows compile cost exactly once
+        per cache entry instead of folded into the first step."""
+        with _tracing.span("compile", step=self.fetch_names[:4]):
+            t0 = time.perf_counter()
+            lowered = self._jitted.lower(mut, const, feeds, step_counter)
+            t1 = time.perf_counter()
+            compiled = lowered.compile()
+            t2 = time.perf_counter()
+        _metrics.histogram("compile_cache/trace_time").observe(t1 - t0)
+        _metrics.histogram("compile_cache/compile_time").observe(t2 - t1)
+        if _metrics.enabled():  # serialization is real work, not a no-op
+            try:
+                # bytecode serialization, NOT as_text(): the pretty text
+                # of a large step runs to tens of MB just to be len()'d
+                import io
+
+                buf = io.BytesIO()
+                lowered.compiler_ir("stablehlo").operation.write_bytecode(
+                    buf)
+                _metrics.histogram("compile_cache/stablehlo_module_bytes",
+                                   buckets=_BYTE_BUCKETS).observe(
+                    buf.tell())
+            except Exception:
+                pass
+        self._aot = compiled
+        return compiled
 
     def _run_rpc_plan(self, scope, fetched):
         """Host-side pserver round (grpc_client.h parity): send grads,
@@ -291,14 +363,26 @@ class Executor:
             tuple(fetch_names),
             bool(flag("check_nan_inf")),
         )
-        compiled = self._cache.get(key) if use_program_cache else None
-        if compiled is None:
-            compiled = _CompiledStep(program, feed.keys(), fetch_names, scope)
-            if use_program_cache:
-                self._cache[key] = compiled
+        rec = _metrics.enabled()
+        with _observability.step_scope():
+            compiled = self._cache.get(key) if use_program_cache else None
+            if compiled is None:
+                if rec:
+                    _metrics.counter("compile_cache/miss").inc()
+                with _tracing.span("lower"):
+                    compiled = _CompiledStep(program, feed.keys(),
+                                             fetch_names, scope)
+                if use_program_cache:
+                    self._cache[key] = compiled
+            elif rec:
+                _metrics.counter("compile_cache/hit").inc()
 
-        with jax.default_device(self.place.jax_device()):
-            fetches = compiled.run(scope, feed)
+            with jax.default_device(self.place.jax_device()):
+                fetches = compiled.run(scope, feed)
+        if rec:
+            _metrics.counter("executor/feed_bytes").inc(
+                _nbytes(feed.values()))
+            _metrics.counter("executor/fetch_bytes").inc(_nbytes(fetches))
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return fetches

@@ -1,0 +1,184 @@
+"""Span-based host tracing with Chrome-trace/Perfetto JSON export.
+
+`span("compile")` is a nestable, thread-safe context manager. Each
+completed span is recorded as one chrome://tracing complete ("X") event
+(the format tools/timeline.py merges and Perfetto/chrome://tracing open
+directly). Device-side alignment: while a `jax.profiler` trace is
+active, every span also enters a `jax.profiler.TraceAnnotation`, so the
+host spans show up on the XPlane timeline next to the XLA device rows —
+the CUPTI DeviceTracer correlation the reference had (SURVEY §5.1).
+Spans are additionally forwarded to the native C++ collector
+(native/profiler.cc ptpu_prof_mark) when it is loaded and enabled, so
+one chrome-trace dump can carry Python, C++, and device work.
+
+Enablement mirrors metrics.py: OFF unless `PTPU_TRACE=1` or
+`PTPU_TRACE_DIR=<dir>` is set (or `enable()` is called); when off,
+`span()` returns a shared null context manager — no per-call
+allocation. Buffering is a bounded ring (`MAX_EVENTS`): the newest
+spans win, and the dump carries a `ptpuDroppedSpans` eviction count.
+"""
+
+import collections
+import json
+import os
+import threading
+import time
+
+__all__ = ["span", "enabled", "enable", "disable", "events",
+           "dump_chrome_trace", "reset", "MAX_EVENTS"]
+
+MAX_EVENTS = 200000
+
+# ring buffer: the NEWEST spans win (the tail of a long run is what gets
+# debugged); evictions are counted into the dump's ptpuDroppedSpans note
+_events = collections.deque(maxlen=MAX_EVENTS)
+_dropped = 0
+_lock = threading.Lock()
+_pid = os.getpid()
+
+_jax_profiler = None  # resolved lazily; False = unavailable
+
+
+def _annotation(name):
+    """jax.profiler.TraceAnnotation if jax is importable, else None."""
+    global _jax_profiler
+    if _jax_profiler is None:
+        try:
+            from jax import profiler as jp
+            _jax_profiler = jp
+        except Exception:
+            _jax_profiler = False
+    if _jax_profiler:
+        try:
+            return _jax_profiler.TraceAnnotation(name)
+        except Exception:
+            return None
+    return None
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **args):
+        return self  # chains like Span.set: `with span(...).set(...)`
+
+
+NULL_SPAN = _NullSpan()
+
+
+class Span:
+    __slots__ = ("name", "args", "_t0", "_ann")
+
+    def __init__(self, name, args=None):
+        self.name = name
+        self.args = args
+        self._t0 = 0
+        self._ann = None
+
+    def set(self, **args):
+        """Attach key/values rendered in the trace viewer's args pane."""
+        if self.args is None:
+            self.args = args
+        else:
+            self.args.update(args)
+        return self
+
+    def __enter__(self):
+        ann = _annotation(self.name)
+        if ann is not None:
+            ann.__enter__()
+        self._ann = ann
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter_ns()
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+        ts = self._t0 // 1000
+        dur = (t1 - self._t0) // 1000
+        ev = {"name": self.name, "ph": "X", "pid": _pid,
+              "tid": threading.get_ident() % 100000, "ts": ts, "dur": dur,
+              "cat": "host"}
+        if self.args:
+            ev["args"] = self.args
+        global _dropped
+        with _lock:
+            if len(_events) == MAX_EVENTS:
+                _dropped += 1  # deque evicts the oldest on append
+            _events.append(ev)
+        _forward_native(self.name, ts, ts + dur)
+        return False
+
+
+def _forward_native(name, us_start, us_end):
+    """Mirror the span into the C++ collector when it is live+enabled,
+    so ptpu_prof_dump_chrome sees host spans too."""
+    try:
+        from ..core import native
+
+        l = native.lib()
+        if l is not None and l.ptpu_prof_enabled():
+            l.ptpu_prof_mark(name.encode(), us_start, us_end)
+    except Exception:
+        pass
+
+
+from .metrics import _env_on  # one parser for every PTPU_* switch
+
+_ENABLED = _env_on("PTPU_TRACE") or _env_on("PTPU_TRACE_DIR")
+
+
+def enabled():
+    return _ENABLED
+
+
+def enable():
+    global _ENABLED
+    _ENABLED = True
+
+
+def disable():
+    global _ENABLED
+    _ENABLED = False
+
+
+def span(name, **args):
+    """A context manager timing one named region; nested spans nest in
+    the exported trace. No-op singleton (zero allocation) when disabled."""
+    if not _ENABLED:
+        return NULL_SPAN
+    return Span(name, args or None)
+
+
+def events():
+    """Snapshot of the recorded chrome-trace events."""
+    with _lock:
+        return list(_events)
+
+
+def reset():
+    global _dropped
+    with _lock:
+        _events.clear()
+        _dropped = 0
+
+
+def dump_chrome_trace(path):
+    """Write {"traceEvents": [...]} Chrome-trace JSON (open in Perfetto:
+    ui.perfetto.dev > Open trace file). Returns the event count."""
+    with _lock:
+        evs = list(_events)
+        dropped = _dropped
+    doc = {"traceEvents": evs, "displayTimeUnit": "ms"}
+    if dropped:
+        doc["ptpuDroppedSpans"] = dropped
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return len(evs)

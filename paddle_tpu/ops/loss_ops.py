@@ -9,6 +9,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..core.jax_compat import optimization_barrier
 from .registry import register
 
 
@@ -69,7 +70,7 @@ def _hard_label_ce_bwd(ignore_index, res, g):
     # barrier: without it XLA CSEs this upcast with the forward's and
     # keeps the full fp32 logits alive from forward to backward — the
     # exact buffer this custom vjp exists to avoid
-    logits = jax.lax.optimization_barrier(logits)
+    logits = optimization_barrier(logits)
     xf = logits.astype(jnp.float32)
     lse = jax.scipy.special.logsumexp(xf, axis=-1, keepdims=True)
     # dlogits in the LOGITS dtype end to end: softmax values are in [0, 1]

@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.jax_compat import optimization_barrier
 from .registry import register, simple_op, np_dtype
 
 
@@ -85,7 +86,7 @@ def _layer_norm(ctx, ins, attrs):
     # fusion, which measurably serializes the dot (flagship FFN pair:
     # 4.06 ms fused-with-stats vs ~1.8 ms behind a barrier — a 2.2x
     # slowdown on the hottest fusions in the step)
-    x = jax.lax.optimization_barrier(x)
+    x = optimization_barrier(x)
     xf = x.astype(jnp.float32)
     mean = jnp.mean(xf, axis=axes, keepdims=True)
     var = jnp.mean((xf - mean) ** 2, axis=axes, keepdims=True)

@@ -47,7 +47,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ..core.jax_compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.lowering import LoweringContext, execute_op

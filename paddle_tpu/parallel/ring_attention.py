@@ -91,7 +91,7 @@ def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=True,
     stay GSPMD-auto) — the form the descriptor-path flash_attention op
     uses inside a jitted step whose dp/tp axes GSPMD manages."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from ..core.jax_compat import shard_map
 
     spec = P(None, None, axis_name, None)
     kwargs = ({"axis_names": {axis_name}, "check_vma": False}

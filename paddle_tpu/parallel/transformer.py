@@ -39,7 +39,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from ..core.jax_compat import shard_map
 
 from ..models import transformer as T
 

@@ -15,7 +15,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from ..core.jax_compat import shard_map
 
 
 def _pad_leading(x, n):

@@ -11,12 +11,19 @@ reference's timeline.py produced).
 import contextlib
 import os
 import time
-from collections import defaultdict
+
+from .observability import metrics as _obs_metrics
+from .observability import tracing as _obs_tracing
 
 __all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler",
-           "stop_profiler", "record_event", "dump_chrome_trace"]
+           "stop_profiler", "record_event", "dump_chrome_trace",
+           "event_stats"]
 
-_events = defaultdict(lambda: [0, 0.0, 0.0, float("inf")])  # calls,total,max,min
+# Legacy aggregator, rebuilt on the observability registry: each
+# record_event name is one histogram in this dedicated always-on registry
+# (the fluid profiler API predates the PTPU_METRICS switch and must
+# aggregate whenever used, so it does not share the global gate).
+_legacy = _obs_metrics.MetricsRegistry()
 _active = [False]
 _trace_dir = [None]
 
@@ -30,7 +37,18 @@ def cuda_profiler(output_file, output_mode=None, config=None):
 
 
 def reset_profiler():
-    _events.clear()
+    _legacy.reset()
+
+
+def event_stats():
+    """{event name: {'calls', 'total', 'avg', 'max', 'min'}} in seconds —
+    the table _print_summary renders, as data."""
+    out = {}
+    for name, h in _legacy.metrics().items():
+        out[name] = {"calls": h.count, "total": h.sum, "avg": h.avg,
+                     "max": h.max if h.count else None,
+                     "min": h.min if h.count else None}
+    return out
 
 
 def start_profiler(state="All", tracer_option=None, trace_dir=None):
@@ -67,17 +85,27 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
 
 
 def _print_summary(sorted_key=None):
-    if not _events:
+    hists = _legacy.metrics()
+    if not hists:
         return
     rows = []
-    for name, (calls, total, mx, mn) in _events.items():
-        rows.append((name, calls, total, total / max(calls, 1), mx, mn))
+    for name, h in hists.items():
+        # zero-call events (registered but never observed) carry the
+        # histogram's +/-inf sentinels; keep them sortable here and
+        # render them as '-' below instead of leaking inf into the table
+        rows.append((name, h.count, h.sum, h.avg,
+                     h.max if h.count else 0.0,
+                     h.min if h.count else 0.0))
     key_idx = {"calls": 1, "total": 2, "ave": 3, "max": 4, "min": 5}.get(
         sorted_key, 2)
     rows.sort(key=lambda r: r[key_idx], reverse=True)
     print("%-40s %8s %12s %12s %12s %12s" % (
         "Event", "Calls", "Total(ms)", "Avg(ms)", "Max(ms)", "Min(ms)"))
     for name, calls, total, avg, mx, mn in rows:
+        if calls == 0:
+            print("%-40s %8d %12.4f %12s %12s %12s" % (
+                name, 0, 0.0, "-", "-", "-"))
+            continue
         print("%-40s %8d %12.4f %12.4f %12.4f %12.4f" % (
             name, calls, total * 1e3, avg * 1e3, mx * 1e3, mn * 1e3))
 
@@ -86,24 +114,28 @@ def _print_summary(sorted_key=None):
 def record_event(name):
     """Host-side RAII event marker (parity: platform/profiler.h RecordEvent).
     When the native library is present, spans also land in the C++ collector
-    (platform/profiler.cc parity) for chrome-trace export."""
+    (platform/profiler.cc parity) for chrome-trace export; when span tracing
+    is on (PTPU_TRACE), they land in the observability chrome trace too."""
     from .core import native
 
     l = native.lib()
+    span = _obs_tracing.span(name)
+    # when span tracing is on, Span.__exit__ already forwards the interval
+    # to the native collector (ptpu_prof_mark) — pushing here too would
+    # record every event twice in the chrome-trace dump
+    use_native = (l is not None and _active[0]
+                  and not _obs_tracing.enabled())
     t0 = time.perf_counter()
-    if l is not None and _active[0]:
+    if use_native:
         l.ptpu_prof_push(name.encode())
+    span.__enter__()
     try:
         yield
     finally:
-        if l is not None and _active[0]:
+        span.__exit__(None, None, None)
+        if use_native:
             l.ptpu_prof_pop()
-        dt = time.perf_counter() - t0
-        ev = _events[name]
-        ev[0] += 1
-        ev[1] += dt
-        ev[2] = max(ev[2], dt)
-        ev[3] = min(ev[3], dt)
+        _legacy.histogram(name).observe(time.perf_counter() - t0)
 
 
 def dump_chrome_trace(path):

@@ -12,6 +12,9 @@ import itertools
 import queue as _queue
 import random as _random
 import threading
+import time as _time
+
+from ..observability import metrics as _obs_metrics
 
 __all__ = ["map_readers", "buffered", "compose", "chain", "shuffle",
            "firstn", "xmap_readers", "cache", "batch", "PyReader",
@@ -302,9 +305,22 @@ class PyReader:
         # H2D overlap on its own stream; jax.device_put is async)
         pending = None
         while True:
+            # re-checked per batch so enable()/disable() mid-epoch takes
+            # effect here just like it does in Executor.run
+            rec = _obs_metrics.enabled()
+            t_wait = _time.perf_counter() if rec else 0.0
             item = q.get()
             if item is end:
                 break
+            if rec:
+                # batch-wait is the starvation signal: high wait + low
+                # queue depth means the host parse can't keep the device
+                # fed. Recorded only for real batches — the sentinel's
+                # wait measures producer teardown, not starvation.
+                _obs_metrics.histogram("reader/batch_wait_time").observe(
+                    _time.perf_counter() - t_wait)
+                _obs_metrics.gauge("reader/queue_depth").set(q.qsize())
+                _obs_metrics.counter("reader/batches").inc()
             staged = self._stage(item)
             if pending is not None:
                 yield pending

@@ -7,7 +7,8 @@
 #   bench      - headline benchmark (single JSON line; runs on the default
 #                backend — real TPU when attached)
 #   stress     - 5x back-to-back run of the rendezvous-heaviest file
-# Usage: scripts/ci.sh [build|test|api_check|bench|stress|all]
+#   obs        - observability smoke: metrics dump + stats CLI render
+# Usage: scripts/ci.sh [build|test|api_check|bench|stress|obs|all]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -75,6 +76,38 @@ do_test() {
   for f in "${HEAVY_FILES[@]}"; do
     run_isolated "$f"
   done
+  do_obs_smoke
+}
+
+do_obs_smoke() {
+  # observability receipt (docs/OBSERVABILITY.md): a 3-step toy program
+  # under PTPU_METRICS=1 must produce a metrics dump at exit that the
+  # stats CLI renders — step_time count, compile-cache hit/miss, trace
+  local dump=/tmp/ptpu_ci_metrics.json
+  rm -f "$dump"
+  JAX_PLATFORMS=cpu PTPU_METRICS=1 PTPU_METRICS_OUT="$dump" \
+    python - <<'PYEOF'
+import numpy as np
+import paddle_tpu as fluid
+
+x = fluid.layers.data(name="x", shape=[4])
+loss = fluid.layers.mean(fluid.layers.fc(input=x, size=2))
+fluid.optimizer.SGD(0.1).minimize(loss)
+exe = fluid.Executor(fluid.CPUPlace())
+exe.run(fluid.default_startup_program())
+for _ in range(3):
+    exe.run(feed={"x": np.ones((2, 4), np.float32)}, fetch_list=[loss])
+PYEOF
+  python tools/ptpu_stats.py --selftest
+  python tools/ptpu_stats.py "$dump"
+  python - "$dump" <<'PYEOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+assert doc["histograms"]["executor/step_time"]["count"] >= 3, doc
+assert doc["counters"]["compile_cache/hit"] >= 1, doc
+assert doc["counters"]["compile_cache/miss"] >= 1, doc
+print("observability smoke ok")
+PYEOF
 }
 
 do_stress() {
@@ -102,6 +135,7 @@ case "$stage" in
   api_check) do_api_check ;;
   bench) do_bench ;;
   stress) do_stress ;;
+  obs) do_obs_smoke ;;
   all) do_build; do_test; do_api_check; do_bench ;;
   *) echo "unknown stage: $stage" >&2; exit 2 ;;
 esac

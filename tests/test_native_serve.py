@@ -230,3 +230,29 @@ def test_native_train_loop_reaches_device_boundary(tmp_path):
         capture_output=True, text=True, timeout=300)
     assert rc.returncode == 1
     assert "client create" in rc.stderr
+
+
+def test_train_loop_stats_selftest(tmp_path):
+    """The step-latency stats accumulator behind --metrics-out (profiler.cc
+    shared with the train loop) records and dumps JSON without needing a
+    PJRT device; the schema is the one tools/ptpu_stats.py renders."""
+    _need_bin()
+    import json
+
+    out = str(tmp_path / "stats.json")
+    rc = subprocess.run([_BIN, "--stats-selftest", out],
+                        capture_output=True, text=True, timeout=60)
+    assert rc.returncode == 0, rc.stderr
+    with open(out) as f:
+        doc = json.load(f)
+    s = doc["stats"]["train_loop/step_time_us"]
+    assert s["count"] == 3
+    assert s["min"] == 80.0 and s["max"] == 120.0
+    assert abs(s["avg"] - 100.0) < 1e-9
+    import sys
+
+    cli = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "ptpu_stats.py"), out],
+        capture_output=True, text=True, timeout=120)
+    assert cli.returncode == 0, cli.stderr
+    assert "train_loop/step_time_us" in cli.stdout

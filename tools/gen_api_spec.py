@@ -25,6 +25,7 @@ NAMESPACES = [
     ("paddle_tpu.regularizer", None),
     ("paddle_tpu.transpiler", None),
     ("paddle_tpu.nets", None),
+    ("paddle_tpu.observability", None),
     ("paddle_tpu.profiler", None),
     ("paddle_tpu.unique_name", None),
     ("paddle_tpu.reader", None),

@@ -1,0 +1,168 @@
+"""Pretty-print a paddle_tpu metrics dump (parity: the reference's
+profiler PrintProfiler tables, now fed from files instead of process
+state).
+
+Accepts either exposition schema the framework writes:
+  - a registry dump ({"counters": ..., "gauges": ..., "histograms": ...})
+    from PTPU_METRICS_OUT / MetricsRegistry.dump_json / bench.py
+    --metrics-out
+  - a native stats dump ({"stats": {name: {count,sum,min,max,avg}}})
+    from native_serve --train-loop --metrics-out (profiler.cc)
+
+Usage:
+  python tools/ptpu_stats.py dump.json [more.json ...]
+  python tools/ptpu_stats.py --prometheus dump.json   # re-expose as text
+  python tools/ptpu_stats.py --selftest               # CI smoke hook
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def _fmt(v):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        if v and (abs(v) < 1e-3 or abs(v) >= 1e6):
+            return "%.3e" % v
+        return "%.6g" % v
+    return str(v)
+
+
+def render(doc, out=sys.stdout):
+    """Render one parsed metrics document as aligned tables."""
+    wrote = False
+    if "stats" in doc:  # native profiler.cc schema
+        doc = {"histograms": {
+            name: {"count": s.get("count", 0), "sum": s.get("sum", 0.0),
+                   "avg": s.get("avg"), "min": s.get("min"),
+                   "max": s.get("max")}
+            for name, s in doc["stats"].items()}}
+    counters = doc.get("counters", {})
+    gauges = doc.get("gauges", {})
+    hists = doc.get("histograms", {})
+    if counters:
+        out.write("%-44s %14s\n" % ("Counter", "Value"))
+        for name in sorted(counters):
+            out.write("%-44s %14s\n" % (name, _fmt(counters[name])))
+        wrote = True
+    if gauges:
+        if wrote:
+            out.write("\n")
+        out.write("%-44s %14s\n" % ("Gauge", "Value"))
+        for name in sorted(gauges):
+            out.write("%-44s %14s\n" % (name, _fmt(gauges[name])))
+        wrote = True
+    if hists:
+        if wrote:
+            out.write("\n")
+        out.write("%-44s %8s %12s %12s %12s %12s\n" % (
+            "Histogram", "Count", "Sum", "Avg", "Min", "Max"))
+        for name in sorted(hists):
+            h = hists[name]
+            count = h.get("count", 0)
+            # zero-observation histograms have no min/max — render '-'
+            out.write("%-44s %8d %12s %12s %12s %12s\n" % (
+                name, count, _fmt(h.get("sum", 0.0)),
+                _fmt(h.get("avg") if count else None),
+                _fmt(h.get("min") if count else None),
+                _fmt(h.get("max") if count else None)))
+        wrote = True
+    if not wrote:
+        out.write("(no metrics)\n")
+
+
+def _to_prometheus(doc):
+    """Rebuild a registry from a JSON dump and re-expose as Prometheus
+    text. Registry dumps carry their bucket bounds/counts and round-trip
+    exactly; the native profiler.cc schema has no buckets (count/sum/
+    min/max only), so its histograms expose all mass at +Inf."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from paddle_tpu.observability.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+
+    def _fill(name, h):
+        bucket_doc = h.get("buckets") or {}
+        bounds = tuple(sorted(float(k) for k in bucket_doc if k != "+Inf"))
+        hist = reg.histogram(name, buckets=bounds or None)
+        if bucket_doc:
+            hist.bucket_counts = [int(bucket_doc.get(repr(b), 0))
+                                  for b in hist.buckets]
+            hist.bucket_counts.append(int(bucket_doc.get("+Inf", 0)))
+        else:
+            hist.bucket_counts[-1] = int(h.get("count", 0))
+        hist.count = int(h.get("count", 0))
+        hist.sum = float(h.get("sum", 0.0))
+        if hist.count:
+            hist.min = float(h.get("min", 0.0))
+            hist.max = float(h.get("max", 0.0))
+
+    if "stats" in doc:
+        for name, s in doc["stats"].items():
+            _fill(name, s)
+    for name, v in doc.get("counters", {}).items():
+        reg.counter(name).inc(v)
+    for name, v in doc.get("gauges", {}).items():
+        reg.gauge(name).set(v)
+    for name, h in doc.get("histograms", {}).items():
+        _fill(name, h)
+    return reg.to_prometheus()
+
+
+def _selftest():
+    """Build a registry in-process, dump it, re-read and render — the CI
+    smoke that the full JSON round trip stays parseable."""
+    import tempfile
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from paddle_tpu.observability.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.counter("selftest/count").inc(3)
+    reg.gauge("selftest/gauge").set(1.5)
+    h = reg.histogram("selftest/hist")
+    for v in (0.01, 0.02, 0.04):
+        h.observe(v)
+    reg.histogram("selftest/empty")  # zero-call rendering path
+    with tempfile.NamedTemporaryFile("r", suffix=".json") as f:
+        reg.dump_json(f.name)
+        doc = json.load(open(f.name))
+    render(doc)
+    assert doc["counters"]["selftest/count"] == 3
+    assert doc["histograms"]["selftest/hist"]["count"] == 3
+    assert "min" not in doc["histograms"]["selftest/empty"]
+    print("ptpu_stats selftest ok")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("files", nargs="*", help="metrics JSON dump(s)")
+    ap.add_argument("--prometheus", action="store_true",
+                    help="emit Prometheus text instead of tables")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the in-process round-trip smoke and exit")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _selftest()
+    if not args.files:
+        ap.error("no metrics files given (or use --selftest)")
+    for i, path in enumerate(args.files):
+        with open(path) as f:
+            doc = json.load(f)
+        if len(args.files) > 1:
+            sys.stdout.write("%s== %s ==\n" % ("\n" if i else "", path))
+        if args.prometheus:
+            sys.stdout.write(_to_prometheus(doc))
+        else:
+            render(doc)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
