@@ -37,7 +37,8 @@ from .framework import (
     CUDAPinnedPlace,
 )
 from .core.scope import Scope, global_scope, scope_guard
-from .executor import Executor
+from .executor import Executor, as_numpy  # noqa: F401
+from . import async_engine
 from .compiler import CompiledProgram, ExecutionStrategy, BuildStrategy
 from .backward import append_backward, gradients
 from .param_attr import ParamAttr, WeightNormParamAttr

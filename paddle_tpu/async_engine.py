@@ -1,0 +1,406 @@
+"""Asynchronous execution pipeline (the Fluid lineage's "dispatch step,
+fetch results" loop, made real on TPU).
+
+XLA dispatch is asynchronous: a jitted step returns device arrays that are
+futures, and the host only stalls when something forces a host copy. The
+seed executor threw that away by `np.asarray`-ing every fetch every step.
+This module holds the pieces that keep N steps in flight end-to-end:
+
+  LazyFetchList    — what `Executor.run(return_numpy=False)` (and every
+                     `fetch_every_n` skipped step) returns: the fetches as
+                     unmaterialized device futures. `as_numpy` (or
+                     np.asarray on an element) is the ONE sync point.
+  InflightWindow   — bounded count of dispatched-but-unsynced steps.
+                     Admitting a step past the limit first materializes the
+                     oldest step's fetches (host-transfer sync — reliable
+                     where block_until_ready is not, e.g. the axon tunnel),
+                     so device buffers can't grow without bound.
+  FeedPrefetcher   — background thread that `jax.device_put`s the NEXT
+                     batch (with its target sharding) while the current
+                     step executes; preserves batch order; feeds the
+                     `feed/h2d_bytes` / `feed/prefetch_depth` telemetry.
+  DeferredWarns    — host-side accumulator for the packed runtime-warning
+                     flags each step returns; materializes every few steps
+                     instead of syncing the device every step.
+  persistent cache — `PTPU_CACHE_DIR` wires jax's on-disk compilation
+                     cache, plus a program-fingerprint manifest so
+                     `compile_cache/persistent_hit|miss` can attribute
+                     cross-process cache reuse to OUR cache key (XLA's
+                     own key is the lowered HLO; the manifest threads the
+                     framework-level fingerprint through it).
+
+Sync-point contract (docs/ASYNC_EXECUTION.md): fetch values, scope state
+and runtime warnings are only guaranteed observed after a sync — a
+materialized fetch (`as_numpy`), a `fetch_every_n` boundary step, a
+`return_numpy=True` run, `Executor.sync()`, or window backpressure.
+Donated state buffers never alias a held fetch: XLA's copy insertion
+gives every entry-computation output its own buffer, so a fetch handle
+from step t stays valid (and keeps its step-t value) after step t+1
+donates and overwrites the state — tests/test_async_exec.py pins this.
+"""
+
+import hashlib
+import os
+import queue as _queue
+import threading
+
+import numpy as np
+
+from .observability import metrics as _metrics
+
+__all__ = ["LazyFetchList", "InflightWindow", "FeedPrefetcher",
+           "DeferredWarns", "as_numpy", "prefetch_iter",
+           "setup_persistent_cache", "persistent_cache_dir",
+           "note_compiled_program"]
+
+
+def as_numpy(value):
+    """THE sync point: materialize device fetch values as numpy. Accepts a
+    single value, a list/tuple of values, or a LazyFetchList."""
+    if isinstance(value, (list, tuple)):
+        return [np.asarray(v) for v in value]
+    return np.asarray(value)
+
+
+class LazyFetchList(list):
+    """Fetch results that have NOT been synced to host. Elements are the
+    raw device arrays — futures under XLA async dispatch — so any numpy
+    coercion (np.asarray, float(...)) is the materialization point."""
+
+    def as_numpy(self):
+        return [np.asarray(v) for v in self]
+
+
+def _materialize(token):
+    """Force one admitted step's fetches to host. np.asarray rather than
+    block_until_ready: a host transfer is the sync that works everywhere
+    (block_until_ready does not reliably block on the axon platform —
+    bench.py round-3 measurement)."""
+    if isinstance(token, (list, tuple)):
+        for v in token:
+            np.asarray(v)
+    else:
+        np.asarray(token)
+
+
+class InflightWindow:
+    """Bounded window of dispatched-but-unsynced steps (backpressure).
+
+    `admit` registers one async step's fetch handles; when the window is
+    full it first blocks on the OLDEST step, so at most `limit` steps of
+    fetch/state buffers are ever pending on device. The
+    `exec/inflight_steps` gauge records the window depth at each dispatch
+    (it is deliberately not zeroed on sync — it reads as "how deep was
+    the pipeline when a step was last dispatched")."""
+
+    def __init__(self, limit=12):
+        self.limit = max(1, int(limit))
+        self._pending = []
+
+    @property
+    def depth(self):
+        return len(self._pending)
+
+    def admit(self, token):
+        if token is None or (isinstance(token, (list, tuple))
+                             and not token):
+            return
+        while len(self._pending) >= self.limit:
+            _materialize(self._pending.pop(0))
+        self._pending.append(token)
+        _metrics.gauge("exec/inflight_steps").set(len(self._pending))
+
+    def drain(self):
+        """Block until every admitted step has materialized."""
+        while self._pending:
+            _materialize(self._pending.pop(0))
+
+    def reset(self):
+        """Forget admitted steps without blocking — for callers that just
+        synced the NEWEST step (device execution is in-order, so older
+        steps are complete by then)."""
+        del self._pending[:]
+
+
+class DeferredWarns:
+    """Deferred materialization for the per-step packed warning flags.
+
+    The all-false common case must not cost a device sync per step, so
+    each step's bool vector is merely kept (a device future); every
+    `drain_every` steps — and at executor close/sync — the pending
+    vectors are OR-reduced host-side and any newly-flagged label warns
+    once. Labels are trace-static per compiled step, so every pending
+    vector is congruent."""
+
+    __slots__ = ("drain_every", "_labels", "_pending")
+
+    def __init__(self, drain_every=8):
+        self.drain_every = max(1, int(drain_every))
+        self._labels = ()
+        self._pending = []
+
+    def add(self, labels, flags, warned):
+        if not labels or not getattr(flags, "size", 0):
+            return
+        if all(label in warned for label in labels):
+            return  # every label already fired: nothing left to observe
+        self._labels = labels
+        self._pending.append(flags)
+        if len(self._pending) >= self.drain_every:
+            self.drain(warned)
+
+    def drain(self, warned):
+        if not self._pending:
+            return
+        import warnings
+
+        flagged = np.logical_or.reduce(
+            [np.asarray(f) for f in self._pending])
+        del self._pending[:]
+        for label, hit in zip(self._labels, flagged):
+            if hit and label not in warned:
+                warned.add(label)
+                warnings.warn(label, RuntimeWarning)
+
+
+# ---------------------------------------------------------------------------
+# feed prefetch
+# ---------------------------------------------------------------------------
+
+
+def _nbytes(vals):
+    """Total buffer bytes across feed/fetch values without touching device
+    memory (jax.Array.nbytes is shape metadata, not a transfer). The one
+    byte-accounting helper behind executor/feed_bytes, executor/
+    fetch_bytes and feed/h2d_bytes."""
+    total = 0
+    for v in vals:
+        nb = getattr(v, "nbytes", None)
+        if nb is not None:
+            total += int(nb)
+    return total
+
+
+class FeedPrefetcher:
+    """Background host->device double buffer for feed dicts.
+
+    `put(feed)` hands a host batch to the worker thread, which
+    `jax.device_put`s every value — with the target sharding from
+    `sharding_fn(name, value)` when given (the compiled step's batch/seq
+    sharding decision) — while the device executes the current step.
+    `get()` returns staged batches strictly in put() order. At most
+    `depth` batches are staged ahead (put() blocks past that — the same
+    bounded-buffer contract as the in-flight window).
+
+    `take_if_match(feed)` serves the raw feed-dict path: it returns the
+    head staged batch only when it was built from exactly these value
+    objects (identity match), so `Executor.prefetch(feed)` followed by
+    `Executor.run(feed=feed)` transparently picks up the staged copy."""
+
+    _CLOSE = object()
+
+    def __init__(self, sharding_fn=None, depth=2, stage_fn=None):
+        self._sharding_fn = sharding_fn
+        self._stage_fn = stage_fn
+        # unbounded queues + a slot semaphore: the WORKER never blocks
+        # (so close() always reaches it), producers block in put() once
+        # `depth` batches are staged ahead
+        self._in = _queue.Queue()
+        self._out = _queue.Queue()
+        self._keys = _queue.Queue()
+        self._slots = threading.Semaphore(max(1, int(depth)))
+        self._thread = None
+        self._closed = False
+
+    # -- worker --------------------------------------------------------
+    def _ensure_thread(self):
+        if self._thread is None:
+            t = threading.Thread(target=self._worker,
+                                 name="ptpu-feed-prefetch", daemon=True)
+            t.start()
+            self._thread = t
+
+    def _stage_one(self, name, value):
+        if self._stage_fn is not None:
+            return self._stage_fn(name, value)
+        import jax
+
+        if isinstance(value, jax.Array):
+            return value  # already device-resident
+        from .executor import check_feed_int64
+
+        check_feed_int64(name, value)
+        dt = getattr(value, "dtype", None)
+        if dt is not None and np.dtype(dt) in (np.dtype(np.int64),
+                                               np.dtype(np.uint64)):
+            # keep 64-bit int slots host-side: device_put would
+            # canonicalize them to int32 BEFORE the executor's declared-
+            # dtype cast (and warn per batch); the step dispatch stages
+            # them exactly as the unprefetched path does
+            return value
+        sharding = (self._sharding_fn(name, value)
+                    if self._sharding_fn is not None else None)
+        try:
+            if sharding is not None:
+                return jax.device_put(value, sharding)
+            return jax.device_put(value)
+        except (TypeError, ValueError):
+            return value  # non-array feed entries pass through host-side
+
+    def _worker(self):
+        while True:
+            item = self._in.get()
+            if item is self._CLOSE:
+                return
+            try:
+                staged = {k: self._stage_one(k, v) for k, v in item.items()}
+                if _metrics.enabled():
+                    _metrics.counter("feed/h2d_bytes").inc(
+                        _nbytes(staged.values()))
+                result = ("ok", staged)
+            except BaseException as e:  # re-raised on the consumer side
+                result = ("error", e)
+            self._out.put(result)
+            if _metrics.enabled():
+                _metrics.gauge("feed/prefetch_depth").set(
+                    self._out.qsize())
+
+    # -- producer/consumer API -----------------------------------------
+    def put(self, feed):
+        """Queue one host feed dict for background staging. Blocks when
+        `depth` batches are already staged ahead."""
+        if self._closed:
+            raise RuntimeError("FeedPrefetcher is closed")
+        self._ensure_thread()
+        self._slots.acquire()
+        # strong refs to the SOURCE objects: identity matching via bare
+        # id() would misfire when CPython reuses a freed array's address
+        self._keys.put(dict(feed))
+        self._in.put(dict(feed))
+
+    def get(self):
+        """Next staged device feed, in put() order."""
+        self._keys.get()
+        kind, payload = self._out.get()
+        self._slots.release()
+        if kind == "error":
+            raise payload
+        return payload
+
+    def take_if_match(self, feed):
+        """The head staged batch if it was built from exactly `feed`'s
+        value objects; None otherwise (the staged queue is untouched)."""
+        try:
+            key = self._keys.queue[0]  # deque peek; GIL-atomic
+        except IndexError:
+            return None
+        if len(key) != len(feed) or any(
+                key.get(k) is not v for k, v in feed.items()):
+            return None
+        return self.get()
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        if self._thread is not None:
+            self._in.put(self._CLOSE)
+            self._thread.join(timeout=5.0)
+
+
+def prefetch_iter(batches, prefetcher):
+    """Drive `batches` (an iterable of host feed dicts) through a
+    FeedPrefetcher with one-batch lookahead: while the consumer runs the
+    step for batch k, the worker stages batch k+1's H2D transfer. Yields
+    staged feeds in source order."""
+    in_flight = 0
+    for feed in batches:
+        prefetcher.put(feed)
+        in_flight += 1
+        if in_flight >= 2:
+            yield prefetcher.get()
+            in_flight -= 1
+    while in_flight:
+        yield prefetcher.get()
+        in_flight -= 1
+
+
+# ---------------------------------------------------------------------------
+# persistent compilation cache
+# ---------------------------------------------------------------------------
+
+_PERSISTENT = {"dir": None}
+
+
+def setup_persistent_cache(cache_dir=None):
+    """Point jax's on-disk compilation cache at `cache_dir` (default:
+    $PTPU_CACHE_DIR). Idempotent, first configured dir wins; returns the
+    active dir or None when unconfigured. With this set, a fresh process
+    re-running the same program skips XLA recompiles entirely — the
+    executable is deserialized from disk."""
+    if _PERSISTENT["dir"]:
+        return _PERSISTENT["dir"]
+    cache_dir = cache_dir or os.environ.get("PTPU_CACHE_DIR")
+    if not cache_dir:
+        return None
+    import jax
+
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # cache everything: the default thresholds skip small/fast compiles,
+    # which is exactly the CPU-test regime the process-sim tests run in
+    for opt, val in (("jax_persistent_cache_min_entry_size_bytes", -1),
+                     ("jax_persistent_cache_min_compile_time_secs", 0.0)):
+        try:
+            jax.config.update(opt, val)
+        except Exception:
+            pass  # knob absent on this jax version
+    try:
+        # jax initializes its cache singleton lazily on the FIRST compile;
+        # if anything compiled before this call (with no dir configured)
+        # the disabled state is latched for the process — reset so the
+        # new dir takes effect
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _cc)
+
+        _cc.reset_cache()
+    except Exception:
+        pass
+    _PERSISTENT["dir"] = cache_dir
+    return cache_dir
+
+
+def persistent_cache_dir():
+    return _PERSISTENT["dir"]
+
+
+def note_compiled_program(*fingerprint_parts):
+    """Record a program-level compile in the persistent manifest under the
+    framework's OWN cache key (program fingerprint + feed signature +
+    fetch names + jax/jaxlib versions). Returns 'hit' when an earlier
+    process (or executor) already compiled this exact key against the
+    active cache dir — i.e. the jit compile below it is expected to be
+    served from disk — else records it and returns 'miss'. None when no
+    persistent cache is configured."""
+    d = _PERSISTENT["dir"]
+    if not d:
+        return None
+    import jax
+    import jaxlib.version
+
+    key = hashlib.sha256(repr(
+        (jax.__version__, jaxlib.version.__version__, jax.default_backend(),
+         fingerprint_parts)).encode()).hexdigest()
+    mdir = os.path.join(d, "ptpu_manifest")
+    path = os.path.join(mdir, key)
+    if os.path.exists(path):
+        _metrics.counter("compile_cache/persistent_hit").inc()
+        return "hit"
+    try:
+        os.makedirs(mdir, exist_ok=True)
+        with open(path, "w") as f:
+            f.write("")
+    except OSError:
+        return None  # read-only cache dir: stay quiet, jax still reads
+    _metrics.counter("compile_cache/persistent_miss").inc()
+    return "miss"

@@ -147,7 +147,12 @@ def normalize_feed_value(block, name, arr):
     """Feed normalization shared by the data-parallel and pipeline steps:
     device-resident jax.Arrays pass through without a host round-trip
     (PyReader double-buffer / user device_put); host values become numpy
-    cast to the var's declared dtype."""
+    cast to the var's declared dtype. int64 ids above int32 range fail
+    loudly BEFORE the branch (executor.check_feed_int64) — silently
+    truncated feature hashes are the alternative."""
+    from .executor import check_feed_int64
+
+    check_feed_int64(name, arr)
     v = block._find_var_recursive(name)
     if not isinstance(arr, jax.Array):
         arr = np.asarray(arr)
@@ -257,14 +262,17 @@ class CompiledProgram:
                 axis_names=("dp",) + tuple(n for n, _ in extra))
         return self._mesh
 
-    def _run(self, executor, feed, fetch_list, scope, return_numpy):
+    def _run(self, executor, feed, fetch_list, scope, return_numpy,
+             fetch_every_n=None):
+        from .async_engine import LazyFetchList
         from .core.scope import global_scope
         from .executor import _CompiledStep, _feed_signature
 
         if not self._is_data_parallel:
             return executor.run(self._program, feed=feed,
                                 fetch_list=fetch_list, scope=scope,
-                                return_numpy=return_numpy)
+                                return_numpy=return_numpy,
+                                fetch_every_n=fetch_every_n)
         feed = dict(feed or {})
         scope = scope if scope is not None else global_scope()
         fetch_names = [
@@ -275,12 +283,26 @@ class CompiledProgram:
 
         key = (self._program.version, _feed_signature(feed),
                tuple(fetch_names), bool(flag("check_nan_inf")))
+        # staged substitution only after the key: device_put canonicalizes
+        # some dtypes, and a signature drift would recompile spuriously
+        if executor._prefetcher is not None:
+            staged = executor._prefetcher.take_if_match(feed)
+            if staged is not None:
+                feed = staged
         rec = _metrics.enabled()
         with _observability.step_scope():
             step = self._compiled_steps.get(key)
             if step is None:
                 if rec:
                     _metrics.counter("compile_cache/miss").inc()
+                from .async_engine import (note_compiled_program,
+                                           persistent_cache_dir)
+
+                if persistent_cache_dir():
+                    note_compiled_program(
+                        self._program.fingerprint(), key[1],
+                        tuple(fetch_names), key[3],
+                        tuple(self._get_mesh().shape.items()))
                 pp = int(getattr(self._build_strategy,
                                  "pipeline_stages", 1) or 1)
                 with _tracing.span("lower"):
@@ -299,6 +321,16 @@ class CompiledProgram:
                 self._compiled_steps[key] = step
             elif rec:
                 _metrics.counter("compile_cache/hit").inc()
+            if not any(step is s for s in executor._warn_sources):
+                # registered per EXECUTOR: a CompiledProgram's cached step
+                # driven by a second executor must be drainable by that
+                # executor's sync()/close() too
+                executor._warn_sources.append(step)
+            sharding_fn = getattr(step, "feed_sharding", None)
+            if sharding_fn is not None:
+                # the prefetcher stages straight into the step's target
+                # sharding from now on (no device-side reshard)
+                executor._feed_sharding_fn = sharding_fn
             with _tracing.span("execute"):
                 fetches = step.run(scope, feed)
         if rec:
@@ -307,9 +339,13 @@ class CompiledProgram:
             _metrics.counter("executor/feed_bytes").inc(
                 _nbytes(feed.values()))
             _metrics.counter("executor/fetch_bytes").inc(_nbytes(fetches))
-        if return_numpy:
-            return [np.asarray(f) for f in fetches]
-        return fetches
+        out = executor._finish_run(fetches, return_numpy, fetch_every_n)
+        warns = getattr(step, "_deferred_warns", None)
+        if warns is not None and not isinstance(out, LazyFetchList):
+            # a materializing run is already a sync point: flush pending
+            # runtime warnings so the per-step-sync loop warns promptly
+            warns.drain(step._warned)
+        return out
 
 
 class _DataParallelStep:
@@ -337,6 +373,7 @@ class _DataParallelStep:
         batch = NamedSharding(mesh, P("dp"))
         self._repl = repl
         self._batch = batch
+        self._dp = int(dict(mesh.shape).get("dp", 1))
         # long-context feeds [B, T, ...] shard their seq dim over sp too
         self._sp = int(dict(mesh.shape).get("sp", 1))
         self._batch_seq = (NamedSharding(mesh, P("dp", "sp"))
@@ -373,6 +410,9 @@ class _DataParallelStep:
         self._nan_labels = []
         self._warn_labels = []
         self._warned = set()
+        from .async_engine import DeferredWarns
+
+        self._deferred_warns = DeferredWarns()
 
         def step(mut_state, const_state, feeds, step_counter):
             base_key = jax.random.fold_in(
@@ -419,33 +459,30 @@ class _DataParallelStep:
             in_shardings=(mut_sh, const_sh, None, None),
         )
 
+    def feed_sharding(self, name, arr):
+        """Target sharding for one feed value: batch-sharded over dp when
+        the leading dim divides (replicated fallback otherwise), seq dim
+        over sp for long-context feeds. One decision point for run() AND
+        the background FeedPrefetcher, so prefetched batches land on
+        device already in the layout the step consumes."""
+        if not np.ndim(arr) or np.shape(arr)[0] % self._dp:
+            return self._repl
+        if (self._sp > 1 and np.ndim(arr) >= 2
+                and np.shape(arr)[1] % self._sp == 0):
+            return self._batch_seq
+        return self._batch
+
     def run(self, scope, feed):
         mut, const = read_persistable_state(scope, self.mut_names,
                                             self.const_names)
-        dp = int(dict(self.mesh.shape).get("dp", 1))
         feeds = {}
         for name in self.feed_names:
             arr = normalize_feed_value(self.block, name, feed[name])
             if not self._multiprocess:
-                if not arr.ndim or arr.shape[0] % dp:
-                    sh = self._repl
-                elif (self._sp > 1 and arr.ndim >= 2
-                        and arr.shape[1] % self._sp == 0):
-                    sh = self._batch_seq
-                else:
-                    sh = self._batch
-                arr = jax.device_put(arr, sh)
+                arr = jax.device_put(arr, self.feed_sharding(name, arr))
             feeds[name] = arr
         if self._multiprocess:
-            def _feed_sharding(arr):
-                if not np.ndim(arr) or arr.shape[0] % dp:
-                    return self._repl
-                if (self._sp > 1 and np.ndim(arr) >= 2
-                        and arr.shape[1] % self._sp == 0):
-                    return self._batch_seq
-                return self._batch
-
-            feeds = {name: lift_to_global(arr, _feed_sharding(arr))
+            feeds = {name: lift_to_global(arr, self.feed_sharding(name, arr))
                      for name, arr in feeds.items()}
             for store in (mut, const):
                 for name, val in store.items():
@@ -462,14 +499,9 @@ class _DataParallelStep:
         ctr = np.uint32(scope.get("__step_counter__", 0) or 0)
         fetches, new_state, finite, warns = self._jitted(mut, const,
                                                          feeds, ctr)
-        if self._warn_labels and warns.size:
-            import warnings
-
-            for label, flagged in zip(self._warn_labels,
-                                      np.asarray(warns)):
-                if flagged and label not in self._warned:
-                    self._warned.add(label)
-                    warnings.warn(label, RuntimeWarning)
+        # deferred: flags accumulate host-side and materialize every few
+        # steps — the all-false common case costs no per-step sync
+        self._deferred_warns.add(self._warn_labels, warns, self._warned)
         if self._check_nan_inf and finite.size:
             # state was NOT donated under the debug flag: raising here leaves
             # the scope at its pre-step values, so the poisoned update is

@@ -11,6 +11,20 @@ shard_map: `jax.shard_map` (top-level since jax 0.6) vs
   axis_names={...}   -> auto=mesh axes - axis_names  (partial-manual:
                         the new API names the MANUAL axes, the old one
                         names the AUTO remainder)
+
+axis_index: on jaxlib < 0.5, `jax.lax.axis_index` inside a PARTIAL-auto
+shard_map region lowers to a PartitionId HLO instruction old XLA rejects
+under SPMD partitioning (XlaRuntimeError UNIMPLEMENTED — ROADMAP
+jax-version drift). There is no in-region workaround on that XLA:
+collective-based rank derivations (psum_scatter, asymmetric ppermute) and
+even the region's ordinary ppermutes CHECK-abort the whole process in the
+old SPMD partitioner once PartitionId is out of the way (measured on
+jaxlib 0.4.36: `Check failed: sharding.IsManualSubgroup()`), which is
+strictly worse than the UNIMPLEMENTED raise. So the shim keeps the native
+primitive — one routing point for when a lowering-level fix exists — and
+exports AXIS_INDEX_SAFE_UNDER_PARTIAL_AUTO for the version-gated xfails
+on the affected sp/pp-combo tests (the raise is the loud, catchable
+failure mode; the tests document it instead of polluting tier-1).
 """
 
 try:  # jax >= 0.6
@@ -22,7 +36,17 @@ except ImportError:
 
     _NEW_API = False
 
-__all__ = ["shard_map", "optimization_barrier"]
+import jaxlib.version
+
+_JAXLIB_VERSION = tuple(
+    int(p) for p in jaxlib.version.__version__.split(".")[:2])
+
+# PartitionId under partial-auto SPMD partitioning is supported by the
+# XLA bundled with jaxlib >= 0.5
+AXIS_INDEX_SAFE_UNDER_PARTIAL_AUTO = _JAXLIB_VERSION >= (0, 5)
+
+__all__ = ["shard_map", "optimization_barrier", "axis_index",
+           "AXIS_INDEX_SAFE_UNDER_PARTIAL_AUTO"]
 
 
 def _make_optimization_barrier():
@@ -43,6 +67,15 @@ def _make_optimization_barrier():
 
 
 optimization_barrier = _make_optimization_barrier()
+
+
+def axis_index(axis_name):
+    """Routing point for jax.lax.axis_index (see module docstring): all
+    in-tree shard_map bodies call this instead of the primitive, so a
+    future jaxlib-specific lowering fix lands in exactly one place."""
+    import jax
+
+    return jax.lax.axis_index(axis_name)
 
 
 def shard_map(f, mesh=None, in_specs=None, out_specs=None, **kwargs):

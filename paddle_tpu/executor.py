@@ -9,19 +9,32 @@ in-place buffer aliases in HBM and the host loop does nothing but feed and
 fetch. Compiled executables are cached on (program fingerprint, feed
 signature, fetch names) — the analogue of Fluid's `_get_strong_program_cache_key`
 (executor.py:250), but a cache hit here skips XLA retracing entirely.
+
+The hot loop is asynchronous end-to-end (docs/ASYNC_EXECUTION.md):
+`return_numpy=False` (or a non-boundary `fetch_every_n` step) returns the
+fetches as unmaterialized device futures, a bounded in-flight window
+(`async_steps`, default $PTPU_ASYNC_STEPS or 12) backpressures dispatch,
+feed batches can be staged host->device in the background
+(`Executor.prefetch` / `train_from_dataset`'s built-in lookahead), and
+$PTPU_CACHE_DIR persists compiled executables across processes.
 """
 
+import os
 import time
 
 import numpy as np
 
 import jax
-import jax.numpy as jnp
 
 from . import framework
 from . import observability as _observability
 from .observability import metrics as _metrics
 from .observability import tracing as _tracing
+from .async_engine import (DeferredWarns, FeedPrefetcher, InflightWindow,
+                           LazyFetchList, note_compiled_program,
+                           prefetch_iter, setup_persistent_cache)
+from .async_engine import _nbytes  # shared feed/fetch byte accounting
+from .async_engine import as_numpy  # noqa: F401  (re-export: sync point)
 from .core.lowering import (LoweringContext, execute_block,
                             pack_nan_reports, pack_warn_reports,
                             raise_if_nonfinite)
@@ -29,7 +42,7 @@ from .core.place import CPUPlace, TPUPlace, default_place
 from .core.scope import Scope, global_scope, scope_guard  # noqa: F401
 from .framework import Program, dtype_to_np
 
-__all__ = ["Executor", "global_scope", "scope_guard"]
+__all__ = ["Executor", "global_scope", "scope_guard", "as_numpy"]
 
 
 def _feed_signature(feed):
@@ -44,19 +57,39 @@ def _feed_signature(feed):
     )
 
 
-def as_numpy(x):
-    return np.asarray(x)
+_INT64_DTYPES = (np.dtype(np.int64), np.dtype(np.uint64))
 
 
-def _nbytes(vals):
-    """Total buffer bytes across feed/fetch values without touching device
-    memory (jax.Array.nbytes is shape metadata, not a transfer)."""
-    total = 0
-    for v in vals:
-        nb = getattr(v, "nbytes", None)
-        if nb is not None:
-            total += int(nb)
-    return total
+def check_feed_int64(name, value):
+    """JAX canonicalizes int64 device inputs to int32; an id above 2^31
+    would truncate SILENTLY. Fail loudly instead — raw feature hashes
+    belong on the host side (DataFeedDesc slot hash_mod /
+    HostEmbeddingTable(hash_ids=True)).
+
+    Checked on the ORIGINAL feed value, BEFORE the host/device branch:
+    a device-resident jax.Array keeps an int64 dtype only under
+    jax_enable_x64, and exactly then this guard still sees it (with x64
+    off the truncation already happened inside the user's device_put,
+    which no run()-time check can undo). Only int64/uint64 feeds pay the
+    range reduction; every other dtype is one dtype compare."""
+    dt = getattr(value, "dtype", None)
+    if dt is None or np.dtype(dt) not in _INT64_DTYPES:
+        return
+    if not getattr(value, "size", 0):
+        return
+    # host-side reduction even for device arrays: a jnp.max on an int64
+    # operand under x64-off canonicalizes the REDUCTION to int32 and
+    # reports the truncated value — the very bug being guarded against.
+    # The transfer only taxes the rare (and discouraged) int64 feed path.
+    arr = np.asarray(value)
+    mx, mn = int(arr.max()), int(arr.min())
+    if mx > np.iinfo(np.int32).max or mn < np.iinfo(np.int32).min:
+        raise ValueError(
+            "feed %r holds int64 ids above int32 range; JAX would "
+            "silently truncate them on device. Hash them on the "
+            "host first (DataFeedDesc.set_hash_mod, or "
+            "HostEmbeddingTable(hash_ids=True) for direct "
+            "pull/push)" % name)
 
 
 # byte-scale buckets for module-size histograms (1KiB .. 1GiB)
@@ -127,6 +160,7 @@ class _CompiledStep:
         self._nan_labels = []
         self._warn_labels = []
         self._warned = set()
+        self._deferred_warns = DeferredWarns()
 
         def step(mut_state, const_state, feeds, step_counter):
             base_key = jax.random.fold_in(
@@ -181,6 +215,10 @@ class _CompiledStep:
         for name in self.feed_names:
             v = block._find_var_recursive(name)
             arr = feed[name]
+            # range-check the ORIGINAL value: after the device branch a
+            # jax.Array has already been canonicalized, after the astype
+            # a numpy int64 has already been narrowed
+            check_feed_int64(name, arr)
             # device-resident arrays (PyReader double-buffer, user
             # device_put) pass through untouched — np.asarray here would
             # round-trip them over the host link every step
@@ -190,20 +228,6 @@ class _CompiledStep:
                 want = dtype_to_np(v.dtype)
                 if arr.dtype != want:
                     arr = arr.astype(want)
-            # JAX canonicalizes int64 device inputs to int32; an id above
-            # 2^31 would truncate SILENTLY. Fail loudly instead — raw
-            # feature hashes belong on the host side (DataFeedDesc slot
-            # hash_mod / HostEmbeddingTable(hash_ids=True)).
-            if (isinstance(arr, np.ndarray) and arr.size
-                    and arr.dtype in (np.int64, np.uint64)
-                    and (arr.max() > np.iinfo(np.int32).max
-                         or arr.min() < np.iinfo(np.int32).min)):
-                raise ValueError(
-                    "feed %r holds int64 ids above int32 range; JAX would "
-                    "silently truncate them on device. Hash them on the "
-                    "host first (DataFeedDesc.set_hash_mod, or "
-                    "HostEmbeddingTable(hash_ids=True) for direct "
-                    "pull/push)" % name)
             feeds[name] = arr
         step_counter = np.uint32(scope.get("__step_counter__", 0) or 0)
         fn = self._aot
@@ -221,14 +245,10 @@ class _CompiledStep:
         with _tracing.span("execute"):
             fetches, new_state, finite, warns = fn(
                 mut, const, feeds, step_counter)
-        if self._warn_labels and warns.size:
-            import warnings
-
-            for label, flagged in zip(self._warn_labels,
-                                      np.asarray(warns)):
-                if flagged and label not in self._warned:
-                    self._warned.add(label)
-                    warnings.warn(label, RuntimeWarning)
+        # deferred: the all-false common case must not sync the device
+        # every step — flags accumulate and materialize every few steps
+        # (and at Executor.sync/close)
+        self._deferred_warns.add(self._warn_labels, warns, self._warned)
         if self._check_nan_inf and finite.size:
             # state was NOT donated under the debug flag: raising here leaves
             # the scope at its pre-step values, so the poisoned update is
@@ -302,15 +322,90 @@ class _CompiledStep:
 
 
 class Executor:
-    """Drop-in parity with fluid.Executor (executor.py:292)."""
+    """Drop-in parity with fluid.Executor (executor.py:292).
 
-    def __init__(self, place=None):
+    `async_steps` bounds how many dispatched-but-unsynced steps the
+    async return paths (`return_numpy=False`, `fetch_every_n`) keep in
+    flight before backpressuring on the oldest (default: $PTPU_ASYNC_STEPS
+    or 12 — the measured axon-tunnel sweet spot, deep enough to amortize
+    the drain RTT, shallow enough to stay clear of the
+    many-outstanding-steps wedge)."""
+
+    def __init__(self, place=None, async_steps=None):
         self.place = place if place is not None else default_place()
         self._cache = {}
+        if async_steps is None:
+            try:
+                async_steps = int(os.environ.get("PTPU_ASYNC_STEPS") or 12)
+            except ValueError:
+                async_steps = 12
+        self._window = InflightWindow(async_steps)
+        self._fetch_tick = 0
+        self._prefetcher = None
+        self._feed_sharding_fn = None
+        # compiled steps owned by CompiledPrograms run through this
+        # executor — sync() must reach their deferred warnings too
+        self._warn_sources = []
+        setup_persistent_cache()
+
+    # -- async pipeline ----------------------------------------------------
+    def sync(self):
+        """Explicit sync point: block until every in-flight step has
+        materialized and flush deferred runtime warnings."""
+        self._window.drain()
+        for compiled in list(self._cache.values()) + self._warn_sources:
+            warns = getattr(compiled, "_deferred_warns", None)
+            if warns is not None:
+                warns.drain(compiled._warned)
+
+    def _feed_sharding(self, name, value):
+        """Target placement for a prefetched feed value: the compiled
+        sharded step's decision once one exists (compiler.py
+        feed_sharding), this executor's device until then."""
+        fn = self._feed_sharding_fn
+        if fn is not None:
+            return fn(name, value)
+        return self.place.jax_device()
+
+    def prefetch(self, feed):
+        """Stage `feed`'s host values to device on a background thread,
+        overlapping the H2D transfer with the device's current step. A
+        subsequent `run(feed=feed)` with the SAME value objects picks up
+        the staged copies transparently; staged batches are consumed in
+        prefetch order."""
+        if self._prefetcher is None:
+            self._prefetcher = FeedPrefetcher(
+                sharding_fn=self._feed_sharding)
+        self._prefetcher.put(feed)
+
+    def _finish_run(self, fetches, return_numpy, fetch_every_n):
+        """Shared async/sync return path (Executor.run and
+        CompiledProgram._run): materialize at the sync points, otherwise
+        admit the step to the in-flight window and hand back lazy fetch
+        handles."""
+        n = int(fetch_every_n or 0)
+        if n > 1:
+            self._fetch_tick += 1
+            if self._fetch_tick % n:
+                self._window.admit(fetches)
+                return LazyFetchList(fetches)
+        if return_numpy:
+            out = [np.asarray(f) for f in fetches]
+            # the newest step is now host-complete; device execution is
+            # in-order, so every older in-flight step is too
+            self._window.reset()
+            return out
+        self._window.admit(fetches)
+        return LazyFetchList(fetches)
 
     def close(self):
         """Notify pservers this trainer is done (executor.py:453 parity —
-        the server exits once every trainer completed), then drop caches."""
+        the server exits once every trainer completed), then drop caches,
+        flushing deferred warnings and the in-flight window."""
+        self.sync()
+        if self._prefetcher is not None:
+            self._prefetcher.close()
+            self._prefetcher = None
         for compiled in self._cache.values():
             client = getattr(compiled, "_rpc_client", None)
             if client is not None:
@@ -329,13 +424,20 @@ class Executor:
         scope=None,
         return_numpy=True,
         use_program_cache=True,
+        fetch_every_n=None,
     ):
+        """`fetch_every_n=N` keeps the loop asynchronous between sync
+        points: only every Nth call materializes fetches (per
+        `return_numpy`); the steps in between return LazyFetchList
+        handles without touching the host link, bounded by the
+        executor's in-flight window."""
         from .compiler import CompiledProgram
 
         if program is None:
             program = framework.default_main_program()
         if isinstance(program, CompiledProgram):
-            return program._run(self, feed, fetch_list, scope, return_numpy)
+            return program._run(self, feed, fetch_list, scope, return_numpy,
+                                fetch_every_n)
         feed = dict(feed or {})
         fetch_list = list(fetch_list or [])
         scope = scope if scope is not None else global_scope()
@@ -363,17 +465,37 @@ class Executor:
             tuple(fetch_names),
             bool(flag("check_nan_inf")),
         )
+        # substitute staged device copies only AFTER the cache key is
+        # computed from the ORIGINAL feed: device_put canonicalizes some
+        # dtypes, and a signature drift here would force a spurious
+        # recompile of the identical program
+        if self._prefetcher is not None:
+            staged = self._prefetcher.take_if_match(feed)
+            if staged is not None:
+                feed = staged
         rec = _metrics.enabled()
         with _observability.step_scope():
             compiled = self._cache.get(key) if use_program_cache else None
             if compiled is None:
                 if rec:
                     _metrics.counter("compile_cache/miss").inc()
+                # thread OUR fingerprint through the on-disk cache: the
+                # manifest attributes the jit compile below to this
+                # program+signature across process restarts
+                from .async_engine import persistent_cache_dir
+
+                if persistent_cache_dir():
+                    note_compiled_program(program.fingerprint(), key[2],
+                                          tuple(fetch_names), key[4])
                 with _tracing.span("lower"):
                     compiled = _CompiledStep(program, feed.keys(),
                                              fetch_names, scope)
                 if use_program_cache:
                     self._cache[key] = compiled
+                else:
+                    # sync()/close() can never reach an uncached step, so
+                    # its warnings must not defer past this run
+                    compiled._deferred_warns.drain_every = 1
             elif rec:
                 _metrics.counter("compile_cache/hit").inc()
 
@@ -383,9 +505,12 @@ class Executor:
             _metrics.counter("executor/feed_bytes").inc(
                 _nbytes(feed.values()))
             _metrics.counter("executor/fetch_bytes").inc(_nbytes(fetches))
-        if return_numpy:
-            return [np.asarray(f) for f in fetches]
-        return fetches
+        out = self._finish_run(fetches, return_numpy, fetch_every_n)
+        if not isinstance(out, LazyFetchList):
+            # a materializing run is already a sync point: flush pending
+            # runtime warnings so the per-step-sync loop warns promptly
+            compiled._deferred_warns.drain(compiled._warned)
+        return out
 
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
@@ -409,15 +534,22 @@ class Executor:
         batches = (dataset._batches_prefetched()
                    if getattr(dataset, "_thread", 1) > 1
                    else dataset._batches())
-        for feed in batches:
-            last = self.run(program, feed=feed, fetch_list=fetch_list,
-                            scope=scope)
-            step += 1
-            if debug and fetch_names and step % print_period == 0:
-                info = fetch_info or fetch_names
-                print("step %d: %s" % (step, {
-                    k: np.asarray(v).ravel()[:4]
-                    for k, v in zip(info, last)}))
+        # H2D lookahead: while the device runs batch k, a background
+        # thread device_puts batch k+1 (same contract as PyReader's
+        # double buffer, here for the Dataset path)
+        device_feeder = FeedPrefetcher(sharding_fn=self._feed_sharding)
+        try:
+            for feed in prefetch_iter(batches, device_feeder):
+                last = self.run(program, feed=feed, fetch_list=fetch_list,
+                                scope=scope)
+                step += 1
+                if debug and fetch_names and step % print_period == 0:
+                    info = fetch_info or fetch_names
+                    print("step %d: %s" % (step, {
+                        k: np.asarray(v).ravel()[:4]
+                        for k, v in zip(info, last)}))
+        finally:
+            device_feeder.close()
         return last
 
     infer_from_dataset = train_from_dataset

@@ -47,7 +47,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from ..core.jax_compat import shard_map
+from ..core.jax_compat import axis_index as _axis_index, shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.lowering import LoweringContext, execute_op
@@ -843,8 +843,8 @@ class PipelineProgramStep:
         vector)."""
         dp, pp, M, v = self.dp, self.pp, self.M, self.v
         sched = self.schedule
-        my_pp = jax.lax.axis_index("pp")
-        my_dp = jax.lax.axis_index("dp")
+        my_pp = _axis_index("pp")
+        my_dp = _axis_index("dp")
 
         micro = {}
         for name, arr in batched.items():

@@ -19,6 +19,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..core.jax_compat import axis_index as _axis_index
+
 _NEG_INF = -1e30
 
 
@@ -48,7 +50,7 @@ def ring_attention(q, k, v, axis_name, causal=True, sm_scale=None):
     length-(n*T_local) sequence laid out contiguously by rank order.
     """
     n = jax.lax.psum(1, axis_name)
-    rank = jax.lax.axis_index(axis_name)
+    rank = _axis_index(axis_name)
     B, H, Tl, D = q.shape
     scale = sm_scale if sm_scale is not None else D ** -0.5
     q_off = rank * Tl

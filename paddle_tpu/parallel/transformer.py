@@ -39,7 +39,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from ..core.jax_compat import shard_map
+from ..core.jax_compat import axis_index as _axis_index, shard_map
 
 from ..models import transformer as T
 
@@ -234,7 +234,7 @@ class SPMDTrainer:
         def local_loss(params, tokens, labels):
             """Rank-local loss for pp == 1 (no pipeline): embed -> stage ->
             head on the sequence shard; Σ over all ranks == global mean CE."""
-            my_tp = jax.lax.axis_index("tp")
+            my_tp = _axis_index("tp")
             B_local, T_full = tokens.shape
             t_shard = T_full // tp
             moe_p = params.get("moe")
@@ -277,8 +277,8 @@ class SPMDTrainer:
 
             Returns (rank-local loss contribution, fp32 grads congruent
             with params)."""
-            my_pp = jax.lax.axis_index("pp")
-            my_tp = jax.lax.axis_index("tp")
+            my_pp = _axis_index("pp")
+            my_tp = _axis_index("tp")
             B_local, T_full = tokens.shape
             t_shard = T_full // tp
             mb = B_local // M

@@ -244,6 +244,9 @@ class PyReader:
         # buddy-allocator staging pool (native/allocator.cc, C19): batches
         # are copied into arena-backed buffers before the async device_put
         self._arena = None
+        # optional (name, value) -> jax sharding for the staged transfer
+        # (set_feed_sharding; e.g. a _DataParallelStep.feed_sharding)
+        self._sharding_fn = None
 
     def decorate_sample_list_generator(self, generator, places=None):
         from ..data_feeder import DataFeeder
@@ -253,6 +256,13 @@ class PyReader:
         self._places = places
 
     decorate_paddle_reader = decorate_sample_list_generator
+
+    def set_feed_sharding(self, sharding_fn):
+        """Attach a (name, value) -> sharding decision so the double
+        buffer's device_put lands batches in the compiled step's target
+        layout (e.g. pass a CompiledProgram step's `feed_sharding`, or
+        `executor._feed_sharding`)."""
+        self._sharding_fn = sharding_fn
 
     def decorate_sample_generator(self, sample_generator, batch_size,
                                   drop_last=True, places=None):
@@ -321,14 +331,14 @@ class PyReader:
                     _time.perf_counter() - t_wait)
                 _obs_metrics.gauge("reader/queue_depth").set(q.qsize())
                 _obs_metrics.counter("reader/batches").inc()
-            staged = self._stage(item)
+            staged = self._stage(item, depth=1 if pending is not None else 0)
             if pending is not None:
                 yield pending
             pending = staged
         if pending is not None:
             yield pending
 
-    def _stage(self, item):
+    def _stage(self, item, depth=0):
         if not self._use_double_buffer:
             return item
         try:
@@ -343,14 +353,31 @@ class PyReader:
                 # slots per feed name), then async H2D from them — the
                 # reference's pinned staging in buffered_reader.cc. The
                 # arena blocks on a slot's in-flight transfer before
-                # reusing its memory (note_transfer bookkeeping).
+                # reusing its memory (note_transfer bookkeeping). With a
+                # sharding fn attached (set_feed_sharding), each value
+                # lands directly in the compiled step's target layout.
+                sharding_fn = self._sharding_fn
+
                 def _one(k, v):
+                    from ..executor import check_feed_int64
+
+                    check_feed_int64(k, v)
                     staged = self._arena.stage(k, v)
-                    dev = jax.device_put(staged)
+                    sh = (sharding_fn(k, staged)
+                          if sharding_fn is not None else None)
+                    dev = (jax.device_put(staged, sh) if sh is not None
+                           else jax.device_put(staged))
                     self._arena.note_transfer(staged, dev)
                     return dev
 
-                return {k: _one(k, v) for k, v in item.items()}
+                out = {k: _one(k, v) for k, v in item.items()}
+                if _obs_metrics.enabled():
+                    from ..async_engine import _nbytes
+
+                    _obs_metrics.counter("feed/h2d_bytes").inc(
+                        _nbytes(out.values()))
+                    _obs_metrics.gauge("feed/prefetch_depth").set(depth)
+                return out
         except Exception:
             pass
         return item

@@ -8,7 +8,10 @@
 #                backend — real TPU when attached)
 #   stress     - 5x back-to-back run of the rendezvous-heaviest file
 #   obs        - observability smoke: metrics dump + stats CLI render
-# Usage: scripts/ci.sh [build|test|api_check|bench|stress|obs|all]
+#   bench-smoke- tiny-model bench.py --metrics-out run asserting the async
+#                pipeline telemetry (in-flight window, prefetch H2D) lands
+#                in the dump
+# Usage: scripts/ci.sh [build|test|api_check|bench|bench-smoke|stress|obs|all]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -129,13 +132,29 @@ do_bench() {
   python bench.py
 }
 
+do_bench_smoke() {
+  # async-pipeline receipt (docs/ASYNC_EXECUTION.md): a tiny-model bench
+  # run with executor telemetry on must record >1 step in flight, H2D
+  # bytes through the background prefetcher, and both steady-state step
+  # times in the metrics dump the stats CLI gates on
+  local dump=/tmp/ptpu_bench_smoke.json
+  rm -f "$dump"
+  JAX_PLATFORMS=cpu PTPU_METRICS=1 \
+    python bench.py --tiny --metrics-out "$dump"
+  python tools/ptpu_stats.py "$dump" \
+    --assert-has feed/h2d_bytes bench/step_time_async \
+                 bench/step_time_sync executor/step_time \
+    --assert-min exec/inflight_steps=2
+}
+
 case "$stage" in
   build) do_build ;;
   test) do_build; do_test ;;
   api_check) do_api_check ;;
   bench) do_bench ;;
+  bench-smoke) do_bench_smoke ;;
   stress) do_stress ;;
   obs) do_obs_smoke ;;
-  all) do_build; do_test; do_api_check; do_bench ;;
+  all) do_build; do_test; do_api_check; do_bench_smoke; do_bench ;;
   *) echo "unknown stage: $stage" >&2; exit 2 ;;
 esac

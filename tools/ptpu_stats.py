@@ -13,6 +13,9 @@ Usage:
   python tools/ptpu_stats.py dump.json [more.json ...]
   python tools/ptpu_stats.py --prometheus dump.json   # re-expose as text
   python tools/ptpu_stats.py --selftest               # CI smoke hook
+  python tools/ptpu_stats.py dump.json \
+      --assert-has exec/inflight_steps \
+      --assert-min exec/inflight_steps=2   # CI gating on metric presence
 """
 
 import argparse
@@ -140,6 +143,40 @@ def _selftest():
     return 0
 
 
+def _lookup(doc, name):
+    """(found, numeric value-or-None) for a metric of any kind."""
+    for kind in ("counters", "gauges"):
+        if name in doc.get(kind, {}):
+            return True, float(doc[kind][name])
+    for kind in ("histograms", "stats"):
+        if name in doc.get(kind, {}):
+            return True, float(doc[kind][name].get("count", 0))
+    return False, None
+
+
+def check_assertions(doc, has, mins):
+    """CI gating: every `has` name must exist in the dump; every
+    `mins` "name=value" must exist with numeric value >= the bound
+    (histograms compare their observation count). Returns a list of
+    failure messages."""
+    failures = []
+    for name in has or ():
+        if not _lookup(doc, name)[0]:
+            failures.append("missing metric: %s" % name)
+    for spec in mins or ():
+        name, _, bound = spec.partition("=")
+        if not bound:
+            failures.append("--assert-min wants NAME=VALUE, got %r" % spec)
+            continue
+        found, val = _lookup(doc, name)
+        if not found:
+            failures.append("missing metric: %s" % name)
+        elif val < float(bound):
+            failures.append("metric %s = %s, want >= %s"
+                            % (name, val, bound))
+    return failures
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("files", nargs="*", help="metrics JSON dump(s)")
@@ -147,11 +184,19 @@ def main(argv=None):
                     help="emit Prometheus text instead of tables")
     ap.add_argument("--selftest", action="store_true",
                     help="run the in-process round-trip smoke and exit")
+    ap.add_argument("--assert-has", nargs="+", default=None,
+                    metavar="NAME",
+                    help="fail unless every named metric is in the dump")
+    ap.add_argument("--assert-min", nargs="+", default=None,
+                    metavar="NAME=VALUE",
+                    help="fail unless metric >= value (histograms "
+                         "compare their observation count)")
     args = ap.parse_args(argv)
     if args.selftest:
         return _selftest()
     if not args.files:
         ap.error("no metrics files given (or use --selftest)")
+    rc = 0
     for i, path in enumerate(args.files):
         with open(path) as f:
             doc = json.load(f)
@@ -161,7 +206,12 @@ def main(argv=None):
             sys.stdout.write(_to_prometheus(doc))
         else:
             render(doc)
-    return 0
+        failures = check_assertions(doc, args.assert_has, args.assert_min)
+        for msg in failures:
+            sys.stderr.write("%s: %s\n" % (path, msg))
+        if failures:
+            rc = 1
+    return rc
 
 
 if __name__ == "__main__":
