@@ -108,7 +108,7 @@ def bench_transformer(steps=24, warmup=3, batch=192, seq=512, remat=None):
 
 def bench_transformer_fluid(steps=24, warmup=3, batch=160, seq=512,
                             async_exec=True, feed_mode="device",
-                            model_kwargs=None):
+                            model_kwargs=None, program_opt=True):
     """The SAME flagship trained through the Fluid-equivalent Python API
     (fluid.layers program -> descriptor lowering -> one donated jitted
     step). This is the HEADLINE path (BASELINE.json north star: "via the
@@ -130,7 +130,14 @@ def bench_transformer_fluid(steps=24, warmup=3, batch=160, seq=512,
     feed_mode="device" pins the (fixed) batch in HBM once — the headline
     configuration. "host" re-feeds host numpy each step through
     Executor.prefetch, exercising the background H2D staging path (the
-    --tiny smoke uses it so feed/h2d_bytes telemetry has traffic)."""
+    --tiny smoke uses it so feed/h2d_bytes telemetry has traffic).
+
+    program_opt=False runs the leg under PTPU_NO_PROGRAM_OPT=1 — the
+    exact pre-pass-pipeline lowering path, measured so the compile-time
+    optimization win (compile_time_s, StableHLO module size, tokens/s)
+    is visible in BENCH_*.json."""
+    import os
+
     import jax
 
     import paddle_tpu as fluid
@@ -145,7 +152,20 @@ def bench_transformer_fluid(steps=24, warmup=3, batch=160, seq=512,
             fluid.optimizer.SGD(0.01), init_loss_scaling=1.0,
             use_dynamic_loss_scaling=False)
         opt.minimize(loss)
+        # compile-pipeline receipt (docs/COMPILER_PASSES.md): a foldable
+        # const chain, a CSE-able duplicate pair, and a fetch-dead branch
+        # — the optimized leg's compiler/* counters and the noopt leg's
+        # larger module size come from these
+        _c = fluid.layers.scale(
+            fluid.layers.fill_constant([1], "float32", 1.5), scale=0.5)
+        _d1 = fluid.layers.scale(loss, scale=3.0)
+        _d2 = fluid.layers.scale(loss, scale=3.0)
+        fluid.layers.elementwise_add(
+            fluid.layers.elementwise_add(_d1, _d2), _c)
     exe = fluid.Executor(fluid.TPUPlace(), async_steps=12)
+    prev_opt = os.environ.get("PTPU_NO_PROGRAM_OPT")
+    if not program_opt:
+        os.environ["PTPU_NO_PROGRAM_OPT"] = "1"
     exe.run(sprog)
     vocab = (model_kwargs or {}).get("vocab_size", 32000)
     rng = np.random.RandomState(0)
@@ -163,19 +183,32 @@ def bench_transformer_fluid(steps=24, warmup=3, batch=160, seq=512,
                        return_numpy=not async_exec)
         return out
 
-    out = None
-    for _ in range(warmup):
-        out = one_step()
-        float(np.asarray(out).ravel()[0])
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        out = one_step()
-        if not async_exec:
+    try:
+        out = None
+        compile_time_s = None
+        for i in range(warmup):
+            t0 = time.perf_counter()
+            out = one_step()
             float(np.asarray(out).ravel()[0])
-    last = float(np.asarray(out).ravel()[0])  # the one sync point
-    dt = time.perf_counter() - t0
-    exe.close()
-    return steps * batch * seq / dt, last, dt / steps
+            if i == 0:
+                # cold call: program optimization + trace + XLA compile
+                # (the steady-state step time is measured separately)
+                compile_time_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = one_step()
+            if not async_exec:
+                float(np.asarray(out).ravel()[0])
+        last = float(np.asarray(out).ravel()[0])  # the one sync point
+        dt = time.perf_counter() - t0
+        exe.close()
+    finally:
+        if not program_opt:
+            if prev_opt is None:
+                os.environ.pop("PTPU_NO_PROGRAM_OPT", None)
+            else:
+                os.environ["PTPU_NO_PROGRAM_OPT"] = prev_opt
+    return steps * batch * seq / dt, last, dt / steps, compile_time_s
 
 
 # tiny configuration for the CI bench-smoke stage: exercises the whole
@@ -185,6 +218,42 @@ TINY = dict(
                       d_ff=128),
     batch=8, seq=32, steps=6, warmup=1,
 )
+
+
+def _stablehlo_bytes():
+    """Cumulative lowered-module bytes from the compile-cache telemetry
+    (None when metrics are off — the AOT instrumentation is what records
+    module sizes). Callers diff before/after a leg."""
+    from paddle_tpu.observability import metrics as obs_metrics
+
+    if not obs_metrics.enabled():
+        return None
+    h = obs_metrics.registry().histogram(
+        "compile_cache/stablehlo_module_bytes")
+    return h.sum
+
+
+def _fusion_receipt():
+    """One forward-only fc+relu program through CompiledProgram with
+    fuse_elewise_add_act_ops on: the bias add + relu collapse into a
+    fused_elemwise_activation, putting traffic on compiler/ops_fused
+    (the CI bench-smoke asserts the counter)."""
+    import paddle_tpu as fluid
+
+    prog, sprog = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, sprog):
+        x = fluid.layers.data(name="fr_x", shape=[16], dtype="float32")
+        h = fluid.layers.fc(input=x, size=32, act="relu")
+        out = fluid.layers.reduce_mean(h)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(sprog)
+    bs = fluid.compiler.BuildStrategy()
+    bs.fuse_elewise_add_act_ops = True
+    cp = fluid.compiler.CompiledProgram(prog).with_data_parallel(
+        build_strategy=bs)
+    exe.run(cp, feed={"fr_x": np.ones((4, 16), np.float32)},
+            fetch_list=[out])
+    exe.close()
 
 
 def main(argv=None):
@@ -216,10 +285,24 @@ def main(argv=None):
     async_tps = async_step = None
     last_loss = None
     if not args.sync_only:
-        async_tps, last_loss, async_step = bench_transformer_fluid(
+        async_tps, last_loss, async_step, _ = bench_transformer_fluid(
             async_exec=True, **kw)
-    sync_tps, last_loss_sync, sync_step = bench_transformer_fluid(
-        async_exec=False, **kw)
+    hlo0 = _stablehlo_bytes()
+    sync_tps, last_loss_sync, sync_step, compile_opt = \
+        bench_transformer_fluid(async_exec=False, **kw)
+    hlo1 = _stablehlo_bytes()
+    # the PTPU_NO_PROGRAM_OPT=1 leg: identical program through the exact
+    # pre-pass-pipeline lowering path — its compile time, module size and
+    # throughput are the optimization pipeline's before/after receipt
+    noopt_tps, _, noopt_step, compile_noopt = bench_transformer_fluid(
+        async_exec=False, program_opt=False, **kw)
+    hlo2 = _stablehlo_bytes()
+    hlo_opt = (hlo1 - hlo0) if hlo0 is not None else None
+    hlo_noopt = (hlo2 - hlo1) if hlo0 is not None else None
+    if hlo0 is not None:
+        # metrics are on: pay the extra compile only when its counter
+        # (compiler/ops_fused) actually lands in a dump
+        _fusion_receipt()
     if last_loss is None:
         last_loss = last_loss_sync
     headline = async_tps if async_tps is not None else sync_tps
@@ -241,6 +324,14 @@ def main(argv=None):
         if async_tps is not None:
             reg.gauge("bench/step_time_async").set(async_step)
             reg.gauge("bench/tokens_per_sec_async").set(async_tps)
+        if compile_opt is not None:  # --warmup 0: no cold call measured
+            reg.gauge("bench/compile_time_s_opt").set(compile_opt)
+        if compile_noopt is not None:
+            reg.gauge("bench/compile_time_s_noopt").set(compile_noopt)
+        reg.gauge("bench/tokens_per_sec_noopt").set(noopt_tps)
+        if hlo_opt is not None:
+            reg.gauge("bench/stablehlo_bytes_opt").set(hlo_opt)
+            reg.gauge("bench/stablehlo_bytes_noopt").set(hlo_noopt)
         reg.dump_json(args.metrics_out)
     result = {
         "metric": "transformer_base_tokens_per_sec_per_chip",
@@ -249,7 +340,15 @@ def main(argv=None):
         "vs_baseline": round(headline / BASELINE_TOKENS_PER_SEC, 4),
         "sync_tokens_per_sec": round(sync_tps, 1),
         "step_time_sync_s": round(sync_step, 6),
+        "noopt_tokens_per_sec": round(noopt_tps, 1),
     }
+    if compile_opt is not None:  # --warmup 0: no cold call measured
+        result["compile_time_s_opt"] = round(compile_opt, 3)
+    if compile_noopt is not None:
+        result["compile_time_s_noopt"] = round(compile_noopt, 3)
+    if hlo_opt is not None:
+        result["stablehlo_bytes_opt"] = int(hlo_opt)
+        result["stablehlo_bytes_noopt"] = int(hlo_noopt)
     if async_tps is not None:
         result["async_tokens_per_sec"] = round(async_tps, 1)
         result["step_time_async_s"] = round(async_step, 6)

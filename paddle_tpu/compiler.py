@@ -98,11 +98,19 @@ class BuildStrategy:
         self.sequence_parallel_degree = 1
 
 
-def classify_persistable_state(block, fetch_names):
+def classify_persistable_state(block, fetch_names, inplace=None):
     """(mut_names, const_names, state_out): the persistable vars a lowered
     step reads — split into donated read/write vs read-only — and writes.
-    Shared by _DataParallelStep and parallel.pipeline_program so the
-    scope/caching contract cannot drift between the two."""
+    Shared by _CompiledStep, _DataParallelStep and
+    parallel.pipeline_program so the scope/caching contract cannot drift.
+
+    `inplace` (an ir_passes.InplaceInfo) is the donation policy —
+    BuildStrategy.enable_inplace made real: disabled, every read+written
+    persistable moves to the undonated read-only set (buffers never
+    aliased in place); enabled, the last-use analysis additionally
+    promotes large write-before-read persistables into the donated
+    inputs so their stale scope buffers free into XLA's arena for the
+    step. None keeps the legacy classification exactly."""
     produced = set()
     state_in = []
     state_out = set()
@@ -124,17 +132,27 @@ def classify_persistable_state(block, fetch_names):
             state_in.append(name)
     mut = [n for n in state_in if n in state_out]
     const = [n for n in state_in if n not in state_out]
+    if inplace is not None:
+        mut, const = inplace.adjust(block, state_in, sorted(state_out),
+                                    mut, const)
     return mut, const, sorted(state_out)
 
 
-def read_persistable_state(scope, mut_names, const_names):
+def read_persistable_state(scope, mut_names, const_names, fallback=None):
     """(mut, const) value dicts for a step's persistable inputs, with the
     standard not-initialized error. Shared by _DataParallelStep and
-    parallel.pipeline_program."""
+    parallel.pipeline_program. `fallback(name)` supplies values for
+    compile-time artifacts missing from this scope (baked folded
+    constants, donation-promoted dead inputs), which are then seeded
+    into the scope."""
     mut, const = {}, {}
     for names, store in ((mut_names, mut), (const_names, const)):
         for name in names:
             val = scope.get(name)
+            if val is None and fallback is not None:
+                val = fallback(name)
+                if val is not None:
+                    scope.set(name, val)
             if val is None:
                 raise RuntimeError(
                     "persistable var %r is not initialized — run the "
@@ -210,6 +228,10 @@ class CompiledProgram:
         self._share_vars_from = None
         self._compiled_steps = {}
         self._mesh = None
+        self._infer_opt = False
+        # inference-optimized clones for the NON-data-parallel run path,
+        # keyed by (program version, fetch names)
+        self._infer_programs = {}
 
     def with_data_parallel(self, loss_name=None, build_strategy=None,
                            exec_strategy=None, share_vars_from=None,
@@ -224,6 +246,11 @@ class CompiledProgram:
         return self
 
     def with_inference_optimize(self, config):
+        """Opt into the inference-mode pass pipeline (dropout_remove +
+        the baked conv_bn fold + conv_elementwise_add_fuse on top of the
+        default compile-time passes — docs/COMPILER_PASSES.md). Honors
+        `config.switch_ir_optim(False)` (AnalysisConfig parity)."""
+        self._infer_opt = bool(getattr(config, "_ir_optim", True))
         return self
 
     # ------------------------------------------------------------------
@@ -269,7 +296,27 @@ class CompiledProgram:
         from .executor import _CompiledStep, _feed_signature
 
         if not self._is_data_parallel:
-            return executor.run(self._program, feed=feed,
+            from . import ir_passes
+
+            run_program = self._program
+            if self._infer_opt and ir_passes.pipeline_enabled():
+                # apply the inference passes HERE — the executor's own
+                # pipeline has no way to know this CompiledProgram asked
+                # for them (Executor.run only sees a plain Program)
+                fetch_names = tuple(
+                    v.name if isinstance(v, framework.Variable) else str(v)
+                    for v in (fetch_list or []))
+                ikey = (self._program.version, fetch_names)
+                run_program = self._infer_programs.get(ikey)
+                if run_program is None:
+                    from .core.scope import global_scope
+
+                    run_program = ir_passes.optimize_for_execution(
+                        self._program, fetch_names,
+                        scope if scope is not None else global_scope(),
+                        infer_opt=True)
+                    self._infer_programs[ikey] = run_program
+            return executor.run(run_program, feed=feed,
                                 fetch_list=fetch_list, scope=scope,
                                 return_numpy=return_numpy,
                                 fetch_every_n=fetch_every_n)
@@ -279,10 +326,23 @@ class CompiledProgram:
             v.name if isinstance(v, framework.Variable) else str(v)
             for v in (fetch_list or [])
         ]
+        from . import ir_passes
         from .flags import flag
 
+        pp = int(getattr(self._build_strategy,
+                         "pipeline_stages", 1) or 1)
+        # the pass pipeline (and its BuildStrategy knobs) is part of the
+        # compiled-step identity; pipeline-parallel programs are split by
+        # stage attrs the generic passes don't understand, so they keep
+        # the unoptimized path
+        pkey = (ir_passes.pipeline_key(self._build_strategy,
+                                       self._program, self._infer_opt)
+                if pp == 1 else ())
+        # the scope is NOT in the key: scope-bound compile artifacts
+        # (baked constants, promoted dead inputs) self-heal through
+        # ir_passes.state_fallback at state-read time
         key = (self._program.version, _feed_signature(feed),
-               tuple(fetch_names), bool(flag("check_nan_inf")))
+               tuple(fetch_names), bool(flag("check_nan_inf")), pkey)
         # staged substitution only after the key: device_put canonicalizes
         # some dtypes, and a signature drift would recompile spuriously
         if executor._prefetcher is not None:
@@ -298,13 +358,18 @@ class CompiledProgram:
                 from .async_engine import (note_compiled_program,
                                            persistent_cache_dir)
 
+                run_program = self._program
+                if pp == 1 and ir_passes.pipeline_enabled():
+                    with _tracing.span("optimize"):
+                        run_program = ir_passes.optimize_for_execution(
+                            self._program, fetch_names, scope,
+                            build_strategy=self._build_strategy,
+                            infer_opt=self._infer_opt)
                 if persistent_cache_dir():
                     note_compiled_program(
-                        self._program.fingerprint(), key[1],
+                        run_program.fingerprint(), key[1],
                         tuple(fetch_names), key[3],
                         tuple(self._get_mesh().shape.items()))
-                pp = int(getattr(self._build_strategy,
-                                 "pipeline_stages", 1) or 1)
                 with _tracing.span("lower"):
                     if pp > 1:
                         from .parallel.pipeline_program import \
@@ -316,8 +381,9 @@ class CompiledProgram:
                             self._loss_name)
                     else:
                         step = _DataParallelStep(
-                            self._program, feed.keys(), fetch_names,
-                            self._get_mesh(), self._build_strategy)
+                            run_program, feed.keys(), fetch_names,
+                            self._get_mesh(), self._build_strategy,
+                            scope=scope)
                 self._compiled_steps[key] = step
             elif rec:
                 _metrics.counter("compile_cache/hit").inc()
@@ -358,15 +424,26 @@ class _DataParallelStep:
     over dp (ZeRO-1, reduce_op_handle.cc parity); tensor_parallel_degree>1
     adds a tp mesh axis with Megatron param specs for ANY program."""
 
-    def __init__(self, program, feed_names, fetch_names, mesh, build_strategy):
+    def __init__(self, program, feed_names, fetch_names, mesh,
+                 build_strategy, scope=None):
+        from . import ir_passes
+
         self.program = program
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
         self.mesh = mesh
         block = program.global_block()
         self.block = block
+        inplace = None
+        if ir_passes.pipeline_enabled():
+            inplace = ir_passes.InplaceInfo(
+                enabled=bool(getattr(build_strategy, "enable_inplace",
+                                     True)),
+                scope=scope)
+        self._inplace = inplace
         self.mut_names, self.const_names, self.state_out = \
-            classify_persistable_state(block, self.fetch_names)
+            classify_persistable_state(block, self.fetch_names,
+                                       inplace=inplace)
         self._seed = program.random_seed or 0
 
         repl = NamedSharding(mesh, P())
@@ -472,9 +549,15 @@ class _DataParallelStep:
             return self._batch_seq
         return self._batch
 
+    def _state_fallback(self, name):
+        from . import ir_passes
+
+        return ir_passes.state_fallback(self.program, self._inplace, name)
+
     def run(self, scope, feed):
         mut, const = read_persistable_state(scope, self.mut_names,
-                                            self.const_names)
+                                            self.const_names,
+                                            fallback=self._state_fallback)
         feeds = {}
         for name in self.feed_names:
             arr = normalize_feed_value(self.block, name, feed[name])

@@ -45,8 +45,18 @@ _JAXLIB_VERSION = tuple(
 # XLA bundled with jaxlib >= 0.5
 AXIS_INDEX_SAFE_UNDER_PARTIAL_AUTO = _JAXLIB_VERSION >= (0, 5)
 
+# Cross-process collectives on the CPU backend ("Multiprocess
+# computations aren't implemented on the CPU backend"): the old XLA:CPU
+# client has no cross-process collective implementation, so
+# jax.distributed multi-host runs CHECK out at the first psum. Landed
+# with the thread-pool collectives rework shipped in jaxlib >= 0.5; the
+# multi-process CPU tests are version-gated on this probe, mirroring
+# AXIS_INDEX_SAFE_UNDER_PARTIAL_AUTO.
+MULTIPROCESS_CPU_COLLECTIVES = _JAXLIB_VERSION >= (0, 5)
+
 __all__ = ["shard_map", "optimization_barrier", "axis_index",
-           "AXIS_INDEX_SAFE_UNDER_PARTIAL_AUTO"]
+           "AXIS_INDEX_SAFE_UNDER_PARTIAL_AUTO",
+           "MULTIPROCESS_CPU_COLLECTIVES"]
 
 
 def _make_optimization_barrier():

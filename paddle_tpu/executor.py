@@ -100,26 +100,14 @@ class _CompiledStep:
     """One lowered+jitted step for a (program, feed signature, fetches)."""
 
     def __init__(self, program, feed_names, fetch_names, scope, mesh_ctx=None):
+        from . import ir_passes
+        from .compiler import classify_persistable_state
+
         self.program = program
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
         block = program.global_block()
 
-        # classify persistable state the step reads/writes
-        produced = set()
-        state_in = []
-        state_out = set()
-        for op in block.ops:
-            for name in op.input_names():
-                v = block._find_var_recursive(name)
-                if v is not None and v.persistable and name not in produced \
-                        and name not in state_in:
-                    state_in.append(name)
-            for name in op.output_names():
-                produced.add(name)
-                v = block._find_var_recursive(name)
-                if v is not None and v.persistable:
-                    state_out.add(name)
         # pserver-mode RPC ops (transpiled trainer program) run host-side
         # after the jitted step: send needs the step's grad values fetched
         self._rpc_ops = [op for op in block.ops if op.type in
@@ -140,17 +128,15 @@ class _CompiledStep:
                         rpc_fetches.append(v.name)
         self._all_fetch_names = self.fetch_names + rpc_fetches
 
-        # fetched persistables must also come from state
-        for name in self._all_fetch_names:
-            v = block._find_var_recursive(name)
-            if v is not None and v.persistable and name not in produced \
-                    and name not in state_in:
-                state_in.append(name)
-        self.state_out = sorted(state_out)
-        # split read state: donated (also written — param/accumulator updates
-        # alias in-place in HBM) vs const (read-only, e.g. learning rate)
-        self.mut_names = [n for n in state_in if n in state_out]
-        self.const_names = [n for n in state_in if n not in state_out]
+        # persistable read/write classification (shared with the
+        # data-parallel step): mut is donated — param/accumulator updates
+        # alias in-place in HBM; const is read-only (e.g. learning rate)
+        inplace = (ir_passes.InplaceInfo(scope=scope)
+                   if ir_passes.pipeline_enabled() else None)
+        self._inplace = inplace
+        self.mut_names, self.const_names, self.state_out = \
+            classify_persistable_state(block, self._all_fetch_names,
+                                       inplace=inplace)
         seed = program.random_seed or 0
         self._seed = seed
 
@@ -195,9 +181,19 @@ class _CompiledStep:
         self._ran_jit = False
 
     def _read_state(self, scope, names):
+        from . import ir_passes
+
         state = {}
         for name in names:
             val = scope.get(name)
+            if val is None:
+                # compile-time artifacts (baked folded constants,
+                # donation-promoted dead inputs) self-heal into whatever
+                # scope this cached step runs against
+                val = ir_passes.state_fallback(self.program,
+                                               self._inplace, name)
+                if val is not None:
+                    scope.set(name, val)
             if val is None:
                 raise RuntimeError(
                     "persistable var %r is not initialized — run the startup "
@@ -456,6 +452,7 @@ class Executor:
             v.name if isinstance(v, framework.Variable) else str(v)
             for v in fetch_list
         ]
+        from . import ir_passes
         from .flags import flag
 
         key = (
@@ -464,6 +461,13 @@ class Executor:
             _feed_signature(feed),
             tuple(fetch_names),
             bool(flag("check_nan_inf")),
+            # the compile-time pass pipeline is part of the step identity:
+            # toggling PTPU_NO_PROGRAM_OPT (or the program flipping
+            # between train/inference shape) must not hit a stale entry.
+            # The scope is NOT in the key: scope-bound compile artifacts
+            # (baked constants, promoted dead inputs) self-heal through
+            # ir_passes.state_fallback at state-read time
+            ir_passes.pipeline_key(None, program),
         )
         # substitute staged device copies only AFTER the cache key is
         # computed from the ORIGINAL feed: device_put canonicalizes some
@@ -484,11 +488,20 @@ class Executor:
                 # program+signature across process restarts
                 from .async_engine import persistent_cache_dir
 
+                # compile-time pass pipeline (docs/COMPILER_PASSES.md):
+                # DCE/CSE/constant folding on a clone of the program;
+                # PTPU_NO_PROGRAM_OPT=1 restores the unoptimized path
+                run_program = program
+                if ir_passes.pipeline_enabled():
+                    with _tracing.span("optimize"):
+                        run_program = ir_passes.optimize_for_execution(
+                            program, fetch_names, scope)
                 if persistent_cache_dir():
-                    note_compiled_program(program.fingerprint(), key[2],
-                                          tuple(fetch_names), key[4])
+                    note_compiled_program(run_program.fingerprint(),
+                                          key[2], tuple(fetch_names),
+                                          key[4])
                 with _tracing.span("lower"):
-                    compiled = _CompiledStep(program, feed.keys(),
+                    compiled = _CompiledStep(run_program, feed.keys(),
                                              fetch_names, scope)
                 if use_program_cache:
                     self._cache[key] = compiled

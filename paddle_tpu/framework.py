@@ -537,10 +537,14 @@ class Program:
                         trainable=v.trainable,
                         lod_level=v.lod_level,
                         stop_gradient=v.stop_gradient,
+                        shard_spec=v.shard_spec,
+                        is_distributed=v.is_distributed,
                     )
                     nv.initializer = v.initializer
                     nv.regularizer = v.regularizer
                     nv.optimize_attr = dict(v.optimize_attr)
+                    nv.gradient_clip_attr = v.gradient_clip_attr
+                    nv.do_model_average = v.do_model_average
                 else:
                     nv = Variable(
                         nb,
@@ -554,7 +558,14 @@ class Program:
                         type=v.type,
                     )
                     nv.initializer = v.initializer
+                if getattr(v, "is_tensor_array", False):
+                    # ad-hoc flag from layers.create_array: the lowering
+                    # treats a first mention with no producer as the
+                    # empty array, keyed off this attribute
+                    nv.is_tensor_array = True
                 nb.vars[name] = nv
+        op_map = {}  # original Operator -> cloned Operator (by identity)
+        for blk, nb in zip(self.blocks, p.blocks):
             for op in blk.ops:
                 if for_test and self._is_train_only_op(op):
                     continue
@@ -565,7 +576,7 @@ class Program:
                 for k, v in attrs.items():
                     if isinstance(v, Block):
                         attrs[k] = p.blocks[v.idx]
-                nb.append_op(
+                op_map[id(op)] = nb.append_op(
                     type=op.type,
                     inputs={
                         k: [nb.var(v.name) for v in vs]
@@ -577,6 +588,15 @@ class Program:
                     },
                     attrs=attrs,
                 )
+        # grad ops reference their forward op by OBJECT (__fwd_op__);
+        # rewire those references onto the cloned ops so the clone's
+        # execution snapshots and serialized desc are self-contained
+        # (a clone pointing into the source program breaks both)
+        for nb in p.blocks:
+            for op in nb.ops:
+                for k, v in op.attrs.items():
+                    if isinstance(v, Operator) and id(v) in op_map:
+                        op.attrs[k] = op_map[id(v)]
         p.param_grad_map = dict(self.param_grad_map)
         p.current_block_idx = 0
         return p

@@ -109,6 +109,24 @@ class AnalysisPredictor:
                 io.load_inference_model(config.model_dir, self._exe,
                                         model_filename=config.prog_file,
                                         params_filename=config.params_file)
+        if config._ir_optim:
+            # OptimizeInferenceProgram parity: the registered inference
+            # passes run once at load time. The predictor owns a private
+            # scope and a freshly loaded program, so the weight-editing
+            # conv_bn fold is safe here (the generic compile-time
+            # pipeline — DCE/CSE/folding — runs per compile in the
+            # executor; docs/COMPILER_PASSES.md).
+            from . import ir
+
+            # pin the fetch targets so the passes' rewrites can never
+            # orphan an output the predictor will fetch
+            self._program._opt_fetch_targets = tuple(
+                v.name for v in self._fetch_vars)
+            ir.apply_passes(
+                self._program,
+                ["conv_bn_fold", "dropout_remove",
+                 "conv_elementwise_add_fuse"],
+                self._scope)
         if config._aot_shapes:
             self._warmup(config._aot_shapes)
 

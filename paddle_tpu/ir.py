@@ -360,8 +360,22 @@ class DropoutRemovePass(Pass):
 
     def apply(self, program, scope=None):
         from .framework import Operator
+        from .ir_passes import _fetch_targets, _outside_reads
 
         block = program.global_block()
+        # names the rename rewiring cannot reach: fetch targets (pinned
+        # by the compile pipeline) and vars read from sub-blocks — those
+        # dropout outputs keep a producer (identity scale) instead.
+        # Rename is also only sound under single assignment: if the
+        # dropout's out name (or the rename SOURCE) is written again
+        # later, rewired readers would observe the rebound value.
+        protected = set(_fetch_targets(program) or ()) \
+            | _outside_reads(program)
+        writes = {}
+        for blk in program.blocks:
+            for op in blk.ops:
+                for n in op.output_names():
+                    writes[n] = writes.get(n, 0) + 1
         new_ops = []
         rename = {}
         changed = False
@@ -373,7 +387,17 @@ class DropoutRemovePass(Pass):
                 impl = op.attrs.get("dropout_implementation",
                                     "downgrade_in_infer")
                 if impl == "upscale_in_train":
-                    for outv in op.outputs.get("Out", []):
+                    outs = op.outputs.get("Out", [])
+                    if any(v.name in protected
+                           or writes.get(v.name, 0) != 1
+                           for v in outs) \
+                            or writes.get(src.name, 0) > 1:
+                        new_ops.append(Operator(
+                            block, "scale", inputs={"X": [src]},
+                            outputs={"Out": [outs[0]]},
+                            attrs={"scale": 1.0}))
+                        continue
+                    for outv in outs:
                         rename[outv.name] = src
                     continue
                 p = op.attrs.get("dropout_prob", 0.5)
@@ -402,8 +426,15 @@ class ConvResidualAddFusePass(Pass):
 
     def apply(self, program, scope=None):
         from .framework import Operator
+        from .ir_passes import _fetch_targets, _outside_reads
 
         block = program.global_block()
+        # interior outputs (conv's, add's — and the act's when fused)
+        # disappear; a match whose interior is fetched or sub-block-read
+        # must be left alone (Pattern's single_consumer only counts
+        # consuming OPS)
+        protected = set(_fetch_targets(program) or ()) \
+            | _outside_reads(program)
         changed = False
         for with_act in (True, False):  # longest pattern first
             p = Pattern()
@@ -429,11 +460,22 @@ class ConvResidualAddFusePass(Pass):
             for m in p.match(block):
                 conv, add = m["conv"], m["add"]
                 last = m["act"] if with_act else add
+                interior = [o for o in (conv, add, m.get("act"))
+                            if o is not None and o is not last]
+                if any(n in protected for o in interior
+                       for n in o.output_names()):
+                    continue
+                fused_ins = {"Input": conv.inputs["Input"],
+                             "Filter": conv.inputs["Filter"],
+                             "ResidualData": add.inputs["Y"]}
+                if conv.inputs.get("FoldedBias"):
+                    # per-channel shift left by a preceding conv+bn fold
+                    # — conv2d_fusion applies Bias before the residual
+                    # and activation, the same order the unfused ops ran
+                    fused_ins["Bias"] = conv.inputs["FoldedBias"]
                 fused = Operator(
                     block, "conv2d_fusion",
-                    inputs={"Input": conv.inputs["Input"],
-                            "Filter": conv.inputs["Filter"],
-                            "ResidualData": add.inputs["Y"]},
+                    inputs=fused_ins,
                     outputs={"Output": last.outputs["Out"]},
                     attrs=dict(conv.attrs,
                                activation="relu" if with_act
@@ -454,8 +496,30 @@ class ConvResidualAddFusePass(Pass):
 def _memory_optimize_pass(program, scope):
     """Lifetime analysis + reuse-plan annotation
     (memory_optimization_transpiler.memory_optimize as a registered
-    pass; XLA performs the actual buffer aliasing)."""
+    pass; XLA performs the actual buffer aliasing). Bumps the version so
+    the compile pipeline's change detection keeps the annotated clone."""
     from .transpiler.memory_optimization_transpiler import memory_optimize
 
     memory_optimize(program)
+    program._bump_version()
     return program
+
+
+# ---------------------------------------------------------------------------
+# default compile-time pipeline (ir_passes.py registers fetch_dce / cse /
+# constant_fold / fuse_elewise_add_act / conv_bn_fold_baked on import and
+# the executors run them on every compile-cache miss — docs/
+# COMPILER_PASSES.md)
+# ---------------------------------------------------------------------------
+
+from . import ir_passes as _ir_passes  # noqa: E402
+
+build_pipeline = _ir_passes.build_pipeline
+optimize_for_execution = _ir_passes.optimize_for_execution
+pipeline_enabled = _ir_passes.pipeline_enabled
+pipeline_key = _ir_passes.pipeline_key
+program_is_inference = _ir_passes.program_is_inference
+InplaceInfo = _ir_passes.InplaceInfo
+
+__all__ += ["build_pipeline", "optimize_for_execution", "pipeline_enabled",
+            "pipeline_key", "program_is_inference", "InplaceInfo"]

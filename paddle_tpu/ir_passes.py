@@ -1,0 +1,860 @@
+"""Default compile-time program-optimization pipeline.
+
+Parity: the reference's multi-device builder runs a graph-pass pipeline
+before execution (framework/details/build_strategy.cc — the
+`fuse_elewise_add_act_ops` / `memory_optimize` / `enable_inplace` knobs
+all name real framework/ir/ passes). TPU-native, the pipeline runs at
+COMPILE time on a clone of the program, right before the executor lowers
+it into one jitted step: the passes shrink what gets traced into XLA
+(trace time, StableHLO module size, compile latency) and drop
+fetch-unreachable work from the steady-state step entirely.
+
+Generic passes (registered in `paddle_tpu.ir`'s registry, composable with
+user passes):
+
+  constant_fold         evaluate const-only subgraphs once via the op
+                        registry's own kernels; small results stay as
+                        inline constants, large ones bake into the scope
+                        as initialized parameters
+  cse                   common-subexpression elimination (type + inputs
+                        at identical reaching definitions + attrs)
+  fuse_elewise_add_act  elementwise_add + {relu,tanh,sigmoid} ->
+                        fused_elemwise_activation (BuildStrategy.
+                        fuse_elewise_add_act_ops)
+  fetch_dce             drop ops whose outputs cannot reach a fetch
+                        target, persistable write, or side-effecting op
+  conv_bn_fold_baked    non-destructive conv+bn fold for compile-time
+                        clones: folded weights land in NEW scope entries,
+                        the user's original parameters stay untouched
+
+Entry points: `Executor.run` and `CompiledProgram._run` call
+`optimize_for_execution` on every compile-cache miss; the cache key
+carries `pipeline_key(...)` so BuildStrategy knobs and the opt-out are
+part of the compiled-step identity. `PTPU_NO_PROGRAM_OPT=1` disables
+everything and restores the exact unoptimized lowering path.
+
+Every pass mutates ONLY the cloned program it is handed (constant folding
+and conv_bn_fold_baked additionally write fresh, content-addressed
+persistable entries into the scope — never existing names), so the
+original program can keep executing unoptimized against the same scope.
+
+Soundness invariants shared by the rewriting passes:
+  - ops referenced (transitively) through a surviving op's `__fwd_op__`
+    attr are never deleted — grad ops re-run their forward op's kernel
+    and the serialized desc stores the reference by op index;
+  - var names read by OTHER blocks (control-flow sub-blocks close over
+    parent vars) are never rewired or orphaned;
+  - CSE/folding only treat a var as value-stable when its name has a
+    single static definition reaching every rewired read (reaching-def
+    indices are part of the CSE key, so in-place rebinding is safe).
+"""
+
+import os
+import time
+
+import numpy as np
+
+from .observability import metrics as _metrics
+from .observability import tracing as _tracing
+
+__all__ = [
+    "pipeline_enabled", "build_pipeline", "pipeline_key",
+    "optimize_for_execution", "InplaceInfo", "program_is_inference",
+]
+
+# fused_elemwise_activation supports exactly these unary functors with
+# impls identical to the standalone activation ops (bitwise-preserving)
+_FUSABLE_ACTS = ("relu", "tanh", "sigmoid")
+
+# constant folding refuses to bake results larger than this (a folded
+# iota the size of an embedding table belongs in the program, not the
+# scope)
+_MAX_FOLD_BYTES = 1 << 24
+
+# folded values up to this many elements stay INLINE (one assign_value
+# op, lowered as a module-embedded constant): consumers that require
+# trace-time-concrete values (tensor-array indices, static range bounds)
+# keep working, exactly as they did with the original const op. Larger
+# values bake as initialized scope parameters instead — they enter the
+# step as arguments, keeping big constants out of the StableHLO module.
+_INLINE_FOLD_ELEMS = 1 << 16
+
+# pure-but-context-sensitive kernels (mesh/collective dependent): their
+# compile-time evaluation context differs from the step's, so they never
+# constant-fold; ditto any op carrying the __loss_seed__ attr, whose
+# value scales by ctx.grad_seed_scale at lowering time
+_CTX_SENSITIVE_TYPES = frozenset({"flash_attention"})
+
+# donation promotion only pays off (and only risks an unused-donation
+# warning) for buffers worth freeing early
+_MIN_PROMOTE_BYTES = 1 << 20
+
+
+def pipeline_enabled():
+    """False under PTPU_NO_PROGRAM_OPT=1 — every compile-time transform
+    (including donation promotion) gates on this, so the opt-out restores
+    the exact unoptimized lowering path."""
+    return os.environ.get("PTPU_NO_PROGRAM_OPT", "") not in ("1", "true")
+
+
+def program_is_inference(program):
+    """True when the program carries no backward/optimizer ops and every
+    train/eval-switchable op (dropout, batch_norm) is pinned to test mode
+    — i.e. a clone(for_test=True)-shaped program. Cached per program
+    mutation version (checked on the executor hot path)."""
+    from .framework import Program, _TEST_MODE_OPS
+
+    cached = getattr(program, "_is_test_cache", None)
+    if cached is not None and cached[0] == program.version:
+        return cached[1]
+    result = True
+    for blk in program.blocks:
+        for op in blk.ops:
+            if Program._is_train_only_op(op):
+                result = False
+                break
+            if "is_test" in _TEST_MODE_OPS.get(op.type, ()) \
+                    and not op.attrs.get("is_test", False):
+                result = False
+                break
+        if not result:
+            break
+    program._is_test_cache = (program.version, result)
+    return result
+
+
+def build_pipeline(build_strategy=None, is_test=False, infer_opt=False,
+                   single_block=True):
+    """Ordered pass-name list for one compile. `infer_opt` is the
+    explicit inference-optimize request (with_inference_optimize /
+    AnalysisConfig ir_optim) and adds the numerics-adjusting conv folds;
+    `is_test` alone stays bitwise-preserving."""
+    names = []
+    if (is_test or infer_opt) and single_block:
+        # identity at test time (downgrade dropout becomes the identical
+        # x*(1-p) scale); the rename rewiring only covers one block
+        names.append("dropout_remove")
+    if infer_opt:
+        names.append("conv_bn_fold_baked")
+        names.append("conv_elementwise_add_fuse")
+    names.append("constant_fold")
+    names.append("cse")
+    if infer_opt or (build_strategy is not None
+                     and getattr(build_strategy,
+                                 "fuse_elewise_add_act_ops", False)):
+        names.append("fuse_elewise_add_act")
+    names.append("fetch_dce")
+    if build_strategy is not None and getattr(build_strategy,
+                                              "memory_optimize", False):
+        names.append("memory_optimize")
+    return names
+
+
+def pipeline_key(build_strategy=None, program=None, infer_opt=False):
+    """Compile-cache key component covering the pass list and the
+    BuildStrategy knobs that select it. Cheap enough for the per-run hot
+    path (program inspection is cached on the program version)."""
+    if not pipeline_enabled():
+        return ("noopt",)
+    is_test = program_is_inference(program) if program is not None else False
+    single = program is None or program.num_blocks == 1
+    key = tuple(build_pipeline(build_strategy, is_test, infer_opt, single))
+    if build_strategy is not None:
+        # enable_inplace selects the donation classification of the
+        # compiled step — flipping it must not reuse a stale entry
+        key += ("inplace:%d" % int(getattr(build_strategy,
+                                           "enable_inplace", True)),)
+    return key
+
+
+def optimize_for_execution(program, fetch_names, scope=None,
+                           build_strategy=None, infer_opt=False):
+    """Run the default pipeline on a CLONE of `program` and return the
+    optimized clone (or the original, untouched, when the pipeline is
+    disabled or changed nothing). Called on every compile-cache miss."""
+    if not pipeline_enabled():
+        return program
+    names = build_pipeline(build_strategy, program_is_inference(program),
+                           infer_opt, program.num_blocks == 1)
+    from .ir import get_pass
+
+    clone = program.clone()
+    clone._opt_fetch_targets = tuple(fetch_names)
+    baked = getattr(program, "_baked_values", None)
+    if baked:
+        # re-optimizing an already-optimized program (e.g. the
+        # with_inference_optimize non-dp path hands its clone to
+        # Executor.run) must not lose the state_fallback values
+        clone._baked_values = dict(baked)
+    rec = _metrics.enabled()
+    changed_any = False
+    for name in names:
+        v0 = clone.version
+        t0 = time.perf_counter()
+        with _tracing.span("pass:" + name):
+            get_pass(name).apply(clone, scope)
+        if rec:
+            _metrics.histogram("compiler/pass_time").observe(
+                time.perf_counter() - t0)
+        changed_any = changed_any or clone.version != v0
+    if not changed_any:
+        # nothing fired: hand the executor the ORIGINAL program so the
+        # common case keeps its exact pre-optimization identity
+        return program
+    if rec:
+        _metrics.counter("compiler/programs_optimized").inc()
+    return clone
+
+
+# ---------------------------------------------------------------------------
+# shared analyses
+# ---------------------------------------------------------------------------
+
+
+def _fetch_targets(program):
+    """Fetch-target names the pipeline runner pinned on the clone; None
+    means "unknown" and makes the fetch-driven passes no-ops (a user
+    applying `fetch_dce` standalone must set program._opt_fetch_targets)."""
+    return getattr(program, "_opt_fetch_targets", None)
+
+
+def _outside_reads(program):
+    """Var names read by any op OUTSIDE the global block (control-flow
+    sub-blocks close over parent-block vars by name)."""
+    gb = program.global_block()
+    reads = set()
+    for blk in program.blocks:
+        if blk is gb:
+            continue
+        for op in blk.ops:
+            reads.update(op.input_names())
+    return reads
+
+
+def _outside_writes(program):
+    """Var names written by any op outside the global block: their write
+    ORDER relative to global-block ops is unknown, so value-identity
+    reasoning (CSE reaching defs, single-assignment checks) must treat
+    them as unstable."""
+    gb = program.global_block()
+    writes = set()
+    for blk in program.blocks:
+        if blk is gb:
+            continue
+        for op in blk.ops:
+            writes.update(op.output_names())
+    return writes
+
+
+def bake_value(program, name, value):
+    """Record a compile-time-materialized value on the optimized program
+    (baked folded constants, folded conv weights). `state_fallback`
+    re-seeds any scope that lacks the entry, so a cached compiled step
+    stays valid across scopes."""
+    baked = getattr(program, "_baked_values", None)
+    if baked is None:
+        baked = program._baked_values = {}
+    baked[name] = value
+
+
+def state_fallback(program, inplace, name):
+    """Value for a persistable step input missing from the run scope:
+    baked compile-time constants come back verbatim; donation-promoted
+    write-before-read names come back as zeros (their input value is
+    dead — the step overwrites before any read). None = genuinely
+    uninitialized."""
+    baked = getattr(program, "_baked_values", None)
+    if baked and name in baked:
+        return baked[name]
+    if inplace is not None and name in inplace.promoted:
+        shape, dtype = inplace.promoted[name]
+        return np.zeros(shape, dtype)
+    return None
+
+
+def _grad_referenced_ids(program):
+    """ids of ops referenced (transitively) through `__fwd_op__` attrs —
+    grad ops re-run these kernels and the serialized desc stores them by
+    op index, so rewriting passes must not delete them."""
+    from .framework import Operator
+
+    refed = set()
+    for blk in program.blocks:
+        for op in blk.ops:
+            fwd = op.attrs.get("__fwd_op__")
+            while isinstance(fwd, Operator) and id(fwd) not in refed:
+                refed.add(id(fwd))
+                fwd = fwd.attrs.get("__fwd_op__")
+    return refed
+
+
+def _write_indices(block):
+    """{name: [op index, ...]} for every output name in `block`."""
+    writes = {}
+    for i, op in enumerate(block.ops):
+        for n in op.output_names():
+            writes.setdefault(n, []).append(i)
+    return writes
+
+
+def _is_pure(op):
+    """Pure program-level op: a registered, stateless kernel with no
+    bespoke lowering, no structural role, no sub-block/operator attrs and
+    no grad machinery — safe to evaluate, dedup or delete on the usual
+    liveness grounds."""
+    from .core.lowering import _SPECIAL, _STRUCTURAL
+    from .framework import Block, Operator
+    from .ops import registry
+
+    if op.type in _STRUCTURAL or op.type in _SPECIAL:
+        return False
+    if "__fwd_op__" in op.attrs:
+        return False
+    if not registry.has(op.type) or registry.get(op.type).stateful:
+        return False
+    return not any(isinstance(v, (Block, Operator))
+                   for v in op.attrs.values())
+
+
+def _attr_fingerprint(attrs):
+    """Hashable canonical form of an op's attrs (ndarrays by content,
+    containers recursively)."""
+    def canon(v):
+        if isinstance(v, np.ndarray):
+            return ("__ndarray__", v.shape, str(v.dtype), v.tobytes())
+        if isinstance(v, (list, tuple)):
+            return tuple(canon(x) for x in v)
+        if isinstance(v, dict):
+            return tuple(sorted((kk, canon(vv)) for kk, vv in v.items()))
+        try:
+            hash(v)
+        except TypeError:
+            return repr(v)
+        return v
+
+    return tuple((k, canon(attrs[k])) for k in sorted(attrs))
+
+
+# ---------------------------------------------------------------------------
+# inplace / last-use analysis -> donation classification
+# ---------------------------------------------------------------------------
+
+
+class InplaceInfo:
+    """Donation policy handed to `compiler.classify_persistable_state`
+    (BuildStrategy.enable_inplace made real). `enabled=False` moves every
+    read+written persistable out of the donated set — buffers are never
+    aliased in place, the scope's pre-step arrays stay valid (debugging
+    parity with the reference's inplace pass off). `enabled=True` keeps
+    the standard donation AND promotes write-before-read persistables
+    (outputs whose old value no step op reads — e.g. a re-filled
+    accumulator) into the donated inputs, so their stale scope buffers
+    join XLA's arena for the step instead of pinning HBM; only buffers
+    >= min_promote_bytes are worth the extra argument."""
+
+    def __init__(self, enabled=True, scope=None,
+                 min_promote_bytes=_MIN_PROMOTE_BYTES):
+        self.enabled = enabled
+        self.scope = scope
+        self.min_promote_bytes = min_promote_bytes
+        # name -> (shape, dtype) of promoted write-before-read inputs;
+        # state_fallback synthesizes zeros from this when a later run
+        # scope has no value (the input is dead — write precedes read)
+        self.promoted = {}
+
+    def adjust(self, block, state_in, state_out, mut, const):
+        if not self.enabled:
+            return [], const + mut
+        if self.scope is None:
+            return mut, const
+        promoted = []
+        for name in state_out:
+            if name in state_in:
+                continue
+            val = self.scope.get(name)
+            if val is None:
+                continue
+            nbytes = getattr(val, "nbytes", None)
+            if nbytes is None:
+                val = np.asarray(val)
+                nbytes = val.nbytes
+            if nbytes >= self.min_promote_bytes:
+                promoted.append(name)
+                dt = getattr(val, "dtype", None)
+                self.promoted[name] = (tuple(np.shape(val)),
+                                       dt if dt is not None
+                                       else np.asarray(val).dtype)
+        return mut + promoted, const
+
+
+# ---------------------------------------------------------------------------
+# the passes
+# ---------------------------------------------------------------------------
+
+
+def _register_builtin_passes():
+    """Registered lazily from paddle_tpu.ir to keep a single import
+    direction (ir -> ir_passes)."""
+    from .ir import register_pass, Pass
+
+    @register_pass("fetch_dce")
+    class FetchDeadOpEliminationPass(Pass):
+        """Fetch-driven dead-op elimination: remove global-block ops whose
+        outputs cannot reach a fetch target, a persistable write, a
+        side-effecting/structural op, a sub-block read, or a surviving
+        grad op's forward reference. Name-based and order-insensitive,
+        i.e. conservative under in-place rebinding."""
+
+        def apply(self, program, scope=None):
+            from .core.lowering import _SPECIAL, _STRUCTURAL
+            from .framework import Block, Operator
+
+            targets = _fetch_targets(program)
+            if targets is None:
+                return program
+            block = program.global_block()
+            ops = block.ops
+            idx_of = {id(op): i for i, op in enumerate(ops)}
+            writers = _write_indices(block)
+
+            live = set()
+            live_names = set(targets) | _outside_reads(program)
+            for i, op in enumerate(ops):
+                anchor = (op.type in _STRUCTURAL or op.type in _SPECIAL
+                          or not op.output_names()
+                          or any(isinstance(v, Block)
+                                 for v in op.attrs.values()))
+                if not anchor:
+                    for n in op.output_names():
+                        v = block._find_var_recursive(n)
+                        if v is not None and v.persistable:
+                            anchor = True
+                            break
+                if anchor:
+                    live.add(i)
+
+            changed = True
+            while changed:
+                changed = False
+                for n in list(live_names):
+                    for i in writers.get(n, ()):
+                        if i not in live:
+                            live.add(i)
+                            changed = True
+                for i in list(live):
+                    op = ops[i]
+                    new = set(op.input_names()) - live_names
+                    if new:
+                        live_names |= new
+                        changed = True
+                    fwd = op.attrs.get("__fwd_op__")
+                    while isinstance(fwd, Operator):
+                        j = idx_of.get(id(fwd))
+                        if j is not None and j not in live:
+                            live.add(j)
+                            changed = True
+                        fwd = fwd.attrs.get("__fwd_op__")
+
+            if len(live) == len(ops):
+                return program
+            removed = len(ops) - len(live)
+            block.ops = [op for i, op in enumerate(ops) if i in live]
+            _metrics.counter("compiler/ops_removed").inc(removed)
+            program._bump_version()
+            return program
+
+    @register_pass("cse")
+    class CommonSubexpressionEliminationPass(Pass):
+        """Dedup pure global-block ops computing the identical value: the
+        key is (type, per-slot inputs as (name, reaching-def index),
+        output arity, attrs). A later duplicate is deleted and every
+        subsequent reader rewired to the kept op's outputs; outputs that
+        are fetched, persistable, multiply-written, or read by sub-blocks
+        stay put."""
+
+        def apply(self, program, scope=None):
+            targets = _fetch_targets(program)
+            if targets is None:
+                # fetch set unknown: eliminating an op could orphan a
+                # name the caller intends to fetch (the documented
+                # _fetch_targets contract — pin program._opt_fetch_targets
+                # to run this pass standalone)
+                return program
+            block = program.global_block()
+            protected = set(targets) | _outside_reads(program)
+            grad_refed = _grad_referenced_ids(program)
+            writes = _write_indices(block)
+            # names also written by sub-block ops: their write order
+            # relative to global ops is unknown — no stable reaching def
+            sub_written = _outside_writes(program)
+
+            def rdef(name, i):
+                if name in sub_written:
+                    return None
+                last = -1
+                for w in writes.get(name, ()):
+                    if w < i:
+                        last = w
+                    else:
+                        break
+                return last
+
+            seen = {}
+            rewire = {}
+            removed = []
+            for i, op in enumerate(block.ops):
+                for slot, vs in op.inputs.items():
+                    op.inputs[slot] = [rewire.get(v.name, v) for v in vs]
+                if not _is_pure(op):
+                    continue
+                key_in = []
+                ok = True
+                for slot in sorted(op.inputs):
+                    ids = []
+                    for v in op.inputs[slot]:
+                        d = rdef(v.name, i)
+                        if d is None:
+                            ok = False
+                            break
+                        ids.append((v.name, d))
+                    if not ok:
+                        break
+                    key_in.append((slot, tuple(ids)))
+                if not ok:
+                    continue
+                key = (op.type, tuple(key_in),
+                       tuple(sorted((s, len(vs))
+                                    for s, vs in op.outputs.items())),
+                       _attr_fingerprint(op.attrs))
+                kept = seen.get(key)
+                if kept is None:
+                    seen[key] = op
+                    continue
+                eliminable = id(op) not in grad_refed
+                for n in op.output_names():
+                    v = block._find_var_recursive(n)
+                    if (n in protected or n in sub_written
+                            or len(writes.get(n, ())) != 1
+                            or (v is not None
+                                and (v.persistable or v.is_data))):
+                        eliminable = False
+                        break
+                # the KEPT op's outputs must be singly-written too: a
+                # later in-place rebinding of the kept name would make
+                # rewired readers observe the REBOUND value, not the
+                # common subexpression
+                for n in kept.output_names():
+                    if n in sub_written or len(writes.get(n, ())) != 1:
+                        eliminable = False
+                        break
+                if not eliminable:
+                    continue
+                for slot, vs in op.outputs.items():
+                    for v, kv in zip(vs, kept.outputs.get(slot, ())):
+                        rewire[v.name] = kv
+                removed.append(i)
+            if removed:
+                gone = set(removed)
+                block.ops = [op for i, op in enumerate(block.ops)
+                             if i not in gone]
+                _metrics.counter("compiler/ops_removed").inc(len(removed))
+                program._bump_version()
+            return program
+
+    @register_pass("constant_fold")
+    class ConstantFoldPass(Pass):
+        """Evaluate const-only subgraphs once at compile time through the
+        op registry's own kernels and bake each boundary value into the
+        scope as an initialized parameter (a fresh content-addressed
+        persistable var — existing names are never overwritten, so the
+        unoptimized program keeps running against the same scope). The
+        dead const producers are swept by the fetch_dce pass behind it."""
+
+        def apply(self, program, scope=None):
+            if scope is None:
+                return program
+            import hashlib
+
+            import jax
+
+            from .core.lowering import LoweringContext
+            from .ops import registry
+
+            targets = set(_fetch_targets(program) or ())
+            block = program.global_block()
+            outside = _outside_reads(program)
+            grad_refed = _grad_referenced_ids(program)
+            writes = _write_indices(block)
+            sub_written = _outside_writes(program)
+
+            ctx = LoweringContext(base_key=jax.random.PRNGKey(0))
+            const_vals = {}
+            const_ops = set()
+            for op in block.ops:
+                if not _is_pure(op) or id(op) in grad_refed:
+                    continue
+                if op.type in _CTX_SENSITIVE_TYPES \
+                        or "__loss_seed__" in op.attrs:
+                    continue
+                names_in = op.input_names()
+                if any(n not in const_vals for n in names_in):
+                    continue
+                foldable = True
+                for n in op.output_names():
+                    v = block._find_var_recursive(n)
+                    if (n in sub_written or len(writes.get(n, ())) != 1
+                            or v is None or v.persistable or v.is_data):
+                        foldable = False
+                        break
+                if not foldable:
+                    continue
+                ins = {slot: [const_vals[v.name] for v in vs]
+                       for slot, vs in op.inputs.items() if vs}
+                try:
+                    with _tracing.span("fold:" + op.type):
+                        outs = registry.get(op.type).impl(ctx, ins,
+                                                          op.attrs)
+                except Exception:
+                    continue
+                vals = {}
+                for slot, vs in op.outputs.items():
+                    produced = outs.get(slot)
+                    if produced is None:
+                        continue
+                    for v, val in zip(vs, produced):
+                        arr = np.asarray(val)
+                        if arr.nbytes > _MAX_FOLD_BYTES:
+                            vals = None
+                            break
+                        vals[v.name] = arr
+                    if vals is None:
+                        break
+                if vals is None:
+                    continue
+                const_vals.update(vals)
+                const_ops.add(id(op))
+            if not const_ops:
+                return program
+
+            from .framework import Operator
+
+            # boundary values: const vars read by a non-const op. Small
+            # ones become ONE inline assign_value producing the SAME var
+            # (a module-embedded constant — consumers needing trace-time
+            # concreteness keep it, no rewiring); big ones bake as fresh
+            # persistable scope params and the readers are rewired.
+            boundary = set()
+            for op in block.ops:
+                if id(op) in const_ops:
+                    continue
+                boundary.update(n for n in op.input_names()
+                                if n in const_vals)
+            boundary |= {n for n in const_vals if n in outside}
+
+            producer = {}
+            for op in block.ops:
+                if id(op) in const_ops:
+                    for n in op.output_names():
+                        producer[n] = op
+
+            changed = False
+            baked = {}
+            for name in sorted(boundary):
+                arr = const_vals[name]
+                prod = producer[name]
+                if arr.size <= _INLINE_FOLD_ELEMS:
+                    if len(prod.output_names()) != 1:
+                        continue  # multi-output producer: leave it be
+                    if prod.type == "assign_value":
+                        # already the folded form (idempotence: a
+                        # re-optimized program must not read as changed)
+                        continue
+                    v = block.var(name)
+                    # dtype = the EVALUATED dtype: the eager evaluation
+                    # already applied jax's canonicalization (int64 ->
+                    # int32 under x64-off), so lowering re-materializes
+                    # the value with NO conversion — conversions on this
+                    # jax stage a traced op, and consumers needing a
+                    # trace-time-concrete value (tensor-array indices)
+                    # would break
+                    block.ops[block.ops.index(prod)] = Operator(
+                        block, "assign_value", inputs={},
+                        outputs={"Out": [v]},
+                        attrs={"shape": list(arr.shape),
+                               "dtype": str(arr.dtype), "values": arr})
+                    const_ops.discard(id(prod))
+                    changed = True
+                elif name not in outside and name not in targets:
+                    digest = hashlib.sha1(
+                        arr.tobytes() + repr((name, arr.shape,
+                                              str(arr.dtype))).encode()
+                    ).hexdigest()[:12]
+                    fname = "__folded__.%s.%s" % (digest, name)
+                    if not block.has_var(fname):
+                        block.create_var(name=fname, shape=arr.shape,
+                                         dtype=block.var(name).dtype,
+                                         persistable=True)
+                    scope.set(fname, np.asarray(arr))
+                    # a cached step may later run against a DIFFERENT
+                    # scope: keep the value on the program so the state
+                    # read can re-seed it (state_fallback)
+                    bake_value(program, fname, np.asarray(arr))
+                    baked[name] = block.var(fname)
+                    changed = True
+            if baked:
+                for op in block.ops:
+                    if id(op) in const_ops:
+                        continue
+                    for slot, vs in op.inputs.items():
+                        op.inputs[slot] = [baked.get(v.name, v)
+                                           for v in vs]
+            if changed:
+                _metrics.counter("compiler/ops_folded").inc(
+                    len(const_ops))
+                program._bump_version()
+            return program
+
+    @register_pass("fuse_elewise_add_act")
+    class FuseElewiseAddActPass(Pass):
+        """elementwise_add -> {relu,tanh,sigmoid} (single consumer) ->
+        one fused_elemwise_activation op — BuildStrategy.
+        fuse_elewise_add_act_ops (fuse_elewise_add_act_pass.cc parity).
+        Only trailing-broadcast adds fuse (the fused kernel applies numpy
+        broadcasting; Fluid's axis must agree) and the standalone act
+        impls are bitwise-identical to the fused functors."""
+
+        def apply(self, program, scope=None):
+            from .framework import Operator
+
+            targets = set(_fetch_targets(program) or ())
+            block = program.global_block()
+            protected = (targets | _outside_reads(program)
+                         | _outside_writes(program))
+            grad_refed = _grad_referenced_ids(program)
+            writes = _write_indices(block)
+            consumers = {}
+            for op in block.ops:
+                for n in set(op.input_names()):
+                    consumers.setdefault(n, []).append(op)
+
+            def _trailing_broadcast(add):
+                xs, ys = add.inputs.get("X", []), add.inputs.get("Y", [])
+                if len(xs) != 1 or len(ys) != 1:
+                    return False
+                axis = add.attrs.get("axis", -1)
+                if axis in (-1, None):
+                    return True
+                xsh = getattr(xs[0], "shape", None)
+                ysh = getattr(ys[0], "shape", None)
+                if xsh is None or ysh is None:
+                    return False
+                return axis == len(xsh) - len(ysh)
+
+            fused = 0
+            new_ops = list(block.ops)
+            for add in block.ops:
+                if add.type != "elementwise_add" or add not in new_ops:
+                    continue
+                if id(add) in grad_refed or not _trailing_broadcast(add):
+                    continue
+                outs = add.output_names("Out")
+                if len(outs) != 1 or outs[0] in protected \
+                        or len(writes.get(outs[0], ())) != 1:
+                    continue
+                users = consumers.get(outs[0], [])
+                if len(users) != 1 or users[0] not in new_ops:
+                    continue
+                act = users[0]
+                if act.type not in _FUSABLE_ACTS or id(act) in grad_refed:
+                    continue
+                if act.attrs or act.input_names() != outs:
+                    continue
+                act_outs = act.output_names("Out")
+                if len(act_outs) != 1 \
+                        or len(writes.get(act_outs[0], ())) != 1:
+                    continue  # rebinding: moving the def earlier unsafe
+                fop = Operator(
+                    block, "fused_elemwise_activation",
+                    inputs={"X": add.inputs["X"], "Y": add.inputs["Y"]},
+                    outputs={"Out": act.outputs["Out"],
+                             "IntermediateOut": add.outputs["Out"]},
+                    attrs={"functor_list": [act.type, "elementwise_add"],
+                           "save_intermediate_out": False})
+                new_ops[new_ops.index(add)] = fop
+                new_ops.remove(act)
+                fused += 1
+            if fused:
+                block.ops = new_ops
+                _metrics.counter("compiler/ops_fused").inc(fused)
+                program._bump_version()
+            return program
+
+    @register_pass("conv_bn_fold_baked")
+    class ConvBNFoldBakedPass(Pass):
+        """conv2d -> batch_norm(is_test) fold for compile-time clones:
+        same algebra as the `conv_bn_fold` builtin but NON-destructive —
+        folded weights/bias land in fresh content-addressed scope entries
+        and the conv is rewired to them, so the original program (which
+        still carries the bn op) keeps reading its untouched parameters."""
+
+        def apply(self, program, scope=None):
+            if scope is None:
+                return program
+            import hashlib
+
+            from .ir import match_chain
+
+            block = program.global_block()
+            protected = set(_fetch_targets(program) or ()) \
+                | _outside_reads(program)
+            changed = False
+            for conv, bn in match_chain(block, ("conv2d", "batch_norm")):
+                if not bn.attrs.get("is_test", False):
+                    continue
+                if any(n in protected for n in conv.output_names()):
+                    # the pre-bn conv output is fetched (or read by a
+                    # sub-block): rewiring it onto bn's Y would orphan
+                    # the name — match_chain only counts consuming OPS
+                    continue
+                w_name = conv.input_names("Filter")[0]
+                names = [w_name, bn.input_names("Scale")[0],
+                         bn.input_names("Bias")[0],
+                         bn.input_names("Mean")[0],
+                         bn.input_names("Variance")[0]]
+                vals = [scope.get(n) for n in names]
+                if any(v is None for v in vals):
+                    continue
+                w, gamma, beta, mean, var = [np.asarray(v) for v in vals]
+                eps = bn.attrs.get("epsilon", 1e-5)
+                factor = gamma / np.sqrt(var + eps)
+                w2 = (w * factor.reshape((-1, 1, 1, 1))).astype(w.dtype)
+                shift = (beta - mean * factor).astype(w.dtype)
+                digest = hashlib.sha1(
+                    w2.tobytes() + shift.tobytes()).hexdigest()[:12]
+                wf_name = "%s.bnfold.%s" % (w_name, digest)
+                bf_name = "%s.bnfold_bias.%s" % (w_name, digest)
+                if not block.has_var(wf_name):
+                    block.create_var(name=wf_name, shape=w2.shape,
+                                     dtype=str(w.dtype), persistable=True)
+                if not block.has_var(bf_name):
+                    block.create_var(name=bf_name, shape=shift.shape,
+                                     dtype=str(shift.dtype),
+                                     persistable=True)
+                scope.set(wf_name, w2)
+                scope.set(bf_name, shift)
+                bake_value(program, wf_name, w2)
+                bake_value(program, bf_name, shift)
+                conv.inputs["Filter"] = [block.var(wf_name)]
+                conv.inputs["FoldedBias"] = [block.var(bf_name)]
+                conv.outputs["Output"] = bn.outputs["Y"]
+                block.ops.remove(bn)
+                _metrics.counter("compiler/ops_fused").inc()
+                changed = True
+            if changed:
+                program._bump_version()
+            return program
+
+    return True
+
+
+_register_builtin_passes()

@@ -98,9 +98,13 @@ def _assign(ctx, ins, attrs):
 
 @register("assign_value", differentiable=False)
 def _assign_value(ctx, ins, attrs):
+    # returned as a host numpy array (the fill_constant convention):
+    # jnp.asarray under an active trace stages a device_put and the
+    # value becomes a Tracer, breaking consumers that need a trace-time
+    # concrete value (tensor-array indices, static bounds)
     dt = np_dtype(attrs.get("dtype", "float32"))
     vals = np.asarray(attrs["values"], dtype=dt).reshape(attrs["shape"])
-    return {"Out": [jnp.asarray(vals)]}
+    return {"Out": [vals]}
 
 
 @simple_op("shape", differentiable=False)

@@ -141,10 +141,14 @@ do_bench_smoke() {
   rm -f "$dump"
   JAX_PLATFORMS=cpu PTPU_METRICS=1 \
     python bench.py --tiny --metrics-out "$dump"
+  # compiler/ops_removed + ops_fused: the compile-time pass pipeline
+  # (docs/COMPILER_PASSES.md) fired on the bench program's receipt ops
   python tools/ptpu_stats.py "$dump" \
     --assert-has feed/h2d_bytes bench/step_time_async \
                  bench/step_time_sync executor/step_time \
-    --assert-min exec/inflight_steps=2
+                 compiler/ops_removed bench/compile_time_s_noopt \
+    --assert-min exec/inflight_steps=2 compiler/ops_removed=1 \
+                 compiler/ops_fused=1
 }
 
 case "$stage" in
