@@ -73,6 +73,8 @@ from . import communicator  # noqa: F401
 from . import debugger  # noqa: F401
 from . import install_check  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import resilience  # noqa: F401
+from .resilience import ResilientTrainer  # noqa: F401
 from .reader import batch  # noqa: F401  (top-level paddle.batch parity)
 
 

@@ -47,6 +47,7 @@ import threading
 import numpy as np
 
 from .observability import metrics as _metrics
+from .observability import tracing as _tracing
 
 __all__ = ["LazyFetchList", "InflightWindow", "FeedPrefetcher",
            "DeferredWarns", "as_numpy", "prefetch_iter",
@@ -111,9 +112,15 @@ class InflightWindow:
         _metrics.gauge("exec/inflight_steps").set(len(self._pending))
 
     def drain(self):
-        """Block until every admitted step has materialized."""
-        while self._pending:
-            _materialize(self._pending.pop(0))
+        """Block until every admitted step has materialized — the sync
+        point behind Executor.sync(), resilience's preemption drain, and
+        pre-checkpoint quiesce (docs/RESILIENCE.md)."""
+        if not self._pending:
+            return
+        _metrics.counter("exec/window_drains").inc()
+        with _tracing.span("window_drain", depth=len(self._pending)):
+            while self._pending:
+                _materialize(self._pending.pop(0))
 
     def reset(self):
         """Forget admitted steps without blocking — for callers that just

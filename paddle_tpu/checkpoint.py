@@ -8,12 +8,48 @@ this module checkpoints an arbitrary jax pytree — including
 NamedSharding'd arrays from an SPMD mesh — via orbax, so every host writes
 only its shards and restore re-shards onto the current mesh. Works for
 single-chip state too.
+
+Crash safety (docs/RESILIENCE.md): every save is ATOMIC — the orbax tree
+and a `ptpu_manifest.json` of per-leaf content digests are written into a
+hidden temp dir, fsynced, and `os.rename`d into place, so a crash mid-save
+can never leave a `step_N` that `latest_checkpoint` would hand back.
+Restore verifies the digests and — when pointed at a directory — falls
+back to the newest INTACT step, counting what it skipped in
+`resilience/ckpt_corrupt_detected`. `CheckpointManager(async_save=True)`
+writes on a background thread from a host copy taken synchronously, so
+donated device buffers can't be torn by the next step.
+
+Layout (one step):
+    directory/step_N/ptpu_manifest.json   digests + leaf inventory
+    directory/step_N/data/...             the orbax pytree checkpoint
+Legacy step dirs (orbax files directly under step_N, no manifest) still
+restore when named explicitly, but are treated as torn by directory-level
+scans — a manifest is the completeness marker.
 """
 
+import hashlib
+import json
 import os
+import shutil
+import threading
+
+import numpy as np
+
+from .observability import metrics as _metrics
+from .observability import tracing as _tracing
 
 __all__ = ["save_checkpoint", "restore_checkpoint", "latest_checkpoint",
-           "CheckpointManager"]
+           "all_checkpoints", "CheckpointManager",
+           "CheckpointCorruptionError", "MANIFEST_NAME"]
+
+MANIFEST_NAME = "ptpu_manifest.json"
+_DATA_SUBDIR = "data"
+_TMP_PREFIX = ".ptpu_tmp_"
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """A checkpoint failed digest verification (torn write, bit rot) or
+    its payload cannot be deserialized."""
 
 
 def _checkpointer():
@@ -22,87 +58,315 @@ def _checkpointer():
     return ocp.PyTreeCheckpointer()
 
 
+def _norm_path(path):
+    """Keypath -> stable tuple of strings (sequence indices and dict/attr
+    keys normalized), shared by digest manifests and target placement so
+    orbax's loose container round-trip (tuples come back as lists) cannot
+    desynchronize them."""
+    out = []
+    for k in path:
+        if hasattr(k, "idx"):
+            out.append(str(k.idx))
+        elif hasattr(k, "key"):
+            out.append(str(k.key))
+        elif hasattr(k, "name"):
+            out.append(str(k.name))
+        else:
+            out.append(str(k))
+    return tuple(out)
+
+
+def _leaf_digest(leaf):
+    """sha256 over a leaf's host bytes + dtype + shape, or None when the
+    leaf is not fully addressable from this host (multi-host shards: the
+    local view would hash differently per process)."""
+    if leaf is None:
+        return None
+    addressable = getattr(leaf, "is_fully_addressable", True)
+    if not addressable:
+        return None
+    try:
+        arr = np.asarray(leaf)
+    except (TypeError, ValueError):
+        return None
+    h = hashlib.sha256()
+    h.update(str(arr.dtype).encode())
+    h.update(repr(arr.shape).encode())
+    h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()
+
+
+def _flatten_with_keys(tree):
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [("/".join(_norm_path(p)), leaf) for p, leaf in flat]
+
+
+def _write_manifest(path, state, step):
+    digests = {key: _leaf_digest(leaf)
+               for key, leaf in _flatten_with_keys(state)}
+    # file inventory (relpath -> size): lets directory scans detect a
+    # truncation-torn payload with a handful of stat calls — full digest
+    # verification stays a restore-time concern
+    files = {}
+    for root, _dirs, names in os.walk(path):
+        for name in names:
+            if name == MANIFEST_NAME:
+                continue
+            p = os.path.join(root, name)
+            files[os.path.relpath(p, path)] = os.path.getsize(p)
+    doc = {"format": 1, "step": int(step), "digests": digests,
+           "files": files}
+    mpath = os.path.join(path, MANIFEST_NAME)
+    with open(mpath, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    return doc
+
+
+def _read_manifest(path):
+    mpath = os.path.join(path, MANIFEST_NAME)
+    if not os.path.isfile(mpath):
+        return None
+    try:
+        with open(mpath) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _files_intact(path, manifest):
+    """Cheap (stat-only) truncation check of the manifest's file
+    inventory. Manifests without one (older format) pass — digest
+    verification at restore still covers them."""
+    files = manifest.get("files")
+    if not files:
+        return True
+    for rel, size in files.items():
+        p = os.path.join(path, rel)
+        try:
+            if os.path.getsize(p) != int(size):
+                return False
+        except OSError:
+            return False
+    return True
+
+
+def _orbax_path(step_path):
+    data = os.path.join(step_path, _DATA_SUBDIR)
+    # legacy (pre-manifest) checkpoints hold the orbax tree directly
+    return data if os.path.isdir(data) else step_path
+
+
+def _fsync_dir(path):
+    try:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:
+        pass  # fsync on a dir is best-effort (not all filesystems)
+
+
+def _maybe_tear(step_path):
+    """`ckpt_torn_write` fault injection: after a save lands, corrupt its
+    largest payload file in place — the torn write the digest manifest
+    exists to catch. Routed through the global injector so the hook costs
+    one predicate when injection is off."""
+    from .resilience import global_injector
+
+    if not global_injector().fire_occurrence("ckpt_torn_write"):
+        return
+    for root, _dirs, files in os.walk(step_path):
+        for name in files:
+            if name == MANIFEST_NAME:
+                continue
+            p = os.path.join(root, name)
+            with open(p, "r+b") as f:
+                data = f.read()
+                if not data:
+                    continue
+                f.seek(0)
+                f.write(bytes(b ^ 0xFF
+                              for b in data[: max(1, len(data) // 2)]))
+                f.truncate(max(1, len(data) // 2))
+
+
+def _dist_info():
+    """(process_index, process_count) — (0, 1) when jax is absent or
+    uninitialized."""
+    try:
+        import jax
+
+        return jax.process_index(), jax.process_count()
+    except Exception:
+        return 0, 1
+
+
 def save_checkpoint(directory, state, step):
-    """Write `state` (any jax pytree, sharded arrays included) under
-    directory/step_N. Returns the checkpoint path."""
-    path = os.path.join(os.path.abspath(directory), "step_%d" % int(step))
-    _checkpointer().save(path, state, force=True)
-    return path
+    """Atomically write `state` (any jax pytree, sharded arrays included)
+    under directory/step_N: orbax tree + digest manifest land in a temp
+    dir first, then one rename publishes the step. Under jax.distributed
+    (process_count > 1) every process must participate in ONE coordinated
+    orbax save, so per-host tmp+rename cannot work; there the tree is
+    written in place and the manifest — written LAST, by process 0 — is
+    the publish/completeness marker instead. Returns the checkpoint
+    path."""
+    directory = os.path.abspath(directory)
+    final = os.path.join(directory, "step_%d" % int(step))
+    pidx, pcount = _dist_info()
+    os.makedirs(directory, exist_ok=True)
+    with _tracing.span("checkpoint/save", step=int(step)):
+        if pcount > 1:
+            # drop any stale manifest first: while the payload is being
+            # rewritten the step must read as incomplete
+            mpath = os.path.join(final, MANIFEST_NAME)
+            if pidx == 0 and os.path.isfile(mpath):
+                os.remove(mpath)
+            _checkpointer().save(os.path.join(final, _DATA_SUBDIR),
+                                 state, force=True)
+            if pidx == 0:
+                _write_manifest(final, state, step)
+                _fsync_dir(directory)
+        else:
+            tmp = os.path.join(directory,
+                               _TMP_PREFIX + "step_%d" % int(step))
+            shutil.rmtree(tmp, ignore_errors=True)
+            _checkpointer().save(os.path.join(tmp, _DATA_SUBDIR), state,
+                                 force=True)
+            _write_manifest(tmp, state, step)
+            aside = None
+            if os.path.isdir(final):
+                # overwriting the same step must stay atomic: park the
+                # old dir aside first — rmtree-then-rename would leave
+                # NO intact step_N if the process dies in between. A
+                # crash between the two renames is healed by
+                # _reap_stale_tmp's journal replay.
+                aside = tmp + "_old"
+                shutil.rmtree(aside, ignore_errors=True)
+                os.rename(final, aside)
+            os.rename(tmp, final)
+            _fsync_dir(directory)
+            if aside is not None:
+                shutil.rmtree(aside, ignore_errors=True)
+    _metrics.counter("resilience/ckpt_saves").inc()
+    if pidx == 0:
+        _maybe_tear(final)
+    return final
+
+
+def _scan_steps(directory, level="intact"):
+    """[(step, path)] newest first, filtered by `level`:
+      "all"      every step_N dir
+      "manifest" steps with a manifest (the completeness marker a crash
+                 mid-save never writes) — restore-candidate set: a
+                 size-torn step is TRIED so its corruption is counted
+      "intact"   manifest present AND file inventory passes the stat
+                 check — what latest_checkpoint hands back and what GC
+                 retention counts"""
+    directory = os.path.abspath(directory)
+    if not os.path.isdir(directory):
+        return []
+    out = []
+    for name in os.listdir(directory):
+        if not name.startswith("step_"):
+            continue
+        try:
+            step = int(name.split("_", 1)[1])
+        except ValueError:
+            continue
+        path = os.path.join(directory, name)
+        if level != "all":
+            manifest = _read_manifest(path)
+            if manifest is None:
+                continue
+            if level == "intact" and not _files_intact(path, manifest):
+                continue
+        out.append((step, path))
+    out.sort(reverse=True)
+    return out
+
+
+def all_checkpoints(directory):
+    """Intact (manifest present, file inventory passing) step numbers
+    under directory, ascending."""
+    return sorted(step for step, _ in _scan_steps(directory))
 
 
 def latest_checkpoint(directory):
-    """Most recent step_N path under directory, or None."""
-    directory = os.path.abspath(directory)
-    if not os.path.isdir(directory):
-        return None
-    steps = []
-    for name in os.listdir(directory):
-        if name.startswith("step_"):
-            try:
-                steps.append(int(name.split("_", 1)[1]))
-            except ValueError:
-                continue
-    if not steps:
-        return None
-    return os.path.join(directory, "step_%d" % max(steps))
+    """Most recent INTACT step_N path under directory, or None. Steps
+    without a digest manifest (a crash mid-save, a foreign writer) are
+    skipped — handing back a torn directory is how a dead run stays
+    dead."""
+    steps = _scan_steps(directory)
+    return steps[0][1] if steps else None
 
 
-def restore_checkpoint(directory_or_path, target_state=None):
-    """Restore a pytree checkpoint. With `target_state` (an abstract or
-    concrete pytree of the expected structure/shardings — e.g. the fresh
-    `trainer.init()` output) the restored arrays are placed to match it;
-    without, the stored structure is returned as saved. `directory_or_path`
-    may be the checkpoint dir (latest step is used) or a step path."""
-    path = directory_or_path
-    if not os.path.basename(path).startswith("step_"):
-        latest = latest_checkpoint(path)
-        if latest is None:
-            raise FileNotFoundError("no step_N checkpoints under %r" % path)
-        path = latest
-    ckpt = _checkpointer()
-    raw = ckpt.restore(path)
-    if target_state is None:
-        return raw
+def _verify_digests(path, raw):
+    """Compare the restored tree's per-leaf digests against the manifest;
+    raises CheckpointCorruptionError naming the first mismatch. Legacy
+    checkpoints (no manifest) pass through unverified."""
+    manifest = _read_manifest(path)
+    if manifest is None:
+        return
+    want = manifest.get("digests", {})
+    got = dict(_flatten_with_keys(raw))
+    if set(want) != set(got):
+        raise CheckpointCorruptionError(
+            "checkpoint %s leaf inventory mismatch: manifest has %d "
+            "leaves, payload has %d" % (path, len(want), len(got)))
+    for key, digest in want.items():
+        if digest is None:
+            continue  # leaf was not addressable at save time
+        actual = _leaf_digest(got[key])
+        if actual != digest:
+            raise CheckpointCorruptionError(
+                "checkpoint %s leaf %r failed digest verification "
+                "(torn write or corruption)" % (path, key))
+
+
+def _restore_step(path, verify=True):
+    if verify:
+        manifest = _read_manifest(path)
+        if manifest is not None and not _files_intact(path, manifest):
+            raise CheckpointCorruptionError(
+                "checkpoint %s payload files do not match the manifest "
+                "inventory (truncated/torn write)" % path)
+    try:
+        raw = _checkpointer().restore(_orbax_path(path))
+    except CheckpointCorruptionError:
+        raise
+    except Exception as exc:  # orbax deserialization of a torn payload
+        raise CheckpointCorruptionError(
+            "checkpoint %s failed to deserialize: %s" % (path, exc))
+    if verify:
+        _verify_digests(path, raw)
+    return raw
+
+
+def _place_like(raw, target_state):
+    """Place restored leaves onto `target_state`'s structure/shardings —
+    keypath-matched (see _norm_path) so renamed/reordered same-shape
+    weights fail loudly instead of restoring into the wrong slots."""
     import jax
-    import numpy as np
 
-    # orbax round-trips containers loosely (tuples come back as lists), so
-    # match by keypath — with sequence indices and dict/attr keys
-    # normalized to plain strings, stable across that transformation — and
-    # place each leaf onto the target's sharding (device_put with a
-    # NamedSharding re-shards onto the current mesh). Shape alone is not
-    # enough: many transformer weights share a shape, and a silent
-    # order-based match would restore renamed/reordered keys into the
-    # wrong slots.
     raw_paths = jax.tree_util.tree_flatten_with_path(raw)[0]
     t_paths, treedef = jax.tree_util.tree_flatten_with_path(target_state)
     if len(raw_paths) != len(t_paths):
         raise ValueError(
             "checkpoint has %d leaves but target_state has %d"
             % (len(raw_paths), len(t_paths)))
-
-    def _norm(path):
-        out = []
-        for k in path:
-            if hasattr(k, "idx"):
-                out.append(str(k.idx))
-            elif hasattr(k, "key"):
-                out.append(str(k.key))
-            elif hasattr(k, "name"):
-                out.append(str(k.name))
-            else:
-                out.append(str(k))
-        return tuple(out)
-
-    raw_by_key = {_norm(p): leaf for p, leaf in raw_paths}
+    raw_by_key = {_norm_path(p): leaf for p, leaf in raw_paths}
     raw_leaves, t_leaves = [], []
     for p, t in t_paths:
-        key = _norm(p)
+        key = _norm_path(p)
         if key not in raw_by_key:
             raise ValueError(
                 "target_state leaf %r not found in checkpoint (checkpoint "
-                "keys: %s...)" % ("/".join(key),
-                                  sorted(raw_by_key)[:8]))
+                "keys: %s...)" % ("/".join(key), sorted(raw_by_key)[:8]))
         raw_leaves.append(raw_by_key[key])
         t_leaves.append(t)
     placed = []
@@ -122,39 +386,221 @@ def restore_checkpoint(directory_or_path, target_state=None):
     return jax.tree.unflatten(treedef, placed)
 
 
+def restore_checkpoint(directory_or_path, target_state=None, verify=True):
+    """Restore a pytree checkpoint with digest verification. With
+    `target_state` (an abstract or concrete pytree of the expected
+    structure/shardings — e.g. the fresh `trainer.init()` output) the
+    restored arrays are placed to match it; without, the stored structure
+    is returned as saved.
+
+    `directory_or_path` may be a step path (one attempt; corruption
+    raises CheckpointCorruptionError) or the checkpoint dir — there,
+    steps are tried newest-intact first and corrupt ones are skipped with
+    a warning + `resilience/ckpt_corrupt_detected`, so one torn write
+    costs one checkpoint interval, not the run."""
+    path = directory_or_path
+    if os.path.basename(path).startswith("step_"):
+        raw = _restore_step(path, verify=verify)
+        return raw if target_state is None else _place_like(raw,
+                                                            target_state)
+    # candidate set is manifest-bearing steps (not just size-intact
+    # ones): a size-torn step must be TRIED and FAIL so its corruption
+    # is warned about and counted, not silently ignored. Manifest-less
+    # dirs (the pre-manifest writer's format — the atomic tmp+rename
+    # writer never publishes a step without one) are last-resort
+    # candidates, so upgrading an existing run still resumes.
+    manifested = _scan_steps(path, level="manifest")
+    seen = {p for _s, p in manifested}
+    legacy = [(s, p) for s, p in _scan_steps(path, level="all")
+              if p not in seen]
+    if not manifested and not legacy:
+        raise FileNotFoundError("no step_N checkpoints under %r" % path)
+    last_exc = None
+    for is_legacy, step, step_path in (
+            [(False, s, p) for s, p in manifested]
+            + [(True, s, p) for s, p in legacy]):
+        try:
+            with _tracing.span("checkpoint/restore", step=step):
+                raw = _restore_step(step_path, verify=verify)
+        except CheckpointCorruptionError as exc:
+            last_exc = exc
+            _metrics.counter("resilience/ckpt_corrupt_detected").inc()
+            import warnings
+
+            warnings.warn(
+                "skipping corrupt checkpoint %s: %s" % (step_path, exc),
+                RuntimeWarning)
+            continue
+        if is_legacy:
+            import warnings
+
+            warnings.warn(
+                "restored pre-manifest checkpoint %s (no digest "
+                "verification possible)" % step_path, RuntimeWarning)
+        return (raw if target_state is None
+                else _place_like(raw, target_state))
+    raise CheckpointCorruptionError(
+        "every checkpoint under %r is corrupt (last: %s)"
+        % (path, last_exc))
+
+
 class CheckpointManager:
     """Rolling checkpoint manager (keep the newest `max_to_keep`) — the
     coordinated-snapshot shape of §5.3's checkpoint_notify flow, minus the
     pserver RPC: under jax.distributed every process participates in the
-    same orbax save."""
+    same orbax save.
 
-    def __init__(self, directory, max_to_keep=3):
+    `async_save=True` moves the filesystem write to a background thread:
+    `save` first copies every leaf to host memory IN THE CALLER (that is
+    the consistency point — the next step may donate the very buffers
+    being saved), then returns while the orbax write + manifest + rename
+    run behind. At most one save is in flight; `wait()` (or the next
+    `save`) joins it and re-raises any background failure."""
+
+    def __init__(self, directory, max_to_keep=3, async_save=False):
         self.directory = os.path.abspath(directory)
         self.max_to_keep = max_to_keep
+        self.async_save = bool(async_save)
+        self._thread = None
+        self._error = None
         os.makedirs(self.directory, exist_ok=True)
+        self._reap_stale_tmp()
 
-    def save(self, state, step):
-        path = save_checkpoint(self.directory, state, step)
-        self._gc()
-        return path
+    def _reap_stale_tmp(self):
+        """Journal replay for a writer that died mid-publish. A complete
+        tmp dir (manifest present) whose step_N is missing finishes its
+        crashed rename; an `_old` aside whose step_N is missing is the
+        pre-overwrite original and is restored; everything else from a
+        crashed writer is dead weight and reclaimed. Only process 0 may
+        touch shared temp state under jax.distributed."""
+        if _dist_info()[0] != 0:
+            return
+        asides = []
+        for name in sorted(os.listdir(self.directory)):
+            if not name.startswith(_TMP_PREFIX):
+                continue
+            path = os.path.join(self.directory, name)
+            target = name[len(_TMP_PREFIX):]
+            if target.endswith("_old"):
+                asides.append((path, target[:-len("_old")]))
+                continue
+            final = os.path.join(self.directory, target)
+            if (target.startswith("step_")
+                    and not os.path.isdir(final)
+                    and _read_manifest(path) is not None):
+                os.rename(path, final)  # finish the crashed publish
+            else:
+                shutil.rmtree(path, ignore_errors=True)
+        for path, target in asides:
+            final = os.path.join(self.directory, target)
+            if target.startswith("step_") and not os.path.isdir(final):
+                os.rename(path, final)  # restore the parked original
+            else:
+                shutil.rmtree(path, ignore_errors=True)
+
+    def _host_state(self, state):
+        """(host_copy, all_addressable). Multi-host shards cannot be
+        copied to one host — the caller must fall back to a blocking
+        save rather than let the background write race donation."""
+        import jax
+
+        holdouts = []
+
+        def copy_leaf(leaf):
+            addressable = getattr(leaf, "is_fully_addressable", True)
+            if not addressable:
+                holdouts.append(leaf)
+                return leaf
+            if hasattr(leaf, "dtype"):
+                return np.array(leaf)  # forced copy off device buffers
+            return leaf
+
+        copied = jax.tree.map(copy_leaf, state)
+        return copied, not holdouts
+
+    def save(self, state, step, blocking=None, host_copied=False):
+        """Write one checkpoint and GC old steps. Returns the final path
+        (async saves return it even though the write is still landing —
+        `wait()` before depending on it). `host_copied=True` promises
+        `state` is already a private host copy (e.g. a resilience
+        ScopeSnapshot), skipping the defensive per-leaf copy."""
+        self.wait()
+        if blocking is None:
+            blocking = not self.async_save
+        final = os.path.join(self.directory, "step_%d" % int(step))
+        if not blocking and not host_copied:
+            state, all_addressable = self._host_state(state)
+            if not all_addressable:
+                # non-addressable shards stayed live device arrays; a
+                # background write would race the next step's donation
+                import warnings
+
+                warnings.warn(
+                    "checkpoint state holds non-fully-addressable "
+                    "shards; saving step %d synchronously" % int(step),
+                    RuntimeWarning)
+                blocking = True
+        if blocking:
+            save_checkpoint(self.directory, state, step)
+            self._gc()
+            return final
+        host_state = state
+
+        def _write():
+            try:
+                save_checkpoint(self.directory, host_state, step)
+                self._gc()
+            except BaseException as exc:  # surfaced by wait()
+                self._error = exc
+
+        self._thread = threading.Thread(
+            target=_write, name="ptpu-ckpt-save", daemon=True)
+        self._thread.start()
+        return final
+
+    def wait(self):
+        """Join the in-flight async save (if any); re-raises a background
+        write failure here, in the caller's thread."""
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+        if self._error is not None:
+            exc, self._error = self._error, None
+            raise exc
 
     def restore(self, target_state=None):
+        """Newest-intact-first restore with corruption fallback (see
+        restore_checkpoint)."""
+        self.wait()
         return restore_checkpoint(self.directory, target_state)
 
+    def latest_step(self):
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
     def all_steps(self):
-        steps = []
-        for name in os.listdir(self.directory):
-            if name.startswith("step_"):
-                try:
-                    steps.append(int(name.split("_", 1)[1]))
-                except ValueError:
-                    continue
-        return sorted(steps)
+        return all_checkpoints(self.directory)
 
     def _gc(self):
-        import shutil
-
-        steps = self.all_steps()
-        for step in steps[:-self.max_to_keep] if self.max_to_keep else []:
-            shutil.rmtree(os.path.join(self.directory, "step_%d" % step),
-                          ignore_errors=True)
+        if not self.max_to_keep:
+            return
+        # retention is counted over INTACT steps only — a torn step must
+        # never push an intact fallback out of the quota (with
+        # max_to_keep=1, intact N then torn M would otherwise delete N
+        # and leave the run unrecoverable). Non-intact dirs (fault-torn,
+        # or the pre-manifest writer's legacy format, both still restore
+        # fallbacks) are reclaimed only once a full quota of NEWER
+        # intact steps exists to fall back to instead.
+        intact = _scan_steps(self.directory)  # newest first
+        keep = {path for _s, path in intact[:self.max_to_keep]}
+        intact_paths = {path for _s, path in intact}
+        intact_steps = [s for s, _p in intact]
+        for step, path in _scan_steps(self.directory, level="all"):
+            if path in keep:
+                continue
+            if path not in intact_paths:
+                newer_intact = sum(1 for s in intact_steps if s > step)
+                if newer_intact < self.max_to_keep:
+                    continue
+            shutil.rmtree(path, ignore_errors=True)

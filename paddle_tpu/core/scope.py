@@ -72,6 +72,12 @@ class Scope:
     def local_var_names(self):
         return list(self._vars)
 
+    def items(self):
+        """This scope's OWN (name, value) bindings — the state surface
+        resilience.snapshot_scope copies to host for rollback/checkpoint
+        (ancestor bindings belong to their owning scope's snapshot)."""
+        return list(self._vars.items())
+
     def __contains__(self, name):
         return self.has(name)
 

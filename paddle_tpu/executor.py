@@ -481,6 +481,13 @@ class Executor:
         with _observability.step_scope():
             compiled = self._cache.get(key) if use_program_cache else None
             if compiled is None:
+                # fault-injection hook (docs/RESILIENCE.md): the
+                # `transient_compile` site raises a retryable error here
+                # so the rollback-and-retry path is testable without a
+                # real allocator failure
+                from .resilience import maybe_inject_compile_fault
+
+                maybe_inject_compile_fault()
                 if rec:
                     _metrics.counter("compile_cache/miss").inc()
                 # thread OUR fingerprint through the on-disk cache: the

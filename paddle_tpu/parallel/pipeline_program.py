@@ -246,7 +246,13 @@ class _ResidLayout:
                 # stashing N in-flight fp32 copies of the weights
                 self.records.append(("rebind", tuple(shape), d, ref))
                 continue
-            if np.issubdtype(d, np.inexact) or d == jnp.bfloat16:
+            if d == jax.dtypes.float0:
+                # float0 cotangent placeholders (integer/bool primals in
+                # the vjp) carry no bytes — strip them from the stash and
+                # re-materialize zeros at unpack, the same treatment
+                # core/lowering.py and dygraph/base.py give float0 grads
+                kind = "float0"
+            elif np.issubdtype(d, np.inexact) or d == jnp.bfloat16:
                 kind = "f"
             elif d.kind in "iub" and d.itemsize == 4:
                 kind = "bitcast"
@@ -265,7 +271,7 @@ class _ResidLayout:
     def pack(self, leaves, nf_max, ni_max):
         fparts, iparts = [], []
         for leaf, (kind, s, d, _) in zip(leaves, self.records):
-            if kind == "rebind":
+            if kind in ("rebind", "float0"):
                 continue
             if kind == "f":
                 fparts.append(leaf.astype(jnp.float32).reshape(-1))
@@ -290,6 +296,9 @@ class _ResidLayout:
         for kind, s, d, ref in self.records:
             if kind == "rebind":
                 leaves.append(sources[ref[0]][ref[1]])
+                continue
+            if kind == "float0":
+                leaves.append(np.zeros(s, dtype=jax.dtypes.float0))
                 continue
             k = _numel(s)
             if kind == "f":

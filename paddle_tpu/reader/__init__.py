@@ -233,7 +233,7 @@ class PyReader:
     batches through a background thread into the executor feed."""
 
     def __init__(self, feed_list=None, capacity=16, use_double_buffer=True,
-                 iterable=True, return_list=False):
+                 iterable=True, return_list=False, worker_restarts=0):
         self._feed_list = feed_list
         self._capacity = capacity
         self._iterable = iterable
@@ -241,6 +241,11 @@ class PyReader:
         self._places = None
         self._feeder = None
         self._use_double_buffer = use_double_buffer
+        # bounded worker-restart budget: a generator that raises is
+        # re-invoked from scratch up to this many times before the
+        # exception is forwarded to the consumer (docs/RESILIENCE.md)
+        self._worker_restarts = int(worker_restarts)
+        self._stage_warned = False
         # buddy-allocator staging pool (native/allocator.cc, C19): batches
         # are copied into arena-backed buffers before the async device_put
         self._arena = None
@@ -298,13 +303,42 @@ class PyReader:
         q = _queue.Queue(self._capacity)
         end = object()
 
+        class _WorkerFailure:
+            """Sentinel carrying the worker's exception (with its
+            original traceback) to the consumer thread — a parse error
+            must raise at next(), not silently end (or hang) the
+            stream."""
+
+            def __init__(self, exc):
+                self.exc = exc
+
         def worker():
-            for sample_list in self._generator():
-                if self._feeder is not None:
-                    q.put(self._feeder.feed(sample_list))
-                else:
-                    q.put(sample_list)
-            q.put(end)
+            restarts_left = self._worker_restarts
+            while True:
+                try:
+                    for sample_list in self._generator():
+                        if self._feeder is not None:
+                            q.put(self._feeder.feed(sample_list))
+                        else:
+                            q.put(sample_list)
+                    q.put(end)
+                    return
+                except Exception as exc:  # forwarded to the consumer
+                    if restarts_left > 0:
+                        restarts_left -= 1
+                        _obs_metrics.counter(
+                            "reader/worker_restarts").inc()
+                        import warnings
+
+                        warnings.warn(
+                            "PyReader worker raised %r — restarting "
+                            "generator FROM SCRATCH (%d restarts left); "
+                            "batches already delivered before the "
+                            "failure will repeat" % (exc, restarts_left),
+                            RuntimeWarning)
+                        continue
+                    q.put(_WorkerFailure(exc))
+                    return
 
         t = threading.Thread(target=worker)
         t.daemon = True
@@ -322,6 +356,13 @@ class PyReader:
             item = q.get()
             if item is end:
                 break
+            if isinstance(item, _WorkerFailure):
+                # deliver the already-staged good batch first, then
+                # re-raise in the consumer with the worker's traceback
+                if pending is not None:
+                    yield pending
+                    pending = None
+                raise item.exc
             if rec:
                 # batch-wait is the starvation signal: high wait + low
                 # queue depth means the host parse can't keep the device
@@ -339,8 +380,15 @@ class PyReader:
             yield pending
 
     def _stage(self, item, depth=0):
-        if not self._use_double_buffer:
+        if not self._use_double_buffer or not isinstance(item, dict):
             return item
+        from ..executor import check_feed_int64
+
+        # the int64-truncation guard is a USER error — raise it here with
+        # the batch in hand rather than letting the staging fallback
+        # below swallow it and the executor rediscover it a step later
+        for k, v in item.items():
+            check_feed_int64(k, v)
         try:
             import jax
 
@@ -359,9 +407,6 @@ class PyReader:
                 sharding_fn = self._sharding_fn
 
                 def _one(k, v):
-                    from ..executor import check_feed_int64
-
-                    check_feed_int64(k, v)
                     staged = self._arena.stage(k, v)
                     sh = (sharding_fn(k, staged)
                           if sharding_fn is not None else None)
@@ -378,8 +423,20 @@ class PyReader:
                         _nbytes(out.values()))
                     _obs_metrics.gauge("feed/prefetch_depth").set(depth)
                 return out
-        except Exception:
-            pass
+        except Exception as exc:
+            # staging infrastructure failure (native arena absent, an
+            # exotic value device_put rejects): fall back to the host
+            # batch — the step still runs — but never silently: warn once
+            # and count, so a run that quietly lost its double buffer is
+            # visible in the metrics dump
+            _obs_metrics.counter("reader/stage_fallbacks").inc()
+            if not self._stage_warned:
+                self._stage_warned = True
+                import warnings
+
+                warnings.warn(
+                    "PyReader double-buffer staging failed (%r); feeding "
+                    "host batches directly" % (exc,), RuntimeWarning)
         return item
 
     def staging_stats(self):

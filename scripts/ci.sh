@@ -11,7 +11,11 @@
 #   bench-smoke- tiny-model bench.py --metrics-out run asserting the async
 #                pipeline telemetry (in-flight window, prefetch H2D) lands
 #                in the dump
-# Usage: scripts/ci.sh [build|test|api_check|bench|bench-smoke|stress|obs|all]
+#   chaos      - fault-injected fit-a-line train (NaN step + torn
+#                checkpoint, docs/RESILIENCE.md): gates on
+#                resilience/rollbacks >= 1, corrupt-checkpoint fallback,
+#                and final-loss sanity via ptpu_stats --assert-max
+# Usage: scripts/ci.sh [build|test|api_check|bench|bench-smoke|stress|obs|chaos|all]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -143,12 +147,99 @@ do_bench_smoke() {
     python bench.py --tiny --metrics-out "$dump"
   # compiler/ops_removed + ops_fused: the compile-time pass pipeline
   # (docs/COMPILER_PASSES.md) fired on the bench program's receipt ops
+  # bench/step_time_guarded|unguarded: the resilience-overhead leg ran
+  # and recorded the guard's measured cost (docs/RESILIENCE.md)
   python tools/ptpu_stats.py "$dump" \
     --assert-has feed/h2d_bytes bench/step_time_async \
                  bench/step_time_sync executor/step_time \
                  compiler/ops_removed bench/compile_time_s_noopt \
+                 bench/step_time_guarded bench/step_time_unguarded \
+                 bench/guard_overhead_pct \
     --assert-min exec/inflight_steps=2 compiler/ops_removed=1 \
                  compiler/ops_fused=1
+}
+
+do_chaos() {
+  # resilience receipt (docs/RESILIENCE.md): a short fit-a-line train
+  # survives an injected NaN step AND a torn newest checkpoint. The
+  # trainer must roll back and retry (resilience/rollbacks), restore must
+  # detect the torn step and fall back to the intact one
+  # (resilience/ckpt_corrupt_detected), and the final loss must match a
+  # healthy run (--assert-max chaos/final_loss).
+  local dump=/tmp/ptpu_chaos_metrics.json ckdir=/tmp/ptpu_chaos_ckpt
+  rm -rf "$dump" "$ckdir"
+  # nan_at_step:12 poisons one mid-training batch; ckpt_torn_write:2
+  # tears the SECOND save — with checkpoint_every=60 over 120 steps the
+  # saves land at the step-65 boundary (occurrence 1, intact) and the
+  # final step-121 blocking save (occurrence 2, torn), so restore must
+  # fall back across the newest step
+  JAX_PLATFORMS=cpu PTPU_METRICS=1 PTPU_METRICS_OUT="$dump" \
+    PTPU_ANOMALY_POLICY=rollback PTPU_RETRY_BACKOFF=0 \
+    PTPU_FAULT_INJECT="nan_at_step:12,ckpt_torn_write:2" \
+    python - "$ckdir" <<'PYEOF'
+import sys
+import warnings
+
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import checkpoint
+from paddle_tpu.observability import metrics as obs
+
+ckdir = sys.argv[1]
+x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+pred = fluid.layers.fc(input=x, size=1)
+loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+fluid.optimizer.SGD(0.05).minimize(loss)
+exe = fluid.Executor(fluid.CPUPlace())
+exe.run(fluid.default_startup_program())
+
+rng = np.random.RandomState(0)
+xs = rng.uniform(-1, 1, (256, 13)).astype(np.float32)
+w = rng.uniform(-2, 2, (13, 1)).astype(np.float32)
+ys = (xs @ w + 0.5).astype(np.float32)
+
+
+def batches(epochs=30, batch=64):
+    for _ in range(epochs):
+        for i in range(0, len(xs), batch):
+            yield {"x": xs[i:i + batch], "y": ys[i:i + batch]}
+
+
+trainer = fluid.ResilientTrainer(
+    exe, fluid.default_main_program(), fetch_list=[loss],
+    guard_every=8, checkpoint_dir=ckdir, checkpoint_every=60)
+with warnings.catch_warnings():
+    warnings.simplefilter("ignore")
+    result = trainer.run(batches())
+print("chaos train:", result, "final loss", result.losses[-1])
+assert result.rollbacks >= 1, result
+assert not result.preempted, result
+
+# newest checkpoint is torn: restore must detect it and fall back
+scope2 = fluid.Scope()
+exe2 = fluid.Executor(fluid.CPUPlace())
+exe2.run(fluid.default_startup_program(), scope=scope2)
+trainer2 = fluid.ResilientTrainer(
+    exe2, fluid.default_main_program(), fetch_list=[loss],
+    scope=scope2, checkpoint_dir=ckdir)
+with warnings.catch_warnings():
+    warnings.simplefilter("ignore")
+    step = trainer2.restore()
+print("restored from step", step, "of", checkpoint.all_checkpoints(ckdir))
+assert step is not None and step < result.step, (step, result.step)
+
+reg = obs.registry()
+reg.gauge("chaos/final_loss").set(result.losses[-1])
+reg.gauge("chaos/restored_step").set(step)
+PYEOF
+  python tools/ptpu_stats.py "$dump" \
+    --assert-has resilience/anomalies resilience/snapshot_bytes \
+                 chaos/restored_step \
+    --assert-min resilience/rollbacks=1 resilience/retries=1 \
+                 resilience/ckpt_corrupt_detected=1 \
+                 resilience/ckpt_saves=2 resilience/faults_injected=2 \
+    --assert-max chaos/final_loss=0.1
 }
 
 case "$stage" in
@@ -159,6 +250,7 @@ case "$stage" in
   bench-smoke) do_bench_smoke ;;
   stress) do_stress ;;
   obs) do_obs_smoke ;;
-  all) do_build; do_test; do_api_check; do_bench_smoke; do_bench ;;
+  chaos) do_chaos ;;
+  all) do_build; do_test; do_api_check; do_bench_smoke; do_chaos; do_bench ;;
   *) echo "unknown stage: $stage" >&2; exit 2 ;;
 esac

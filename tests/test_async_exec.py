@@ -371,3 +371,8 @@ def test_ptpu_stats_assertions(tmp_path, capsys):
     assert stats_main([dump, "--assert-has", "nope/metric"]) == 1
     assert stats_main([dump, "--assert-min",
                        "exec/inflight_steps=9"]) == 1
+    assert stats_main([dump, "--assert-max",
+                       "exec/inflight_steps=9"]) == 0
+    assert stats_main([dump, "--assert-max",
+                       "exec/inflight_steps=2"]) == 1
+    assert stats_main([dump, "--assert-max", "malformed"]) == 1

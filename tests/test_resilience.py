@@ -1,0 +1,698 @@
+"""Fault-tolerant training runtime tests (docs/RESILIENCE.md): every
+PTPU_FAULT_INJECT recovery path end-to-end — anomaly -> rollback resumes
+bitwise from last-good state, torn checkpoint -> fallback restore,
+SIGTERM -> emergency checkpoint that a fresh process-equivalent trainer
+resumes from — plus checkpoint digest-mismatch detection, the anomaly
+detector/policy matrix, and the PyReader worker-error forwarding."""
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import checkpoint, resilience
+from paddle_tpu.observability import metrics as obs_metrics
+
+
+def _build_fit_a_line():
+    prog, sprog = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, sprog):
+        x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1, act=None)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    return prog, sprog, loss
+
+
+def _data(n=256):
+    rng = np.random.RandomState(0)
+    xs = rng.uniform(-1, 1, (n, 13)).astype(np.float32)
+    w = rng.uniform(-2, 2, (13, 1)).astype(np.float32)
+    ys = (xs @ w + 0.5).astype(np.float32)
+    return xs, ys
+
+
+def _batches(xs, ys, epochs, batch=64):
+    for _ in range(epochs):
+        for i in range(0, len(xs), batch):
+            yield {"x": xs[i:i + batch], "y": ys[i:i + batch]}
+
+
+class _Harness:
+    """One program trained under different scopes/injectors so runs are
+    comparable parameter-for-parameter (params keep one name)."""
+
+    def __init__(self, epochs=4):
+        self.prog, self.sprog, self.loss = _build_fit_a_line()
+        self.pname = self.prog.global_block().all_parameters()[0].name
+        self.xs, self.ys = _data()
+        self.epochs = epochs
+
+    def feeds(self):
+        return _batches(self.xs, self.ys, self.epochs)
+
+    def train(self, inject=None, trainer_kwargs=None, feeds=None,
+              scope=None, trainer_out=None):
+        scope = scope or fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(self.sprog, scope=scope)
+        tr = fluid.ResilientTrainer(
+            exe, self.prog, fetch_list=[self.loss], scope=scope,
+            guard_every=4, backoff_base=0.0,
+            fault_injector=resilience.FaultInjector(inject or ""),
+            **(trainer_kwargs or {}))
+        if trainer_out is not None:
+            trainer_out.append(tr)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            result = tr.run(feeds if feeds is not None else self.feeds())
+        return result, np.array(scope.get(self.pname)), scope
+
+
+# ---------------------------------------------------------------------------
+# guarded steps + rollback
+# ---------------------------------------------------------------------------
+
+
+def test_clean_run_trains():
+    h = _Harness(epochs=8)
+    result, _, _ = h.train()
+    assert not result.preempted
+    assert result.anomalies == result.rollbacks == 0
+    assert result.losses[-1] < result.losses[0] * 0.5
+
+
+def test_nan_rollback_resumes_bitwise():
+    """Injected NaN at step 10 under policy=rollback: the batch is
+    retried from the last-good snapshot at its ORIGINAL step counter, so
+    the final params are bitwise identical to the fault-free run."""
+    h = _Harness()
+    _, w_clean, _ = h.train()
+    result, w_faulty, _ = h.train("nan_at_step:10",
+                                  {"policy": "rollback"})
+    assert result.anomalies == 1
+    assert result.rollbacks == 1
+    assert result.retries == 1
+    assert result.skipped_steps == 0
+    assert np.array_equal(w_clean, w_faulty)
+
+
+def test_nan_skip_batch_converges():
+    """policy=skip_batch drops the poisoned batch; the run completes and
+    the final loss stays within tolerance of the fault-free run."""
+    h = _Harness(epochs=8)
+    clean, _, _ = h.train()
+    result, _, _ = h.train("nan_at_step:10", {"policy": "skip_batch"})
+    assert result.skipped_steps == 1
+    assert result.rollbacks == 1
+    assert result.retries == 0
+    assert result.step == clean.step - 1  # one batch gone
+    assert abs(result.losses[-1] - clean.losses[-1]) < 0.1
+
+
+def test_nan_warn_policy_continues_poisoned():
+    h = _Harness(epochs=1)
+    with pytest.warns(RuntimeWarning):
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(h.sprog, scope=scope)
+        tr = fluid.ResilientTrainer(
+            exe, h.prog, fetch_list=[h.loss], scope=scope, guard_every=4,
+            policy="warn",
+            fault_injector=resilience.FaultInjector("nan_at_step:2"))
+        result = tr.run(h.feeds())
+    assert result.anomalies == 1
+    assert result.rollbacks == 0  # warn never rolls back
+    # the poisoned update propagated — that is what warn means
+    assert not np.isfinite(np.array(scope.get(h.pname))).all()
+
+
+def test_nan_abort_policy_raises():
+    h = _Harness(epochs=1)
+    with pytest.raises(resilience.AnomalousStepError) as ei:
+        h.train("nan_at_step:2", {"policy": "abort"})
+    assert ei.value.kind == "nonfinite"
+
+
+def test_transient_step_error_retried_bitwise():
+    h = _Harness()
+    _, w_clean, _ = h.train()
+    result, w_retry, _ = h.train("transient_at_step:7")
+    assert result.retries == 1
+    assert result.rollbacks == 1
+    assert np.array_equal(w_clean, w_retry)
+
+
+def test_transient_compile_error_retried(tmp_path):
+    """The executor-side transient_compile hook fires on the first cache
+    miss; the trainer classifies it as transient and retries."""
+    h = _Harness(epochs=2)
+    _, w_clean, _ = h.train()
+    # occurrence 1 is the STARTUP program's compile (outside the guarded
+    # loop); occurrence 2 is the train step's compile inside trainer.run
+    prev = resilience.set_global_injector(
+        resilience.FaultInjector("transient_compile:2"))
+    try:
+        result, w_retry, _ = h.train()
+    finally:
+        resilience.set_global_injector(prev)
+    assert result.retries == 1
+    assert np.array_equal(w_clean[:], w_retry[:])
+
+
+def test_retry_budget_exhausts():
+    """A persistently-poisoned state (every window anomalous) must stop
+    at the retry budget, not loop forever."""
+    h = _Harness(epochs=2)
+    xs = h.xs.copy()
+    xs[3, 0] = np.nan  # every epoch re-feeds the same poisoned batch
+    feeds = _batches(xs, h.ys, 8)
+    with pytest.raises(resilience.RetryBudgetExceededError):
+        h.train(trainer_kwargs={"retry_budget": 2,
+                                "max_step_retries": 99}, feeds=feeds)
+
+
+def test_spike_detector():
+    det = resilience.AnomalyDetector(spike_factor=5.0, warmup=3)
+    for v in (1.0, 1.1, 0.9, 1.0):
+        assert det.check(v) is None
+    assert det.check(50.0) == "spike"
+    assert det.check(np.nan) == "nonfinite"
+    assert det.check(1.05) is None  # the spike never polluted the EMA
+
+
+def test_anomaly_policy_env(monkeypatch):
+    monkeypatch.setenv("PTPU_ANOMALY_POLICY", "skip_batch")
+    assert resilience.anomaly_policy() == "skip_batch"
+    assert resilience.anomaly_policy("abort") == "abort"
+    monkeypatch.setenv("PTPU_ANOMALY_POLICY", "bogus")
+    with pytest.raises(ValueError):
+        resilience.anomaly_policy()
+
+
+def test_fault_injector_spec_parsing():
+    inj = resilience.FaultInjector(
+        "nan_at_step:3, transient_compile:2,ckpt_torn_write:1")
+    assert inj.active()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        assert not inj.fire_at_step("nan_at_step", 2)
+        assert inj.fire_at_step("nan_at_step", 3)
+        assert not inj.fire_at_step("nan_at_step", 3)  # one-shot
+        assert not inj.fire_occurrence("transient_compile")
+        assert inj.fire_occurrence("transient_compile")
+        assert not inj.fire_occurrence("transient_compile")
+    with pytest.raises(ValueError):
+        resilience.FaultInjector("explode_at_step:1")
+    assert not resilience.FaultInjector("").active()
+
+
+def test_is_transient_error_classification():
+    assert resilience.is_transient_error(
+        resilience.InjectedTransientError("RESOURCE_EXHAUSTED"))
+    assert not resilience.is_transient_error(ValueError("nope"))
+    try:
+        import jaxlib.xla_extension as xe
+
+        exc = xe.XlaRuntimeError("RESOURCE_EXHAUSTED: out of memory")
+        assert resilience.is_transient_error(exc)
+        exc2 = xe.XlaRuntimeError("INVALID_ARGUMENT: bad shape")
+        assert not resilience.is_transient_error(exc2)
+    except (ImportError, AttributeError, TypeError):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# crash-safe checkpoints
+# ---------------------------------------------------------------------------
+
+
+def _corrupt_payload(step_path):
+    """Flip the bytes of every payload file (a torn write — the manifest
+    survives, so the step still LOOKS complete to a directory scan)."""
+    for root, _dirs, files in os.walk(step_path):
+        for name in files:
+            if name == checkpoint.MANIFEST_NAME:
+                continue
+            p = os.path.join(root, name)
+            with open(p, "r+b") as f:
+                data = f.read()
+                f.seek(0)
+                f.write(bytes(b ^ 0xFF for b in data))
+
+
+def test_latest_checkpoint_skips_manifestless_dirs(tmp_path):
+    """A crash mid-save leaves a step dir without a manifest; directory
+    scans must never hand it back."""
+    import jax.numpy as jnp
+
+    checkpoint.save_checkpoint(str(tmp_path), {"x": jnp.asarray(1.0)}, 3)
+    os.makedirs(str(tmp_path / "step_9"))  # torn: no manifest
+    assert checkpoint.latest_checkpoint(str(tmp_path)).endswith("step_3")
+    assert checkpoint.all_checkpoints(str(tmp_path)) == [3]
+    got = checkpoint.restore_checkpoint(str(tmp_path))
+    assert float(np.asarray(got["x"])) == 1.0
+
+
+def test_digest_mismatch_detected(tmp_path):
+    """Silent bit rot: the payload deserializes fine but its content no
+    longer matches the manifest digest — verification must catch what
+    orbax alone cannot."""
+    import json
+
+    import jax.numpy as jnp
+
+    checkpoint.save_checkpoint(
+        str(tmp_path), {"w": jnp.arange(128.0)}, 1)
+    mpath = str(tmp_path / "step_1" / checkpoint.MANIFEST_NAME)
+    with open(mpath) as f:
+        doc = json.load(f)
+    doc["digests"]["w"] = "0" * 64  # what a rotted payload would hash to
+    with open(mpath, "w") as f:
+        json.dump(doc, f)
+    with pytest.raises(checkpoint.CheckpointCorruptionError,
+                       match="digest"):
+        checkpoint.restore_checkpoint(str(tmp_path / "step_1"))
+    # verify=False restores anyway (explicit escape hatch)
+    got = checkpoint.restore_checkpoint(str(tmp_path / "step_1"),
+                                        verify=False)
+    np.testing.assert_allclose(np.asarray(got["w"]), np.arange(128.0))
+
+
+def test_torn_checkpoint_falls_back_to_intact(tmp_path):
+    import jax.numpy as jnp
+
+    checkpoint.save_checkpoint(str(tmp_path), {"w": jnp.arange(64.0)}, 5)
+    checkpoint.save_checkpoint(
+        str(tmp_path), {"w": jnp.arange(64.0) * 2}, 10)
+    _corrupt_payload(str(tmp_path / "step_10"))
+    obs_metrics.enable()
+    try:
+        before = obs_metrics.registry().counter(
+            "resilience/ckpt_corrupt_detected").value
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            got = checkpoint.restore_checkpoint(str(tmp_path))
+        after = obs_metrics.registry().counter(
+            "resilience/ckpt_corrupt_detected").value
+    finally:
+        obs_metrics.disable()
+    np.testing.assert_allclose(np.asarray(got["w"]), np.arange(64.0))
+    assert after == before + 1
+
+
+def test_all_checkpoints_corrupt_raises(tmp_path):
+    import jax.numpy as jnp
+
+    checkpoint.save_checkpoint(str(tmp_path), {"w": jnp.arange(32.0)}, 1)
+    _corrupt_payload(str(tmp_path / "step_1"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(checkpoint.CheckpointCorruptionError):
+            checkpoint.restore_checkpoint(str(tmp_path))
+
+
+def test_torn_write_injection_hook(tmp_path):
+    """ckpt_torn_write fires through checkpoint.save itself — the save
+    lands, then reads back corrupt, exactly like a torn write."""
+    import jax.numpy as jnp
+
+    prev = resilience.set_global_injector(
+        resilience.FaultInjector("ckpt_torn_write:1"))
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            checkpoint.save_checkpoint(
+                str(tmp_path), {"w": jnp.arange(64.0)}, 1)
+    finally:
+        resilience.set_global_injector(prev)
+    with pytest.raises(checkpoint.CheckpointCorruptionError):
+        checkpoint.restore_checkpoint(str(tmp_path / "step_1"))
+
+
+def test_manager_async_save_and_gc(tmp_path):
+    import jax.numpy as jnp
+
+    mgr = checkpoint.CheckpointManager(str(tmp_path), max_to_keep=2,
+                                       async_save=True)
+    for s in (1, 2, 3, 4):
+        mgr.save({"x": jnp.asarray(float(s))}, s)
+    mgr.wait()
+    assert mgr.all_steps() == [3, 4]
+    assert mgr.latest_step() == 4
+    got = mgr.restore()
+    assert float(np.asarray(got["x"])) == 4.0
+
+
+def test_gc_keeps_intact_fallback_despite_torn_newest(tmp_path):
+    """A torn step must not consume the GC retention quota: with
+    max_to_keep=1, intact step 1 survives a torn step-2 save and restore
+    falls back across the tear. A later intact save then reclaims the
+    torn dir (older than the newest intact)."""
+    import jax.numpy as jnp
+
+    mgr = checkpoint.CheckpointManager(str(tmp_path), max_to_keep=1)
+    mgr.save({"x": jnp.asarray(1.0)}, 1)
+    prev = resilience.set_global_injector(
+        resilience.FaultInjector("ckpt_torn_write:1"))
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            mgr.save({"x": jnp.asarray(2.0)}, 2)
+    finally:
+        resilience.set_global_injector(prev)
+    assert mgr.all_steps() == [1]  # torn step 2 is not intact
+    assert os.path.isdir(str(tmp_path / "step_2"))  # left for fallback scan
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        got = mgr.restore()
+    assert float(np.asarray(got["x"])) == 1.0
+    mgr.save({"x": jnp.asarray(3.0)}, 3)
+    # torn step 2 is now older than the newest intact step: reclaimed,
+    # and step 1 left the quota
+    assert mgr.all_steps() == [3]
+    assert not os.path.isdir(str(tmp_path / "step_2"))
+
+
+def test_legacy_pre_manifest_checkpoint_restores(tmp_path):
+    """Checkpoints written by the pre-manifest writer (orbax files
+    directly under step_N, no manifest) are last-resort restore
+    candidates — upgrading an existing run must not lose its state, and
+    GC must not reclaim the legacy dir until a full quota of newer
+    intact steps exists."""
+    import jax.numpy as jnp
+
+    checkpoint._checkpointer().save(
+        str(tmp_path / "step_7"), {"x": jnp.asarray(7.0)}, force=True)
+    assert checkpoint.latest_checkpoint(str(tmp_path)) is None
+    with warnings.catch_warnings(record=True) as wlog:
+        warnings.simplefilter("always")
+        got = checkpoint.restore_checkpoint(str(tmp_path))
+    assert float(np.asarray(got["x"])) == 7.0
+    assert any("pre-manifest" in str(w.message) for w in wlog)
+    mgr = checkpoint.CheckpointManager(str(tmp_path), max_to_keep=2)
+    mgr.save({"x": jnp.asarray(9.0)}, 9)
+    assert os.path.isdir(str(tmp_path / "step_7"))  # 1 newer intact < 2
+    mgr.save({"x": jnp.asarray(11.0)}, 11)
+    assert not os.path.isdir(str(tmp_path / "step_7"))  # quota reached
+
+
+def test_warn_policy_counts_once_per_anomalous_window():
+    """policy=warn counts each anomalous WINDOW once (per-step counting
+    would spam once the state is poisoned) but the scan still finishes
+    the window, so later healthy losses keep folding into the EMA."""
+    h = _Harness(epochs=2)  # 8 steps = 2 guard windows of 4
+    result, _, _ = h.train("nan_at_step:1,nan_at_step:2",
+                           {"policy": "warn"})
+    assert result.anomalies == 2  # both windows poisoned, counted once each
+    assert result.rollbacks == 0
+
+
+def test_retry_budget_resets_per_run():
+    """The budget is per run(): a retry spent in one run must not
+    shrink the next run's budget (nor may batch-ordinal retry keys
+    bleed across runs)."""
+    h = _Harness(epochs=2)
+    out = []
+    result, _, _ = h.train("transient_at_step:3",
+                           trainer_kwargs={"retry_budget": 4},
+                           trainer_out=out)
+    tr = out[0]
+    assert result.retries == 1
+    assert tr._retries_left == 3
+    tr.run(iter([]))
+    assert tr._retries_left == 4
+    assert not tr._batch_retries
+
+
+def test_same_step_overwrite_stays_atomic(tmp_path):
+    """Re-saving an existing step parks the old dir aside instead of
+    rmtree-before-rename (a crash between the two would leave NO intact
+    step_N); the new content wins and no temp dirs leak."""
+    import jax.numpy as jnp
+
+    checkpoint.save_checkpoint(str(tmp_path), {"x": jnp.asarray(1.0)}, 5)
+    checkpoint.save_checkpoint(str(tmp_path), {"x": jnp.asarray(2.0)}, 5)
+    got = checkpoint.restore_checkpoint(str(tmp_path))
+    assert float(np.asarray(got["x"])) == 2.0
+    leftovers = [n for n in os.listdir(str(tmp_path))
+                 if n.startswith(checkpoint._TMP_PREFIX)]
+    assert leftovers == []
+
+
+def test_reap_replays_crashed_publish(tmp_path):
+    """A writer that died mid-publish is healed at the next manager
+    init: a COMPLETE tmp dir finishes its crashed rename, and an `_old`
+    aside (the pre-overwrite original) is restored when its step_N is
+    missing."""
+    import jax.numpy as jnp
+
+    checkpoint.save_checkpoint(str(tmp_path), {"x": jnp.asarray(4.0)}, 4)
+    os.rename(str(tmp_path / "step_4"),
+              str(tmp_path / (checkpoint._TMP_PREFIX + "step_4")))
+    checkpoint.save_checkpoint(str(tmp_path), {"x": jnp.asarray(6.0)}, 6)
+    os.rename(str(tmp_path / "step_6"),
+              str(tmp_path / (checkpoint._TMP_PREFIX + "step_6_old")))
+    checkpoint.CheckpointManager(str(tmp_path))
+    assert checkpoint.all_checkpoints(str(tmp_path)) == [4, 6]
+    got = checkpoint.restore_checkpoint(str(tmp_path))
+    assert float(np.asarray(got["x"])) == 6.0
+
+
+def test_snapshot_restore_copies_mutable_containers():
+    """Rollback hands out fresh copies of list/dict scope values too —
+    post-rollback mutation must never dirty the snapshot."""
+    scope = fluid.Scope()
+    scope.set("meta", [1, 2, 3])
+    snap = resilience.snapshot_scope(scope, 0)
+    resilience.restore_scope_snapshot(snap, scope)
+    scope.get("meta").append(99)  # post-rollback training mutates it
+    assert snap.state["meta"] == [1, 2, 3]
+    resilience.restore_scope_snapshot(snap, scope)
+    assert scope.get("meta") == [1, 2, 3]
+
+
+def test_skip_batch_does_not_spend_retry_budget():
+    """Skipping makes forward progress, so a dataset with more bad
+    batches than the retry budget must complete under skip_batch, not
+    die on RetryBudgetExceededError."""
+    h = _Harness(epochs=2)
+    xs = h.xs.copy()
+    xs[::64, 0] = np.nan  # every batch poisoned
+    feeds = _batches(xs, h.ys, 2)
+    result, _, _ = h.train(trainer_kwargs={"policy": "skip_batch",
+                                           "retry_budget": 2},
+                           feeds=feeds)
+    assert result.skipped_steps == 8  # all batches dropped, none fatal
+    assert result.retries == 0
+
+
+def test_detector_state_rewinds_on_rollback():
+    """Replayed losses must not fold into the spike EMA twice: detector
+    state rides on each snapshot and a rollback restores it. Unit: the
+    state round-trips. E2E: after a NaN rollback+replay, the detector
+    saw each healthy loss exactly once."""
+    det = resilience.AnomalyDetector(spike_factor=3.0, warmup=2)
+    for v in (1.0, 1.1, 0.9):
+        assert det.check(v) is None
+    saved = det.state()
+    assert det.check(1.05) is None
+    assert det.state() != saved
+    det.restore(saved)
+    assert det.state() == saved
+
+    h = _Harness()
+    out = []
+    result, _, _ = h.train("nan_at_step:10",
+                           {"policy": "rollback", "spike_factor": 100.0},
+                           trainer_out=out)
+    assert result.rollbacks == 1
+    # every healthy loss folded exactly once; the NaN never folded
+    assert out[0].detector.state()[1] == len(result.losses)
+
+
+def test_manager_reaps_stale_tmp(tmp_path):
+    stale = tmp_path / (checkpoint._TMP_PREFIX + "step_7_999")
+    os.makedirs(str(stale))
+    checkpoint.CheckpointManager(str(tmp_path))
+    assert not os.path.isdir(str(stale))
+
+
+# ---------------------------------------------------------------------------
+# preemption drain + resume
+# ---------------------------------------------------------------------------
+
+
+def test_sigterm_drains_and_emergency_checkpoint_resumes(tmp_path):
+    """SIGTERM at step N: the trainer drains the in-flight window, writes
+    an emergency checkpoint, and returns preempted=True; a FRESH trainer
+    restores it and finishes bitwise identical to the uninterrupted
+    run."""
+    h = _Harness(epochs=6)
+    _, w_clean, _ = h.train()
+
+    feeds = list(h.feeds())
+    ckdir = str(tmp_path / "ck")
+    result, _, _ = h.train(
+        "sigterm_at_step:10",
+        {"checkpoint_dir": ckdir, "checkpoint_every": 1000})
+    assert result.preempted
+    assert result.checkpoints_saved >= 1
+    assert checkpoint.all_checkpoints(ckdir)
+
+    # resume: fresh scope/executor, restore, feed the remaining batches
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(h.sprog, scope=scope)
+    tr = fluid.ResilientTrainer(exe, h.prog, fetch_list=[h.loss],
+                                scope=scope, guard_every=4,
+                                checkpoint_dir=ckdir)
+    step = tr.restore()
+    assert step == result.step
+    consumed = step - 1  # the startup run owns counter slot 0
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        r2 = tr.run(iter(feeds[consumed:]))
+    assert not r2.preempted
+    w_resumed = np.array(scope.get(h.pname))
+    assert np.array_equal(w_clean, w_resumed)
+
+
+def test_preemption_guard_restores_handlers():
+    import signal as _signal
+
+    before_term = _signal.getsignal(_signal.SIGTERM)
+    before_int = _signal.getsignal(_signal.SIGINT)
+    with resilience.PreemptionGuard() as guard:
+        assert _signal.getsignal(_signal.SIGTERM) == guard._handle
+        os.kill(os.getpid(), _signal.SIGTERM)
+        # flag set, no exception raised
+        assert guard.triggered == _signal.SIGTERM
+    assert _signal.getsignal(_signal.SIGTERM) == before_term
+    assert _signal.getsignal(_signal.SIGINT) == before_int
+
+
+# ---------------------------------------------------------------------------
+# trainer + checkpoint integration, metrics
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_nan_plus_torn_checkpoint_completes(tmp_path):
+    """The acceptance scenario: injected NaN AND a torn checkpoint in one
+    run — training completes, matches the fault-free loss, and restore
+    falls back to an intact step."""
+    h = _Harness(epochs=8)
+    clean, w_clean, _ = h.train()
+    ckdir = str(tmp_path / "ck")
+    result, w_faulty, _ = h.train(
+        "nan_at_step:14,ckpt_torn_write:1",
+        {"policy": "rollback", "checkpoint_dir": ckdir,
+         "checkpoint_every": 8})
+    assert result.rollbacks >= 1
+    assert np.array_equal(w_clean, w_faulty)
+    assert abs(result.losses[-1] - clean.losses[-1]) < 1e-6
+    # the torn step is detected and skipped at restore time
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(h.sprog, scope=scope)
+    tr = fluid.ResilientTrainer(exe, h.prog, fetch_list=[h.loss],
+                                scope=scope, checkpoint_dir=ckdir)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        step = tr.restore()
+    assert step is not None
+
+
+def test_resilience_metrics_flow(tmp_path):
+    obs_metrics.enable()
+    try:
+        reg = obs_metrics.registry()
+        before = {
+            name: reg.counter("resilience/" + name).value
+            for name in ("anomalies", "rollbacks", "retries")}
+        h = _Harness(epochs=2)
+        h.train("nan_at_step:5", {"policy": "rollback"})
+        for name in ("anomalies", "rollbacks", "retries"):
+            assert reg.counter("resilience/" + name).value \
+                == before[name] + 1, name
+    finally:
+        obs_metrics.disable()
+
+
+def test_trainer_restore_without_dir_raises():
+    h = _Harness(epochs=1)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(h.sprog, scope=scope)
+    tr = fluid.ResilientTrainer(exe, h.prog, fetch_list=[h.loss],
+                                scope=scope)
+    with pytest.raises(ValueError):
+        tr.restore()
+    with pytest.raises(ValueError):
+        fluid.ResilientTrainer(exe, h.prog, fetch_list=[], scope=scope)
+
+
+# ---------------------------------------------------------------------------
+# PyReader worker robustness (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_pyreader_forwards_worker_exception():
+    """A generator error must raise at next() in the consumer — never
+    silently end (or hang) the stream."""
+    from paddle_tpu.reader import PyReader
+
+    class BatchBoom(RuntimeError):
+        pass
+
+    def gen():
+        yield {"x": np.ones((2, 4), np.float32)}
+        raise BatchBoom("parse error in worker")
+
+    r = PyReader(capacity=2, use_double_buffer=False)
+    r.decorate_batch_generator(gen)
+    it = iter(r)
+    next(it)
+    with pytest.raises(BatchBoom, match="parse error"):
+        next(it)
+
+
+def test_pyreader_bounded_worker_restart():
+    from paddle_tpu.reader import PyReader
+
+    calls = []
+
+    def flaky_gen():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError("transient source failure")
+        for i in range(3):
+            yield {"x": np.full((1, 2), i, np.float32)}
+
+    r = PyReader(capacity=2, use_double_buffer=False, worker_restarts=2)
+    r.decorate_batch_generator(flaky_gen)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        batches = list(r())
+    assert len(batches) == 3
+    assert len(calls) == 3
+
+    # budget exhausted: the error is forwarded, not swallowed
+    calls.clear()
+
+    def always_fails():
+        calls.append(1)
+        raise RuntimeError("permanent failure")
+        yield  # pragma: no cover
+
+    r2 = PyReader(capacity=2, use_double_buffer=False, worker_restarts=1)
+    r2.decorate_batch_generator(always_fails)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(RuntimeError, match="permanent failure"):
+            list(r2())
+    assert len(calls) == 2

@@ -26,6 +26,8 @@ NAMESPACES = [
     ("paddle_tpu.transpiler", None),
     ("paddle_tpu.nets", None),
     ("paddle_tpu.observability", None),
+    ("paddle_tpu.resilience", None),
+    ("paddle_tpu.checkpoint", None),
     ("paddle_tpu.ir", None),
     ("paddle_tpu.profiler", None),
     ("paddle_tpu.unique_name", None),

@@ -154,26 +154,34 @@ def _lookup(doc, name):
     return False, None
 
 
-def check_assertions(doc, has, mins):
+def check_assertions(doc, has, mins, maxs=None):
     """CI gating: every `has` name must exist in the dump; every
-    `mins` "name=value" must exist with numeric value >= the bound
-    (histograms compare their observation count). Returns a list of
-    failure messages."""
+    `mins`/`maxs` "name=value" must exist with numeric value >=/<= the
+    bound (histograms compare their observation count). Returns a list
+    of failure messages."""
     failures = []
     for name in has or ():
         if not _lookup(doc, name)[0]:
             failures.append("missing metric: %s" % name)
-    for spec in mins or ():
-        name, _, bound = spec.partition("=")
-        if not bound:
-            failures.append("--assert-min wants NAME=VALUE, got %r" % spec)
-            continue
-        found, val = _lookup(doc, name)
-        if not found:
-            failures.append("missing metric: %s" % name)
-        elif val < float(bound):
-            failures.append("metric %s = %s, want >= %s"
-                            % (name, val, bound))
+
+    def _bound_check(specs, flag, bad):
+        for spec in specs or ():
+            name, _, bound = spec.partition("=")
+            if not bound:
+                failures.append("%s wants NAME=VALUE, got %r"
+                                % (flag, spec))
+                continue
+            found, val = _lookup(doc, name)
+            if not found:
+                failures.append("missing metric: %s" % name)
+            elif bad(val, float(bound)):
+                failures.append("metric %s = %s, want %s %s"
+                                % (name, val,
+                                   ">=" if flag == "--assert-min"
+                                   else "<=", bound))
+
+    _bound_check(mins, "--assert-min", lambda v, b: v < b)
+    _bound_check(maxs, "--assert-max", lambda v, b: v > b)
     return failures
 
 
@@ -191,6 +199,10 @@ def main(argv=None):
                     metavar="NAME=VALUE",
                     help="fail unless metric >= value (histograms "
                          "compare their observation count)")
+    ap.add_argument("--assert-max", nargs="+", default=None,
+                    metavar="NAME=VALUE",
+                    help="fail unless metric <= value (the chaos stage "
+                         "gates final loss this way)")
     args = ap.parse_args(argv)
     if args.selftest:
         return _selftest()
@@ -206,7 +218,8 @@ def main(argv=None):
             sys.stdout.write(_to_prometheus(doc))
         else:
             render(doc)
-        failures = check_assertions(doc, args.assert_has, args.assert_min)
+        failures = check_assertions(doc, args.assert_has, args.assert_min,
+                                    args.assert_max)
         for msg in failures:
             sys.stderr.write("%s: %s\n" % (path, msg))
         if failures:
