@@ -108,7 +108,8 @@ def bench_transformer(steps=24, warmup=3, batch=192, seq=512, remat=None):
 
 def bench_transformer_fluid(steps=24, warmup=3, batch=160, seq=512,
                             async_exec=True, feed_mode="device",
-                            model_kwargs=None, program_opt=True):
+                            model_kwargs=None, program_opt=True,
+                            dtype="bfloat16", amp="legacy"):
     """The SAME flagship trained through the Fluid-equivalent Python API
     (fluid.layers program -> descriptor lowering -> one donated jitted
     step). This is the HEADLINE path (BASELINE.json north star: "via the
@@ -135,7 +136,15 @@ def bench_transformer_fluid(steps=24, warmup=3, batch=160, seq=512,
     program_opt=False runs the leg under PTPU_NO_PROGRAM_OPT=1 — the
     exact pre-pass-pipeline lowering path, measured so the compile-time
     optimization win (compile_time_s, StableHLO module size, tokens/s)
-    is visible in BENCH_*.json."""
+    is visible in BENCH_*.json.
+
+    dtype/amp select the precision scheme for the AMP-vs-fp32 pair of
+    legs (docs/MIXED_PRECISION.md): amp="legacy" keeps the historical
+    headline configuration (bf16-stored params + the contrib attr-mark
+    decorator); amp=False is the pure-fp32 baseline leg; amp=True runs
+    the same fp32-stored model through paddle_tpu.amp.decorate — the
+    compile-time bf16 dtype-rewrite pass — so the two legs isolate
+    exactly what automatic mixed precision buys."""
     import os
 
     import jax
@@ -146,11 +155,16 @@ def bench_transformer_fluid(steps=24, warmup=3, batch=160, seq=512,
     prog, sprog = fluid.Program(), fluid.Program()
     with fluid.program_guard(prog, sprog):
         _t, _l, loss = transformer_fluid.build(
-            seq_len=seq, remat=False, dtype="bfloat16",
+            seq_len=seq, remat=False, dtype=dtype,
             **(model_kwargs or {}))
-        opt = fluid.contrib.mixed_precision.decorate(
-            fluid.optimizer.SGD(0.01), init_loss_scaling=1.0,
-            use_dynamic_loss_scaling=False)
+        if amp == "legacy":
+            opt = fluid.contrib.mixed_precision.decorate(
+                fluid.optimizer.SGD(0.01), init_loss_scaling=1.0,
+                use_dynamic_loss_scaling=False)
+        elif amp:
+            opt = fluid.amp.decorate(fluid.optimizer.SGD(0.01))
+        else:
+            opt = fluid.optimizer.SGD(0.01)
         opt.minimize(loss)
         # compile-pipeline receipt (docs/COMPILER_PASSES.md): a foldable
         # const chain, a CSE-able duplicate pair, and a fetch-dead branch
@@ -330,6 +344,11 @@ def main(argv=None):
                          "metrics registry as a JSON dump (the BENCH_*.json "
                          "trajectory becomes reproducible from the "
                          "framework's own telemetry)")
+    ap.add_argument("--legs-out", metavar="bench_legs.json", default=None,
+                    help="write a machine-readable per-leg JSON array "
+                         "(leg name, tokens/s, step time, loss) so "
+                         "BENCH_r*.json can track fp32 vs AMP legs "
+                         "separately")
     ap.add_argument("--steps", type=int, default=24)
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--tiny", action="store_true",
@@ -337,6 +356,9 @@ def main(argv=None):
                          "prefetcher — the CI bench-smoke configuration")
     ap.add_argument("--sync-only", action="store_true",
                     help="skip the async leg (debug aid)")
+    ap.add_argument("--amp-only", action="store_true",
+                    help="run only the fp32-vs-AMP leg pair (the CI amp "
+                         "stage configuration)")
     ap.add_argument("--resilience", action="store_true",
                     help="also measure guarded vs unguarded step time "
                          "(always on under --tiny)")
@@ -348,36 +370,76 @@ def main(argv=None):
     else:
         kw = dict(steps=args.steps, warmup=args.warmup)
 
+    legs = []
+
+    def _leg(name, tps, step_s, loss=None, **extra):
+        entry = {"leg": name, "tokens_per_sec": round(tps, 1),
+                 "step_time_s": round(step_s, 6)}
+        if loss is not None:
+            entry["last_loss"] = float(loss)
+        entry.update(extra)
+        legs.append(entry)
+        return entry
+
     sync_tps = sync_step = None
     async_tps = async_step = None
+    noopt_tps = noopt_step = None
+    compile_opt = compile_noopt = None
+    hlo_opt = hlo_noopt = None
     last_loss = None
-    if not args.sync_only:
-        async_tps, last_loss, async_step, _ = bench_transformer_fluid(
-            async_exec=True, **kw)
-    hlo0 = _stablehlo_bytes()
-    sync_tps, last_loss_sync, sync_step, compile_opt = \
-        bench_transformer_fluid(async_exec=False, **kw)
-    hlo1 = _stablehlo_bytes()
-    # the PTPU_NO_PROGRAM_OPT=1 leg: identical program through the exact
-    # pre-pass-pipeline lowering path — its compile time, module size and
-    # throughput are the optimization pipeline's before/after receipt
-    noopt_tps, _, noopt_step, compile_noopt = bench_transformer_fluid(
-        async_exec=False, program_opt=False, **kw)
-    hlo2 = _stablehlo_bytes()
-    hlo_opt = (hlo1 - hlo0) if hlo0 is not None else None
-    hlo_noopt = (hlo2 - hlo1) if hlo0 is not None else None
-    if hlo0 is not None:
-        # metrics are on: pay the extra compile only when its counter
-        # (compiler/ops_fused) actually lands in a dump
-        _fusion_receipt()
+    if not args.amp_only:
+        if not args.sync_only:
+            async_tps, last_loss, async_step, _ = bench_transformer_fluid(
+                async_exec=True, **kw)
+            _leg("async", async_tps, async_step, last_loss)
+        hlo0 = _stablehlo_bytes()
+        sync_tps, last_loss_sync, sync_step, compile_opt = \
+            bench_transformer_fluid(async_exec=False, **kw)
+        _leg("sync", sync_tps, sync_step, last_loss_sync)
+        hlo1 = _stablehlo_bytes()
+        # the PTPU_NO_PROGRAM_OPT=1 leg: identical program through the
+        # exact pre-pass-pipeline lowering path — its compile time, module
+        # size and throughput are the optimization pipeline's
+        # before/after receipt
+        noopt_tps, _, noopt_step, compile_noopt = bench_transformer_fluid(
+            async_exec=False, program_opt=False, **kw)
+        _leg("noopt", noopt_tps, noopt_step)
+        hlo2 = _stablehlo_bytes()
+        hlo_opt = (hlo1 - hlo0) if hlo0 is not None else None
+        hlo_noopt = (hlo2 - hlo1) if hlo0 is not None else None
+        if hlo0 is not None:
+            # metrics are on: pay the extra compile only when its counter
+            # (compiler/ops_fused) actually lands in a dump
+            _fusion_receipt()
+        if last_loss is None:
+            last_loss = last_loss_sync
+
+    # AMP receipt (docs/MIXED_PRECISION.md): the SAME fp32 transformer
+    # config trained plain and through paddle_tpu.amp.decorate — the
+    # bf16 dtype-rewrite's tokens/s/chip win is recorded per leg so the
+    # BENCH_r*.json trajectory tracks fp32 vs AMP separately. The tiny
+    # bench-smoke run skips the pair (ci.sh's dedicated `amp` stage
+    # already pays the identical tiny pair via --amp-only).
+    fp32_tps = amp_tps = fp32_step = amp_step = None
+    fp32_loss = amp_loss = None
+    if args.amp_only or not args.tiny:
+        fp32_tps, fp32_loss, fp32_step, _ = bench_transformer_fluid(
+            async_exec=False, dtype="float32", amp=False, **kw)
+        _leg("fp32", fp32_tps, fp32_step, fp32_loss)
+        amp_tps, amp_loss, amp_step, _ = bench_transformer_fluid(
+            async_exec=False, dtype="float32", amp=True, **kw)
+        _leg("amp", amp_tps, amp_step, amp_loss,
+             speedup_vs_fp32=round(amp_tps / fp32_tps, 4))
+
+    headline = async_tps if async_tps is not None else \
+        (sync_tps if sync_tps is not None else amp_tps)
     if last_loss is None:
-        last_loss = last_loss_sync
-    headline = async_tps if async_tps is not None else sync_tps
+        last_loss = amp_loss
 
     # resilience-overhead leg (docs/RESILIENCE.md): the guard's cost is
     # measured, not assumed — acceptance is < 5% on the tiny config
     guarded = unguarded = overhead_pct = None
-    if args.resilience or args.tiny:
+    if (args.resilience or args.tiny) and not args.amp_only:
         unguarded, guarded = bench_resilience_overhead()
         overhead_pct = 100.0 * (guarded - unguarded) / unguarded
 
@@ -393,8 +455,9 @@ def main(argv=None):
             headline / BASELINE_TOKENS_PER_SEC)
         reg.gauge("bench/last_loss").set(last_loss)
         reg.counter("bench/steps").inc(kw.get("steps", args.steps))
-        reg.gauge("bench/step_time_sync").set(sync_step)
-        reg.gauge("bench/tokens_per_sec_sync").set(sync_tps)
+        if sync_tps is not None:  # --amp-only skips the headline legs
+            reg.gauge("bench/step_time_sync").set(sync_step)
+            reg.gauge("bench/tokens_per_sec_sync").set(sync_tps)
         if async_tps is not None:
             reg.gauge("bench/step_time_async").set(async_step)
             reg.gauge("bench/tokens_per_sec_async").set(async_tps)
@@ -402,7 +465,14 @@ def main(argv=None):
             reg.gauge("bench/compile_time_s_opt").set(compile_opt)
         if compile_noopt is not None:
             reg.gauge("bench/compile_time_s_noopt").set(compile_noopt)
-        reg.gauge("bench/tokens_per_sec_noopt").set(noopt_tps)
+        if noopt_tps is not None:
+            reg.gauge("bench/tokens_per_sec_noopt").set(noopt_tps)
+        if amp_tps is not None:  # pair skipped on the tiny smoke run
+            reg.gauge("bench/tokens_per_sec_fp32").set(fp32_tps)
+            reg.gauge("bench/tokens_per_sec_amp").set(amp_tps)
+            reg.gauge("bench/amp_speedup_vs_fp32").set(amp_tps / fp32_tps)
+            reg.gauge("bench/amp_last_loss").set(amp_loss)
+            reg.gauge("bench/fp32_last_loss").set(fp32_loss)
         if hlo_opt is not None:
             reg.gauge("bench/stablehlo_bytes_opt").set(hlo_opt)
             reg.gauge("bench/stablehlo_bytes_noopt").set(hlo_noopt)
@@ -411,15 +481,26 @@ def main(argv=None):
             reg.gauge("bench/step_time_unguarded").set(unguarded)
             reg.gauge("bench/guard_overhead_pct").set(overhead_pct)
         reg.dump_json(args.metrics_out)
+    if args.legs_out:
+        # machine-readable per-leg trajectory (ISSUE 5): BENCH_r*.json
+        # can track the fp32 vs AMP legs separately from the headline
+        with open(args.legs_out, "w") as f:
+            json.dump(legs, f, indent=2)
     result = {
         "metric": "transformer_base_tokens_per_sec_per_chip",
         "value": round(headline, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(headline / BASELINE_TOKENS_PER_SEC, 4),
-        "sync_tokens_per_sec": round(sync_tps, 1),
-        "step_time_sync_s": round(sync_step, 6),
-        "noopt_tokens_per_sec": round(noopt_tps, 1),
     }
+    if amp_tps is not None:
+        result["fp32_tokens_per_sec"] = round(fp32_tps, 1)
+        result["amp_tokens_per_sec"] = round(amp_tps, 1)
+        result["amp_speedup_vs_fp32"] = round(amp_tps / fp32_tps, 4)
+    if sync_tps is not None:
+        result["sync_tokens_per_sec"] = round(sync_tps, 1)
+        result["step_time_sync_s"] = round(sync_step, 6)
+    if noopt_tps is not None:
+        result["noopt_tokens_per_sec"] = round(noopt_tps, 1)
     if compile_opt is not None:  # --warmup 0: no cold call measured
         result["compile_time_s_opt"] = round(compile_opt, 3)
     if compile_noopt is not None:

@@ -1,0 +1,708 @@
+"""Automatic mixed-precision (bf16) training (parity:
+fluid.contrib.mixed_precision.decorate — decorator.py:26
+OptimizerWithMixedPrecision; recipe: Micikevicius et al., *Mixed Precision
+Training*, ICLR 2018 + Megatron-LM DDP gradient bucketing).
+
+TPU-native, AMP is a COMPILE-TIME dtype rewrite, not a per-op kernel
+switch: the `amp_rewrite` pass (registered in `fluid.ir`'s registry and
+run by the default PR-3 pipeline right before constant_fold/cse) walks
+the op graph and casts the inputs of matmul/conv/attention-class ops to
+bfloat16 — the MXU's native input type — while blacklisted ops
+(softmax/log/exp/norm/reduce/loss) and every persistable parameter stay
+fp32. Because gradient ops re-run their forward op's kernel under
+`jax.vjp` (core/lowering.py), the backward follows the forward's dtypes
+automatically: a bf16 forward dot yields bf16 gradient dots and bf16
+parameter gradients — half the HBM traffic and half the collective bytes
+on a data-parallel mesh — with ZERO grad-op rewriting.
+
+Master weights: fp32-stored params are their own master copy — the pass
+inserts `cast(param) -> bf16` ops feeding the white-list consumers, so
+the bf16 compute copy is re-derived inside the SAME fused jitted step
+(no extra buffers, no device syncs) while the optimizer update applies
+to the fp32 original (optimizer kernels cast the incoming bf16 gradient
+to fp32 exactly once — ops/optimizer_ops.py). For bf16/f16-STORED params
+(e.g. a model built with dtype="bfloat16"), `decorate(...)` creates an
+explicit fp32 master Parameter per low-precision param: the startup
+program initializes it from the param, the optimizer update runs on the
+master, and one trailing in-step cast re-derives the low-precision copy.
+
+Loss scaling rides behind a knob: OFF by default for bfloat16 (same
+exponent range as fp32) and ON by default for float16, using the same
+check_finite_and_unscale / update_loss_scaling state machine as the
+contrib decorator (ops/quant_ops.py).
+
+Activation: `decorate(...)` marks the program (`program._amp_config`);
+`PTPU_AMP=1` activates a default config process-wide (level
+`PTPU_AMP_LEVEL`, dtype `PTPU_AMP_DTYPE`); `BuildStrategy.amp = True`
+activates it for one CompiledProgram. With all three unset, the pass
+pipeline, the compile-cache keys and every lowered program are BITWISE
+identical to the pre-AMP framework (pinned by tests/test_amp.py).
+
+Gradient bucketing: `plan_buckets` coalesces per-parameter gradients
+into flattened same-dtype buckets (size `PTPU_AMP_BUCKET_MB`, default
+4 MiB) so data-parallel reduce-scatter/all-reduce moves a few large
+low-precision collectives instead of many small fp32 ones — consumed by
+`parallel.ShardedAdam(bucket_mb=...)` (docs/MIXED_PRECISION.md).
+"""
+
+import hashlib
+import os
+
+import numpy as np
+
+from . import framework, unique_name
+from .framework import convert_dtype, default_startup_program
+from .ir import Pass, register_pass
+from .observability import metrics as _metrics
+
+__all__ = [
+    "AutoMixedPrecisionLists", "AmpConfig", "AmpOptimizer", "decorate",
+    "amp_env_enabled", "active_config", "bucket_bytes_from_env",
+    "mb_to_bucket_bytes", "plan_buckets", "flatten_bucket",
+    "unflatten_bucket",
+]
+
+# white list: MXU-class ops whose fp32 inputs are cast to the low
+# precision dtype (their outputs then carry it)
+DEFAULT_WHITE_OPS = frozenset({
+    "mul", "matmul", "conv2d", "conv3d", "depthwise_conv2d",
+    "conv2d_transpose", "conv2d_fusion", "flash_attention",
+    "fused_multihead_attention",
+})
+
+# black list: numerically sensitive ops pinned to fp32 — low-precision
+# values reaching them are cast back up first
+DEFAULT_BLACK_OPS = frozenset({
+    "softmax", "softmax_with_cross_entropy", "cross_entropy",
+    "cross_entropy2", "sigmoid_cross_entropy_with_logits",
+    "square_error_cost", "huber_loss", "smooth_l1", "log_loss",
+    "mean", "sum", "reduce_sum", "reduce_mean", "reduce_prod",
+    "reduce_max", "reduce_min",
+    "layer_norm", "batch_norm", "group_norm", "instance_norm",
+    "exp", "log", "rsqrt", "sqrt", "pow", "softmax_with_upper_triangular",
+})
+
+_DEFAULT_BUCKET_MB = 4.0
+
+
+class AutoMixedPrecisionLists:
+    """White list computes in the low-precision dtype, black list stays
+    fp32, everything else (gray) follows its inputs (parity:
+    contrib/mixed_precision/fp16_lists.py)."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None):
+        self.white_list = set(DEFAULT_WHITE_OPS) | set(custom_white_list
+                                                       or ())
+        self.black_list = set(DEFAULT_BLACK_OPS) | set(custom_black_list
+                                                       or ())
+        self.white_list -= self.black_list
+
+
+class AmpConfig:
+    """Resolved AMP policy consumed by the `amp_rewrite` pass.
+
+    level O1: only white-list ops compute low-precision; their outputs
+    are cast back to fp32 before any non-white consumer. level O2: low
+    precision also flows through gray ops (elementwise/reshape/...) and
+    is only raised back to fp32 at black-list / structural seams."""
+
+    def __init__(self, level="O1", dtype="bfloat16", lists=None):
+        level = str(level).upper()
+        if level not in ("O1", "O2"):
+            raise ValueError("amp_level must be 'O1' or 'O2', got %r"
+                             % (level,))
+        dtype = convert_dtype(dtype)
+        if dtype not in ("bfloat16", "float16"):
+            raise ValueError(
+                "AMP compute dtype must be bfloat16 or float16, got %r"
+                % (dtype,))
+        self.level = level
+        self.dtype = dtype
+        self.lists = lists or AutoMixedPrecisionLists()
+
+    def cache_key(self):
+        """Short stable digest for the compile-cache pipeline key."""
+        h = hashlib.sha1()
+        h.update(repr((self.level, self.dtype,
+                       sorted(self.lists.white_list),
+                       sorted(self.lists.black_list))).encode())
+        return "%s:%s:%s" % (self.level, self.dtype, h.hexdigest()[:8])
+
+
+def amp_env_enabled():
+    return os.environ.get("PTPU_AMP", "") in ("1", "true")
+
+
+def _env_config():
+    return AmpConfig(level=os.environ.get("PTPU_AMP_LEVEL", "O1"),
+                     dtype=os.environ.get("PTPU_AMP_DTYPE", "bfloat16"))
+
+
+def active_config(program=None, build_strategy=None):
+    """The AMP config in effect for one compile, or None. Precedence:
+    program decoration (amp.decorate) > BuildStrategy.amp >
+    PTPU_AMP=1."""
+    cfg = getattr(program, "_amp_config", None) if program is not None \
+        else None
+    if cfg is not None:
+        return cfg
+    if build_strategy is not None and getattr(build_strategy, "amp",
+                                              False):
+        return AmpConfig(
+            level=getattr(build_strategy, "amp_level", "O1") or "O1",
+            dtype=getattr(build_strategy, "amp_dtype", "bfloat16")
+            or "bfloat16")
+    if amp_env_enabled():
+        return _env_config()
+    return None
+
+
+# ---------------------------------------------------------------------------
+# the dtype-rewrite pass
+# ---------------------------------------------------------------------------
+
+
+@register_pass("amp_rewrite")
+class AmpRewritePass(Pass):
+    """Insert low-precision casts around white-list ops on the compile
+    clone. Soundness:
+
+      - only forward ops are rewritten; grad ops (``__fwd_op__``),
+        optimizer ops and AMP state ops are skipped — the backward
+        follows the forward's dtypes through jax.vjp, and the grad-var
+        NAME wiring (__grad_in_map__/__grad_out_map__) is positional per
+        slot, so rewiring a forward op's input list never breaks it;
+      - an op's outputs are only marked low-precision when every float
+        output is unfetched, not read/written by sub-blocks, singly
+        written and not persistable — fetches, checkpoints and scope
+        state keep their pre-AMP dtypes bitwise;
+      - parameters are never rewritten in place: the inserted
+        ``cast(param)`` is the bf16 compute copy, re-derived inside the
+        same jitted step, while the fp32 original stays the master the
+        optimizer updates;
+      - inserted casts are deduped per (source, reaching definition) and
+        any survivors are swept by the pipeline's cse pass behind this
+        one.
+    """
+
+    def apply(self, program, scope=None):
+        cfg = active_config(program)
+        if cfg is None:
+            return program
+        from .core.lowering import _SPECIAL, _STRUCTURAL
+        from .framework import (_AMP_STATE_OP_TYPES, _OPTIMIZER_OP_TYPES,
+                                Block, Operator)
+        from .ir_passes import (_fetch_targets, _outside_reads,
+                                _outside_writes, _write_indices)
+
+        targets = _fetch_targets(program)
+        if targets is None:
+            # fetch set unknown (standalone apply): rewriting could hand
+            # a fetched name a low-precision value — pin
+            # program._opt_fetch_targets to run this pass standalone
+            return program
+        block = program.global_block()
+        lp = cfg.dtype
+        white = cfg.lists.white_list
+        black = cfg.lists.black_list
+        protected = (set(targets) | _outside_reads(program)
+                     | _outside_writes(program))
+        writes = _write_indices(block)
+
+        def rdef(name, i):
+            last = -1
+            for w in writes.get(name, ()):
+                if w < i:
+                    last = w
+                else:
+                    break
+            return last
+
+        lp_names = set()   # names whose RUNTIME value is low precision
+        cast_cache = {}    # (src name, reaching def, dtype) -> Variable
+        new_ops = []
+        inserted = [0]
+        deduped = [0]
+        rewritten = 0
+
+        def cast_to(v, i, dtype):
+            key = (v.name, rdef(v.name, i), dtype)
+            hit = cast_cache.get(key)
+            if hit is not None:
+                deduped[0] += 1
+                return hit
+            cv = block.create_var(
+                name=unique_name.generate(v.name + "@amp." + dtype),
+                shape=v.shape, dtype=dtype, persistable=False)
+            new_ops.append(Operator(
+                block, "cast", inputs={"X": [v]}, outputs={"Out": [cv]},
+                attrs={"in_dtype": v.dtype, "out_dtype": dtype,
+                       "__amp_cast__": True}))
+            cast_cache[key] = cv
+            inserted[0] += 1
+            return cv
+
+        def runtime_lp(v):
+            return v.name in lp_names or convert_dtype(v.dtype) == lp
+
+        def float_vars(vs_map):
+            return [v for vs in vs_map.values() for v in vs
+                    if convert_dtype(v.dtype) in ("float32", lp)]
+
+        def out_markable(n):
+            v = block._find_var_recursive(n)
+            return (n not in protected and len(writes.get(n, ())) == 1
+                    and v is not None and not v.persistable
+                    and not v.is_data
+                    and convert_dtype(v.dtype) in ("float32", lp))
+
+        def force_fp32_inputs(op, i):
+            for slot, vs in op.inputs.items():
+                op.inputs[slot] = [
+                    cast_to(v, i, "float32") if v.name in lp_names else v
+                    for v in vs]
+
+        def skip(op):
+            return ("__fwd_op__" in op.attrs
+                    or op.type in _OPTIMIZER_OP_TYPES
+                    or op.type in _AMP_STATE_OP_TYPES
+                    or op.attrs.get("__amp_state__")
+                    or op.attrs.get("__amp_cast__"))
+
+        def structural(op):
+            return (op.type in _STRUCTURAL or op.type in _SPECIAL
+                    or any(isinstance(a, (Block, Operator))
+                           for a in op.attrs.values()))
+
+        for i, op in enumerate(block.ops):
+            if skip(op):
+                new_ops.append(op)
+                continue
+            fouts = [n for n in op.output_names()
+                     if convert_dtype(
+                         getattr(block._find_var_recursive(n), "dtype",
+                                 "int32")) in ("float32", lp)]
+            if op.type in white and all(out_markable(n) for n in fouts) \
+                    and fouts:
+                touched = False
+                for slot, vs in op.inputs.items():
+                    nvs = []
+                    for v in vs:
+                        if runtime_lp(v):
+                            nvs.append(v)
+                            touched = True
+                        elif convert_dtype(v.dtype) == "float32":
+                            nvs.append(cast_to(v, i, lp))
+                            touched = True
+                        else:
+                            nvs.append(v)
+                    op.inputs[slot] = nvs
+                if touched:
+                    rewritten += 1
+                    for n in fouts:
+                        lp_names.add(n)
+                        block._find_var_recursive(n).dtype = lp
+                new_ops.append(op)
+                continue
+            if op.type in black or structural(op):
+                force_fp32_inputs(op, i)
+                for n in op.output_names():
+                    lp_names.discard(n)
+                new_ops.append(op)
+                continue
+            # gray op
+            if cfg.level == "O1":
+                # low precision never leaks past the white op itself
+                force_fp32_inputs(op, i)
+                for n in op.output_names():
+                    lp_names.discard(n)
+            else:
+                fins = float_vars(op.inputs)
+                if fins and any(runtime_lp(v) for v in fins) \
+                        and not all(out_markable(n) for n in fouts):
+                    # a protected/rebound output must keep fp32: raise
+                    # the inputs back up instead of tracking the name
+                    force_fp32_inputs(op, i)
+                    for n in op.output_names():
+                        lp_names.discard(n)
+                elif op.type == "cast":
+                    od = convert_dtype(op.attrs.get("out_dtype",
+                                                    "float32"))
+                    for n in op.output_names():
+                        (lp_names.add if od == lp
+                         else lp_names.discard)(n)
+                elif fins and all(runtime_lp(v) for v in fins):
+                    for n in fouts:
+                        lp_names.add(n)
+                        block._find_var_recursive(n).dtype = lp
+                else:
+                    for n in op.output_names():
+                        lp_names.discard(n)
+            new_ops.append(op)
+
+        if not inserted[0] and not rewritten:
+            # nothing marked AND nothing cast — truly untouched (a
+            # bf16-built model can rewrite white ops without inserting
+            # a single cast; it must still version-bump and report)
+            return program
+        block.ops = new_ops
+        if inserted[0]:
+            _metrics.counter("amp/casts_inserted").inc(inserted[0])
+        if deduped[0]:
+            _metrics.counter("amp/casts_deduped").inc(deduped[0])
+        _metrics.counter("amp/ops_rewritten").inc(rewritten)
+        program._bump_version()
+        return program
+
+
+# ---------------------------------------------------------------------------
+# optimizer decoration: master weights + loss scaling
+# ---------------------------------------------------------------------------
+
+
+class AmpOptimizer:
+    """`decorate(...)` result (parity: OptimizerWithMixedPrecision).
+    Marks the program for the `amp_rewrite` pass, optionally scales the
+    loss with the dynamic loss-scaling state machine, and maintains fp32
+    master weights for low-precision-stored parameters."""
+
+    def __init__(self, optimizer, config, init_loss_scaling,
+                 use_dynamic_loss_scaling, incr_every_n_steps,
+                 decr_every_n_nan_or_inf, incr_ratio, decr_ratio,
+                 master_weight=True):
+        self._optimizer = optimizer
+        self._config = config
+        self._init_loss_scaling = float(init_loss_scaling)
+        self._use_dynamic = bool(use_dynamic_loss_scaling)
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._master_weight = master_weight
+        self._loss_scaling = None
+        self._overflow_steps = None
+        self._masters = {}  # param name -> master Parameter
+
+    # -- parity surface ----------------------------------------------------
+    def get_loss_scaling(self):
+        return self._loss_scaling
+
+    def get_scaled_loss(self):
+        return getattr(self, "_scaled_loss", None)
+
+    def _scaling_on(self):
+        return self._use_dynamic or self._init_loss_scaling != 1.0
+
+    # -- graph construction ------------------------------------------------
+    def _mk_state(self, prog, startup, name, value, dtype="float32"):
+        from .initializer import Constant
+
+        vname = unique_name.generate(name)
+        gb = prog.global_block()
+        v = gb.create_var(name=vname, shape=(1,), dtype=dtype,
+                          persistable=True, stop_gradient=True)
+        sb = startup.global_block()
+        sv = sb.create_var(name=vname, shape=(1,), dtype=dtype,
+                           persistable=True)
+        Constant(value)(sv, sb)
+        return v
+
+    def _create_scaling_state(self, prog, startup):
+        self._loss_scaling = self._mk_state(prog, startup, "loss_scaling",
+                                            self._init_loss_scaling)
+        self._good_steps = self._mk_state(prog, startup, "amp_good_steps",
+                                          0, "int32")
+        self._bad_steps = self._mk_state(prog, startup, "amp_bad_steps",
+                                         0, "int32")
+        self._overflow_steps = self._mk_state(
+            prog, startup, "amp_overflow_steps", 0, "int32")
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        prog = loss.block.program
+        prog._amp_config = self._config
+        startup = startup_program or default_startup_program()
+        self._startup_program = startup
+        if self._scaling_on():
+            self._create_scaling_state(prog, startup)
+            with framework.program_guard(prog, startup):
+                from .layers import nn as nn_layers
+
+                loss = nn_layers.elementwise_mul(loss, self._loss_scaling)
+        self._scaled_loss = loss
+        return self._optimizer.backward(loss, startup_program,
+                                        parameter_list, no_grad_set,
+                                        callbacks)
+
+    def _unscale(self, prog, params_grads):
+        """check_finite_and_unscale (+ dynamic update + cumulative
+        overflow counter) — contrib decorator parity, ops pruned from
+        for_test clones via _AMP_STATE_OP_TYPES / __amp_state__."""
+        block = prog.global_block()
+        grads = [g for _, g in params_grads]
+        found_inf = block.create_var(
+            name=unique_name.generate("amp_found_inf"), dtype="bool",
+            shape=(1,))
+        unscaled = []
+        for _, g in params_grads:
+            ng = block.create_var(name=g.name + "@UNSCALED", dtype=g.dtype,
+                                  shape=g.shape)
+            unscaled.append(ng)
+        block.append_op(
+            type="check_finite_and_unscale",
+            inputs={"X": grads, "Scale": [self._loss_scaling]},
+            outputs={"Out": unscaled, "FoundInfinite": [found_inf]})
+        if self._use_dynamic:
+            block.append_op(
+                type="update_loss_scaling",
+                inputs={"PrevLossScaling": [self._loss_scaling],
+                        "InGoodSteps": [self._good_steps],
+                        "InBadSteps": [self._bad_steps],
+                        "FoundInfinite": [found_inf]},
+                outputs={"LossScaling": [self._loss_scaling],
+                         "OutGoodSteps": [self._good_steps],
+                         "OutBadSteps": [self._bad_steps]},
+                attrs={"incr_every_n_steps": self._incr_every_n_steps,
+                       "decr_every_n_nan_or_inf":
+                           self._decr_every_n_nan_or_inf,
+                       "incr_ratio": self._incr_ratio,
+                       "decr_ratio": self._decr_ratio})
+        inc = block.create_var(name=unique_name.generate("amp_ovf_inc"),
+                               dtype="int32", shape=(1,))
+        block.append_op(type="cast", inputs={"X": [found_inf]},
+                        outputs={"Out": [inc]},
+                        attrs={"in_dtype": "bool", "out_dtype": "int32",
+                               "__amp_state__": True})
+        block.append_op(type="elementwise_add",
+                        inputs={"X": [self._overflow_steps], "Y": [inc]},
+                        outputs={"Out": [self._overflow_steps]},
+                        attrs={"__amp_state__": True})
+        return [(p, ug) for (p, _), ug in zip(params_grads, unscaled)]
+
+    def _master_for(self, prog, p):
+        """fp32 master Parameter for a low-precision-stored param,
+        initialized from the param by a cast appended to the startup
+        program (decorate before running startup)."""
+        m = self._masters.get(p.name)
+        if m is not None:
+            return m
+        gb = prog.global_block()
+        m = gb.create_parameter(shape=tuple(p.shape), dtype="float32",
+                                name=p.name + ".master", trainable=False)
+        m.optimize_attr = dict(p.optimize_attr or {"learning_rate": 1.0})
+        m.regularizer = None
+        # the startup program backward() resolved (honors an explicit
+        # minimize(..., startup_program=...)); default only when the
+        # user drove apply_gradients without backward()
+        startup = getattr(self, "_startup_program", None) \
+            or default_startup_program()
+        sb = startup.global_block()
+        if sb.has_var(p.name):
+            sv = sb.create_var(name=m.name, shape=tuple(p.shape),
+                               dtype="float32", persistable=True)
+            sb.append_op(type="cast", inputs={"X": [sb.var(p.name)]},
+                         outputs={"Out": [sv]},
+                         attrs={"in_dtype": p.dtype,
+                                "out_dtype": "float32"})
+        self._masters[p.name] = m
+        return m
+
+    def apply_gradients(self, params_grads):
+        if not params_grads:
+            return self._optimizer.apply_gradients(params_grads)
+        prog = params_grads[0][0].block.program
+        block = prog.global_block()
+        if self._scaling_on():
+            with framework.program_guard(prog):
+                params_grads = self._unscale(prog, params_grads)
+        low_prec = []
+        routed = []
+        for p, g in params_grads:
+            if self._master_weight and convert_dtype(p.dtype) in (
+                    "bfloat16", "float16"):
+                master = self._master_for(prog, p)
+                low_prec.append((p, master))
+                routed.append((master, g))
+            else:
+                routed.append((p, g))
+        ops = self._optimizer.apply_gradients(routed)
+        for p, master in low_prec:
+            # re-derive the low-precision compute copy from the updated
+            # fp32 master INSIDE the same jitted step (no device sync);
+            # pruned from for_test clones with the other update ops
+            block.append_op(type="cast", inputs={"X": [master]},
+                            outputs={"Out": [p]},
+                            attrs={"in_dtype": "float32",
+                                   "out_dtype": p.dtype,
+                                   "__amp_state__": True})
+        return ops
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(loss, startup_program,
+                                     parameter_list, no_grad_set)
+        optimize_ops = self.apply_optimize(loss, startup_program,
+                                           params_grads)
+        return optimize_ops, params_grads
+
+    # -- telemetry ---------------------------------------------------------
+    def record_metrics(self, scope=None):
+        """Publish the runtime loss-scaling state as amp/* gauges
+        (docs/OBSERVABILITY.md) and return it as a dict. Host-side scope
+        read — call at a sync point, not per step."""
+        from .core.scope import global_scope
+
+        scope = scope if scope is not None else global_scope()
+        out = {}
+        if self._loss_scaling is not None:
+            v = scope.get(self._loss_scaling.name)
+            if v is not None:
+                out["loss_scale"] = float(np.asarray(v).reshape(()))
+                _metrics.gauge("amp/loss_scale").set(out["loss_scale"])
+        if self._overflow_steps is not None:
+            v = scope.get(self._overflow_steps.name)
+            if v is not None:
+                out["overflow_steps"] = int(np.asarray(v).reshape(()))
+                _metrics.gauge("amp/overflow_steps").set(
+                    out["overflow_steps"])
+        return out
+
+
+def decorate(optimizer, amp_lists=None, amp_level="O1", dtype="bfloat16",
+             init_loss_scaling=None, use_dynamic_loss_scaling=None,
+             incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+             incr_ratio=2.0, decr_ratio=0.5, master_weight=True):
+    """Wrap `optimizer` for mixed-precision training (parity:
+    contrib/mixed_precision/decorator.py decorate, extended with the
+    Fluid 1.8 amp_level knob).
+
+    Defaults follow the dtype: bfloat16 shares fp32's exponent range, so
+    loss scaling is OFF (scale 1.0, static); float16 turns dynamic loss
+    scaling ON at 2**15. Pass explicit values to override either."""
+    cfg = AmpConfig(level=amp_level, dtype=dtype, lists=amp_lists)
+    f16 = cfg.dtype == "float16"
+    if use_dynamic_loss_scaling is None:
+        use_dynamic_loss_scaling = f16
+    if init_loss_scaling is None:
+        init_loss_scaling = 2.0 ** 15 if f16 else 1.0
+    return AmpOptimizer(optimizer, cfg, init_loss_scaling,
+                        use_dynamic_loss_scaling, incr_every_n_steps,
+                        decr_every_n_nan_or_inf, incr_ratio, decr_ratio,
+                        master_weight=master_weight)
+
+
+# ---------------------------------------------------------------------------
+# gradient bucketing (Megatron-LM DDP parity)
+# ---------------------------------------------------------------------------
+
+
+def mb_to_bucket_bytes(mb):
+    """MiB -> bytes under the one shared convention: <= 0 disables
+    bucketing (None)."""
+    mb = float(mb)
+    return int(mb * (1 << 20)) if mb > 0 else None
+
+
+def bucket_bytes_from_env(default_mb=_DEFAULT_BUCKET_MB):
+    """Bucket size in BYTES from $PTPU_AMP_BUCKET_MB (None = bucketing
+    not requested when `default_mb` is None)."""
+    raw = os.environ.get("PTPU_AMP_BUCKET_MB", "")
+    if raw:
+        try:
+            return mb_to_bucket_bytes(raw)
+        except ValueError:
+            raise ValueError(
+                "PTPU_AMP_BUCKET_MB=%r is not a number" % (raw,))
+    if default_mb is None:
+        return None
+    return mb_to_bucket_bytes(default_mb)
+
+
+class Bucket:
+    """One flattened same-dtype gradient bucket: leaf indices, their
+    flat sizes/offsets, and the padded total length."""
+
+    __slots__ = ("indices", "sizes", "offsets", "size", "padded", "dtype")
+
+    def __init__(self, dtype):
+        self.indices = []
+        self.sizes = []
+        self.offsets = []
+        self.size = 0
+        self.padded = 0
+        self.dtype = dtype
+
+    def nbytes(self):
+        return self.padded * _dtype_itemsize(self.dtype)
+
+
+def _dtype_itemsize(dtype):
+    if _is_bf16(dtype):
+        return 2
+    return np.dtype(dtype).itemsize
+
+
+def _is_bf16(dtype):
+    return "bfloat16" in str(dtype)
+
+
+def plan_buckets(leaves, bucket_bytes, pad_multiple=1, dtype=None):
+    """Group `leaves` (arrays or anything with .shape/.dtype) into
+    flattened buckets of at most `bucket_bytes` each (a single leaf
+    larger than the cap gets its own bucket), grouped by collective
+    dtype and padded to a multiple of `pad_multiple` elements. `dtype`
+    forces one collective dtype for every bucket (e.g. bf16 gradients);
+    None groups by each leaf's own dtype. Records amp/bucket_bytes and
+    amp/buckets telemetry."""
+    groups = {}
+    order = []
+    for i, leaf in enumerate(leaves):
+        dt = dtype if dtype is not None else leaf.dtype
+        key = str(dt)
+        size = int(np.prod(np.shape(leaf))) if np.ndim(leaf) else 1
+        item = _dtype_itemsize(dt)
+        bs = groups.setdefault(key, [])
+        if not bs or (bs[-1].size
+                      and (bs[-1].size + size) * item > bucket_bytes):
+            b = Bucket(dt)
+            bs.append(b)
+            order.append(b)
+        b = bs[-1]
+        b.indices.append(i)
+        b.offsets.append(b.size)
+        b.sizes.append(size)
+        b.size += size
+    for b in order:
+        b.padded = b.size + (-b.size) % max(int(pad_multiple), 1)
+    total = sum(b.padded * _dtype_itemsize(b.dtype) for b in order)
+    _metrics.gauge("amp/bucket_bytes").set(total)
+    _metrics.counter("amp/buckets").inc(len(order))
+    return order
+
+
+def flatten_bucket(bucket, leaves, dtype=None):
+    """Concatenate the bucket's leaves into one padded 1-D array in the
+    bucket's collective dtype (`dtype` overrides it — e.g. fp32 for the
+    master-param buffer sharing a gradient bucket's layout)."""
+    import jax.numpy as jnp
+
+    parts = [jnp.ravel(leaves[i]).astype(dtype or bucket.dtype)
+             for i in bucket.indices]
+    flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+    if bucket.padded > bucket.size:
+        flat = jnp.pad(flat, (0, bucket.padded - bucket.size))
+    return flat
+
+
+def unflatten_bucket(bucket, flat, like_leaves):
+    """{leaf index: array} re-slicing `flat` back into the bucket's
+    leaves, reshaped to (and cast to the dtype of) `like_leaves`."""
+    out = {}
+    for i, off, sz in zip(bucket.indices, bucket.offsets, bucket.sizes):
+        ref = like_leaves[i]
+        out[i] = flat[off:off + sz].reshape(np.shape(ref)).astype(
+            ref.dtype)
+    return out

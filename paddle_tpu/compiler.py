@@ -96,6 +96,14 @@ class BuildStrategy:
         #     propagation from the attention seams (ops/compat_ops.py
         #     flash_attention; SURVEY §5.7 long-context axis)
         self.sequence_parallel_degree = 1
+        #   amp — run the automatic mixed-precision dtype rewrite
+        #     (paddle_tpu/amp.py amp_rewrite pass) for this compiled
+        #     program even without amp.decorate()/PTPU_AMP: white-list
+        #     ops compute in amp_dtype with fp32 master params
+        #     (docs/MIXED_PRECISION.md)
+        self.amp = False
+        self.amp_level = "O1"
+        self.amp_dtype = "bfloat16"
 
 
 def classify_persistable_state(block, fetch_names, inplace=None):

@@ -512,6 +512,10 @@ class Program:
             return True
         if op.type in _OPTIMIZER_OP_TYPES or op.type in _AMP_STATE_OP_TYPES:
             return True
+        if op.attrs.get("__amp_state__"):
+            # AMP bookkeeping built from generic ops (master-weight
+            # re-derive cast, overflow-step counter) — train-only
+            return True
         # the loss-grad seed: fill op writing only @GRAD outputs
         outs = op.output_names()
         return bool(outs) and all(n.endswith("@GRAD") for n in outs)
@@ -598,6 +602,10 @@ class Program:
                     if isinstance(v, Operator) and id(v) in op_map:
                         op.attrs[k] = op_map[id(v)]
         p.param_grad_map = dict(self.param_grad_map)
+        if getattr(self, "_amp_config", None) is not None:
+            # AMP decoration travels with the program: the compile-time
+            # clone (and a user's clone) keeps the dtype-rewrite policy
+            p._amp_config = self._amp_config
         p.current_block_idx = 0
         return p
 

@@ -123,12 +123,24 @@ def program_is_inference(program):
     return result
 
 
+def _amp_cfg(build_strategy=None, program=None):
+    """The AMP config in effect for one compile (None = inactive — the
+    exact pre-AMP pipeline and cache keys). Importing amp here also
+    guarantees the amp_rewrite pass is registered before the pipeline
+    asks for it."""
+    from . import amp
+
+    return amp.active_config(program, build_strategy)
+
+
 def build_pipeline(build_strategy=None, is_test=False, infer_opt=False,
-                   single_block=True):
+                   single_block=True, amp=False):
     """Ordered pass-name list for one compile. `infer_opt` is the
     explicit inference-optimize request (with_inference_optimize /
     AnalysisConfig ir_optim) and adds the numerics-adjusting conv folds;
-    `is_test` alone stays bitwise-preserving."""
+    `is_test` alone stays bitwise-preserving. `amp` (an active
+    amp.AmpConfig resolved by the caller) adds the bf16 dtype rewrite
+    ahead of constant_fold/cse so the inserted casts fold and dedup."""
     names = []
     if (is_test or infer_opt) and single_block:
         # identity at test time (downgrade dropout becomes the identical
@@ -137,6 +149,8 @@ def build_pipeline(build_strategy=None, is_test=False, infer_opt=False,
     if infer_opt:
         names.append("conv_bn_fold_baked")
         names.append("conv_elementwise_add_fuse")
+    if amp:
+        names.append("amp_rewrite")
     names.append("constant_fold")
     names.append("cse")
     if infer_opt or (build_strategy is not None
@@ -158,7 +172,13 @@ def pipeline_key(build_strategy=None, program=None, infer_opt=False):
         return ("noopt",)
     is_test = program_is_inference(program) if program is not None else False
     single = program is None or program.num_blocks == 1
-    key = tuple(build_pipeline(build_strategy, is_test, infer_opt, single))
+    amp_cfg = _amp_cfg(build_strategy, program)
+    key = tuple(build_pipeline(build_strategy, is_test, infer_opt, single,
+                               amp=amp_cfg is not None))
+    if amp_cfg is not None:
+        # flipping PTPU_AMP (or re-decorating with different lists) must
+        # not reuse a compiled step rewritten under the other policy
+        key += ("amp:" + amp_cfg.cache_key(),)
     if build_strategy is not None:
         # enable_inplace selects the donation classification of the
         # compiled step — flipping it must not reuse a stale entry
@@ -174,12 +194,18 @@ def optimize_for_execution(program, fetch_names, scope=None,
     disabled or changed nothing). Called on every compile-cache miss."""
     if not pipeline_enabled():
         return program
+    amp_cfg = _amp_cfg(build_strategy, program)
     names = build_pipeline(build_strategy, program_is_inference(program),
-                           infer_opt, program.num_blocks == 1)
+                           infer_opt, program.num_blocks == 1,
+                           amp=amp_cfg is not None)
     from .ir import get_pass
 
     clone = program.clone()
     clone._opt_fetch_targets = tuple(fetch_names)
+    if amp_cfg is not None:
+        # the clone is what the amp_rewrite pass sees — pin the resolved
+        # config (decoration / BuildStrategy.amp / PTPU_AMP) on it
+        clone._amp_config = amp_cfg
     baked = getattr(program, "_baked_values", None)
     if baked:
         # re-optimizing an already-optimized program (e.g. the

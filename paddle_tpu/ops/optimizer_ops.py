@@ -23,6 +23,14 @@ def _lr(ins):
     return _p(ins, "LearningRate").reshape(())
 
 
+def _g32(ins):
+    """The incoming gradient cast to fp32 exactly ONCE — under AMP
+    (docs/MIXED_PRECISION.md) gradients arrive in bf16 and every update
+    applies to the fp32 master math; for fp32 gradients this is a
+    no-op (bitwise identical update)."""
+    return _p(ins, "Grad").astype(jnp.float32)
+
+
 @register("sgd", differentiable=False)
 def _sgd(ctx, ins, attrs):
     p, g = _p(ins, "Param"), _p(ins, "Grad")
@@ -36,7 +44,7 @@ def _sgd(ctx, ins, attrs):
 
 @register("momentum", differentiable=False)
 def _momentum(ctx, ins, attrs):
-    p, g, v = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "Velocity")
+    p, g, v = _p(ins, "Param"), _g32(ins), _p(ins, "Velocity")
     lr = _lr(ins)
     mu = attrs.get("mu", 0.9)
     use_nesterov = attrs.get("use_nesterov", False)
@@ -50,7 +58,7 @@ def _momentum(ctx, ins, attrs):
 
 @register("lars_momentum", differentiable=False)
 def _lars_momentum(ctx, ins, attrs):
-    p, g, v = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "Velocity")
+    p, g, v = _p(ins, "Param"), _g32(ins), _p(ins, "Velocity")
     lr = _lr(ins)
     mu = attrs.get("mu", 0.9)
     coeff = attrs.get("lars_coeff", 0.001)
@@ -89,7 +97,7 @@ def _adam(ctx, ins, attrs):
 
 @register("adamax", differentiable=False)
 def _adamax(ctx, ins, attrs):
-    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    p, g = _p(ins, "Param"), _g32(ins)
     m, inf_norm = _p(ins, "Moment"), _p(ins, "InfNorm")
     b1p = _p(ins, "Beta1Pow").reshape(())
     lr = _lr(ins)
@@ -105,7 +113,7 @@ def _adamax(ctx, ins, attrs):
 
 @register("adagrad", differentiable=False)
 def _adagrad(ctx, ins, attrs):
-    p, g, mom = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "Moment")
+    p, g, mom = _p(ins, "Param"), _g32(ins), _p(ins, "Moment")
     lr = _lr(ins)
     eps = attrs.get("epsilon", 1e-6)
     mom_out = mom + g * g
@@ -115,7 +123,7 @@ def _adagrad(ctx, ins, attrs):
 
 @register("decayed_adagrad", differentiable=False)
 def _decayed_adagrad(ctx, ins, attrs):
-    p, g, mom = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "Moment")
+    p, g, mom = _p(ins, "Param"), _g32(ins), _p(ins, "Moment")
     lr = _lr(ins)
     decay = attrs.get("decay", 0.95)
     eps = attrs.get("epsilon", 1e-6)
@@ -126,7 +134,7 @@ def _decayed_adagrad(ctx, ins, attrs):
 
 @register("adadelta", differentiable=False)
 def _adadelta(ctx, ins, attrs):
-    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    p, g = _p(ins, "Param"), _g32(ins)
     avg_sq_g = _p(ins, "AvgSquaredGrad")
     avg_sq_u = _p(ins, "AvgSquaredUpdate")
     rho = attrs.get("rho", 0.95)
@@ -140,7 +148,7 @@ def _adadelta(ctx, ins, attrs):
 
 @register("rmsprop", differentiable=False)
 def _rmsprop(ctx, ins, attrs):
-    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    p, g = _p(ins, "Param"), _g32(ins)
     ms, mom = _p(ins, "MeanSquare"), _p(ins, "Moment")
     lr = _lr(ins)
     rho = attrs.get("decay", 0.95)
@@ -165,7 +173,7 @@ def _rmsprop(ctx, ins, attrs):
 
 @register("ftrl", differentiable=False)
 def _ftrl(ctx, ins, attrs):
-    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    p, g = _p(ins, "Param"), _g32(ins)
     sq, lin = _p(ins, "SquaredAccumulator"), _p(ins, "LinearAccumulator")
     lr = _lr(ins)
     l1 = attrs.get("l1", 0.0)
@@ -221,7 +229,7 @@ def _lamb(ctx, ins, attrs):
 
 @register("proximal_gd", differentiable=False)
 def _proximal_gd(ctx, ins, attrs):
-    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    p, g = _p(ins, "Param"), _g32(ins)
     lr = _lr(ins)
     l1 = attrs.get("l1", 0.0)
     l2 = attrs.get("l2", 0.0)
@@ -233,7 +241,7 @@ def _proximal_gd(ctx, ins, attrs):
 
 @register("proximal_adagrad", differentiable=False)
 def _proximal_adagrad(ctx, ins, attrs):
-    p, g, mom = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "Moment")
+    p, g, mom = _p(ins, "Param"), _g32(ins), _p(ins, "Moment")
     lr = _lr(ins)
     l1 = attrs.get("l1", 0.0)
     l2 = attrs.get("l2", 0.0)

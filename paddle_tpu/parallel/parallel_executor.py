@@ -17,8 +17,20 @@ __all__ = ["ParallelExecutor"]
 class ParallelExecutor:
     def __init__(self, use_cuda=True, loss_name=None, main_program=None,
                  share_vars_from=None, exec_strategy=None, build_strategy=None,
-                 num_trainers=1, trainer_id=0, scope=None):
+                 num_trainers=1, trainer_id=0, scope=None, amp=False):
         self._program = main_program or framework.default_main_program()
+        if amp:
+            # convenience: flip the BuildStrategy AMP knob so the bf16
+            # dtype rewrite (docs/MIXED_PRECISION.md) applies to this
+            # executor's compiled step — bf16 gradients also halve the
+            # bytes GSPMD's data-parallel all-reduces move over ICI.
+            # Copy a caller-supplied strategy: a shared BuildStrategy
+            # must not silently go mixed-precision for OTHER executors
+            import copy
+
+            build_strategy = copy.copy(build_strategy) \
+                if build_strategy is not None else BuildStrategy()
+            build_strategy.amp = True
         self._compiled = CompiledProgram(self._program).with_data_parallel(
             loss_name=loss_name,
             build_strategy=build_strategy or BuildStrategy(),

@@ -8,13 +8,22 @@ reduce-scattered along its leading dimension, the optimizer update runs on
 the rank-local 1/n slice of (param, m, v), and updated slices all-gather
 back — optimizer state is born sharded, never materialized whole, exactly
 the memory the pserver param-blocking bought the reference.
-"""
 
-import functools
+Bucketed mode (Megatron-LM DDP parity, docs/MIXED_PRECISION.md): with
+`bucket_mb` set (or $PTPU_AMP_BUCKET_MB in the environment), per-parameter
+gradients are flattened and coalesced into a few large same-dtype buckets
+before the collective — `grad_dtype=jnp.bfloat16` then moves HALF the
+reduce-scatter bytes in a handful of large transfers instead of one small
+fp32 collective per parameter. Optimizer state (m/v) stays fp32, laid out
+flat per bucket and dp-sharded; the update math is identical to the
+per-leaf path (the gradient is cast to fp32 exactly once, after the
+collective).
+"""
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
+
 from ..core.jax_compat import shard_map
 
 
@@ -26,17 +35,60 @@ def _pad_leading(x, n):
 
 
 class ShardedAdam:
-    """Adam with dp-sharded moments (ZeRO-1 / Reduce-mode parity)."""
+    """Adam with dp-sharded moments (ZeRO-1 / Reduce-mode parity).
+
+    bucket_mb: flatten gradients into same-dtype buckets of this many
+    MiB for the reduce-scatter (None = read $PTPU_AMP_BUCKET_MB; 0 or an
+    unset environment = the legacy one-collective-per-leaf path).
+    grad_dtype: dtype the gradients are cast to BEFORE the collective
+    (e.g. jnp.bfloat16 under AMP — half the bytes on the wire); None
+    keeps each gradient's own dtype."""
 
     def __init__(self, learning_rate=1e-3, beta1=0.9, beta2=0.999,
-                 epsilon=1e-8, axis_name="dp"):
+                 epsilon=1e-8, axis_name="dp", grad_dtype=None,
+                 bucket_mb=None):
         self.lr = learning_rate
         self.b1, self.b2, self.eps = beta1, beta2, epsilon
         self.axis = axis_name
+        self.grad_dtype = grad_dtype
+        self.bucket_mb = bucket_mb
+        self._layout = None
+        self._bucketed = None  # resolved by init_state; None = not yet
 
+    def _bucket_bytes(self):
+        from .. import amp
+
+        if self.bucket_mb is not None:
+            return amp.mb_to_bucket_bytes(self.bucket_mb)
+        return amp.bucket_bytes_from_env(default_mb=None)
+
+    # ------------------------------------------------------------------
     def init_state(self, params, mesh):
-        """m/v pytrees sharded over dp on the leading dim (padded)."""
+        """m/v pytrees sharded over dp: per-leaf leading-dim shards in
+        the legacy path, flat per-BUCKET shards in bucketed mode. The
+        mode is LATCHED here — make_step follows this decision even if
+        the environment changes in between (state layout and step
+        function must agree)."""
+        bb = self._bucket_bytes()
+        self._bucketed = bool(bb)
         n = mesh.shape[self.axis]
+        if bb:
+            from .. import amp
+
+            flat, _ = jax.tree.flatten(params)
+            gdt = self.grad_dtype if self.grad_dtype is not None \
+                else jnp.float32
+            self._layout = amp.plan_buckets(flat, bb, pad_multiple=n,
+                                            dtype=gdt)
+            sh = NamedSharding(mesh, P(self.axis))
+
+            def zeros_flat(b):
+                return jax.device_put(jnp.zeros((b.padded,), jnp.float32),
+                                      sh)
+
+            return {"m": [zeros_flat(b) for b in self._layout],
+                    "v": [zeros_flat(b) for b in self._layout],
+                    "step": jnp.zeros((), jnp.int32)}
 
         def zeros_sharded(p):
             shape = ((p.shape[0] + (-p.shape[0]) % n),) + p.shape[1:]
@@ -48,34 +100,45 @@ class ShardedAdam:
                 "v": jax.tree.map(zeros_sharded, params),
                 "step": jnp.zeros((), jnp.int32)}
 
+    # ------------------------------------------------------------------
+    def _local_update(self, g_shard, p_shard, m, v, t):
+        m = self.b1 * m + (1 - self.b1) * g_shard
+        v = self.b2 * v + (1 - self.b2) * jnp.square(g_shard)
+        mhat = m / (1 - self.b1 ** t)
+        vhat = v / (1 - self.b2 ** t)
+        p_new = p_shard - self.lr * mhat / (jnp.sqrt(vhat) + self.eps)
+        return p_new, m, v
+
     def make_step(self, mesh, loss_fn):
         """jit-compiled (params, state, *batch) -> (params, state, loss)
         with grads reduce-scattered and updates computed on local shards."""
+        bucketed = self._bucketed if self._bucketed is not None \
+            else bool(self._bucket_bytes())
+        if bucketed:
+            return self._make_step_bucketed(mesh, loss_fn)
         axis = self.axis
         n = mesh.shape[axis]
-
-        def local_update(g_shard, p_shard, m, v, t):
-            m = self.b1 * m + (1 - self.b1) * g_shard
-            v = self.b2 * v + (1 - self.b2) * jnp.square(g_shard)
-            mhat = m / (1 - self.b1 ** t)
-            vhat = v / (1 - self.b2 ** t)
-            p_new = p_shard - self.lr * mhat / (jnp.sqrt(vhat) + self.eps)
-            return p_new, m, v
 
         def step(params, state, *batch):
             loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
             t = state["step"] + 1
 
             def upd(p, g, m, v):
-                gp = _pad_leading(g.astype(jnp.float32), n)
+                # grad_dtype applies BEFORE the collective in this path
+                # too (halved wire bytes); the fp32 cast moves to the
+                # local shard, after the reduce-scatter
+                gdt = self.grad_dtype if self.grad_dtype is not None \
+                    else jnp.float32
+                gp = _pad_leading(g.astype(gdt), n)
                 pp = _pad_leading(p.astype(jnp.float32), n)
 
                 def inner(gp, pp, m, v):
                     # mean-reduce + scatter the grad to its owner rank
                     gs = jax.lax.psum_scatter(
                         gp, axis, scatter_dimension=0, tiled=True) / n
-                    p_new, m, v = local_update(gs, pp, m, v,
-                                               t.astype(jnp.float32))
+                    p_new, m, v = self._local_update(
+                        gs.astype(jnp.float32), pp, m, v,
+                        t.astype(jnp.float32))
                     # broadcast updated slices back (BCastParamsToDevices
                     # parity, parallel_executor.cc:434)
                     p_full = jax.lax.all_gather(p_new, axis, axis=0,
@@ -102,5 +165,64 @@ class ShardedAdam:
                          "v": tdef.unflatten([o[2] for o in out]),
                          "step": t}
             return new_p, new_state, loss
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def _make_step_bucketed(self, mesh, loss_fn):
+        """Same update math, but the reduce-scatter moves a few large
+        flattened buckets (in grad_dtype) instead of one collective per
+        leaf. Call init_state first — it plans the bucket layout."""
+        from .. import amp
+
+        if self._layout is None:
+            raise RuntimeError(
+                "bucketed ShardedAdam: call init_state(params, mesh) "
+                "before make_step (it plans the bucket layout)")
+        axis = self.axis
+        n = mesh.shape[axis]
+        layout = self._layout
+        spec_full = P()
+        spec_shard = P(axis)
+
+        def step(params, state, *batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
+            t = state["step"] + 1
+            flat_p, tdef = jax.tree.flatten(params)
+            flat_g = tdef.flatten_up_to(grads)
+            new_flat = list(flat_p)
+            new_m, new_v = [], []
+            for k, b in enumerate(layout):
+                gbuf = amp.flatten_bucket(b, flat_g)
+                # params flatten in fp32 REGARDLESS of the collective
+                # dtype — rounding the master copy through bf16 would
+                # destroy the mixed-precision contract
+                pbuf = amp.flatten_bucket(b, flat_p, dtype=jnp.float32)
+
+                def inner(gb, pb, m, v):
+                    # ONE large low-precision reduce-scatter per bucket;
+                    # the fp32 cast happens once, on the local shard
+                    gs = jax.lax.psum_scatter(
+                        gb, axis, scatter_dimension=0, tiled=True) / n
+                    p_new, m, v = self._local_update(
+                        gs.astype(jnp.float32), pb, m, v,
+                        t.astype(jnp.float32))
+                    p_full = jax.lax.all_gather(p_new, axis, axis=0,
+                                                tiled=True)
+                    return p_full, m, v
+
+                p_full, mb, vb = shard_map(
+                    inner, mesh=mesh,
+                    in_specs=(spec_full, spec_shard, spec_shard,
+                              spec_shard),
+                    out_specs=(spec_full, spec_shard, spec_shard),
+                    check_vma=False)(gbuf, pbuf, state["m"][k],
+                                     state["v"][k])
+                for i, seg in amp.unflatten_bucket(b, p_full,
+                                                   flat_p).items():
+                    new_flat[i] = seg
+                new_m.append(mb)
+                new_v.append(vb)
+            return (tdef.unflatten(new_flat),
+                    {"m": new_m, "v": new_v, "step": t}, loss)
 
         return jax.jit(step, donate_argnums=(0, 1))

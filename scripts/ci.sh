@@ -15,7 +15,11 @@
 #                checkpoint, docs/RESILIENCE.md): gates on
 #                resilience/rollbacks >= 1, corrupt-checkpoint fallback,
 #                and final-loss sanity via ptpu_stats --assert-max
-# Usage: scripts/ci.sh [build|test|api_check|bench|bench-smoke|stress|obs|chaos|all]
+#   amp        - mixed-precision receipt (docs/MIXED_PRECISION.md): the
+#                tiny bench fp32-vs-AMP leg pair, gating on the bf16
+#                rewrite firing (amp/casts_inserted >= 1), finite loss,
+#                and the AMP leg not regressing vs fp32
+# Usage: scripts/ci.sh [build|test|api_check|bench|bench-smoke|stress|obs|chaos|amp|all]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -242,6 +246,34 @@ PYEOF
     --assert-max chaos/final_loss=0.1
 }
 
+do_amp() {
+  # mixed-precision receipt (docs/MIXED_PRECISION.md): the tiny
+  # transformer trained plain-fp32 and through paddle_tpu.amp.decorate
+  # in one bench run. Gates: the amp_rewrite pass actually fired
+  # (amp/casts_inserted, amp/ops_rewritten), both legs' losses are
+  # finite and sane (--assert-max; the tiny config starts near
+  # ln(vocab)≈6.2 so 20 catches NaN/divergence without pinning
+  # numerics), and the AMP leg is non-regressing vs fp32 — the floor is
+  # 0.5 because CPU CI emulates bf16 (no MXU win, measured ~0.9x);
+  # on an attached TPU the same gauge records the real speedup.
+  local dump=/tmp/ptpu_amp_metrics.json legs=/tmp/ptpu_amp_legs.json
+  rm -f "$dump" "$legs"
+  JAX_PLATFORMS=cpu PTPU_METRICS=1 \
+    python bench.py --tiny --amp-only --metrics-out "$dump" \
+    --legs-out "$legs"
+  python tools/ptpu_stats.py "$dump" \
+    --assert-has bench/tokens_per_sec_fp32 bench/tokens_per_sec_amp \
+                 bench/amp_speedup_vs_fp32 amp/ops_rewritten \
+    --assert-min amp/casts_inserted=1 bench/amp_speedup_vs_fp32=0.5 \
+    --assert-max bench/amp_last_loss=20 bench/fp32_last_loss=20
+  python - "$legs" <<'PYEOF'
+import json, sys
+legs = {e["leg"]: e for e in json.load(open(sys.argv[1]))}
+assert "fp32" in legs and "amp" in legs, legs
+print("amp stage ok:", {k: v["tokens_per_sec"] for k, v in legs.items()})
+PYEOF
+}
+
 case "$stage" in
   build) do_build ;;
   test) do_build; do_test ;;
@@ -251,6 +283,7 @@ case "$stage" in
   stress) do_stress ;;
   obs) do_obs_smoke ;;
   chaos) do_chaos ;;
-  all) do_build; do_test; do_api_check; do_bench_smoke; do_chaos; do_bench ;;
+  amp) do_amp ;;
+  all) do_build; do_test; do_api_check; do_bench_smoke; do_chaos; do_amp; do_bench ;;
   *) echo "unknown stage: $stage" >&2; exit 2 ;;
 esac

@@ -1,0 +1,634 @@
+"""Automatic mixed-precision training (docs/MIXED_PRECISION.md): the
+amp_rewrite dtype pass (white/black/gray decisions, cast dedup, fetch
+protection), activation precedence (decorate > BuildStrategy.amp >
+PTPU_AMP), the AMP-off bitwise identity pin (ISSUE 5 acceptance: with
+PTPU_AMP unset every program compiles and runs exactly as pre-PR),
+fp32 master weights for low-precision-stored params, f16 dynamic loss
+scaling, loss convergence vs the fp32 run, and Megatron-style gradient
+bucketing (plan/flatten/unflatten + bucketed ShardedAdam)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+import paddle_tpu as fluid
+from paddle_tpu import amp, layers, unique_name
+from paddle_tpu.amp import (AmpConfig, AutoMixedPrecisionLists,
+                            bucket_bytes_from_env, flatten_bucket,
+                            plan_buckets, unflatten_bucket)
+from paddle_tpu.core import scope as scope_mod
+from paddle_tpu.ir_passes import build_pipeline, pipeline_key
+from paddle_tpu.observability import metrics as obs_metrics
+from paddle_tpu.parallel import ShardedAdam
+
+
+@pytest.fixture(autouse=True)
+def _isolate_global_seed_counters():
+    """_reset_build_state zeroes the session-global init-seed counters
+    for its bitwise/convergence reruns; restore them afterwards so this
+    file is invisible to later tests whose initial losses incidentally
+    depend on the session-cumulative counter values."""
+    from paddle_tpu import initializer, layer_helper
+
+    saved = (initializer._global_seed_counter[0],
+             layer_helper._op_seed_counter[0])
+    yield
+    (initializer._global_seed_counter[0],
+     layer_helper._op_seed_counter[0]) = saved
+
+
+def _fresh_scope():
+    scope_mod._scope_stack[:] = [scope_mod.Scope()]
+    return scope_mod.global_scope()
+
+
+def _reset_build_state():
+    """Two builds of the same model must be IDENTICAL (names, init
+    seeds) for the bitwise / convergence comparison runs."""
+    from paddle_tpu import initializer, layer_helper
+
+    fluid.framework.switch_main_program(fluid.Program())
+    fluid.framework.switch_startup_program(fluid.Program())
+    unique_name.switch()
+    initializer._global_seed_counter[0] = 0
+    layer_helper._op_seed_counter[0] = 0
+    return _fresh_scope()
+
+
+def _mlp(prefix="a"):
+    x = layers.data(name=prefix + "_x", shape=[8], dtype="float32")
+    y = layers.data(name=prefix + "_y", shape=[1], dtype="float32")
+    h = layers.fc(x, size=16, act="relu")
+    pred = layers.fc(h, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    return loss
+
+
+def _feed(prefix="a", n=4, seed=0):
+    rng = np.random.RandomState(seed)
+    return {prefix + "_x": rng.randn(n, 8).astype(np.float32),
+            prefix + "_y": rng.randn(n, 1).astype(np.float32)}
+
+
+def _train(decorate=None, steps=1, prefix="a", build_strategy=None,
+           opt_lr=0.05):
+    """Build + train the reference MLP, returning (losses, compiled-step
+    program). `decorate` is a callable(optimizer) -> optimizer."""
+    _reset_build_state()
+    loss = _mlp(prefix)
+    opt = fluid.optimizer.SGD(opt_lr)
+    if decorate is not None:
+        opt = decorate(opt)
+    opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    feed = _feed(prefix)
+    losses = []
+    if build_strategy is not None:
+        target = fluid.compiler.CompiledProgram(
+            fluid.default_main_program()).with_data_parallel(
+                loss_name=loss.name, build_strategy=build_strategy)
+    else:
+        target = fluid.default_main_program()
+    for _ in range(steps):
+        out, = exe.run(target, feed=feed, fetch_list=[loss])
+        losses.append(np.asarray(out))
+    if build_strategy is not None:
+        progs = [s.program for s in target._compiled_steps.values()]
+    else:
+        progs = [s.program for s in exe._cache.values() if s.fetch_names]
+    return losses, (progs[0] if progs else None)
+
+
+def _amp_casts(program):
+    return [op for op in program.global_block().ops
+            if op.type == "cast" and op.attrs.get("__amp_cast__")]
+
+
+# ---------------------------------------------------------------------------
+# the dtype-rewrite pass
+# ---------------------------------------------------------------------------
+
+
+def test_decorate_inserts_bf16_casts_and_trains():
+    losses, prog = _train(decorate=lambda o: fluid.amp.decorate(o),
+                          steps=6)
+    casts = _amp_casts(prog)
+    assert casts, "amp_rewrite inserted no casts"
+    downs = [c for c in casts
+             if fluid.framework.convert_dtype(
+                 c.attrs["out_dtype"]) == "bfloat16"]
+    ups = [c for c in casts
+           if fluid.framework.convert_dtype(
+               c.attrs["out_dtype"]) == "float32"]
+    assert downs and ups  # down-casts into the MXU ops, up at the seams
+    # training converges despite the bf16 compute
+    assert losses[-1].reshape(()) < losses[0].reshape(())
+    assert np.isfinite(losses[-1]).all()
+
+
+def test_white_op_computes_lp_black_op_stays_fp32():
+    """The decision table (docs/MIXED_PRECISION.md): mul inputs get
+    bf16, its output carries bf16, and the value is cast BACK to fp32
+    before any black/gray consumer under O1."""
+    _, prog = _train(decorate=lambda o: fluid.amp.decorate(o))
+    block = prog.global_block()
+    muls = [op for op in block.ops if op.type == "mul"]
+    assert muls
+    for m in muls:
+        for slot in ("X", "Y"):
+            for name in m.input_names(slot):
+                v = block._find_var_recursive(name)
+                assert fluid.framework.convert_dtype(v.dtype) == \
+                    "bfloat16", (m.type, name, v.dtype)
+    # black-list ops read fp32 only
+    for op in block.ops:
+        if op.type in ("mean", "square_error_cost", "softmax"):
+            for slot in op.inputs:
+                for name in op.input_names(slot):
+                    v = block._find_var_recursive(name)
+                    assert fluid.framework.convert_dtype(v.dtype) != \
+                        "bfloat16", (op.type, name)
+
+
+def test_fetched_loss_keeps_fp32_dtype():
+    losses, _ = _train(decorate=lambda o: fluid.amp.decorate(o))
+    assert losses[0].dtype == np.float32
+
+
+def test_cast_dedup_shares_one_cast_per_source(monkeypatch):
+    """Two white ops reading the same fp32 var share ONE inserted cast
+    (keyed on the reaching definition) — amp/casts_deduped receipts."""
+    obs_metrics.enable()
+    try:
+        reg = obs_metrics.registry()
+        base_ins = reg.counter("amp/casts_inserted").value
+        base_dup = reg.counter("amp/casts_deduped").value
+        _reset_build_state()
+        x = layers.data(name="dd_x", shape=[8], dtype="float32")
+        y = layers.data(name="dd_y", shape=[1], dtype="float32")
+        h1 = layers.fc(x, size=16, act="relu")
+        h2 = layers.fc(x, size=16, act="relu")  # same x: cast dedups
+        pred = layers.fc(h1 + h2, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        opt = fluid.amp.decorate(fluid.optimizer.SGD(0.05))
+        opt.minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        rng = np.random.RandomState(0)
+        exe.run(feed={"dd_x": rng.randn(4, 8).astype(np.float32),
+                      "dd_y": rng.randn(4, 1).astype(np.float32)},
+                fetch_list=[loss])
+        assert reg.counter("amp/casts_inserted").value > base_ins
+        assert reg.counter("amp/casts_deduped").value > base_dup
+        step, = [s for s in exe._cache.values() if s.fetch_names]
+        x_casts = [c for c in _amp_casts(step.program)
+                   if c.input_names("X") == ["dd_x"]]
+        assert len(x_casts) == 1, [c.output_names() for c in x_casts]
+    finally:
+        obs_metrics.disable()
+
+
+def test_o2_lets_lp_flow_through_gray_ops():
+    """O2: the white op's bf16 output flows THROUGH elementwise/relu
+    gray ops instead of being raised at every seam — strictly fewer
+    up-casts than O1 on the same graph."""
+    def build(level):
+        return _train(decorate=lambda o: fluid.amp.decorate(
+            o, amp_level=level), steps=2)
+
+    losses1, p1 = build("O1")
+    losses2, p2 = build("O2")
+    ups = {lvl: len([c for c in _amp_casts(p)
+                     if fluid.framework.convert_dtype(
+                         c.attrs["out_dtype"]) == "float32"])
+           for lvl, p in (("O1", p1), ("O2", p2))}
+    assert ups["O2"] < ups["O1"], ups
+    assert np.isfinite(losses2[-1]).all()
+    assert losses2[-1].reshape(()) < losses2[0].reshape(())
+
+
+def test_params_stay_fp32_master_in_scope():
+    """fp32-stored params are their own master: the rewrite casts a
+    COMPUTE copy, the scope value (what the optimizer updates) stays
+    fp32."""
+    _train(decorate=lambda o: fluid.amp.decorate(o), steps=3)
+    sc = scope_mod.global_scope()
+    w = [n for n, _ in sc.items() if n.endswith(".w_0")]
+    assert w
+    for n in w:
+        assert np.asarray(sc.get(n)).dtype == np.float32, n
+
+
+# ---------------------------------------------------------------------------
+# activation precedence + AMP-off identity (acceptance pin)
+# ---------------------------------------------------------------------------
+
+
+def test_amp_off_pipeline_and_keys_are_pre_pr(monkeypatch):
+    monkeypatch.delenv("PTPU_AMP", raising=False)
+    names = build_pipeline()
+    assert "amp_rewrite" not in names
+    key = pipeline_key()
+    assert not any(str(k).startswith("amp:") for k in key), key
+    assert amp.active_config() is None
+
+
+def test_amp_env_flips_pipeline_and_cache_key(monkeypatch):
+    monkeypatch.delenv("PTPU_AMP", raising=False)
+    base = pipeline_key()
+    monkeypatch.setenv("PTPU_AMP", "1")
+    cfg = amp.active_config()
+    assert cfg is not None and cfg.dtype == "bfloat16"
+    key = pipeline_key()
+    assert key != base
+    assert any(str(k).startswith("amp:") for k in key), key
+    # different dtype -> different key (stale compiled steps can't be
+    # reused across policies)
+    monkeypatch.setenv("PTPU_AMP_DTYPE", "float16")
+    assert pipeline_key() != key
+
+
+def test_amp_off_runs_bitwise_identical_to_noopt_path(monkeypatch):
+    """ISSUE 5 acceptance: with PTPU_AMP unset the optimized program
+    contains no AMP casts and the trajectory is bitwise identical to
+    the PTPU_NO_PROGRAM_OPT=1 (pre-pipeline) lowering — the exact
+    test_program_opt identity pattern, re-pinned after the amp_rewrite
+    registration."""
+    monkeypatch.delenv("PTPU_AMP", raising=False)
+    results = []
+    progs = []
+    for noopt in (False, True):
+        if noopt:
+            monkeypatch.setenv("PTPU_NO_PROGRAM_OPT", "1")
+        else:
+            monkeypatch.delenv("PTPU_NO_PROGRAM_OPT", raising=False)
+        _reset_build_state()
+        loss = _mlp("id")
+        opt = fluid.optimizer.SGD(0.05)
+        opt.minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        feed = _feed("id")
+        traj = []
+        for _ in range(3):
+            out, = exe.run(feed=feed, fetch_list=[loss])
+            traj.append(np.asarray(out))
+        results.append(traj)
+        if not noopt:
+            progs = [s.program for s in exe._cache.values()
+                     if s.fetch_names]
+    monkeypatch.delenv("PTPU_NO_PROGRAM_OPT", raising=False)
+    opt_traj, ref_traj = results
+    for a, b in zip(opt_traj, ref_traj):
+        assert a.dtype == b.dtype and np.array_equal(a, b), (a, b)
+    assert not _amp_casts(progs[0])
+    for v in progs[0].global_block().vars:
+        assert "@amp." not in v
+
+
+def test_build_strategy_amp_activates_rewrite(monkeypatch):
+    monkeypatch.delenv("PTPU_AMP", raising=False)
+    bs = fluid.compiler.BuildStrategy()
+    bs.amp = True
+    losses, prog = _train(build_strategy=bs, steps=2)
+    assert prog is not None and _amp_casts(prog)
+    assert np.isfinite(losses[-1]).all()
+
+
+def test_env_activation_inserts_casts(monkeypatch):
+    monkeypatch.setenv("PTPU_AMP", "1")
+    losses, prog = _train(steps=2)
+    assert _amp_casts(prog)
+    assert np.isfinite(losses[-1]).all()
+
+
+def test_decoration_survives_clone():
+    prog = fluid.Program()
+    prog._amp_config = AmpConfig()
+    assert prog.clone()._amp_config is prog._amp_config
+
+
+def test_bad_config_rejected():
+    with pytest.raises(ValueError):
+        AmpConfig(level="O3")
+    with pytest.raises(ValueError):
+        AmpConfig(dtype="int8")
+    with pytest.raises(ValueError):
+        fluid.amp.decorate(fluid.optimizer.SGD(0.1), dtype="float64")
+
+
+# ---------------------------------------------------------------------------
+# loss convergence: bf16 + master weights within tolerance of fp32
+# ---------------------------------------------------------------------------
+
+
+def test_amp_converges_within_tolerance_of_fp32():
+    """Acceptance: the bf16+master-weight run reaches within tolerance
+    of the fp32 run on the tiny train program (same seeds, same
+    steps)."""
+    steps = 12
+    fp32, _ = _train(decorate=None, steps=steps, prefix="cv")
+    amp_l, _ = _train(decorate=lambda o: fluid.amp.decorate(o),
+                      steps=steps, prefix="cv")
+    f_final = float(np.asarray(fp32[-1]).reshape(()))
+    a_final = float(np.asarray(amp_l[-1]).reshape(()))
+    assert np.isfinite(a_final)
+    # both descended...
+    assert f_final < float(np.asarray(fp32[0]).reshape(()))
+    assert a_final < float(np.asarray(amp_l[0]).reshape(()))
+    # ...to the same neighborhood (bf16 has ~3 decimal digits)
+    assert abs(a_final - f_final) <= max(0.15 * abs(f_final), 0.05), \
+        (f_final, a_final)
+
+
+# ---------------------------------------------------------------------------
+# master weights for low-precision-STORED params + f16 loss scaling
+# ---------------------------------------------------------------------------
+
+
+def _bf16_model(prefix="mw"):
+    x = layers.data(name=prefix + "_x", shape=[8], dtype="bfloat16")
+    y = layers.data(name=prefix + "_y", shape=[1], dtype="float32")
+    h = layers.fc(x, size=16, act="relu")
+    pred = layers.fc(layers.cast(h, "float32"), size=1)
+    return layers.mean(layers.square_error_cost(pred, y))
+
+
+def test_bf16_stored_params_get_fp32_masters():
+    _reset_build_state()
+    prog, sprog = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, sprog):
+        loss = _bf16_model()
+        opt = fluid.amp.decorate(fluid.optimizer.SGD(0.1))
+        opt.minimize(loss)
+    masters = [p for p in prog.global_block().all_parameters()
+               if p.name.endswith(".master")]
+    assert masters, "no master weights created for bf16-stored params"
+    for m in masters:
+        assert fluid.framework.convert_dtype(m.dtype) == "float32"
+    # startup initializes each master FROM the low-precision param
+    sops = [op for op in sprog.global_block().ops if op.type == "cast"]
+    assert len(sops) >= len(masters)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(sprog)
+    rng = np.random.RandomState(0)
+    feed = {"mw_x": rng.randn(4, 8).astype(np.float32).astype(
+        jnp.bfloat16), "mw_y": rng.randn(4, 1).astype(np.float32)}
+    losses = []
+    for _ in range(5):
+        out, = exe.run(prog, feed=feed, fetch_list=[loss])
+        losses.append(float(np.asarray(out).reshape(())))
+    assert losses[-1] < losses[0], losses
+    sc = scope_mod.global_scope()
+    m0 = masters[0]
+    assert np.asarray(sc.get(m0.name)).dtype == np.float32
+    # the compute copy is re-derived low-precision from the master
+    pv = sc.get(m0.name[: -len(".master")])
+    assert "bfloat16" in str(pv.dtype)
+    np.testing.assert_allclose(np.asarray(sc.get(m0.name)),
+                               np.asarray(pv, dtype=np.float32),
+                               atol=0.01, rtol=0.01)
+
+
+def test_master_weights_honor_explicit_startup_program():
+    """minimize(loss, startup_program=...) must put the master-init
+    casts in THAT startup, not the ambient default (regression: review
+    finding on _master_for)."""
+    _reset_build_state()
+    prog, sprog = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, sprog):
+        loss = _bf16_model("mw2")
+    opt = fluid.amp.decorate(fluid.optimizer.SGD(0.1))
+    # OUTSIDE the guard: the ambient default startup is a different
+    # program — only the explicit startup_program may receive the
+    # master-init casts
+    opt.minimize(loss, startup_program=sprog)
+    masters = [p for p in prog.global_block().all_parameters()
+               if p.name.endswith(".master")]
+    assert masters
+    ambient = fluid.default_startup_program()
+    for m in masters:
+        assert sprog.global_block().has_var(m.name), m.name
+        assert not ambient.global_block().has_var(m.name), m.name
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(sprog)
+    # the optimizer's own state (lr var) still initializes in the
+    # ambient default startup — run it too, as a real user would
+    exe.run(ambient)
+    rng = np.random.RandomState(0)
+    feed = {"mw2_x": rng.randn(4, 8).astype(np.float32).astype(
+        jnp.bfloat16), "mw2_y": rng.randn(4, 1).astype(np.float32)}
+    l0, = exe.run(prog, feed=feed, fetch_list=[loss])
+    l1, = exe.run(prog, feed=feed, fetch_list=[loss])
+    assert np.isfinite(np.asarray(l1)).all()
+
+
+def test_f16_enables_dynamic_loss_scaling_by_default():
+    _reset_build_state()
+    prog, sprog = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, sprog):
+        loss = _mlp("ls")
+        opt = fluid.amp.decorate(fluid.optimizer.SGD(0.01),
+                                 dtype="float16")
+        opt.minimize(loss)
+    assert opt._scaling_on() and opt._use_dynamic
+    assert opt._init_loss_scaling == 2.0 ** 15
+    types = [op.type for op in prog.global_block().ops]
+    assert "check_finite_and_unscale" in types
+    assert "update_loss_scaling" in types
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(sprog)
+    for _ in range(3):
+        out, = exe.run(prog, feed=_feed("ls"), fetch_list=[loss])
+    assert np.isfinite(np.asarray(out)).all()
+    state = opt.record_metrics()
+    assert np.isfinite(state["loss_scale"])
+    assert state["overflow_steps"] >= 0
+
+
+def test_bf16_loss_scaling_off_by_default():
+    opt = fluid.amp.decorate(fluid.optimizer.SGD(0.01))
+    assert not opt._scaling_on()
+    assert opt._init_loss_scaling == 1.0
+    # explicit override still honored
+    opt2 = fluid.amp.decorate(fluid.optimizer.SGD(0.01),
+                              init_loss_scaling=128.0,
+                              use_dynamic_loss_scaling=True)
+    assert opt2._scaling_on() and opt2._use_dynamic
+
+
+def test_scaling_state_pruned_from_for_test_clone():
+    _reset_build_state()
+    prog, sprog = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, sprog):
+        loss = _mlp("pt")
+        opt = fluid.amp.decorate(fluid.optimizer.SGD(0.01),
+                                 dtype="float16")
+        opt.minimize(loss)
+    test_prog = prog.clone(for_test=True)
+    types = [op.type for op in test_prog.global_block().ops]
+    assert "check_finite_and_unscale" not in types
+    assert "update_loss_scaling" not in types
+    assert not any(op.attrs.get("__amp_state__")
+                   for op in test_prog.global_block().ops)
+
+
+# ---------------------------------------------------------------------------
+# gradient bucketing
+# ---------------------------------------------------------------------------
+
+
+def test_plan_buckets_caps_and_padding():
+    leaves = [np.zeros((100,), np.float32) for _ in range(10)]
+    # 100 fp32 elems = 400B each; 1000B cap -> 2 leaves per bucket
+    buckets = plan_buckets(leaves, 1000, pad_multiple=8)
+    assert len(buckets) == 5
+    for b in buckets:
+        assert len(b.indices) == 2 and b.size == 200
+        assert b.padded % 8 == 0 and b.padded >= b.size
+    # planned order covers every leaf exactly once, in order
+    assert sorted(i for b in buckets for i in b.indices) == list(range(10))
+
+
+def test_plan_buckets_oversized_leaf_gets_own_bucket():
+    leaves = [np.zeros((4,), np.float32), np.zeros((10000,), np.float32),
+              np.zeros((4,), np.float32)]
+    buckets = plan_buckets(leaves, 64)
+    by_leaf = {i: b for b in buckets for i in b.indices}
+    assert by_leaf[1] is not by_leaf[0]
+    assert len(by_leaf[1].indices) == 1
+
+
+def test_plan_buckets_groups_by_dtype_and_forced_dtype():
+    leaves = [np.zeros((8,), np.float32), np.zeros((8,), np.float16),
+              np.zeros((8,), np.float32)]
+    buckets = plan_buckets(leaves, 1 << 20)
+    assert len(buckets) == 2  # fp32 pair + f16 singleton
+    forced = plan_buckets(leaves, 1 << 20, dtype=jnp.bfloat16)
+    assert len(forced) == 1 and forced[0].nbytes() == 24 * 2 // 2 + 24
+
+
+def test_flatten_unflatten_roundtrip_bitwise_fp32():
+    rng = np.random.RandomState(0)
+    leaves = [jnp.asarray(rng.randn(3, 4), jnp.float32),
+              jnp.asarray(rng.randn(7), jnp.float32)]
+    (b,) = plan_buckets(leaves, 1 << 20, pad_multiple=8)
+    flat = flatten_bucket(b, leaves)
+    assert flat.shape == (b.padded,)
+    back = unflatten_bucket(b, flat, leaves)
+    for i, leaf in enumerate(leaves):
+        assert back[i].dtype == leaf.dtype
+        np.testing.assert_array_equal(np.asarray(back[i]),
+                                      np.asarray(leaf))
+
+
+def test_bucket_bytes_from_env(monkeypatch):
+    monkeypatch.delenv("PTPU_AMP_BUCKET_MB", raising=False)
+    assert bucket_bytes_from_env(default_mb=None) is None
+    assert bucket_bytes_from_env(default_mb=2) == 2 << 20
+    monkeypatch.setenv("PTPU_AMP_BUCKET_MB", "0.5")
+    assert bucket_bytes_from_env(default_mb=None) == 1 << 19
+    monkeypatch.setenv("PTPU_AMP_BUCKET_MB", "0")
+    assert bucket_bytes_from_env(default_mb=4) is None
+    monkeypatch.setenv("PTPU_AMP_BUCKET_MB", "nope")
+    with pytest.raises(ValueError):
+        bucket_bytes_from_env()
+
+
+def _dp_mesh():
+    devs = np.array(jax.devices()[:8])
+    return Mesh(devs.reshape(8), ["dp"])
+
+
+def _bucket_problem():
+    rng = np.random.RandomState(2)
+    Wn = (rng.normal(size=(16, 4)) * 0.1).astype(np.float32)
+    bn = (rng.normal(size=(4,)) * 0.1).astype(np.float32)
+    x = jnp.asarray(rng.normal(size=(32, 16)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(32, 4)), jnp.float32)
+
+    def fresh():
+        return {"b": jnp.asarray(bn), "w": jnp.asarray(Wn)}
+
+    def loss_fn(p, x, y):
+        return jnp.mean((x @ p["w"] + p["b"] - y) ** 2)
+
+    return fresh, loss_fn, x, y
+
+
+def _run_sharded(opt, fresh, loss_fn, x, y, steps=2):
+    mesh = _dp_mesh()
+    p = fresh()
+    s = opt.init_state(p, mesh)
+    st = opt.make_step(mesh, loss_fn)
+    losses = []
+    for _ in range(steps):
+        p, s, l = st(p, s, x, y)
+        losses.append(float(l))
+    return np.asarray(p["w"]), losses
+
+
+def test_bucketed_fp32_matches_per_leaf_bitwise():
+    """Coalescing alone must not change the math: fp32 buckets produce
+    the exact per-leaf reduce-scatter result."""
+    fresh, loss_fn, x, y = _bucket_problem()
+    w_ref, l_ref = _run_sharded(
+        ShardedAdam(learning_rate=1e-2, axis_name="dp"),
+        fresh, loss_fn, x, y)
+    w_b, l_b = _run_sharded(
+        ShardedAdam(learning_rate=1e-2, axis_name="dp", bucket_mb=1),
+        fresh, loss_fn, x, y)
+    np.testing.assert_array_equal(w_ref, w_b)
+    assert l_ref == l_b
+
+
+def test_bucketed_bf16_grads_close_and_converging():
+    """bf16 collective buckets: HALF the wire bytes, update within bf16
+    rounding of the fp32 path, still converging."""
+    fresh, loss_fn, x, y = _bucket_problem()
+    w_ref, _ = _run_sharded(
+        ShardedAdam(learning_rate=1e-2, axis_name="dp"),
+        fresh, loss_fn, x, y, steps=4)
+    w_b, losses = _run_sharded(
+        ShardedAdam(learning_rate=1e-2, axis_name="dp",
+                    grad_dtype=jnp.bfloat16, bucket_mb=1),
+        fresh, loss_fn, x, y, steps=4)
+    np.testing.assert_allclose(w_b, w_ref, atol=1e-3, rtol=1e-2)
+    assert losses[-1] < losses[0]
+
+
+def test_bucketed_env_knob_activates(monkeypatch):
+    monkeypatch.setenv("PTPU_AMP_BUCKET_MB", "1")
+    fresh, loss_fn, x, y = _bucket_problem()
+    opt = ShardedAdam(learning_rate=1e-2, axis_name="dp")
+    mesh = _dp_mesh()
+    p = fresh()
+    opt.init_state(p, mesh)
+    assert opt._layout is not None  # bucketed layout planned from env
+    w_ref = None
+    monkeypatch.delenv("PTPU_AMP_BUCKET_MB", raising=False)
+
+
+def test_bucketed_make_step_requires_init_state():
+    opt = ShardedAdam(axis_name="dp", bucket_mb=1)
+    with pytest.raises(RuntimeError):
+        opt.make_step(_dp_mesh(), lambda p, x, y: 0.0)
+
+
+def test_bucket_metrics_recorded():
+    obs_metrics.enable()
+    try:
+        reg = obs_metrics.registry()
+        base = reg.counter("amp/buckets").value
+        leaves = [np.zeros((64,), np.float32) for _ in range(4)]
+        plan_buckets(leaves, 512)
+        assert reg.counter("amp/buckets").value > base
+        assert reg.gauge("amp/bucket_bytes").value > 0
+    finally:
+        obs_metrics.disable()

@@ -29,6 +29,7 @@ NAMESPACES = [
     ("paddle_tpu.resilience", None),
     ("paddle_tpu.checkpoint", None),
     ("paddle_tpu.ir", None),
+    ("paddle_tpu.amp", None),
     ("paddle_tpu.profiler", None),
     ("paddle_tpu.unique_name", None),
     ("paddle_tpu.reader", None),
