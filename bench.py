@@ -311,6 +311,78 @@ def bench_resilience_overhead(steps=48, warmup=8, batch=64,
     return (t1 - t0) / steps, (t2 - t1) / steps
 
 
+def bench_serving(n_requests=32, max_new_tokens=24, rate=100000.0,
+                  max_batch=16, vocab=256, d_model=64, n_heads=2,
+                  n_layers=2, d_ff=128, max_seq_len=128):
+    """Continuous-batching serving throughput (docs/SERVING.md): the
+    SAME deterministic Poisson request stream served twice on one tiny
+    decoder-only model — (a) through an 8-slot continuously-batched
+    ServingEngine, (b) serially, one request at a time through a 1-slot
+    engine (the pre-serving "loop over AnalysisPredictor calls" shape).
+    Aggregate generated tokens/s is the metric; the acceptance gate is
+    batched >= 2x serial with >= 8 concurrent requests, and the two
+    legs' outputs must be token-identical (greedy decode is
+    deterministic — batching may never change what a request gets).
+
+    Returns (batched_tps, serial_tps, outputs_match, p50_s, p99_s,
+    total_tokens)."""
+    from paddle_tpu import serving
+
+    cfg = serving.GenerationConfig(
+        vocab_size=vocab, d_model=d_model, n_heads=n_heads,
+        n_layers=n_layers, d_ff=d_ff, max_seq_len=max_seq_len)
+    model = serving.GenerationModel.random(cfg, seed=0)
+    gen = serving.PoissonLoadGenerator(
+        rate, n_requests, prompt_len=(4, 12),
+        max_new_tokens=max_new_tokens, vocab_size=vocab, seed=0)
+
+    # batched leg: open-loop Poisson arrivals into the shared batch.
+    # One warmup request first: the decode step's XLA compile is a
+    # one-time cost, not steady-state serving throughput (the same
+    # reason every other leg here runs warmup steps).
+    eng = serving.ServingEngine(model, max_batch=max_batch,
+                                max_seq_len=max_seq_len, block_size=16)
+    t0 = time.perf_counter()
+    eng.generate([1, 2], max_new_tokens=2, timeout=600)
+    compile_batched_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    accepted, rejected = gen.run(eng)
+    batched_outs = [r.wait(600) for r in accepted]
+    dt_batched = time.perf_counter() - t0
+    lats = sorted(r.latency for r in accepted)
+    eng.close()
+    total_tokens = sum(len(o) for o in batched_outs)
+
+    # serial leg: the identical stream, one request at a time (no
+    # arrival sleeps — this measures pure serial decode capacity)
+    eng1 = serving.ServingEngine(model, max_batch=1,
+                                 max_seq_len=max_seq_len, block_size=16)
+    eng1.generate([1, 2], max_new_tokens=2, timeout=600)
+    t0 = time.perf_counter()
+    serial_outs = [
+        eng1.generate(spec["prompt"],
+                      max_new_tokens=spec["max_new_tokens"], timeout=600)
+        for spec in gen.make_requests()]
+    dt_serial = time.perf_counter() - t0
+    eng1.close()
+    from paddle_tpu.observability import metrics as obs_metrics
+
+    obs_metrics.registry().gauge(
+        "bench/serving_compile_time_s").set(compile_batched_s)
+
+    if rejected:
+        raise RuntimeError("serving bench rejected %d requests — grow "
+                           "max_queue" % len(rejected))
+
+    def pct(q):
+        return lats[min(len(lats) - 1, int(round(q * (len(lats) - 1))))]
+
+    return (total_tokens / dt_batched,
+            sum(len(o) for o in serial_outs) / dt_serial,
+            batched_outs == serial_outs, pct(0.5), pct(0.99),
+            total_tokens)
+
+
 def _fusion_receipt():
     """One forward-only fc+relu program through CompiledProgram with
     fuse_elewise_add_act_ops on: the bias add + relu collapse into a
@@ -359,6 +431,9 @@ def main(argv=None):
     ap.add_argument("--amp-only", action="store_true",
                     help="run only the fp32-vs-AMP leg pair (the CI amp "
                          "stage configuration)")
+    ap.add_argument("--serving-only", action="store_true",
+                    help="run only the continuous-batching serving leg "
+                         "pair (the CI serve stage configuration)")
     ap.add_argument("--resilience", action="store_true",
                     help="also measure guarded vs unguarded step time "
                          "(always on under --tiny)")
@@ -387,7 +462,9 @@ def main(argv=None):
     compile_opt = compile_noopt = None
     hlo_opt = hlo_noopt = None
     last_loss = None
-    if not args.amp_only:
+    if args.serving_only:
+        args.amp_only = False  # serving leg only: skip everything else
+    if not args.amp_only and not args.serving_only:
         if not args.sync_only:
             async_tps, last_loss, async_step, _ = bench_transformer_fluid(
                 async_exec=True, **kw)
@@ -422,7 +499,7 @@ def main(argv=None):
     # already pays the identical tiny pair via --amp-only).
     fp32_tps = amp_tps = fp32_step = amp_step = None
     fp32_loss = amp_loss = None
-    if args.amp_only or not args.tiny:
+    if args.amp_only or not (args.tiny or args.serving_only):
         fp32_tps, fp32_loss, fp32_step, _ = bench_transformer_fluid(
             async_exec=False, dtype="float32", amp=False, **kw)
         _leg("fp32", fp32_tps, fp32_step, fp32_loss)
@@ -431,15 +508,32 @@ def main(argv=None):
         _leg("amp", amp_tps, amp_step, amp_loss,
              speedup_vs_fp32=round(amp_tps / fp32_tps, 4))
 
+    # continuous-batching serving receipt (docs/SERVING.md): batched vs
+    # serial aggregate tokens/s on the same Poisson stream + identity
+    serve_batched = serve_serial = serve_match = None
+    serve_p50 = serve_p99 = serve_tokens = None
+    if args.serving_only or not (args.tiny or args.amp_only):
+        (serve_batched, serve_serial, serve_match, serve_p50,
+         serve_p99, serve_tokens) = bench_serving()
+        _leg("serving_batched", serve_batched, 0.0,
+             p50_latency_s=round(serve_p50, 4),
+             p99_latency_s=round(serve_p99, 4),
+             outputs_match=bool(serve_match))
+        _leg("serving_serial", serve_serial, 0.0,
+             speedup_batched_vs_serial=round(
+                 serve_batched / serve_serial, 4))
+
     headline = async_tps if async_tps is not None else \
-        (sync_tps if sync_tps is not None else amp_tps)
+        (sync_tps if sync_tps is not None else
+         (amp_tps if amp_tps is not None else serve_batched))
     if last_loss is None:
         last_loss = amp_loss
 
     # resilience-overhead leg (docs/RESILIENCE.md): the guard's cost is
     # measured, not assumed — acceptance is < 5% on the tiny config
     guarded = unguarded = overhead_pct = None
-    if (args.resilience or args.tiny) and not args.amp_only:
+    if (args.resilience or args.tiny) and not (args.amp_only
+                                               or args.serving_only):
         unguarded, guarded = bench_resilience_overhead()
         overhead_pct = 100.0 * (guarded - unguarded) / unguarded
 
@@ -453,7 +547,8 @@ def main(argv=None):
         reg.gauge("bench/tokens_per_sec_per_chip").set(headline)
         reg.gauge("bench/vs_baseline").set(
             headline / BASELINE_TOKENS_PER_SEC)
-        reg.gauge("bench/last_loss").set(last_loss)
+        if last_loss is not None:  # --serving-only trains nothing
+            reg.gauge("bench/last_loss").set(last_loss)
         reg.counter("bench/steps").inc(kw.get("steps", args.steps))
         if sync_tps is not None:  # --amp-only skips the headline legs
             reg.gauge("bench/step_time_sync").set(sync_step)
@@ -480,6 +575,18 @@ def main(argv=None):
             reg.gauge("bench/step_time_guarded").set(guarded)
             reg.gauge("bench/step_time_unguarded").set(unguarded)
             reg.gauge("bench/guard_overhead_pct").set(overhead_pct)
+        if serve_batched is not None:
+            reg.gauge("bench/serving_tokens_per_sec_batched").set(
+                serve_batched)
+            reg.gauge("bench/serving_tokens_per_sec_serial").set(
+                serve_serial)
+            reg.gauge("bench/serving_speedup_vs_serial").set(
+                serve_batched / serve_serial)
+            reg.gauge("bench/serving_outputs_match").set(
+                1.0 if serve_match else 0.0)
+            reg.gauge("bench/serving_p50_latency_s").set(serve_p50)
+            reg.gauge("bench/serving_p99_latency_s").set(serve_p99)
+            reg.gauge("bench/serving_total_tokens").set(serve_tokens)
         reg.dump_json(args.metrics_out)
     if args.legs_out:
         # machine-readable per-leg trajectory (ISSUE 5): BENCH_r*.json
@@ -515,6 +622,13 @@ def main(argv=None):
         result["step_time_guarded_s"] = round(guarded, 6)
         result["step_time_unguarded_s"] = round(unguarded, 6)
         result["guard_overhead_pct"] = round(overhead_pct, 2)
+    if serve_batched is not None:
+        result["serving_tokens_per_sec_batched"] = round(serve_batched, 1)
+        result["serving_tokens_per_sec_serial"] = round(serve_serial, 1)
+        result["serving_speedup_vs_serial"] = round(
+            serve_batched / serve_serial, 4)
+        result["serving_p99_latency_s"] = round(serve_p99, 4)
+        result["serving_outputs_match"] = bool(serve_match)
     print(json.dumps(result))
 
 

@@ -80,9 +80,12 @@ Tensor parse_npy(const std::string& buf) {
     hlen = rd16(p + 8);
     hoff = 10;
   } else {
+    if (buf.size() < 12) die("npy payload truncated");
     hlen = rd32(p + 8);
     hoff = 12;
   }
+  if (hlen > buf.size() - hoff)
+    die("npy header length out of bounds (truncated payload?)");
   std::string hdr = buf.substr(hoff, hlen);
   Tensor t;
   auto grab = [&](const char* key) -> std::string {
@@ -155,6 +158,10 @@ std::map<std::string, Tensor> read_npz(const std::string& path) {
   std::map<std::string, Tensor> out;
   size_t off = cdoff;
   for (uint16_t i = 0; i < n; ++i) {
+    // every central-directory field is attacker-/corruption-controlled:
+    // bounds-check before each dereference so a truncated or corrupt
+    // .npz dies with a message instead of reading out of bounds
+    if (off + 46 > buf.size()) die("npz: truncated central directory");
     if (rd32(p + off) != 0x02014b50) die("npz: bad central header");
     uint16_t method = rd16(p + off + 10);
     uint32_t csize = rd32(p + off + 20);
@@ -162,17 +169,27 @@ std::map<std::string, Tensor> read_npz(const std::string& path) {
     uint16_t xlen = rd16(p + off + 30);
     uint16_t clen = rd16(p + off + 32);
     uint32_t lho = rd32(p + off + 42);
+    if (off + 46 + nlen > buf.size())
+      die("npz: central-directory entry name out of bounds");
     std::string name(buf.data() + off + 46, nlen);
     if (method != 0) die("npz entry " + name + " is compressed; use "
                          "np.savez (stored), not savez_compressed");
     // local header: skip its (possibly different) name/extra lengths
+    if (static_cast<size_t>(lho) + 30 > buf.size())
+      die("npz: local header offset for " + name + " out of bounds");
+    if (rd32(p + lho) != 0x04034b50)
+      die("npz: bad local header for " + name);
     uint16_t lnlen = rd16(p + lho + 26);
     uint16_t lxlen = rd16(p + lho + 28);
-    std::string payload = buf.substr(lho + 30 + lnlen + lxlen, csize);
+    size_t payload_off = static_cast<size_t>(lho) + 30 + lnlen + lxlen;
+    if (payload_off > buf.size() ||
+        static_cast<size_t>(csize) > buf.size() - payload_off)
+      die("npz: payload for " + name + " out of bounds (truncated?)");
+    std::string payload = buf.substr(payload_off, csize);
     if (name.size() > 4 && name.substr(name.size() - 4) == ".npy")
       name = name.substr(0, name.size() - 4);
     out[name] = parse_npy(payload);
-    off += 46 + nlen + xlen + clen;
+    off += 46 + static_cast<size_t>(nlen) + xlen + clen;
   }
   return out;
 }
@@ -514,6 +531,29 @@ int train_loop(PJRT_Client* client, PJRT_Device* device,
     a.compile_options_size = 0;
     check(g_api->PJRT_Client_Compile(&a), "compile train step");
     exec = a.executable;
+  }
+
+  // manifest-vs-executable output arity check (mirrors the inference
+  // path): on version skew between the exported module and the
+  // manifest, executing would write past the results vector below
+  size_t expected_results = mf.state.size() + 1 + mf.outputs.size();
+  {
+    PJRT_LoadedExecutable_GetExecutable_Args g;
+    memset(&g, 0, sizeof(g));
+    g.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+    g.loaded_executable = exec;
+    check(g_api->PJRT_LoadedExecutable_GetExecutable(&g), "get exec");
+    PJRT_Executable_NumOutputs_Args a;
+    memset(&a, 0, sizeof(a));
+    a.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
+    a.executable = g.executable;
+    check(g_api->PJRT_Executable_NumOutputs(&a), "num outputs");
+    if (a.num_outputs != expected_results)
+      die("train-step executable has " + std::to_string(a.num_outputs) +
+          " outputs but the manifest expects " +
+          std::to_string(expected_results) +
+          " (state + counter + fetches) — stale artifact? re-export "
+          "with paddle_tpu.inference.export_native_train_step");
   }
 
   auto state_npz = read_npz(state_path.empty()

@@ -397,8 +397,19 @@ class InMemoryDataset(DatasetBase):
             for s in self._samples:
                 rec = serialize_sample(s)
                 outgoing[zlib.crc32(rec + salt) % self._world].append(rec)
-            self._samples = None  # free the pre-exchange copy
-            records = exchange_samples(endpoints, self._rank, outgoing)
+            # free the deserialized pre-exchange copy (the serialized
+            # records in `outgoing` still hold every local sample), but
+            # keep it RECOVERABLE: a peer failure mid-exchange must not
+            # lose this worker's share of the dataset
+            self._samples = None
+            try:
+                records = exchange_samples(endpoints, self._rank, outgoing)
+            except BaseException:
+                # restore the pre-exchange samples from the outgoing
+                # buckets so the dataset stays usable (retry/local run)
+                self._samples = [deserialize_sample(r)
+                                 for bucket in outgoing for r in bucket]
+                raise
             samples = [deserialize_sample(r) for r in records]
             random.Random(seed * 1000003 + self._rank).shuffle(samples)
             self._samples = samples

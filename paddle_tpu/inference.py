@@ -22,7 +22,8 @@ from .executor import Executor
 
 __all__ = ["AnalysisConfig", "AnalysisPredictor", "create_paddle_predictor",
            "PaddleTensor", "export_serving_model", "load_serving_model",
-           "ServingPredictor"]
+           "ServingPredictor", "export_generation_model",
+           "load_generation_model"]
 
 
 class PaddleTensor:
@@ -371,6 +372,44 @@ def export_native_train_step(dirname, program, feed_shapes, scope=None,
     with open(os.path.join(dirname, "__train_meta__.json"), "w") as f:
         _json.dump(meta, f)
     return state_names
+
+
+# ---------------------------------------------------------------------------
+# Generation-serving artifact (docs/SERVING.md): the training-side
+# transformer program's decoder weights, lifted into the layout the
+# continuous-batching engine's fixed-shape decode step consumes. The
+# artifact directory is shared with the one-shot exports above —
+# export_serving_model's __serving_native__.txt for native_serve, this
+# module's __generation__.npz for paddle_tpu.serving.ServingEngine — so
+# one directory deploys both the Python-free single-call path and the
+# concurrent-traffic path.
+# ---------------------------------------------------------------------------
+
+
+def export_generation_model(dirname, program, scope=None,
+                            max_seq_len=None):
+    """Export a program built by ``models.transformer_fluid.build``
+    (remat=False, dropout_rate=0) as a generation-serving artifact:
+    ``__generation__.npz`` (fp32 decoder weights in the serving layout)
+    plus ``__generation_meta__.json`` (the GenerationConfig). Serve it
+    with ``paddle_tpu.serving.ServingEngine(dirname)`` (or
+    ``load_generation_model``). Returns the GenerationConfig."""
+    from .core.scope import global_scope
+    from .serving import model as _serving_model
+
+    scope = scope if scope is not None else global_scope()
+    config, weights = _serving_model.extract_decoder_weights(
+        program, scope, max_seq_len=max_seq_len)
+    _serving_model.save_generation_artifact(dirname, config, weights)
+    return config
+
+
+def load_generation_model(dirname, name=None):
+    """Load an exported generation artifact as a
+    ``paddle_tpu.serving.GenerationModel`` (ready for ServingEngine)."""
+    from .serving import load_generation_artifact
+
+    return load_generation_artifact(dirname, name=name)
 
 
 class ServingPredictor:

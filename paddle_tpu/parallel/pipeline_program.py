@@ -252,6 +252,15 @@ class _ResidLayout:
                 # re-materialize zeros at unpack, the same treatment
                 # core/lowering.py and dygraph/base.py give float0 grads
                 kind = "float0"
+            elif d == np.float64:
+                # under jax_enable_x64 a float64 residual would silently
+                # lose mantissa bits through the shared fp32 buffer —
+                # refuse instead of downcasting (ADVICE round 5)
+                raise NotImplementedError(
+                    "pipeline_activation_stash cannot pack a float64 "
+                    "residual losslessly through the fp32 stash buffer "
+                    "(jax_enable_x64 run) — use the default recompute "
+                    "mode for float64 models")
             elif np.issubdtype(d, np.inexact) or d == jnp.bfloat16:
                 kind = "f"
             elif d.kind in "iub" and d.itemsize == 4:

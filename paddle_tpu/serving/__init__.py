@@ -1,0 +1,32 @@
+"""`paddle_tpu.serving` — continuous-batching generation serving runtime
+(docs/SERVING.md).
+
+The "millions of users" leg of the north star: a multi-model generation
+service that batches concurrent requests at decode-*step* granularity
+(Orca-style iteration-level scheduling over one fixed-shape XLA step, so
+joins/retires never retrace) with a blocked KV-cache pool (vLLM-style
+block tables) for memory feasibility. ``native_serve`` remains the
+Python-free deployment backend for the same exported artifact directory.
+
+    from paddle_tpu import serving
+    engine = serving.ServingEngine(serving.GenerationModel.random(cfg))
+    req = engine.submit([1, 2, 3], max_new_tokens=16)
+    tokens = engine.result(req)
+"""
+
+from .engine import ServingEngine  # noqa: F401
+from .kv_cache import KVBlockPool, blocks_needed  # noqa: F401
+from .loadgen import PoissonLoadGenerator  # noqa: F401
+from .model import (GenerationConfig, GenerationModel,  # noqa: F401
+                    extract_decoder_weights, load_generation_artifact,
+                    random_weights, reference_decode,
+                    save_generation_artifact)
+from .scheduler import (AdmissionError, GenerationRequest,  # noqa: F401
+                        RequestQueue, StepScheduler)
+
+__all__ = ["ServingEngine", "KVBlockPool", "blocks_needed",
+           "PoissonLoadGenerator", "GenerationConfig", "GenerationModel",
+           "extract_decoder_weights", "load_generation_artifact",
+           "random_weights", "reference_decode",
+           "save_generation_artifact", "AdmissionError",
+           "GenerationRequest", "RequestQueue", "StepScheduler"]

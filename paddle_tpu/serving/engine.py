@@ -1,0 +1,353 @@
+"""`ServingEngine` — the multi-model continuous-batching generation
+service front end.
+
+One process serves N models: each model gets an isolated
+:class:`~paddle_tpu.core.scope.Scope` holding its weights, its own
+blocked KV pool, scheduler, bounded request queue, and a worker thread
+driving the fixed-shape decode step. ``submit()`` is thread-safe and
+non-blocking (admission control raises :class:`AdmissionError` when the
+queue is full); ``result()``/``request.wait()`` is the pull side and
+``stream=`` callbacks are the push side.
+
+Decode steps ride the PR-2 async machinery: the step's input token
+vector chains on *device* from the previous step's output, so the worker
+dispatches step ``k+1`` without materializing step ``k`` — an
+``InflightWindow`` (``async_depth``, default ``$PTPU_SERVE_ASYNC_STEPS``
+or 4) bounds the lag, and EOS detection/streaming callbacks process the
+materialized tokens a few steps behind dispatch. Deterministic finishes
+(``max_new_tokens``, the sequence-length cap) are known at dispatch
+time, so the only cost of the lag is a handful of discarded
+speculative steps after an EOS.
+
+Telemetry (the autoscaling surface, docs/OBSERVABILITY.md):
+``serving/{queue_depth,batch_occupancy,peak_batch_occupancy,
+kv_blocks_in_use,tokens_per_sec,request_latency(_p50/_p99),steps,
+prefill_tokens,decode_tokens,requests_submitted,requests_completed,
+requests_rejected,requests_failed}``.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+
+from ..core.scope import Scope
+from ..observability import metrics as _metrics
+from ..observability import tracing as _tracing
+from .kv_cache import KVBlockPool, blocks_needed
+from .model import GenerationModel, load_generation_artifact
+from .scheduler import (AdmissionError, GenerationRequest, RequestQueue,
+                        StepScheduler)
+
+__all__ = ["ServingEngine"]
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+class _ModelWorker:
+    """Per-model serving state: isolated scope + pool + scheduler +
+    decode loop thread."""
+
+    def __init__(self, name, model, max_batch, max_seq_len, block_size,
+                 num_blocks, max_queue, async_depth, engine):
+        self.name = name
+        self.model = model
+        self.engine = engine
+        cfg = model.config
+        max_seq_len = min(int(max_seq_len), cfg.max_seq_len)
+        if num_blocks is None:
+            # default: enough cache for every slot to run a full-length
+            # sequence concurrently (no admission stalls from the pool)
+            num_blocks = max_batch * blocks_needed(max_seq_len,
+                                                   block_size)
+        self.pool = KVBlockPool(cfg.n_layers, cfg.n_heads, cfg.head_dim,
+                                block_size, num_blocks)
+        self.scheduler = StepScheduler(max_batch, self.pool, max_seq_len)
+        self.queue = RequestQueue(max_queue)
+        self.max_batch = int(max_batch)
+        # bounded in-flight step lag (the PR-2 InflightWindow contract,
+        # with the per-step scheduling plan riding each admitted handle
+        # so lagged processing can fold tokens back into sequences)
+        self.async_depth = max(1, int(async_depth))
+        self._inflight = []  # [(next_tokens_handle, plan)], FIFO
+
+        # isolated per-model scope: the weights the step consumes are
+        # read from here each dispatch, so hot-swapping an entry (or
+        # inspecting one) goes through the same surface training uses
+        self.scope = Scope()
+        for wname, val in model.weights.items():
+            self.scope.set(wname, val)
+        self._weight_names = list(model.weights)
+
+        self._step = model.make_decode_step(
+            self.max_batch, self.scheduler.max_blocks_per_seq)
+        import jax.numpy as jnp
+
+        self._prev_tokens = jnp.zeros((self.max_batch,), jnp.int32)
+
+        self._cv = threading.Condition()
+        self._closing = False
+        self.error = None
+        self._gen_tokens = 0
+        self._t_first_step = None
+        self._t_last_step = None
+        # bounded window for the p50/p99 gauges: a long-lived engine
+        # completes millions of requests, so an ever-growing list
+        # (re-sorted per completion) would be an O(n^2 log n) leak; the
+        # full-fidelity distribution lives in the
+        # serving/request_latency histogram
+        from collections import deque
+        self._latencies = deque(maxlen=1024)
+        self._thread = threading.Thread(
+            target=self._run, name="ptpu-serve-%s" % name, daemon=True)
+        self._thread.start()
+
+    # -- submission side -----------------------------------------------
+    def submit(self, request):
+        worst = blocks_needed(
+            min(len(request.prompt) + request.max_new_tokens,
+                self.scheduler.max_seq_len), self.pool.block_size)
+        if worst > self.pool.blocks_total:
+            raise AdmissionError(
+                "request needs %d KV blocks but the pool holds %d — "
+                "shorten the request or grow num_blocks"
+                % (worst, self.pool.blocks_total))
+        # the liveness checks and the enqueue are one atomic region
+        # under the worker's condition lock: the worker only exits (or
+        # drains the queue on death) while holding the same lock, so a
+        # request can never land in a queue nobody will ever pop
+        with self._cv:
+            if self._closing:
+                raise RuntimeError("ServingEngine is closed")
+            if self.error is not None:
+                raise RuntimeError("serving worker %r died: %r"
+                                   % (self.name, self.error))
+            self.queue.submit(request)
+            self._cv.notify()
+        _metrics.counter("serving/requests_submitted").inc()
+        _metrics.gauge("serving/queue_depth").set(len(self.queue))
+        return request
+
+    # -- decode loop ----------------------------------------------------
+    def _run(self):
+        try:
+            while True:
+                with self._cv:
+                    while (not self._closing and not len(self.queue)
+                           and not self.scheduler.has_work()
+                           and not self._inflight):
+                        self._cv.wait(timeout=0.1)
+                    if (self._closing and not len(self.queue)
+                            and not self.scheduler.has_work()
+                            and not self._inflight):
+                        return
+                self._tick()
+        except BaseException as e:  # deliver, don't vanish
+            # error latch + queue drain run under the cv lock so they
+            # are atomic with submit()'s liveness check (no request can
+            # slip into the queue between the drain and the latch)
+            with self._cv:
+                self.error = e
+                self.scheduler.fail_all(e)
+                while True:
+                    req = self.queue.pop()
+                    if req is None:
+                        break
+                    req._finish(e)
+                    _metrics.counter("serving/requests_failed").inc()
+
+    def _tick(self):
+        """One scheduler round: admit at the boundary, dispatch one
+        fixed-shape step, lag-process materialized tokens, retire."""
+        sched = self.scheduler
+        sched.admit(self.queue)
+        _metrics.gauge("serving/queue_depth").set(len(self.queue))
+        plan = sched.plan_step()
+        if plan:
+            self._dispatch(plan)
+            if len(self._inflight) > self.async_depth - 1:
+                self._process_oldest()
+        elif self._inflight:
+            # nothing left to dispatch — drain the pipeline
+            self._process_oldest()
+        sched.reap()
+        _metrics.gauge("serving/kv_blocks_in_use").set(
+            self.pool.blocks_in_use)
+
+    def _dispatch(self, plan):
+        sched = self.scheduler
+        occupancy = int(sched.active.sum())
+        with _tracing.span("serving_step", model=self.name,
+                           occupancy=occupancy):
+            weights = {n: self.scope.get(n) for n in self._weight_names}
+            self.pool.k, self.pool.v, next_tokens = self._step(
+                weights, self.pool.k, self.pool.v,
+                sched.prompt_feed.copy(), sched.use_prompt.copy(),
+                self._prev_tokens, sched.positions.copy(),
+                sched.block_tables.copy(), sched.active.copy())
+        self._prev_tokens = next_tokens
+        self._inflight.append((next_tokens, plan))
+        _metrics.gauge("serving/inflight_steps").set(len(self._inflight))
+        now = time.perf_counter()
+        if self._t_first_step is None:
+            self._t_first_step = now
+        self._t_last_step = now
+        if _metrics.enabled():
+            reg = _metrics.registry()
+            reg.counter("serving/steps").inc()
+            reg.gauge("serving/batch_occupancy").set(occupancy)
+            peak = reg.gauge("serving/peak_batch_occupancy")
+            if occupancy > peak.value:
+                peak.set(occupancy)
+            n_prefill = sum(1 for _seq, g in plan if g is None)
+            reg.counter("serving/prefill_tokens").inc(n_prefill)
+            reg.counter("serving/decode_tokens").inc(len(plan) - n_prefill)
+
+    def _process_oldest(self):
+        handle, plan = self._inflight.pop(0)
+        _metrics.gauge("serving/inflight_steps").set(len(self._inflight))
+        tokens = np.asarray(handle)
+        for seq, gen_idx in plan:
+            was_done = seq.request.finished
+            self.scheduler.record_token(seq, gen_idx,
+                                        tokens[seq.slot])
+            if seq.request.finished and not was_done:
+                self._note_completion(seq.request)
+        if gen_tokens := sum(1 for _, g in plan if g is not None):
+            self._gen_tokens += gen_tokens
+            if (self._t_first_step is not None
+                    and self._t_last_step > self._t_first_step):
+                _metrics.gauge("serving/tokens_per_sec").set(
+                    self._gen_tokens
+                    / (self._t_last_step - self._t_first_step))
+
+    def _note_completion(self, request):
+        _metrics.counter("serving/requests_completed").inc()
+        lat = request.latency
+        if lat is None:
+            return
+        if _metrics.enabled():
+            _metrics.histogram("serving/request_latency").observe(lat)
+            self._latencies.append(lat)
+            lats = sorted(self._latencies)
+            _metrics.gauge("serving/request_latency_p50").set(
+                _percentile(lats, 0.50))
+            _metrics.gauge("serving/request_latency_p99").set(
+                _percentile(lats, 0.99))
+
+    # -- shutdown -------------------------------------------------------
+    def close(self, timeout=30.0):
+        with self._cv:
+            self._closing = True
+            self._cv.notify_all()
+        self._thread.join(timeout)
+
+
+class ServingEngine:
+    """Multi-model generation service (see module docstring).
+
+    ``models`` is a single :class:`GenerationModel`, an artifact
+    directory (written by ``inference.export_generation_model``), or a
+    ``{name: model-or-artifact-dir}`` dict for multi-model serving.
+    """
+
+    def __init__(self, models, max_batch=8, max_seq_len=256,
+                 block_size=16, num_blocks=None, max_queue=64,
+                 async_depth=None):
+        if async_depth is None:
+            try:
+                async_depth = int(
+                    os.environ.get("PTPU_SERVE_ASYNC_STEPS") or 4)
+            except ValueError:
+                async_depth = 4
+        if not isinstance(models, dict):
+            models = {"default": models}
+        if not models:
+            raise ValueError("ServingEngine needs at least one model")
+        self._workers = {}
+        for name, model in models.items():
+            if isinstance(model, str):
+                model = load_generation_artifact(model, name=name)
+            if not isinstance(model, GenerationModel):
+                raise TypeError(
+                    "model %r must be a GenerationModel or an artifact "
+                    "dir, got %r" % (name, type(model).__name__))
+            self._workers[name] = _ModelWorker(
+                name, model, max_batch=max_batch,
+                max_seq_len=max_seq_len, block_size=block_size,
+                num_blocks=num_blocks, max_queue=max_queue,
+                async_depth=async_depth, engine=self)
+        self._default = next(iter(self._workers))
+        self._closed = False
+
+    # -- public API -----------------------------------------------------
+    @property
+    def model_names(self):
+        return list(self._workers)
+
+    def model_scope(self, model=None):
+        """The named model's isolated weight scope."""
+        return self._workers[model or self._default].scope
+
+    def submit(self, prompt, max_new_tokens=32, eos_id=None, stream=None,
+               model=None):
+        """Enqueue one generation request; returns the
+        :class:`GenerationRequest` handle. Raises
+        :class:`AdmissionError` when the model's queue is full."""
+        if self._closed:
+            raise RuntimeError("ServingEngine is closed")
+        name = model or self._default
+        if name not in self._workers:
+            raise KeyError("unknown model %r (have %r)"
+                           % (name, list(self._workers)))
+        request = GenerationRequest(prompt, max_new_tokens=max_new_tokens,
+                                    eos_id=eos_id, stream=stream,
+                                    model=name)
+        try:
+            return self._workers[name].submit(request)
+        except AdmissionError:
+            _metrics.counter("serving/requests_rejected").inc()
+            raise
+
+    def result(self, request, timeout=None):
+        """Block until `request` completed; returns its token list."""
+        return request.wait(timeout)
+
+    def generate(self, prompt, max_new_tokens=32, eos_id=None,
+                 model=None, timeout=None):
+        """Synchronous convenience: submit + wait."""
+        return self.result(
+            self.submit(prompt, max_new_tokens=max_new_tokens,
+                        eos_id=eos_id, model=model), timeout)
+
+    def stats(self):
+        out = {}
+        for name, w in self._workers.items():
+            out[name] = {
+                "queue_depth": len(w.queue),
+                "batch_occupancy": w.scheduler.num_occupied,
+                "generated_tokens": w._gen_tokens,
+                **w.pool.stats(),
+            }
+        return out
+
+    def close(self, timeout=30.0):
+        """Drain outstanding requests and stop the worker threads."""
+        if self._closed:
+            return
+        self._closed = True
+        for w in self._workers.values():
+            w.close(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,164 @@
+"""Blocked (paged) KV-cache pool for the generation serving runtime
+(vLLM SOSP '23 PagedAttention, mapped onto the framework's fixed-shape
+decode step).
+
+The device side is two dense arrays per model —
+``k``/``v`` of shape ``[n_layers, num_blocks, block_size, n_heads,
+head_dim]`` — that the jitted decode step takes as donated arguments and
+returns updated, so the pool never round-trips over the host link. A
+sequence's cache is NOT contiguous: it owns an ordered list of block ids
+(its *block table*), and the decode step gathers
+``k[layer][block_table]`` to reconstruct the sequence's logical
+``[max_seq_len]`` key/value layout. Fixed shapes everywhere means XLA
+compiles the step exactly once no matter how sequences join and retire.
+
+Block 0 is the *null block*: it is never allocated, every unused
+block-table entry points at it, and inactive batch slots route their
+(masked-out) cache writes into it — so scatter/gather indices are always
+in range without per-slot branches in the compiled step.
+
+Allocation is host-side and two-phase:
+
+  * ``reserve(n)`` at admission: the scheduler reserves the worst-case
+    block count for a request (``ceil((prompt + max_new) / block_size)``)
+    before it joins the batch. Admission control — a request only enters
+    the batch when its whole reservation fits, so the pool can never be
+    exhausted mid-decode and no preemption/swap path is needed.
+  * ``alloc_block(owner)`` per crossing: physical ids are handed out
+    lazily as the sequence's position crosses a block boundary, drawn
+    from the reservation made at admit time.
+
+``free_owner`` returns a retired sequence's blocks to the free list and
+releases any unused remainder of its reservation.
+"""
+
+import threading
+
+import numpy as np
+
+__all__ = ["KVBlockPool", "blocks_needed"]
+
+
+def blocks_needed(num_tokens, block_size):
+    """Blocks required to hold ``num_tokens`` cache slots."""
+    if num_tokens <= 0:
+        return 0
+    return -(-int(num_tokens) // int(block_size))
+
+
+class KVBlockPool:
+    """Fixed-size-block KV cache pool with per-owner block accounting.
+
+    ``num_blocks`` counts usable blocks; one extra null block (id 0) is
+    added on top, so the device arrays hold ``num_blocks + 1`` blocks.
+    """
+
+    NULL_BLOCK = 0
+
+    def __init__(self, n_layers, n_heads, head_dim, block_size,
+                 num_blocks, dtype="float32", device=None):
+        if num_blocks < 1:
+            raise ValueError("KVBlockPool needs at least one usable block")
+        if block_size < 1:
+            raise ValueError("block_size must be >= 1")
+        self.n_layers = int(n_layers)
+        self.n_heads = int(n_heads)
+        self.head_dim = int(head_dim)
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+        self.dtype = np.dtype(dtype)
+
+        import jax.numpy as jnp
+
+        shape = (self.n_layers, self.num_blocks + 1, self.block_size,
+                 self.n_heads, self.head_dim)
+        if device is not None:
+            import jax
+
+            with jax.default_device(device):
+                self.k = jnp.zeros(shape, self.dtype)
+                self.v = jnp.zeros(shape, self.dtype)
+        else:
+            self.k = jnp.zeros(shape, self.dtype)
+            self.v = jnp.zeros(shape, self.dtype)
+
+        self._lock = threading.Lock()
+        # LIFO free list: a retired sequence's blocks are handed to the
+        # next admit while still warm in cache
+        self._free = list(range(self.num_blocks, 0, -1))
+        self._reserved = {}      # owner -> blocks still reservable
+        self._owned = {}         # owner -> [block ids], table order
+
+    # -- accounting ----------------------------------------------------
+    @property
+    def blocks_total(self):
+        return self.num_blocks
+
+    @property
+    def blocks_free(self):
+        """Blocks neither allocated nor spoken for by a reservation."""
+        with self._lock:
+            return len(self._free) - sum(self._reserved.values())
+
+    @property
+    def blocks_in_use(self):
+        with self._lock:
+            return self.num_blocks - len(self._free)
+
+    def stats(self):
+        with self._lock:
+            free = len(self._free)
+            reserved = sum(self._reserved.values())
+        return {
+            "blocks_total": self.num_blocks,
+            "blocks_in_use": self.num_blocks - free,
+            "blocks_reserved": reserved,
+            "blocks_free": free - reserved,
+            "utilization": (self.num_blocks - free) / self.num_blocks,
+        }
+
+    # -- admission-side API --------------------------------------------
+    def can_reserve(self, n):
+        return self.blocks_free >= int(n)
+
+    def reserve(self, owner, n):
+        """Reserve ``n`` blocks for ``owner``. Returns False (reserving
+        nothing) when the pool cannot cover the reservation — the
+        scheduler's admission check."""
+        n = int(n)
+        with self._lock:
+            if owner in self._reserved or owner in self._owned:
+                raise ValueError("owner %r already holds a reservation"
+                                 % (owner,))
+            if len(self._free) - sum(self._reserved.values()) < n:
+                return False
+            self._reserved[owner] = n
+            self._owned[owner] = []
+            return True
+
+    def alloc_block(self, owner):
+        """Hand one physical block id to ``owner``, drawn from its
+        reservation (appends to the owner's block table)."""
+        with self._lock:
+            if self._reserved.get(owner, 0) <= 0:
+                raise RuntimeError(
+                    "owner %r has no remaining reservation — the "
+                    "scheduler must reserve the worst-case block count "
+                    "at admission" % (owner,))
+            bid = self._free.pop()
+            self._reserved[owner] -= 1
+            self._owned[owner].append(bid)
+            return bid
+
+    def block_table(self, owner):
+        with self._lock:
+            return list(self._owned.get(owner, ()))
+
+    def free_owner(self, owner):
+        """Return all of ``owner``'s blocks and release the unused part
+        of its reservation. Idempotent."""
+        with self._lock:
+            blocks = self._owned.pop(owner, [])
+            self._reserved.pop(owner, None)
+            self._free.extend(blocks)
+            return len(blocks)

@@ -1,0 +1,496 @@
+"""Decode-side transformer for the serving runtime.
+
+The serving engine does not re-run the training program descriptor per
+token — generation wants one *fixed-shape* decode step (one token per
+active batch slot, cache reads/writes through block tables) that XLA
+compiles exactly once. This module holds that step and the bridge from
+the training world into it:
+
+  * ``GenerationConfig`` — the decoder-only architecture hyperparameters
+    (the shape of ``models/transformer_fluid.build``: pre-LN blocks,
+    fused QKV, gelu FFN, sinusoidal position encoding, untied LM head).
+  * ``extract_decoder_weights(program, scope)`` — walks a Fluid program
+    built by ``transformer_fluid.build`` (remat=False, dropout=0) and
+    lifts its parameters out of the scope into the serving weight
+    layout. This is what ``inference.export_generation_model`` calls.
+  * ``GenerationModel`` — config + weights; ``make_decode_step`` builds
+    the jitted continuous-batching decode step over a ``KVBlockPool``.
+  * ``reference_decode`` — an unbatched, unpaged greedy decoder over a
+    contiguous cache; the correctness oracle the tests pin the paged
+    batched step against token-for-token.
+
+The decode step's calling convention (all shapes fixed per engine):
+
+    step(weights, kv_k, kv_v, prompt_feed, use_prompt, prev_tokens,
+         positions, block_tables, active)
+      -> (kv_k', kv_v', next_tokens)
+
+``prev_tokens`` is the *device* token vector the previous step returned:
+decode-phase slots chain their input token on device (the host never
+has to materialize a step before dispatching the next — the PR-2
+async-window contract), while prefill-phase slots override it with
+``prompt_feed`` under ``use_prompt``. Inactive slots route their cache
+writes to the pool's null block and their outputs are ignored.
+"""
+
+import math
+
+import numpy as np
+
+__all__ = ["GenerationConfig", "GenerationModel",
+           "extract_decoder_weights", "random_weights",
+           "reference_decode", "save_generation_artifact",
+           "load_generation_artifact"]
+
+# serving-artifact file names (written by
+# inference.export_generation_model next to the one-shot
+# __serving__/__serving_native__ artifacts so native_serve and the
+# continuous-batching engine deploy from ONE directory)
+GENERATION_WEIGHTS = "__generation__.npz"
+GENERATION_META = "__generation_meta__.json"
+
+
+class GenerationConfig:
+    """Decoder-only LM hyperparameters (transformer_fluid.build shape)."""
+
+    def __init__(self, vocab_size, d_model, n_heads, n_layers, d_ff,
+                 max_seq_len=512, pe_alpha=1.0, pe_beta=1.0):
+        if d_model % n_heads:
+            raise ValueError("n_heads must divide d_model")
+        self.vocab_size = int(vocab_size)
+        self.d_model = int(d_model)
+        self.n_heads = int(n_heads)
+        self.n_layers = int(n_layers)
+        self.d_ff = int(d_ff)
+        self.max_seq_len = int(max_seq_len)
+        self.pe_alpha = float(pe_alpha)
+        self.pe_beta = float(pe_beta)
+
+    @property
+    def head_dim(self):
+        return self.d_model // self.n_heads
+
+    def to_dict(self):
+        return {k: getattr(self, k) for k in
+                ("vocab_size", "d_model", "n_heads", "n_layers", "d_ff",
+                 "max_seq_len", "pe_alpha", "pe_beta")}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(**d)
+
+
+# weight-name layout (one flat dict; per-layer names carry an l<i>/
+# prefix). Everything is fp32 on the serving side.
+_LAYER_KEYS = ("ln1_scale", "ln1_bias", "wqkv", "bqkv", "wproj", "bproj",
+               "ln2_scale", "ln2_bias", "wff1", "bff1", "wff2", "bff2")
+
+
+def weight_names(config):
+    names = ["embedding", "lm_head", "final_ln_scale", "final_ln_bias"]
+    for i in range(config.n_layers):
+        names.extend("l%d/%s" % (i, k) for k in _LAYER_KEYS)
+    return names
+
+
+def _position_encoding_table(config):
+    """The exact ``add_position_encoding`` kernel table
+    (ops/nn_ops.py): pe[t] = [sin(t/10000^(2i/d)) | cos(...)]."""
+    d = config.d_model
+    pos = np.arange(config.max_seq_len)[:, None]
+    i = np.arange(d // 2)[None, :]
+    angle = pos / np.power(10000.0, 2 * i / d)
+    return np.concatenate([np.sin(angle), np.cos(angle)],
+                          axis=1).astype(np.float32)
+
+
+def random_weights(config, seed=0, scale=0.1):
+    """Deterministic random weights (tests/bench: a servable model with
+    no training program behind it)."""
+    rng = np.random.RandomState(seed)
+    D, F, V = config.d_model, config.d_ff, config.vocab_size
+
+    def w(*shape):
+        return (rng.randn(*shape) * scale).astype(np.float32)
+
+    weights = {
+        "embedding": w(V, D),
+        "lm_head": w(D, V),
+        "final_ln_scale": np.ones(D, np.float32),
+        "final_ln_bias": np.zeros(D, np.float32),
+    }
+    for i in range(config.n_layers):
+        p = "l%d/" % i
+        weights[p + "ln1_scale"] = np.ones(D, np.float32)
+        weights[p + "ln1_bias"] = np.zeros(D, np.float32)
+        weights[p + "wqkv"] = w(D, 3 * D)
+        weights[p + "bqkv"] = np.zeros(3 * D, np.float32)
+        weights[p + "wproj"] = w(D, D)
+        weights[p + "bproj"] = np.zeros(D, np.float32)
+        weights[p + "ln2_scale"] = np.ones(D, np.float32)
+        weights[p + "ln2_bias"] = np.zeros(D, np.float32)
+        weights[p + "wff1"] = w(D, F)
+        weights[p + "bff1"] = np.zeros(F, np.float32)
+        weights[p + "wff2"] = w(F, D)
+        weights[p + "bff2"] = np.zeros(D, np.float32)
+    return weights
+
+
+# ---------------------------------------------------------------------------
+# extraction from a transformer_fluid.build program
+# ---------------------------------------------------------------------------
+
+
+def extract_decoder_weights(program, scope, max_seq_len=None):
+    """Lift the decoder weights out of a program built by
+    ``models.transformer_fluid.build(remat=False, dropout_rate=0)`` (the
+    bench/CI flagship configuration) into the serving layout.
+
+    The walker is positional over op *types*, so it is insensitive to the
+    interleaved elementwise/reshape plumbing: embeddings come from the
+    ``lookup_table`` op, per-layer weights from the in-order sequence of
+    ``layer_norm`` / ``fused_multihead_attention`` / parameter ``mul``
+    ops, and the LM head from the (chunk-shared) ``lm_head_w`` matmuls.
+    Returns ``(GenerationConfig, weights_dict)`` with everything cast to
+    fp32.
+    """
+    block = program.global_block()
+
+    def _is_param(name):
+        v = block._find_var_recursive(name)
+        return v is not None and getattr(v, "persistable", False)
+
+    def _val(name):
+        val = scope.get(name)
+        if val is None:
+            raise RuntimeError(
+                "parameter %r has no value — run the startup program "
+                "before exporting" % name)
+        return np.asarray(val, np.float32)
+
+    emb = None
+    pe_alpha = pe_beta = 1.0
+    lns, atts, muls = [], [], []
+    pending_mul = None
+    for op in block.ops:
+        if op.type == "lookup_table" and emb is None:
+            emb = op.inputs["W"][0].name
+        elif op.type == "add_position_encoding":
+            pe_alpha = op.attrs.get("alpha", 1.0)
+            pe_beta = op.attrs.get("beta", 1.0)
+        elif op.type == "layer_norm":
+            lns.append((op.inputs["Scale"][0].name,
+                        op.inputs["Bias"][0].name))
+        elif op.type == "fused_multihead_attention":
+            atts.append({k: v[0].name for k, v in op.inputs.items()
+                         if k != "X"})
+        elif op.type == "mul" and _is_param(op.inputs["Y"][0].name):
+            pending_mul = [op.inputs["Y"][0].name, None]
+            muls.append(pending_mul)
+        elif (op.type == "elementwise_add" and pending_mul is not None
+              and _is_param(op.inputs["Y"][0].name)):
+            pending_mul[1] = op.inputs["Y"][0].name
+            pending_mul = None
+        elif op.type == "recompute":
+            raise NotImplementedError(
+                "export_generation_model walks the flat op list — build "
+                "the program with transformer_fluid.build(remat=False)")
+
+    if emb is None or not atts:
+        raise ValueError(
+            "program does not look like transformer_fluid.build output "
+            "(no embedding / fused_multihead_attention ops found)")
+    L = len(atts)
+    if len(lns) != 2 * L + 1:
+        raise ValueError(
+            "expected %d layer_norm ops for %d layers, found %d — only "
+            "the remat=False, dropout_rate=0 build is exportable"
+            % (2 * L + 1, L, len(lns)))
+    ffn_muls = muls[:2 * L]
+    head_muls = muls[2 * L:]
+    head_params = {m[0] for m in head_muls}
+    if len(ffn_muls) != 2 * L or len(head_params) != 1:
+        raise ValueError(
+            "expected 2 FFN matmuls per layer plus one shared LM-head "
+            "parameter; found %d muls over params %r"
+            % (len(muls), sorted({m[0] for m in muls})))
+
+    emb_w = _val(emb)
+    V, D = emb_w.shape
+    wq0 = _val(atts[0]["WQ"])
+    H = wq0.shape[1]
+    F = _val(ffn_muls[0][0]).shape[1]
+    config = GenerationConfig(
+        vocab_size=V, d_model=D, n_heads=H, n_layers=L, d_ff=F,
+        max_seq_len=max_seq_len or 512, pe_alpha=pe_alpha,
+        pe_beta=pe_beta)
+
+    weights = {"embedding": emb_w,
+               "lm_head": _val(next(iter(head_params))),
+               "final_ln_scale": _val(lns[2 * L][0]),
+               "final_ln_bias": _val(lns[2 * L][1])}
+    if weights["lm_head"].shape != (D, V):
+        raise ValueError("LM head shape %r != (d_model, vocab)"
+                         % (weights["lm_head"].shape,))
+    for i in range(L):
+        p = "l%d/" % i
+        att = atts[i]
+        # [D, H, Dh] per-head projections -> fused [D, 3D] qkv matmul
+        wq, wk, wv = (_val(att[k]).reshape(D, D)
+                      for k in ("WQ", "WK", "WV"))
+        weights[p + "wqkv"] = np.concatenate([wq, wk, wv], axis=1)
+        bq, bk, bv = (_val(att[k]).reshape(D) if k in att
+                      else np.zeros(D, np.float32)
+                      for k in ("BQ", "BK", "BV"))
+        weights[p + "bqkv"] = np.concatenate([bq, bk, bv])
+        weights[p + "wproj"] = _val(att["WO"]).reshape(D, D)
+        weights[p + "bproj"] = (_val(att["BO"]) if "BO" in att
+                                else np.zeros(D, np.float32))
+        weights[p + "ln1_scale"] = _val(lns[2 * i][0])
+        weights[p + "ln1_bias"] = _val(lns[2 * i][1])
+        weights[p + "ln2_scale"] = _val(lns[2 * i + 1][0])
+        weights[p + "ln2_bias"] = _val(lns[2 * i + 1][1])
+        for j, nm in ((0, "ff1"), (1, "ff2")):
+            wname, bname = ffn_muls[2 * i + j]
+            weights[p + "w" + nm] = _val(wname)
+            weights[p + "b" + nm] = (
+                _val(bname) if bname is not None
+                else np.zeros(weights[p + "w" + nm].shape[1], np.float32))
+    return config, weights
+
+
+# ---------------------------------------------------------------------------
+# serving artifact (weights npz + meta json)
+# ---------------------------------------------------------------------------
+
+
+def save_generation_artifact(dirname, config, weights):
+    """Write the generation-serving artifact: one STORED npz of fp32
+    weights plus a json config. Returns the npz path."""
+    import json
+    import os
+
+    os.makedirs(dirname, exist_ok=True)
+    path = os.path.join(dirname, GENERATION_WEIGHTS)
+    np.savez(path, **{k: np.asarray(v, np.float32)
+                      for k, v in weights.items()})
+    with open(os.path.join(dirname, GENERATION_META), "w") as f:
+        json.dump(config.to_dict(), f, indent=2, sort_keys=True)
+    return path
+
+
+def load_generation_artifact(dirname, name=None):
+    """Load an exported generation artifact as a ready-to-serve
+    :class:`GenerationModel`."""
+    import json
+    import os
+
+    meta_path = os.path.join(dirname, GENERATION_META)
+    if not os.path.exists(meta_path):
+        raise FileNotFoundError(
+            "%s has no %s — export with "
+            "paddle_tpu.inference.export_generation_model"
+            % (dirname, GENERATION_META))
+    with open(meta_path) as f:
+        config = GenerationConfig.from_dict(json.load(f))
+    with np.load(os.path.join(dirname, GENERATION_WEIGHTS)) as z:
+        weights = {k: z[k] for k in z.files}
+    return GenerationModel(config, weights,
+                           name=name or os.path.basename(dirname))
+
+
+# ---------------------------------------------------------------------------
+# the fixed-shape decode step
+# ---------------------------------------------------------------------------
+
+
+class GenerationModel:
+    """Config + weights + the jitted continuous-batching decode step."""
+
+    def __init__(self, config, weights, name="model"):
+        self.config = config
+        self.name = name
+        missing = [n for n in weight_names(config) if n not in weights]
+        if missing:
+            raise ValueError("missing weights: %s" % missing[:4])
+        import jax.numpy as jnp
+
+        self.weights = {k: jnp.asarray(np.asarray(v, np.float32))
+                        for k, v in weights.items()}
+        # python-trace counter: the body below only executes while jax
+        # traces, so tests can pin "no retrace across join/retire"
+        self.trace_count = 0
+        self._steps = {}
+
+    @classmethod
+    def random(cls, config, seed=0, name="model"):
+        return cls(config, random_weights(config, seed), name=name)
+
+    def _forward_token(self, jnp, weights, x, positions, block_tables,
+                       active, kv_k, kv_v):
+        """One token through all layers. x: [B, D]; returns
+        (kv_k, kv_v, logits[B, V])."""
+        import jax
+
+        cfg = self.config
+        B = x.shape[0]
+        H, Dh = cfg.n_heads, cfg.head_dim
+        bs = kv_k.shape[2]
+        max_ctx = block_tables.shape[1] * bs
+        sm_scale = Dh ** -0.5
+
+        blk_idx = positions // bs
+        slot_idx = positions % bs
+        # inactive slots scatter into the null block (never read back)
+        write_blk = jnp.where(
+            active,
+            jnp.take_along_axis(block_tables, blk_idx[:, None],
+                                axis=1)[:, 0],
+            0)
+
+        def ln(h, scale, bias):
+            mu = jnp.mean(h, axis=-1, keepdims=True)
+            var = jnp.mean((h - mu) ** 2, axis=-1, keepdims=True)
+            return (h - mu) * jax.lax.rsqrt(var + 1e-5) * scale + bias
+
+        # context-position validity: t <= position (the current token's
+        # k/v are written before the gather, so self-attention sees them)
+        t_ids = jnp.arange(max_ctx)[None, :]
+        valid = t_ids <= positions[:, None]
+
+        for i in range(cfg.n_layers):
+            p = "l%d/" % i
+            a = ln(x, weights[p + "ln1_scale"], weights[p + "ln1_bias"])
+            qkv = a @ weights[p + "wqkv"] + weights[p + "bqkv"]
+            q, k_new, v_new = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(B, H, Dh)
+            k_new = k_new.reshape(B, H, Dh)
+            v_new = v_new.reshape(B, H, Dh)
+            kv_k = kv_k.at[i, write_blk, slot_idx].set(k_new)
+            kv_v = kv_v.at[i, write_blk, slot_idx].set(v_new)
+            # paged gather: [B, Mb, bs, H, Dh] -> [B, max_ctx, H, Dh]
+            k_ctx = kv_k[i][block_tables].reshape(B, max_ctx, H, Dh)
+            v_ctx = kv_v[i][block_tables].reshape(B, max_ctx, H, Dh)
+            scores = jnp.einsum("bhd,bthd->bht", q, k_ctx) * sm_scale
+            scores = jnp.where(valid[:, None, :], scores, -jnp.inf)
+            w = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
+            w = w / jnp.sum(w, axis=-1, keepdims=True)
+            ctx = jnp.einsum("bht,bthd->bhd", w, v_ctx).reshape(B, -1)
+            x = x + ctx @ weights[p + "wproj"] + weights[p + "bproj"]
+            b2 = ln(x, weights[p + "ln2_scale"], weights[p + "ln2_bias"])
+            f = jax.nn.gelu(b2 @ weights[p + "wff1"]
+                            + weights[p + "bff1"], approximate=False)
+            x = x + f @ weights[p + "wff2"] + weights[p + "bff2"]
+
+        x = ln(x, weights["final_ln_scale"], weights["final_ln_bias"])
+        return kv_k, kv_v, x @ weights["lm_head"]
+
+    def make_decode_step(self, max_batch, max_blocks_per_seq,
+                         return_logits=False):
+        """Build (and cache) the jitted fixed-shape decode step for this
+        engine geometry. The KV arrays are donated — updates alias
+        in-place in device memory."""
+        key = (int(max_batch), int(max_blocks_per_seq),
+               bool(return_logits))
+        if key in self._steps:
+            return self._steps[key]
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        pe = jnp.asarray(_position_encoding_table(cfg))
+        emb_scale = float(cfg.d_model) ** 0.5
+
+        def step(weights, kv_k, kv_v, prompt_feed, use_prompt,
+                 prev_tokens, positions, block_tables, active):
+            self.trace_count += 1
+            tok = jnp.where(use_prompt, prompt_feed, prev_tokens)
+            tok = jnp.clip(tok, 0, cfg.vocab_size - 1)
+            x = (jnp.take(weights["embedding"], tok, axis=0) * emb_scale
+                 * cfg.pe_alpha
+                 + cfg.pe_beta * jnp.take(pe, positions, axis=0))
+            kv_k, kv_v, logits = self._forward_token(
+                jnp, weights, x, positions, block_tables, active,
+                kv_k, kv_v)
+            next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            if return_logits:
+                return kv_k, kv_v, next_tokens, logits
+            return kv_k, kv_v, next_tokens
+
+        jitted = jax.jit(step, donate_argnums=(1, 2))
+        self._steps[key] = jitted
+        return jitted
+
+
+# ---------------------------------------------------------------------------
+# unbatched, unpaged reference decoder (the correctness oracle)
+# ---------------------------------------------------------------------------
+
+
+def reference_decode(model, prompt, max_new_tokens, eos_id=None):
+    """Greedy-decode ONE sequence with a plain contiguous KV cache and
+    full attention — no blocks, no batching, no masking tricks. The
+    batched paged decode must match this token-for-token."""
+    import jax.numpy as jnp
+
+    cfg = model.config
+    w = model.weights
+    pe = _position_encoding_table(cfg)
+    emb_scale = float(cfg.d_model) ** 0.5
+    H, Dh = cfg.n_heads, cfg.head_dim
+    sm_scale = Dh ** -0.5
+
+    def ln(h, scale, bias):
+        mu = np.mean(h, keepdims=True)
+        var = np.mean((h - mu) ** 2, keepdims=True)
+        return (h - mu) / np.sqrt(var + 1e-5) * np.asarray(scale) \
+            + np.asarray(bias)
+
+    ks = [[] for _ in range(cfg.n_layers)]
+    vs = [[] for _ in range(cfg.n_layers)]
+    tokens = list(prompt)
+    generated = []
+
+    def one(tok, pos):
+        x = (np.asarray(w["embedding"])[tok] * emb_scale * cfg.pe_alpha
+             + cfg.pe_beta * pe[pos])
+        for i in range(cfg.n_layers):
+            p = "l%d/" % i
+            a = ln(x, w[p + "ln1_scale"], w[p + "ln1_bias"])
+            qkv = a @ np.asarray(w[p + "wqkv"]) + np.asarray(
+                w[p + "bqkv"])
+            q, k_new, v_new = np.split(qkv, 3)
+            ks[i].append(k_new.reshape(H, Dh))
+            vs[i].append(v_new.reshape(H, Dh))
+            k_ctx = np.stack(ks[i])            # [T, H, Dh]
+            v_ctx = np.stack(vs[i])
+            qh = q.reshape(H, Dh)
+            scores = np.einsum("hd,thd->ht", qh, k_ctx) * sm_scale
+            scores = scores - scores.max(axis=-1, keepdims=True)
+            wgt = np.exp(scores)
+            wgt = wgt / wgt.sum(axis=-1, keepdims=True)
+            ctx = np.einsum("ht,thd->hd", wgt, v_ctx).reshape(-1)
+            x = x + ctx @ np.asarray(w[p + "wproj"]) + np.asarray(
+                w[p + "bproj"])
+            b2 = ln(x, w[p + "ln2_scale"], w[p + "ln2_bias"])
+            h = b2 @ np.asarray(w[p + "wff1"]) + np.asarray(w[p + "bff1"])
+            # exact (erf) gelu, matching jax.nn.gelu(approximate=False)
+            h = h * 0.5 * (1.0 + np.vectorize(math.erf)(
+                h / np.sqrt(2.0)))
+            x = x + h @ np.asarray(w[p + "wff2"]) + np.asarray(
+                w[p + "bff2"])
+        x = ln(x, w["final_ln_scale"], w["final_ln_bias"])
+        logits = x @ np.asarray(w["lm_head"])
+        return int(np.argmax(logits))
+
+    nxt = None
+    for pos, tok in enumerate(tokens):
+        nxt = one(tok, pos)
+    pos = len(tokens)
+    while len(generated) < max_new_tokens and pos < cfg.max_seq_len:
+        generated.append(nxt)
+        if eos_id is not None and nxt == eos_id:
+            break
+        nxt = one(generated[-1], pos)
+        pos += 1
+    return generated

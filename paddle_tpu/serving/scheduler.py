@@ -1,0 +1,322 @@
+"""Request queue and iteration-level (continuous-batching) scheduler
+(Orca OSDI '22 mapped onto a fixed-shape XLA decode step).
+
+The unit of scheduling is one *decode step*: every active batch slot
+advances by exactly one token per step, and sequences join/retire only
+at step boundaries. The compiled step's shapes never change — admission
+fills a free slot's row in the (fixed ``[max_batch]``) input arrays and
+flips its ``active`` flag, retirement flips it back — so XLA never
+retraces no matter how traffic arrives.
+
+Admission control is two-gated:
+
+  * queue gate — ``RequestQueue`` bounds how many requests may wait;
+    past ``max_queue`` a submit fails fast with ``AdmissionError``
+    (callers see backpressure instead of unbounded memory growth).
+  * KV gate — a queued request joins the batch only when the block pool
+    can reserve its worst-case block count (``blocks_needed(prompt +
+    max_new)``), so decode can never deadlock on cache exhaustion.
+    Head-of-line order is preserved: if the head request doesn't fit,
+    nothing behind it jumps the queue (no starvation of big requests).
+
+Prefill rides the same step (Orca's iteration-level scheduling): a
+just-admitted sequence consumes one prompt token per step (``use_prompt``
+rows) until its prompt is exhausted, after which its input token chains
+on-device from the previous step's output.
+"""
+
+import itertools
+import threading
+import time
+from collections import deque
+
+from ..observability import metrics as _metrics
+from .kv_cache import blocks_needed
+
+__all__ = ["AdmissionError", "GenerationRequest", "RequestQueue",
+           "StepScheduler"]
+
+_req_ids = itertools.count()
+
+
+class AdmissionError(RuntimeError):
+    """Raised by submit() when the request queue is at capacity."""
+
+
+class GenerationRequest:
+    """One generation request plus its completion surface.
+
+    ``stream`` (optional) is called as ``stream(request, token_id,
+    finished)`` from the engine thread for every generated token, in
+    order. ``wait()``/``result`` is the pull side.
+    """
+
+    def __init__(self, prompt, max_new_tokens=32, eos_id=None,
+                 stream=None, model=None):
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("prompt must hold at least one token")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.id = next(_req_ids)
+        self.model = model
+        self.prompt = prompt
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.stream = stream
+        self.submit_time = time.perf_counter()
+        self.start_time = None      # admitted to the batch
+        self.finish_time = None
+        self.tokens = []            # generated ids (truncated at EOS)
+        self.error = None
+        self._done = threading.Event()
+
+    # -- completion surface --------------------------------------------
+    @property
+    def finished(self):
+        return self._done.is_set()
+
+    def wait(self, timeout=None):
+        """Block until the request completed; returns the generated
+        token list. Raises the engine-side error, if any."""
+        if not self._done.wait(timeout):
+            raise TimeoutError("request %d not finished" % self.id)
+        if self.error is not None:
+            raise self.error
+        return list(self.tokens)
+
+    @property
+    def latency(self):
+        if self.finish_time is None:
+            return None
+        return self.finish_time - self.submit_time
+
+    def _finish(self, error=None):
+        self.error = error
+        self.finish_time = time.perf_counter()
+        self._done.set()
+
+
+class RequestQueue:
+    """Bounded FIFO with fail-fast admission (the queue gate)."""
+
+    def __init__(self, max_queue=64):
+        self.max_queue = int(max_queue)
+        self._q = deque()
+        self._lock = threading.Lock()
+
+    def __len__(self):
+        return len(self._q)
+
+    def submit(self, request):
+        with self._lock:
+            if len(self._q) >= self.max_queue:
+                raise AdmissionError(
+                    "request queue full (%d waiting); retry later or "
+                    "raise max_queue" % len(self._q))
+            self._q.append(request)
+        return request
+
+    def peek(self):
+        with self._lock:
+            return self._q[0] if self._q else None
+
+    def pop(self):
+        with self._lock:
+            return self._q.popleft() if self._q else None
+
+
+class _Sequence:
+    """Scheduler-internal per-slot decode state."""
+
+    __slots__ = ("request", "slot", "pos", "n_dispatched", "pending",
+                 "finished", "dispatch_done")
+
+    def __init__(self, request, slot):
+        self.request = request
+        self.slot = slot
+        self.pos = 0             # position of the NEXT token to process
+        self.n_dispatched = 0    # generated tokens dispatched so far
+        self.pending = 0         # dispatched steps not yet processed
+        self.finished = False    # result delivered (EOS/max/seq-cap)
+        self.dispatch_done = False  # no more steps will be dispatched
+
+    @property
+    def in_prefill(self):
+        return self.pos < len(self.request.prompt)
+
+
+class StepScheduler:
+    """Joins/retires sequences at step boundaries over fixed slots.
+
+    The engine drives it:  ``admit()`` → ``plan_step()`` → dispatch →
+    (lagged) ``record_token()`` per decode output → ``reap()``.
+    """
+
+    def __init__(self, max_batch, pool, max_seq_len):
+        import numpy as np
+
+        self.max_batch = int(max_batch)
+        self.pool = pool
+        self.max_seq_len = int(max_seq_len)
+        self.slots = [None] * self.max_batch
+        # persistent step-input arrays (host side, fixed shapes)
+        self._np = np
+        mb = blocks_needed(self.max_seq_len, pool.block_size)
+        self.max_blocks_per_seq = mb
+        self.block_tables = np.zeros((self.max_batch, mb), np.int32)
+        self.prompt_feed = np.zeros(self.max_batch, np.int32)
+        self.use_prompt = np.zeros(self.max_batch, bool)
+        self.positions = np.zeros(self.max_batch, np.int32)
+        self.active = np.zeros(self.max_batch, bool)
+
+    # -- occupancy ------------------------------------------------------
+    @property
+    def num_active(self):
+        return sum(1 for s in self.slots
+                   if s is not None and not s.dispatch_done)
+
+    @property
+    def num_occupied(self):
+        return sum(1 for s in self.slots if s is not None)
+
+    def has_work(self):
+        return any(s is not None for s in self.slots)
+
+    # -- admission (step boundary) -------------------------------------
+    def _budget_for(self, request):
+        total = min(len(request.prompt) + request.max_new_tokens,
+                    self.max_seq_len)
+        return blocks_needed(total, self.pool.block_size)
+
+    def admit(self, queue):
+        """Move queued requests into free slots while the KV pool can
+        cover their reservations (head-of-line order). Returns the list
+        of admitted sequences."""
+        admitted = []
+        for slot in range(self.max_batch):
+            if self.slots[slot] is not None:
+                continue
+            request = queue.peek()
+            if request is None:
+                break
+            if len(request.prompt) >= self.max_seq_len:
+                queue.pop()
+                request._finish(ValueError(
+                    "prompt length %d >= engine max_seq_len %d"
+                    % (len(request.prompt), self.max_seq_len)))
+                _metrics.counter("serving/requests_failed").inc()
+                continue
+            seq = _Sequence(request, slot)
+            if not self.pool.reserve(seq, self._budget_for(request)):
+                break  # KV gate: head doesn't fit — keep queue order
+            queue.pop()
+            request.start_time = time.perf_counter()
+            self.slots[slot] = seq
+            self.block_tables[slot, :] = self.pool.NULL_BLOCK
+            self.positions[slot] = 0
+            self.active[slot] = True
+            admitted.append(seq)
+        return admitted
+
+    # -- step planning --------------------------------------------------
+    def plan_step(self):
+        """Fill the fixed step-input arrays for the next decode step and
+        return the per-step processing plan: a list of
+        ``(seq, generated_index | None)`` rows, one per dispatching
+        slot (``None`` while the slot is still consuming its prompt)."""
+        plan = []
+        for slot, seq in enumerate(self.slots):
+            if seq is None or seq.dispatch_done:
+                self.active[slot] = False
+                self.use_prompt[slot] = False
+                continue
+            pos = seq.pos
+            # lazy block allocation at boundary crossings (drawn from
+            # the admission-time reservation, so it cannot fail)
+            if pos % self.pool.block_size == 0:
+                bid = self.pool.alloc_block(seq)
+                self.block_tables[slot, pos // self.pool.block_size] = bid
+            self.positions[slot] = pos
+            self.active[slot] = True
+            if seq.in_prefill:
+                self.prompt_feed[slot] = seq.request.prompt[pos]
+                self.use_prompt[slot] = True
+                # the step consuming the LAST prompt token emits the
+                # first generated token
+                gen_idx = (0 if pos == len(seq.request.prompt) - 1
+                           else None)
+            else:
+                self.use_prompt[slot] = False
+                gen_idx = seq.n_dispatched
+            if gen_idx is not None:
+                seq.n_dispatched = gen_idx + 1
+            seq.pos = pos + 1
+            seq.pending += 1
+            plan.append((seq, gen_idx))
+            if (seq.n_dispatched >= seq.request.max_new_tokens
+                    or seq.pos >= self.max_seq_len):
+                seq.dispatch_done = True
+        return plan
+
+    # -- lagged result processing --------------------------------------
+    def record_token(self, seq, gen_idx, token):
+        """Fold one materialized decode output back into its sequence
+        (called in dispatch order — possibly several steps after the
+        dispatch, under the async window)."""
+        seq.pending -= 1
+        if gen_idx is None or seq.finished:
+            return
+        request = seq.request
+        if len(request.tokens) != gen_idx:
+            # a later step of a sequence that already hit EOS — the
+            # overshoot tokens are dropped
+            return
+        request.tokens.append(int(token))
+        hit_eos = (request.eos_id is not None
+                   and int(token) == request.eos_id)
+        final = (hit_eos
+                 or len(request.tokens) >= request.max_new_tokens
+                 or (seq.dispatch_done
+                     and gen_idx == seq.n_dispatched - 1))
+        if request.stream is not None:
+            try:
+                request.stream(request, int(token), bool(final))
+            except Exception:
+                pass  # a streaming consumer must not kill the engine
+        if final:
+            seq.finished = True
+            seq.dispatch_done = True
+            request._finish()
+
+    def reap(self):
+        """Retire slots whose sequence is complete AND fully drained
+        (no in-flight step still scatters into their blocks). Returns
+        the number of freed slots."""
+        freed = 0
+        for slot, seq in enumerate(self.slots):
+            if seq is None or seq.pending:
+                continue
+            if seq.dispatch_done and not seq.finished:
+                # ran out of budget (max_new/max_seq) without EOS
+                seq.finished = True
+                seq.request._finish()
+            if seq.finished:
+                self.pool.free_owner(seq)
+                self.slots[slot] = None
+                self.active[slot] = False
+                freed += 1
+        return freed
+
+    def fail_all(self, error):
+        """Engine-fatal path: deliver `error` to every occupied slot and
+        free its blocks."""
+        for slot, seq in enumerate(self.slots):
+            if seq is None:
+                continue
+            self.pool.free_owner(seq)
+            if not seq.request.finished:
+                seq.request._finish(error)
+                _metrics.counter("serving/requests_failed").inc()
+            self.slots[slot] = None
+            self.active[slot] = False

@@ -19,7 +19,12 @@
 #                tiny bench fp32-vs-AMP leg pair, gating on the bf16
 #                rewrite firing (amp/casts_inserted >= 1), finite loss,
 #                and the AMP leg not regressing vs fp32
-# Usage: scripts/ci.sh [build|test|api_check|bench|bench-smoke|stress|obs|chaos|amp|all]
+#   serve      - continuous-batching serving receipt (docs/SERVING.md):
+#                the same Poisson request stream through a batched vs a
+#                serial engine, gating on occupancy > 1, token-identical
+#                outputs, finite request latencies, and batched >= 2x
+#                serial aggregate tokens/s
+# Usage: scripts/ci.sh [build|test|api_check|bench|bench-smoke|stress|obs|chaos|amp|serve|all]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -274,6 +279,54 @@ print("amp stage ok:", {k: v["tokens_per_sec"] for k, v in legs.items()})
 PYEOF
 }
 
+do_serve() {
+  # serving receipt (docs/SERVING.md): one deterministic Poisson stream
+  # served through a 16-slot continuously-batched engine and replayed
+  # serially through a 1-slot engine. Gates: the batch actually filled
+  # (peak occupancy > 1), every request completed with finite latency
+  # (p99 bound), batching never changed any request's tokens
+  # (serving_outputs_match — greedy decode is deterministic), and
+  # continuous batching bought >= 2x aggregate tokens/s over serial
+  # decoding (measured ~3-4x on the 2-core CI box, ISSUE 6 acceptance).
+  # The throughput ratio is a measurement on a shared box, so a run
+  # that misses the bar retries up to twice; the functional gates
+  # (occupancy/identity/latency) must hold on every attempt.
+  local dump=/tmp/ptpu_serve_metrics.json legs=/tmp/ptpu_serve_legs.json
+  local attempt rc=1
+  for attempt in 1 2 3; do
+    rm -f "$dump" "$legs"
+    JAX_PLATFORMS=cpu PTPU_METRICS=1 \
+      python bench.py --serving-only --metrics-out "$dump" \
+      --legs-out "$legs"
+    python tools/ptpu_stats.py "$dump" \
+      --assert-has serving/request_latency serving/tokens_per_sec \
+                   serving/queue_depth serving/batch_occupancy \
+                   bench/serving_tokens_per_sec_batched \
+                   bench/serving_tokens_per_sec_serial \
+      --assert-min serving/peak_batch_occupancy=2 \
+                   serving/requests_completed=1 \
+                   bench/serving_outputs_match=1 \
+      --assert-max serving/request_latency_p99=120 \
+                   bench/serving_p99_latency_s=120
+    set +e
+    python tools/ptpu_stats.py "$dump" \
+      --assert-min bench/serving_speedup_vs_serial=2
+    rc=$?
+    set -e
+    [ "$rc" -eq 0 ] && break
+    echo "serving speedup below 2x (loaded box?) — retry $attempt/2" >&2
+  done
+  [ "$rc" -eq 0 ]
+  python - "$legs" <<'PYEOF'
+import json, sys
+legs = {e["leg"]: e for e in json.load(open(sys.argv[1]))}
+assert "serving_batched" in legs and "serving_serial" in legs, legs
+assert legs["serving_batched"]["outputs_match"], legs
+print("serve stage ok:",
+      {k: v["tokens_per_sec"] for k, v in legs.items()})
+PYEOF
+}
+
 case "$stage" in
   build) do_build ;;
   test) do_build; do_test ;;
@@ -284,6 +337,7 @@ case "$stage" in
   obs) do_obs_smoke ;;
   chaos) do_chaos ;;
   amp) do_amp ;;
-  all) do_build; do_test; do_api_check; do_bench_smoke; do_chaos; do_amp; do_bench ;;
+  serve) do_serve ;;
+  all) do_build; do_test; do_api_check; do_bench_smoke; do_chaos; do_amp; do_serve; do_bench ;;
   *) echo "unknown stage: $stage" >&2; exit 2 ;;
 esac

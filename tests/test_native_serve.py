@@ -25,8 +25,13 @@ _LIBTPU = "/opt/venv/lib/python3.12/site-packages/libtpu/libtpu.so"
 
 
 def _need_bin():
-    if not os.path.exists(_BIN):
-        pytest.skip("native_serve not built (make -C native)")
+    # the binary is a build artifact (no longer committed): build it
+    # from source so the tests can never exercise a stale ELF
+    r = subprocess.run(["make", "-C", os.path.dirname(_BIN), "-s",
+                        "native_serve"], capture_output=True, text=True)
+    if r.returncode != 0 or not os.path.exists(_BIN):
+        pytest.skip("native_serve build failed (make -C native "
+                    "native_serve): %s" % (r.stderr or r.stdout)[-400:])
 
 
 def test_npz_roundtrip_matches_numpy(tmp_path):
