@@ -600,9 +600,16 @@ def decorate(optimizer, amp_lists=None, amp_level="O1", dtype="bfloat16",
 
 
 def mb_to_bucket_bytes(mb):
-    """MiB -> bytes under the one shared convention: <= 0 disables
-    bucketing (None)."""
+    """MiB -> bytes under the one shared convention: 0 is the documented
+    off switch (None = bucketing disabled). Anything that cannot become a
+    sane capacity — NaN, negative values — raises here, at the knob, so a
+    typo'd $PTPU_AMP_BUCKET_MB can never propagate a NaN bucket cap into
+    plan_buckets."""
     mb = float(mb)
+    if np.isnan(mb) or mb < 0:
+        raise ValueError(
+            "bucket size %r MiB is not a valid capacity (use a positive "
+            "number of MiB, or 0 to disable bucketing)" % (mb,))
     return int(mb * (1 << 20)) if mb > 0 else None
 
 
@@ -613,9 +620,10 @@ def bucket_bytes_from_env(default_mb=_DEFAULT_BUCKET_MB):
     if raw:
         try:
             return mb_to_bucket_bytes(raw)
-        except ValueError:
+        except ValueError as exc:
             raise ValueError(
-                "PTPU_AMP_BUCKET_MB=%r is not a number" % (raw,))
+                "PTPU_AMP_BUCKET_MB=%r is not a usable bucket size: %s"
+                % (raw, exc))
     if default_mb is None:
         return None
     return mb_to_bucket_bytes(default_mb)
@@ -623,9 +631,14 @@ def bucket_bytes_from_env(default_mb=_DEFAULT_BUCKET_MB):
 
 class Bucket:
     """One flattened same-dtype gradient bucket: leaf indices, their
-    flat sizes/offsets, and the padded total length."""
+    flat sizes/offsets, and the padded total length. `segment` is the
+    bucket's position in the planned issue order — under backward-order
+    planning (docs/ZERO.md) segment 0 is the bucket whose gradients the
+    backward pass produces FIRST, i.e. the first collective the overlap
+    chain may issue."""
 
-    __slots__ = ("indices", "sizes", "offsets", "size", "padded", "dtype")
+    __slots__ = ("indices", "sizes", "offsets", "size", "padded", "dtype",
+                 "segment")
 
     def __init__(self, dtype):
         self.indices = []
@@ -634,6 +647,7 @@ class Bucket:
         self.size = 0
         self.padded = 0
         self.dtype = dtype
+        self.segment = None
 
     def nbytes(self):
         return self.padded * _dtype_itemsize(self.dtype)
@@ -649,17 +663,34 @@ def _is_bf16(dtype):
     return "bfloat16" in str(dtype)
 
 
-def plan_buckets(leaves, bucket_bytes, pad_multiple=1, dtype=None):
+def plan_buckets(leaves, bucket_bytes, pad_multiple=1, dtype=None,
+                 order="forward"):
     """Group `leaves` (arrays or anything with .shape/.dtype) into
     flattened buckets of at most `bucket_bytes` each (a single leaf
     larger than the cap gets its own bucket), grouped by collective
     dtype and padded to a multiple of `pad_multiple` elements. `dtype`
     forces one collective dtype for every bucket (e.g. bf16 gradients);
-    None groups by each leaf's own dtype. Records amp/bucket_bytes and
+    None groups by each leaf's own dtype.
+
+    `order` is the issue order the plan encodes (Bucket.segment):
+    "forward" walks leaves in tree-flatten order (the PR-5 layout);
+    "backward" walks them REVERSED — bucket/segment 0 then holds the
+    LAST leaves, whose gradients the backward pass produces first, which
+    is the order the comm/compute overlap chain wants to issue
+    collectives in (docs/ZERO.md). Records amp/bucket_bytes and
     amp/buckets telemetry."""
+    bb = float(bucket_bytes) if bucket_bytes is not None else float("nan")
+    if np.isnan(bb) or bb <= 0:
+        raise ValueError(
+            "plan_buckets: bucket_bytes=%r is not a positive capacity "
+            "(check bucket_mb / $PTPU_AMP_BUCKET_MB)" % (bucket_bytes,))
+    if order not in ("forward", "backward"):
+        raise ValueError("plan_buckets: unknown order %r" % (order,))
     groups = {}
-    order = []
-    for i, leaf in enumerate(leaves):
+    out = []
+    walk = (reversed(list(enumerate(leaves))) if order == "backward"
+            else enumerate(leaves))
+    for i, leaf in walk:
         dt = dtype if dtype is not None else leaf.dtype
         key = str(dt)
         size = int(np.prod(np.shape(leaf))) if np.ndim(leaf) else 1
@@ -669,18 +700,19 @@ def plan_buckets(leaves, bucket_bytes, pad_multiple=1, dtype=None):
                       and (bs[-1].size + size) * item > bucket_bytes):
             b = Bucket(dt)
             bs.append(b)
-            order.append(b)
+            out.append(b)
         b = bs[-1]
         b.indices.append(i)
         b.offsets.append(b.size)
         b.sizes.append(size)
         b.size += size
-    for b in order:
+    for seg, b in enumerate(out):
+        b.segment = seg
         b.padded = b.size + (-b.size) % max(int(pad_multiple), 1)
-    total = sum(b.padded * _dtype_itemsize(b.dtype) for b in order)
+    total = sum(b.padded * _dtype_itemsize(b.dtype) for b in out)
     _metrics.gauge("amp/bucket_bytes").set(total)
-    _metrics.counter("amp/buckets").inc(len(order))
-    return order
+    _metrics.counter("amp/buckets").inc(len(out))
+    return out
 
 
 def flatten_bucket(bucket, leaves, dtype=None):

@@ -50,7 +50,7 @@ from .observability import metrics as _metrics
 from .observability import tracing as _tracing
 
 __all__ = ["LazyFetchList", "InflightWindow", "FeedPrefetcher",
-           "DeferredWarns", "as_numpy", "prefetch_iter",
+           "DeferredWarns", "HostStateStager", "as_numpy", "prefetch_iter",
            "setup_persistent_cache", "persistent_cache_dir",
            "note_compiled_program"]
 
@@ -313,6 +313,73 @@ class FeedPrefetcher:
         if self._thread is not None:
             self._in.put(self._CLOSE)
             self._thread.join(timeout=5.0)
+
+
+class HostStateStager:
+    """Host<->device staging for host-offloaded optimizer state
+    (docs/ZERO.md): m/v live in host RAM between steps; each step stages
+    them to device for the sharded update and copies the updated shards
+    back out.
+
+    The H2D leg rides the FeedPrefetcher worker thread: `stage_in_begin`
+    hands the host leaves to the worker (which `place_fn`s each one onto
+    its target sharding) and returns immediately, so the transfer runs
+    WHILE the backward/scatter jit — dispatched right after — executes;
+    `stage_in_end` collects the staged device arrays at the point the
+    update phase needs them. The D2H leg (`stage_out`) is a forced host
+    copy (np.array — the same everywhere-reliable sync the in-flight
+    window uses), which is also the step's optimizer-state sync point.
+    Both directions count into the `counter` metric (zero/offload_bytes);
+    the worker's own feed/h2d_bytes accounting sees the H2D leg too, as
+    it is real host->device traffic."""
+
+    def __init__(self, place_fn, counter="zero/offload_bytes"):
+        self._prefetcher = FeedPrefetcher(
+            stage_fn=lambda _name, value: place_fn(value))
+        self._counter = counter
+        self._pending_n = None
+
+    def stage_in_begin(self, leaves):
+        """Queue `leaves` (host arrays) for background placement."""
+        if self._pending_n is not None:
+            raise RuntimeError("stage_in_begin before the previous "
+                               "stage_in_end was collected")
+        self._pending_n = len(leaves)
+        self._prefetcher.put({str(i): v for i, v in enumerate(leaves)})
+
+    def stage_in_end(self):
+        """The staged device arrays, in stage_in_begin order."""
+        if self._pending_n is None:
+            raise RuntimeError("stage_in_end without stage_in_begin")
+        n, self._pending_n = self._pending_n, None
+        staged = self._prefetcher.get()
+        vals = [staged[str(i)] for i in range(n)]
+        _metrics.counter(self._counter).inc(_nbytes(vals))
+        return vals
+
+    def abort(self):
+        """Drop a begun-but-uncollected stage — error recovery for a
+        caller whose compute phase failed between begin and end. The
+        staged batch is collected and discarded so the worker slot frees
+        and the next stage_in_begin starts clean. No-op when nothing is
+        pending."""
+        if self._pending_n is None:
+            return
+        self._pending_n = None
+        try:
+            self._prefetcher.get()
+        except Exception:
+            pass  # a staging error dies with the aborted step
+
+    def stage_out(self, leaves):
+        """Forced host copies of `leaves` (device arrays) — the D2H side.
+        Blocks until the producing computation delivers."""
+        out = [np.array(v) for v in leaves]
+        _metrics.counter(self._counter).inc(_nbytes(out))
+        return out
+
+    def close(self):
+        self._prefetcher.close()
 
 
 def prefetch_iter(batches, prefetcher):

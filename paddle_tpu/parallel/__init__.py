@@ -9,13 +9,14 @@ reference) tensor/pipeline/sequence/expert parallelism built TPU-first.
 from .mesh import get_mesh, mesh_axis_sizes  # noqa: F401
 from .parallel_executor import ParallelExecutor  # noqa: F401
 from .ring_attention import ring_attention, ring_attention_sharded  # noqa
-from .zero import ShardedAdam  # noqa: F401
+from .zero import ShardedAdam, ZeroLayoutError  # noqa: F401
 from .dgc import dgc_allreduce, make_dgc_step  # noqa: F401
 from .fleet import (fleet, Fleet, PaddleCloudRoleMaker,  # noqa: F401
                     UserDefinedRoleMaker, DistributedStrategy)
 
 __all__ = ["ParallelExecutor", "get_mesh", "mesh_axis_sizes",
            "ring_attention", "ring_attention_sharded", "ShardedAdam",
+           "ZeroLayoutError",
            "dgc_allreduce", "make_dgc_step", "fleet", "Fleet",
            "PaddleCloudRoleMaker", "UserDefinedRoleMaker",
            "DistributedStrategy"]
