@@ -46,11 +46,11 @@ low-precision collectives instead of many small fp32 ones — consumed by
 """
 
 import hashlib
-import os
 
 import numpy as np
 
 from . import framework, unique_name
+from .flags import env as _env
 from .framework import convert_dtype, default_startup_program
 from .ir import Pass, register_pass
 from .observability import metrics as _metrics
@@ -130,12 +130,12 @@ class AmpConfig:
 
 
 def amp_env_enabled():
-    return os.environ.get("PTPU_AMP", "") in ("1", "true")
+    return bool(_env("PTPU_AMP"))
 
 
 def _env_config():
-    return AmpConfig(level=os.environ.get("PTPU_AMP_LEVEL", "O1"),
-                     dtype=os.environ.get("PTPU_AMP_DTYPE", "bfloat16"))
+    return AmpConfig(level=_env("PTPU_AMP_LEVEL"),
+                     dtype=_env("PTPU_AMP_DTYPE"))
 
 
 def active_config(program=None, build_strategy=None):
@@ -616,14 +616,18 @@ def mb_to_bucket_bytes(mb):
 def bucket_bytes_from_env(default_mb=_DEFAULT_BUCKET_MB):
     """Bucket size in BYTES from $PTPU_AMP_BUCKET_MB (None = bucketing
     not requested when `default_mb` is None)."""
-    raw = os.environ.get("PTPU_AMP_BUCKET_MB", "")
-    if raw:
+    try:
+        mb = _env("PTPU_AMP_BUCKET_MB")
+    except ValueError as exc:
+        raise ValueError(
+            "PTPU_AMP_BUCKET_MB is not a usable bucket size: %s" % (exc,))
+    if mb is not None:
         try:
-            return mb_to_bucket_bytes(raw)
+            return mb_to_bucket_bytes(mb)
         except ValueError as exc:
             raise ValueError(
                 "PTPU_AMP_BUCKET_MB=%r is not a usable bucket size: %s"
-                % (raw, exc))
+                % (mb, exc))
     if default_mb is None:
         return None
     return mb_to_bucket_bytes(default_mb)

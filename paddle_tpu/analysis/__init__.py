@@ -1,0 +1,22 @@
+"""Static analysis over the Program IR (docs/STATIC_ANALYSIS.md).
+
+`verify(program)` checks a Program against the construction-time
+invariants the reference enforced through `OpProto` arity checks and
+`InferShape`/`InferVarType` propagation; `PTPU_VERIFY_PASSES=1` makes
+every compile path run it before the pass pipeline and after each pass,
+blaming the pass that broke an invariant (`ir_passes.
+optimize_for_execution`, `ir.apply_passes`, and the no-opt compile
+paths all route through the same hook). The repo-invariant linter that
+rides with it lives in `tools/ptpu_lint.py`.
+"""
+
+from .meta import OpMeta, declare, meta_of, var_meta
+from .verifier import (PassPipelineVerifier, ProgramVerifier, VerifyError,
+                       Violation, maybe_verify, verify, verify_enabled,
+                       verify_or_raise)
+
+__all__ = [
+    "OpMeta", "declare", "meta_of", "var_meta",
+    "PassPipelineVerifier", "ProgramVerifier", "VerifyError", "Violation",
+    "maybe_verify", "verify", "verify_enabled", "verify_or_raise",
+]

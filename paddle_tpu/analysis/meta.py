@@ -1,0 +1,307 @@
+"""Static op metadata: required slots/attrs + shape/dtype inference.
+
+Parity: the reference validates every op at CONSTRUCTION time —
+`OpProto` pins required inputs/outputs/attrs (framework/op_desc.cc) and
+`InferShape`/`InferVarType` propagate shapes and dtypes through the
+graph before anything executes (framework/shape_inference.h). TPU-native
+kernels are shape-polymorphic jax functions, so nothing forces that
+declaration discipline at build time; this module restores it as
+ANALYSIS metadata: each kernel contributes an optional `OpMeta` entry
+(via `ops.registry.register(infer_meta=...)` at registration, or
+`declare()` here for kernels that predate the verifier), and
+`paddle_tpu.analysis.verifier` checks every program op against it.
+
+An `OpMeta` carries:
+  ins / outs    required input / output slot names (signature
+                conformance — a missing/empty required slot is a
+                violation)
+  attrs         required attr keys
+  infer         optional `infer(op, in_metas) -> {slot: [meta, ...]}`
+                where a meta is `(shape, dtype)`: shape is a tuple with
+                `None` for unknown dims (declared -1 batch dims) or None
+                when fully unknown; dtype is a canonical dtype string or
+                None. The verifier compares the inferred metas against
+                the DECLARED output vars and reports op index, var name,
+                expected vs found.
+
+Inference runs in DECLARED space (the var descriptors), not runtime
+space: jax's x64 canonicalization and the deliberate AMP divergence
+(amp_rewrite marks ops `__amp_bf16__` and lets runtime values run
+bfloat16 under fp32 declarations) are invisible to it — rules must
+return None (unknown) wherever declared-space reasoning cannot pin the
+value, and the verifier skips dtype checks on AMP-marked ops.
+"""
+
+import numpy as np
+
+from ..framework import convert_dtype
+from ..ops import registry
+
+__all__ = ["OpMeta", "declare", "meta_of", "var_meta", "broadcast_dims",
+           "align_y_to_x", "elementwise_out_dims"]
+
+
+class OpMeta:
+    """Signature + inference metadata for one op type (docstring above)."""
+
+    __slots__ = ("ins", "outs", "attrs", "infer")
+
+    def __init__(self, ins=(), outs=(), attrs=(), infer=None):
+        self.ins = tuple(ins)
+        self.outs = tuple(outs)
+        self.attrs = tuple(attrs)
+        self.infer = infer
+
+
+def declare(op_type, ins=(), outs=(), attrs=(), infer=None):
+    """Attach an OpMeta to an already-registered kernel (skipped silently
+    when the kernel is absent — op modules are allowed to be trimmed)."""
+    if not registry.has(op_type):
+        return None
+    return registry.set_infer_meta(op_type,
+                                   OpMeta(ins, outs, attrs, infer))
+
+
+def meta_of(op_type):
+    """The OpMeta for `op_type`, or None (unregistered op types are the
+    verifier's unknown-op rule, not this lookup's concern)."""
+    if not registry.has(op_type):
+        return None
+    m = registry.get(op_type).infer_meta
+    if m is None:
+        return None
+    if not isinstance(m, OpMeta):
+        # a bare infer function handed to register(infer_meta=...)
+        m = OpMeta(infer=m)
+    return m
+
+
+# ---------------------------------------------------------------------------
+# (shape, dtype) helpers shared by the rules
+# ---------------------------------------------------------------------------
+
+
+def var_meta(v):
+    """Declared (shape, dtype) of a Variable: -1 dims become None."""
+    if v is None:
+        return (None, None)
+    shape = None
+    if v.shape is not None:
+        shape = tuple(None if int(d) < 0 else int(d) for d in v.shape)
+    return (shape, v.dtype or None)
+
+
+def _in0(in_metas, slot):
+    ms = in_metas.get(slot) or []
+    return ms[0] if ms else (None, None)
+
+
+def broadcast_dims(xs, ys):
+    """Numpy-broadcast two shape tuples with None = unknown. Returns the
+    merged shape, or raises ValueError on a definite incompatibility
+    (both dims known, neither 1, different)."""
+    if xs is None or ys is None:
+        return None
+    n = max(len(xs), len(ys))
+    xs = (None,) * (n - len(xs)) + tuple(xs)
+    ys = (None,) * (n - len(ys)) + tuple(ys)
+    out = []
+    for a, b in zip(xs, ys):
+        if a is None or b is None:
+            # a known non-1 dim survives broadcasting against anything
+            # compatible; 1-vs-unknown stays unknown
+            known = a if a is not None else b
+            out.append(known if known is not None and known != 1 else None)
+        elif a == b or b == 1:
+            out.append(a)
+        elif a == 1:
+            out.append(b)
+        else:
+            raise ValueError("dims %r and %r do not broadcast" % (a, b))
+    return tuple(out)
+
+
+def align_y_to_x(xs, ys, axis):
+    """Fluid elementwise axis alignment: pad Y's dims to X's rank
+    starting at `axis` (ops.registry.broadcast_to_axis, on shapes).
+    Returns None when the alignment is impossible (ranks don't fit)."""
+    if axis in (-1, None) or xs is None or ys is None:
+        return ys
+    if axis + len(ys) <= len(xs):
+        return (1,) * axis + tuple(ys) + (1,) * (len(xs) - axis
+                                                 - len(ys))
+    return None
+
+
+def elementwise_out_dims(xs, ys, axis):
+    """Out shape of one elementwise op (axis alignment + numpy
+    broadcast) in None-for-unknown space — THE shared rule: the
+    `layers._elementwise` builder declares with it (translating -1) and
+    the verifier infers with it, so the two can never drift (the
+    declaration-drift bug class the verifier exists to catch). Raises
+    ValueError on a definite incompatibility."""
+    return broadcast_dims(xs, align_y_to_x(xs, ys, axis))
+
+
+def _same_dtype(*metas):
+    dts = {dt for _, dt in metas if dt is not None}
+    return dts.pop() if len(dts) == 1 else None
+
+
+# ---------------------------------------------------------------------------
+# inference rules
+# ---------------------------------------------------------------------------
+
+
+def _identity(op, in_metas, slot="X"):
+    """Out mirrors X (the unary elementwise family)."""
+    return {"Out": [_in0(in_metas, slot)]}
+
+
+def _elementwise(op, in_metas):
+    xs, xdt = _in0(in_metas, "X")
+    ys, ydt = _in0(in_metas, "Y")
+    # raises on definite mismatch
+    shape = elementwise_out_dims(xs, ys, op.attrs.get("axis", -1))
+    # mixed declared dtypes promote at runtime (AMP O2 gray flows rely on
+    # it) — only a matching pair pins the out dtype
+    return {"Out": [(shape, _same_dtype((xs, xdt), (ys, ydt)))]}
+
+
+def _mul(op, in_metas):
+    xs, xdt = _in0(in_metas, "X")
+    ys, ydt = _in0(in_metas, "Y")
+    shape = None
+    if xs is not None and ys is not None:
+        xn = int(op.attrs.get("x_num_col_dims", 1))
+        yn = int(op.attrs.get("y_num_col_dims", 1))
+        if 0 < xn <= len(xs) and 0 < yn < len(ys) + 1:
+            kx = [d for d in xs[xn:]]
+            ky = [d for d in ys[:yn]]
+            if None not in kx and None not in ky and \
+                    int(np.prod(kx or [1])) != int(np.prod(ky or [1])):
+                raise ValueError(
+                    "contraction dims %r x %r do not agree" % (kx, ky))
+            shape = tuple(xs[:xn]) + tuple(ys[yn:])
+    return {"Out": [(shape, _same_dtype((xs, xdt), (ys, ydt)))]}
+
+
+def _matmul(op, in_metas):
+    xs, xdt = _in0(in_metas, "X")
+    ys, ydt = _in0(in_metas, "Y")
+    shape = None
+    if xs is not None and ys is not None and len(xs) == 2 and len(ys) == 2:
+        m = xs[1] if op.attrs.get("transpose_X") else xs[0]
+        kx = xs[0] if op.attrs.get("transpose_X") else xs[1]
+        ky = ys[1] if op.attrs.get("transpose_Y") else ys[0]
+        n = ys[0] if op.attrs.get("transpose_Y") else ys[1]
+        if kx is not None and ky is not None and kx != ky:
+            raise ValueError(
+                "contraction dims %r and %r do not agree" % (kx, ky))
+        shape = (m, n)
+    return {"Out": [(shape, _same_dtype((xs, xdt), (ys, ydt)))]}
+
+
+def _cast(op, in_metas):
+    xs, _ = _in0(in_metas, "X")
+    return {"Out": [(xs, convert_dtype(op.attrs["out_dtype"]))]}
+
+
+def _fill_shape_dtype(op, in_metas):
+    shape = tuple(int(s) for s in op.attrs["shape"])
+    return {"Out": [(shape, convert_dtype(op.attrs.get("dtype",
+                                                       "float32")))]}
+
+
+def _mean(op, in_metas):
+    _, dt = _in0(in_metas, "X")
+    return {"Out": [((1,), dt)]}
+
+
+def _reduce(op, in_metas):
+    xs, dt = _in0(in_metas, "X")
+    shape = None
+    if xs is not None:
+        keep = bool(op.attrs.get("keep_dim", False))
+        if op.attrs.get("reduce_all", False):
+            shape = (1,) * len(xs) if keep else (1,)
+        else:
+            dim = op.attrs.get("dim", [0])
+            dims = dim if isinstance(dim, (list, tuple)) else [dim]
+            axes = {int(d) % len(xs) for d in dims}
+            shape = tuple(1 if i in axes else d
+                          for i, d in enumerate(xs)
+                          if keep or i not in axes)
+            if not shape:
+                shape = (1,)
+    return {"Out": [(shape, dt)]}
+
+
+def _sum(op, in_metas):
+    metas = in_metas.get("X") or [(None, None)]
+    shape = metas[0][0]
+    for s, _ in metas[1:]:
+        try:
+            shape = broadcast_dims(shape, s)
+        except ValueError:
+            raise
+    return {"Out": [(shape, _same_dtype(*metas))]}
+
+
+def _square_error_cost(op, in_metas):
+    xs, dt = _in0(in_metas, "X")
+    ys, _ = _in0(in_metas, "Label")
+    return {"Out": [(broadcast_dims(xs, ys), dt)]}
+
+
+def _register_builtin_metas():
+    for name in ("elementwise_add", "elementwise_sub", "elementwise_mul",
+                 "elementwise_div", "elementwise_max", "elementwise_min",
+                 "elementwise_pow"):
+        declare(name, ins=("X", "Y"), outs=("Out",), infer=_elementwise)
+    for name in ("relu", "tanh", "sigmoid", "exp", "sqrt", "rsqrt", "abs",
+                 "square", "softmax", "scale", "sign", "softsign",
+                 "softplus", "ceil", "floor", "round", "reciprocal"):
+        declare(name, ins=("X",), outs=("Out",), infer=_identity)
+    declare("mul", ins=("X", "Y"), outs=("Out",), infer=_mul)
+    declare("matmul", ins=("X", "Y"), outs=("Out",), infer=_matmul)
+    declare("cast", ins=("X",), outs=("Out",), attrs=("out_dtype",),
+            infer=_cast)
+    declare("fill_constant", outs=("Out",), attrs=("shape",),
+            infer=_fill_shape_dtype)
+    declare("assign_value", outs=("Out",), attrs=("shape", "values"),
+            infer=_fill_shape_dtype)
+    declare("fill_zeros_like", ins=("X",), outs=("Out",), infer=_identity)
+    declare("mean", ins=("X",), outs=("Out",), infer=_mean)
+    for name in ("reduce_sum", "reduce_mean", "reduce_max", "reduce_min",
+                 "reduce_prod"):
+        declare(name, ins=("X",), outs=("Out",), infer=_reduce)
+    declare("sum", ins=("X",), outs=("Out",), infer=_sum)
+    declare("square_error_cost", ins=("X", "Label"), outs=("Out",),
+            infer=_square_error_cost)
+    declare("dropout", ins=("X",), outs=("Out",),
+            infer=lambda op, m: {"Out": [_in0(m, "X")]})
+    declare("fused_elemwise_activation", ins=("X", "Y"), outs=("Out",),
+            attrs=("functor_list",))
+    declare("fill_constant_batch_size_like", ins=("Input",), outs=("Out",),
+            attrs=("shape",))
+    declare("lookup_table", ins=("Ids", "W"), outs=("Out",))
+    declare("concat", ins=("X",), outs=("Out",))
+    declare("reshape", ins=("X",), outs=("Out",))
+    declare("transpose", ins=("X",), outs=("Out",), attrs=("axis",))
+    declare("layer_norm", ins=("X",), outs=("Y",))
+    declare("batch_norm", ins=("X", "Scale", "Bias", "Mean", "Variance"),
+            outs=("Y",))
+    declare("conv2d", ins=("Input", "Filter"), outs=("Output",))
+    declare("conv2d_fusion", ins=("Input", "Filter"), outs=("Output",))
+    declare("cross_entropy", ins=("X", "Label"), outs=("Y",))
+    declare("softmax_with_cross_entropy", ins=("Logits", "Label"),
+            outs=("Loss",))
+    declare("sgd", ins=("Param", "Grad", "LearningRate"),
+            outs=("ParamOut",))
+    declare("adam", ins=("Param", "Grad", "LearningRate",
+                         "Moment1", "Moment2", "Beta1Pow", "Beta2Pow"),
+            outs=("ParamOut", "Moment1Out", "Moment2Out"))
+
+
+_register_builtin_metas()

@@ -1,0 +1,465 @@
+"""Program IR verifier (parity: the construction-time validation the
+reference spreads across `OpProto`/`OperatorBase` arity checks
+(framework/op_desc.cc), `InferShape`/`InferVarType` propagation
+(framework/shape_inference.h) and MLIR-style per-pass IR verification).
+
+`verify(program, level='strict'|'basic')` walks every block and checks:
+
+  rule `unknown-op`        op type has a registered kernel, a bespoke
+                           lowering, or a structural role
+  rule `op-signature`      required input/output slots and attrs per the
+                           op's `analysis.meta.OpMeta` (strict)
+  rule `use-before-def`    every read is def-before-use within its block,
+                           honoring sub-block visibility and the
+                           persistable/feed/tensor-array anchors
+  rule `dangling-ref`      `__fwd_op__` references resolve to live ops of
+                           the SAME program, sub-block attrs are this
+                           program's blocks, and every referenced var
+                           resolves in (and belongs to) this program —
+                           the clone invariants
+  rule `dtype-mismatch`    statically inferred output dtype vs the
+  rule `shape-mismatch`    declared var descriptor (strict; declared
+                           space — AMP-marked ops are exempt by design)
+  rule `donated-fetch`     donation safety: an inplace-promotion
+                           candidate (large write-before-read
+                           persistable) may not also be a fetch target,
+                           and must genuinely be written before read
+
+Violations are structured (`Violation`), and `verify_or_raise` wraps
+them in a `VerifyError` carrying `program_version`, `block_idx`,
+`op_idx`, `var`, `rule` (of the first violation) plus the full list.
+
+`PassPipelineVerifier` is the per-pass harness `ir_passes.
+optimize_for_execution` and `ir.apply_passes` run under
+`PTPU_VERIFY_PASSES=1`: it verifies the input program, re-verifies after
+every pass, and attributes any NEW violation to the offending pass by
+name (telemetry `verify/{programs_checked,violations,pass_blamed}`,
+trace spans `verify:<pass>`). docs/STATIC_ANALYSIS.md is the contract.
+"""
+
+from .. import flags as _flags
+from ..observability import metrics as _metrics
+from ..observability import tracing as _tracing
+from . import meta as _meta
+
+__all__ = ["Violation", "VerifyError", "ProgramVerifier", "verify",
+           "verify_or_raise", "verify_enabled", "PassPipelineVerifier"]
+
+LEVELS = ("basic", "strict")
+
+# donation promotion only fires for buffers >= this many bytes
+# (ir_passes._MIN_PROMOTE_BYTES — imported lazily to keep this module
+# import-light; kept as a fallback mirror for direct use)
+_MIN_PROMOTE_BYTES = 1 << 20
+
+
+def verify_enabled():
+    """True under PTPU_VERIFY_PASSES=1 — the pipeline hooks gate on this,
+    so with the env unset the compile path is exactly the pre-verifier
+    one."""
+    return bool(_flags.env("PTPU_VERIFY_PASSES"))
+
+
+class Violation:
+    """One structured diagnostic. `key()` identifies the violation
+    across pass applications (op indices shift as passes insert/delete
+    ops, so identity is (rule, block, var, op type))."""
+
+    __slots__ = ("rule", "message", "block_idx", "op_idx", "op_type",
+                 "var")
+
+    def __init__(self, rule, message, block_idx=None, op_idx=None,
+                 op_type=None, var=None):
+        self.rule = rule
+        self.message = message
+        self.block_idx = block_idx
+        self.op_idx = op_idx
+        self.op_type = op_type
+        self.var = var
+
+    def key(self):
+        return (self.rule, self.block_idx, self.op_type, self.var)
+
+    def __repr__(self):
+        loc = []
+        if self.block_idx is not None:
+            loc.append("block %d" % self.block_idx)
+        if self.op_idx is not None:
+            loc.append("op %d" % self.op_idx)
+        if self.op_type:
+            loc.append(self.op_type)
+        if self.var:
+            loc.append("var %r" % self.var)
+        return "[%s] %s%s" % (self.rule, self.message,
+                              " (%s)" % ", ".join(loc) if loc else "")
+
+
+class VerifyError(RuntimeError):
+    """Raised on verification failure. Carries the first violation's
+    structured fields plus the full list; `pass_name` names the pipeline
+    pass that introduced the violations (None = the input program)."""
+
+    def __init__(self, violations, program=None, pass_name=None):
+        self.violations = list(violations)
+        self.pass_name = pass_name
+        self.program_version = getattr(program, "version", None)
+        first = self.violations[0] if self.violations else \
+            Violation("unknown", "no violations recorded")
+        self.rule = first.rule
+        self.block_idx = first.block_idx
+        self.op_idx = first.op_idx
+        self.var = first.var
+        where = ("pass %r broke the program" % pass_name) if pass_name \
+            else "program failed verification"
+        super().__init__(
+            "%s (version %s): %d violation(s)\n  %s"
+            % (where, self.program_version, len(self.violations),
+               "\n  ".join(repr(v) for v in self.violations[:8])))
+
+
+class ProgramVerifier:
+    """One verification walk over a Program (docstring above). `level`:
+    'basic' = structural rules only; 'strict' adds signature conformance
+    and static dtype/shape propagation."""
+
+    def __init__(self, level="strict"):
+        if level not in LEVELS:
+            raise ValueError("verify level must be one of %r, got %r"
+                             % (LEVELS, level))
+        self.level = level
+
+    # -- entry ---------------------------------------------------------
+    def verify(self, program, fetch_names=None):
+        """All violations found in `program` (empty list = clean).
+        `fetch_names` default to the pipeline-pinned
+        `program._opt_fetch_targets`; without either, the donation rules
+        are skipped (fetch set unknown — same contract as the
+        fetch-driven passes)."""
+        if fetch_names is None:
+            fetch_names = getattr(program, "_opt_fetch_targets", None)
+        out = []
+        op_ids = {id(op) for blk in program.blocks for op in blk.ops}
+        # per-block write sets, computed ONCE (one pass over all ops):
+        # the per-block "written elsewhere" union below stays O(B*N)
+        # instead of re-walking every other block per block — this runs
+        # after every pipeline pass under PTPU_VERIFY_PASSES=1
+        block_writes = []
+        for blk in program.blocks:
+            names = set()
+            for op in blk.ops:
+                names.update(op.output_names())
+            block_writes.append(names)
+        for blk in program.blocks:
+            writes_outside = set()
+            for idx, names in enumerate(block_writes):
+                if idx != blk.idx:
+                    writes_outside |= names
+            out.extend(self._check_block(program, blk, op_ids,
+                                         block_writes[blk.idx],
+                                         writes_outside))
+        out.extend(self._check_donation(program, fetch_names))
+        return out
+
+    # -- per-block rules ----------------------------------------------
+    def _check_block(self, program, blk, op_ids, written_in_block,
+                     writes_outside):
+        from ..core.lowering import _SPECIAL, _STRUCTURAL
+        from ..framework import Block, Operator
+        from ..ops import registry
+
+        out = []
+        # `writes_outside` = names written by ops of OTHER blocks: a
+        # sub-block writes into closed-over parent names (and vice
+        # versa) at an order this block cannot see — def-before-use is
+        # only decidable for names whose every writer is in THIS block
+
+        def anchored(name, v):
+            """True when reading `name` needs no earlier in-block def:
+            state (persistable), feeds (is_data, or never written
+            anywhere — supplied by the feed dict), tensor arrays (the
+            first mention IS the empty array), or writes in other blocks
+            (order unknown — conservative)."""
+            if v is not None and (v.persistable or v.is_data
+                                  or getattr(v, "is_tensor_array",
+                                             False)):
+                return True
+            return name in writes_outside \
+                or name not in written_in_block
+
+        produced = set()
+        for i, op in enumerate(blk.ops):
+            is_grad = "__fwd_op__" in op.attrs
+            # rule unknown-op --------------------------------------------
+            if not is_grad and op.type not in _STRUCTURAL \
+                    and op.type not in _SPECIAL \
+                    and not registry.has(op.type):
+                out.append(Violation(
+                    "unknown-op",
+                    "op type %r has no registered kernel, bespoke "
+                    "lowering, or structural role" % op.type,
+                    blk.idx, i, op.type))
+                produced.update(op.output_names())
+                continue
+            # rule dangling-ref ------------------------------------------
+            for k, a in op.attrs.items():
+                if isinstance(a, Operator) and id(a) not in op_ids:
+                    out.append(Violation(
+                        "dangling-ref",
+                        "attr %r references an op (%s) that is not in "
+                        "this program — grad ops must point at live "
+                        "forward ops of the SAME program (clone "
+                        "invariant)" % (k, a.type),
+                        blk.idx, i, op.type))
+                elif isinstance(a, Block) and (
+                        a.idx >= len(program.blocks)
+                        or program.blocks[a.idx] is not a):
+                    out.append(Violation(
+                        "dangling-ref",
+                        "attr %r references a sub-block that is not "
+                        "this program's block %d" % (k, a.idx),
+                        blk.idx, i, op.type))
+            for direction, slots in (("input", op.inputs),
+                                     ("output", op.outputs)):
+                for slot, vs in slots.items():
+                    for v in vs:
+                        if blk._find_var_recursive(v.name) is None:
+                            out.append(Violation(
+                                "dangling-ref",
+                                "%s %s[%r] -> var %r is not declared in "
+                                "this block or an ancestor"
+                                % (direction, op.type, slot, v.name),
+                                blk.idx, i, op.type, v.name))
+                        elif v.block.program is not program:
+                            out.append(Violation(
+                                "dangling-ref",
+                                "%s %s[%r] -> var %r belongs to a "
+                                "DIFFERENT program (clone invariant: "
+                                "a cloned op must reference the "
+                                "clone's vars)"
+                                % (direction, op.type, slot, v.name),
+                                blk.idx, i, op.type, v.name))
+            # rule use-before-def ----------------------------------------
+            for name in op.input_names():
+                if name in produced:
+                    continue
+                v = blk._find_var_recursive(name)
+                if anchored(name, v):
+                    continue
+                out.append(Violation(
+                    "use-before-def",
+                    "op reads %r before any op of this block defines "
+                    "it (first definition comes later in program "
+                    "order)" % name,
+                    blk.idx, i, op.type, name))
+            # strict: signature + meta propagation -----------------------
+            if self.level == "strict" and not is_grad:
+                out.extend(self._check_meta(blk, i, op))
+            produced.update(op.output_names())
+        return out
+
+    def _check_meta(self, blk, i, op):
+        m = _meta.meta_of(op.type)
+        if m is None:
+            return []
+        out = []
+        # rule op-signature ----------------------------------------------
+        for slot in m.ins:
+            if not op.inputs.get(slot):
+                out.append(Violation(
+                    "op-signature",
+                    "required input slot %r is missing or empty" % slot,
+                    blk.idx, i, op.type))
+        for slot in m.outs:
+            if not op.outputs.get(slot):
+                out.append(Violation(
+                    "op-signature",
+                    "required output slot %r is missing or empty" % slot,
+                    blk.idx, i, op.type))
+        for key in m.attrs:
+            if key not in op.attrs:
+                out.append(Violation(
+                    "op-signature",
+                    "required attr %r is missing" % key,
+                    blk.idx, i, op.type))
+        if out or m.infer is None:
+            return out
+        # rules dtype-mismatch / shape-mismatch --------------------------
+        in_metas = {slot: [_meta.var_meta(blk._find_var_recursive(v.name))
+                           for v in vs]
+                    for slot, vs in op.inputs.items()}
+        try:
+            inferred = m.infer(op, in_metas)
+        except ValueError as e:
+            return [Violation(
+                "shape-mismatch",
+                "input shapes are statically incompatible: %s" % e,
+                blk.idx, i, op.type)]
+        except Exception:
+            return []  # meta rule choked on an exotic attr: no verdict
+        # AMP-marked ops deliberately run low precision under fp32
+        # declarations (docs/MIXED_PRECISION.md) — declared-space dtype
+        # reasoning does not apply to them
+        amp_marked = bool(op.attrs.get("__amp_bf16__"))
+        for slot, metas in (inferred or {}).items():
+            declared = op.outputs.get(slot, [])
+            for v, (shape, dtype) in zip(declared, metas):
+                want_shape, want_dtype = _meta.var_meta(
+                    blk._find_var_recursive(v.name))
+                if dtype is not None and want_dtype is not None \
+                        and dtype != want_dtype and not amp_marked:
+                    out.append(Violation(
+                        "dtype-mismatch",
+                        "%s[%r] infers dtype %s but var %r is declared "
+                        "%s" % (op.type, slot, dtype, v.name,
+                                want_dtype),
+                        blk.idx, i, op.type, v.name))
+                if shape is not None and want_shape is not None:
+                    if len(shape) != len(want_shape) or any(
+                            a is not None and b is not None and a != b
+                            for a, b in zip(shape, want_shape)):
+                        out.append(Violation(
+                            "shape-mismatch",
+                            "%s[%r] infers shape %r but var %r is "
+                            "declared %r" % (op.type, slot, shape,
+                                             v.name, want_shape),
+                            blk.idx, i, op.type, v.name))
+        return out
+
+    # -- donation safety ----------------------------------------------
+    def _check_donation(self, program, fetch_names):
+        """The PR-2/PR-3 convention, made checkable: an inplace-promotion
+        candidate (a persistable the step writes whose OLD value no step
+        op reads, large enough to promote) is donated with its input
+        synthesized — so it may not also be a fetch target, and its
+        first write must genuinely precede every read (docs/
+        COMPILER_PASSES.md enable_inplace)."""
+        if fetch_names is None:
+            return []
+        try:
+            from ..ir_passes import _MIN_PROMOTE_BYTES as min_bytes
+        except Exception:
+            min_bytes = _MIN_PROMOTE_BYTES
+        import numpy as np
+
+        blk = program.global_block()
+        first_write, first_read = {}, {}
+        for i, op in enumerate(blk.ops):
+            for n in op.input_names():
+                first_read.setdefault(n, i)
+            for n in op.output_names():
+                first_write.setdefault(n, i)
+        out = []
+        fetch_set = set(fetch_names)
+        for name, w in first_write.items():
+            v = blk._find_var_recursive(name)
+            if v is None or not v.persistable:
+                continue
+            r = first_read.get(name)
+            if r is not None and r <= w:
+                continue  # read-before-write: classified mut, never
+                # promoted — standard donated state is safe (XLA copy
+                # insertion protects held fetches, async_engine.py)
+            if v.shape is None or any(int(d) < 0 for d in v.shape):
+                continue
+            try:
+                from ..framework import dtype_to_np
+
+                nbytes = int(np.prod(v.shape)) * np.dtype(
+                    dtype_to_np(v.dtype)).itemsize
+            except Exception:
+                continue
+            if nbytes < min_bytes:
+                continue
+            if name in fetch_set:
+                out.append(Violation(
+                    "donated-fetch",
+                    "persistable %r is an inplace-promotion candidate "
+                    "(write-before-read, %d bytes) AND a fetch target — "
+                    "a donated buffer may not be fetched (the promoted "
+                    "input is synthesized, not the scope value)"
+                    % (name, nbytes),
+                    blk.idx, first_write[name], blk.ops[w].type, name))
+        return out
+
+
+def verify(program, level="strict", fetch_names=None):
+    """All violations in `program` (empty list = clean). See
+    ProgramVerifier for the rules and `level` semantics."""
+    return ProgramVerifier(level).verify(program, fetch_names)
+
+
+def verify_or_raise(program, level="strict", fetch_names=None,
+                    pass_name=None):
+    violations = verify(program, level, fetch_names)
+    if violations:
+        raise VerifyError(violations, program, pass_name)
+    return program
+
+
+# ---------------------------------------------------------------------------
+# per-pass pipeline harness (PTPU_VERIFY_PASSES=1)
+# ---------------------------------------------------------------------------
+
+
+class PassPipelineVerifier:
+    """Blame-assigning wrapper around one pass-pipeline application.
+
+        pv = PassPipelineVerifier(program, fetch_names)   # raises if the
+                                                          # INPUT is bad
+        for name in pass_names:
+            get_pass(name).apply(program, scope)
+            pv.after_pass(name, program)   # raises VerifyError blaming
+                                           # `name` on any NEW violation
+
+    Pre-existing violations (same rule/block/var/op-type key) are carried
+    forward, never re-blamed. Telemetry: `verify/programs_checked` per
+    walk, `verify/violations` per violation found, `verify/pass_blamed`
+    per blamed pass; spans `verify:input` / `verify:<pass>`."""
+
+    def __init__(self, program, fetch_names=None, level="strict",
+                 raise_on_input=True):
+        self._verifier = ProgramVerifier(level)
+        self._fetch_names = fetch_names
+        with _tracing.span("verify:input"):
+            baseline = self._run(program)
+        self._seen = {v.key() for v in baseline}
+        if baseline and raise_on_input:
+            raise VerifyError(baseline, program, pass_name=None)
+
+    def _run(self, program):
+        violations = self._verifier.verify(program, self._fetch_names)
+        if _metrics.enabled():
+            _metrics.counter("verify/programs_checked").inc()
+            # inc(0) materializes the counter: CI gates `== 0` through
+            # --assert-max, which needs the metric present in the dump
+            _metrics.counter("verify/violations").inc(len(violations))
+        return violations
+
+    def after_pass(self, name, program):
+        """Verify `program` post-`name`; raise VerifyError blaming `name`
+        for any violation not present before it ran."""
+        with _tracing.span("verify:" + name):
+            violations = self._run(program)
+        new = [v for v in violations if v.key() not in self._seen]
+        self._seen |= {v.key() for v in violations}
+        if new:
+            if _metrics.enabled():
+                _metrics.counter("verify/pass_blamed").inc()
+            raise VerifyError(new, program, pass_name=name)
+        return program
+
+
+def maybe_verify(program, fetch_names=None, where="compile"):
+    """Executor-side hook for compile paths that skip the pass pipeline
+    (PTPU_NO_PROGRAM_OPT=1): one full verification when
+    PTPU_VERIFY_PASSES=1, a no-op otherwise."""
+    if not verify_enabled():
+        return program
+    with _tracing.span("verify:" + where):
+        violations = ProgramVerifier("strict").verify(program, fetch_names)
+    if _metrics.enabled():
+        _metrics.counter("verify/programs_checked").inc()
+        _metrics.counter("verify/violations").inc(len(violations))
+    if violations:
+        raise VerifyError(violations, program, pass_name=None)
+    return program

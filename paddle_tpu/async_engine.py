@@ -414,7 +414,9 @@ def setup_persistent_cache(cache_dir=None):
     executable is deserialized from disk."""
     if _PERSISTENT["dir"]:
         return _PERSISTENT["dir"]
-    cache_dir = cache_dir or os.environ.get("PTPU_CACHE_DIR")
+    from .flags import env as _env
+
+    cache_dir = cache_dir or _env("PTPU_CACHE_DIR")
     if not cache_dir:
         return None
     import jax

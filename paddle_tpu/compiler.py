@@ -373,6 +373,14 @@ class CompiledProgram:
                             self._program, fetch_names, scope,
                             build_strategy=self._build_strategy,
                             infer_opt=self._infer_opt)
+                elif pp == 1:
+                    # opted-out pipeline still verifies once per compile
+                    # under PTPU_VERIFY_PASSES=1 (pipeline-parallel
+                    # stage-split programs stay out of scope, like the
+                    # generic passes themselves)
+                    from .analysis import maybe_verify
+
+                    maybe_verify(self._program, tuple(fetch_names))
                 if persistent_cache_dir():
                     note_compiled_program(
                         run_program.fingerprint(), key[1],

@@ -19,7 +19,6 @@ feed batches can be staged host->device in the background
 $PTPU_CACHE_DIR persists compiled executables across processes.
 """
 
-import os
 import time
 
 import numpy as np
@@ -27,6 +26,7 @@ import numpy as np
 import jax
 
 from . import framework
+from .flags import env as flags_env
 from . import observability as _observability
 from .observability import metrics as _metrics
 from .observability import tracing as _tracing
@@ -331,10 +331,7 @@ class Executor:
         self.place = place if place is not None else default_place()
         self._cache = {}
         if async_steps is None:
-            try:
-                async_steps = int(os.environ.get("PTPU_ASYNC_STEPS") or 12)
-            except ValueError:
-                async_steps = 12
+            async_steps = flags_env("PTPU_ASYNC_STEPS")
         self._window = InflightWindow(async_steps)
         self._fetch_tick = 0
         self._prefetcher = None
@@ -503,6 +500,13 @@ class Executor:
                     with _tracing.span("optimize"):
                         run_program = ir_passes.optimize_for_execution(
                             program, fetch_names, scope)
+                else:
+                    # PTPU_NO_PROGRAM_OPT=1 skips the pipeline (and its
+                    # per-pass verification) — PTPU_VERIFY_PASSES=1 must
+                    # still check the program once per compile
+                    from .analysis import maybe_verify
+
+                    maybe_verify(program, tuple(fetch_names))
                 if persistent_cache_dir():
                     note_compiled_program(run_program.fingerprint(),
                                           key[2], tuple(fetch_names),

@@ -14,9 +14,21 @@ Supported flags:
                         default on TPU; kept for API parity).
   eager_delete_tensor_gb : accepted for parity; XLA buffer liveness already
                         frees intermediates (donation in executor).
+
+This module is also the ONE registry for the framework's own `PTPU_*`
+environment switches (docs/STATIC_ANALYSIS.md): every in-tree read goes
+through `env("PTPU_...")` against a declared (type, default, docstring)
+entry — `tools/ptpu_lint.py` rejects direct `os.environ` reads of
+`PTPU_*` names and `env()` calls naming an undeclared flag, so a typo'd
+flag name fails CI instead of silently reading a default. `describe()`
+prints the registry as the reference table. This module must stay
+dependency-free (stdlib only) so anything in the package can import it.
 """
 
 import os
+
+__all__ = ["set_flags", "get_flags", "flag", "env", "env_flag",
+           "declared_flags", "describe", "EnvFlag"]
 
 _FLAGS = {
     "check_nan_inf": False,
@@ -79,3 +91,166 @@ def get_flags(keys):
 
 def flag(name):
     return _FLAGS[name]
+
+
+# ---------------------------------------------------------------------------
+# PTPU_* environment-switch registry
+# ---------------------------------------------------------------------------
+
+
+def env_flag(name, raw=None):
+    """Boolean env parsing shared by every PTPU_* switch (the spelling
+    semantics parallel/zero.py established): unset/empty -> None,
+    1/true/on/yes -> True, 0/false/off/no -> False (case-insensitive),
+    anything else raises naming the flag."""
+    raw = os.environ.get(name, "") if raw is None else raw
+    if raw == "":
+        return None
+    low = raw.strip().lower()
+    if low in ("1", "true", "on", "yes"):
+        return True
+    if low in ("0", "false", "off", "no"):
+        return False
+    raise ValueError("%s=%r is not a boolean flag (use 0/1)" % (name, raw))
+
+
+class EnvFlag:
+    """One declared PTPU_* environment switch: name, type ('bool', 'int',
+    'float', 'str', 'path'), default (returned when unset/empty),
+    docstring. 'path' accepts the boolean OFF spellings as unset —
+    `PTPU_TRACE_DIR=0` disables tracing rather than naming a directory
+    literally '0', the semantics the pre-registry `_env_on` gate had."""
+
+    __slots__ = ("name", "type", "default", "doc")
+
+    def __init__(self, name, type, default, doc):
+        self.name = name
+        self.type = type
+        self.default = default
+        self.doc = doc
+
+    def parse(self, raw):
+        if raw == "":
+            return self.default
+        if self.type == "bool":
+            val = env_flag(self.name, raw)
+            return self.default if val is None else val
+        if self.type in ("int", "float"):
+            conv = int if self.type == "int" else float
+            try:
+                return conv(raw)
+            except ValueError:
+                raise ValueError("%s=%r is not %s %s"
+                                 % (self.name, raw,
+                                    "an" if self.type == "int" else "a",
+                                    self.type))
+        if self.type == "path" and raw.strip().lower() in (
+                "0", "false", "off", "no"):
+            return self.default
+        return raw
+
+
+_ENV_REGISTRY = {}
+
+
+def _declare(name, type, default, doc):
+    _ENV_REGISTRY[name] = EnvFlag(name, type, default, doc)
+
+
+# -- observability (docs/OBSERVABILITY.md) ----------------------------------
+_declare("PTPU_METRICS", "bool", False,
+         "enable the instrumented metrics hot paths")
+_declare("PTPU_METRICS_OUT", "path", None,
+         "dump the metrics registry as JSON to this path at process exit")
+_declare("PTPU_TRACE", "bool", False,
+         "enable tracing-span recording")
+_declare("PTPU_TRACE_DIR", "path", None,
+         "enable spans and write <dir>/ptpu_trace.json at process exit")
+# -- executor / async engine (docs/ASYNC_EXECUTION.md) ----------------------
+_declare("PTPU_ASYNC_STEPS", "int", 12,
+         "async in-flight window depth before dispatch backpressures")
+_declare("PTPU_CACHE_DIR", "path", None,
+         "persistent on-disk XLA compile cache directory")
+# -- compiler pipeline (docs/COMPILER_PASSES.md, docs/STATIC_ANALYSIS.md) ---
+_declare("PTPU_NO_PROGRAM_OPT", "bool", False,
+         "disable the compile-time pass pipeline (exact unoptimized path)")
+_declare("PTPU_VERIFY_PASSES", "bool", False,
+         "run the Program IR verifier before the pass pipeline and after "
+         "each pass, blaming the pass that introduced a violation")
+# -- mixed precision (docs/MIXED_PRECISION.md) ------------------------------
+_declare("PTPU_AMP", "bool", False,
+         "activate the AMP dtype rewrite process-wide")
+_declare("PTPU_AMP_LEVEL", "str", "O1",
+         "AMP level when activated via PTPU_AMP (O1 or O2)")
+_declare("PTPU_AMP_DTYPE", "str", "bfloat16",
+         "AMP compute dtype when activated via PTPU_AMP")
+_declare("PTPU_AMP_BUCKET_MB", "float", None,
+         "gradient-bucket size in MiB for coalesced collectives "
+         "(0/unset = per-leaf collectives)")
+# -- ZeRO (docs/ZERO.md) ----------------------------------------------------
+_declare("PTPU_ZERO_STAGE", "int", None,
+         "ZeRO sharding stage for ShardedAdam (1, 2 or 3)")
+_declare("PTPU_ZERO_OVERLAP", "bool", False,
+         "issue per-bucket collectives in backward order (comm/compute "
+         "overlap)")
+_declare("PTPU_ZERO_OFFLOAD", "bool", False,
+         "keep optimizer state in host RAM between steps")
+# -- resilience (docs/RESILIENCE.md) ----------------------------------------
+_declare("PTPU_ANOMALY_POLICY", "str", None,
+         "ResilientTrainer anomaly policy (warn|skip_batch|rollback|abort; "
+         "unset = rollback)")
+_declare("PTPU_SPIKE_FACTOR", "float", None,
+         "loss-spike threshold as a multiple of the running EMA "
+         "(unset = spike detection off)")
+_declare("PTPU_FAULT_INJECT", "str", None,
+         "deterministic fault-injection spec, e.g. "
+         "'nan_at_step:12,ckpt_torn_write:2'")
+_declare("PTPU_RETRY_BUDGET", "int", 8,
+         "rollback-and-retry attempts per training run")
+_declare("PTPU_RETRY_BACKOFF", "float", 0.05,
+         "base seconds of exponential backoff between transient retries")
+# -- serving (docs/SERVING.md) ----------------------------------------------
+_declare("PTPU_SERVE_ASYNC_STEPS", "int", 4,
+         "decode steps kept in flight ahead of EOS/stream materialization")
+# -- tests / CI -------------------------------------------------------------
+_declare("PTPU_PARITY_TIMEOUT", "float", 45.0,
+         "seconds the TPU-backend parity test waits on its subprocess "
+         "before skipping")
+
+
+def env(name):
+    """Read one declared PTPU_* environment switch: the parsed value, or
+    the declared default when unset/empty. Reads the environment at CALL
+    time (no import-time latch). Unknown names raise — declare the flag
+    here first (the linter enforces the same rule statically)."""
+    spec = _ENV_REGISTRY.get(name)
+    if spec is None:
+        raise KeyError(
+            "undeclared environment flag %r — add it to the "
+            "paddle_tpu.flags registry (see docs/STATIC_ANALYSIS.md)"
+            % (name,))
+    return spec.parse(os.environ.get(name, ""))
+
+
+def declared_flags():
+    """{name: EnvFlag} snapshot of the PTPU_* registry (the linter's and
+    describe()'s source of truth)."""
+    return dict(_ENV_REGISTRY)
+
+
+def describe():
+    """The PTPU_* registry as an aligned text table (name, type, default,
+    description) — the contract surface docs and the linter check
+    against."""
+    rows = [("Flag", "Type", "Default", "Description")]
+    for name in sorted(_ENV_REGISTRY):
+        spec = _ENV_REGISTRY[name]
+        rows.append((name, spec.type,
+                     "-" if spec.default is None else repr(spec.default),
+                     spec.doc))
+    w0 = max(len(r[0]) for r in rows)
+    w1 = max(len(r[1]) for r in rows)
+    w2 = max(len(r[2]) for r in rows)
+    return "\n".join("%-*s  %-*s  %-*s  %s" % (w0, r[0], w1, r[1],
+                                               w2, r[2], r[3])
+                     for r in rows)

@@ -94,10 +94,24 @@ def registered_passes():
 
 
 def apply_passes(program, names, scope=None):
-    """Run the named passes in order over `program` (PassBuilder parity)."""
+    """Run the named passes in order over `program` (PassBuilder parity).
+
+    Under `PTPU_VERIFY_PASSES=1` the Program IR verifier runs on the
+    input and after every pass, raising `analysis.VerifyError` naming
+    the pass that introduced a violation (docs/STATIC_ANALYSIS.md) —
+    the same hook `ir_passes.optimize_for_execution` uses, so
+    AnalysisPredictor load-time passes and user pipelines get the same
+    per-pass validation as the compile-time pipeline."""
     scope = scope if scope is not None else global_scope()
+    from .analysis import verifier as _av
+
+    verifier = None
+    if _av.verify_enabled():
+        verifier = _av.PassPipelineVerifier(program)
     for name in names:
         get_pass(name).apply(program, scope)
+        if verifier is not None:
+            verifier.after_pass(name, program)
     return program
 
 

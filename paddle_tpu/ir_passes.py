@@ -49,11 +49,11 @@ Soundness invariants shared by the rewriting passes:
     indices are part of the CSE key, so in-place rebinding is safe).
 """
 
-import os
 import time
 
 import numpy as np
 
+from . import flags as _flags
 from .observability import metrics as _metrics
 from .observability import tracing as _tracing
 
@@ -94,7 +94,7 @@ def pipeline_enabled():
     """False under PTPU_NO_PROGRAM_OPT=1 — every compile-time transform
     (including donation promotion) gates on this, so the opt-out restores
     the exact unoptimized lowering path."""
-    return os.environ.get("PTPU_NO_PROGRAM_OPT", "") not in ("1", "true")
+    return not _flags.env("PTPU_NO_PROGRAM_OPT")
 
 
 def program_is_inference(program):
@@ -212,6 +212,15 @@ def optimize_for_execution(program, fetch_names, scope=None,
         # with_inference_optimize non-dp path hands its clone to
         # Executor.run) must not lose the state_fallback values
         clone._baked_values = dict(baked)
+    # PTPU_VERIFY_PASSES=1: verify the input clone, then re-verify after
+    # every pass, blaming the pass that introduced a violation (docs/
+    # STATIC_ANALYSIS.md). Env unset -> verifier is None and this path is
+    # exactly the pre-verifier one.
+    verifier = None
+    from .analysis import verifier as _av
+
+    if _av.verify_enabled():
+        verifier = _av.PassPipelineVerifier(clone, tuple(fetch_names))
     rec = _metrics.enabled()
     changed_any = False
     for name in names:
@@ -222,6 +231,10 @@ def optimize_for_execution(program, fetch_names, scope=None,
         if rec:
             _metrics.histogram("compiler/pass_time").observe(
                 time.perf_counter() - t0)
+        if verifier is not None:
+            # unconditionally — a buggy pass that mutates WITHOUT
+            # bumping the version must still be blamed
+            verifier.after_pass(name, clone)
         changed_any = changed_any or clone.version != v0
     if not changed_any:
         # nothing fired: hand the executor the ORIGINAL program so the

@@ -691,13 +691,38 @@ def clip_by_norm(x, max_norm, name=None):
 # ---------------------------------------------------------------------------
 
 
+def _elementwise_out_shape(xs, ys, axis):
+    """Declared Out shape of an elementwise op: the kernel numpy-
+    broadcasts after Fluid axis alignment, so a bigger Y dominates —
+    declaring plain X.shape mis-describes the reversed-scalar case
+    (`1 - v`: X is the promoted (1,) constant, Out is v's shape; flagged
+    by the IR verifier's shape propagation). Delegates to the SAME rule
+    the verifier infers with (analysis.meta.elementwise_out_dims), so
+    builder declaration and verifier inference cannot drift; -1 is this
+    side's unknown-dim spelling, None the verifier's."""
+    if xs is None or ys is None:
+        return xs
+    from ..analysis.meta import elementwise_out_dims
+
+    unk = lambda s: tuple(None if d == -1 else d for d in s)  # noqa: E731
+    try:
+        merged = elementwise_out_dims(unk(xs), unk(ys), axis)
+    except ValueError:
+        return tuple(xs)  # statically incompatible: the kernel will raise
+    if merged is None:
+        return tuple(xs)
+    return tuple(-1 if d is None else d for d in merged)
+
+
 def _elementwise(op_type):
     def layer(x, y, axis=-1, act=None, name=None):
         helper = LayerHelper(op_type, **locals())
         out = helper.create_variable_for_type_inference(dtype=x.dtype)
         helper.append_op(type=op_type, inputs={"X": [x], "Y": [y]},
                          outputs={"Out": [out]}, attrs={"axis": axis})
-        out.shape = x.shape
+        out.shape = _elementwise_out_shape(x.shape,
+                                           getattr(y, "shape", None),
+                                           axis)
         return helper.append_activation(out)
 
     layer.__name__ = op_type
@@ -773,7 +798,11 @@ def _reduce(op_type):
                          outputs={"Out": [out]}, attrs=attrs)
         if input.shape is not None:
             if dim is None:
-                out.shape = (1,)
+                # reduce_all honors keep_dim too: jnp keepdims leaves an
+                # all-ones shape of the input's rank, not (1,) (declared
+                # drift flagged by the IR verifier's shape propagation)
+                out.shape = ((1,) * len(input.shape)) if keep_dim \
+                    else (1,)
             else:
                 dims = [d % len(input.shape)
                         for d in (dim if isinstance(dim, (list, tuple)) else [dim])]

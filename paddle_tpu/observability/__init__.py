@@ -99,14 +99,16 @@ def step_scope():
 
 
 def _exit_dumps():
-    out = os.environ.get("PTPU_METRICS_OUT")
+    from .. import flags as _flags
+
+    out = _flags.env("PTPU_METRICS_OUT")
     if out:
         try:
             metrics.dump_json(out)
         except OSError:
             pass
-    if metrics._env_on("PTPU_TRACE_DIR"):
-        tdir = os.environ["PTPU_TRACE_DIR"]
+    tdir = _flags.env("PTPU_TRACE_DIR")
+    if tdir:
         try:
             os.makedirs(tdir, exist_ok=True)
             tracing.dump_chrome_trace(os.path.join(tdir, "ptpu_trace.json"))
@@ -114,5 +116,7 @@ def _exit_dumps():
             pass
 
 
-if os.environ.get("PTPU_METRICS_OUT") or metrics._env_on("PTPU_TRACE_DIR"):
+from .. import flags as _flags  # noqa: E402  (stdlib-only, cycle-free)
+
+if _flags.env("PTPU_METRICS_OUT") or _flags.env("PTPU_TRACE_DIR"):
     atexit.register(_exit_dumps)

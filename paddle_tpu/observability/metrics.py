@@ -25,7 +25,6 @@ without it.
 
 import json
 import math
-import os
 import threading
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -276,7 +275,12 @@ _REGISTRY = MetricsRegistry()
 
 
 def _env_on(name):
-    return os.environ.get(name, "") not in ("", "0", "false", "False")
+    """PTPU_* switch check through the central flags registry (bool flags
+    parse with the shared spellings; path-valued flags count as on when
+    set non-empty)."""
+    from .. import flags as _flags
+
+    return bool(_flags.env(name))
 
 
 _ENABLED = _env_on("PTPU_METRICS")

@@ -130,7 +130,7 @@ def _forward_native(name, us_start, us_end):
         pass
 
 
-from .metrics import _env_on  # one parser for every PTPU_* switch
+from .metrics import _env_on  # central flags-registry check
 
 _ENABLED = _env_on("PTPU_TRACE") or _env_on("PTPU_TRACE_DIR")
 

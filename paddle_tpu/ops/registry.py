@@ -30,7 +30,7 @@ class OpDef:
         differentiable=True,
         nondiff_inputs=(),
         stateful=False,
-        infer_shape=None,
+        infer_meta=None,
     ):
         self.name = name
         self.impl = impl
@@ -39,25 +39,37 @@ class OpDef:
         self.nondiff_inputs = frozenset(nondiff_inputs)
         # stateful ops use ctx.rng() or update persistable state
         self.stateful = stateful
-        self.infer_shape = infer_shape
+        # optional static-analysis metadata (an analysis.meta.OpMeta):
+        # required input/output slots + attrs and a shape/dtype
+        # propagation rule — the InferShape/InferVarType parity surface
+        # the Program verifier checks ops against (docs/STATIC_ANALYSIS.md)
+        self.infer_meta = infer_meta
 
     def __repr__(self):
         return "OpDef(%s)" % self.name
 
 
 def register(name, differentiable=True, nondiff_inputs=(), stateful=False,
-             infer_shape=None):
+             infer_meta=None):
     """Decorator: register `impl(ctx, ins, attrs) -> outs` for op `name`."""
 
     def deco(fn):
         if name in _REGISTRY:
             raise ValueError("op %r already registered" % name)
         _REGISTRY[name] = OpDef(
-            name, fn, differentiable, nondiff_inputs, stateful, infer_shape
+            name, fn, differentiable, nondiff_inputs, stateful, infer_meta
         )
         return fn
 
     return deco
+
+
+def set_infer_meta(name, meta):
+    """Attach (or replace) the static-analysis metadata of a registered
+    op — how `paddle_tpu.analysis.meta` contributes entries for ops whose
+    kernels predate the verifier."""
+    get(name).infer_meta = meta
+    return meta
 
 
 def simple_op(name, in_slots=("X",), out_slot="Out", differentiable=True,

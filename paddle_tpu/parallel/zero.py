@@ -53,13 +53,12 @@ run byte-for-byte the pre-overlap paths, so the existing ZeRO-1
 trajectory is bitwise identical.
 """
 
-import os
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import flags as _flags
 from ..core.jax_compat import shard_map
 from ..observability import metrics as _metrics
 
@@ -73,26 +72,16 @@ class ZeroLayoutError(RuntimeError):
     a stale layout."""
 
 
-def _env_flag(name):
-    raw = os.environ.get(name, "")
-    if raw == "":
-        return None
-    low = raw.strip().lower()
-    if low in ("1", "true", "on", "yes"):
-        return True
-    if low in ("0", "false", "off", "no"):
-        return False
-    raise ValueError("%s=%r is not a boolean flag (use 0/1)" % (name, raw))
+# the one boolean-spelling parser for PTPU_* switches now lives in the
+# central flags registry; kept under the established local name
+_env_flag = _flags.env_flag
 
 
 def _env_stage():
-    raw = os.environ.get("PTPU_ZERO_STAGE", "")
-    if raw == "":
-        return None
     try:
-        return int(raw)
-    except ValueError:
-        raise ValueError("PTPU_ZERO_STAGE=%r is not an integer" % (raw,))
+        return _flags.env("PTPU_ZERO_STAGE")
+    except ValueError as exc:
+        raise ValueError("PTPU_ZERO_STAGE is not an integer: %s" % (exc,))
 
 
 def _pad_leading(x, n):

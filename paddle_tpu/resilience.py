@@ -52,6 +52,7 @@ import warnings
 
 import numpy as np
 
+from .flags import env as _env
 from .observability import metrics as _metrics
 from .observability import tracing as _tracing
 
@@ -81,8 +82,7 @@ def anomaly_policy(value=None):
     """Resolve the anomaly policy: explicit arg > $PTPU_ANOMALY_POLICY >
     `rollback` (the trainer exists to recover, so recovery is the
     default)."""
-    policy = value or os.environ.get("PTPU_ANOMALY_POLICY") \
-        or POLICY_ROLLBACK
+    policy = value or _env("PTPU_ANOMALY_POLICY") or POLICY_ROLLBACK
     if policy not in POLICIES:
         raise ValueError("unknown anomaly policy %r (want one of %s)"
                          % (policy, "|".join(POLICIES)))
@@ -119,8 +119,7 @@ class AnomalyDetector:
 
     def __init__(self, spike_factor=None, spike_window=16, warmup=5):
         if spike_factor is None:
-            env = os.environ.get("PTPU_SPIKE_FACTOR")
-            spike_factor = float(env) if env else 0.0
+            spike_factor = _env("PTPU_SPIKE_FACTOR") or 0.0
         self.spike_factor = float(spike_factor or 0.0)
         self.warmup = int(warmup)
         self._alpha = 2.0 / (max(2, int(spike_window)) + 1.0)
@@ -258,7 +257,7 @@ class FaultInjector:
 
     @classmethod
     def from_env(cls):
-        return cls(os.environ.get("PTPU_FAULT_INJECT"))
+        return cls(_env("PTPU_FAULT_INJECT"))
 
     def active(self):
         return bool(self._steps or self._targets)
@@ -542,11 +541,10 @@ class ResilientTrainer:
         self.guard_every = max(1, int(guard_every))
         self.guard_fetch_index = int(guard_fetch_index)
         if retry_budget is None:
-            retry_budget = int(os.environ.get("PTPU_RETRY_BUDGET") or 8)
+            retry_budget = _env("PTPU_RETRY_BUDGET")
         self.retry_budget = int(retry_budget)
         if backoff_base is None:
-            backoff_base = float(os.environ.get("PTPU_RETRY_BACKOFF")
-                                 or 0.05)
+            backoff_base = _env("PTPU_RETRY_BACKOFF")
         self.backoff_base = float(backoff_base)
         self.backoff_max = float(backoff_max)
         self.max_step_retries = int(max_step_retries)

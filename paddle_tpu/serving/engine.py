@@ -26,7 +26,6 @@ prefill_tokens,decode_tokens,requests_submitted,requests_completed,
 requests_rejected,requests_failed}``.
 """
 
-import os
 import threading
 import time
 
@@ -262,11 +261,9 @@ class ServingEngine:
                  block_size=16, num_blocks=None, max_queue=64,
                  async_depth=None):
         if async_depth is None:
-            try:
-                async_depth = int(
-                    os.environ.get("PTPU_SERVE_ASYNC_STEPS") or 4)
-            except ValueError:
-                async_depth = 4
+            from ..flags import env as _env
+
+            async_depth = _env("PTPU_SERVE_ASYNC_STEPS")
         if not isinstance(models, dict):
             models = {"default": models}
         if not models:

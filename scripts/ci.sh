@@ -24,6 +24,12 @@
 #                serial engine, gating on occupancy > 1, token-identical
 #                outputs, finite request latencies, and batched >= 2x
 #                serial aggregate tokens/s
+#   lint       - repo-invariant linter (docs/STATIC_ANALYSIS.md):
+#                tools/ptpu_lint.py over paddle_tpu/, zero findings
+#   verify     - Program IR verifier receipt: fit-a-line (default
+#                pipeline + PTPU_NO_PROGRAM_OPT=1) and the tiny
+#                transformer bench with AMP on, all under
+#                PTPU_VERIFY_PASSES=1, gating verify/violations == 0
 #   zero       - ZeRO ladder + comm/compute overlap receipt
 #                (docs/ZERO.md): one tiny MLP through ZeRO-1 per-leaf /
 #                bucketed-no-overlap (the PR-5 path) / ZeRO-2 overlap /
@@ -31,7 +37,7 @@
 #                gating numerics per rung, losses decreasing, offload
 #                bytes moved, and the step-time overlap receipt
 #                (overlapped <= non-overlapped)
-# Usage: scripts/ci.sh [build|test|api_check|bench|bench-smoke|stress|obs|chaos|amp|serve|zero|all]
+# Usage: scripts/ci.sh [build|test|api_check|bench|bench-smoke|stress|obs|chaos|amp|serve|lint|verify|zero|all]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -334,6 +340,59 @@ print("serve stage ok:",
 PYEOF
 }
 
+do_lint() {
+  # source-invariant gate (docs/STATIC_ANALYSIS.md): PTPU_* env reads
+  # through the flags registry, no bare excepts, no build-time jnp in
+  # op builders, metric names documented. Zero findings or fail.
+  python tools/ptpu_lint.py paddle_tpu/
+  python -c "import paddle_tpu; print(paddle_tpu.flags.describe())" \
+    > /dev/null
+}
+
+do_verify() {
+  # Program IR verifier receipt (docs/STATIC_ANALYSIS.md): training and
+  # inference compile paths run clean under PTPU_VERIFY_PASSES=1 — the
+  # verifier checked >= 1 program and found 0 violations — on the
+  # default pipeline, under PTPU_NO_PROGRAM_OPT=1 (the no-opt compile
+  # hook), and on the tiny transformer bench with AMP on.
+  local dump=/tmp/ptpu_verify_metrics.json
+  local noopt
+  for noopt in "" "1"; do
+    rm -f "$dump"
+    JAX_PLATFORMS=cpu PTPU_METRICS=1 PTPU_METRICS_OUT="$dump" \
+      PTPU_VERIFY_PASSES=1 PTPU_NO_PROGRAM_OPT="$noopt" \
+      python - <<'PYEOF'
+import numpy as np
+import paddle_tpu as fluid
+
+x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+pred = fluid.layers.fc(input=x, size=1)
+loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+fluid.optimizer.SGD(0.05).minimize(loss)
+exe = fluid.Executor(fluid.CPUPlace())
+exe.run(fluid.default_startup_program())
+rng = np.random.RandomState(0)
+for _ in range(10):
+    out, = exe.run(feed={"x": rng.uniform(-1, 1, (16, 13)).astype("float32"),
+                         "y": rng.uniform(-1, 1, (16, 1)).astype("float32")},
+                   fetch_list=[loss])
+assert np.isfinite(np.asarray(out)).all(), out
+print("verify fit-a-line ok, loss", np.asarray(out))
+PYEOF
+    python tools/ptpu_stats.py "$dump" \
+      --assert-min verify/programs_checked=1 \
+      --assert-max verify/violations=0
+  done
+  # transformer bench config, AMP on, verifier live for every compile
+  rm -f "$dump"
+  JAX_PLATFORMS=cpu PTPU_METRICS=1 PTPU_VERIFY_PASSES=1 \
+    python bench.py --tiny --amp-only --metrics-out "$dump"
+  python tools/ptpu_stats.py "$dump" \
+    --assert-min verify/programs_checked=1 amp/casts_inserted=1 \
+    --assert-max verify/violations=0
+}
+
 do_zero() {
   # ZeRO/overlap receipt (docs/ZERO.md). Functional gates hold on every
   # attempt: every rung's trained params close to the bucketed anchor
@@ -405,7 +464,9 @@ case "$stage" in
   chaos) do_chaos ;;
   amp) do_amp ;;
   serve) do_serve ;;
+  lint) do_lint ;;
+  verify) do_verify ;;
   zero) do_zero ;;
-  all) do_build; do_test; do_api_check; do_bench_smoke; do_chaos; do_amp; do_serve; do_zero; do_bench ;;
+  all) do_build; do_lint; do_test; do_api_check; do_bench_smoke; do_chaos; do_amp; do_serve; do_verify; do_zero; do_bench ;;
   *) echo "unknown stage: $stage" >&2; exit 2 ;;
 esac

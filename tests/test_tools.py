@@ -95,3 +95,91 @@ def test_install_check_runs():
     # top-level batch alias (paddle.batch parity)
     batches = list(fluid.batch(lambda: iter(range(10)), batch_size=4)())
     assert [len(b) for b in batches] == [4, 4, 2]
+
+
+def test_ptpu_stats_nan_fails_assertions():
+    """ISSUE 9 satellite: a NaN metric value must fail ANY --assert-min/
+    --assert-max comparison loudly (NaN compares false against every
+    bound, so the old code passed it silently — noted landing PR 7)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import ptpu_stats
+    finally:
+        sys.path.pop(0)
+    doc = {"gauges": {"chaos/final_loss": float("nan"),
+                      "ok/value": 1.0}}
+    fails = ptpu_stats.check_assertions(
+        doc, None, None, maxs=["chaos/final_loss=0.1"])
+    assert fails and "NaN" in fails[0]
+    fails = ptpu_stats.check_assertions(
+        doc, None, ["chaos/final_loss=0.1"])
+    assert fails and "NaN" in fails[0]
+    # a NaN BOUND is a spec bug, not a pass
+    assert ptpu_stats.check_assertions(doc, None, ["ok/value=nan"])
+    # a non-numeric bound is a clean failure message, not a traceback —
+    # and a missing metric with a bad bound still reports, not raises
+    fails = ptpu_stats.check_assertions(doc, None, ["missing/m=abc"])
+    assert fails and "numeric" in fails[0]
+    # healthy values keep passing
+    assert ptpu_stats.check_assertions(
+        doc, ["ok/value"], ["ok/value=1"], maxs=["ok/value=1"]) == []
+
+
+def test_ptpu_lint_clean_on_repo():
+    """The CI lint gate: zero findings over paddle_tpu/ (ISSUE 9 — the
+    gates land green, not suppressed)."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "ptpu_lint.py")],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_ptpu_lint_rules_fire(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import ptpu_lint
+    finally:
+        sys.path.pop(0)
+    fixture = tmp_path / "paddle_tpu" / "layers" / "fixture.py"
+    fixture.parent.mkdir(parents=True)
+    fixture.write_text(
+        "import os\n"
+        "import jax.numpy as jnp\n"
+        "from paddle_tpu import flags\n"
+        "def builder(helper, x):\n"
+        "    y = jnp.maximum(x, 0)\n"
+        "    helper.append_op(type='relu')\n"
+        "    return y\n"
+        "def reads():\n"
+        "    a = os.environ.get('PTPU_NOPE')\n"
+        "    b = os.environ['PTPU_ALSO_NOPE']\n"
+        "    c = flags.env('PTPU_TYPO')\n"
+        "    d = flags.env('PTPU_METRICS')  # declared: fine\n"
+        "    try:\n"
+        "        pass\n"
+        "    except:\n"
+        "        pass\n"
+        "    return a, b, c, d\n"
+        "def metric(m):\n"
+        "    m.counter('compiler/ops_removed').inc()\n"
+        "    m.gauge('nodoc/surely_not_documented').set(1)\n")
+    findings = ptpu_lint.lint_file(str(fixture),
+                                   ptpu_lint.declared_flag_names(),
+                                   ptpu_lint.documented_metric_names())
+    rules = sorted({f.rule for f in findings})
+    assert rules == ["bare-except", "buildtime-jnp", "env-read",
+                     "env-undeclared", "metric-undocumented"], findings
+    assert len([f for f in findings if f.rule == "env-read"]) == 2
+    # writes to os.environ (test setup idiom) are not reads
+    setter = tmp_path / "setter.py"
+    setter.write_text("import os\nos.environ['PTPU_METRICS'] = '1'\n")
+    assert ptpu_lint.lint_file(str(setter),
+                               ptpu_lint.declared_flag_names(),
+                               "") == []
+
+
+def test_flags_describe_cli_table():
+    from paddle_tpu import flags
+
+    table = flags.describe()
+    assert "PTPU_VERIFY_PASSES" in table and "PTPU_METRICS" in table

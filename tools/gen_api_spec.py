@@ -30,6 +30,8 @@ NAMESPACES = [
     ("paddle_tpu.checkpoint", None),
     ("paddle_tpu.ir", None),
     ("paddle_tpu.amp", None),
+    ("paddle_tpu.analysis", None),
+    ("paddle_tpu.flags", None),
     ("paddle_tpu.parallel", None),
     ("paddle_tpu.serving", None),
     ("paddle_tpu.profiler", None),

@@ -1,0 +1,295 @@
+"""Repo-invariant linter (docs/STATIC_ANALYSIS.md) — the source-level
+sibling of the Program IR verifier: AST checks for the conventions the
+framework relies on but Python cannot enforce.
+
+Rules:
+
+  env-read     every `PTPU_*` environment read must go through the
+               central `paddle_tpu.flags` registry (`flags.env(...)`),
+               never `os.environ[...]`/`os.environ.get`/`os.getenv`
+               directly — the registry is what pins type, default and
+               boolean spelling (the `_env_flag` drift class of bug)
+  env-undeclared
+               a flag name passed to `flags.env("PTPU_...")` (or
+               `env_flag`) must exist in the registry — a typo'd name
+               fails here instead of silently reading a default
+  bare-except  no `except:` without an exception type — it swallows
+               KeyboardInterrupt/SystemExit and masks real faults
+  buildtime-jnp
+               an op-BUILDER function (one that calls `append_op`/
+               `prepend_op`, i.e. runs at program-build time) in
+               `layers/` or `ops/` must not also call `jnp.*`/`jax.*` —
+               that executes device compute while building the graph
+               (kernels run jnp at TRACE time; builders must not)
+  metric-undocumented
+               a metric name literal passed to `counter()/gauge()/
+               histogram()` must appear in docs/OBSERVABILITY.md — the
+               registry's exposition tables are the contract dashboards
+               are built against
+
+Usage:
+  python tools/ptpu_lint.py [path ...]     # default: paddle_tpu/
+  python tools/ptpu_lint.py --list-rules
+
+Exit status 1 when any finding is reported (the CI `lint` stage gates on
+zero findings).
+"""
+
+import argparse
+import ast
+import importlib.util
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FLAGS_PATH = os.path.join(REPO_ROOT, "paddle_tpu", "flags.py")
+OBS_DOC_PATH = os.path.join(REPO_ROOT, "docs", "OBSERVABILITY.md")
+STATIC_DOC_PATH = os.path.join(REPO_ROOT, "docs", "STATIC_ANALYSIS.md")
+
+RULES = {
+    "env-read": "PTPU_* environment reads must go through flags.env",
+    "env-undeclared": "flag names passed to flags.env/env_flag must be "
+                      "declared in the registry",
+    "bare-except": "no bare `except:` handlers",
+    "buildtime-jnp": "op-builder functions may not call jnp.*/jax.* at "
+                     "program-build time",
+    "metric-undocumented": "metric name literals must appear in "
+                           "docs/OBSERVABILITY.md",
+}
+
+# directories whose functions are program-BUILDERS when they append ops
+_BUILDER_DIRS = (os.path.join("paddle_tpu", "layers"),
+                 os.path.join("paddle_tpu", "ops"))
+
+_ENV_CALL_NAMES = ("env", "env_flag", "flags_env", "_env", "_env_flag",
+                   "_env_on")
+
+
+class Finding:
+    __slots__ = ("path", "line", "rule", "message")
+
+    def __init__(self, path, line, rule, message):
+        self.path = path
+        self.line = line
+        self.rule = rule
+        self.message = message
+
+    def __str__(self):
+        rel = os.path.relpath(self.path, REPO_ROOT)
+        return "%s:%d: [%s] %s" % (rel, self.line, self.rule,
+                                   self.message)
+
+
+def declared_flag_names():
+    """Flag names from the registry, loaded from flags.py BY PATH — the
+    module is stdlib-only, so the linter never imports the jax-heavy
+    package."""
+    spec = importlib.util.spec_from_file_location("_ptpu_flags",
+                                                  FLAGS_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return set(mod.declared_flags())
+
+
+def documented_metric_names():
+    """The raw OBSERVABILITY.md text; documented-name checks are
+    substring membership (table rows list several names per cell)."""
+    try:
+        with open(OBS_DOC_PATH) as f:
+            obs = f.read()
+    except OSError:
+        obs = ""
+    try:
+        with open(STATIC_DOC_PATH) as f:
+            obs += f.read()
+    except OSError:
+        pass
+    return obs
+
+
+def _is_environ(node):
+    """node is `os.environ` (or bare `environ` from `from os import
+    environ`)."""
+    if isinstance(node, ast.Attribute) and node.attr == "environ" \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "os":
+        return True
+    return isinstance(node, ast.Name) and node.id == "environ"
+
+
+def _const_str(node):
+    return node.value if isinstance(node, ast.Constant) \
+        and isinstance(node.value, str) else None
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path, flag_names, doc_text, is_flags_module,
+                 builder_scope):
+        self.path = path
+        self.flag_names = flag_names
+        self.doc_text = doc_text
+        self.is_flags_module = is_flags_module
+        self.builder_scope = builder_scope
+        self.findings = []
+        self._func_stack = []
+
+    def _add(self, node, rule, message):
+        self.findings.append(Finding(self.path, node.lineno, rule,
+                                     message))
+
+    # -- helpers -------------------------------------------------------
+    def _check_env_name_arg(self, node):
+        """`flags.env("NAME")`-family call: NAME must be declared."""
+        if not node.args:
+            return
+        name = _const_str(node.args[0])
+        if name is not None and name.startswith("PTPU_") \
+                and name not in self.flag_names:
+            self._add(node, "env-undeclared",
+                      "flag %r is not declared in the paddle_tpu.flags "
+                      "registry" % name)
+
+    def _ptpu_arg(self, node):
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            s = _const_str(arg)
+            if s is not None and s.startswith("PTPU_"):
+                return s
+        return None
+
+    # -- visitors ------------------------------------------------------
+    def visit_FunctionDef(self, node):
+        self._func_stack.append({"appends": False, "jnp_calls": []})
+        self.generic_visit(node)
+        info = self._func_stack.pop()
+        if self.builder_scope and info["appends"]:
+            for call in info["jnp_calls"]:
+                self._add(call, "buildtime-jnp",
+                          "op-builder %r calls %s at program-build time "
+                          "— compute belongs in the op KERNEL, not the "
+                          "builder" % (node.name, call._jnp_repr))
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ExceptHandler(self, node):
+        if node.type is None:
+            self._add(node, "bare-except",
+                      "bare `except:` swallows KeyboardInterrupt/"
+                      "SystemExit — name the exception class")
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node):
+        if not self.is_flags_module and _is_environ(node.value) \
+                and isinstance(node.ctx, ast.Load):
+            key = _const_str(node.slice)
+            if key is not None and key.startswith("PTPU_"):
+                self._add(node, "env-read",
+                          "read %s through flags.env(%r), not "
+                          "os.environ" % (key, key))
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        func = node.func
+        # os.environ.get("PTPU_...") / os.getenv("PTPU_...")
+        if isinstance(func, ast.Attribute):
+            if func.attr == "get" and _is_environ(func.value) \
+                    and not self.is_flags_module:
+                key = self._ptpu_arg(node)
+                if key:
+                    self._add(node, "env-read",
+                              "read %s through flags.env(%r), not "
+                              "os.environ.get" % (key, key))
+            elif func.attr == "getenv" \
+                    and isinstance(func.value, ast.Name) \
+                    and func.value.id == "os" \
+                    and not self.is_flags_module:
+                key = self._ptpu_arg(node)
+                if key:
+                    self._add(node, "env-read",
+                              "read %s through flags.env(%r), not "
+                              "os.getenv" % (key, key))
+            elif func.attr in _ENV_CALL_NAMES:
+                self._check_env_name_arg(node)
+            # metric name literals: counter/gauge/histogram("a/b")
+            if func.attr in ("counter", "gauge", "histogram") \
+                    and node.args:
+                name = _const_str(node.args[0])
+                if name and "/" in name and name not in self.doc_text:
+                    self._add(node, "metric-undocumented",
+                              "metric %r is not documented in "
+                              "docs/OBSERVABILITY.md" % name)
+            # builder-scope jnp/jax calls
+            root = func
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name) and root.id in ("jnp", "jax") \
+                    and self._func_stack:
+                node._jnp_repr = ast.unparse(func) if hasattr(
+                    ast, "unparse") else root.id + ".*"
+                self._func_stack[-1]["jnp_calls"].append(node)
+            if func.attr in ("append_op", "prepend_op") \
+                    and self._func_stack:
+                self._func_stack[-1]["appends"] = True
+        elif isinstance(func, ast.Name):
+            if func.id in _ENV_CALL_NAMES:
+                self._check_env_name_arg(node)
+        self.generic_visit(node)
+
+
+def lint_file(path, flag_names, doc_text):
+    with open(path) as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 0, "parse-error", str(e))]
+    norm = os.path.abspath(path).replace(os.sep, "/")
+    is_flags = os.path.abspath(path) == FLAGS_PATH
+    builder = any(("/%s/" % d.replace(os.sep, "/")) in norm
+                  for d in _BUILDER_DIRS)
+    linter = _Linter(path, flag_names, doc_text, is_flags, builder)
+    linter.visit(tree)
+    return linter.findings
+
+
+def iter_py_files(paths):
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git")]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("paths", nargs="*",
+                    default=[os.path.join(REPO_ROOT, "paddle_tpu")],
+                    help="files/directories to lint (default: "
+                         "paddle_tpu/)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+    if args.list_rules:
+        for rule in sorted(RULES):
+            print("%-20s %s" % (rule, RULES[rule]))
+        return 0
+    flag_names = declared_flag_names()
+    doc_text = documented_metric_names()
+    findings = []
+    n_files = 0
+    for path in iter_py_files(args.paths):
+        n_files += 1
+        findings.extend(lint_file(path, flag_names, doc_text))
+    for f in findings:
+        print(f)
+    print("ptpu_lint: %d file(s), %d finding(s)" % (n_files,
+                                                    len(findings)),
+          file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -157,8 +157,13 @@ def _lookup(doc, name):
 def check_assertions(doc, has, mins, maxs=None):
     """CI gating: every `has` name must exist in the dump; every
     `mins`/`maxs` "name=value" must exist with numeric value >=/<= the
-    bound (histograms compare their observation count). Returns a list
-    of failure messages."""
+    bound (histograms compare their observation count). A NaN value
+    fails ANY bound comparison loudly — NaN compares false against
+    everything, so without the explicit check a poisoned metric would
+    sail through `--assert-max` (and a NaN bound would never fire).
+    Returns a list of failure messages."""
+    import math
+
     failures = []
     for name in has or ():
         if not _lookup(doc, name)[0]:
@@ -172,9 +177,19 @@ def check_assertions(doc, has, mins, maxs=None):
                                 % (flag, spec))
                 continue
             found, val = _lookup(doc, name)
+            try:
+                bound_val = float(bound)
+            except ValueError:
+                failures.append("%s wants NAME=VALUE with a numeric "
+                                "value, got %r" % (flag, spec))
+                continue
             if not found:
                 failures.append("missing metric: %s" % name)
-            elif bad(val, float(bound)):
+            elif math.isnan(val) or math.isnan(bound_val):
+                failures.append(
+                    "metric %s = %s vs bound %s: NaN fails every "
+                    "%s comparison" % (name, val, bound, flag))
+            elif bad(val, bound_val):
                 failures.append("metric %s = %s, want %s %s"
                                 % (name, val,
                                    ">=" if flag == "--assert-min"
