@@ -509,6 +509,160 @@ def bench_zero(steps=16, warmup=4, repeats=3, depth=4, width=256,
     }
 
 
+def bench_quant_predictor(batches=24, batch=64, in_dim=64, hidden=256,
+                          n_classes=16, warmup=3):
+    """fp32-vs-int8 predictor receipt (docs/QUANTIZATION.md): one MLP
+    classifier exported through save_inference_model, served three ways
+    — plain fp32 AnalysisPredictor, full_int8 (calibrate -> quant_rewrite
+    int8 execution), and weight_only (convert_to_int8's int8 store).
+    Reported: examples/s fp32 vs int8, the numerics receipt
+    (max-abs-err of the logits + top-1 agreement vs fp32 — the
+    documented CI bound), and the weight-store receipt
+    (bytes saved / fp32 bytes >= 0.4 is the acceptance gate; int8 twins
+    plus per-channel fp32 scales land ~0.74 on this model).
+
+    Returns a dict of per-leg numbers."""
+    import shutil
+    import tempfile
+
+    import paddle_tpu as fluid
+    from paddle_tpu import inference, quant
+
+    prog, sprog = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, sprog):
+        x = fluid.layers.data(name="qb_x", shape=[in_dim],
+                              dtype="float32")
+        h = fluid.layers.fc(input=x, size=hidden, act="relu")
+        h = fluid.layers.fc(input=h, size=hidden, act="relu")
+        logits = fluid.layers.fc(input=h, size=n_classes)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(sprog)
+    outdir = tempfile.mkdtemp(prefix="ptpu_quant_bench_")
+    try:
+        fluid.io.save_inference_model(outdir, ["qb_x"], [logits], exe,
+                                      main_program=prog)
+        exe.close()
+        rng = np.random.RandomState(0)
+        eval_feeds = [rng.uniform(-1, 1, (batch, in_dim))
+                      .astype(np.float32) for _ in range(batches)]
+
+        cfg = inference.AnalysisConfig(outdir)
+        cfg.disable_gpu()
+        p_fp32 = inference.AnalysisPredictor(cfg)
+        table = quant.calibrate(
+            p_fp32._program, ({"qb_x": f} for f in eval_feeds[:4]),
+            scope=p_fp32._scope)
+
+        cfg8 = inference.AnalysisConfig(outdir)
+        cfg8.disable_gpu()
+        cfg8.enable_quantize("full_int8",
+                             calibration_table=table)
+        p_int8 = inference.AnalysisPredictor(cfg8)
+
+        # weight-store receipt from the weight_only predictor: its
+        # private scope holds the int8 twins INSTEAD of the fp32 copies
+        cfgw = inference.AnalysisConfig(outdir)
+        cfgw.disable_gpu()
+        cfgw.enable_quantize("weight_only")
+        p_wo = inference.AnalysisPredictor(cfgw)
+        fp32_bytes = saved_bytes = 0
+        for name in table.weights:
+            w = np.asarray(p_fp32._scope.get(name))
+            q = p_wo._scope.get(name + ".int8")
+            if q is None:
+                continue
+            fp32_bytes += w.nbytes
+            saved_bytes += w.nbytes - np.asarray(q).nbytes
+        saved_ratio = saved_bytes / fp32_bytes if fp32_bytes else 0.0
+
+        def run_leg(pred):
+            for f in eval_feeds[:warmup]:
+                pred.run_dict({"qb_x": f})
+            outs = []
+            t0 = time.perf_counter()
+            for f in eval_feeds:
+                out, = pred.run_dict({"qb_x": f})
+                outs.append(np.asarray(out))
+            dt = time.perf_counter() - t0
+            return batches * batch / dt, outs
+
+        fp32_eps, fp32_outs = run_leg(p_fp32)
+        int8_eps, int8_outs = run_leg(p_int8)
+        max_err = max(float(np.abs(a - b).max())
+                      for a, b in zip(fp32_outs, int8_outs))
+        agree = float(np.mean([
+            np.argmax(a, axis=1) == np.argmax(b, axis=1)
+            for a, b in zip(fp32_outs, int8_outs)]))
+        return {
+            "fp32_examples_per_sec": fp32_eps,
+            "int8_examples_per_sec": int8_eps,
+            "speedup_vs_fp32": int8_eps / fp32_eps,
+            "max_abs_err": max_err,
+            "top1_agreement": agree,
+            "weight_bytes_saved_ratio": saved_ratio,
+        }
+    finally:
+        shutil.rmtree(outdir, ignore_errors=True)
+
+
+def bench_serving_quant(n_requests=16, max_new_tokens=16, max_batch=8,
+                        vocab=256, d_model=64, n_heads=2, n_layers=2,
+                        d_ff=128, max_seq_len=128):
+    """Quantized serving receipt (docs/QUANTIZATION.md): the SAME
+    deterministic request set decoded through a continuously-batched
+    engine twice — fp32 weights vs the weight-only-int8 store
+    (`GenerationModel.quantized()`). Gates: the int8 leg must be
+    token-identical to `reference_decode` over its own dequantized
+    weights (its fp32 reference — greedy decode is deterministic, the
+    int8 store may never change what the STEP computes), and the
+    per-token agreement vs the plain-fp32 leg is reported as the
+    quantization-noise receipt. Aggregate tokens/s per leg is the
+    throughput receipt (`bench/serving_tokens_per_sec_int8`).
+
+    Returns (int8_tps, fp32_tps, int8_matches_reference,
+    token_agreement_vs_fp32, total_tokens)."""
+    from paddle_tpu import serving
+
+    cfg = serving.GenerationConfig(
+        vocab_size=vocab, d_model=d_model, n_heads=n_heads,
+        n_layers=n_layers, d_ff=d_ff, max_seq_len=max_seq_len)
+    model = serving.GenerationModel.random(cfg, seed=0)
+    qmodel = model.quantized()
+    specs = serving.PoissonLoadGenerator(
+        1e9, n_requests, prompt_len=(4, 12),
+        max_new_tokens=max_new_tokens, vocab_size=vocab,
+        seed=0).make_requests()
+
+    def run_leg(m):
+        eng = serving.ServingEngine(m, max_batch=max_batch,
+                                    max_seq_len=max_seq_len,
+                                    block_size=16)
+        eng.generate([1, 2], max_new_tokens=2, timeout=600)  # compile
+        t0 = time.perf_counter()
+        reqs = [eng.submit(s["prompt"],
+                           max_new_tokens=s["max_new_tokens"])
+                for s in specs]
+        outs = [r.wait(600) for r in reqs]
+        dt = time.perf_counter() - t0
+        eng.close()
+        return sum(len(o) for o in outs) / dt, outs
+
+    fp32_tps, fp32_outs = run_leg(model)
+    int8_tps, int8_outs = run_leg(qmodel)
+    refs = [serving.reference_decode(qmodel, s["prompt"],
+                                     s["max_new_tokens"])
+            for s in specs]
+    matches_ref = int8_outs == refs
+    agree_n = agree_d = 0
+    for a, b in zip(int8_outs, fp32_outs):
+        for ta, tb in zip(a, b):
+            agree_n += int(ta == tb)
+            agree_d += 1
+    agreement = agree_n / max(agree_d, 1)
+    return (int8_tps, fp32_tps, matches_ref, agreement,
+            sum(len(o) for o in int8_outs))
+
+
 def _fusion_receipt():
     """One forward-only fc+relu program through CompiledProgram with
     fuse_elewise_add_act_ops on: the bias add + relu collapse into a
@@ -564,6 +718,11 @@ def main(argv=None):
                     help="run only the ZeRO/overlap ladder on the "
                          "8-device CPU mesh (the CI zero stage "
                          "configuration)")
+    ap.add_argument("--quant-only", action="store_true",
+                    help="run only the int8 quantization legs — the "
+                         "fp32-vs-int8 predictor pair and the "
+                         "weight-only-int8 serving pair (the CI quant "
+                         "stage configuration)")
     ap.add_argument("--resilience", action="store_true",
                     help="also measure guarded vs unguarded step time "
                          "(always on under --tiny)")
@@ -662,9 +821,10 @@ def main(argv=None):
     compile_opt = compile_noopt = None
     hlo_opt = hlo_noopt = None
     last_loss = None
-    if args.serving_only:
-        args.amp_only = False  # serving leg only: skip everything else
-    if not args.amp_only and not args.serving_only:
+    if args.serving_only or args.quant_only:
+        args.amp_only = False  # dedicated leg: skip everything else
+    if not args.amp_only and not args.serving_only \
+            and not args.quant_only:
         if not args.sync_only:
             async_tps, last_loss, async_step, _ = bench_transformer_fluid(
                 async_exec=True, **kw)
@@ -699,7 +859,8 @@ def main(argv=None):
     # already pays the identical tiny pair via --amp-only).
     fp32_tps = amp_tps = fp32_step = amp_step = None
     fp32_loss = amp_loss = None
-    if args.amp_only or not (args.tiny or args.serving_only):
+    if args.amp_only or not (args.tiny or args.serving_only
+                             or args.quant_only):
         fp32_tps, fp32_loss, fp32_step, _ = bench_transformer_fluid(
             async_exec=False, dtype="float32", amp=False, **kw)
         _leg("fp32", fp32_tps, fp32_step, fp32_loss)
@@ -712,7 +873,8 @@ def main(argv=None):
     # serial aggregate tokens/s on the same Poisson stream + identity
     serve_batched = serve_serial = serve_match = None
     serve_p50 = serve_p99 = serve_tokens = None
-    if args.serving_only or not (args.tiny or args.amp_only):
+    if args.serving_only or not (args.tiny or args.amp_only
+                                 or args.quant_only):
         (serve_batched, serve_serial, serve_match, serve_p50,
          serve_p99, serve_tokens) = bench_serving()
         _leg("serving_batched", serve_batched, 0.0,
@@ -723,9 +885,37 @@ def main(argv=None):
              speedup_batched_vs_serial=round(
                  serve_batched / serve_serial, 4))
 
+    # int8 quantization receipt (docs/QUANTIZATION.md): fp32-vs-int8
+    # predictor numerics + throughput + weight-store shrink, and the
+    # weight-only-int8 serving leg gated token-identical against its
+    # fp32 reference
+    quant_res = None
+    qserve_int8 = qserve_fp32 = qserve_match = None
+    qserve_agree = qserve_tokens = None
+    if args.quant_only or not (args.tiny or args.amp_only
+                               or args.serving_only):
+        quant_res = bench_quant_predictor()
+        _leg("quant_fp32_predictor",
+             quant_res["fp32_examples_per_sec"], 0.0)
+        _leg("quant_int8_predictor",
+             quant_res["int8_examples_per_sec"], 0.0,
+             speedup_vs_fp32=round(quant_res["speedup_vs_fp32"], 4),
+             max_abs_err=round(quant_res["max_abs_err"], 6),
+             top1_agreement=round(quant_res["top1_agreement"], 4),
+             weight_bytes_saved_ratio=round(
+                 quant_res["weight_bytes_saved_ratio"], 4))
+        (qserve_int8, qserve_fp32, qserve_match, qserve_agree,
+         qserve_tokens) = bench_serving_quant()
+        _leg("serving_fp32_ref", qserve_fp32, 0.0)
+        _leg("serving_int8", qserve_int8, 0.0,
+             speedup_vs_fp32=round(qserve_int8 / qserve_fp32, 4),
+             outputs_match=bool(qserve_match),
+             token_agreement=round(qserve_agree, 4))
+
     headline = async_tps if async_tps is not None else \
         (sync_tps if sync_tps is not None else
-         (amp_tps if amp_tps is not None else serve_batched))
+         (amp_tps if amp_tps is not None else
+          (serve_batched if serve_batched is not None else qserve_int8)))
     if last_loss is None:
         last_loss = amp_loss
 
@@ -733,7 +923,8 @@ def main(argv=None):
     # measured, not assumed — acceptance is < 5% on the tiny config
     guarded = unguarded = overhead_pct = None
     if (args.resilience or args.tiny) and not (args.amp_only
-                                               or args.serving_only):
+                                               or args.serving_only
+                                               or args.quant_only):
         unguarded, guarded = bench_resilience_overhead()
         overhead_pct = 100.0 * (guarded - unguarded) / unguarded
 
@@ -775,6 +966,32 @@ def main(argv=None):
             reg.gauge("bench/step_time_guarded").set(guarded)
             reg.gauge("bench/step_time_unguarded").set(unguarded)
             reg.gauge("bench/guard_overhead_pct").set(overhead_pct)
+        if quant_res is not None:
+            reg.gauge("bench/quant_examples_per_sec_fp32").set(
+                quant_res["fp32_examples_per_sec"])
+            reg.gauge("bench/quant_examples_per_sec_int8").set(
+                quant_res["int8_examples_per_sec"])
+            reg.gauge("bench/quant_speedup_vs_fp32").set(
+                quant_res["speedup_vs_fp32"])
+            reg.gauge("bench/quant_max_abs_err").set(
+                quant_res["max_abs_err"])
+            reg.gauge("bench/quant_top1_agreement").set(
+                quant_res["top1_agreement"])
+            reg.gauge("bench/quant_weight_bytes_saved_ratio").set(
+                quant_res["weight_bytes_saved_ratio"])
+        if qserve_int8 is not None:
+            reg.gauge("bench/serving_tokens_per_sec_int8").set(
+                qserve_int8)
+            reg.gauge("bench/serving_tokens_per_sec_fp32_ref").set(
+                qserve_fp32)
+            reg.gauge("bench/serving_int8_speedup_vs_fp32").set(
+                qserve_int8 / qserve_fp32)
+            reg.gauge("bench/serving_int8_outputs_match").set(
+                1.0 if qserve_match else 0.0)
+            reg.gauge("bench/serving_int8_token_agreement").set(
+                qserve_agree)
+            reg.gauge("bench/serving_int8_total_tokens").set(
+                qserve_tokens)
         if serve_batched is not None:
             reg.gauge("bench/serving_tokens_per_sec_batched").set(
                 serve_batched)
@@ -822,6 +1039,21 @@ def main(argv=None):
         result["step_time_guarded_s"] = round(guarded, 6)
         result["step_time_unguarded_s"] = round(unguarded, 6)
         result["guard_overhead_pct"] = round(overhead_pct, 2)
+    if quant_res is not None:
+        result["quant_int8_examples_per_sec"] = round(
+            quant_res["int8_examples_per_sec"], 1)
+        result["quant_speedup_vs_fp32"] = round(
+            quant_res["speedup_vs_fp32"], 4)
+        result["quant_max_abs_err"] = round(quant_res["max_abs_err"], 6)
+        result["quant_top1_agreement"] = round(
+            quant_res["top1_agreement"], 4)
+        result["quant_weight_bytes_saved_ratio"] = round(
+            quant_res["weight_bytes_saved_ratio"], 4)
+    if qserve_int8 is not None:
+        result["serving_tokens_per_sec_int8"] = round(qserve_int8, 1)
+        result["serving_int8_speedup_vs_fp32"] = round(
+            qserve_int8 / qserve_fp32, 4)
+        result["serving_int8_outputs_match"] = bool(qserve_match)
     if serve_batched is not None:
         result["serving_tokens_per_sec_batched"] = round(serve_batched, 1)
         result["serving_tokens_per_sec_serial"] = round(serve_serial, 1)

@@ -70,6 +70,7 @@ from .core.tensor import LoDTensor, LoDTensorArray  # noqa: F401
 from .core.tensor import create_lod_tensor, create_random_int_lodtensor  # noqa: F401,E501
 from . import ir  # noqa: F401
 from . import amp  # noqa: F401  (registers the amp_rewrite pass)
+from . import quant  # noqa: F401  (registers the quant_rewrite pass)
 from . import analysis  # noqa: F401  (Program IR verifier + infer_meta)
 from . import flags  # noqa: F401  (the PTPU_* env-flag registry)
 from . import communicator  # noqa: F401

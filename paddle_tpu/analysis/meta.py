@@ -168,6 +168,16 @@ def _elementwise(op, in_metas):
     return {"Out": [(shape, _same_dtype((xs, xdt), (ys, ydt)))]}
 
 
+def _dot_dtype(op, *metas):
+    """Output dtype of a dot-class op: int32 when the quant_rewrite pass
+    marked it `__quant_int8__` (int8 operands accumulate in int32 — the
+    one DELIBERATE declared-space dtype change of the int8 path), the
+    matching operand dtype otherwise."""
+    if op.attrs.get("__quant_int8__"):
+        return "int32"
+    return _same_dtype(*metas)
+
+
 def _mul(op, in_metas):
     xs, xdt = _in0(in_metas, "X")
     ys, ydt = _in0(in_metas, "Y")
@@ -183,7 +193,7 @@ def _mul(op, in_metas):
                 raise ValueError(
                     "contraction dims %r x %r do not agree" % (kx, ky))
             shape = tuple(xs[:xn]) + tuple(ys[yn:])
-    return {"Out": [(shape, _same_dtype((xs, xdt), (ys, ydt)))]}
+    return {"Out": [(shape, _dot_dtype(op, (xs, xdt), (ys, ydt)))]}
 
 
 def _matmul(op, in_metas):
@@ -199,7 +209,7 @@ def _matmul(op, in_metas):
             raise ValueError(
                 "contraction dims %r and %r do not agree" % (kx, ky))
         shape = (m, n)
-    return {"Out": [(shape, _same_dtype((xs, xdt), (ys, ydt)))]}
+    return {"Out": [(shape, _dot_dtype(op, (xs, xdt), (ys, ydt)))]}
 
 
 def _cast(op, in_metas):
@@ -254,6 +264,71 @@ def _square_error_cost(op, in_metas):
     return {"Out": [(broadcast_dims(xs, ys), dt)]}
 
 
+# -- quant op family (ops/quant_ops.py + quant_rewrite; their dtype
+# changes are DELIBERATE and declared here so PTPU_VERIFY_PASSES=1
+# verifies quantized programs instead of tripping on them) -------------------
+
+
+def _quantize_out(op, in_metas):
+    xs, _ = _in0(in_metas, "Input")
+    return {"Output": [(xs, "int8")]}
+
+
+def _dequantize_out(op, in_metas):
+    xs, _ = _in0(in_metas, "Input")
+    od = op.attrs.get("out_dtype")
+    return {"Output": [(xs, convert_dtype(od) if od is not None
+                        else "float32")]}
+
+
+def _requantize_out(op, in_metas):
+    xs, _ = _in0(in_metas, "Input")
+    return {"Output": [(xs, "int8")]}
+
+
+def _fake_quant(op, in_metas, scale_shape=(1,)):
+    """fake_quantize_*: Out mirrors X (quantize-dequantize stays in the
+    input dtype); OutScale is the collected range."""
+    xs, dt = _in0(in_metas, "X")
+    return {"Out": [(xs, dt)], "OutScale": [(scale_shape, dt)]}
+
+
+def _fake_quant_channel(op, in_metas):
+    xs, dt = _in0(in_metas, "X")
+    cs = (xs[0],) if xs else None
+    return {"Out": [(xs, dt)], "OutScale": [(cs, dt)]}
+
+
+def _fake_dequant(op, in_metas):
+    xs, dt = _in0(in_metas, "X")
+    return {"Out": [(xs, dt)]}
+
+
+def _register_quant_metas():
+    declare("quantize", ins=("Input",), outs=("Output",),
+            infer=_quantize_out)
+    declare("dequantize", ins=("Input",), outs=("Output",),
+            infer=_dequantize_out)
+    declare("dequantize_linear", ins=("Input", "Scale"),
+            outs=("Output",), infer=_dequantize_out)
+    declare("requantize", ins=("Input",), outs=("Output",),
+            infer=_requantize_out)
+    declare("fake_quantize_abs_max", ins=("X",),
+            outs=("Out", "OutScale"), infer=_fake_quant)
+    declare("fake_channel_wise_quantize_abs_max", ins=("X",),
+            outs=("Out", "OutScale"), infer=_fake_quant_channel)
+    for name in ("fake_quantize_range_abs_max",
+                 "fake_quantize_moving_average_abs_max",
+                 "fake_quantize_dequantize_moving_average_abs_max",
+                 "moving_average_abs_max_scale"):
+        declare(name, ins=("X", "InScale"), outs=("Out", "OutScale"),
+                infer=_fake_quant)
+    declare("fake_dequantize_max_abs", ins=("X", "Scale"), outs=("Out",),
+            infer=_fake_dequant)
+    declare("fake_channel_wise_dequantize_max_abs", ins=("X", "Scales"),
+            outs=("Out",), infer=_fake_dequant)
+
+
 def _register_builtin_metas():
     for name in ("elementwise_add", "elementwise_sub", "elementwise_mul",
                  "elementwise_div", "elementwise_max", "elementwise_min",
@@ -305,3 +380,4 @@ def _register_builtin_metas():
 
 
 _register_builtin_metas()
+_register_quant_metas()

@@ -71,12 +71,19 @@ class QuantizeTranspiler:
         qv = block.create_var(name=v.name + ".quantized",
                               dtype=v.dtype, shape=v.shape)
         qv.shape = v.shape
+        channel_wise = (qtype == "abs_max" and is_weight
+                        and v.shape and len(v.shape) == 4)
+        # channel-wise quantizers emit one scale PER output channel —
+        # declare the var that way (the IR verifier checks declarations
+        # against the fake_quantize_* infer rules)
         scale = block.create_var(name=v.name + ".scale", dtype=v.dtype,
-                                 shape=(1,), persistable=True)
+                                 shape=(v.shape[0],) if channel_wise
+                                 else (1,),
+                                 persistable=True)
         ops = []
         if qtype == "abs_max":
             op_type = ("fake_channel_wise_quantize_abs_max"
-                       if is_weight and v.shape and len(v.shape) == 4
+                       if channel_wise
                        else "fake_quantize_abs_max")
             ops.append(Operator(
                 block, op_type, inputs={"X": [v]},
@@ -136,7 +143,7 @@ class QuantizeTranspiler:
         program._bump_version()
         return program
 
-    def convert_to_int8(self, program, place=None, scope=None):
+    def convert_to_int8(self, program, place=None, scope=None, skip=()):
         """Store quantizable ops' weights as int8 (parity:
         quantize_transpiler.py:354 convert_to_int8): each persistable
         weight feeding a quantizable op is REPLACED by an int8 twin
@@ -145,29 +152,54 @@ class QuantizeTranspiler:
         op reconstructs it from the int8 values at run time (halving the
         serving weight footprint is the point; the runtime genuinely
         computes from the int8 store, unlike a side-car copy). The fp
-        scale is kept on the int8 var (`quant_scale`)."""
+        scale is kept on the int8 var (`quant_scale`). Ops touching a
+        name in `skip` (any input or output var — the quant blacklist
+        contract) keep their fp32 weights."""
+        # lazy: quant imports ir/observability at module level — pulling
+        # it in at convert time keeps contrib import-light
+        from ... import quant as _quant
+
         scope = scope or global_scope()
         bnt = (1 << (self.weight_bits - 1)) - 1
+        skip = frozenset(skip or ())
+        quantizable = ("conv2d", "depthwise_conv2d", "mul", "matmul")
+        # weights of SKIPPED ops are protected outright: converting one
+        # via a non-skipped sharer would still demote+erase the fp32
+        # copy the skipped op computes from (shared/tied weights)
+        protected = set()
+        if skip:
+            for block in program.blocks:
+                for op in block.ops:
+                    if op.type in quantizable and (
+                            (set(op.input_names())
+                             | set(op.output_names())) & skip):
+                        protected.update(
+                            v.name for vs in op.inputs.values()
+                            for v in vs
+                            if getattr(v, "persistable", False))
         converted = {}
+        saved_bytes = fp32_bytes = 0
         pending = []  # (var, int8 var, scale): prepend AFTER the scan —
         # prepend_op mid-iteration would mutate the list being walked
         for block in program.blocks:
             for op in list(block.ops):
-                if op.type not in ("conv2d", "depthwise_conv2d", "mul",
-                                   "matmul"):
+                if op.type not in quantizable:
+                    continue
+                if skip and ((set(op.input_names())
+                              | set(op.output_names())) & skip):
                     continue
                 for slot, vs in op.inputs.items():
                     for v in vs:
                         if not getattr(v, "persistable", False):
                             continue
-                        if v.name in converted:
+                        if v.name in converted or v.name in protected:
                             continue
                         w = scope.get(v.name)
                         if w is None:
                             continue
                         w = np.asarray(w)
                         scale = max(float(np.abs(w).max()), 1e-8)
-                        q = np.round(w / scale * bnt).astype(np.int8)
+                        q = _quant.quantize_to_int8(w, scale, qmax=bnt)
                         int8_name = v.name + ".int8"
                         iv = program.global_block().create_var(
                             name=int8_name, shape=v.shape, dtype="int8",
@@ -182,6 +214,8 @@ class QuantizeTranspiler:
                         scope.erase_nearest(v.name)
                         pending.append((v, iv, scale))
                         converted[v.name] = int8_name
+                        saved_bytes += max(w.nbytes - q.nbytes, 0)
+                        fp32_bytes += w.nbytes
         for v, iv, scale in pending:
             program.global_block().prepend_op(
                 type="dequantize",
@@ -189,5 +223,8 @@ class QuantizeTranspiler:
                 outputs={"Output": [v]},
                 attrs={"Scale": bnt / scale, "out_dtype": v.dtype},
             )
+        if pending:
+            _quant.record_weight_store(len(pending), saved_bytes,
+                                       fp32_bytes)
         program._bump_version()
         return program

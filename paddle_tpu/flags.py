@@ -187,6 +187,18 @@ _declare("PTPU_AMP_DTYPE", "str", "bfloat16",
 _declare("PTPU_AMP_BUCKET_MB", "float", None,
          "gradient-bucket size in MiB for coalesced collectives "
          "(0/unset = per-leaf collectives)")
+# -- quantized inference (docs/QUANTIZATION.md) -----------------------------
+_declare("PTPU_QUANT", "bool", False,
+         "activate the int8 quant_rewrite pass process-wide")
+_declare("PTPU_QUANT_MODE", "str", "weight_only",
+         "quantization mode when activated via PTPU_QUANT "
+         "(weight_only or full_int8)")
+_declare("PTPU_QUANT_TABLE", "path", None,
+         "calibration-table JSON (quant.CalibrationTable.save) supplying "
+         "activation ranges for full_int8")
+_declare("PTPU_QUANT_BLACKLIST", "str", None,
+         "comma-separated var names whose ops are pinned fp32 by the "
+         "quant_rewrite pass")
 # -- ZeRO (docs/ZERO.md) ----------------------------------------------------
 _declare("PTPU_ZERO_STAGE", "int", None,
          "ZeRO sharding stage for ShardedAdam (1, 2 or 3)")

@@ -606,6 +606,9 @@ class Program:
             # AMP decoration travels with the program: the compile-time
             # clone (and a user's clone) keeps the dtype-rewrite policy
             p._amp_config = self._amp_config
+        if getattr(self, "_quant_config", None) is not None:
+            # quantization decoration travels the same way (quant.py)
+            p._quant_config = self._quant_config
         p.current_block_idx = 0
         return p
 

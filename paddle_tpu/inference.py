@@ -51,6 +51,9 @@ class AnalysisConfig:
         self._use_accelerator = True
         self._ir_optim = True
         self._aot_shapes = None
+        self._quant_mode = None
+        self._quant_table = None
+        self._quant_blacklist = None
 
     def set_model(self, model_dir, params_file=None):
         self.model_dir = model_dir
@@ -78,6 +81,22 @@ class AnalysisConfig:
         predictor creation (jax.jit lower/compile — the XLA-native
         equivalent of TRT engine build at load time)."""
         self._aot_shapes = dict(feed_shapes)
+
+    def enable_quantize(self, mode="weight_only", calibration_table=None,
+                        blacklist=None):
+        """Quantize the loaded model at predictor creation
+        (docs/QUANTIZATION.md). ``weight_only`` stores the weights int8
+        in the predictor's private scope (``QuantizeTranspiler.
+        convert_to_int8`` — the weight store genuinely shrinks 4x) with
+        dequantize-on-use; ``full_int8`` additionally rewrites the
+        matmul/conv compute to int8×int8→int32 via the `quant_rewrite`
+        pass and needs `calibration_table` (a ``quant.CalibrationTable``,
+        a dict, or a saved-table JSON path) for the activation ranges.
+        Honors ``switch_ir_optim``: with IR optimization off the model
+        loads exactly as saved, un-quantized."""
+        self._quant_mode = mode
+        self._quant_table = calibration_table
+        self._quant_blacklist = blacklist
 
 
 def _resolve_feed(inputs, feed_names):
@@ -128,6 +147,19 @@ class AnalysisPredictor:
                 ["conv_bn_fold", "dropout_remove",
                  "conv_elementwise_add_fuse"],
                 self._scope)
+        if config._quant_mode and config._ir_optim:
+            # post-training quantization at load time (docs/
+            # QUANTIZATION.md): the predictor owns the program AND the
+            # scope, so the weight_only int8 conversion may edit weights
+            # destructively (the conv_bn_fold argument); full_int8 rides
+            # the compile pipeline's quant_rewrite pass. Gated on
+            # switch_ir_optim like the other load-time transforms.
+            from . import quant
+
+            quant.quantize_predictor_program(
+                self._program, self._scope, mode=config._quant_mode,
+                table=config._quant_table,
+                blacklist=config._quant_blacklist)
         if config._aot_shapes:
             self._warmup(config._aot_shapes)
 
@@ -404,12 +436,14 @@ def export_generation_model(dirname, program, scope=None,
     return config
 
 
-def load_generation_model(dirname, name=None):
+def load_generation_model(dirname, name=None, quantize=None):
     """Load an exported generation artifact as a
-    ``paddle_tpu.serving.GenerationModel`` (ready for ServingEngine)."""
+    ``paddle_tpu.serving.GenerationModel`` (ready for ServingEngine).
+    ``quantize='weight_only'`` serves the same artifact with the int8
+    weight store (docs/QUANTIZATION.md)."""
     from .serving import load_generation_artifact
 
-    return load_generation_artifact(dirname, name=name)
+    return load_generation_artifact(dirname, name=name, quantize=quantize)
 
 
 class ServingPredictor:

@@ -133,14 +133,26 @@ def _amp_cfg(build_strategy=None, program=None):
     return amp.active_config(program, build_strategy)
 
 
+def _quant_cfg(build_strategy=None, program=None):
+    """The quantization config in effect for one compile (None =
+    inactive — the exact pre-quant pipeline and cache keys). The lazy
+    import registers the quant_rewrite pass (docs/QUANTIZATION.md)."""
+    from . import quant
+
+    return quant.active_config(program, build_strategy)
+
+
 def build_pipeline(build_strategy=None, is_test=False, infer_opt=False,
-                   single_block=True, amp=False):
+                   single_block=True, amp=False, quant=False):
     """Ordered pass-name list for one compile. `infer_opt` is the
     explicit inference-optimize request (with_inference_optimize /
     AnalysisConfig ir_optim) and adds the numerics-adjusting conv folds;
     `is_test` alone stays bitwise-preserving. `amp` (an active
     amp.AmpConfig resolved by the caller) adds the bf16 dtype rewrite
-    ahead of constant_fold/cse so the inserted casts fold and dedup."""
+    ahead of constant_fold/cse so the inserted casts fold and dedup;
+    `quant` (an active quant.QuantConfig) schedules the int8 rewrite in
+    the same slot — after the conv folds (so quantization sees folded
+    weights), before cse (so duplicate quantize/dequantize ops dedup)."""
     names = []
     if (is_test or infer_opt) and single_block:
         # identity at test time (downgrade dropout becomes the identical
@@ -151,6 +163,8 @@ def build_pipeline(build_strategy=None, is_test=False, infer_opt=False,
         names.append("conv_elementwise_add_fuse")
     if amp:
         names.append("amp_rewrite")
+    if quant:
+        names.append("quant_rewrite")
     names.append("constant_fold")
     names.append("cse")
     if infer_opt or (build_strategy is not None
@@ -173,12 +187,18 @@ def pipeline_key(build_strategy=None, program=None, infer_opt=False):
     is_test = program_is_inference(program) if program is not None else False
     single = program is None or program.num_blocks == 1
     amp_cfg = _amp_cfg(build_strategy, program)
+    quant_cfg = _quant_cfg(build_strategy, program)
     key = tuple(build_pipeline(build_strategy, is_test, infer_opt, single,
-                               amp=amp_cfg is not None))
+                               amp=amp_cfg is not None,
+                               quant=quant_cfg is not None))
     if amp_cfg is not None:
         # flipping PTPU_AMP (or re-decorating with different lists) must
         # not reuse a compiled step rewritten under the other policy
         key += ("amp:" + amp_cfg.cache_key(),)
+    if quant_cfg is not None:
+        # same contract for PTPU_QUANT / quant.decorate: a step compiled
+        # under one quantization policy can't serve another
+        key += ("quant:" + quant_cfg.cache_key(),)
     if build_strategy is not None:
         # enable_inplace selects the donation classification of the
         # compiled step — flipping it must not reuse a stale entry
@@ -195,9 +215,11 @@ def optimize_for_execution(program, fetch_names, scope=None,
     if not pipeline_enabled():
         return program
     amp_cfg = _amp_cfg(build_strategy, program)
+    quant_cfg = _quant_cfg(build_strategy, program)
     names = build_pipeline(build_strategy, program_is_inference(program),
                            infer_opt, program.num_blocks == 1,
-                           amp=amp_cfg is not None)
+                           amp=amp_cfg is not None,
+                           quant=quant_cfg is not None)
     from .ir import get_pass
 
     clone = program.clone()
@@ -206,6 +228,9 @@ def optimize_for_execution(program, fetch_names, scope=None,
         # the clone is what the amp_rewrite pass sees — pin the resolved
         # config (decoration / BuildStrategy.amp / PTPU_AMP) on it
         clone._amp_config = amp_cfg
+    if quant_cfg is not None:
+        # ditto for the quant_rewrite pass (decoration / PTPU_QUANT)
+        clone._quant_config = quant_cfg
     baked = getattr(program, "_baked_values", None)
     if baked:
         # re-optimizing an already-optimized program (e.g. the

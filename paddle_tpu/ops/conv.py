@@ -14,18 +14,24 @@ import numpy as np
 from .registry import register
 
 
-def _conv_nd(x, w, strides, paddings, dilations, groups, nd, transpose=False):
+def _conv_nd(x, w, strides, paddings, dilations, groups, nd, transpose=False,
+             preferred=None):
     dn_str = {2: ("NCHW", "OIHW", "NCHW"), 3: ("NCDHW", "OIDHW", "NCDHW")}[nd]
     dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, dn_str)
     pads = [(p, p) for p in paddings]
     if not transpose:
-        # NOTE: no preferred_element_type here — its transpose rule can't
-        # match a trailing cast (mixed-dtype grad error); XLA accumulates
-        # bf16 convs in fp32 on the MXU regardless
+        # NOTE: `preferred` stays None on float convs — the transpose
+        # rule of preferred_element_type can't match a trailing cast
+        # (mixed-dtype grad error), and XLA accumulates bf16 convs in
+        # fp32 on the MXU regardless. Non-None is the NON-differentiable
+        # int8 quantized path (quant_rewrite: int8 operands, int32
+        # accumulation); passing None is identical to omitting the
+        # kwarg (its default).
         return jax.lax.conv_general_dilated(
             x, w, window_strides=strides, padding=pads,
             rhs_dilation=dilations, dimension_numbers=dn,
             feature_group_count=groups,
+            preferred_element_type=preferred,
         )
     # conv transpose: fractionally-strided conv. Fluid filter layout is
     # [C_in, C_out/groups, *k]; flip spatial dims and swap io.
@@ -68,12 +74,16 @@ def _make_conv(name, nd, transpose=False):
     def impl(ctx, ins, attrs):
         x, w = ins["Input"][0], ins["Filter"][0]
         x, w = _amp_bf16_pair(x, w, attrs)
+        quant = (attrs.get("__quant_int8__")
+                 and jnp.issubdtype(x.dtype, jnp.integer)
+                 and jnp.issubdtype(w.dtype, jnp.integer))
         out = _conv_nd(
             x, w,
             tuple(attrs.get("strides", [1] * nd)),
             tuple(attrs.get("paddings", [0] * nd)),
             tuple(attrs.get("dilations", [1] * nd)),
             attrs.get("groups", 1) or 1, nd, transpose,
+            preferred=jnp.int32 if quant else None,
         )
         # white-list AMP output stays bf16 (reference fp16 semantics): the
         # following batch_norm (black list) upcasts to fp32 itself

@@ -136,6 +136,23 @@ def _linspace(ctx, ins, attrs):
 # -- linear algebra ----------------------------------------------------------
 
 
+def _int8_dot(x, y):
+    """quant_rewrite-marked matmul/mul: int8 operands, int32 MXU
+    accumulation (`preferred_element_type` — overflow-free over any K,
+    and the layout XLA lowers onto the int8 systolic path). The
+    per-channel dequantize back to fp32 is a separate
+    `dequantize_linear` op (paddle_tpu/quant.py)."""
+    return jax.lax.dot_general(
+        x, y, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+
+
+def _quant_int8(x, y, attrs):
+    return (attrs.get("__quant_int8__")
+            and jnp.issubdtype(x.dtype, jnp.integer)
+            and jnp.issubdtype(y.dtype, jnp.integer))
+
+
 def _amp_dot(x, y, attrs):
     """AMP white-list matmul: bf16 operands, fp32 MXU accumulation, bf16
     output (reference AMP semantics — white-list ops produce the low
@@ -165,6 +182,8 @@ def _matmul(ctx, ins, attrs):
         x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
     if ty:
         y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    if _quant_int8(x, y, attrs):
+        return {"Out": [_int8_dot(x, y)]}
     out = _amp_dot(x, y, attrs)
     if alpha != 1.0:
         out = out * alpha
@@ -181,7 +200,8 @@ def _mul(ctx, ins, attrs):
     xs, ys = x.shape, y.shape
     x2 = x.reshape((int(np.prod(xs[:xn])), int(np.prod(xs[xn:]))))
     y2 = y.reshape((int(np.prod(ys[:yn])), int(np.prod(ys[yn:]))))
-    out = _amp_dot(x2, y2, attrs)
+    out = _int8_dot(x2, y2) if _quant_int8(x2, y2, attrs) \
+        else _amp_dot(x2, y2, attrs)
     return {"Out": [out.reshape(xs[:xn] + ys[yn:])]}
 
 

@@ -129,6 +129,24 @@ def _dequantize(ctx, ins, attrs):
     return {"Output": [out]}
 
 
+@register("dequantize_linear", differentiable=False)
+def _dequantize_linear(ctx, ins, attrs):
+    """Per-channel linear dequantization (the quant_rewrite pass's
+    counterpart to `quantize`): Output = float(Input) * Scale, where
+    Scale is an array already SHAPED for plain numpy broadcasting onto
+    Input — per-output-column vectors for matmul/mul weights and
+    accumulators, (C_out, 1, ..) for conv filters/outputs (paddle_tpu/
+    quant.py bakes it that way, dequantize_linear in the reference op
+    set)."""
+    x = ins["Input"][0]
+    scale = ins["Scale"][0]
+    out = x.astype(jnp.float32) * scale
+    od = attrs.get("out_dtype")
+    if od is not None and str(od) != "float32":
+        out = out.astype(od)
+    return {"Output": [out]}
+
+
 @register("requantize", differentiable=False)
 def _requantize(ctx, ins, attrs):
     x = ins["Input"][0]

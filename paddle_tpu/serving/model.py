@@ -279,9 +279,11 @@ def save_generation_artifact(dirname, config, weights):
     return path
 
 
-def load_generation_artifact(dirname, name=None):
+def load_generation_artifact(dirname, name=None, quantize=None):
     """Load an exported generation artifact as a ready-to-serve
-    :class:`GenerationModel`."""
+    :class:`GenerationModel`. ``quantize='weight_only'`` serves the SAME
+    artifact with the int8 weight store (``GenerationModel.quantized``)
+    — no re-export needed."""
     import json
     import os
 
@@ -295,8 +297,16 @@ def load_generation_artifact(dirname, name=None):
         config = GenerationConfig.from_dict(json.load(f))
     with np.load(os.path.join(dirname, GENERATION_WEIGHTS)) as z:
         weights = {k: z[k] for k in z.files}
-    return GenerationModel(config, weights,
-                           name=name or os.path.basename(dirname))
+    model = GenerationModel(config, weights,
+                            name=name or os.path.basename(dirname))
+    if quantize:
+        if quantize not in (True, "weight_only", "int8"):
+            raise ValueError(
+                "quantize=%r — the serving runtime supports the "
+                "weight_only int8 store (docs/QUANTIZATION.md)"
+                % (quantize,))
+        model = model.quantized()
+    return model
 
 
 # ---------------------------------------------------------------------------
@@ -305,7 +315,17 @@ def load_generation_artifact(dirname, name=None):
 
 
 class GenerationModel:
-    """Config + weights + the jitted continuous-batching decode step."""
+    """Config + weights + the jitted continuous-batching decode step.
+
+    ``quantized()`` derives the weight-only-int8 variant
+    (docs/QUANTIZATION.md): every 2-D matmul weight (embedding, qkv,
+    proj, ffn, lm head) is STORED int8 with a per-output-channel fp32
+    scale riding in the same weights dict under ``<name>@qscale``, and
+    the decode step dequantizes on use — the compute stays fp32, the
+    HBM-resident weight store (what a memory-bandwidth-bound decode
+    step actually streams) shrinks ~4x. Decoding a quantized model is
+    token-identical to ``reference_decode`` over
+    ``dequantized_weights()`` (its fp32 reference)."""
 
     def __init__(self, config, weights, name="model"):
         self.config = config
@@ -315,8 +335,14 @@ class GenerationModel:
             raise ValueError("missing weights: %s" % missing[:4])
         import jax.numpy as jnp
 
-        self.weights = {k: jnp.asarray(np.asarray(v, np.float32))
-                        for k, v in weights.items()}
+        # int8 entries (the weight-only-quantized store) keep their
+        # dtype; everything else normalizes to fp32 as before
+        self.weights = {
+            k: jnp.asarray(v if np.asarray(v).dtype == np.int8
+                           else np.asarray(v, np.float32))
+            for k, v in weights.items()}
+        self.weight_only_int8 = any(
+            str(v.dtype) == "int8" for v in self.weights.values())
         # python-trace counter: the body below only executes while jax
         # traces, so tests can pin "no retrace across join/retire"
         self.trace_count = 0
@@ -325,6 +351,58 @@ class GenerationModel:
     @classmethod
     def random(cls, config, seed=0, name="model"):
         return cls(config, random_weights(config, seed), name=name)
+
+    # -- weight-only int8 ---------------------------------------------------
+    def quantized(self, name=None):
+        """The weight-only-int8 variant of this model: 2-D matmul
+        weights become int8 + ``@qscale`` per-output-channel scales;
+        biases, layer norms and the model structure are untouched.
+        Records quant/{weights_quantized,weight_bytes_saved,
+        weight_fp32_bytes} telemetry."""
+        from ..quant import quantize_symmetric, record_weight_store
+
+        if self.weight_only_int8:
+            return self
+        qw = {}
+        n_q = saved = fp32 = 0
+        for k, v in self.weights.items():
+            w = np.asarray(v)
+            if w.ndim == 2 and w.dtype == np.float32:
+                # the shared symmetric int8 grid (paddle_tpu.quant),
+                # per output column (axis 1 of the [in, out] layout;
+                # per d_model column for the [V, D] embedding)
+                q, s = quantize_symmetric(w, channel_axis=1)
+                qw[k] = q
+                qw[k + "@qscale"] = (s / 127.0).astype(np.float32)
+                n_q += 1
+                saved += max(w.nbytes - q.nbytes - s.nbytes, 0)
+                fp32 += w.nbytes
+            else:
+                qw[k] = w
+        record_weight_store(n_q, saved, fp32)
+        return GenerationModel(self.config, qw,
+                               name=name or self.name + ".int8")
+
+    def dequantized_weights(self):
+        """fp32 weights dict with the int8 store multiplied back out —
+        the quantized model's numerics reference (a GenerationModel
+        built from these decodes token-identically to this one)."""
+        out = {}
+        for k, v in self.weights.items():
+            if k.endswith("@qscale"):
+                continue
+            w = np.asarray(v)
+            s = self.weights.get(k + "@qscale")
+            out[k] = (w.astype(np.float32) * np.asarray(s)
+                      if s is not None else w)
+        return out
+
+    def _w(self, jnp, weights, key):
+        """One weight in compute dtype: dequantize-on-use for the int8
+        store (XLA fuses the convert+scale into the consuming dot)."""
+        s = weights.get(key + "@qscale")
+        w = weights[key]
+        return w.astype(jnp.float32) * s if s is not None else w
 
     def _forward_token(self, jnp, weights, x, positions, block_tables,
                        active, kv_k, kv_v):
@@ -361,7 +439,8 @@ class GenerationModel:
         for i in range(cfg.n_layers):
             p = "l%d/" % i
             a = ln(x, weights[p + "ln1_scale"], weights[p + "ln1_bias"])
-            qkv = a @ weights[p + "wqkv"] + weights[p + "bqkv"]
+            qkv = a @ self._w(jnp, weights, p + "wqkv") \
+                + weights[p + "bqkv"]
             q, k_new, v_new = jnp.split(qkv, 3, axis=-1)
             q = q.reshape(B, H, Dh)
             k_new = k_new.reshape(B, H, Dh)
@@ -376,14 +455,16 @@ class GenerationModel:
             w = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
             w = w / jnp.sum(w, axis=-1, keepdims=True)
             ctx = jnp.einsum("bht,bthd->bhd", w, v_ctx).reshape(B, -1)
-            x = x + ctx @ weights[p + "wproj"] + weights[p + "bproj"]
+            x = x + ctx @ self._w(jnp, weights, p + "wproj") \
+                + weights[p + "bproj"]
             b2 = ln(x, weights[p + "ln2_scale"], weights[p + "ln2_bias"])
-            f = jax.nn.gelu(b2 @ weights[p + "wff1"]
+            f = jax.nn.gelu(b2 @ self._w(jnp, weights, p + "wff1")
                             + weights[p + "bff1"], approximate=False)
-            x = x + f @ weights[p + "wff2"] + weights[p + "bff2"]
+            x = x + f @ self._w(jnp, weights, p + "wff2") \
+                + weights[p + "bff2"]
 
         x = ln(x, weights["final_ln_scale"], weights["final_ln_bias"])
-        return kv_k, kv_v, x @ weights["lm_head"]
+        return kv_k, kv_v, x @ self._w(jnp, weights, "lm_head")
 
     def make_decode_step(self, max_batch, max_blocks_per_seq,
                          return_logits=False):
@@ -406,8 +487,14 @@ class GenerationModel:
             self.trace_count += 1
             tok = jnp.where(use_prompt, prompt_feed, prev_tokens)
             tok = jnp.clip(tok, 0, cfg.vocab_size - 1)
-            x = (jnp.take(weights["embedding"], tok, axis=0) * emb_scale
-                 * cfg.pe_alpha
+            # int8 embedding store: gather the int8 rows FIRST, then
+            # dequantize the [B, D] slice — the full fp32 table is never
+            # materialized
+            emb = jnp.take(weights["embedding"], tok, axis=0)
+            es = weights.get("embedding@qscale")
+            if es is not None:
+                emb = emb.astype(jnp.float32) * es
+            x = (emb * emb_scale * cfg.pe_alpha
                  + cfg.pe_beta * jnp.take(pe, positions, axis=0))
             kv_k, kv_v, logits = self._forward_token(
                 jnp, weights, x, positions, block_tables, active,
@@ -430,11 +517,14 @@ class GenerationModel:
 def reference_decode(model, prompt, max_new_tokens, eos_id=None):
     """Greedy-decode ONE sequence with a plain contiguous KV cache and
     full attention — no blocks, no batching, no masking tricks. The
-    batched paged decode must match this token-for-token."""
+    batched paged decode must match this token-for-token. A weight-only
+    quantized model decodes over its dequantized fp32 weights (the same
+    values the int8 step computes with)."""
     import jax.numpy as jnp
 
     cfg = model.config
-    w = model.weights
+    w = model.dequantized_weights() if model.weight_only_int8 \
+        else model.weights
     pe = _position_encoding_table(cfg)
     emb_scale = float(cfg.d_model) ** 0.5
     H, Dh = cfg.n_heads, cfg.head_dim

@@ -30,6 +30,14 @@
 #                pipeline + PTPU_NO_PROGRAM_OPT=1) and the tiny
 #                transformer bench with AMP on, all under
 #                PTPU_VERIFY_PASSES=1, gating verify/violations == 0
+#   quant      - int8 quantized-inference receipt (docs/QUANTIZATION.md):
+#                a tiny calibrate -> quant_rewrite -> predict run under
+#                PTPU_VERIFY_PASSES=1 gating quant/ops_rewritten >= 1,
+#                verify/violations == 0 and the numerics bound, then the
+#                bench quant legs gating top-1 agreement, the >= 40%
+#                weight-store shrink, token-identical int8 serving, and
+#                the int8-vs-fp32 serving throughput floor (retried like
+#                serve's ratio; functional gates hold every attempt)
 #   zero       - ZeRO ladder + comm/compute overlap receipt
 #                (docs/ZERO.md): one tiny MLP through ZeRO-1 per-leaf /
 #                bucketed-no-overlap (the PR-5 path) / ZeRO-2 overlap /
@@ -37,7 +45,7 @@
 #                gating numerics per rung, losses decreasing, offload
 #                bytes moved, and the step-time overlap receipt
 #                (overlapped <= non-overlapped)
-# Usage: scripts/ci.sh [build|test|api_check|bench|bench-smoke|stress|obs|chaos|amp|serve|lint|verify|zero|all]
+# Usage: scripts/ci.sh [build|test|api_check|bench|bench-smoke|stress|obs|chaos|amp|serve|lint|verify|quant|zero|all]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -393,6 +401,96 @@ PYEOF
     --assert-max verify/violations=0
 }
 
+do_quant() {
+  # int8 quantized-inference receipt (docs/QUANTIZATION.md).
+  # (a) the full workflow — calibrate on sample feeds, full_int8
+  # quant_rewrite through the compile pipeline, predict — under the IR
+  # verifier: the pass must actually fire (quant/ops_rewritten >= 1,
+  # quant/calib_tensors >= 1), every program must verify clean
+  # (verify/violations == 0), and the int8 logits must sit inside the
+  # documented numerics bound vs the same predictor's fp32 run
+  # (quant/predict_max_abs_err via ptpu_stats --assert-max).
+  local dump=/tmp/ptpu_quant_metrics.json legs=/tmp/ptpu_quant_legs.json
+  rm -f "$dump"
+  JAX_PLATFORMS=cpu PTPU_METRICS=1 PTPU_METRICS_OUT="$dump" \
+    PTPU_VERIFY_PASSES=1 \
+    python - <<'PYEOF'
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import quant
+from paddle_tpu.observability import metrics as obs
+
+prog, sprog = fluid.Program(), fluid.Program()
+with fluid.program_guard(prog, sprog):
+    x = fluid.layers.data(name="cx", shape=[32], dtype="float32")
+    h = fluid.layers.fc(input=x, size=64, act="relu")
+    out = fluid.layers.fc(input=h, size=10)
+exe = fluid.Executor(fluid.CPUPlace())
+exe.run(sprog)
+rng = np.random.RandomState(0)
+feeds = [{"cx": rng.uniform(-1, 1, (16, 32)).astype(np.float32)}
+         for _ in range(6)]
+ref, = exe.run(prog, feed=feeds[0], fetch_list=[out])
+table = quant.calibrate(prog, feeds)
+infer = prog.clone(for_test=True)
+quant.decorate(infer, mode="full_int8", table=table)
+got, = exe.run(infer, feed=feeds[0], fetch_list=[out])
+err = float(np.abs(np.asarray(ref) - np.asarray(got)).max())
+exe.close()
+obs.registry().gauge("quant/predict_max_abs_err").set(err)
+print("quant ci: calibrate->rewrite->predict ok, max-abs-err", err)
+PYEOF
+  python tools/ptpu_stats.py "$dump" \
+    --assert-min quant/ops_rewritten=1 quant/calib_tensors=1 \
+                 quant/weights_quantized=1 verify/programs_checked=1 \
+    --assert-max verify/violations=0 quant/predict_max_abs_err=0.1
+  # (b) the bench quant legs. Functional gates hold on EVERY attempt:
+  # predictor numerics (max-abs-err bound + top-1 agreement vs fp32),
+  # the >= 40% weight-store shrink (ISSUE 10 acceptance), and the
+  # serving int8 leg token-identical to its fp32 reference. The
+  # batched-serving int8-vs-fp32 throughput floor is a timing
+  # measurement on a shared box, so it retries up to twice (the serve
+  # stage's ratio pattern); the floor is 0.5 because CPU XLA pays the
+  # dequantize without an int8 MXU to win it back — on TPU the same
+  # gauge records the real memory-bandwidth win.
+  local attempt rc=1
+  for attempt in 1 2 3; do
+    rm -f "$dump" "$legs"
+    JAX_PLATFORMS=cpu PTPU_METRICS=1 \
+      python bench.py --quant-only --metrics-out "$dump" \
+      --legs-out "$legs"
+    python tools/ptpu_stats.py "$dump" \
+      --assert-has bench/quant_examples_per_sec_fp32 \
+                   bench/quant_examples_per_sec_int8 \
+                   bench/serving_tokens_per_sec_int8 \
+                   bench/serving_tokens_per_sec_fp32_ref \
+                   quant/weight_bytes_saved \
+      --assert-min bench/quant_top1_agreement=0.9 \
+                   bench/quant_weight_bytes_saved_ratio=0.4 \
+                   bench/serving_int8_outputs_match=1 \
+                   bench/serving_int8_token_agreement=0.5 \
+      --assert-max bench/quant_max_abs_err=0.1
+    set +e
+    python tools/ptpu_stats.py "$dump" \
+      --assert-min bench/serving_int8_speedup_vs_fp32=0.5
+    rc=$?
+    set -e
+    [ "$rc" -eq 0 ] && break
+    echo "int8 serving throughput below floor (loaded box?) — retry $attempt/2" >&2
+  done
+  [ "$rc" -eq 0 ]
+  python - "$legs" <<'PYEOF'
+import json, sys
+legs = {e["leg"]: e for e in json.load(open(sys.argv[1]))}
+for need in ("quant_fp32_predictor", "quant_int8_predictor",
+             "serving_int8", "serving_fp32_ref"):
+    assert need in legs, (need, sorted(legs))
+assert legs["serving_int8"]["outputs_match"], legs
+print("quant stage ok:",
+      {k: legs[k]["tokens_per_sec"] for k in sorted(legs)})
+PYEOF
+}
+
 do_zero() {
   # ZeRO/overlap receipt (docs/ZERO.md). Functional gates hold on every
   # attempt: every rung's trained params close to the bucketed anchor
@@ -466,7 +564,8 @@ case "$stage" in
   serve) do_serve ;;
   lint) do_lint ;;
   verify) do_verify ;;
+  quant) do_quant ;;
   zero) do_zero ;;
-  all) do_build; do_lint; do_test; do_api_check; do_bench_smoke; do_chaos; do_amp; do_serve; do_verify; do_zero; do_bench ;;
+  all) do_build; do_lint; do_test; do_api_check; do_bench_smoke; do_chaos; do_amp; do_serve; do_verify; do_quant; do_zero; do_bench ;;
   *) echo "unknown stage: $stage" >&2; exit 2 ;;
 esac

@@ -30,6 +30,7 @@ NAMESPACES = [
     ("paddle_tpu.checkpoint", None),
     ("paddle_tpu.ir", None),
     ("paddle_tpu.amp", None),
+    ("paddle_tpu.quant", None),
     ("paddle_tpu.analysis", None),
     ("paddle_tpu.flags", None),
     ("paddle_tpu.parallel", None),
