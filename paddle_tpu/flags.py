@@ -224,6 +224,13 @@ _declare("PTPU_RETRY_BACKOFF", "float", 0.05,
 # -- serving (docs/SERVING.md) ----------------------------------------------
 _declare("PTPU_SERVE_ASYNC_STEPS", "int", 4,
          "decode steps kept in flight ahead of EOS/stream materialization")
+_declare("PTPU_SERVE_PREFILL_CHUNK", "int", 0,
+         "prompt tokens a prefill row consumes per serving step via the "
+         "chunked-prefill fast path (0 = legacy one-token prefill)")
+_declare("PTPU_SERVE_PREFIX_CACHE", "bool", False,
+         "content-addressed KV block sharing: requests whose prompt "
+         "prefix is cached skip its prefill compute and block "
+         "allocations (radix prefix caching)")
 # -- tests / CI -------------------------------------------------------------
 _declare("PTPU_PARITY_TIMEOUT", "float", 45.0,
          "seconds the TPU-backend parity test waits on its subprocess "

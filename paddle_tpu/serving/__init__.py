@@ -5,8 +5,13 @@ The "millions of users" leg of the north star: a multi-model generation
 service that batches concurrent requests at decode-*step* granularity
 (Orca-style iteration-level scheduling over one fixed-shape XLA step, so
 joins/retires never retrace) with a blocked KV-cache pool (vLLM-style
-block tables) for memory feasibility. ``native_serve`` remains the
-Python-free deployment backend for the same exported artifact directory.
+block tables) for memory feasibility. The opt-in serving fast path adds
+chunked prefill (Sarathi-style mixed prompt-window/decode steps,
+``prefill_chunk=`` / ``$PTPU_SERVE_PREFILL_CHUNK``) and radix prefix
+caching (content-addressed refcounted KV block sharing across requests,
+``prefix_cache=`` / ``$PTPU_SERVE_PREFIX_CACHE``). ``native_serve``
+remains the Python-free deployment backend for the same exported
+artifact directory.
 
     from paddle_tpu import serving
     engine = serving.ServingEngine(serving.GenerationModel.random(cfg))
@@ -15,7 +20,8 @@ Python-free deployment backend for the same exported artifact directory.
 """
 
 from .engine import ServingEngine  # noqa: F401
-from .kv_cache import KVBlockPool, blocks_needed  # noqa: F401
+from .kv_cache import (KVBlockPool, blocks_needed,  # noqa: F401
+                       prefix_chain_keys)
 from .loadgen import PoissonLoadGenerator  # noqa: F401
 from .model import (GenerationConfig, GenerationModel,  # noqa: F401
                     extract_decoder_weights, load_generation_artifact,
@@ -25,6 +31,7 @@ from .scheduler import (AdmissionError, GenerationRequest,  # noqa: F401
                         RequestQueue, StepScheduler)
 
 __all__ = ["ServingEngine", "KVBlockPool", "blocks_needed",
+           "prefix_chain_keys",
            "PoissonLoadGenerator", "GenerationConfig", "GenerationModel",
            "extract_decoder_weights", "load_generation_artifact",
            "random_weights", "reference_decode",

@@ -19,11 +19,26 @@ materialized tokens a few steps behind dispatch. Deterministic finishes
 time, so the only cost of the lag is a handful of discarded
 speculative steps after an EOS.
 
+The serving fast path (docs/SERVING.md) is two opt-in legs, both OFF by
+default (the legacy engine is bitwise unchanged): **chunked prefill**
+(``prefill_chunk`` / ``$PTPU_SERVE_PREFILL_CHUNK``) dispatches the
+second compiled step shape — a ``[max_batch, chunk]`` window where
+prefill rows consume whole prompt spans while decode rows ride along as
+1-token windows — with ``prefill_token_budget`` (default ``4 * chunk``)
+bounding the prompt tokens per mixed step so decode latency stays
+bounded; **radix prefix caching** (``prefix_cache`` /
+``$PTPU_SERVE_PREFIX_CACHE``) content-addresses the KV pool so requests
+sharing a prompt prefix skip its prefill compute and block allocations.
+Prefix reuse assumes the weights that computed the cached KV state:
+hot-swapping a model's scope should be followed by
+``pool.flush_prefix_cache()``.
+
 Telemetry (the autoscaling surface, docs/OBSERVABILITY.md):
 ``serving/{queue_depth,batch_occupancy,peak_batch_occupancy,
-kv_blocks_in_use,tokens_per_sec,request_latency(_p50/_p99),steps,
-prefill_tokens,decode_tokens,requests_submitted,requests_completed,
-requests_rejected,requests_failed}``.
+kv_blocks_in_use,tokens_per_sec,request_latency(_p50/_p99),
+ttft(_p50/_p99),steps,prefill_tokens,decode_tokens,prefill_chunk_steps,
+prefix_blocks_reused,prefix_tokens_skipped,requests_submitted,
+requests_completed,requests_rejected,requests_failed}``.
 """
 
 import threading
@@ -55,7 +70,9 @@ class _ModelWorker:
     decode loop thread."""
 
     def __init__(self, name, model, max_batch, max_seq_len, block_size,
-                 num_blocks, max_queue, async_depth, engine):
+                 num_blocks, max_queue, async_depth, engine,
+                 prefill_chunk=0, prefix_cache=False,
+                 prefill_token_budget=None):
         self.name = name
         self.model = model
         self.engine = engine
@@ -68,7 +85,22 @@ class _ModelWorker:
                                                    block_size)
         self.pool = KVBlockPool(cfg.n_layers, cfg.n_heads, cfg.head_dim,
                                 block_size, num_blocks)
-        self.scheduler = StepScheduler(max_batch, self.pool, max_seq_len)
+        # chunk-size budgeting: the chunk is a compiled shape, so it is
+        # clamped to the context; the per-step token budget (default
+        # 4 chunks) bounds how much prefill compute a MIXED step carries
+        # alongside decode rows — the decode-latency bound
+        self.prefill_chunk = max(0, min(int(prefill_chunk or 0),
+                                        max_seq_len))
+        self.prefix_cache = bool(prefix_cache)
+        if self.prefill_chunk and prefill_token_budget is None:
+            prefill_token_budget = 4 * self.prefill_chunk
+        self.scheduler = StepScheduler(
+            max_batch, self.pool, max_seq_len,
+            prefill_chunk=self.prefill_chunk,
+            prefix_cache=self.prefix_cache,
+            prefill_token_budget=(prefill_token_budget
+                                  if self.prefill_chunk else None),
+            cache_namespace=name)
         self.queue = RequestQueue(max_queue)
         self.max_batch = int(max_batch)
         # bounded in-flight step lag (the PR-2 InflightWindow contract,
@@ -87,6 +119,14 @@ class _ModelWorker:
 
         self._step = model.make_decode_step(
             self.max_batch, self.scheduler.max_blocks_per_seq)
+        # the second compiled shape (mixed prefill/decode window); jit
+        # is lazy, so geometry that never sees a prompt mid-flight still
+        # traces exactly one step
+        self._chunk_step = (
+            model.make_prefill_step(self.max_batch,
+                                    self.scheduler.max_blocks_per_seq,
+                                    self.prefill_chunk)
+            if self.prefill_chunk else None)
         import jax.numpy as jnp
 
         self._prev_tokens = jnp.zeros((self.max_batch,), jnp.int32)
@@ -104,6 +144,7 @@ class _ModelWorker:
         # serving/request_latency histogram
         from collections import deque
         self._latencies = deque(maxlen=1024)
+        self._ttfts = deque(maxlen=1024)
         self._thread = threading.Thread(
             target=self._run, name="ptpu-serve-%s" % name, daemon=True)
         self._thread.start()
@@ -164,13 +205,18 @@ class _ModelWorker:
 
     def _tick(self):
         """One scheduler round: admit at the boundary, dispatch one
-        fixed-shape step, lag-process materialized tokens, retire."""
+        fixed-shape step (the mixed chunk shape whenever a row is
+        mid-prompt under the chunked fast path), lag-process
+        materialized tokens, retire."""
         sched = self.scheduler
         sched.admit(self.queue)
         _metrics.gauge("serving/queue_depth").set(len(self.queue))
-        plan = sched.plan_step()
+        if self.prefill_chunk:
+            plan, chunked = sched.plan_chunk()
+        else:
+            plan, chunked = sched.plan_step(), False
         if plan:
-            self._dispatch(plan)
+            self._dispatch(plan, chunked)
             if len(self._inflight) > self.async_depth - 1:
                 self._process_oldest()
         elif self._inflight:
@@ -180,17 +226,25 @@ class _ModelWorker:
         _metrics.gauge("serving/kv_blocks_in_use").set(
             self.pool.blocks_in_use)
 
-    def _dispatch(self, plan):
+    def _dispatch(self, plan, chunked=False):
         sched = self.scheduler
         occupancy = int(sched.active.sum())
         with _tracing.span("serving_step", model=self.name,
-                           occupancy=occupancy):
+                           occupancy=occupancy, chunked=chunked):
             weights = {n: self.scope.get(n) for n in self._weight_names}
-            self.pool.k, self.pool.v, next_tokens = self._step(
-                weights, self.pool.k, self.pool.v,
-                sched.prompt_feed.copy(), sched.use_prompt.copy(),
-                self._prev_tokens, sched.positions.copy(),
-                sched.block_tables.copy(), sched.active.copy())
+            if chunked:
+                self.pool.k, self.pool.v, next_tokens = self._chunk_step(
+                    weights, self.pool.k, self.pool.v,
+                    sched.chunk_feed.copy(), sched.use_prompt.copy(),
+                    self._prev_tokens, sched.positions.copy(),
+                    sched.chunk_lens.copy(), sched.block_tables.copy(),
+                    sched.active.copy())
+            else:
+                self.pool.k, self.pool.v, next_tokens = self._step(
+                    weights, self.pool.k, self.pool.v,
+                    sched.prompt_feed.copy(), sched.use_prompt.copy(),
+                    self._prev_tokens, sched.positions.copy(),
+                    sched.block_tables.copy(), sched.active.copy())
         self._prev_tokens = next_tokens
         self._inflight.append((next_tokens, plan))
         _metrics.gauge("serving/inflight_steps").set(len(self._inflight))
@@ -205,9 +259,18 @@ class _ModelWorker:
             peak = reg.gauge("serving/peak_batch_occupancy")
             if occupancy > peak.value:
                 peak.set(occupancy)
-            n_prefill = sum(1 for _seq, g in plan if g is None)
-            reg.counter("serving/prefill_tokens").inc(n_prefill)
-            reg.counter("serving/decode_tokens").inc(len(plan) - n_prefill)
+            if chunked:
+                reg.counter("serving/prefill_chunk_steps").inc()
+                n_prefill = int(
+                    sched.chunk_lens[sched.use_prompt].sum())
+                n_decode = len(plan) - int(sched.use_prompt.sum())
+                reg.counter("serving/prefill_tokens").inc(n_prefill)
+                reg.counter("serving/decode_tokens").inc(n_decode)
+            else:
+                n_prefill = sum(1 for _seq, g in plan if g is None)
+                reg.counter("serving/prefill_tokens").inc(n_prefill)
+                reg.counter("serving/decode_tokens").inc(
+                    len(plan) - n_prefill)
 
     def _process_oldest(self):
         handle, plan = self._inflight.pop(0)
@@ -215,8 +278,12 @@ class _ModelWorker:
         tokens = np.asarray(handle)
         for seq, gen_idx in plan:
             was_done = seq.request.finished
+            had_first = seq.request.first_token_time is not None
             self.scheduler.record_token(seq, gen_idx,
                                         tokens[seq.slot])
+            if (not had_first
+                    and seq.request.first_token_time is not None):
+                self._note_first_token(seq.request)
             if seq.request.finished and not was_done:
                 self._note_completion(seq.request)
         if gen_tokens := sum(1 for _, g in plan if g is not None):
@@ -226,6 +293,19 @@ class _ModelWorker:
                 _metrics.gauge("serving/tokens_per_sec").set(
                     self._gen_tokens
                     / (self._t_last_step - self._t_first_step))
+
+    def _note_first_token(self, request):
+        """TTFT telemetry: submit-to-first-generated-token. The
+        end-to-end request_latency can't see the prefill stall the
+        chunked/prefix fast paths remove — this row can."""
+        ttft = request.ttft
+        if ttft is None or not _metrics.enabled():
+            return
+        _metrics.histogram("serving/ttft").observe(ttft)
+        self._ttfts.append(ttft)
+        ts = sorted(self._ttfts)
+        _metrics.gauge("serving/ttft_p50").set(_percentile(ts, 0.50))
+        _metrics.gauge("serving/ttft_p99").set(_percentile(ts, 0.99))
 
     def _note_completion(self, request):
         _metrics.counter("serving/requests_completed").inc()
@@ -259,11 +339,16 @@ class ServingEngine:
 
     def __init__(self, models, max_batch=8, max_seq_len=256,
                  block_size=16, num_blocks=None, max_queue=64,
-                 async_depth=None):
-        if async_depth is None:
-            from ..flags import env as _env
+                 async_depth=None, prefill_chunk=None, prefix_cache=None,
+                 prefill_token_budget=None):
+        from ..flags import env as _env
 
+        if async_depth is None:
             async_depth = _env("PTPU_SERVE_ASYNC_STEPS")
+        if prefill_chunk is None:
+            prefill_chunk = _env("PTPU_SERVE_PREFILL_CHUNK")
+        if prefix_cache is None:
+            prefix_cache = bool(_env("PTPU_SERVE_PREFIX_CACHE"))
         if not isinstance(models, dict):
             models = {"default": models}
         if not models:
@@ -280,7 +365,9 @@ class ServingEngine:
                 name, model, max_batch=max_batch,
                 max_seq_len=max_seq_len, block_size=block_size,
                 num_blocks=num_blocks, max_queue=max_queue,
-                async_depth=async_depth, engine=self)
+                async_depth=async_depth, engine=self,
+                prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
+                prefill_token_budget=prefill_token_budget)
         self._default = next(iter(self._workers))
         self._closed = False
 
@@ -331,6 +418,11 @@ class ServingEngine:
                 "queue_depth": len(w.queue),
                 "batch_occupancy": w.scheduler.num_occupied,
                 "generated_tokens": w._gen_tokens,
+                "prefill_chunk": w.prefill_chunk,
+                "prefix_cache": w.prefix_cache,
+                "prefix_blocks_reused": w.scheduler.prefix_blocks_reused,
+                "prefix_tokens_skipped":
+                    w.scheduler.prefix_tokens_skipped,
                 **w.pool.stats(),
             }
         return out

@@ -1,6 +1,7 @@
 """Blocked (paged) KV-cache pool for the generation serving runtime
 (vLLM SOSP '23 PagedAttention, mapped onto the framework's fixed-shape
-decode step).
+decode step) — with content-addressed **radix prefix caching** (SGLang
+RadixAttention mapped onto flat block tables).
 
 The device side is two dense arrays per model —
 ``k``/``v`` of shape ``[n_layers, num_blocks, block_size, n_heads,
@@ -28,15 +29,43 @@ Allocation is host-side and two-phase:
     lazily as the sequence's position crosses a block boundary, drawn
     from the reservation made at admit time.
 
-``free_owner`` returns a retired sequence's blocks to the free list and
-releases any unused remainder of its reservation.
+``free_owner`` returns a retired sequence's blocks and releases any
+unused remainder of its reservation.
+
+Prefix caching (docs/SERVING.md) makes the pool *content-addressed*:
+
+  * Every block is refcounted. A FULL block whose contents are a known
+    prompt span can be *sealed* into the content index under a
+    chain-hash key (:func:`prefix_chain_keys`: key ``i`` commits to the
+    namespace — the model — plus every token of blocks ``0..i``, so
+    equal keys imply an identical prompt prefix AND an identical chain
+    of predecessor blocks).
+  * ``reserve(owner, n, prefix_keys=...)`` adopts the longest sealed
+    run of the caller's prefix keys: matched blocks join the new
+    owner's table with a refcount bump, and only the remainder of the
+    worst case is actually reserved — the admission gate shrinks by
+    exactly the shared span.
+  * A shared block is returned to circulation only when its refcount
+    hits zero; sealed blocks then park on an LRU *cached* list instead
+    of the free list, still indexed, so a later identical prefix can
+    revive them without recomputation. ``alloc_block`` evicts from the
+    LRU (dropping the index entry) only once the free list is empty.
+
+Reservation conservation survives sharing (pinned by test):
+``blocks_free(+cached) - reserved >= 0`` at every point, and
+``free + cached + owned + shared == total`` — reviving a cached block
+during adoption is charged against availability exactly like an
+allocation, so outstanding reservations can never be left unbacked
+(the two-phase no-deadlock invariant).
 """
 
+import hashlib
 import threading
+from collections import OrderedDict
 
 import numpy as np
 
-__all__ = ["KVBlockPool", "blocks_needed"]
+__all__ = ["KVBlockPool", "blocks_needed", "prefix_chain_keys"]
 
 
 def blocks_needed(num_tokens, block_size):
@@ -46,8 +75,31 @@ def blocks_needed(num_tokens, block_size):
     return -(-int(num_tokens) // int(block_size))
 
 
+def prefix_chain_keys(token_ids, block_size, namespace=""):
+    """Content-addressed keys for every FULL block of ``token_ids``.
+
+    ``key[i]`` is a hash chain committing to ``namespace`` (the model),
+    ``key[i-1]`` and block ``i``'s token content — two requests share
+    ``key[i]`` iff their first ``(i + 1) * block_size`` tokens are
+    identical under the same namespace. Returns
+    ``len(token_ids) // block_size`` hex digests (the trailing partial
+    block, whose content a future decode would extend, is never keyed).
+    """
+    bs = int(block_size)
+    h = hashlib.sha1(("ptpu-prefix:%s" % namespace).encode()).hexdigest()
+    out = []
+    for i in range(len(token_ids) // bs):
+        blk = token_ids[i * bs:(i + 1) * bs]
+        h = hashlib.sha1(
+            (h + ":" + ",".join(str(int(t)) for t in blk)).encode()
+        ).hexdigest()
+        out.append(h)
+    return out
+
+
 class KVBlockPool:
-    """Fixed-size-block KV cache pool with per-owner block accounting.
+    """Fixed-size-block KV cache pool with refcounted per-owner block
+    accounting and an optional content-addressed prefix index.
 
     ``num_blocks`` counts usable blocks; one extra null block (id 0) is
     added on top, so the device arrays hold ``num_blocks + 1`` blocks.
@@ -88,6 +140,12 @@ class KVBlockPool:
         self._free = list(range(self.num_blocks, 0, -1))
         self._reserved = {}      # owner -> blocks still reservable
         self._owned = {}         # owner -> [block ids], table order
+        # -- content-addressed prefix state -----------------------------
+        self._refs = {}          # bid -> refcount (>= 1 while in a table)
+        self._sealed = {}        # content key -> bid
+        self._block_key = {}     # bid -> content key (sealed blocks)
+        # refcount-0 sealed blocks, oldest-freed first (the LRU evictees)
+        self._cached = OrderedDict()   # bid -> content key
 
     # -- accounting ----------------------------------------------------
     @property
@@ -96,57 +154,106 @@ class KVBlockPool:
 
     @property
     def blocks_free(self):
-        """Blocks neither allocated nor spoken for by a reservation."""
+        """Blocks reclaimable for a new reservation: truly free plus
+        refcount-zero cached prefix blocks, minus what reservations
+        already spoke for."""
         with self._lock:
-            return len(self._free) - sum(self._reserved.values())
+            return (len(self._free) + len(self._cached)
+                    - sum(self._reserved.values()))
 
     @property
     def blocks_in_use(self):
+        """Unique blocks referenced by at least one owner's table."""
         with self._lock:
-            return self.num_blocks - len(self._free)
+            return len(self._refs)
+
+    @property
+    def blocks_cached(self):
+        """Refcount-zero sealed blocks kept for prefix reuse."""
+        with self._lock:
+            return len(self._cached)
 
     def stats(self):
         with self._lock:
             free = len(self._free)
+            cached = len(self._cached)
             reserved = sum(self._reserved.values())
+            owned = sum(1 for r in self._refs.values() if r == 1)
+            shared = len(self._refs) - owned
         return {
             "blocks_total": self.num_blocks,
-            "blocks_in_use": self.num_blocks - free,
+            "blocks_in_use": owned + shared,
+            "blocks_owned": owned,
+            "blocks_shared": shared,
+            "blocks_cached": cached,
             "blocks_reserved": reserved,
-            "blocks_free": free - reserved,
-            "utilization": (self.num_blocks - free) / self.num_blocks,
+            "blocks_free": free + cached - reserved,
+            "utilization": (owned + shared) / self.num_blocks,
         }
 
     # -- admission-side API --------------------------------------------
     def can_reserve(self, n):
         return self.blocks_free >= int(n)
 
-    def reserve(self, owner, n):
-        """Reserve ``n`` blocks for ``owner``. Returns False (reserving
-        nothing) when the pool cannot cover the reservation — the
-        scheduler's admission check."""
+    def reserve(self, owner, n, prefix_keys=None):
+        """Reserve ``n`` worst-case blocks for ``owner``. Returns False
+        (reserving nothing) when the pool cannot cover the reservation —
+        the scheduler's admission check.
+
+        With ``prefix_keys`` (the prompt's :func:`prefix_chain_keys`),
+        the longest sealed run is adopted first: matched blocks join the
+        owner's table (``block_table(owner)``) with a refcount bump and
+        only ``n - matched`` blocks are actually reserved. Reviving a
+        refcount-zero cached block is charged against availability like
+        an allocation, so reservations already outstanding stay backed.
+        """
         n = int(n)
         with self._lock:
             if owner in self._reserved or owner in self._owned:
                 raise ValueError("owner %r already holds a reservation"
                                  % (owner,))
-            if len(self._free) - sum(self._reserved.values()) < n:
+            matched = []
+            if prefix_keys:
+                for key in prefix_keys:
+                    bid = self._sealed.get(key)
+                    if bid is None:
+                        break
+                    matched.append(bid)
+            revive = sum(1 for bid in matched
+                         if self._refs.get(bid, 0) == 0)
+            need = max(n - len(matched), 0)
+            avail = (len(self._free) + len(self._cached)
+                     - sum(self._reserved.values()))
+            if avail < need + revive:
                 return False
-            self._reserved[owner] = n
-            self._owned[owner] = []
+            for bid in matched:
+                r = self._refs.get(bid, 0)
+                if r == 0:
+                    self._cached.pop(bid, None)  # revive from the LRU
+                self._refs[bid] = r + 1
+            self._reserved[owner] = need
+            self._owned[owner] = list(matched)
             return True
 
     def alloc_block(self, owner):
         """Hand one physical block id to ``owner``, drawn from its
-        reservation (appends to the owner's block table)."""
+        reservation (appends to the owner's block table). Evicts the
+        least-recently-freed cached prefix block when the free list is
+        empty (its content-index entry is dropped)."""
         with self._lock:
             if self._reserved.get(owner, 0) <= 0:
                 raise RuntimeError(
                     "owner %r has no remaining reservation — the "
                     "scheduler must reserve the worst-case block count "
                     "at admission" % (owner,))
-            bid = self._free.pop()
+            if self._free:
+                bid = self._free.pop()
+            else:
+                bid, key = self._cached.popitem(last=False)
+                del self._sealed[key]
+                del self._block_key[bid]
             self._reserved[owner] -= 1
+            self._refs[bid] = 1
             self._owned[owner].append(bid)
             return bid
 
@@ -155,10 +262,74 @@ class KVBlockPool:
             return list(self._owned.get(owner, ()))
 
     def free_owner(self, owner):
-        """Return all of ``owner``'s blocks and release the unused part
-        of its reservation. Idempotent."""
+        """Drop ``owner``'s references and release the unused part of
+        its reservation. A block returns to circulation only at
+        refcount zero: sealed blocks park on the cached LRU (still
+        prefix-matchable), unsealed ones go back to the free list.
+        Parking walks the table in REVERSE order so eviction consumes a
+        chain tail-first — the longest-prefix-match walks head-first,
+        so evicting the head would strand every still-cached successor
+        as unmatchable dead index entries. Idempotent. Returns the
+        number of blocks the owner's table held."""
         with self._lock:
             blocks = self._owned.pop(owner, [])
             self._reserved.pop(owner, None)
-            self._free.extend(blocks)
+            for bid in reversed(blocks):
+                r = self._refs.get(bid, 0) - 1
+                if r > 0:
+                    self._refs[bid] = r
+                    continue
+                self._refs.pop(bid, None)
+                key = self._block_key.get(bid)
+                if key is not None:
+                    self._cached[bid] = key
+                    self._cached.move_to_end(bid)
+                else:
+                    self._free.append(bid)
             return len(blocks)
+
+    # -- content index (radix prefix caching) --------------------------
+    def seal_block(self, bid, key):
+        """Register a FULL, fully-written prompt block in the content
+        index so later ``reserve(prefix_keys=...)`` calls can adopt it.
+        Only live (refcount >= 1) non-null blocks are sealable; the
+        first sealer of a key wins (a concurrent identical prefill just
+        keeps its private copy). Returns True when ``bid`` is the
+        canonical block for ``key``."""
+        bid = int(bid)
+        with self._lock:
+            if bid == self.NULL_BLOCK or self._refs.get(bid, 0) < 1:
+                return False
+            if bid in self._block_key:
+                return self._block_key[bid] == key
+            if key in self._sealed:
+                return False
+            self._sealed[key] = bid
+            self._block_key[bid] = key
+            return True
+
+    def lookup_prefix(self, prefix_keys):
+        """Longest sealed run of ``prefix_keys`` currently adoptable
+        (diagnostic; admission uses the atomic ``reserve``)."""
+        with self._lock:
+            out = []
+            for key in prefix_keys:
+                bid = self._sealed.get(key)
+                if bid is None:
+                    break
+                out.append(bid)
+            return out
+
+    def flush_prefix_cache(self):
+        """Drop the whole content index (e.g. after a weight hot-swap —
+        cached KV state is only valid for the weights that computed it).
+        Referenced blocks stay in their owners' tables but lose their
+        index entry; cached blocks return to the free list. Returns the
+        number of index entries dropped."""
+        with self._lock:
+            dropped = len(self._sealed)
+            self._free.extend(self._cached)
+            self._cached.clear()
+            self._sealed.clear()
+            self._block_key.clear()
+            return dropped

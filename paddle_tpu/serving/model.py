@@ -31,6 +31,14 @@ has to materialize a step before dispatching the next — the PR-2
 async-window contract), while prefill-phase slots override it with
 ``prompt_feed`` under ``use_prompt``. Inactive slots route their cache
 writes to the pool's null block and their outputs are ignored.
+
+``make_prefill_step`` is the second, chunked step shape (Sarathi-style
+mixed batches, docs/SERVING.md): every row carries a ``[chunk]`` token
+window — prefill rows consume up to ``chunk`` prompt tokens per call
+(writing that many KV slots, masked per row by ``lengths``), decode
+rows ride the same step as 1-token windows chaining ``prev_tokens`` on
+device. Each engine geometry compiles exactly TWO step shapes: this one
+and the one-token decode step.
 """
 
 import math
@@ -498,6 +506,137 @@ class GenerationModel:
                  + cfg.pe_beta * jnp.take(pe, positions, axis=0))
             kv_k, kv_v, logits = self._forward_token(
                 jnp, weights, x, positions, block_tables, active,
+                kv_k, kv_v)
+            next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            if return_logits:
+                return kv_k, kv_v, next_tokens, logits
+            return kv_k, kv_v, next_tokens
+
+        jitted = jax.jit(step, donate_argnums=(1, 2))
+        self._steps[key] = jitted
+        return jitted
+
+    def _forward_chunk(self, jnp, weights, x, pos2d, lengths,
+                       block_tables, active, kv_k, kv_v):
+        """A ``[B, C]`` token window through all layers. x: [B, C, D];
+        returns (kv_k, kv_v, logits[B, V]) — each row's logits at its
+        LAST valid window slot (``lengths - 1``)."""
+        import jax
+
+        cfg = self.config
+        B, C = x.shape[0], x.shape[1]
+        H, Dh = cfg.n_heads, cfg.head_dim
+        bs = kv_k.shape[2]
+        Mb = block_tables.shape[1]
+        max_ctx = Mb * bs
+        sm_scale = Dh ** -0.5
+
+        # per-slot write targets: window slot j of row b lands at
+        # position pos2d[b, j]; slots past the row's valid length (and
+        # whole inactive rows) scatter into the null block instead
+        valid = ((jnp.arange(C, dtype=jnp.int32)[None, :]
+                  < lengths[:, None]) & active[:, None])
+        blk_idx = jnp.clip(pos2d // bs, 0, Mb - 1)
+        write_blk = jnp.where(
+            valid, jnp.take_along_axis(block_tables, blk_idx, axis=1), 0)
+        slot_idx = pos2d % bs
+
+        def ln(h, scale, bias):
+            mu = jnp.mean(h, axis=-1, keepdims=True)
+            var = jnp.mean((h - mu) ** 2, axis=-1, keepdims=True)
+            return (h - mu) * jax.lax.rsqrt(var + 1e-5) * scale + bias
+
+        # context validity per window slot: t <= that slot's position.
+        # The whole window's k/v are written BEFORE the gather, so
+        # in-chunk self-attention sees exactly the causal prefix; t=0 is
+        # always visible, so no softmax row is fully masked.
+        t_ids = jnp.arange(max_ctx)[None, None, :]
+        attn_valid = t_ids <= pos2d[:, :, None]          # [B, C, T]
+
+        for i in range(cfg.n_layers):
+            p = "l%d/" % i
+            a = ln(x, weights[p + "ln1_scale"], weights[p + "ln1_bias"])
+            qkv = a @ self._w(jnp, weights, p + "wqkv") \
+                + weights[p + "bqkv"]
+            q, k_new, v_new = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(B, C, H, Dh)
+            k_new = k_new.reshape(B, C, H, Dh)
+            v_new = v_new.reshape(B, C, H, Dh)
+            kv_k = kv_k.at[i, write_blk, slot_idx].set(k_new)
+            kv_v = kv_v.at[i, write_blk, slot_idx].set(v_new)
+            # paged gather: [B, Mb, bs, H, Dh] -> [B, max_ctx, H, Dh]
+            k_ctx = kv_k[i][block_tables].reshape(B, max_ctx, H, Dh)
+            v_ctx = kv_v[i][block_tables].reshape(B, max_ctx, H, Dh)
+            scores = jnp.einsum("bchd,bthd->bcht", q, k_ctx) * sm_scale
+            scores = jnp.where(attn_valid[:, :, None, :], scores,
+                               -jnp.inf)
+            w = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
+            w = w / jnp.sum(w, axis=-1, keepdims=True)
+            ctx = jnp.einsum("bcht,bthd->bchd", w, v_ctx) \
+                .reshape(B, C, -1)
+            x = x + ctx @ self._w(jnp, weights, p + "wproj") \
+                + weights[p + "bproj"]
+            b2 = ln(x, weights[p + "ln2_scale"], weights[p + "ln2_bias"])
+            f = jax.nn.gelu(b2 @ self._w(jnp, weights, p + "wff1")
+                            + weights[p + "bff1"], approximate=False)
+            x = x + f @ self._w(jnp, weights, p + "wff2") \
+                + weights[p + "bff2"]
+
+        last = jnp.clip(lengths - 1, 0, C - 1).astype(jnp.int32)
+        x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
+        x_last = ln(x_last, weights["final_ln_scale"],
+                    weights["final_ln_bias"])
+        return kv_k, kv_v, x_last @ self._w(jnp, weights, "lm_head")
+
+    def make_prefill_step(self, max_batch, max_blocks_per_seq, chunk,
+                          return_logits=False):
+        """Build (and cache) the jitted fixed-shape CHUNKED step for
+        this engine geometry — the mixed prefill/decode shape
+        (docs/SERVING.md). Calling convention:
+
+            step(weights, kv_k, kv_v, chunk_tokens[B, C], use_prompt[B],
+                 prev_tokens[B], positions[B], lengths[B],
+                 block_tables[B, Mb], active[B])
+              -> (kv_k', kv_v', next_tokens[B])
+
+        ``positions[b]`` is row b's FIRST window position; window slot
+        ``j`` processes position ``positions[b] + j``. Prefill rows
+        (``use_prompt``) take all ``lengths[b]`` tokens from
+        ``chunk_tokens``; decode rows are 1-token windows whose first
+        slot chains ``prev_tokens`` on device. ``next_tokens[b]`` is
+        the greedy token at the row's last valid slot — meaningful when
+        the window consumed the final prompt token (the first generated
+        token) or for decode rows. The KV arrays are donated."""
+        key = ("chunk", int(max_batch), int(max_blocks_per_seq),
+               int(chunk), bool(return_logits))
+        if key in self._steps:
+            return self._steps[key]
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        pe = jnp.asarray(_position_encoding_table(cfg))
+        emb_scale = float(cfg.d_model) ** 0.5
+        C = int(chunk)
+
+        def step(weights, kv_k, kv_v, chunk_tokens, use_prompt,
+                 prev_tokens, positions, lengths, block_tables, active):
+            self.trace_count += 1
+            tok0 = jnp.where(use_prompt, chunk_tokens[:, 0], prev_tokens)
+            tok = jnp.concatenate([tok0[:, None], chunk_tokens[:, 1:]],
+                                  axis=1)
+            tok = jnp.clip(tok, 0, cfg.vocab_size - 1)
+            pos2d = (positions[:, None]
+                     + jnp.arange(C, dtype=jnp.int32)[None, :])
+            emb = jnp.take(weights["embedding"], tok, axis=0)
+            es = weights.get("embedding@qscale")
+            if es is not None:
+                emb = emb.astype(jnp.float32) * es
+            pe_idx = jnp.clip(pos2d, 0, cfg.max_seq_len - 1)
+            x = (emb * emb_scale * cfg.pe_alpha
+                 + cfg.pe_beta * jnp.take(pe, pe_idx, axis=0))
+            kv_k, kv_v, logits = self._forward_chunk(
+                jnp, weights, x, pos2d, lengths, block_tables, active,
                 kv_k, kv_v)
             next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             if return_logits:

@@ -23,6 +23,30 @@ Prefill rides the same step (Orca's iteration-level scheduling): a
 just-admitted sequence consumes one prompt token per step (``use_prompt``
 rows) until its prompt is exhausted, after which its input token chains
 on-device from the previous step's output.
+
+Two fast-path modes stack on top (docs/SERVING.md), both OFF by
+default — with ``prefill_chunk=0`` and ``prefix_cache=False`` the
+scheduler's plan sequence and pool accounting are exactly the legacy
+PR-6 behavior:
+
+  * **chunked prefill** (``prefill_chunk=C``, Sarathi-Serve style):
+    ``plan_chunk`` plans MIXED steps whenever any row is mid-prompt —
+    prefill rows consume up to ``C`` prompt tokens (all of whose blocks
+    are allocated at the boundary, still drawn from the admission
+    reservation), decode rows ride the same step as 1-token windows —
+    and falls back to the one-token decode plan when nobody is in
+    prefill. ``prefill_token_budget`` caps the TOTAL prompt tokens per
+    mixed step (rows past the budget sit the step out, in slot order),
+    so decode rows' per-step latency stays bounded no matter how many
+    prompts arrive at once.
+  * **radix prefix caching** (``prefix_cache=True``): admission runs a
+    longest-prefix-match of the prompt's chain keys
+    (:func:`~paddle_tpu.serving.kv_cache.prefix_chain_keys`) against
+    the pool's content index; matched blocks are adopted refcounted
+    into the block table and ``pos`` starts past the shared span — the
+    request skips both the prefill compute and the block allocations
+    for it. As a sequence's own prefill crosses each full-prompt-block
+    boundary the block is sealed into the index for later requests.
 """
 
 import itertools
@@ -31,7 +55,7 @@ import time
 from collections import deque
 
 from ..observability import metrics as _metrics
-from .kv_cache import blocks_needed
+from .kv_cache import blocks_needed, prefix_chain_keys
 
 __all__ = ["AdmissionError", "GenerationRequest", "RequestQueue",
            "StepScheduler"]
@@ -66,6 +90,7 @@ class GenerationRequest:
         self.stream = stream
         self.submit_time = time.perf_counter()
         self.start_time = None      # admitted to the batch
+        self.first_token_time = None  # first generated token materialized
         self.finish_time = None
         self.tokens = []            # generated ids (truncated at EOS)
         self.error = None
@@ -90,6 +115,15 @@ class GenerationRequest:
         if self.finish_time is None:
             return None
         return self.finish_time - self.submit_time
+
+    @property
+    def ttft(self):
+        """Time-to-first-token: submit until the first generated token
+        materialized (None until then) — the latency the prefill fast
+        path optimizes; ``latency`` can't see the prefill stall."""
+        if self.first_token_time is None:
+            return None
+        return self.first_token_time - self.submit_time
 
     def _finish(self, error=None):
         self.error = error
@@ -130,7 +164,8 @@ class _Sequence:
     """Scheduler-internal per-slot decode state."""
 
     __slots__ = ("request", "slot", "pos", "n_dispatched", "pending",
-                 "finished", "dispatch_done")
+                 "finished", "dispatch_done", "prefix_keys",
+                 "sealed_upto")
 
     def __init__(self, request, slot):
         self.request = request
@@ -140,6 +175,8 @@ class _Sequence:
         self.pending = 0         # dispatched steps not yet processed
         self.finished = False    # result delivered (EOS/max/seq-cap)
         self.dispatch_done = False  # no more steps will be dispatched
+        self.prefix_keys = ()    # content keys of the prompt's full blocks
+        self.sealed_upto = 0     # prompt blocks already in the pool index
 
     @property
     def in_prefill(self):
@@ -153,7 +190,9 @@ class StepScheduler:
     (lagged) ``record_token()`` per decode output → ``reap()``.
     """
 
-    def __init__(self, max_batch, pool, max_seq_len):
+    def __init__(self, max_batch, pool, max_seq_len, prefill_chunk=0,
+                 prefix_cache=False, prefill_token_budget=None,
+                 cache_namespace=""):
         import numpy as np
 
         self.max_batch = int(max_batch)
@@ -169,6 +208,21 @@ class StepScheduler:
         self.use_prompt = np.zeros(self.max_batch, bool)
         self.positions = np.zeros(self.max_batch, np.int32)
         self.active = np.zeros(self.max_batch, bool)
+        # -- fast-path configuration (both OFF = exact legacy PR-6) ----
+        self.prefill_chunk = max(0, int(prefill_chunk or 0))
+        self.prefix_cache = bool(prefix_cache)
+        self.prefill_token_budget = (
+            None if prefill_token_budget is None
+            else max(1, int(prefill_token_budget)))
+        self.cache_namespace = str(cache_namespace)
+        # host-side reuse telemetry (live even with metrics disabled —
+        # engine.stats()/bench read these)
+        self.prefix_blocks_reused = 0
+        self.prefix_tokens_skipped = 0
+        if self.prefill_chunk:
+            self.chunk_feed = np.zeros(
+                (self.max_batch, self.prefill_chunk), np.int32)
+            self.chunk_lens = np.zeros(self.max_batch, np.int32)
 
     # -- occupancy ------------------------------------------------------
     @property
@@ -208,16 +262,55 @@ class StepScheduler:
                 _metrics.counter("serving/requests_failed").inc()
                 continue
             seq = _Sequence(request, slot)
-            if not self.pool.reserve(seq, self._budget_for(request)):
+            keys = ()
+            if self.prefix_cache:
+                # longest-prefix-match candidates: every full prompt
+                # block EXCEPT one covering the final prompt token — at
+                # least one prompt token must still be processed so the
+                # first generated token has logits to come from
+                bs = self.pool.block_size
+                shareable = ((len(request.prompt) - 1) // bs) * bs
+                keys = prefix_chain_keys(request.prompt[:shareable], bs,
+                                         namespace=self.cache_namespace)
+            if not self.pool.reserve(seq, self._budget_for(request),
+                                     prefix_keys=keys or None):
                 break  # KV gate: head doesn't fit — keep queue order
             queue.pop()
             request.start_time = time.perf_counter()
             self.slots[slot] = seq
             self.block_tables[slot, :] = self.pool.NULL_BLOCK
-            self.positions[slot] = 0
+            seq.prefix_keys = tuple(keys)
+            matched = self.pool.block_table(seq)
+            if matched:
+                # adopted shared blocks: skip their prefill compute and
+                # allocations — decoding starts past the shared span
+                self.block_tables[slot, :len(matched)] = matched
+                seq.pos = len(matched) * self.pool.block_size
+                seq.sealed_upto = len(matched)
+                self.prefix_blocks_reused += len(matched)
+                self.prefix_tokens_skipped += (len(matched)
+                                               * self.pool.block_size)
+                _metrics.counter("serving/prefix_blocks_reused").inc(
+                    len(matched))
+                _metrics.counter("serving/prefix_tokens_skipped").inc(
+                    len(matched) * self.pool.block_size)
+            self.positions[slot] = seq.pos
             self.active[slot] = True
             admitted.append(seq)
         return admitted
+
+    def _seal_ready(self, slot, seq):
+        """Seal every fully-written full-prompt block (its content is
+        now fixed: prefill has advanced past it) into the pool's
+        content index so later admissions can adopt it."""
+        bs = self.pool.block_size
+        done = min(seq.pos, len(seq.request.prompt)) // bs
+        limit = min(done, len(seq.prefix_keys))
+        while seq.sealed_upto < limit:
+            i = seq.sealed_upto
+            self.pool.seal_block(int(self.block_tables[slot, i]),
+                                 seq.prefix_keys[i])
+            seq.sealed_upto += 1
 
     # -- step planning --------------------------------------------------
     def plan_step(self):
@@ -257,7 +350,75 @@ class StepScheduler:
             if (seq.n_dispatched >= seq.request.max_new_tokens
                     or seq.pos >= self.max_seq_len):
                 seq.dispatch_done = True
+            if seq.prefix_keys:
+                self._seal_ready(slot, seq)
         return plan
+
+    def plan_chunk(self):
+        """Chunked-prefill planning (Sarathi-style mixed batches).
+        When no active row is mid-prompt this delegates to the
+        one-token ``plan_step`` (the engine then dispatches the cheap
+        decode shape). Otherwise fills the ``chunk_feed``/``chunk_lens``
+        window arrays — prefill rows consume up to ``prefill_chunk``
+        prompt tokens (bounded further by ``prefill_token_budget``
+        across rows; rows past the budget sit this step out), decode
+        rows are 1-token windows — and returns ``(plan, True)``.
+        Returns ``(plan, used_chunk)``."""
+        if not any(s is not None and not s.dispatch_done and s.in_prefill
+                   for s in self.slots):
+            return self.plan_step(), False
+        bs = self.pool.block_size
+        budget = self.prefill_token_budget
+        plan = []
+        for slot, seq in enumerate(self.slots):
+            if seq is None or seq.dispatch_done:
+                self.active[slot] = False
+                self.use_prompt[slot] = False
+                self.chunk_lens[slot] = 0
+                continue
+            pos = seq.pos
+            prompt = seq.request.prompt
+            if seq.in_prefill:
+                n = min(self.prefill_chunk, len(prompt) - pos)
+                if budget is not None:
+                    if budget <= 0:
+                        # prefill budget for this step is spent: the
+                        # row sits the step out so decode rows' latency
+                        # stays bounded (it resumes next step)
+                        self.active[slot] = False
+                        self.use_prompt[slot] = False
+                        self.chunk_lens[slot] = 0
+                        continue
+                    n = min(n, budget)
+                    budget -= n
+                self.chunk_feed[slot, :n] = prompt[pos:pos + n]
+                self.use_prompt[slot] = True
+                gen_idx = 0 if pos + n == len(prompt) else None
+            else:
+                n = 1
+                self.use_prompt[slot] = False
+                gen_idx = seq.n_dispatched
+            # lazy block allocation for EVERY boundary the window
+            # crosses (drawn from the admission-time reservation, so it
+            # cannot fail)
+            for p in range(pos, pos + n):
+                if p % bs == 0:
+                    bid = self.pool.alloc_block(seq)
+                    self.block_tables[slot, p // bs] = bid
+            self.positions[slot] = pos
+            self.chunk_lens[slot] = n
+            self.active[slot] = True
+            if gen_idx is not None:
+                seq.n_dispatched = gen_idx + 1
+            seq.pos = pos + n
+            seq.pending += 1
+            plan.append((seq, gen_idx))
+            if (seq.n_dispatched >= seq.request.max_new_tokens
+                    or seq.pos >= self.max_seq_len):
+                seq.dispatch_done = True
+            if seq.prefix_keys:
+                self._seal_ready(slot, seq)
+        return plan, True
 
     # -- lagged result processing --------------------------------------
     def record_token(self, seq, gen_idx, token):
@@ -273,6 +434,8 @@ class StepScheduler:
             # overshoot tokens are dropped
             return
         request.tokens.append(int(token))
+        if len(request.tokens) == 1:
+            request.first_token_time = time.perf_counter()
         hit_eos = (request.eos_id is not None
                    and int(token) == request.eos_id)
         final = (hit_eos
