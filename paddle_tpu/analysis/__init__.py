@@ -8,15 +8,29 @@ blaming the pass that broke an invariant (`ir_passes.
 optimize_for_execution`, `ir.apply_passes`, and the no-opt compile
 paths all route through the same hook). The repo-invariant linter that
 rides with it lives in `tools/ptpu_lint.py`.
+
+`concurrency` is the runtime sibling for the THREADED runtime: tracked
+lock/condition factories (`make_lock`/`make_rlock`/`make_condition`,
+plain primitives unless `PTPU_LOCK_CHECK=1`), a lock-order/deadlock
+detector in the Eraser/TSan spirit, blocking-while-holding and
+long-hold rules, and the violation/telemetry surface the CI `race`
+stage gates on.
 """
 
 from .meta import OpMeta, declare, meta_of, var_meta
 from .verifier import (PassPipelineVerifier, ProgramVerifier, VerifyError,
                        Violation, maybe_verify, verify, verify_enabled,
                        verify_or_raise)
+from . import concurrency
+from .concurrency import (LockCheckError, LockViolation, TrackedCondition,
+                          TrackedLock, TrackedRLock, make_condition,
+                          make_lock, make_rlock)
 
 __all__ = [
     "OpMeta", "declare", "meta_of", "var_meta",
     "PassPipelineVerifier", "ProgramVerifier", "VerifyError", "Violation",
     "maybe_verify", "verify", "verify_enabled", "verify_or_raise",
+    "concurrency", "LockCheckError", "LockViolation", "TrackedCondition",
+    "TrackedLock", "TrackedRLock", "make_condition", "make_lock",
+    "make_rlock",
 ]

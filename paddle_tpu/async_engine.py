@@ -72,11 +72,29 @@ class LazyFetchList(list):
         return [np.asarray(v) for v in self]
 
 
+_concurrency = None
+
+
+def _note_blocking(kind, site):
+    """Concurrency-analysis hook (docs/STATIC_ANALYSIS.md): declare a
+    blocking operation so PTPU_LOCK_CHECK=1 can flag a tracked lock held
+    across it. Resolved lazily (this module imports during package
+    bootstrap, before `paddle_tpu.analysis` exists); a no-op dict hit
+    when tracking is off."""
+    global _concurrency
+    if _concurrency is None:
+        from .analysis import concurrency as _c
+
+        _concurrency = _c
+    _concurrency.check_blocking(kind, site)
+
+
 def _materialize(token):
     """Force one admitted step's fetches to host. np.asarray rather than
     block_until_ready: a host transfer is the sync that works everywhere
     (block_until_ready does not reliably block on the axon platform —
     bench.py round-3 measurement)."""
+    _note_blocking("device-sync", "async_engine._materialize")
     if isinstance(token, (list, tuple)):
         for v in token:
             np.asarray(v)
@@ -279,6 +297,7 @@ class FeedPrefetcher:
         if self._closed:
             raise RuntimeError("FeedPrefetcher is closed")
         self._ensure_thread()
+        _note_blocking("Semaphore.acquire", "feed_prefetcher.slots")
         self._slots.acquire()
         # strong refs to the SOURCE objects: identity matching via bare
         # id() would misfire when CPython reuses a freed array's address
@@ -287,6 +306,7 @@ class FeedPrefetcher:
 
     def get(self):
         """Next staged device feed, in put() order."""
+        _note_blocking("queue.get", "feed_prefetcher.out")
         self._keys.get()
         kind, payload = self._out.get()
         self._slots.release()

@@ -458,9 +458,23 @@ class CheckpointManager:
     `save`) joins it and re-raises any background failure."""
 
     def __init__(self, directory, max_to_keep=3, async_save=False):
+        from .analysis.concurrency import make_lock
+
         self.directory = os.path.abspath(directory)
         self.max_to_keep = max_to_keep
         self.async_save = bool(async_save)
+        # named lock sites (docs/STATIC_ANALYSIS.md): `_mu` guards the
+        # background-save handoff fields (`_thread`, `_error`) — without
+        # it, concurrent wait() callers race the join/clear sequence and
+        # a background failure can be dropped. `_save_mu` serializes
+        # whole save() calls (the at-most-one-in-flight contract): two
+        # concurrent save()s would otherwise both pass the leading
+        # wait() and the second spawn would drop the first writer's
+        # handle, letting wait() return mid-write. The writer thread
+        # takes only `_mu`, so holding `_save_mu` across its join cannot
+        # deadlock.
+        self._mu = make_lock("checkpoint.manager")
+        self._save_mu = make_lock("checkpoint.manager.save")
         self._thread = None
         self._error = None
         os.makedirs(self.directory, exist_ok=True)
@@ -523,7 +537,15 @@ class CheckpointManager:
         (async saves return it even though the write is still landing —
         `wait()` before depending on it). `host_copied=True` promises
         `state` is already a private host copy (e.g. a resilience
-        ScopeSnapshot), skipping the defensive per-leaf copy."""
+        ScopeSnapshot), skipping the defensive per-leaf copy.
+
+        Serialized: concurrent save() callers queue behind `_save_mu`,
+        so the join-the-previous-writer-then-spawn sequence is atomic
+        and at most one write is ever in flight."""
+        with self._save_mu:
+            return self._save_locked(state, step, blocking, host_copied)
+
+    def _save_locked(self, state, step, blocking, host_copied):
         self.wait()
         if blocking is None:
             blocking = not self.async_save
@@ -551,22 +573,38 @@ class CheckpointManager:
                 save_checkpoint(self.directory, host_state, step)
                 self._gc()
             except BaseException as exc:  # surfaced by wait()
-                self._error = exc
+                with self._mu:
+                    self._error = exc
 
-        self._thread = threading.Thread(
-            target=_write, name="ptpu-ckpt-save", daemon=True)
-        self._thread.start()
+        t = threading.Thread(target=_write, name="ptpu-ckpt-save",
+                             daemon=True)
+        # start BEFORE publishing: a concurrent wait() that reads the
+        # handle must never join an unstarted thread (RuntimeError). A
+        # wait() landing in the gap just misses the writer — the same
+        # outcome as calling wait() a moment earlier — and the next
+        # save() is serialized behind _save_mu, which we still hold
+        t.start()
+        with self._mu:
+            self._thread = t
         return final
 
     def wait(self):
         """Join the in-flight async save (if any); re-raises a background
-        write failure here, in the caller's thread."""
-        t = self._thread
+        write failure here, in the caller's thread. Thread-safe: the
+        join runs OUTSIDE the handoff lock (the writer only needs it for
+        the error latch, so a join under the lock could not deadlock,
+        but holding a lock across a join is exactly what the
+        blocking-while-holding rule exists to flag)."""
+        with self._mu:
+            t = self._thread
         if t is not None:
             t.join()
-            self._thread = None
-        if self._error is not None:
+            with self._mu:
+                if self._thread is t:
+                    self._thread = None
+        with self._mu:
             exc, self._error = self._error, None
+        if exc is not None:
             raise exc
 
     def restore(self, target_state=None):

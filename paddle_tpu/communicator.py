@@ -86,6 +86,12 @@ class _AsyncPusher:
     def flush(self):
         """Block until every queued push has been applied (the reference's
         send_barrier). Re-raises any error the send thread hit."""
+        from .analysis.concurrency import check_blocking
+
+        # declared blocking region (docs/STATIC_ANALYSIS.md): a caller
+        # flushing while holding a tracked lock would stall that lock
+        # behind the whole push backlog
+        check_blocking("queue.join", "communicator.flush")
         self._q.join()
         self._idle.wait()
         self._raise_if_failed()

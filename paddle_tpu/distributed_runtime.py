@@ -111,8 +111,14 @@ class ParameterServerClient:
                         else float(flag("rpc_deadline")))
         self.retry_times = (retry_times if retry_times is not None
                             else int(flag("rpc_retry_times")))
+        from .analysis.concurrency import make_lock
+
         self._socks = {}
-        self._lock = threading.Lock()
+        # NOTE: _rpc deliberately holds this across the network
+        # round-trip — the client is "thread-safe per instance" by
+        # serializing RPCs; it nests no other lock, so the concurrency
+        # tracker sees no order edge out of it
+        self._lock = make_lock("dist.ps_client")
         # incarnation nonce: a restarted trainer process must not reuse
         # seqs its previous life already registered in the server's
         # exactly-once window (a collision silently replays the cached
@@ -256,10 +262,18 @@ def shutdown_pservers(endpoints, trainer_id=0):
 
 class _ServerState:
     def __init__(self, fanin, sync_mode, apply_update):
+        from .analysis.concurrency import make_condition
+
         self.fanin = fanin
         self.sync_mode = sync_mode
         self.apply_update = apply_update  # fn(grad_means: {name: np}) -> None
-        self.cv = threading.Condition()
+        # the lock NAME carries the mode: sync servers order cv -> opt
+        # (round fire under the barrier cv), async servers opt -> cv
+        # (checkpoint seq snapshot under the optimizer lock) — distinct
+        # names keep one process hosting both modes from tripping a
+        # false cross-server cycle
+        self.cv = make_condition("dist.pserver.state.%s"
+                                 % ("sync" if sync_mode else "async"))
         self.grads = {}          # name -> {trainer_id: array}
         self.barrier_set = set()  # trainer ids that sent send_barrier
         self.fetch_set = set()
@@ -445,6 +459,7 @@ class _Handler(socketserver.BaseRequestHandler):
                     _write_msg(self.request, MSG_OK, {})
                     if all_done:
                         threading.Thread(target=server.shutdown,
+                                         name="ptpu-pserver-shutdown",
                                          daemon=True).start()
                         with server.state.cv:
                             server.state.stopping = True
@@ -453,6 +468,7 @@ class _Handler(socketserver.BaseRequestHandler):
                 elif mtype == MSG_SHUTDOWN:
                     _write_msg(self.request, MSG_OK, {})
                     threading.Thread(target=server.shutdown,
+                                     name="ptpu-pserver-shutdown",
                                      daemon=True).start()
                     with server.state.cv:
                         server.state.stopping = True
@@ -502,7 +518,10 @@ def run_pserver(program, scope, endpoint, executor_place=None):
     opt_blocks = [program.blocks[i]
                   for i in lsv.attrs.get("optimize_blocks", [])]
 
-    lock = threading.Lock()
+    from .analysis.concurrency import make_lock
+
+    lock = make_lock("dist.pserver.opt.%s"
+                     % ("sync" if sync_mode else "async"))
 
     def scope_np(name):
         v = scope.get(name)
@@ -575,7 +594,7 @@ def run_pserver(program, scope, endpoint, executor_place=None):
         safe = endpoint.replace(":", "_").replace("/", "_")
         return os.path.join(ckpt_dir, "pserver_%s.npz" % safe)
 
-    _ckpt_write_lock = threading.Lock()
+    _ckpt_write_lock = make_lock("dist.pserver.ckpt_write")
     _ckpt_seq = [0]        # allocated under the optimizer lock
     _ckpt_committed = [0]  # last seq whose file write landed (write lock)
 
@@ -621,7 +640,8 @@ def run_pserver(program, scope, endpoint, executor_place=None):
                 os.replace(tmp, path)
                 _ckpt_committed[0] = my_seq
 
-        threading.Thread(target=_write, daemon=True).start()
+        threading.Thread(target=_write, name="ptpu-pserver-ckpt",
+                         daemon=True).start()
 
     _state_box = [None]
     _restored_seqs = {}
@@ -690,8 +710,10 @@ def exchange_samples(endpoints, rank, outgoing, timeout=300.0):
     world = len(endpoints)
     if world == 1:
         return list(outgoing[0])
+    from .analysis.concurrency import make_lock
+
     received = {}
-    recv_lock = threading.Lock()
+    recv_lock = make_lock("dist.shuffle.recv")
     all_in = threading.Event()
 
     def _pack(records):
@@ -729,7 +751,8 @@ def exchange_samples(endpoints, rank, outgoing, timeout=300.0):
             finally:
                 conn.close()
 
-    server = threading.Thread(target=_serve, daemon=True)
+    server = threading.Thread(target=_serve, name="ptpu-shuffle-serve",
+                              daemon=True)
     server.start()
 
     deadline = _time.monotonic() + timeout

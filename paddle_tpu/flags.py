@@ -231,6 +231,16 @@ _declare("PTPU_SERVE_PREFIX_CACHE", "bool", False,
          "content-addressed KV block sharing: requests whose prompt "
          "prefix is cached skip its prefill compute and block "
          "allocations (radix prefix caching)")
+# -- concurrency analysis (docs/STATIC_ANALYSIS.md) -------------------------
+_declare("PTPU_LOCK_CHECK", "bool", False,
+         "route the runtime's named lock sites through tracked "
+         "wrappers: lock-order/deadlock detection, "
+         "blocking-while-holding checks and the pool/engine invariant "
+         "hooks (unset = plain threading primitives, zero overhead)")
+_declare("PTPU_LOCK_HOLD_MS", "float", None,
+         "with PTPU_LOCK_CHECK=1, report a long-hold violation when a "
+         "tracked lock is held longer than this many milliseconds "
+         "(unset = off)")
 # -- tests / CI -------------------------------------------------------------
 _declare("PTPU_PARITY_TIMEOUT", "float", 45.0,
          "seconds the TPU-backend parity test waits on its subprocess "

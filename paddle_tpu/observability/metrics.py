@@ -25,6 +25,7 @@ without it.
 
 import json
 import math
+import sys
 import threading
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -37,6 +38,22 @@ DEFAULT_BUCKETS = (1e-4, 3e-4, 1e-3, 3e-3, 1e-2, 3e-2, 1e-1, 3e-1,
                    1.0, 3.0, 10.0, 30.0, 100.0)
 
 
+def _make_lock(name):
+    """Named lock site (docs/STATIC_ANALYSIS.md): tracked under
+    PTPU_LOCK_CHECK=1. STRICTLY passive about the import: this module
+    executes during package bootstrap, and importing
+    `paddle_tpu.analysis` from here would run `analysis.meta`'s
+    kernel-conditional `declare(...)` calls against a half-registered op
+    corpus (their registrations silently no-op — a measured breakage).
+    Locks created before the analysis package exists (the global
+    registry's own lock) stay plain; every metric lock created at
+    runtime goes through the tracker."""
+    conc = sys.modules.get("paddle_tpu.analysis.concurrency")
+    if conc is None:
+        return threading.Lock()
+    return conc.make_lock(name)
+
+
 class Counter:
     """Monotonically increasing count (Prometheus counter semantics)."""
 
@@ -45,7 +62,7 @@ class Counter:
     def __init__(self, name):
         self.name = name
         self._value = 0
-        self._lock = threading.Lock()
+        self._lock = _make_lock("obs.metric")
 
     def inc(self, n=1):
         if n < 0:
@@ -69,7 +86,7 @@ class Gauge:
     def __init__(self, name):
         self.name = name
         self._value = 0.0
-        self._lock = threading.Lock()
+        self._lock = _make_lock("obs.metric")
 
     def set(self, v):
         self._value = float(v)
@@ -112,7 +129,7 @@ class Histogram:
         self.sum = 0.0
         self.min = float("inf")
         self.max = float("-inf")
-        self._lock = threading.Lock()
+        self._lock = _make_lock("obs.metric")
 
     def observe(self, v):
         v = float(v)
@@ -176,7 +193,7 @@ class MetricsRegistry:
 
     def __init__(self):
         self._metrics = {}
-        self._lock = threading.Lock()
+        self._lock = _make_lock("obs.registry")
 
     def _get(self, name, cls, *args):
         m = self._metrics.get(name)

@@ -33,6 +33,11 @@ MAX_EVENTS = 200000
 # debugged); evictions are counted into the dump's ptpuDroppedSpans note
 _events = collections.deque(maxlen=MAX_EVENTS)
 _dropped = 0
+# deliberately a PLAIN lock, not a tracked one (docs/STATIC_ANALYSIS.md):
+# this module executes during package bootstrap, before
+# paddle_tpu.analysis exists, and the ring-buffer append it guards is the
+# tracing hot path — it nests no other lock, so there is no order to
+# observe
 _lock = threading.Lock()
 _pid = os.getpid()
 

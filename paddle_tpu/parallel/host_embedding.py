@@ -11,7 +11,6 @@ pass pushes sparse row gradients back with jax.experimental.io_callback —
 the TPU analogue of PullSparseVarsSync/PushSparseVarsWithLabelAsync
 (framework/fleet/fleet_wrapper.h:62/:95)."""
 
-import threading
 
 import numpy as np
 
@@ -63,7 +62,9 @@ class HostEmbeddingTable:
                 (rng.rand(rows, dim).astype(dtype) - 0.5) * 2 * init_scale)
         if optimizer == "adagrad":
             self._accum = [np.zeros_like(sh) for sh in self._shards]
-        self._lock = threading.Lock()
+        from ..analysis.concurrency import make_lock
+
+        self._lock = make_lock("parallel.host_table")
         _TABLES[name] = self
 
     # -- shard addressing -------------------------------------------------

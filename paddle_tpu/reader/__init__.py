@@ -127,7 +127,8 @@ def buffered(reader, size):
     def data_reader():
         r = reader()
         q = _queue.Queue(maxsize=size)
-        t = threading.Thread(target=read_worker, args=(r, q))
+        t = threading.Thread(target=read_worker, args=(r, q),
+                             name="ptpu-reader-buffered")
         t.daemon = True
         t.start()
         e = q.get()
@@ -166,8 +167,10 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
     out_q = _queue.Queue(buffer_size)
 
     def data_reader():
+        from ..analysis.concurrency import make_lock
+
         finished = [0]
-        lock = threading.Lock()
+        lock = make_lock("reader.xmap_finished")
 
         def read_worker():
             for d in reader():
@@ -186,12 +189,13 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                 if finished[0] == process_num:
                     out_q.put(end)
 
-        t = threading.Thread(target=read_worker)
+        t = threading.Thread(target=read_worker, name="ptpu-xmap-read")
         t.daemon = True
         t.start()
         workers = []
-        for _ in range(process_num):
-            w = threading.Thread(target=map_worker)
+        for i in range(process_num):
+            w = threading.Thread(target=map_worker,
+                                 name="ptpu-xmap-map-%d" % i)
             w.daemon = True
             w.start()
             workers.append(w)
@@ -340,7 +344,7 @@ class PyReader:
                     q.put(_WorkerFailure(exc))
                     return
 
-        t = threading.Thread(target=worker)
+        t = threading.Thread(target=worker, name="ptpu-pyreader")
         t.daemon = True
         t.start()
 

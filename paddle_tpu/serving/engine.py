@@ -46,6 +46,7 @@ import time
 
 import numpy as np
 
+from ..analysis import concurrency as _conc
 from ..core.scope import Scope
 from ..observability import metrics as _metrics
 from ..observability import tracing as _tracing
@@ -131,7 +132,11 @@ class _ModelWorker:
 
         self._prev_tokens = jnp.zeros((self.max_batch,), jnp.int32)
 
-        self._cv = threading.Condition()
+        # named lock site (docs/STATIC_ANALYSIS.md): tracked under
+        # PTPU_LOCK_CHECK=1, a plain Condition otherwise; the same flag
+        # turns on the pool/engine invariant audit at step boundaries
+        self._cv = _conc.make_condition("serving.engine.cv")
+        self._lock_check = _conc.tracking_enabled()
         self._closing = False
         self.error = None
         self._gen_tokens = 0
@@ -225,6 +230,49 @@ class _ModelWorker:
         sched.reap()
         _metrics.gauge("serving/kv_blocks_in_use").set(
             self.pool.blocks_in_use)
+        if self._lock_check:
+            self._check_invariants()
+
+    def _check_invariants(self):
+        """Step-boundary runtime audit (PTPU_LOCK_CHECK=1 only): the
+        pool's conservation/refcount/index invariants plus the engine's
+        own queue/liveness bounds, reported as structured concurrency
+        violations (docs/STATIC_ANALYSIS.md) so the CI `race` stage can
+        gate `concurrency/violations == 0`."""
+        import re as _re
+
+        for msg in self.pool.check_invariants():
+            # detail = the digit-stripped problem class per model, so
+            # two DIFFERENT corruption kinds on one pool both report
+            # while a recurring one (counts evolving per tick) doesn't
+            # spam a violation per step
+            _conc.record_violation(
+                "pool-invariant", "KVBlockPool[%s]: %s" % (self.name, msg),
+                locks=("serving.kv_pool",),
+                detail=(self.name, _re.sub(r"\d+", "N", msg)))
+        if len(self._inflight) > self.async_depth:
+            _conc.record_violation(
+                "engine-invariant",
+                "model %r: %d in-flight steps exceed async_depth %d"
+                % (self.name, len(self._inflight), self.async_depth),
+                locks=("serving.engine.cv",),
+                detail=(self.name, "inflight"))
+        if len(self.queue) > self.queue.max_queue:
+            _conc.record_violation(
+                "engine-invariant",
+                "model %r: queue depth %d exceeds bound %d"
+                % (self.name, len(self.queue), self.queue.max_queue),
+                locks=("serving.request_queue",),
+                detail=(self.name, "queue-depth"))
+        occupied = self.scheduler.num_occupied
+        if occupied > self.max_batch:
+            _conc.record_violation(
+                "engine-invariant",
+                "model %r: %d occupied slots exceed max_batch %d"
+                % (self.name, occupied, self.max_batch),
+                locks=("serving.engine.cv",),
+                detail=(self.name, "occupancy"))
+        _conc.publish_metrics()
 
     def _dispatch(self, plan, chunked=False):
         sched = self.scheduler

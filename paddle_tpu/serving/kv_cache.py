@@ -60,7 +60,6 @@ allocation, so outstanding reservations can never be left unbacked
 """
 
 import hashlib
-import threading
 from collections import OrderedDict
 
 import numpy as np
@@ -134,7 +133,9 @@ class KVBlockPool:
             self.k = jnp.zeros(shape, self.dtype)
             self.v = jnp.zeros(shape, self.dtype)
 
-        self._lock = threading.Lock()
+        from ..analysis.concurrency import make_lock
+
+        self._lock = make_lock("serving.kv_pool")
         # LIFO free list: a retired sequence's blocks are handed to the
         # next admit while still warm in cache
         self._free = list(range(self.num_blocks, 0, -1))
@@ -287,6 +288,92 @@ class KVBlockPool:
                 else:
                     self._free.append(bid)
             return len(blocks)
+
+    # -- runtime invariants (docs/STATIC_ANALYSIS.md, PTPU_LOCK_CHECK) -
+    def check_invariants(self):
+        """Audit the pool's accounting in one consistent snapshot and
+        return a list of problem strings (empty = clean). The serving
+        engine calls this at step boundaries under ``PTPU_LOCK_CHECK=1``
+        and reports findings as ``pool-invariant`` violations; the pins:
+
+          * conservation: ``free + cached + in-table == total`` (the
+            ``free+reserved+owned+shared==total`` identity of stats(),
+            with reservations counted against availability)
+          * every referenced block has refcount >= 1, reservations are
+            never negative, and outstanding reservations stay backed
+            (``free + cached - reserved >= 0`` — the two-phase
+            no-deadlock invariant)
+          * LRU/index consistency: sealed index and reverse map agree,
+            cached blocks are exactly the refcount-zero sealed ones,
+            the null block never circulates, and no block id appears
+            twice across free/cached/tables
+        """
+        problems = []
+        with self._lock:
+            free = list(self._free)
+            cached = list(self._cached)
+            refs = dict(self._refs)
+            reserved = dict(self._reserved)
+            owned = {o: list(b) for o, b in self._owned.items()}
+            sealed = dict(self._sealed)
+            block_key = dict(self._block_key)
+        n_free, n_cached, n_tab = len(free), len(cached), len(refs)
+        if n_free + n_cached + n_tab != self.num_blocks:
+            problems.append(
+                "conservation broken: free %d + cached %d + in-table %d "
+                "!= total %d" % (n_free, n_cached, n_tab,
+                                 self.num_blocks))
+        for bid, r in refs.items():
+            if r < 1:
+                problems.append("block %d referenced with refcount %d"
+                                % (bid, r))
+        for owner, n in reserved.items():
+            if n < 0:
+                problems.append("owner %r reservation went negative (%d)"
+                                % (owner, n))
+        n_reserved = sum(max(n, 0) for n in reserved.values())
+        if n_free + n_cached < n_reserved:
+            problems.append(
+                "reservations unbacked: free %d + cached %d < reserved "
+                "%d" % (n_free, n_cached, n_reserved))
+        for key, bid in sealed.items():
+            if block_key.get(bid) != key:
+                problems.append(
+                    "sealed index maps key %s.. to block %d but the "
+                    "block's key is %r" % (key[:8], bid,
+                                           block_key.get(bid)))
+        for bid, key in block_key.items():
+            if sealed.get(key) != bid:
+                problems.append(
+                    "block %d keyed %s.. missing from the sealed index"
+                    % (bid, key[:8]))
+        for bid in cached:
+            if bid in refs:
+                problems.append("cached block %d is also referenced "
+                                "(refcount %d)" % (bid, refs[bid]))
+            if bid not in block_key:
+                problems.append("cached block %d lost its index entry"
+                                % bid)
+        seen = {}
+        for where, ids in (("free", free), ("cached", cached)):
+            for bid in ids:
+                if bid == self.NULL_BLOCK:
+                    problems.append("null block circulating on the %s "
+                                    "list" % where)
+                if bid in seen:
+                    problems.append("block %d on both %s and %s"
+                                    % (bid, seen[bid], where))
+                seen[bid] = where
+        for owner, blocks in owned.items():
+            for bid in blocks:
+                if refs.get(bid, 0) < 1:
+                    problems.append(
+                        "owner %r table references block %d with no "
+                        "refcount" % (owner, bid))
+                if bid in seen:
+                    problems.append("block %d in a table but also on "
+                                    "the %s list" % (bid, seen[bid]))
+        return problems
 
     # -- content index (radix prefix caching) --------------------------
     def seal_block(self, bid, key):

@@ -135,9 +135,11 @@ class RequestQueue:
     """Bounded FIFO with fail-fast admission (the queue gate)."""
 
     def __init__(self, max_queue=64):
+        from ..analysis.concurrency import make_lock
+
         self.max_queue = int(max_queue)
         self._q = deque()
-        self._lock = threading.Lock()
+        self._lock = make_lock("serving.request_queue")
 
     def __len__(self):
         return len(self._q)

@@ -26,6 +26,15 @@
 #                serial aggregate tokens/s
 #   lint       - repo-invariant linter (docs/STATIC_ANALYSIS.md):
 #                tools/ptpu_lint.py over paddle_tpu/, zero findings
+#   race       - concurrency-analysis receipt (docs/STATIC_ANALYSIS.md
+#                "Concurrency analysis"): the serving fast path
+#                (chunked prefill + prefix cache, concurrent
+#                submitters) and the resilience chaos leg replayed
+#                under PTPU_LOCK_CHECK=1 with sys.setswitchinterval
+#                (1e-5) jitter to flush interleavings, gating
+#                concurrency/violations == 0 with order_edges >= 1 and
+#                locks_tracked >= 6 (the tracker demonstrably saw the
+#                real runtime, not a stub)
 #   verify     - Program IR verifier receipt: fit-a-line (default
 #                pipeline + PTPU_NO_PROGRAM_OPT=1) and the tiny
 #                transformer bench with AMP on, all under
@@ -45,7 +54,7 @@
 #                gating numerics per rung, losses decreasing, offload
 #                bytes moved, and the step-time overlap receipt
 #                (overlapped <= non-overlapped)
-# Usage: scripts/ci.sh [build|test|api_check|bench|bench-smoke|stress|obs|chaos|amp|serve|lint|verify|quant|zero|all]
+# Usage: scripts/ci.sh [build|test|api_check|bench|bench-smoke|stress|obs|chaos|amp|serve|lint|race|verify|quant|zero|all]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -377,6 +386,130 @@ do_lint() {
     > /dev/null
 }
 
+do_race() {
+  # concurrency-analysis receipt (docs/STATIC_ANALYSIS.md). Leg 1: the
+  # serving fast path — chunked prefill + radix prefix caching with 4
+  # concurrent submitter threads — under PTPU_LOCK_CHECK=1 and a 10us
+  # thread switch interval so the GIL hands off mid-critical-section.
+  # Every tracked acquisition feeds the lock-order graph; the gates
+  # prove the tracker saw the real runtime (locks_tracked >= 6,
+  # order_edges >= 1) and that no potential deadlock / blocking-while-
+  # holding / invariant violation surfaced (violations == 0). Outputs
+  # stay pinned token-identical to reference_decode — the tracked
+  # wrappers may not change behavior.
+  local dump=/tmp/ptpu_race_metrics.json
+  rm -f "$dump"
+  JAX_PLATFORMS=cpu PTPU_METRICS=1 PTPU_METRICS_OUT="$dump" \
+    PTPU_LOCK_CHECK=1 \
+    python - <<'PYEOF'
+import sys
+import threading
+
+sys.setswitchinterval(1e-5)
+import numpy as np
+
+from paddle_tpu import serving
+from paddle_tpu.analysis import concurrency
+from paddle_tpu.serving import (GenerationConfig, GenerationModel,
+                                reference_decode)
+
+model = GenerationModel.random(
+    GenerationConfig(vocab_size=64, d_model=32, n_heads=2, n_layers=2,
+                     d_ff=64, max_seq_len=64), seed=0, name="race")
+rng = np.random.RandomState(7)
+shared = rng.randint(0, 64, size=8).tolist()  # shared prefix -> radix path
+prompts = [shared + rng.randint(0, 64, size=rng.randint(2, 8)).tolist()
+           for _ in range(12)]
+results = {}
+with serving.ServingEngine(model, max_batch=4, max_seq_len=64,
+                           block_size=4, prefill_chunk=4,
+                           prefix_cache=True) as eng:
+    def client(lo, hi):
+        for i in range(lo, hi):
+            results[i] = eng.generate(prompts[i], max_new_tokens=8,
+                                      timeout=300)
+    threads = [threading.Thread(target=client, args=(i * 3, i * 3 + 3),
+                                name="race-client-%d" % i)
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    pools = [w.pool for w in eng._workers.values()]
+for i, p in enumerate(prompts):
+    assert results[i] == reference_decode(model, p, 8), (i, results[i])
+for pool in pools:
+    assert pool.check_invariants() == [], pool.check_invariants()
+concurrency.assert_clean()
+concurrency.publish_metrics()
+print("race serve leg ok:", concurrency.stats())
+PYEOF
+  python tools/ptpu_stats.py "$dump" \
+    --assert-min concurrency/locks_tracked=6 concurrency/order_edges=1 \
+                 concurrency/acquisitions=1 \
+                 serving/prefill_chunk_steps=1 \
+                 serving/prefix_blocks_reused=1 \
+    --assert-max concurrency/violations=0
+  # Leg 2: the async-executor chaos leg — ResilientTrainer with an
+  # injected NaN step, rollback + async checkpointing (the background
+  # writer thread + the PR-2 in-flight window + prefetcher), same
+  # switch-interval jitter. The tracked checkpoint-manager lock and the
+  # runtime's queue blocking regions must come through violation-free.
+  rm -f "$dump"
+  JAX_PLATFORMS=cpu PTPU_METRICS=1 PTPU_METRICS_OUT="$dump" \
+    PTPU_LOCK_CHECK=1 PTPU_ANOMALY_POLICY=rollback PTPU_RETRY_BACKOFF=0 \
+    PTPU_FAULT_INJECT="nan_at_step:12" \
+    python - <<'PYEOF'
+import sys
+import tempfile
+import warnings
+
+sys.setswitchinterval(1e-5)
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.analysis import concurrency
+
+x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+pred = fluid.layers.fc(input=x, size=1)
+loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+fluid.optimizer.SGD(0.05).minimize(loss)
+exe = fluid.Executor(fluid.CPUPlace())
+exe.run(fluid.default_startup_program())
+
+rng = np.random.RandomState(0)
+xs = rng.uniform(-1, 1, (256, 13)).astype(np.float32)
+w = rng.uniform(-2, 2, (13, 1)).astype(np.float32)
+ys = (xs @ w + 0.5).astype(np.float32)
+
+
+def batches(epochs=10, batch=64):
+    for _ in range(epochs):
+        for i in range(0, len(xs), batch):
+            yield {"x": xs[i:i + batch], "y": ys[i:i + batch]}
+
+
+with tempfile.TemporaryDirectory() as ckdir:
+    trainer = fluid.ResilientTrainer(
+        exe, fluid.default_main_program(), fetch_list=[loss],
+        guard_every=8, checkpoint_dir=ckdir, checkpoint_every=20)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        result = trainer.run(batches())
+assert result.rollbacks >= 1, result
+assert np.isfinite(result.losses[-1]), result
+concurrency.assert_clean()
+concurrency.publish_metrics()
+print("race chaos leg ok:", concurrency.stats(),
+      "rollbacks", result.rollbacks)
+PYEOF
+  python tools/ptpu_stats.py "$dump" \
+    --assert-min concurrency/locks_tracked=1 concurrency/acquisitions=1 \
+                 resilience/rollbacks=1 \
+    --assert-max concurrency/violations=0
+}
+
 do_verify() {
   # Program IR verifier receipt (docs/STATIC_ANALYSIS.md): training and
   # inference compile paths run clean under PTPU_VERIFY_PASSES=1 — the
@@ -583,9 +716,10 @@ case "$stage" in
   amp) do_amp ;;
   serve) do_serve ;;
   lint) do_lint ;;
+  race) do_race ;;
   verify) do_verify ;;
   quant) do_quant ;;
   zero) do_zero ;;
-  all) do_build; do_lint; do_test; do_api_check; do_bench_smoke; do_chaos; do_amp; do_serve; do_verify; do_quant; do_zero; do_bench ;;
+  all) do_build; do_lint; do_test; do_api_check; do_bench_smoke; do_chaos; do_amp; do_serve; do_race; do_verify; do_quant; do_zero; do_bench ;;
   *) echo "unknown stage: $stage" >&2; exit 2 ;;
 esac

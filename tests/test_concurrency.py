@@ -1,0 +1,688 @@
+"""Concurrency analysis layer (docs/STATIC_ANALYSIS.md "Concurrency
+analysis", ISSUE 12): the tracked-lock factory's flag-off identity, the
+lock-order/deadlock detector (an ABBA fixture must report a potential
+deadlock WITHOUT hanging), blocking-while-holding and long-hold rules,
+the KVBlockPool/engine runtime invariant hooks, and the serving engine
+running token-identical and violation-free under PTPU_LOCK_CHECK=1.
+"""
+
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+from paddle_tpu import flags
+from paddle_tpu.analysis import concurrency as conc
+
+
+@pytest.fixture
+def tracking(monkeypatch):
+    """PTPU_LOCK_CHECK=1 with a fresh tracker before AND after (so
+    violations manufactured here never leak into another test's
+    assert_clean)."""
+    monkeypatch.setenv("PTPU_LOCK_CHECK", "1")
+    conc.reset()
+    yield conc
+    conc.reset()
+
+
+def _quiet(fn, *args, **kwargs):
+    """Run fn with the tracker's RuntimeWarnings muted (the violation
+    under test is asserted structurally, not via the warning)."""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        return fn(*args, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# factory identity (the PTPU_VERIFY_PASSES pattern)
+# ---------------------------------------------------------------------------
+
+
+def test_factory_off_returns_plain_primitives(monkeypatch):
+    """Flag unset -> the factories hand back the PLAIN threading
+    primitives (zero overhead, behaviorally identical — the acceptance
+    identity pin)."""
+    monkeypatch.delenv("PTPU_LOCK_CHECK", raising=False)
+    assert type(conc.make_lock("x")) is type(threading.Lock())
+    assert type(conc.make_rlock("x")) is type(threading.RLock())
+    cv = conc.make_condition("x")
+    assert isinstance(cv, threading.Condition)
+    assert not isinstance(cv, conc.TrackedCondition)
+    mine = threading.Lock()
+    assert conc.make_condition("x", lock=mine)._lock is mine
+
+
+def test_factory_off_in_real_runtime(monkeypatch):
+    """The converted lock sites degrade to plain primitives when the
+    flag is off: the serving pool, request queue and engine condition
+    are untracked stdlib objects."""
+    monkeypatch.delenv("PTPU_LOCK_CHECK", raising=False)
+    from paddle_tpu.serving import KVBlockPool
+    from paddle_tpu.serving.scheduler import RequestQueue
+
+    pool = KVBlockPool(1, 1, 4, 4, num_blocks=4)
+    assert type(pool._lock) is type(threading.Lock())
+    assert type(RequestQueue()._lock) is type(threading.Lock())
+
+
+def test_flags_registered():
+    assert flags.env("PTPU_LOCK_CHECK") is False
+    assert flags.env("PTPU_LOCK_HOLD_MS") is None
+    table = flags.describe()
+    assert "PTPU_LOCK_CHECK" in table and "PTPU_LOCK_HOLD_MS" in table
+
+
+def test_factory_on_returns_tracked(tracking):
+    lk = conc.make_lock("t.lock")
+    rl = conc.make_rlock("t.rlock")
+    cv = conc.make_condition("t.cv")
+    assert isinstance(lk, conc.TrackedLock)
+    assert isinstance(rl, conc.TrackedRLock)
+    assert isinstance(cv, conc.TrackedCondition)
+    assert conc.stats()["locks_tracked"] == 3  # cv reuses its own rlock
+
+
+# ---------------------------------------------------------------------------
+# lock-order cycle detection (the ABBA acceptance pin)
+# ---------------------------------------------------------------------------
+
+
+def test_abba_cycle_reported_without_hanging(tracking):
+    """Two threads acquire {A, B} in opposite orders — serialized, so
+    the run never actually deadlocks — and the tracker still reports the
+    POTENTIAL deadlock, naming both locks, both threads, and both
+    acquisition stacks."""
+    A = conc.make_lock("test.A")
+    B = conc.make_lock("test.B")
+
+    def order_ab():
+        with A:
+            with B:
+                pass
+
+    def order_ba():
+        with B:
+            with A:
+                pass
+
+    t1 = threading.Thread(target=order_ab, name="abba-fwd")
+    t1.start()
+    t1.join()
+    assert conc.violations() == []  # one order alone is legal
+    t2 = threading.Thread(target=lambda: _quiet(order_ba),
+                          name="abba-rev")
+    t2.start()
+    t2.join(timeout=30)
+    assert not t2.is_alive()
+
+    vs = conc.violations()
+    assert len(vs) == 1 and vs[0].rule == "lock-order-cycle", vs
+    v = vs[0]
+    assert set(v.locks) == {"test.A", "test.B"}
+    assert "abba-fwd" in v.threads and "abba-rev" in v.threads
+    # both acquisition stacks are in the report: the reversing thread's
+    # frames AND the conflicting first-order thread's frames
+    assert "order_ba" in v.message and "order_ab" in v.message
+    assert len(v.stacks) == 4  # hold+acquire for each direction
+    with pytest.raises(conc.LockCheckError) as ei:
+        conc.assert_clean()
+    assert ei.value.rule == "lock-order-cycle"
+    assert conc.stats()["violations"] == 1
+
+
+def test_same_class_nesting_reported(tracking):
+    """Two instances of one lock class nested — the class-level graph
+    cannot order them, so the nesting itself is the hazard (the
+    opposite order elsewhere would be an invisible ABBA)."""
+    a = conc.TrackedLock("t.pool")
+    b = conc.TrackedLock("t.pool")
+
+    def nest():
+        with a:
+            with b:
+                pass
+
+    _quiet(nest)
+    vs = conc.violations()
+    assert vs and vs[0].rule == "same-class-nesting", vs
+    assert vs[0].locks == ("t.pool",)
+    assert "nest" in vs[0].message
+    # re-acquiring the SAME instance reentrancy path stays separate:
+    conc.reset()
+    r = conc.make_rlock("t.pool.r")
+    with r:
+        with r:
+            pass
+    assert conc.violations() == []
+
+
+def test_blocking_violation_locks_field_holds_only_locks(tracking):
+    """LockViolation.locks names LOCKS only — the blocking site rides
+    detail/message, not the locks tuple (the documented contract)."""
+    L = conc.make_lock("t.pure.lock")
+    with L:
+        with _quiet(conc.blocking_region, "queue.get", "some.site"):
+            pass
+    vs = conc.violations()
+    assert vs and vs[0].locks == ("t.pure.lock",), vs
+    assert vs[0].detail == ("queue.get", "some.site")
+    assert "some.site" in vs[0].message
+
+
+def test_tracked_rlock_locked_parity(tracking):
+    """locked() on the tracked RLock mirrors the plain primitive:
+    delegate where this Python has it, AttributeError where not."""
+    plain_has = hasattr(threading.RLock(), "locked")
+    rl = conc.make_rlock("t.locked")
+    if plain_has:
+        assert rl.locked() is False
+        with rl:
+            assert rl.locked() is True
+    else:
+        with pytest.raises(AttributeError):
+            rl.locked()
+
+
+def test_reentrant_condition_creates_no_false_cycle(tracking):
+    """cv -> L -> cv (reentrant re-acquire of the RLock-backed
+    condition, the pserver checkpoint-under-round shape) must NOT
+    manufacture a cycle: re-acquiring a held lock records no edge."""
+    cv = conc.make_condition("t.cv2")
+    L = conc.make_lock("t.L2")
+    with cv:
+        with L:
+            with cv:
+                pass
+    assert conc.violations() == []
+    assert conc.stats()["order_edges"] == 1  # cv -> L only
+
+
+def test_three_lock_cycle(tracking):
+    """Cycles longer than ABBA: A->B, B->C observed, then C->A closes
+    the triangle."""
+    A, B, C = (conc.make_lock("t3.%s" % n) for n in "ABC")
+
+    def run(x, y):
+        with x:
+            with y:
+                pass
+
+    run(A, B)
+    run(B, C)
+    assert conc.violations() == []
+    _quiet(run, C, A)
+    vs = conc.violations()
+    assert vs and vs[0].rule == "lock-order-cycle"
+    assert set(vs[0].locks) == {"t3.A", "t3.B", "t3.C"}
+
+
+# ---------------------------------------------------------------------------
+# blocking-while-holding / long-hold / self-deadlock
+# ---------------------------------------------------------------------------
+
+
+def test_condition_wait_while_holding_other_lock(tracking):
+    L = conc.make_lock("t.bwh.lock")
+    cv = conc.make_condition("t.bwh.cv")
+    with L:
+        with cv:
+            _quiet(cv.wait, timeout=0.01)
+    vs = conc.violations()
+    assert vs and vs[0].rule == "blocking-while-holding", vs
+    assert "t.bwh.lock" in vs[0].locks
+
+
+def test_condition_wait_on_own_lock_is_clean(tracking):
+    cv = conc.make_condition("t.own.cv")
+    with cv:
+        cv.wait(timeout=0.01)
+    assert conc.violations() == []
+
+
+def test_blocking_region(tracking):
+    with conc.blocking_region("queue.get", "t.site"):
+        pass  # nothing held: clean
+    assert conc.violations() == []
+    L = conc.make_lock("t.region.lock")
+    with L:
+        with _quiet(conc.blocking_region, "queue.get", "t.site"):
+            pass
+    vs = conc.violations()
+    assert vs and vs[0].rule == "blocking-while-holding"
+    assert "t.region.lock" in vs[0].locks
+
+
+def test_long_hold(tracking, monkeypatch):
+    monkeypatch.setenv("PTPU_LOCK_HOLD_MS", "5")
+    H = conc.make_lock("t.hold")
+
+    def hold():
+        with H:
+            time.sleep(0.03)
+
+    _quiet(hold)
+    vs = conc.violations()
+    assert vs and vs[0].rule == "long-hold", vs
+    assert "t.hold" in vs[0].locks and "hold" in vs[0].message
+    assert conc.stats()["max_hold_ms"] >= 5.0
+
+
+def test_hold_time_excludes_condition_wait(tracking, monkeypatch):
+    """Condition.wait genuinely releases the lock — a long wait must
+    not count as a long hold."""
+    monkeypatch.setenv("PTPU_LOCK_HOLD_MS", "20")
+    cv = conc.make_condition("t.waithold.cv")
+    with cv:
+        cv.wait(timeout=0.06)
+    assert conc.violations() == []
+
+
+def test_self_deadlock_raises_instead_of_hanging(tracking):
+    S = conc.make_lock("t.self")
+    S.acquire()
+    try:
+        with pytest.raises(conc.LockCheckError) as ei:
+            _quiet(S.acquire)
+        assert ei.value.rule == "self-deadlock"
+    finally:
+        S.release()
+
+
+def test_timed_reacquire_times_out_like_plain_threading(tracking):
+    """A TIMED re-acquire by the holder must behave exactly like the
+    plain primitive (return False after the wait), not trip the
+    self-deadlock guard — the guard is only for the would-hang-forever
+    untimed case."""
+    S = conc.make_lock("t.timed")
+    S.acquire()
+    try:
+        t0 = time.perf_counter()
+        assert S.acquire(True, 0.05) is False
+        assert time.perf_counter() - t0 >= 0.04
+        assert S.acquire(False) is False
+    finally:
+        S.release()
+    assert conc.violations() == []
+
+
+# ---------------------------------------------------------------------------
+# tracked primitives behave like the stdlib ones
+# ---------------------------------------------------------------------------
+
+
+def test_tracked_condition_producer_consumer(tracking):
+    cv = conc.make_condition("t.pc.cv")
+    items = []
+    got = []
+
+    def consumer():
+        with cv:
+            while len(got) < 3:
+                if items:
+                    got.append(items.pop())
+                else:
+                    cv.wait(timeout=5)
+
+    t = threading.Thread(target=consumer, name="pc-consumer")
+    t.start()
+    for i in range(3):
+        with cv:
+            items.append(i)
+            cv.notify_all()
+        time.sleep(0.01)
+    t.join(timeout=30)
+    assert not t.is_alive() and len(got) == 3
+    assert conc.violations() == []
+
+
+def test_tracked_condition_wait_for(tracking):
+    cv = conc.make_condition("t.wf.cv")
+    box = []
+
+    def setter():
+        time.sleep(0.05)
+        with cv:
+            box.append(1)
+            cv.notify_all()
+
+    t = threading.Thread(target=setter)
+    t.start()
+    with cv:
+        assert cv.wait_for(lambda: box, timeout=10)
+    t.join()
+    with cv:
+        assert not cv.wait_for(lambda: False, timeout=0.02)
+    assert conc.violations() == []
+
+
+def test_tracked_lock_nonblocking_and_timeout(tracking):
+    L = conc.make_lock("t.nb")
+    assert L.acquire(False)
+    assert L.locked()
+    got = []
+
+    def prober():
+        got.append(L.acquire(True, 0.01))
+
+    t = threading.Thread(target=prober)
+    t.start()
+    t.join()
+    assert got == [False]
+    L.release()
+    assert conc.violations() == []
+
+
+def test_publish_metrics_writes_gauges(tracking):
+    from paddle_tpu.observability import metrics as obs
+
+    L = conc.make_lock("t.pub")
+    with L:
+        pass
+    # publish twice: the FIRST publish may itself create the gauge
+    # objects (tracked locks under the flag), moving locks_tracked —
+    # the second run writes the settled values
+    conc.publish_metrics()
+    conc.publish_metrics()
+    reg = obs.registry()
+    snap = conc.stats()
+    assert reg.gauge("concurrency/locks_tracked").value \
+        == snap["locks_tracked"]
+    assert reg.gauge("concurrency/acquisitions").value \
+        == snap["acquisitions"]
+    assert reg.gauge("concurrency/violations").value == 0
+
+
+# ---------------------------------------------------------------------------
+# runtime invariant hooks
+# ---------------------------------------------------------------------------
+
+
+def _pool(**kw):
+    from paddle_tpu.serving import KVBlockPool
+
+    args = dict(n_layers=1, n_heads=1, head_dim=4, block_size=4,
+                num_blocks=6)
+    args.update(kw)
+    return KVBlockPool(**args)
+
+
+def test_pool_invariants_clean_through_lifecycle():
+    pool = _pool()
+    assert pool.check_invariants() == []
+    assert pool.reserve("a", 3)
+    ids = [pool.alloc_block("a") for _ in range(2)]
+    assert pool.check_invariants() == []
+    from paddle_tpu.serving import prefix_chain_keys
+
+    keys = prefix_chain_keys(list(range(8)), 4)
+    pool.seal_block(ids[0], keys[0])
+    pool.free_owner("a")
+    assert pool.check_invariants() == []  # one cached, one freed
+    assert pool.reserve("b", 2, prefix_keys=keys)  # adopt the cached one
+    assert pool.check_invariants() == []
+    pool.flush_prefix_cache()
+    assert pool.check_invariants() == []
+
+
+def test_pool_invariants_catch_corruption():
+    pool = _pool()
+    assert pool.reserve("a", 2)
+    bid = pool.alloc_block("a")
+    # conservation: leak a free block
+    stolen = pool._free.pop()
+    probs = pool.check_invariants()
+    assert any("conservation" in p for p in probs), probs
+    pool._free.append(stolen)
+    assert pool.check_invariants() == []
+    # refcount corruption
+    pool._refs[bid] = 0
+    probs = pool.check_invariants()
+    assert any("refcount" in p for p in probs), probs
+    pool._refs[bid] = 1
+    # index corruption: sealed entry pointing at an unkeyed block
+    pool._sealed["deadbeef"] = bid
+    probs = pool.check_invariants()
+    assert any("sealed index" in p for p in probs), probs
+    del pool._sealed["deadbeef"]
+    # duplicate on the free list
+    pool._free.append(pool._free[-1])
+    probs = pool.check_invariants()
+    assert any("both" in p for p in probs), probs
+
+
+def test_pool_invariant_reported_as_violation_under_flag(tracking):
+    """The engine's step-boundary hook routes pool problems into the
+    tracker as pool-invariant violations."""
+    pool = _pool()
+    pool._free.pop()  # break conservation
+    for msg in pool.check_invariants():
+        _quiet(conc.record_violation, "pool-invariant", msg,
+               locks=("serving.kv_pool",))
+    vs = conc.violations()
+    assert vs and vs[0].rule == "pool-invariant"
+
+
+# ---------------------------------------------------------------------------
+# the serving engine under PTPU_LOCK_CHECK=1 (the bench-path pin)
+# ---------------------------------------------------------------------------
+
+
+def test_serving_engine_clean_and_identical_under_lock_check(tracking):
+    """A concurrent fast-path serving run under the tracker: outputs
+    stay token-identical to the unbatched reference (tracked wrappers
+    may not change behavior), the invariant hooks run clean, and the
+    tracker demonstrably saw the runtime (locks, acquisitions, >= 1
+    order edge)."""
+    from paddle_tpu import serving
+    from paddle_tpu.serving import (GenerationConfig, GenerationModel,
+                                    reference_decode)
+
+    model = GenerationModel.random(
+        GenerationConfig(vocab_size=64, d_model=32, n_heads=2,
+                         n_layers=2, d_ff=64, max_seq_len=64),
+        seed=3, name="lockcheck")
+    rng = np.random.RandomState(11)
+    shared = rng.randint(0, 64, size=8).tolist()
+    prompts = [shared + rng.randint(0, 64,
+                                    size=rng.randint(2, 8)).tolist()
+               for _ in range(8)]
+    results = {}
+    with serving.ServingEngine({"lockcheck": model}, max_batch=4,
+                               max_seq_len=64, block_size=4,
+                               prefill_chunk=4,
+                               prefix_cache=True) as eng:
+        worker = eng._workers["lockcheck"]
+        assert isinstance(worker._cv, conc.TrackedCondition)
+        assert isinstance(worker.pool._lock, conc.TrackedLock)
+        assert worker._lock_check
+
+        def client(lo, hi):
+            for i in range(lo, hi):
+                results[i] = eng.generate(prompts[i], max_new_tokens=8,
+                                          timeout=300)
+
+        threads = [threading.Thread(target=client, args=(i * 2, i * 2 + 2))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert worker.pool.check_invariants() == []
+    for i, p in enumerate(prompts):
+        assert results[i] == reference_decode(model, p, 8), i
+    assert conc.violations() == []
+    snap = conc.stats()
+    assert snap["locks_tracked"] >= 3
+    assert snap["acquisitions"] >= len(prompts)
+    assert snap["order_edges"] >= 1  # submit: engine.cv -> request_queue
+
+
+def test_engine_invariant_hook_fires_on_corruption(tracking):
+    """Corrupting the pool mid-run makes the step-boundary hook record
+    a pool-invariant violation (the hook is live, not decorative)."""
+    from paddle_tpu import serving
+    from paddle_tpu.serving import GenerationConfig, GenerationModel
+
+    model = GenerationModel.random(
+        GenerationConfig(vocab_size=64, d_model=32, n_heads=2,
+                         n_layers=2, d_ff=64, max_seq_len=64),
+        seed=4, name="corrupt")
+    with serving.ServingEngine({"corrupt": model}, max_batch=2,
+                               max_seq_len=64, block_size=4) as eng:
+        worker = eng._workers["corrupt"]
+        with worker.pool._lock._raw:  # bypass tracking for the sabotage
+            worker.pool._free.pop()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            eng.generate([1, 2, 3], max_new_tokens=4, timeout=120)
+    vs = conc.violations()
+    assert any(v.rule == "pool-invariant" for v in vs), vs
+
+
+# ---------------------------------------------------------------------------
+# satellite hardening regressions
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_manager_concurrent_save_wait(tmp_path):
+    """CheckpointManager's thread/error handoff is lock-guarded now:
+    concurrent wait() callers racing an async save must neither crash
+    nor drop a background failure."""
+    from paddle_tpu import checkpoint
+
+    mgr = checkpoint.CheckpointManager(str(tmp_path), max_to_keep=2,
+                                       async_save=True)
+    state = {"w": np.arange(8, dtype=np.float32)}
+    errs = []
+
+    def waiter():
+        for _ in range(20):
+            try:
+                mgr.wait()
+            except BaseException as e:  # pragma: no cover
+                errs.append(e)
+
+    threads = [threading.Thread(target=waiter) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for step in range(3):
+        mgr.save(state, step)
+    for t in threads:
+        t.join()
+    mgr.wait()
+    assert errs == []
+    assert mgr.latest_step() == 2
+    restored = mgr.restore()
+    np.testing.assert_array_equal(restored["w"], state["w"])
+
+
+def test_checkpoint_manager_background_error_still_surfaces(tmp_path,
+                                                            monkeypatch):
+    from paddle_tpu import checkpoint
+
+    mgr = checkpoint.CheckpointManager(str(tmp_path), async_save=True)
+
+    def boom(*a, **k):
+        raise RuntimeError("disk on fire")
+
+    monkeypatch.setattr(checkpoint, "save_checkpoint", boom)
+    mgr.save({"w": np.zeros(2, np.float32)}, 0)
+    with pytest.raises(RuntimeError, match="disk on fire"):
+        mgr.wait()
+    mgr.wait()  # error is consumed, not re-raised forever
+
+
+def test_async_engine_blocking_hooks_fire(tracking):
+    """The prefetcher's declared blocking regions report when entered
+    with a tracked lock held (the queue.get / device-sync hook)."""
+    from paddle_tpu import async_engine
+
+    L = conc.make_lock("t.ae.lock")
+    pf = async_engine.FeedPrefetcher(depth=1)
+    try:
+        pf.put({"x": np.zeros(2, np.float32)})
+        with L:
+            _quiet(pf.get)
+    finally:
+        pf.close()
+    vs = conc.violations()
+    assert vs and vs[0].rule == "blocking-while-holding", vs
+    assert "t.ae.lock" in vs[0].locks
+
+
+def test_distinct_invariant_violations_all_report(tracking):
+    """Dedup keys carry a `detail`: two DIFFERENT pool-invariant breaks
+    on the same lock set must both report (the first must not shadow
+    the second), while re-reporting the same detail stays deduped."""
+    _quiet(conc.record_violation, "pool-invariant",
+           "KVBlockPool[a]: conservation broken",
+           locks=("serving.kv_pool",), detail=("a", "conservation"))
+    _quiet(conc.record_violation, "pool-invariant",
+           "KVBlockPool[a]: block 3 referenced with refcount 0",
+           locks=("serving.kv_pool",), detail=("a", "refcount"))
+    _quiet(conc.record_violation, "pool-invariant",
+           "KVBlockPool[a]: conservation broken",
+           locks=("serving.kv_pool",), detail=("a", "conservation"))
+    vs = conc.violations()
+    assert len(vs) == 2, vs
+    assert {v.detail for v in vs} == {("a", "conservation"),
+                                      ("a", "refcount")}
+
+
+def test_tracked_condition_adopts_plain_lock(tracking):
+    """make_condition(lock=<plain primitive>) is legal with the flag
+    off, so it must be legal (wrapped, tracked) with the flag on."""
+    plain = threading.Lock()
+    cv = conc.make_condition("t.adopt.cv", lock=plain)
+    assert isinstance(cv, conc.TrackedCondition)
+    assert cv._lock._raw is plain
+    assert not isinstance(cv._lock, conc.TrackedRLock)  # Lock stays Lock
+    with cv:
+        cv.wait(timeout=0.01)
+    rcv = conc.make_condition("t.adopt.rcv", lock=threading.RLock())
+    assert isinstance(rcv._lock, conc.TrackedRLock)
+    with rcv:
+        with rcv:  # reentrant through the adopted RLock
+            pass
+    assert conc.violations() == []
+
+
+def test_checkpoint_manager_concurrent_saves_serialize(tmp_path):
+    """Concurrent save() callers queue instead of racing the
+    join-then-spawn handoff: every step lands, wait() returns only
+    after the last writer finished, and no failure is dropped."""
+    from paddle_tpu import checkpoint
+
+    mgr = checkpoint.CheckpointManager(str(tmp_path), max_to_keep=10,
+                                       async_save=True)
+    errs = []
+
+    def saver(step):
+        try:
+            mgr.save({"w": np.full(4, step, np.float32)}, step)
+        except BaseException as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=saver, args=(s,))
+               for s in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    mgr.wait()
+    assert errs == []
+    assert mgr.all_steps() == list(range(6))
+
+
+def test_reset_clears_state(tracking):
+    A = conc.make_lock("t.reset.A")
+    with A:
+        pass
+    assert conc.stats()["acquisitions"] == 1
+    conc.reset()
+    snap = conc.stats()
+    assert snap["acquisitions"] == 0 and snap["order_edges"] == 0
+    assert conc.violations() == []

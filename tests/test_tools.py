@@ -178,6 +178,88 @@ def test_ptpu_lint_rules_fire(tmp_path):
                                "") == []
 
 
+def test_ptpu_lint_concurrency_rules_fire(tmp_path):
+    """ISSUE 12: each of the four concurrency lint rules fires on a
+    fixture, and the safe idioms (with-block, while-wait, wait_for,
+    daemon/joined threads, non-blocking probes) stay clean."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import ptpu_lint
+    finally:
+        sys.path.pop(0)
+    fixture = tmp_path / "fixture.py"
+    fixture.write_text(
+        "import threading\n"
+        "import time\n"
+        "def bad_acquire(lock):\n"
+        "    lock.acquire()\n"          # lock-with
+        "    lock.release()\n"
+        "def ok_acquire(lock):\n"
+        "    lock.acquire()\n"          # try/finally: clean
+        "    try:\n"
+        "        pass\n"
+        "    finally:\n"
+        "        lock.release()\n"
+        "def ok_probe(lock):\n"
+        "    return lock.acquire(False)\n"
+        "def ok_with(lock):\n"
+        "    with lock:\n"
+        "        pass\n"
+        "def bad_wait(cv, ready):\n"
+        "    with cv:\n"
+        "        if not ready:\n"
+        "            cv.wait()\n"       # cond-wait-loop
+        "def ok_wait(cv, ready):\n"
+        "    with cv:\n"
+        "        while not ready():\n"
+        "            cv.wait(timeout=0.1)\n"
+        "def ok_wait_for(cv, ready):\n"
+        "    with cv:\n"
+        "        cv.wait_for(ready)\n"
+        "def bad_thread(fn):\n"
+        "    threading.Thread(target=fn).start()\n"  # thread-lifecycle
+        "def bad_explicit_nondaemon(fn):\n"          # thread-lifecycle:
+        "    threading.Thread(target=fn, daemon=False).start()\n"
+        "def bad_unrelated_join(fn, names, q):\n"    # thread-lifecycle:
+        "    threading.Thread(target=fn).start()\n"  # str/queue .join
+        "    q.join()\n"                             # must not vouch
+        "    return ', '.join(names)\n"
+        "def bad_sibling_credit(fn):\n"  # thread-lifecycle: t1's daemon
+        "    t1 = threading.Thread(target=fn)\n"  # flag must not vouch
+        "    t1.daemon = True\n"                  # for t2
+        "    t1.start()\n"
+        "    t2 = threading.Thread(target=fn)\n"
+        "    t2.start()\n"
+        "def ok_daemon(fn):\n"
+        "    threading.Thread(target=fn, daemon=True).start()\n"
+        "def ok_joined(fn):\n"
+        "    t = threading.Thread(target=fn)\n"
+        "    t.start()\n"
+        "    t.join()\n"
+        "def bad_sleep(lock):\n"
+        "    with lock:\n"
+        "        time.sleep(1)\n"       # sleep-under-lock
+        "def ok_sleep(lock):\n"
+        "    with lock:\n"
+        "        pass\n"
+        "    time.sleep(0.1)\n")
+    findings = ptpu_lint.lint_file(str(fixture),
+                                   ptpu_lint.declared_flag_names(), "")
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f.line)
+    assert sorted(by_rule) == ["cond-wait-loop", "lock-with",
+                               "sleep-under-lock",
+                               "thread-lifecycle"], findings
+    # every ok_* idiom stayed clean: one finding per bad_* function
+    # (thread-lifecycle has four — the bare Thread, the explicit
+    # daemon=False which earns no credit from the kwarg's presence,
+    # the unrelated str/queue join which cannot vouch, and t2 left
+    # uncovered by its daemonized sibling t1)
+    assert len(by_rule.pop("thread-lifecycle")) == 4, findings
+    assert all(len(lines) == 1 for lines in by_rule.values()), findings
+
+
 def test_flags_describe_cli_table():
     from paddle_tpu import flags
 

@@ -27,6 +27,38 @@ Rules:
                registry's exposition tables are the contract dashboards
                are built against
 
+Concurrency rules (docs/STATIC_ANALYSIS.md "Concurrency analysis" —
+receivers are judged by NAME: `lock`/`mu`/`mutex` and `*_lock`-style
+names are lock-like, `cv`/`cond`/`condition` and `*_cv`-style names are
+condition-like; the runtime keeps to those spellings so the rules stay
+sound):
+
+  lock-with    a lock-like receiver's bare `.acquire()` must be paired
+               with a try/finally that releases the same receiver in
+               the enclosing scope — otherwise use `with` (an exception
+               between acquire and release orphans the lock forever);
+               non-blocking probes (`acquire(False)` / `timeout=`) and
+               delegating wrappers (an enclosing function itself named
+               `acquire`/`__enter__`) are exempt
+  cond-wait-loop
+               a condition-like receiver's `.wait()` must sit inside a
+               `while` loop — `if pred: cv.wait()` is spurious-wakeup-
+               unsafe (PEP 343 era condition contract); `.wait_for()`
+               builds the loop in and is exempt, as are delegating
+               wrappers (an enclosing function itself named `wait`/
+               `wait_for`)
+  thread-lifecycle
+               every `threading.Thread(...)` is `daemon=True` (at the
+               constructor or via `.daemon = True` in the same scope —
+               a literal False earns no credit) or provably joined (a
+               `.join()` on a name the scope binds a Thread to; a stray
+               str.join/queue.join cannot vouch) — a forgotten
+               non-daemon thread hangs interpreter exit
+  sleep-under-lock
+               no `time.sleep(...)` lexically inside a `with <lock-like>`
+               block — sleeping under a lock serializes every waiter
+               behind the nap
+
 Usage:
   python tools/ptpu_lint.py [path ...]     # default: paddle_tpu/
   python tools/ptpu_lint.py --list-rules
@@ -39,6 +71,7 @@ import argparse
 import ast
 import importlib.util
 import os
+import re
 import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -55,7 +88,39 @@ RULES = {
                      "program-build time",
     "metric-undocumented": "metric name literals must appear in "
                            "docs/OBSERVABILITY.md",
+    "lock-with": "lock-like receivers are acquired via `with` (or "
+                 "try/finally-released); no orphanable bare .acquire()",
+    "cond-wait-loop": "condition-like .wait() must sit in a `while` "
+                      "loop (spurious wakeups); .wait_for is exempt",
+    "thread-lifecycle": "every threading.Thread is daemon=True or "
+                        "provably joined in the same scope",
+    "sleep-under-lock": "no time.sleep inside a `with <lock>` block",
 }
+
+# receiver-name heuristics for the concurrency rules: the runtime names
+# its primitives this way on purpose (docs/STATIC_ANALYSIS.md)
+_LOCKISH = re.compile(r"_{0,2}(?:.*_)?(?:lock|mu|mutex|cv|cond|condition)$")
+_CONDISH = re.compile(r"_{0,2}(?:.*_)?(?:cv|cond|condition)$")
+
+
+def _recv_name(node):
+    """Terminal name of a receiver expression: `self._cv` -> '_cv',
+    `lock` -> 'lock', anything else -> None."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _is_lockish(node):
+    name = _recv_name(node)
+    return name is not None and bool(_LOCKISH.fullmatch(name.lower()))
+
+
+def _is_condish(node):
+    name = _recv_name(node)
+    return name is not None and bool(_CONDISH.fullmatch(name.lower()))
 
 # directories whose functions are program-BUILDERS when they append ops
 _BUILDER_DIRS = (os.path.join("paddle_tpu", "layers"),
@@ -235,6 +300,206 @@ class _Linter(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+def _parent_map(tree):
+    parents = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def _ancestors(node, parents):
+    n = parents.get(node)
+    while n is not None:
+        yield n
+        n = parents.get(n)
+
+
+_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _enclosing_scope(node, parents):
+    """Nearest enclosing function (or the module) — the unit the
+    thread-lifecycle/daemon-assignment scan runs over."""
+    for a in _ancestors(node, parents):
+        if isinstance(a, _SCOPES + (ast.Module,)):
+            return a
+    return None
+
+
+def _nonblocking_acquire(call):
+    """acquire(False) / acquire(blocking=False) / any timeout= probe —
+    the caller is inspecting, not holding-forever-on-raise."""
+    if call.args:
+        a0 = call.args[0]
+        if isinstance(a0, ast.Constant) and a0.value is False:
+            return True
+        if len(call.args) > 1:
+            return True  # positional timeout
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return True
+        if kw.arg == "blocking" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return True
+    return False
+
+
+def _try_releases(try_node, recv_name=None):
+    """The Try's finalbody contains a `.release()` call (on `recv_name`
+    when given)."""
+    for stmt in try_node.finalbody:
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr == "release" \
+                    and (recv_name is None
+                         or _recv_name(n.func.value) == recv_name):
+                return True
+    return False
+
+
+def _scope_finally_releases(scope, recv_name):
+    """The enclosing scope holds a try/finally releasing `recv_name` —
+    covers the canonical `lock.acquire()`-BEFORE-`try` idiom (the
+    acquire must not sit inside the try, else a failed acquire would
+    release a lock it never took)."""
+    for n in ast.walk(scope):
+        if isinstance(n, ast.Try) and _try_releases(n, recv_name):
+            return True
+    return False
+
+
+def _concurrency_findings(tree, path):
+    """The four concurrency rules (lock-with, cond-wait-loop,
+    thread-lifecycle, sleep-under-lock) — parent-map based, since they
+    reason about statement CONTEXT rather than call shape."""
+    parents = _parent_map(tree)
+    findings = []
+
+    def add(node, rule, message):
+        findings.append(Finding(path, node.lineno, rule, message))
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+
+        # -- lock-with -------------------------------------------------
+        if isinstance(func, ast.Attribute) and func.attr == "acquire" \
+                and _is_lockish(func.value) \
+                and not _nonblocking_acquire(node):
+            scope = _enclosing_scope(node, parents)
+            wrapper = isinstance(scope, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)) \
+                and scope.name in ("acquire", "__enter__")
+            if not wrapper and not _scope_finally_releases(
+                    scope or tree, _recv_name(func.value)):
+                add(node, "lock-with",
+                    "bare %s.acquire() without a try/finally release — "
+                    "acquire via `with` so an exception cannot orphan "
+                    "the lock" % _recv_name(func.value))
+
+        # -- cond-wait-loop --------------------------------------------
+        if isinstance(func, ast.Attribute) and func.attr == "wait" \
+                and _is_condish(func.value):
+            scope = _enclosing_scope(node, parents)
+            wrapper = isinstance(scope, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)) \
+                and scope.name in ("wait", "wait_for")
+            in_while = False
+            for a in _ancestors(node, parents):
+                if isinstance(a, ast.While):
+                    in_while = True
+                    break
+                if isinstance(a, _SCOPES):
+                    break  # don't credit a loop outside this function
+            if not in_while and not wrapper:
+                add(node, "cond-wait-loop",
+                    "%s.wait() outside a `while` loop — an `if`-guarded "
+                    "wait is spurious-wakeup-unsafe; loop on the "
+                    "predicate (or use wait_for)"
+                    % _recv_name(func.value))
+
+        # -- thread-lifecycle ------------------------------------------
+        is_thread = (isinstance(func, ast.Attribute)
+                     and func.attr == "Thread"
+                     and isinstance(func.value, ast.Name)
+                     and func.value.id == "threading") \
+            or (isinstance(func, ast.Name) and func.id == "Thread")
+        if is_thread:
+            # daemon=<anything but a literal False> at the constructor
+            # satisfies the rule; an explicit daemon=False is exactly
+            # the non-daemon thread the rule exists to catch and gets
+            # no credit (it still passes with a join in scope)
+            daemonized = any(
+                kw.arg == "daemon"
+                and not (isinstance(kw.value, ast.Constant)
+                         and kw.value.value is False)
+                for kw in node.keywords)
+            if not daemonized:
+                scope = _enclosing_scope(node, parents) or tree
+                # names THIS Thread call is bound to (its parent
+                # Assign's targets): only a `.daemon = True` or
+                # `.join()` on one of these counts — an unrelated
+                # object's daemon flag, another thread's join, or a
+                # stray str.join/queue.join must not vouch for it (and
+                # a chained `Thread(...).start()` binds no name, so
+                # nothing can)
+                bound = set()
+                parent = parents.get(node)
+                if isinstance(parent, ast.Assign):
+                    for t in parent.targets:
+                        name = _recv_name(t)
+                        if name is not None:
+                            bound.add(name)
+                owned = False
+                for n in ast.walk(scope):
+                    if isinstance(n, ast.Assign) and any(
+                            isinstance(t, ast.Attribute)
+                            and t.attr == "daemon"
+                            and _recv_name(t.value) in bound
+                            for t in n.targets) \
+                            and not (isinstance(n.value, ast.Constant)
+                                     and n.value.value is False):
+                        owned = True
+                        break
+                    if isinstance(n, ast.Call) \
+                            and isinstance(n.func, ast.Attribute) \
+                            and n.func.attr == "join" \
+                            and _recv_name(n.func.value) in bound:
+                        owned = True
+                        break
+                if not owned:
+                    add(node, "thread-lifecycle",
+                        "threading.Thread without daemon=True and no "
+                        "visible join in this scope — a forgotten "
+                        "non-daemon thread hangs interpreter exit; mark "
+                        "it daemon or own a close()/join() path")
+
+        # -- sleep-under-lock ------------------------------------------
+        if isinstance(func, ast.Attribute) and func.attr == "sleep":
+            root = func.value
+            if isinstance(root, ast.Name) and root.id in ("time",
+                                                          "_time"):
+                for a in _ancestors(node, parents):
+                    if isinstance(a, _SCOPES):
+                        break  # deferred body: not under the with
+                    if isinstance(a, ast.With) and any(
+                            _is_lockish(item.context_expr)
+                            for item in a.items):
+                        add(node, "sleep-under-lock",
+                            "time.sleep while holding %s — every waiter "
+                            "on that lock sleeps too; sleep outside the "
+                            "critical section"
+                            % ", ".join(
+                                _recv_name(item.context_expr) or "a lock"
+                                for item in a.items
+                                if _is_lockish(item.context_expr)))
+                        break
+    return findings
+
+
 def lint_file(path, flag_names, doc_text):
     with open(path) as f:
         src = f.read()
@@ -248,7 +513,7 @@ def lint_file(path, flag_names, doc_text):
                   for d in _BUILDER_DIRS)
     linter = _Linter(path, flag_names, doc_text, is_flags, builder)
     linter.visit(tree)
-    return linter.findings
+    return linter.findings + _concurrency_findings(tree, path)
 
 
 def iter_py_files(paths):
