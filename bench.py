@@ -455,6 +455,82 @@ def bench_serving_fastpath(n_requests=10, max_new_tokens=8,
     }
 
 
+def bench_serving_spec(n_requests=6, max_new_tokens=48, spec_k=6,
+                       max_batch=2, vocab=64, d_model=64, n_heads=2,
+                       n_layers=2, d_ff=128, max_seq_len=256,
+                       block_size=16, chunk=8, pattern_len=4, reps=3):
+    """Speculative-decoding receipt (docs/SERVING.md): one
+    repetitive/structured generation set — each prompt is a short
+    random pattern repeated several times, and the tiny model's greedy
+    continuation settles into near-periodic runs: templated/structured
+    output, the traffic shape n-gram/prompt-lookup drafting shines on —
+    served with ``spec_k`` on and off. Requests run one at a time (low
+    concurrency is where the one-compiled-step-per-token bound actually
+    binds; a full batch hides it behind row parallelism).
+
+    The headline is **emitted tokens per compiled step**: legacy decode
+    is exactly 1 per sequence per step, speculation emits the accepted
+    run + 1 correction token per verify window. That ratio is the
+    TPU-relevant receipt — a decode step is memory-bandwidth-bound on
+    real hardware, so streaming the weights once per WINDOW instead of
+    once per token is the win; the CPU CI box is compute-bound and
+    pays the full window FLOPs, so wall-clock tokens/s is recorded as
+    context but the gate rides the step-count ratio. Both legs must
+    stay token-identical to ``reference_decode`` (the functional gate)
+    with a positive accept rate.
+
+    Returns a dict with per-leg tokens_per_sec/tokens_per_step/steps,
+    the spec accept rate, the tokens-per-step speedup and identity."""
+    from paddle_tpu import serving
+
+    cfg = serving.GenerationConfig(
+        vocab_size=vocab, d_model=d_model, n_heads=n_heads,
+        n_layers=n_layers, d_ff=d_ff, max_seq_len=max_seq_len)
+    model = serving.GenerationModel.random(cfg, seed=0)
+    rng = np.random.RandomState(11)
+    prompts = [(rng.randint(0, vocab, size=pattern_len).tolist()) * reps
+               for _ in range(n_requests)]
+    refs = [serving.reference_decode(model, p, max_new_tokens)
+            for p in prompts]
+
+    def run_leg(k):
+        eng = serving.ServingEngine(model, max_batch=max_batch,
+                                    max_seq_len=max_seq_len,
+                                    block_size=block_size,
+                                    prefill_chunk=chunk, spec_k=k)
+        # priming request: pays the one-time XLA compile for every
+        # step shape this leg dispatches
+        eng.generate(prompts[0][:3], max_new_tokens=2, timeout=600)
+        base = eng.stats()["default"]
+        t0 = time.perf_counter()
+        outs = [eng.generate(p, max_new_tokens=max_new_tokens,
+                             timeout=600) for p in prompts]
+        wall = time.perf_counter() - t0
+        st = eng.stats()["default"]
+        eng.close()
+        gen = st["generated_tokens"] - base["generated_tokens"]
+        steps = st["steps"] - base["steps"]
+        return {
+            "outputs_match": outs == refs,
+            "tokens_per_sec": sum(len(o) for o in outs) / wall,
+            "tokens_per_step": gen / max(1, steps),
+            "steps": steps,
+            "accept_rate": st["spec_accept_rate"],
+        }
+
+    legacy = run_leg(0)
+    spec = run_leg(spec_k)
+    return {
+        "legacy": legacy,
+        "spec": spec,
+        "tokens_per_step_speedup": (spec["tokens_per_step"]
+                                    / legacy["tokens_per_step"]),
+        "accept_rate": spec["accept_rate"],
+        "outputs_match": (legacy["outputs_match"]
+                          and spec["outputs_match"]),
+    }
+
+
 def bench_zero(steps=16, warmup=4, repeats=3, depth=4, width=256,
                batch=64, bucket_mb=0.5):
     """ZeRO ladder + comm/compute overlap receipt (docs/ZERO.md) on the
@@ -786,6 +862,10 @@ def main(argv=None):
     ap.add_argument("--serving-only", action="store_true",
                     help="run only the continuous-batching serving leg "
                          "pair (the CI serve stage configuration)")
+    ap.add_argument("--spec-only", action="store_true",
+                    help="run only the speculative-decoding serving "
+                         "pair (spec_k on vs off on the repetitive-"
+                         "generation set)")
     ap.add_argument("--zero-only", action="store_true",
                     help="run only the ZeRO/overlap ladder on the "
                          "8-device CPU mesh (the CI zero stage "
@@ -893,10 +973,10 @@ def main(argv=None):
     compile_opt = compile_noopt = None
     hlo_opt = hlo_noopt = None
     last_loss = None
-    if args.serving_only or args.quant_only:
+    if args.serving_only or args.quant_only or args.spec_only:
         args.amp_only = False  # dedicated leg: skip everything else
     if not args.amp_only and not args.serving_only \
-            and not args.quant_only:
+            and not args.quant_only and not args.spec_only:
         if not args.sync_only:
             async_tps, last_loss, async_step, _ = bench_transformer_fluid(
                 async_exec=True, **kw)
@@ -932,7 +1012,7 @@ def main(argv=None):
     fp32_tps = amp_tps = fp32_step = amp_step = None
     fp32_loss = amp_loss = None
     if args.amp_only or not (args.tiny or args.serving_only
-                             or args.quant_only):
+                             or args.quant_only or args.spec_only):
         fp32_tps, fp32_loss, fp32_step, _ = bench_transformer_fluid(
             async_exec=False, dtype="float32", amp=False, **kw)
         _leg("fp32", fp32_tps, fp32_step, fp32_loss)
@@ -946,7 +1026,7 @@ def main(argv=None):
     serve_batched = serve_serial = serve_match = None
     serve_p50 = serve_p99 = serve_tokens = None
     if args.serving_only or not (args.tiny or args.amp_only
-                                 or args.quant_only):
+                                 or args.quant_only or args.spec_only):
         (serve_batched, serve_serial, serve_match, serve_p50,
          serve_p99, serve_tokens) = bench_serving()
         _leg("serving_batched", serve_batched, 0.0,
@@ -962,7 +1042,7 @@ def main(argv=None):
     # shared-system-prompt stream — TTFT is the headline
     fastpath_res = None
     if args.serving_only or not (args.tiny or args.amp_only
-                                 or args.quant_only):
+                                 or args.quant_only or args.spec_only):
         fastpath_res = bench_serving_fastpath()
         _leg("serving_fastpath", fastpath_res["fast"]["tokens_per_sec"],
              0.0,
@@ -975,6 +1055,25 @@ def main(argv=None):
              chunked_ttft_speedup=round(
                  fastpath_res["ttft_speedup"], 4))
 
+    # speculative-decoding receipt (docs/SERVING.md): draft-k verified
+    # in one step vs legacy one-token decode on the repetitive set —
+    # emitted tokens per compiled step is the headline
+    spec_res = None
+    if args.spec_only or args.serving_only \
+            or not (args.tiny or args.amp_only or args.quant_only):
+        spec_res = bench_serving_spec()
+        _leg("serving_spec", spec_res["spec"]["tokens_per_sec"], 0.0,
+             tokens_per_step=round(spec_res["spec"]["tokens_per_step"],
+                                   4),
+             accept_rate=round(spec_res["accept_rate"], 4),
+             outputs_match=bool(spec_res["outputs_match"]))
+        _leg("serving_spec_baseline",
+             spec_res["legacy"]["tokens_per_sec"], 0.0,
+             tokens_per_step=round(
+                 spec_res["legacy"]["tokens_per_step"], 4),
+             spec_tokens_per_step_speedup=round(
+                 spec_res["tokens_per_step_speedup"], 4))
+
     # int8 quantization receipt (docs/QUANTIZATION.md): fp32-vs-int8
     # predictor numerics + throughput + weight-store shrink, and the
     # weight-only-int8 serving leg gated token-identical against its
@@ -983,7 +1082,7 @@ def main(argv=None):
     qserve_int8 = qserve_fp32 = qserve_match = None
     qserve_agree = qserve_tokens = None
     if args.quant_only or not (args.tiny or args.amp_only
-                               or args.serving_only):
+                               or args.serving_only or args.spec_only):
         quant_res = bench_quant_predictor()
         _leg("quant_fp32_predictor",
              quant_res["fp32_examples_per_sec"], 0.0)
@@ -1005,7 +1104,9 @@ def main(argv=None):
     headline = async_tps if async_tps is not None else \
         (sync_tps if sync_tps is not None else
          (amp_tps if amp_tps is not None else
-          (serve_batched if serve_batched is not None else qserve_int8)))
+          (serve_batched if serve_batched is not None else
+           (qserve_int8 if qserve_int8 is not None else
+            spec_res["spec"]["tokens_per_sec"]))))
     if last_loss is None:
         last_loss = amp_loss
 
@@ -1014,7 +1115,8 @@ def main(argv=None):
     guarded = unguarded = overhead_pct = None
     if (args.resilience or args.tiny) and not (args.amp_only
                                                or args.serving_only
-                                               or args.quant_only):
+                                               or args.quant_only
+                                               or args.spec_only):
         unguarded, guarded = bench_resilience_overhead()
         overhead_pct = 100.0 * (guarded - unguarded) / unguarded
 
@@ -1105,6 +1207,19 @@ def main(argv=None):
                 fastpath_res["prefix_hit_rate"])
             reg.gauge("bench/serving_fastpath_outputs_match").set(
                 1.0 if fastpath_res["outputs_match"] else 0.0)
+        if spec_res is not None:
+            reg.gauge("bench/serving_spec_tokens_per_step").set(
+                spec_res["spec"]["tokens_per_step"])
+            reg.gauge("bench/serving_spec_speedup").set(
+                spec_res["tokens_per_step_speedup"])
+            reg.gauge("bench/serving_spec_accept_rate").set(
+                spec_res["accept_rate"])
+            reg.gauge("bench/serving_spec_outputs_match").set(
+                1.0 if spec_res["outputs_match"] else 0.0)
+            reg.gauge("bench/serving_spec_tokens_per_sec").set(
+                spec_res["spec"]["tokens_per_sec"])
+            reg.gauge("bench/serving_spec_baseline_tokens_per_sec").set(
+                spec_res["legacy"]["tokens_per_sec"])
         reg.dump_json(args.metrics_out)
     if args.legs_out:
         # machine-readable per-leg trajectory (ISSUE 5): BENCH_r*.json
@@ -1173,6 +1288,15 @@ def main(argv=None):
             fastpath_res["prefix_hit_rate"], 4)
         result["serving_fastpath_outputs_match"] = bool(
             fastpath_res["outputs_match"])
+    if spec_res is not None:
+        result["serving_spec_tokens_per_step"] = round(
+            spec_res["spec"]["tokens_per_step"], 4)
+        result["serving_spec_speedup"] = round(
+            spec_res["tokens_per_step_speedup"], 4)
+        result["serving_spec_accept_rate"] = round(
+            spec_res["accept_rate"], 4)
+        result["serving_spec_outputs_match"] = bool(
+            spec_res["outputs_match"])
     print(json.dumps(result))
 
 

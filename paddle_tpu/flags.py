@@ -231,6 +231,10 @@ _declare("PTPU_SERVE_PREFIX_CACHE", "bool", False,
          "content-addressed KV block sharing: requests whose prompt "
          "prefix is cached skip its prefill compute and block "
          "allocations (radix prefix caching)")
+_declare("PTPU_SERVE_SPEC_K", "int", 0,
+         "speculative decoding: draft tokens proposed per serving "
+         "decode step and verified in one batched target step "
+         "(0 = legacy one-token decode)")
 # -- concurrency analysis (docs/STATIC_ANALYSIS.md) -------------------------
 _declare("PTPU_LOCK_CHECK", "bool", False,
          "route the runtime's named lock sites through tracked "

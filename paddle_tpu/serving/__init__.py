@@ -9,9 +9,12 @@ block tables) for memory feasibility. The opt-in serving fast path adds
 chunked prefill (Sarathi-style mixed prompt-window/decode steps,
 ``prefill_chunk=`` / ``$PTPU_SERVE_PREFILL_CHUNK``) and radix prefix
 caching (content-addressed refcounted KV block sharing across requests,
-``prefix_cache=`` / ``$PTPU_SERVE_PREFIX_CACHE``). ``native_serve``
-remains the Python-free deployment backend for the same exported
-artifact directory.
+``prefix_cache=`` / ``$PTPU_SERVE_PREFIX_CACHE``) and speculative
+decoding (draft-k tokens — n-gram prompt lookup by default, or a
+pluggable draft model — verified in one batched target step,
+``spec_k=`` / ``$PTPU_SERVE_SPEC_K``). ``native_serve`` remains the
+Python-free deployment backend for the same exported artifact
+directory.
 
     from paddle_tpu import serving
     engine = serving.ServingEngine(serving.GenerationModel.random(cfg))
@@ -24,6 +27,7 @@ from .kv_cache import (KVBlockPool, blocks_needed,  # noqa: F401
                        prefix_chain_keys)
 from .loadgen import PoissonLoadGenerator  # noqa: F401
 from .model import (GenerationConfig, GenerationModel,  # noqa: F401
+                    ModelDrafter, NGramDrafter,
                     extract_decoder_weights, load_generation_artifact,
                     random_weights, reference_decode,
                     save_generation_artifact)
@@ -33,6 +37,7 @@ from .scheduler import (AdmissionError, GenerationRequest,  # noqa: F401
 __all__ = ["ServingEngine", "KVBlockPool", "blocks_needed",
            "prefix_chain_keys",
            "PoissonLoadGenerator", "GenerationConfig", "GenerationModel",
+           "ModelDrafter", "NGramDrafter",
            "extract_decoder_weights", "load_generation_artifact",
            "random_weights", "reference_decode",
            "save_generation_artifact", "AdmissionError",

@@ -17,7 +17,14 @@ or 4) bounds the lag, and EOS detection/streaming callbacks process the
 materialized tokens a few steps behind dispatch. Deterministic finishes
 (``max_new_tokens``, the sequence-length cap) are known at dispatch
 time, so the only cost of the lag is a handful of discarded
-speculative steps after an EOS.
+speculative steps after an EOS — whose tokens are never emitted
+(``record_token`` drops post-EOS outputs) and whose KV writes land in
+blocks the retiring sequence still owns until ``reap``. With
+speculative DECODING on (``spec_k`` below) the window collapses to one
+step: every verify window is materialized before the next is planned,
+so nothing is ever dispatched for a finished sequence, and rejected
+draft positions are rolled back — the contract
+``test_spec_no_post_eos_emission_and_kv_rolled_back`` pins.
 
 The serving fast path (docs/SERVING.md) is two opt-in legs, both OFF by
 default (the legacy engine is bitwise unchanged): **chunked prefill**
@@ -33,11 +40,25 @@ Prefix reuse assumes the weights that computed the cached KV state:
 hot-swapping a model's scope should be followed by
 ``pool.flush_prefix_cache()``.
 
+The third opt-in leg is **speculative decoding** (``spec_k`` /
+``$PTPU_SERVE_SPEC_K``, 0 = off and bitwise-legacy): when every row is
+past its prompt, the engine dispatches a VERIFY window — each row's
+last committed token plus up to ``spec_k`` tokens proposed by the
+``drafter`` (n-gram prompt lookup by default; any object with
+``propose(history, k)``, e.g. ``ModelDrafter``) — and the target's
+argmax at all ``k+1`` positions decides per-row acceptance in ONE
+step. Every window emits the accepted run plus a correction token
+(never fewer tokens per step than legacy); rejected positions roll
+back through ``KVBlockPool.truncate_owner``. Spec windows run
+synchronously (the acceptance result feeds the next window's drafts),
+trading the async-depth pipelining for multi-token steps.
+
 Telemetry (the autoscaling surface, docs/OBSERVABILITY.md):
 ``serving/{queue_depth,batch_occupancy,peak_batch_occupancy,
 kv_blocks_in_use,tokens_per_sec,request_latency(_p50/_p99),
 ttft(_p50/_p99),steps,prefill_tokens,decode_tokens,prefill_chunk_steps,
-prefix_blocks_reused,prefix_tokens_skipped,requests_submitted,
+prefix_blocks_reused,prefix_tokens_skipped,spec_steps,spec_proposed,
+spec_accepted,spec_rejected,spec_accept_rate,requests_submitted,
 requests_completed,requests_rejected,requests_failed}``.
 """
 
@@ -73,7 +94,9 @@ class _ModelWorker:
     def __init__(self, name, model, max_batch, max_seq_len, block_size,
                  num_blocks, max_queue, async_depth, engine,
                  prefill_chunk=0, prefix_cache=False,
-                 prefill_token_budget=None):
+                 prefill_token_budget=None, spec_k=0, drafter=None):
+        from .model import NGramDrafter
+
         self.name = name
         self.model = model
         self.engine = engine
@@ -95,13 +118,25 @@ class _ModelWorker:
         self.prefix_cache = bool(prefix_cache)
         if self.prefill_chunk and prefill_token_budget is None:
             prefill_token_budget = 4 * self.prefill_chunk
+        # speculative decoding: the verify window is a compiled shape,
+        # clamped so a full window always fits the context
+        self.spec_k = max(0, min(int(spec_k or 0), max_seq_len - 1))
+        if self.spec_k and drafter is None:
+            drafter = NGramDrafter()
+        if drafter is not None and not callable(
+                getattr(drafter, "propose", None)):
+            raise TypeError(
+                "drafter %r has no propose(history, k) method"
+                % (type(drafter).__name__,))
+        self.drafter = drafter if self.spec_k else None
         self.scheduler = StepScheduler(
             max_batch, self.pool, max_seq_len,
             prefill_chunk=self.prefill_chunk,
             prefix_cache=self.prefix_cache,
             prefill_token_budget=(prefill_token_budget
                                   if self.prefill_chunk else None),
-            cache_namespace=name)
+            cache_namespace=name, spec_k=self.spec_k,
+            drafter=self.drafter)
         self.queue = RequestQueue(max_queue)
         self.max_batch = int(max_batch)
         # bounded in-flight step lag (the PR-2 InflightWindow contract,
@@ -128,6 +163,13 @@ class _ModelWorker:
                                     self.scheduler.max_blocks_per_seq,
                                     self.prefill_chunk)
             if self.prefill_chunk else None)
+        # the speculative verify window (third compiled shape; jit is
+        # lazy, so geometry that never speculates still traces nothing)
+        self._spec_step = (
+            model.make_spec_step(self.max_batch,
+                                 self.scheduler.max_blocks_per_seq,
+                                 self.spec_k + 1)
+            if self.spec_k else None)
         import jax.numpy as jnp
 
         self._prev_tokens = jnp.zeros((self.max_batch,), jnp.int32)
@@ -140,6 +182,7 @@ class _ModelWorker:
         self._closing = False
         self.error = None
         self._gen_tokens = 0
+        self._steps_dispatched = 0  # host-side (live with metrics off)
         self._t_first_step = None
         self._t_last_step = None
         # bounded window for the p50/p99 gauges: a long-lived engine
@@ -210,23 +253,35 @@ class _ModelWorker:
 
     def _tick(self):
         """One scheduler round: admit at the boundary, dispatch one
-        fixed-shape step (the mixed chunk shape whenever a row is
-        mid-prompt under the chunked fast path), lag-process
+        fixed-shape step (the speculative verify window when every row
+        is past its prompt, else the mixed chunk shape whenever a row
+        is mid-prompt under the chunked fast path), lag-process
         materialized tokens, retire."""
         sched = self.scheduler
         sched.admit(self.queue)
         _metrics.gauge("serving/queue_depth").set(len(self.queue))
-        if self.prefill_chunk:
-            plan, chunked = sched.plan_chunk()
+        spec_plan = sched.plan_spec() if self.spec_k else None
+        if spec_plan:
+            # verify window: dispatched AND materialized in one round
+            # (acceptance feeds the next window's drafts)
+            self._dispatch_spec(spec_plan)
         else:
-            plan, chunked = sched.plan_step(), False
-        if plan:
-            self._dispatch(plan, chunked)
-            if len(self._inflight) > self.async_depth - 1:
+            if self.prefill_chunk:
+                plan, chunked = sched.plan_chunk()
+            else:
+                plan, chunked = sched.plan_step(), False
+            if plan:
+                self._dispatch(plan, chunked)
+                if self.spec_k:
+                    # spec mode is synchronous everywhere: the next
+                    # plan (a verify window) reads committed history
+                    while self._inflight:
+                        self._process_oldest()
+                elif len(self._inflight) > self.async_depth - 1:
+                    self._process_oldest()
+            elif self._inflight:
+                # nothing left to dispatch — drain the pipeline
                 self._process_oldest()
-        elif self._inflight:
-            # nothing left to dispatch — drain the pipeline
-            self._process_oldest()
         sched.reap()
         _metrics.gauge("serving/kv_blocks_in_use").set(
             self.pool.blocks_in_use)
@@ -257,6 +312,17 @@ class _ModelWorker:
                 % (self.name, len(self._inflight), self.async_depth),
                 locks=("serving.engine.cv",),
                 detail=(self.name, "inflight"))
+        if self.spec_k and self._inflight:
+            # the spec contract: every window materializes before the
+            # next plan — a step left in flight would let a post-EOS
+            # window dispatch (docs/SERVING.md)
+            _conc.record_violation(
+                "engine-invariant",
+                "model %r: %d steps in flight with spec_k=%d (spec "
+                "windows must run synchronously)"
+                % (self.name, len(self._inflight), self.spec_k),
+                locks=("serving.engine.cv",),
+                detail=(self.name, "spec-inflight"))
         if len(self.queue) > self.queue.max_queue:
             _conc.record_violation(
                 "engine-invariant",
@@ -296,6 +362,7 @@ class _ModelWorker:
         self._prev_tokens = next_tokens
         self._inflight.append((next_tokens, plan))
         _metrics.gauge("serving/inflight_steps").set(len(self._inflight))
+        self._steps_dispatched += 1
         now = time.perf_counter()
         if self._t_first_step is None:
             self._t_first_step = now
@@ -319,6 +386,62 @@ class _ModelWorker:
                 reg.counter("serving/prefill_tokens").inc(n_prefill)
                 reg.counter("serving/decode_tokens").inc(
                     len(plan) - n_prefill)
+
+    def _dispatch_spec(self, plan):
+        """Dispatch one speculative verify window and fold it back
+        immediately: per-row acceptance (and the next window's drafts)
+        depend on the materialized tokens, so spec steps run
+        synchronously — the tokens-per-step win replaces the
+        async-depth pipelining (docs/SERVING.md)."""
+        import jax.numpy as jnp
+
+        sched = self.scheduler
+        occupancy = int(sched.active.sum())
+        with _tracing.span("serving_spec_step", model=self.name,
+                           occupancy=occupancy):
+            weights = {n: self.scope.get(n) for n in self._weight_names}
+            self.pool.k, self.pool.v, out = self._spec_step(
+                weights, self.pool.k, self.pool.v,
+                sched.spec_feed.copy(), sched.use_prompt.copy(),
+                self._prev_tokens, sched.positions.copy(),
+                sched.spec_lens.copy(), sched.block_tables.copy(),
+                sched.active.copy())
+        outs = np.asarray(out)  # materialize NOW (the sync contract)
+        self._steps_dispatched += 1
+        now = time.perf_counter()
+        if self._t_first_step is None:
+            self._t_first_step = now
+        self._t_last_step = now
+        n_emitted = 0
+        # decode rows that later ride a mixed prefill step chain their
+        # input from prev_tokens — re-point each spec row's entry at
+        # its last COMMITTED token (the [B, W] window output replaced
+        # the [B] chain this vector used to carry)
+        prev = np.asarray(self._prev_tokens).copy()
+        for seq, window in plan:
+            was_done = seq.request.finished
+            n_emitted += sched.record_spec(seq, window, outs[seq.slot])
+            if seq.request.tokens:
+                prev[seq.slot] = seq.request.tokens[-1]
+            if seq.request.finished and not was_done:
+                self._note_completion(seq.request)
+        self._prev_tokens = jnp.asarray(prev)
+        self._gen_tokens += n_emitted
+        if (self._t_first_step is not None
+                and self._t_last_step > self._t_first_step):
+            _metrics.gauge("serving/tokens_per_sec").set(
+                self._gen_tokens
+                / (self._t_last_step - self._t_first_step))
+        if _metrics.enabled():
+            reg = _metrics.registry()
+            reg.counter("serving/steps").inc()
+            reg.gauge("serving/batch_occupancy").set(occupancy)
+            peak = reg.gauge("serving/peak_batch_occupancy")
+            if occupancy > peak.value:
+                peak.set(occupancy)
+            reg.counter("serving/decode_tokens").inc(n_emitted)
+            reg.gauge("serving/spec_accept_rate").set(
+                sched.spec_accepted / max(1, sched.spec_proposed))
 
     def _process_oldest(self):
         handle, plan = self._inflight.pop(0)
@@ -388,7 +511,7 @@ class ServingEngine:
     def __init__(self, models, max_batch=8, max_seq_len=256,
                  block_size=16, num_blocks=None, max_queue=64,
                  async_depth=None, prefill_chunk=None, prefix_cache=None,
-                 prefill_token_budget=None):
+                 prefill_token_budget=None, spec_k=None, drafter=None):
         from ..flags import env as _env
 
         if async_depth is None:
@@ -397,6 +520,8 @@ class ServingEngine:
             prefill_chunk = _env("PTPU_SERVE_PREFILL_CHUNK")
         if prefix_cache is None:
             prefix_cache = bool(_env("PTPU_SERVE_PREFIX_CACHE"))
+        if spec_k is None:
+            spec_k = _env("PTPU_SERVE_SPEC_K")
         if not isinstance(models, dict):
             models = {"default": models}
         if not models:
@@ -415,7 +540,8 @@ class ServingEngine:
                 num_blocks=num_blocks, max_queue=max_queue,
                 async_depth=async_depth, engine=self,
                 prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
-                prefill_token_budget=prefill_token_budget)
+                prefill_token_budget=prefill_token_budget,
+                spec_k=spec_k, drafter=drafter)
         self._default = next(iter(self._workers))
         self._closed = False
 
@@ -462,15 +588,25 @@ class ServingEngine:
     def stats(self):
         out = {}
         for name, w in self._workers.items():
+            sched = w.scheduler
             out[name] = {
                 "queue_depth": len(w.queue),
-                "batch_occupancy": w.scheduler.num_occupied,
+                "batch_occupancy": sched.num_occupied,
                 "generated_tokens": w._gen_tokens,
+                "steps": w._steps_dispatched,
                 "prefill_chunk": w.prefill_chunk,
                 "prefix_cache": w.prefix_cache,
-                "prefix_blocks_reused": w.scheduler.prefix_blocks_reused,
-                "prefix_tokens_skipped":
-                    w.scheduler.prefix_tokens_skipped,
+                "prefix_blocks_reused": sched.prefix_blocks_reused,
+                "prefix_tokens_skipped": sched.prefix_tokens_skipped,
+                "spec_k": w.spec_k,
+                "spec_steps": sched.spec_steps,
+                "spec_proposed": sched.spec_proposed,
+                "spec_accepted": sched.spec_accepted,
+                "spec_emitted": sched.spec_emitted,
+                "spec_blocks_rolled_back":
+                    sched.spec_blocks_rolled_back,
+                "spec_accept_rate": (sched.spec_accepted
+                                     / max(1, sched.spec_proposed)),
                 **w.pool.stats(),
             }
         return out

@@ -30,7 +30,12 @@ Allocation is host-side and two-phase:
     from the reservation made at admit time.
 
 ``free_owner`` returns a retired sequence's blocks and releases any
-unused remainder of its reservation.
+unused remainder of its reservation. ``truncate_owner`` is the
+speculative-decoding **rollback** path (docs/SERVING.md): rejected
+draft positions wrote KV into over-allocated tail blocks, and
+truncation hands them back while growing the owner's reservation by
+the same count — the exact inverse of ``alloc_block``, so the
+two-phase invariant survives rewinds.
 
 Prefix caching (docs/SERVING.md) makes the pool *content-addressed*:
 
@@ -141,6 +146,11 @@ class KVBlockPool:
         self._free = list(range(self.num_blocks, 0, -1))
         self._reserved = {}      # owner -> blocks still reservable
         self._owned = {}         # owner -> [block ids], table order
+        # owner -> reserved + owned ceiling, fixed at reserve() time:
+        # alloc_block moves one unit reserved->owned, truncate_owner
+        # moves it back, so the sum is invariant until free_owner —
+        # check_invariants pins it (the rollback accounting audit)
+        self._reserve_ceiling = {}
         # -- content-addressed prefix state -----------------------------
         self._refs = {}          # bid -> refcount (>= 1 while in a table)
         self._sealed = {}        # content key -> bid
@@ -234,6 +244,7 @@ class KVBlockPool:
                 self._refs[bid] = r + 1
             self._reserved[owner] = need
             self._owned[owner] = list(matched)
+            self._reserve_ceiling[owner] = need + len(matched)
             return True
 
     def alloc_block(self, owner):
@@ -275,6 +286,7 @@ class KVBlockPool:
         with self._lock:
             blocks = self._owned.pop(owner, [])
             self._reserved.pop(owner, None)
+            self._reserve_ceiling.pop(owner, None)
             for bid in reversed(blocks):
                 r = self._refs.get(bid, 0) - 1
                 if r > 0:
@@ -288,6 +300,55 @@ class KVBlockPool:
                 else:
                     self._free.append(bid)
             return len(blocks)
+
+    def truncate_owner(self, owner, n_keep):
+        """Rewind ``owner``'s block table to its first ``n_keep``
+        entries — the KV **rollback** path of speculative decoding
+        (docs/SERVING.md): positions written for rejected draft tokens
+        live in over-allocated tail blocks, and this returns them.
+
+        Each dropped block leaves the table, clears its refcount, and
+        goes back to the free list while the owner's RESERVATION grows
+        back by one — the exact inverse of ``alloc_block``, so the
+        two-phase no-deadlock invariant is preserved and the rewound
+        sequence re-crosses the same block boundaries without needing
+        a new reservation. Only unshared (refcount 1), unsealed tail
+        blocks may be truncated; a sealed or adopted prefix block can
+        never sit past a rollback point (the scheduler only rewinds
+        decode-phase positions), so hitting one raises rather than
+        corrupting the content index. Returns the dropped block ids in
+        table order."""
+        n_keep = int(n_keep)
+        if n_keep < 0:
+            raise ValueError("n_keep must be >= 0, got %d" % n_keep)
+        with self._lock:
+            blocks = self._owned.get(owner)
+            if blocks is None:
+                raise KeyError("owner %r holds no block table" % (owner,))
+            if n_keep >= len(blocks):
+                return []
+            dropped = blocks[n_keep:]
+            for bid in dropped:
+                if self._refs.get(bid, 0) != 1:
+                    raise RuntimeError(
+                        "refusing to truncate block %d with refcount %d "
+                        "— shared blocks are never rolled back"
+                        % (bid, self._refs.get(bid, 0)))
+                if bid in self._block_key:
+                    raise RuntimeError(
+                        "refusing to truncate sealed block %d (key %s..)"
+                        " — cached prefix blocks are never rolled back"
+                        % (bid, self._block_key[bid][:8]))
+            del blocks[n_keep:]
+            # reversed: the shallowest dropped block lands last on the
+            # LIFO free list, so re-crossing the same boundary hands
+            # the SAME (cache-warm) block back first
+            for bid in reversed(dropped):
+                del self._refs[bid]
+                self._free.append(bid)
+            self._reserved[owner] = (self._reserved.get(owner, 0)
+                                     + len(dropped))
+            return list(dropped)
 
     # -- runtime invariants (docs/STATIC_ANALYSIS.md, PTPU_LOCK_CHECK) -
     def check_invariants(self):
@@ -307,6 +368,12 @@ class KVBlockPool:
             cached blocks are exactly the refcount-zero sealed ones,
             the null block never circulates, and no block id appears
             twice across free/cached/tables
+          * rollback accounting (speculative decoding's truncate path):
+            every owner's ``reserved + owned`` still equals the ceiling
+            fixed at ``reserve()`` time (``alloc_block`` moves a unit
+            one way, ``truncate_owner`` moves it back), and no
+            free-list block retains a content-index entry (a truncated
+            or flushed block must leave the index)
         """
         problems = []
         with self._lock:
@@ -317,6 +384,7 @@ class KVBlockPool:
             owned = {o: list(b) for o, b in self._owned.items()}
             sealed = dict(self._sealed)
             block_key = dict(self._block_key)
+            ceilings = dict(self._reserve_ceiling)
         n_free, n_cached, n_tab = len(free), len(cached), len(refs)
         if n_free + n_cached + n_tab != self.num_blocks:
             problems.append(
@@ -373,6 +441,26 @@ class KVBlockPool:
                 if bid in seen:
                     problems.append("block %d in a table but also on "
                                     "the %s list" % (bid, seen[bid]))
+        # rollback accounting: reserve()'s ceiling is conserved across
+        # alloc_block/truncate_owner round trips
+        for owner, blocks in owned.items():
+            ceiling = ceilings.get(owner)
+            have = reserved.get(owner, 0) + len(blocks)
+            if ceiling is None:
+                problems.append("owner %r holds a table but no "
+                                "reservation ceiling" % (owner,))
+            elif have != ceiling:
+                problems.append(
+                    "owner %r reserved %d + owned %d != reservation "
+                    "ceiling %d (truncate/alloc accounting drift)"
+                    % (owner, reserved.get(owner, 0), len(blocks),
+                       ceiling))
+        for bid in free:
+            if bid in block_key:
+                problems.append(
+                    "free-list block %d still carries content-index "
+                    "key %s.. (truncated/flushed blocks must leave "
+                    "the index)" % (bid, block_key[bid][:8]))
         return problems
 
     # -- content index (radix prefix caching) --------------------------

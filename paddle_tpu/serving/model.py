@@ -39,14 +39,24 @@ window — prefill rows consume up to ``chunk`` prompt tokens per call
 rows ride the same step as 1-token windows chaining ``prev_tokens`` on
 device. Each engine geometry compiles exactly TWO step shapes: this one
 and the one-token decode step.
+
+``make_spec_step`` is the speculative-decoding **verify window**
+(docs/SERVING.md): the same ``[max_batch, window]`` chunk shape, except
+the target's greedy token comes back at EVERY window slot, so feeding
+``[t0, d1..dk]`` (a row's last committed token plus ``k`` drafted
+continuations) verifies all ``k`` drafts in one step. The matching
+draft sources live here too: :class:`NGramDrafter` (prompt-lookup
+drafting over the sequence's own prompt+output history — zero extra
+weights) and :class:`ModelDrafter` (the pluggable draft-model hook
+reusing :class:`GenerationModel`).
 """
 
 import math
 
 import numpy as np
 
-__all__ = ["GenerationConfig", "GenerationModel",
-           "extract_decoder_weights", "random_weights",
+__all__ = ["GenerationConfig", "GenerationModel", "ModelDrafter",
+           "NGramDrafter", "extract_decoder_weights", "random_weights",
            "reference_decode", "save_generation_artifact",
            "load_generation_artifact"]
 
@@ -517,10 +527,13 @@ class GenerationModel:
         return jitted
 
     def _forward_chunk(self, jnp, weights, x, pos2d, lengths,
-                       block_tables, active, kv_k, kv_v):
+                       block_tables, active, kv_k, kv_v,
+                       all_slots=False):
         """A ``[B, C]`` token window through all layers. x: [B, C, D];
         returns (kv_k, kv_v, logits[B, V]) — each row's logits at its
-        LAST valid window slot (``lengths - 1``)."""
+        LAST valid window slot (``lengths - 1``) — or, with
+        ``all_slots=True`` (the speculative verify window), the logits
+        at EVERY window slot: (kv_k, kv_v, logits[B, C, V])."""
         import jax
 
         cfg = self.config
@@ -582,6 +595,9 @@ class GenerationModel:
             x = x + f @ self._w(jnp, weights, p + "wff2") \
                 + weights[p + "bff2"]
 
+        if all_slots:
+            x = ln(x, weights["final_ln_scale"], weights["final_ln_bias"])
+            return kv_k, kv_v, x @ self._w(jnp, weights, "lm_head")
         last = jnp.clip(lengths - 1, 0, C - 1).astype(jnp.int32)
         x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
         x_last = ln(x_last, weights["final_ln_scale"],
@@ -607,8 +623,21 @@ class GenerationModel:
         the greedy token at the row's last valid slot — meaningful when
         the window consumed the final prompt token (the first generated
         token) or for decode rows. The KV arrays are donated."""
-        key = ("chunk", int(max_batch), int(max_blocks_per_seq),
-               int(chunk), bool(return_logits))
+        return self._make_window_step("chunk", max_batch,
+                                      max_blocks_per_seq, chunk,
+                                      all_slots=False,
+                                      return_logits=return_logits)
+
+    def _make_window_step(self, kind, max_batch, max_blocks_per_seq,
+                          window, all_slots, return_logits):
+        """The shared ``[max_batch, window]`` jitted step builder behind
+        :meth:`make_prefill_step` (``all_slots=False`` — logits at each
+        row's last valid slot) and :meth:`make_spec_step`
+        (``all_slots=True`` — the verify window, argmax at every slot).
+        One body, so the token-splice/embedding/position plumbing can
+        never diverge between the two shapes."""
+        key = (kind, int(max_batch), int(max_blocks_per_seq),
+               int(window), bool(return_logits))
         if key in self._steps:
             return self._steps[key]
         import jax
@@ -617,13 +646,14 @@ class GenerationModel:
         cfg = self.config
         pe = jnp.asarray(_position_encoding_table(cfg))
         emb_scale = float(cfg.d_model) ** 0.5
-        C = int(chunk)
+        C = int(window)
 
-        def step(weights, kv_k, kv_v, chunk_tokens, use_prompt,
+        def step(weights, kv_k, kv_v, window_tokens, use_prompt,
                  prev_tokens, positions, lengths, block_tables, active):
             self.trace_count += 1
-            tok0 = jnp.where(use_prompt, chunk_tokens[:, 0], prev_tokens)
-            tok = jnp.concatenate([tok0[:, None], chunk_tokens[:, 1:]],
+            tok0 = jnp.where(use_prompt, window_tokens[:, 0],
+                             prev_tokens)
+            tok = jnp.concatenate([tok0[:, None], window_tokens[:, 1:]],
                                   axis=1)
             tok = jnp.clip(tok, 0, cfg.vocab_size - 1)
             pos2d = (positions[:, None]
@@ -637,7 +667,7 @@ class GenerationModel:
                  + cfg.pe_beta * jnp.take(pe, pe_idx, axis=0))
             kv_k, kv_v, logits = self._forward_chunk(
                 jnp, weights, x, pos2d, lengths, block_tables, active,
-                kv_k, kv_v)
+                kv_k, kv_v, all_slots=all_slots)
             next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             if return_logits:
                 return kv_k, kv_v, next_tokens, logits
@@ -646,6 +676,119 @@ class GenerationModel:
         jitted = jax.jit(step, donate_argnums=(1, 2))
         self._steps[key] = jitted
         return jitted
+
+    def make_spec_step(self, max_batch, max_blocks_per_seq, window,
+                       return_logits=False):
+        """Build (and cache) the jitted speculative **verify window**
+        for this engine geometry (docs/SERVING.md): the
+        ``[max_batch, window]`` chunk shape of :meth:`make_prefill_step`
+        except that the target's greedy token is returned at EVERY
+        window slot instead of only the last one:
+
+            step(weights, kv_k, kv_v, window_tokens[B, W],
+                 use_prompt[B], prev_tokens[B], positions[B],
+                 lengths[B], block_tables[B, Mb], active[B])
+              -> (kv_k', kv_v', next_tokens[B, W])
+
+        ``next_tokens[b, j]`` is the argmax AFTER window slot ``j`` —
+        the token the target would emit at position
+        ``positions[b] + j + 1``. A row feeding ``[t0, d1..dk]`` (its
+        last committed token plus ``k`` draft tokens) therefore
+        verifies every draft in one step: acceptance is the longest
+        prefix with ``d[j+1] == next_tokens[b, j]``, and
+        ``next_tokens[b, m]`` after the last accepted draft is the
+        correction token — computed over an all-verified context, so
+        every window emits at least one sequential-greedy-identical
+        token. Slots at or past ``lengths[b]`` write to the null block
+        and their outputs are meaningless. The KV arrays are donated."""
+        return self._make_window_step("spec", max_batch,
+                                      max_blocks_per_seq, window,
+                                      all_slots=True,
+                                      return_logits=return_logits)
+
+
+# ---------------------------------------------------------------------------
+# draft sources for speculative decoding (docs/SERVING.md)
+# ---------------------------------------------------------------------------
+
+
+class NGramDrafter:
+    """Prompt-lookup / n-gram drafting (zero extra weights): match the
+    sequence's most recent suffix n-gram against earlier occurrences in
+    its OWN prompt+output history and propose the tokens that followed
+    the most recent earlier match. Strongest exactly where the radix
+    prefix cache already wins — templated, repetitive and structured
+    generation (code, JSON, quoting the prompt back) — and free
+    everywhere else: a miss proposes nothing and the verify window
+    degrades to a plain one-token decode step.
+
+    ``propose(history, k)`` tries match lengths from ``max_ngram`` down
+    to ``min_ngram`` and returns up to ``k`` continuation tokens (empty
+    when no n-gram recurs)."""
+
+    def __init__(self, max_ngram=3, min_ngram=1):
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+        if self.min_ngram < 1:
+            raise ValueError("min_ngram must be >= 1")
+        if self.max_ngram < self.min_ngram:
+            raise ValueError("max_ngram must be >= min_ngram")
+
+    def propose(self, history, k):
+        k = int(k)
+        if k < 1 or len(history) < self.min_ngram + 1:
+            return []
+        hist = [int(t) for t in history]
+        L = len(hist)
+        for n in range(min(self.max_ngram, L - 1),
+                       self.min_ngram - 1, -1):
+            suffix = hist[L - n:]
+            # the most recent earlier occurrence able to supply a FULL
+            # k-token continuation wins (recency beats frequency for
+            # local repetition, but a match right at the history's end
+            # can only offer a truncated draft — on a period-p
+            # repetition the nearest match yields only p tokens, so
+            # scan on for an earlier full-window one); the match must
+            # end before the suffix starts so the continuation is real
+            best = None
+            for j in range(L - n - 1, -1, -1):
+                if hist[j:j + n] != suffix:
+                    continue
+                avail = min(k, L - (j + n))
+                if best is None or avail > best[1]:
+                    best = (j, avail)
+                if avail >= k:
+                    break
+            if best is not None:
+                start = best[0] + n
+                return hist[start:start + k]
+        return []
+
+
+class ModelDrafter:
+    """The pluggable draft-model hook: greedy-decode up to ``k``
+    continuation tokens from a (smaller) :class:`GenerationModel` over
+    the sequence's committed history. This reference implementation
+    runs the unbatched ``reference_decode`` oracle — exact but
+    host-side, i.e. a correctness/integration hook for wiring a real
+    jitted small-model drafter, not a production fast path. Drafting
+    with the TARGET model itself yields perfect acceptance (every
+    window emits its full length), which is what the tests pin."""
+
+    def __init__(self, model):
+        if not isinstance(model, GenerationModel):
+            raise TypeError("ModelDrafter needs a GenerationModel, got "
+                            "%r" % (type(model).__name__,))
+        self.model = model
+
+    def propose(self, history, k):
+        k = int(k)
+        hist = [int(t) for t in history]
+        if k < 1 or not hist:
+            return []
+        if len(hist) >= self.model.config.max_seq_len:
+            return []
+        return reference_decode(self.model, hist, k)
 
 
 # ---------------------------------------------------------------------------

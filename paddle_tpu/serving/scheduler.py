@@ -47,6 +47,18 @@ PR-6 behavior:
     request skips both the prefill compute and the block allocations
     for it. As a sequence's own prefill crosses each full-prompt-block
     boundary the block is sealed into the index for later requests.
+
+A third opt-in mode, **speculative decoding** (``spec_k=K`` /
+``$PTPU_SERVE_SPEC_K``, docs/SERVING.md), changes what a decode step
+emits: when every occupied row is past its prompt, ``plan_spec`` plans
+a VERIFY window — each row feeds its last committed token plus up to
+``K`` continuations proposed by a ``drafter`` (n-gram prompt lookup by
+default) — and ``record_spec`` folds the materialized window back:
+per-row acceptance is the longest prefix where draft == the target's
+argmax, the accepted run plus the target's correction token are
+emitted (>= 1 token per window, so speculation is never slower in
+steps than legacy), and the KV blocks past the rewound position are
+returned through ``KVBlockPool.truncate_owner`` (rollback).
 """
 
 import itertools
@@ -194,7 +206,7 @@ class StepScheduler:
 
     def __init__(self, max_batch, pool, max_seq_len, prefill_chunk=0,
                  prefix_cache=False, prefill_token_budget=None,
-                 cache_namespace=""):
+                 cache_namespace="", spec_k=0, drafter=None):
         import numpy as np
 
         self.max_batch = int(max_batch)
@@ -225,6 +237,20 @@ class StepScheduler:
             self.chunk_feed = np.zeros(
                 (self.max_batch, self.prefill_chunk), np.int32)
             self.chunk_lens = np.zeros(self.max_batch, np.int32)
+        # -- speculative decoding (docs/SERVING.md; OFF = exact legacy)
+        self.spec_k = max(0, int(spec_k or 0))
+        self.drafter = drafter
+        # host-side spec telemetry (live even with metrics disabled —
+        # engine.stats()/bench read these)
+        self.spec_steps = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_emitted = 0
+        self.spec_blocks_rolled_back = 0
+        if self.spec_k:
+            self.spec_feed = np.zeros(
+                (self.max_batch, self.spec_k + 1), np.int32)
+            self.spec_lens = np.zeros(self.max_batch, np.int32)
 
     # -- occupancy ------------------------------------------------------
     @property
@@ -421,6 +447,140 @@ class StepScheduler:
             if seq.prefix_keys:
                 self._seal_ready(slot, seq)
         return plan, True
+
+    def plan_spec(self):
+        """Speculative verify-window planning (docs/SERVING.md).
+
+        Applies only when every occupied row is past its prompt with no
+        step still in flight — the engine materializes every window
+        before planning the next, because both acceptance and the next
+        window's drafts read the committed token history — and returns
+        ``None`` otherwise so the engine falls back to the
+        prefill/decode plan. When it applies, fills the
+        ``spec_feed``/``spec_lens`` window arrays: each dispatching row
+        feeds its last committed token plus up to ``spec_k`` drafted
+        continuations (clamped so no window can overshoot
+        ``max_new_tokens`` or the sequence cap — the admission-time
+        reservation therefore always covers the window's block
+        allocations) and returns the spec plan, a list of
+        ``(seq, window_tokens)`` rows."""
+        if not self.spec_k:
+            return None
+        for seq in self.slots:
+            if seq is None:
+                continue
+            if seq.pending or (not seq.dispatch_done and seq.in_prefill):
+                return None
+        bs = self.pool.block_size
+        plan = []
+        for slot, seq in enumerate(self.slots):
+            if seq is None or seq.dispatch_done:
+                self.active[slot] = False
+                self.use_prompt[slot] = False
+                self.spec_lens[slot] = 0
+                continue
+            request = seq.request
+            pos = seq.pos
+            history = request.prompt + request.tokens
+            if pos != len(history) - 1:
+                raise RuntimeError(
+                    "spec window planned at pos %d but the committed "
+                    "history holds %d tokens — a step result was lost"
+                    % (pos, len(history)))
+            # every emitted token consumes one max_new slot and one
+            # sequence position; >= 1 here (else dispatch_done already)
+            limit = min(self.spec_k + 1,
+                        request.max_new_tokens - len(request.tokens),
+                        self.max_seq_len - pos)
+            drafts = []
+            if limit > 1 and self.drafter is not None:
+                drafts = [int(t) for t in
+                          self.drafter.propose(history, limit - 1)]
+                drafts = drafts[:limit - 1]
+            window = [history[-1]] + drafts
+            # lazy block allocation for EVERY boundary the window
+            # crosses (drawn from the admission-time reservation; the
+            # window clamp above keeps it within the worst case)
+            for p in range(pos, pos + len(window)):
+                if p % bs == 0:
+                    bid = self.pool.alloc_block(seq)
+                    self.block_tables[slot, p // bs] = bid
+            self.spec_feed[slot, :len(window)] = window
+            self.spec_lens[slot] = len(window)
+            self.positions[slot] = pos
+            self.use_prompt[slot] = True
+            self.active[slot] = True
+            seq.pending += 1
+            plan.append((seq, window))
+        if plan:
+            self.spec_steps += 1
+            _metrics.counter("serving/spec_steps").inc()
+        return plan
+
+    def record_spec(self, seq, window, outs):
+        """Fold one materialized verify window back into its sequence:
+        acceptance is the longest prefix where draft == the target's
+        argmax at the previous slot; the accepted run plus the target's
+        correction token are emitted in order (>= 1 token per window,
+        truncated at EOS / ``max_new_tokens`` / the sequence cap — no
+        post-EOS token is ever emitted), then the sequence rewinds to
+        its first unverified position and the over-allocated KV blocks
+        go back through ``KVBlockPool.truncate_owner`` (rollback).
+        Returns the number of tokens emitted."""
+        seq.pending -= 1
+        request = seq.request
+        if seq.finished:
+            return 0
+        drafts = [int(t) for t in window[1:]]
+        m = 0
+        while m < len(drafts) and drafts[m] == int(outs[m]):
+            m += 1
+        emitted = drafts[:m] + [int(outs[m])]
+        self.spec_proposed += len(drafts)
+        self.spec_accepted += m
+        _metrics.counter("serving/spec_proposed").inc(len(drafts))
+        _metrics.counter("serving/spec_accepted").inc(m)
+        _metrics.counter("serving/spec_rejected").inc(len(drafts) - m)
+        pos = seq.pos
+        n_emit = 0
+        for tok in emitted:
+            request.tokens.append(tok)
+            n_emit += 1
+            if request.first_token_time is None:
+                request.first_token_time = time.perf_counter()
+            hit_eos = (request.eos_id is not None
+                       and tok == request.eos_id)
+            final = (hit_eos
+                     or len(request.tokens) >= request.max_new_tokens
+                     or pos + n_emit >= self.max_seq_len)
+            if request.stream is not None:
+                try:
+                    request.stream(request, tok, bool(final))
+                except Exception:
+                    pass  # a streaming consumer must not kill the engine
+            if final:
+                # EOS inside an accepted run: the remaining accepted
+                # drafts and the correction token are DISCARDED here,
+                # never emitted; their KV writes are rolled back below
+                seq.finished = True
+                seq.dispatch_done = True
+                request._finish()
+                break
+        seq.pos = pos + n_emit
+        seq.n_dispatched = len(request.tokens)
+        if (len(request.tokens) >= request.max_new_tokens
+                or seq.pos >= self.max_seq_len):
+            seq.dispatch_done = True
+        # KV rollback: blocks past the last verified/committed position
+        # return to the pool (and the table re-points at the null block)
+        keep = blocks_needed(seq.pos, self.pool.block_size)
+        dropped = self.pool.truncate_owner(seq, keep)
+        if dropped:
+            self.spec_blocks_rolled_back += len(dropped)
+            self.block_tables[seq.slot, keep:keep + len(dropped)] = \
+                self.pool.NULL_BLOCK
+        self.spec_emitted += n_emit
+        return n_emit
 
     # -- lagged result processing --------------------------------------
     def record_token(self, seq, gen_idx, token):

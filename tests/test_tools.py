@@ -178,6 +178,39 @@ def test_ptpu_lint_rules_fire(tmp_path):
                                "") == []
 
 
+def test_ptpu_lint_flag_undocumented_fires():
+    """ISSUE 13 satellite: the registry-side `flag-undocumented` rule —
+    a declared PTPU_* name absent from the docs corpus is a finding
+    (anchored at flags.py), a documented one is not, and the REAL
+    registry/docs pair is clean (the CI lint gate covers it via
+    test_ptpu_lint_clean_on_repo)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import ptpu_lint
+    finally:
+        sys.path.pop(0)
+    findings = ptpu_lint.flag_doc_findings(
+        flag_names={"PTPU_METRICS", "PTPU_SURELY_NOT_IN_ANY_DOC"},
+        corpus="PTPU_METRICS turns on the metrics registry.")
+    assert [f.rule for f in findings] == ["flag-undocumented"], findings
+    assert "PTPU_SURELY_NOT_IN_ANY_DOC" in findings[0].message
+    assert findings[0].path.endswith("flags.py")
+    # word-boundary matching: a longer flag's mention must not vouch
+    # for a flag whose name is its prefix
+    shadowed = ptpu_lint.flag_doc_findings(
+        flag_names={"PTPU_QUANT"},
+        corpus="only PTPU_QUANT_MODE is documented here")
+    assert [f.rule for f in shadowed] == ["flag-undocumented"], shadowed
+    # a real declared flag anchors at its declaration line
+    real = ptpu_lint.flag_doc_findings(flag_names={"PTPU_METRICS"},
+                                       corpus="")
+    assert len(real) == 1 and real[0].line > 0
+    # the repo itself is clean: every registered flag is documented
+    assert ptpu_lint.flag_doc_findings() == []
+    # the rule is advertised
+    assert "flag-undocumented" in ptpu_lint.RULES
+
+
 def test_ptpu_lint_concurrency_rules_fire(tmp_path):
     """ISSUE 12: each of the four concurrency lint rules fires on a
     fixture, and the safe idioms (with-block, while-wait, wait_for,

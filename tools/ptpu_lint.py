@@ -26,6 +26,13 @@ Rules:
                histogram()` must appear in docs/OBSERVABILITY.md — the
                registry's exposition tables are the contract dashboards
                are built against
+  flag-undocumented
+               every `PTPU_*` flag declared in the paddle_tpu.flags
+               registry must appear somewhere under docs/ (or the
+               README) — a flag nobody can discover is a flag nobody
+               can audit; the registry docstring alone is not
+               documentation (mirrors metric-undocumented, but checked
+               registry-side rather than call-site)
 
 Concurrency rules (docs/STATIC_ANALYSIS.md "Concurrency analysis" —
 receivers are judged by NAME: `lock`/`mu`/`mutex` and `*_lock`-style
@@ -88,6 +95,8 @@ RULES = {
                      "program-build time",
     "metric-undocumented": "metric name literals must appear in "
                            "docs/OBSERVABILITY.md",
+    "flag-undocumented": "every registry-declared PTPU_* flag must "
+                         "appear in docs/ (or the README)",
     "lock-with": "lock-like receivers are acquired via `with` (or "
                  "try/finally-released); no orphanable bare .acquire()",
     "cond-wait-loop": "condition-like .wait() must sit in a `while` "
@@ -170,6 +179,63 @@ def documented_metric_names():
     except OSError:
         pass
     return obs
+
+
+def documented_flag_corpus():
+    """Every docs/*.md file plus the README, concatenated — the text a
+    registry-declared flag name must appear in (the flag-undocumented
+    rule). Broader than the metric corpus on purpose: each subsystem
+    documents its own flags in its own doc."""
+    corpus = []
+    docs_dir = os.path.join(REPO_ROOT, "docs")
+    try:
+        names = sorted(os.listdir(docs_dir))
+    except OSError:
+        names = []
+    for name in names:
+        if name.endswith(".md"):
+            try:
+                with open(os.path.join(docs_dir, name)) as f:
+                    corpus.append(f.read())
+            except OSError:
+                pass
+    try:
+        with open(os.path.join(REPO_ROOT, "README.md")) as f:
+            corpus.append(f.read())
+    except OSError:
+        pass
+    return "\n".join(corpus)
+
+
+def flag_doc_findings(flag_names=None, corpus=None):
+    """The flag-undocumented rule: one finding per registry-declared
+    PTPU_* flag that appears nowhere in the docs corpus. Checked once
+    per lint run (registry-side), anchored at the flag's declaration
+    line in flags.py. ``flag_names``/``corpus`` are injectable for the
+    fixture tests; defaults read the real registry and docs/."""
+    if flag_names is None:
+        flag_names = declared_flag_names()
+    if corpus is None:
+        corpus = documented_flag_corpus()
+    try:
+        with open(FLAGS_PATH) as f:
+            src_lines = f.read().splitlines()
+    except OSError:
+        src_lines = []
+    findings = []
+    for name in sorted(flag_names):
+        # word-boundary match: a flag whose name prefixes another
+        # documented flag (PTPU_QUANT vs PTPU_QUANT_MODE) must not be
+        # vouched for by the longer name's mentions
+        if re.search(r"\b%s\b" % re.escape(name), corpus):
+            continue
+        line = next((i + 1 for i, s in enumerate(src_lines)
+                     if '"%s"' % name in s or "'%s'" % name in s), 0)
+        findings.append(Finding(
+            FLAGS_PATH, line, "flag-undocumented",
+            "flag %s is declared in the paddle_tpu.flags registry but "
+            "documented nowhere under docs/ (or the README)" % name))
+    return findings
 
 
 def _is_environ(node):
@@ -548,6 +614,8 @@ def main(argv=None):
     for path in iter_py_files(args.paths):
         n_files += 1
         findings.extend(lint_file(path, flag_names, doc_text))
+    # registry-side rule: once per run, not per file
+    findings.extend(flag_doc_findings(flag_names))
     for f in findings:
         print(f)
     print("ptpu_lint: %d file(s), %d finding(s)" % (n_files,
