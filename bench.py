@@ -531,6 +531,76 @@ def bench_serving_spec(n_requests=6, max_new_tokens=48, spec_k=6,
     }
 
 
+def bench_serving_fleet(n_requests=16, max_new_tokens=16, max_batch=4,
+                        vocab=256, d_model=64, n_heads=2, n_layers=2,
+                        d_ff=128, max_seq_len=128, block_size=16):
+    """Fleet scaling receipt (docs/SERVING.md "Fleet & failover"): one
+    deterministic request set through a 1-replica and a 2-replica
+    ``ServingRouter`` on the same model (the replicas share the jitted
+    step, so the pair pays one compile). ``max_batch`` is sized so the
+    single replica is batch-capacity-bound — the fleet's win is
+    aggregate batch slots plus a second worker thread. On a multi-core
+    box the 2-replica leg approaches 2x (two engine threads release
+    the GIL into XLA concurrently); a 1-core box serializes the two
+    step streams and parity is the honest expectation — ci.sh's gate
+    floor is core-aware for exactly that reason, and on real TPU pods
+    each replica owns its own chip so the scaling is the product
+    number. Outputs must stay token-identical to ``reference_decode``
+    on BOTH legs (routing may never change what a request gets).
+
+    Returns a dict with per-leg tokens_per_sec/outputs_match/
+    replicas_used and the 1->2 scaling ratio."""
+    from paddle_tpu import serving
+
+    cfg = serving.GenerationConfig(
+        vocab_size=vocab, d_model=d_model, n_heads=n_heads,
+        n_layers=n_layers, d_ff=d_ff, max_seq_len=max_seq_len)
+    model = serving.GenerationModel.random(cfg, seed=0)
+    rng = np.random.RandomState(23)
+    prompts = [rng.randint(0, vocab,
+                           size=int(rng.randint(4, 12))).tolist()
+               for _ in range(n_requests)]
+    refs = [serving.reference_decode(model, p, max_new_tokens)
+            for p in prompts]
+
+    def run_leg(n_replicas):
+        router = serving.ServingRouter(
+            model, replicas=n_replicas, max_batch=max_batch,
+            max_seq_len=max_seq_len, block_size=block_size)
+        # one primer per replica, submitted concurrently so the
+        # least-loaded dispatch lands one on each: pays the one-time
+        # XLA compile outside the measured window
+        primers = [router.submit([1, 2], max_new_tokens=2)
+                   for _ in range(n_replicas)]
+        for p in primers:
+            p.wait(600)
+        t0 = time.perf_counter()
+        reqs = [router.submit(p, max_new_tokens=max_new_tokens)
+                for p in prompts]
+        outs = [r.wait(600) for r in reqs]
+        wall = time.perf_counter() - t0
+        st = router.stats()
+        router.close()
+        return {
+            "tokens_per_sec": sum(len(o) for o in outs) / wall,
+            "outputs_match": outs == refs,
+            "replicas_used": sum(
+                1 for r in st["replicas"]
+                if r["model:default"]["steps"] > 0),
+            "failovers": st["failovers"],
+            "shed_requests": st["shed_requests"],
+        }
+
+    one = run_leg(1)
+    two = run_leg(2)
+    return {
+        "one": one,
+        "two": two,
+        "scaling": two["tokens_per_sec"] / one["tokens_per_sec"],
+        "outputs_match": one["outputs_match"] and two["outputs_match"],
+    }
+
+
 def bench_zero(steps=16, warmup=4, repeats=3, depth=4, width=256,
                batch=64, bucket_mb=0.5):
     """ZeRO ladder + comm/compute overlap receipt (docs/ZERO.md) on the
@@ -866,6 +936,10 @@ def main(argv=None):
                     help="run only the speculative-decoding serving "
                          "pair (spec_k on vs off on the repetitive-"
                          "generation set)")
+    ap.add_argument("--fleet-only", action="store_true",
+                    help="run only the serving-fleet scaling pair "
+                         "(1-replica vs 2-replica ServingRouter, the "
+                         "CI fleet stage configuration)")
     ap.add_argument("--zero-only", action="store_true",
                     help="run only the ZeRO/overlap ladder on the "
                          "8-device CPU mesh (the CI zero stage "
@@ -973,10 +1047,12 @@ def main(argv=None):
     compile_opt = compile_noopt = None
     hlo_opt = hlo_noopt = None
     last_loss = None
-    if args.serving_only or args.quant_only or args.spec_only:
+    if args.serving_only or args.quant_only or args.spec_only \
+            or args.fleet_only:
         args.amp_only = False  # dedicated leg: skip everything else
     if not args.amp_only and not args.serving_only \
-            and not args.quant_only and not args.spec_only:
+            and not args.quant_only and not args.spec_only \
+            and not args.fleet_only:
         if not args.sync_only:
             async_tps, last_loss, async_step, _ = bench_transformer_fluid(
                 async_exec=True, **kw)
@@ -1012,7 +1088,8 @@ def main(argv=None):
     fp32_tps = amp_tps = fp32_step = amp_step = None
     fp32_loss = amp_loss = None
     if args.amp_only or not (args.tiny or args.serving_only
-                             or args.quant_only or args.spec_only):
+                             or args.quant_only or args.spec_only
+                             or args.fleet_only):
         fp32_tps, fp32_loss, fp32_step, _ = bench_transformer_fluid(
             async_exec=False, dtype="float32", amp=False, **kw)
         _leg("fp32", fp32_tps, fp32_step, fp32_loss)
@@ -1026,7 +1103,8 @@ def main(argv=None):
     serve_batched = serve_serial = serve_match = None
     serve_p50 = serve_p99 = serve_tokens = None
     if args.serving_only or not (args.tiny or args.amp_only
-                                 or args.quant_only or args.spec_only):
+                                 or args.quant_only or args.spec_only
+                                 or args.fleet_only):
         (serve_batched, serve_serial, serve_match, serve_p50,
          serve_p99, serve_tokens) = bench_serving()
         _leg("serving_batched", serve_batched, 0.0,
@@ -1042,7 +1120,8 @@ def main(argv=None):
     # shared-system-prompt stream — TTFT is the headline
     fastpath_res = None
     if args.serving_only or not (args.tiny or args.amp_only
-                                 or args.quant_only or args.spec_only):
+                                 or args.quant_only or args.spec_only
+                                 or args.fleet_only):
         fastpath_res = bench_serving_fastpath()
         _leg("serving_fastpath", fastpath_res["fast"]["tokens_per_sec"],
              0.0,
@@ -1060,7 +1139,8 @@ def main(argv=None):
     # emitted tokens per compiled step is the headline
     spec_res = None
     if args.spec_only or args.serving_only \
-            or not (args.tiny or args.amp_only or args.quant_only):
+            or not (args.tiny or args.amp_only or args.quant_only
+                    or args.fleet_only):
         spec_res = bench_serving_spec()
         _leg("serving_spec", spec_res["spec"]["tokens_per_sec"], 0.0,
              tokens_per_step=round(spec_res["spec"]["tokens_per_step"],
@@ -1082,7 +1162,8 @@ def main(argv=None):
     qserve_int8 = qserve_fp32 = qserve_match = None
     qserve_agree = qserve_tokens = None
     if args.quant_only or not (args.tiny or args.amp_only
-                               or args.serving_only or args.spec_only):
+                               or args.serving_only or args.spec_only
+                               or args.fleet_only):
         quant_res = bench_quant_predictor()
         _leg("quant_fp32_predictor",
              quant_res["fp32_examples_per_sec"], 0.0)
@@ -1101,12 +1182,30 @@ def main(argv=None):
              outputs_match=bool(qserve_match),
              token_agreement=round(qserve_agree, 4))
 
+    # serving-fleet receipt (docs/SERVING.md "Fleet & failover"):
+    # 1-replica vs 2-replica router on one request set — aggregate
+    # tokens/s scaling plus routed-output identity
+    fleet_res = None
+    if args.fleet_only or not (args.tiny or args.amp_only
+                               or args.serving_only or args.quant_only
+                               or args.spec_only):
+        fleet_res = bench_serving_fleet()
+        _leg("serving_fleet_1r", fleet_res["one"]["tokens_per_sec"], 0.0,
+             outputs_match=bool(fleet_res["one"]["outputs_match"]),
+             replicas_used=fleet_res["one"]["replicas_used"])
+        _leg("serving_fleet_2r", fleet_res["two"]["tokens_per_sec"], 0.0,
+             outputs_match=bool(fleet_res["two"]["outputs_match"]),
+             replicas_used=fleet_res["two"]["replicas_used"],
+             fleet_scaling=round(fleet_res["scaling"], 4))
+
     headline = async_tps if async_tps is not None else \
         (sync_tps if sync_tps is not None else
          (amp_tps if amp_tps is not None else
           (serve_batched if serve_batched is not None else
            (qserve_int8 if qserve_int8 is not None else
-            spec_res["spec"]["tokens_per_sec"]))))
+            (spec_res["spec"]["tokens_per_sec"]
+             if spec_res is not None else
+             fleet_res["two"]["tokens_per_sec"])))))
     if last_loss is None:
         last_loss = amp_loss
 
@@ -1116,7 +1215,8 @@ def main(argv=None):
     if (args.resilience or args.tiny) and not (args.amp_only
                                                or args.serving_only
                                                or args.quant_only
-                                               or args.spec_only):
+                                               or args.spec_only
+                                               or args.fleet_only):
         unguarded, guarded = bench_resilience_overhead()
         overhead_pct = 100.0 * (guarded - unguarded) / unguarded
 
@@ -1207,6 +1307,17 @@ def main(argv=None):
                 fastpath_res["prefix_hit_rate"])
             reg.gauge("bench/serving_fastpath_outputs_match").set(
                 1.0 if fastpath_res["outputs_match"] else 0.0)
+        if fleet_res is not None:
+            reg.gauge("bench/serving_fleet_tokens_per_sec_1r").set(
+                fleet_res["one"]["tokens_per_sec"])
+            reg.gauge("bench/serving_fleet_tokens_per_sec_2r").set(
+                fleet_res["two"]["tokens_per_sec"])
+            reg.gauge("bench/serving_fleet_scaling").set(
+                fleet_res["scaling"])
+            reg.gauge("bench/serving_fleet_outputs_match").set(
+                1.0 if fleet_res["outputs_match"] else 0.0)
+            reg.gauge("bench/serving_fleet_replicas_used").set(
+                fleet_res["two"]["replicas_used"])
         if spec_res is not None:
             reg.gauge("bench/serving_spec_tokens_per_step").set(
                 spec_res["spec"]["tokens_per_step"])
@@ -1288,6 +1399,14 @@ def main(argv=None):
             fastpath_res["prefix_hit_rate"], 4)
         result["serving_fastpath_outputs_match"] = bool(
             fastpath_res["outputs_match"])
+    if fleet_res is not None:
+        result["serving_fleet_tokens_per_sec_1r"] = round(
+            fleet_res["one"]["tokens_per_sec"], 1)
+        result["serving_fleet_tokens_per_sec_2r"] = round(
+            fleet_res["two"]["tokens_per_sec"], 1)
+        result["serving_fleet_scaling"] = round(fleet_res["scaling"], 4)
+        result["serving_fleet_outputs_match"] = bool(
+            fleet_res["outputs_match"])
     if spec_res is not None:
         result["serving_spec_tokens_per_step"] = round(
             spec_res["spec"]["tokens_per_step"], 4)

@@ -235,6 +235,17 @@ _declare("PTPU_SERVE_SPEC_K", "int", 0,
          "speculative decoding: draft tokens proposed per serving "
          "decode step and verified in one batched target step "
          "(0 = legacy one-token decode)")
+_declare("PTPU_SERVE_REPLICAS", "int", 1,
+         "ServingRouter engine-replica count (least-loaded dispatch "
+         "with health-checked failover across them)")
+_declare("PTPU_SERVE_DEADLINE_S", "float", None,
+         "per-request serving deadline in seconds: requests past it "
+         "fail with DeadlineExceededError at the next step boundary "
+         "(unset = wait forever, the legacy behavior)")
+_declare("PTPU_SERVE_RETRY_BUDGET", "int", 3,
+         "re-admission attempts the ServingRouter may spend per "
+         "request when its replica fails over (exponential backoff; "
+         "RetryBudgetExceededError when spent)")
 # -- concurrency analysis (docs/STATIC_ANALYSIS.md) -------------------------
 _declare("PTPU_LOCK_CHECK", "bool", False,
          "route the runtime's named lock sites through tracked "

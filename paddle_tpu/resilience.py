@@ -60,6 +60,7 @@ __all__ = [
     "POLICY_WARN", "POLICY_SKIP_BATCH", "POLICY_ROLLBACK", "POLICY_ABORT",
     "POLICIES", "anomaly_policy", "AnomalyDetector", "AnomalousStepError",
     "RetryBudgetExceededError", "InjectedTransientError",
+    "InjectedReplicaDeathError", "maybe_inject_serve_fault",
     "is_transient_error", "FaultInjector", "global_injector",
     "set_global_injector", "PreemptionGuard", "ScopeSnapshot",
     "snapshot_scope", "restore_scope_snapshot", "TrainResult",
@@ -219,7 +220,10 @@ class FaultInjector:
     step N; occurrence-keyed sites fire on the N-th time the hook site is
     reached (1-based). Every firing is ONE-SHOT — a retried step does not
     re-poison itself, which is exactly what makes rollback-and-retry
-    converge.
+    converge. Match-and-consume is atomic (one lock around the armed-set
+    lookup and discard): the serving sites below are hit concurrently
+    from N engine worker threads, and two workers racing one armed step
+    must produce exactly one firing.
 
       nan_at_step:N        poison the step-N feed with a NaN (trainer)
       sigterm_at_step:N    deliver SIGTERM to this process at step N
@@ -227,12 +231,34 @@ class FaultInjector:
       transient_compile:K  K-th executor compile raises retryable error
       ckpt_torn_write:K    corrupt the K-th checkpoint after it lands
                            (a torn write the digest manifest must catch)
+
+    Serving sites (docs/SERVING.md "Fleet & failover") key on the engine
+    worker's own dispatched-step counter (0-based; the hook runs at the
+    step boundary BEFORE dispatching step N, while scheduler state is
+    still consistent). With several replicas the first worker to reach
+    step N consumes the armed firing:
+
+      serve_die_at_step:N       raise a fatal (non-transient) error in
+                                the serving step loop — replica death
+      serve_transient_at_step:N raise a retryable error in the serving
+                                step loop (the worker retries in place)
+      serve_stall_at_step:N     stop making step progress WITHOUT
+                                raising, until the replica is aborted
+                                or closed — the watchdog failure mode
+                                exceptions cannot model
     """
 
-    STEP_SITES = ("nan_at_step", "sigterm_at_step", "transient_at_step")
+    STEP_SITES = ("nan_at_step", "sigterm_at_step", "transient_at_step",
+                  "serve_die_at_step", "serve_transient_at_step",
+                  "serve_stall_at_step")
     OCCURRENCE_SITES = ("transient_compile", "ckpt_torn_write")
 
     def __init__(self, spec=None):
+        from .analysis.concurrency import make_lock
+
+        # one-shot firings must be atomic across engine worker threads
+        # (named site, tracked under PTPU_LOCK_CHECK=1)
+        self._lock = make_lock("resilience.fault_injector")
         self._steps = {}        # site -> set of step numbers still armed
         self._targets = {}      # site -> set of occurrence indices armed
         self._occ = collections.Counter()
@@ -268,25 +294,34 @@ class FaultInjector:
                       RuntimeWarning)
 
     def fire_at_step(self, site, step):
-        """One-shot: True exactly once when `step` is armed for `site`."""
-        armed = self._steps.get(site)
-        if armed and int(step) in armed:
-            armed.discard(int(step))
+        """One-shot: True exactly once when `step` is armed for `site`.
+        Match-and-consume runs under the injector lock; the telemetry
+        side effects run after release (the metrics-registry locks are
+        themselves tracked sites)."""
+        with self._lock:
+            armed = self._steps.get(site)
+            hit = bool(armed and int(step) in armed)
+            if hit:
+                armed.discard(int(step))
+        if hit:
             self._fired("%s:%d" % (site, step))
-            return True
-        return False
+        return hit
 
     def fire_occurrence(self, site):
-        """One-shot: True on the N-th call for each armed N."""
-        armed = self._targets.get(site)
-        if not armed:
-            return False
-        self._occ[site] += 1
-        if self._occ[site] in armed:
-            armed.discard(self._occ[site])
-            self._fired("%s#%d" % (site, self._occ[site]))
-            return True
-        return False
+        """One-shot: True on the N-th call for each armed N (atomic, see
+        `fire_at_step`)."""
+        with self._lock:
+            armed = self._targets.get(site)
+            if not armed:
+                return False
+            self._occ[site] += 1
+            occ = self._occ[site]
+            hit = occ in armed
+            if hit:
+                armed.discard(occ)
+        if hit:
+            self._fired("%s#%d" % (site, occ))
+        return hit
 
 
 _GLOBAL_INJECTOR = None
@@ -319,6 +354,37 @@ def maybe_inject_compile_fault():
         raise InjectedTransientError(
             "RESOURCE_EXHAUSTED: injected transient compile failure "
             "(PTPU_FAULT_INJECT transient_compile)")
+
+
+class InjectedReplicaDeathError(RuntimeError):
+    """What the `serve_die_at_step` site raises in a serving worker — a
+    fatal, NON-transient failure, so the engine dies and the router's
+    failover path (not an in-place retry) must recover."""
+
+
+def maybe_inject_serve_fault(step):
+    """Serving-engine step-boundary hook (docs/SERVING.md "Fleet &
+    failover"): raises for the `serve_die_at_step` /
+    `serve_transient_at_step` sites, returns ``"stall"`` when
+    `serve_stall_at_step` fires (the engine owns the stall loop — it
+    must stay abortable), else None. The engine calls this BEFORE any
+    scheduler mutation, so a retried tick after a transient firing is
+    clean."""
+    inj = global_injector()
+    if not inj.active():
+        return None
+    if inj.fire_at_step("serve_die_at_step", step):
+        raise InjectedReplicaDeathError(
+            "injected serving replica death at step %d "
+            "(PTPU_FAULT_INJECT serve_die_at_step)" % int(step))
+    if inj.fire_at_step("serve_transient_at_step", step):
+        raise InjectedTransientError(
+            "UNAVAILABLE: injected transient serving step failure at "
+            "step %d (PTPU_FAULT_INJECT serve_transient_at_step)"
+            % int(step))
+    if inj.fire_at_step("serve_stall_at_step", step):
+        return "stall"
+    return None
 
 
 # ---------------------------------------------------------------------------

@@ -31,14 +31,17 @@ from .model import (GenerationConfig, GenerationModel,  # noqa: F401
                     extract_decoder_weights, load_generation_artifact,
                     random_weights, reference_decode,
                     save_generation_artifact)
-from .scheduler import (AdmissionError, GenerationRequest,  # noqa: F401
+from .router import RouterRequest, ServingRouter  # noqa: F401
+from .scheduler import (AdmissionError,  # noqa: F401
+                        DeadlineExceededError, GenerationRequest,
                         RequestQueue, StepScheduler)
 
-__all__ = ["ServingEngine", "KVBlockPool", "blocks_needed",
-           "prefix_chain_keys",
+__all__ = ["ServingEngine", "ServingRouter", "RouterRequest",
+           "KVBlockPool", "blocks_needed", "prefix_chain_keys",
            "PoissonLoadGenerator", "GenerationConfig", "GenerationModel",
            "ModelDrafter", "NGramDrafter",
            "extract_decoder_weights", "load_generation_artifact",
            "random_weights", "reference_decode",
            "save_generation_artifact", "AdmissionError",
-           "GenerationRequest", "RequestQueue", "StepScheduler"]
+           "DeadlineExceededError", "GenerationRequest", "RequestQueue",
+           "StepScheduler"]

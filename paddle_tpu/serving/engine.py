@@ -67,6 +67,7 @@ import time
 
 import numpy as np
 
+from .. import resilience as _resil
 from ..analysis import concurrency as _conc
 from ..core.scope import Scope
 from ..observability import metrics as _metrics
@@ -94,7 +95,8 @@ class _ModelWorker:
     def __init__(self, name, model, max_batch, max_seq_len, block_size,
                  num_blocks, max_queue, async_depth, engine,
                  prefill_chunk=0, prefix_cache=False,
-                 prefill_token_budget=None, spec_k=0, drafter=None):
+                 prefill_token_budget=None, spec_k=0, drafter=None,
+                 transient_tolerance=2):
         from .model import NGramDrafter
 
         self.name = name
@@ -181,6 +183,19 @@ class _ModelWorker:
         self._lock_check = _conc.tracking_enabled()
         self._closing = False
         self.error = None
+        # failover surface (docs/SERVING.md "Fleet & failover"): abort()
+        # injects a fatal error at the next step boundary (or into an
+        # injected stall) so a router-declared-dead replica drains its
+        # pool through the normal death path; the transient counters
+        # feed the router's health state machine
+        self._abort_error = None
+        self.transient_tolerance = max(0, int(transient_tolerance))
+        self._consec_transient = 0
+        self._transient_retries = 0  # host-side (live with metrics off)
+        # flipped by the first deadline-carrying submit: the deadline
+        # scan never runs on a deadline-free engine (legacy identity)
+        self._track_deadlines = False
+        self._tick_retryable = False
         self._gen_tokens = 0
         self._steps_dispatched = 0  # host-side (live with metrics off)
         self._t_first_step = None
@@ -217,39 +232,98 @@ class _ModelWorker:
             if self.error is not None:
                 raise RuntimeError("serving worker %r died: %r"
                                    % (self.name, self.error))
+            if request.deadline is not None:
+                self._track_deadlines = True
             self.queue.submit(request)
             self._cv.notify()
         _metrics.counter("serving/requests_submitted").inc()
         _metrics.gauge("serving/queue_depth").set(len(self.queue))
         return request
 
+    # -- failover surface ----------------------------------------------
+    def abort(self, error):
+        """Inject a fatal error into the worker: it raises at the next
+        step boundary (or out of an injected stall) and dies through
+        the normal drain path — fail_all + queue drain, KV pool left
+        fully drained. The router's watchdog uses this to put down a
+        stalled replica; idempotent once dead or already aborted."""
+        with self._cv:
+            if self.error is None and self._abort_error is None:
+                self._abort_error = error
+            self._cv.notify_all()
+
     # -- decode loop ----------------------------------------------------
     def _run(self):
         try:
             while True:
                 with self._cv:
-                    while (not self._closing and not len(self.queue)
+                    while (self._abort_error is None
+                           and not self._closing
+                           and not len(self.queue)
                            and not self.scheduler.has_work()
                            and not self._inflight):
                         self._cv.wait(timeout=0.1)
-                    if (self._closing and not len(self.queue)
+                    abort = self._abort_error
+                    if (abort is None and self._closing
+                            and not len(self.queue)
                             and not self.scheduler.has_work()
                             and not self._inflight):
                         return
-                self._tick()
-        except BaseException as e:  # deliver, don't vanish
-            # error latch + queue drain run under the cv lock so they
-            # are atomic with submit()'s liveness check (no request can
-            # slip into the queue between the drain and the latch)
-            with self._cv:
-                self.error = e
-                self.scheduler.fail_all(e)
-                while True:
-                    req = self.queue.pop()
-                    if req is None:
-                        break
-                    req._finish(e)
-                    _metrics.counter("serving/requests_failed").inc()
+                if abort is not None:
+                    raise abort
+                try:
+                    self._tick()
+                    self._consec_transient = 0
+                except Exception as e:
+                    # a transient failure raised BEFORE any
+                    # scheduler/pool mutation (the injection/admission
+                    # window — the step boundary is still consistent)
+                    # is retried in place, a bounded number of
+                    # consecutive times; anything else — non-transient,
+                    # mid-dispatch, or tolerance spent — is replica
+                    # death and the router's failover problem
+                    if (self._tick_retryable
+                            and _resil.is_transient_error(e)
+                            and self._consec_transient
+                            < self.transient_tolerance):
+                        self._consec_transient += 1
+                        self._transient_retries += 1
+                        _metrics.counter(
+                            "serving/step_transient_retries").inc()
+                        continue
+                    raise
+        except BaseException as e:  # deliver, don't vanish: EVERYTHING
+            # escaping the loop — a tick, the wait/liveness block, an
+            # abort — latches the error and drains, so submit() can
+            # never feed a queue nobody will pop
+            self._die(e)
+
+    def _die(self, e):
+        """Replica death: error latch + fail_all + queue drain run under
+        the cv lock so they are atomic with submit()'s liveness check
+        (no request can slip into the queue between the drain and the
+        latch)."""
+        with self._cv:
+            self.error = e
+            self.scheduler.fail_all(e)
+            while True:
+                req = self.queue.pop()
+                if req is None:
+                    break
+                req._finish(e)
+                _metrics.counter("serving/requests_failed").inc()
+
+    def _stall(self):
+        """Injected step stall (`serve_stall_at_step`): stop making
+        progress WITHOUT raising — the wedged-replica failure mode an
+        exception cannot model — until the router's watchdog aborts
+        this replica or the engine closes, then die through the normal
+        drain path."""
+        while self._abort_error is None and not self._closing:
+            time.sleep(0.005)
+        raise (self._abort_error
+               or RuntimeError("stalled serving worker %r closed while "
+                               "wedged" % self.name))
 
     def _tick(self):
         """One scheduler round: admit at the boundary, dispatch one
@@ -257,9 +331,20 @@ class _ModelWorker:
         is past its prompt, else the mixed chunk shape whenever a row
         is mid-prompt under the chunked fast path), lag-process
         materialized tokens, retire."""
+        # everything up to step planning leaves the scheduler/pool state
+        # consistent, so a transient failure in this window is retried
+        # in place by _run (the fault-injection sites fire here — BEFORE
+        # any mutation — for exactly that reason)
+        self._tick_retryable = True
+        fault = _resil.maybe_inject_serve_fault(self._steps_dispatched)
+        if fault == "stall":
+            self._stall()
         sched = self.scheduler
+        if self._track_deadlines:
+            sched.expire_deadlines(self.queue)
         sched.admit(self.queue)
         _metrics.gauge("serving/queue_depth").set(len(self.queue))
+        self._tick_retryable = False
         spec_plan = sched.plan_spec() if self.spec_k else None
         if spec_plan:
             # verify window: dispatched AND materialized in one round
@@ -511,7 +596,8 @@ class ServingEngine:
     def __init__(self, models, max_batch=8, max_seq_len=256,
                  block_size=16, num_blocks=None, max_queue=64,
                  async_depth=None, prefill_chunk=None, prefix_cache=None,
-                 prefill_token_budget=None, spec_k=None, drafter=None):
+                 prefill_token_budget=None, spec_k=None, drafter=None,
+                 deadline_s=None, transient_tolerance=2):
         from ..flags import env as _env
 
         if async_depth is None:
@@ -522,6 +608,9 @@ class ServingEngine:
             prefix_cache = bool(_env("PTPU_SERVE_PREFIX_CACHE"))
         if spec_k is None:
             spec_k = _env("PTPU_SERVE_SPEC_K")
+        if deadline_s is None:
+            deadline_s = _env("PTPU_SERVE_DEADLINE_S")
+        self._deadline_s = deadline_s
         if not isinstance(models, dict):
             models = {"default": models}
         if not models:
@@ -541,7 +630,8 @@ class ServingEngine:
                 async_depth=async_depth, engine=self,
                 prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
                 prefill_token_budget=prefill_token_budget,
-                spec_k=spec_k, drafter=drafter)
+                spec_k=spec_k, drafter=drafter,
+                transient_tolerance=transient_tolerance)
         self._default = next(iter(self._workers))
         self._closed = False
 
@@ -555,19 +645,34 @@ class ServingEngine:
         return self._workers[model or self._default].scope
 
     def submit(self, prompt, max_new_tokens=32, eos_id=None, stream=None,
-               model=None):
+               model=None, deadline_s=None):
         """Enqueue one generation request; returns the
         :class:`GenerationRequest` handle. Raises
-        :class:`AdmissionError` when the model's queue is full."""
+        :class:`AdmissionError` when the model's queue is full.
+        ``deadline_s`` (default: the engine's ``deadline_s`` /
+        ``$PTPU_SERVE_DEADLINE_S``, unset = wait forever) fails the
+        request with :class:`DeadlineExceededError` at the next step
+        boundary once the wall-clock budget is spent."""
+        if deadline_s is None:
+            deadline_s = self._deadline_s
+        request = GenerationRequest(prompt, max_new_tokens=max_new_tokens,
+                                    eos_id=eos_id, stream=stream,
+                                    model=model or self._default,
+                                    deadline_s=deadline_s)
+        # model-name validation lives in submit_request (one copy)
+        return self.submit_request(request)
+
+    def submit_request(self, request):
+        """Enqueue a pre-built :class:`GenerationRequest` (the router's
+        re-admission path builds the request first, so its stream and
+        ``on_finish`` callbacks are attached before any token can
+        flow). ``request.model`` picks the worker (None = default)."""
         if self._closed:
             raise RuntimeError("ServingEngine is closed")
-        name = model or self._default
+        name = request.model or self._default
         if name not in self._workers:
             raise KeyError("unknown model %r (have %r)"
                            % (name, list(self._workers)))
-        request = GenerationRequest(prompt, max_new_tokens=max_new_tokens,
-                                    eos_id=eos_id, stream=stream,
-                                    model=name)
         try:
             return self._workers[name].submit(request)
         except AdmissionError:
@@ -577,6 +682,49 @@ class ServingEngine:
     def result(self, request, timeout=None):
         """Block until `request` completed; returns its token list."""
         return request.wait(timeout)
+
+    # -- fleet surface (docs/SERVING.md "Fleet & failover") -------------
+    def load(self):
+        """Instantaneous load for least-loaded routing: queued plus
+        in-batch requests across models — the same quantity the
+        ``serving/queue_depth`` + ``serving/batch_occupancy`` gauges
+        record, read per engine."""
+        return sum(len(w.queue) + w.scheduler.num_occupied
+                   for w in self._workers.values())
+
+    def health(self):
+        """Per-model liveness/progress snapshot for an external
+        watchdog (the :class:`~paddle_tpu.serving.router.ServingRouter`
+        health state machine polls this): worker thread liveness, the
+        latched death error, the dispatched-step counter (the stall
+        watchdog's progress signal), whether work is pending, and the
+        consecutive-transient-failure count."""
+        out = {}
+        for name, w in self._workers.items():
+            out[name] = {
+                "alive": w.error is None and w._thread.is_alive(),
+                "error": w.error,
+                "steps": w._steps_dispatched,
+                "busy": bool(len(w.queue) or w.scheduler.has_work()
+                             or w._inflight),
+                "consecutive_transient_errors": w._consec_transient,
+                "transient_retries": w._transient_retries,
+            }
+        return out
+
+    def kill(self, error=None):
+        """Put the whole engine down as a dead replica would go down:
+        every worker aborts at its next step boundary (or out of an
+        injected stall), failing in-flight and queued requests with
+        ``error`` and draining its KV pool through ``fail_all``. New
+        submits are refused. The failover path's teardown half — the
+        router calls this when its watchdog declares a replica dead."""
+        if error is None:
+            error = RuntimeError("ServingEngine killed")
+        self._closed = True
+        for w in self._workers.values():
+            w.abort(error)
+        return error
 
     def generate(self, prompt, max_new_tokens=32, eos_id=None,
                  model=None, timeout=None):
@@ -607,6 +755,8 @@ class ServingEngine:
                     sched.spec_blocks_rolled_back,
                 "spec_accept_rate": (sched.spec_accepted
                                      / max(1, sched.spec_proposed)),
+                "deadline_expired": sched.deadline_expired,
+                "transient_retries": w._transient_retries,
                 **w.pool.stats(),
             }
         return out

@@ -69,14 +69,37 @@ from collections import deque
 from ..observability import metrics as _metrics
 from .kv_cache import blocks_needed, prefix_chain_keys
 
-__all__ = ["AdmissionError", "GenerationRequest", "RequestQueue",
-           "StepScheduler"]
+__all__ = ["AdmissionError", "DeadlineExceededError", "GenerationRequest",
+           "RequestQueue", "StepScheduler", "check_request_args"]
 
 _req_ids = itertools.count()
 
 
 class AdmissionError(RuntimeError):
     """Raised by submit() when the request queue is at capacity."""
+
+
+class DeadlineExceededError(TimeoutError):
+    """Delivered into a request whose ``deadline_s`` passed before it
+    completed (docs/SERVING.md "Fleet & failover"): the scheduler fails
+    the request at the next step boundary — queued or mid-batch —
+    instead of letting it wait forever on a wedged stream. Counted in
+    ``serving/requests_failed`` and ``serving/deadline_expired``."""
+
+
+def check_request_args(prompt, max_new_tokens, deadline_s=None):
+    """Shared request validation (``GenerationRequest`` and the
+    router's ``RouterRequest`` — one rule set, so the two submit
+    surfaces can never drift): returns the int-coerced prompt."""
+    prompt = [int(t) for t in prompt]
+    if not prompt:
+        raise ValueError("prompt must hold at least one token")
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    if deadline_s is not None and float(deadline_s) <= 0:
+        raise ValueError("deadline_s must be > 0 (got %r)"
+                         % (deadline_s,))
+    return prompt
 
 
 class GenerationRequest:
@@ -88,19 +111,23 @@ class GenerationRequest:
     """
 
     def __init__(self, prompt, max_new_tokens=32, eos_id=None,
-                 stream=None, model=None):
-        prompt = [int(t) for t in prompt]
-        if not prompt:
-            raise ValueError("prompt must hold at least one token")
-        if max_new_tokens < 1:
-            raise ValueError("max_new_tokens must be >= 1")
+                 stream=None, model=None, deadline_s=None,
+                 on_finish=None):
+        prompt = check_request_args(prompt, max_new_tokens, deadline_s)
         self.id = next(_req_ids)
         self.model = model
         self.prompt = prompt
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = eos_id
         self.stream = stream
+        # completion hook (the router's re-admission surface): called
+        # once from _finish, success or error, possibly from an engine
+        # thread — it must not call back into engine locks
+        self.on_finish = on_finish
         self.submit_time = time.perf_counter()
+        # absolute perf_counter deadline; None = wait forever (legacy)
+        self.deadline = (self.submit_time + float(deadline_s)
+                         if deadline_s is not None else None)
         self.start_time = None      # admitted to the batch
         self.first_token_time = None  # first generated token materialized
         self.finish_time = None
@@ -141,6 +168,11 @@ class GenerationRequest:
         self.error = error
         self.finish_time = time.perf_counter()
         self._done.set()
+        if self.on_finish is not None:
+            try:
+                self.on_finish(self)
+            except Exception:
+                pass  # a completion consumer must not kill the engine
 
 
 class RequestQueue:
@@ -172,6 +204,18 @@ class RequestQueue:
     def pop(self):
         with self._lock:
             return self._q.popleft() if self._q else None
+
+    def pop_expired(self, now):
+        """Remove and return every queued request whose deadline passed
+        (head-of-line order of the survivors is preserved)."""
+        with self._lock:
+            expired = [r for r in self._q
+                       if r.deadline is not None and now >= r.deadline]
+            if expired:
+                dead = set(id(r) for r in expired)
+                self._q = deque(r for r in self._q
+                                if id(r) not in dead)
+        return expired
 
 
 class _Sequence:
@@ -247,6 +291,8 @@ class StepScheduler:
         self.spec_accepted = 0
         self.spec_emitted = 0
         self.spec_blocks_rolled_back = 0
+        # host-side deadline telemetry (live even with metrics disabled)
+        self.deadline_expired = 0
         if self.spec_k:
             self.spec_feed = np.zeros(
                 (self.max_batch, self.spec_k + 1), np.int32)
@@ -613,6 +659,44 @@ class StepScheduler:
             seq.finished = True
             seq.dispatch_done = True
             request._finish()
+
+    def expire_deadlines(self, queue, now=None):
+        """Fail every request whose deadline passed — queued requests
+        leave the queue immediately; mid-batch sequences stop
+        dispatching and retire through the normal ``reap`` path once
+        their in-flight steps drain, so the KV pool accounting stays
+        exactly the retirement path's. Called by the engine at step
+        boundaries (only when a live request actually carries a
+        deadline — the deadline-free engine path is untouched).
+        Returns the number of requests expired."""
+        if now is None:
+            now = time.perf_counter()
+        expired = 0
+        for request in queue.pop_expired(now):
+            request._finish(DeadlineExceededError(
+                "request %d exceeded its deadline while queued "
+                "(waited %.3fs)" % (request.id,
+                                    now - request.submit_time)))
+            expired += 1
+        for seq in self.slots:
+            if seq is None or seq.finished:
+                continue
+            deadline = seq.request.deadline
+            if deadline is None or now < deadline:
+                continue
+            seq.finished = True
+            seq.dispatch_done = True
+            seq.request._finish(DeadlineExceededError(
+                "request %d exceeded its deadline mid-generation "
+                "(%d/%d tokens emitted)"
+                % (seq.request.id, len(seq.request.tokens),
+                   seq.request.max_new_tokens)))
+            expired += 1
+        if expired:
+            self.deadline_expired += expired
+            _metrics.counter("serving/requests_failed").inc(expired)
+            _metrics.counter("serving/deadline_expired").inc(expired)
+        return expired
 
     def reap(self):
         """Retire slots whose sequence is complete AND fully drained

@@ -47,6 +47,17 @@
 #                weight-store shrink, token-identical int8 serving, and
 #                the int8-vs-fp32 serving throughput floor (retried like
 #                serve's ratio; functional gates hold every attempt)
+#   fleet      - fault-tolerant serving-fleet receipt (docs/SERVING.md
+#                "Fleet & failover"): a 2-replica ServingRouter under
+#                PTPU_LOCK_CHECK=1 survives (a) an injected replica
+#                death and (b) a transient step failure plus an
+#                injected stall — gating zero token divergence vs the
+#                unfailed reference (incl. requests re-admitted
+#                mid-generation), router/failovers >= 1,
+#                router/readmitted >= 1, clean KV-pool invariants on
+#                the dead replica, and concurrency/violations == 0 —
+#                then the 1->2 replica throughput-scaling bench
+#                (core-aware floor, retried like serve's ratios)
 #   zero       - ZeRO ladder + comm/compute overlap receipt
 #                (docs/ZERO.md): one tiny MLP through ZeRO-1 per-leaf /
 #                bucketed-no-overlap (the PR-5 path) / ZeRO-2 overlap /
@@ -54,7 +65,7 @@
 #                gating numerics per rung, losses decreasing, offload
 #                bytes moved, and the step-time overlap receipt
 #                (overlapped <= non-overlapped)
-# Usage: scripts/ci.sh [build|test|api_check|bench|bench-smoke|stress|obs|chaos|amp|serve|lint|race|verify|quant|zero|all]
+# Usage: scripts/ci.sh [build|test|api_check|bench|bench-smoke|stress|obs|chaos|amp|serve|lint|race|verify|quant|zero|fleet|all]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -695,6 +706,187 @@ print("quant stage ok:",
 PYEOF
 }
 
+do_fleet() {
+  # fault-tolerant serving-fleet receipt (docs/SERVING.md "Fleet &
+  # failover"). Leg A — replica death: a 2-replica router serving a
+  # shared-prefix stream loses one replica mid-stream
+  # (serve_die_at_step); every output, including requests re-admitted
+  # with their already-emitted prefix, must be token-identical to the
+  # unfailed reference (greedy decode is history-deterministic), the
+  # dead replica's KV pool must come out invariant-clean and fully
+  # drained, and the whole path runs under PTPU_LOCK_CHECK=1 with
+  # switch-interval jitter gating concurrency/violations == 0.
+  local dump=/tmp/ptpu_fleet_metrics.json legs=/tmp/ptpu_fleet_legs.json
+  rm -f "$dump"
+  JAX_PLATFORMS=cpu PTPU_METRICS=1 PTPU_METRICS_OUT="$dump" \
+    PTPU_LOCK_CHECK=1 PTPU_RETRY_BACKOFF=0 \
+    PTPU_FAULT_INJECT="serve_die_at_step:6" \
+    python - <<'PYEOF'
+import sys
+import threading
+import warnings
+
+sys.setswitchinterval(1e-5)
+import numpy as np
+
+from paddle_tpu import serving
+from paddle_tpu.analysis import concurrency
+from paddle_tpu.serving import (GenerationConfig, GenerationModel,
+                                reference_decode)
+
+warnings.simplefilter("ignore", RuntimeWarning)
+model = GenerationModel.random(
+    GenerationConfig(vocab_size=64, d_model=32, n_heads=2, n_layers=2,
+                     d_ff=64, max_seq_len=64), seed=0, name="fleet")
+rng = np.random.RandomState(7)
+shared = rng.randint(0, 64, size=8).tolist()  # shared prefix -> radix reuse
+prompts = [shared + rng.randint(0, 64, size=rng.randint(2, 6)).tolist()
+           for _ in range(12)]
+refs = [reference_decode(model, p, 10) for p in prompts]
+results = {}
+with serving.ServingRouter(model, replicas=2, max_batch=2, max_seq_len=64,
+                           block_size=4, prefill_chunk=4,
+                           prefix_cache=True, backoff_base=0.0,
+                           health_interval_s=0.02) as router:
+    def client(lo, hi):
+        for i in range(lo, hi):
+            results[i] = router.generate(prompts[i], max_new_tokens=10,
+                                         timeout=300)
+    threads = [threading.Thread(target=client, args=(i * 3, i * 3 + 3),
+                                name="fleet-client-%d" % i, daemon=True)
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    st = router.stats()
+    dead = [r for r in router._replicas if r.state == "dead"]
+    assert len(dead) == 1, st["replicas"]
+    for w in dead[0].engine._workers.values():
+        assert w.pool.check_invariants() == [], w.pool.check_invariants()
+        assert w.pool.stats()["blocks_in_use"] == 0, w.pool.stats()
+for i, p in enumerate(prompts):
+    assert results[i] == refs[i], (i, results[i], refs[i])
+assert st["failovers"] >= 1 and st["readmitted"] >= 1, st
+concurrency.assert_clean()
+concurrency.publish_metrics()
+print("fleet kill leg ok:", {k: st[k] for k in
+      ("failovers", "readmitted", "retries", "replicas_healthy")},
+      concurrency.stats())
+PYEOF
+  python tools/ptpu_stats.py "$dump" \
+    --assert-min router/failovers=1 router/readmitted=1 \
+                 router/retries=1 resilience/faults_injected=1 \
+                 concurrency/locks_tracked=6 concurrency/acquisitions=1 \
+                 serving/prefix_blocks_reused=1 \
+    --assert-max concurrency/violations=0
+  # Leg B — transient + stall: one retryable step failure (retried in
+  # place at the boundary, nobody dies) and one injected stall (no
+  # exception ever raised — the router's step-progress watchdog must
+  # declare the replica dead and fail its work over), same identity and
+  # violation gates.
+  rm -f "$dump"
+  JAX_PLATFORMS=cpu PTPU_METRICS=1 PTPU_METRICS_OUT="$dump" \
+    PTPU_LOCK_CHECK=1 PTPU_RETRY_BACKOFF=0 \
+    python - <<'PYEOF'
+import sys
+import warnings
+
+sys.setswitchinterval(1e-5)
+import numpy as np
+
+from paddle_tpu import resilience, serving
+from paddle_tpu.analysis import concurrency
+from paddle_tpu.serving import (GenerationConfig, GenerationModel,
+                                reference_decode)
+
+warnings.simplefilter("ignore", RuntimeWarning)
+model = GenerationModel.random(
+    GenerationConfig(vocab_size=64, d_model=32, n_heads=2, n_layers=2,
+                     d_ff=64, max_seq_len=64), seed=0, name="fleet")
+rng = np.random.RandomState(11)
+prompts = [rng.randint(0, 64, size=rng.randint(3, 8)).tolist()
+           for _ in range(8)]
+refs = [reference_decode(model, p, 10) for p in prompts]
+# warm the (replica-shared) jitted step through a throwaway engine
+# BEFORE arming the injector: the tight 0.5s stall budget below is
+# meant for the injected stall, not for first-step XLA compile (the
+# watchdog contract: stall_timeout_s must exceed worst-case step time)
+with serving.ServingEngine(model, max_batch=2, max_seq_len=64,
+                           block_size=4) as warm:
+    warm.generate([1, 2], max_new_tokens=2, timeout=300)
+resilience.set_global_injector(resilience.FaultInjector(
+    "serve_transient_at_step:3,serve_stall_at_step:8"))
+with serving.ServingRouter(model, replicas=2, max_batch=2, max_seq_len=64,
+                           block_size=4, backoff_base=0.0,
+                           stall_timeout_s=0.5,
+                           health_interval_s=0.02) as router:
+    reqs = [router.submit(p, max_new_tokens=10) for p in prompts]
+    outs = [r.wait(300) for r in reqs]
+    st = router.stats()
+    dead = [r for r in router._replicas if r.state == "dead"]
+    assert len(dead) == 1, st["replicas"]
+    assert "stalled" in str(dead[0].error), dead[0].error
+    for w in dead[0].engine._workers.values():
+        assert w.pool.check_invariants() == [], w.pool.check_invariants()
+assert outs == refs, [i for i, (o, r) in enumerate(zip(outs, refs))
+                      if o != r]
+assert st["failovers"] >= 1, st
+retried = sum(r["model:default"]["transient_retries"]
+              for r in st["replicas"])
+assert retried >= 1, st
+concurrency.assert_clean()
+concurrency.publish_metrics()
+print("fleet stall leg ok: watchdog failover after in-place transient "
+      "retry", {k: st[k] for k in ("failovers", "readmitted")})
+PYEOF
+  python tools/ptpu_stats.py "$dump" \
+    --assert-min router/failovers=1 serving/step_transient_retries=1 \
+                 resilience/faults_injected=2 \
+    --assert-max concurrency/violations=0
+  # Leg C — throughput scaling 1 -> 2 replicas. The functional gates
+  # (routed outputs token-identical on both legs, both replicas
+  # actually used) hold on every attempt; the scaling ratio is a
+  # timing measurement retried like serve's ratios. The floor is
+  # core-aware: with >= 2 cores the two engine threads run their XLA
+  # steps concurrently (GIL released) and must clear 1.5x; a 1-core
+  # box serializes the step streams, so parity (0.85 with jitter
+  # margin) is the honest expectation — on real TPU pods each replica
+  # owns its chip and the scaling is the product number.
+  local floor=1.5 attempt rc=1
+  if [ "$(nproc)" -lt 2 ]; then floor=0.85; fi
+  for attempt in 1 2 3; do
+    rm -f "$dump" "$legs"
+    JAX_PLATFORMS=cpu PTPU_METRICS=1 \
+      python bench.py --fleet-only --metrics-out "$dump" \
+      --legs-out "$legs"
+    python tools/ptpu_stats.py "$dump" \
+      --assert-has bench/serving_fleet_tokens_per_sec_1r \
+                   bench/serving_fleet_tokens_per_sec_2r \
+      --assert-min bench/serving_fleet_outputs_match=1 \
+                   bench/serving_fleet_replicas_used=2
+    set +e
+    python tools/ptpu_stats.py "$dump" \
+      --assert-min bench/serving_fleet_scaling="$floor"
+    rc=$?
+    set -e
+    [ "$rc" -eq 0 ] && break
+    echo "fleet scaling below ${floor}x (loaded box?) — retry $attempt/2" >&2
+  done
+  [ "$rc" -eq 0 ]
+  python - "$legs" <<'PYEOF'
+import json, sys
+legs = {e["leg"]: e for e in json.load(open(sys.argv[1]))}
+assert "serving_fleet_1r" in legs and "serving_fleet_2r" in legs, legs
+assert legs["serving_fleet_1r"]["outputs_match"], legs
+assert legs["serving_fleet_2r"]["outputs_match"], legs
+assert legs["serving_fleet_2r"]["replicas_used"] == 2, legs
+print("fleet stage ok:",
+      {k: v["tokens_per_sec"] for k, v in legs.items()},
+      "scaling:", legs["serving_fleet_2r"]["fleet_scaling"])
+PYEOF
+}
+
 do_zero() {
   # ZeRO/overlap receipt (docs/ZERO.md). Functional gates hold on every
   # attempt: every rung's trained params close to the bucketed anchor
@@ -771,6 +963,7 @@ case "$stage" in
   verify) do_verify ;;
   quant) do_quant ;;
   zero) do_zero ;;
-  all) do_build; do_lint; do_test; do_api_check; do_bench_smoke; do_chaos; do_amp; do_serve; do_race; do_verify; do_quant; do_zero; do_bench ;;
+  fleet) do_fleet ;;
+  all) do_build; do_lint; do_test; do_api_check; do_bench_smoke; do_chaos; do_amp; do_serve; do_fleet; do_race; do_verify; do_quant; do_zero; do_bench ;;
   *) echo "unknown stage: $stage" >&2; exit 2 ;;
 esac
