@@ -311,6 +311,93 @@ def bench_resilience_overhead(steps=48, warmup=8, batch=64,
     return (t1 - t0) / steps, (t2 - t1) / steps
 
 
+def bench_data_ingestion(n_shards=8, records_per_shard=2048, width=32,
+                         batch_size=256, repeats=3):
+    """Streaming-ingestion receipt (docs/DATA_PLANE.md): records/s
+    through the fault-tolerant QueueDataset reader, healthy vs degraded
+    (one shard corrupted on disk and QUARANTINED by the containment
+    policy). The degraded leg reads fewer records, so the honest
+    receipt is throughput on the SURVIVING stream:
+    `bench/data_degraded_throughput_ratio` = degraded / healthy
+    records-per-second — containment must cost detection overhead, not
+    collapse the pipeline. Returns a result dict."""
+    import shutil
+    import tempfile
+    import warnings
+
+    import paddle_tpu as fluid
+    from paddle_tpu import data_plane
+
+    class _Var:
+        def __init__(self, name):
+            self.name = name
+
+    tmp = tempfile.mkdtemp(prefix="ptpu_bench_data_")
+    try:
+        paths = []
+        payload = np.arange(width, dtype=np.float32)
+        for i in range(n_shards):
+            p = "%s/shard%02d.rec" % (tmp, i)
+
+            def gen(i=i):
+                for j in range(records_per_shard):
+                    yield (payload + i * records_per_shard + j,
+                           np.int64(i * records_per_shard + j))
+
+            fluid.convert_reader_to_recordio_file(p, gen)
+            paths.append(p)
+
+        def make_ds():
+            ds = fluid.DatasetFactory().create_dataset("QueueDataset")
+            ds.set_filelist(paths)
+            ds.set_batch_size(batch_size)
+            ds.set_use_var([_Var("x"), _Var("y")])
+            ds.set_thread(2)
+            return ds
+
+        def run_leg():
+            best = None
+            n_records = 0
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                n_records = 0
+                with warnings.catch_warnings():
+                    warnings.simplefilter("ignore")
+                    for feed in make_ds()._batches_prefetched():
+                        n_records += feed["y"].shape[0]
+                dt = time.perf_counter() - t0
+                best = dt if best is None else min(best, dt)
+                data_plane.reset_quarantine()  # re-detect per repeat
+            return n_records / best, n_records
+
+        healthy_rps, healthy_records = run_leg()
+
+        # damage one mid-list shard on disk (a real torn byte, not an
+        # injector hook — the bench measures the production path)
+        raw = bytearray(open(paths[n_shards // 2], "rb").read())
+        raw[len(raw) // 2] ^= 0xFF
+        with open(paths[n_shards // 2], "wb") as f:
+            f.write(bytes(raw))
+        import os as _os
+
+        _os.environ["PTPU_DATA_ANOMALY_POLICY"] = "quarantine_shard"
+        try:
+            degraded_rps, degraded_records = run_leg()
+        finally:
+            _os.environ.pop("PTPU_DATA_ANOMALY_POLICY", None)
+            data_plane.reset_quarantine()
+        return {
+            "healthy_records_per_sec": healthy_rps,
+            "degraded_records_per_sec": degraded_rps,
+            "degraded_throughput_ratio": degraded_rps / healthy_rps,
+            "healthy_records": healthy_records,
+            "degraded_records": degraded_records,
+            "records_lost": healthy_records - degraded_records,
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_serving(n_requests=32, max_new_tokens=24, rate=100000.0,
                   max_batch=16, vocab=256, d_model=64, n_heads=2,
                   n_layers=2, d_ff=128, max_seq_len=128):
@@ -949,10 +1036,55 @@ def main(argv=None):
                          "fp32-vs-int8 predictor pair and the "
                          "weight-only-int8 serving pair (the CI quant "
                          "stage configuration)")
+    ap.add_argument("--data-only", action="store_true",
+                    help="run only the streaming-ingestion leg pair "
+                         "(healthy vs one-quarantined-shard records/s "
+                         "— the CI data-chaos stage configuration)")
     ap.add_argument("--resilience", action="store_true",
                     help="also measure guarded vs unguarded step time "
                          "(always on under --tiny)")
     args = ap.parse_args(argv)
+
+    if args.data_only:
+        res = bench_data_ingestion()
+        if args.metrics_out:
+            from paddle_tpu.observability import metrics as obs_metrics
+
+            reg = obs_metrics.registry()
+            reg.gauge("bench/data_records_per_sec_healthy").set(
+                res["healthy_records_per_sec"])
+            reg.gauge("bench/data_records_per_sec_degraded").set(
+                res["degraded_records_per_sec"])
+            reg.gauge("bench/data_degraded_throughput_ratio").set(
+                res["degraded_throughput_ratio"])
+            reg.gauge("bench/data_records_lost").set(
+                res["records_lost"])
+            reg.dump_json(args.metrics_out)
+        if args.legs_out:
+            with open(args.legs_out, "w") as f:
+                json.dump([
+                    {"leg": "data_healthy",
+                     "records_per_sec": round(
+                         res["healthy_records_per_sec"], 1),
+                     "records": res["healthy_records"]},
+                    {"leg": "data_degraded",
+                     "records_per_sec": round(
+                         res["degraded_records_per_sec"], 1),
+                     "records": res["degraded_records"],
+                     "data_degraded_throughput_ratio": round(
+                         res["degraded_throughput_ratio"], 4)},
+                ], f, indent=2)
+        print(json.dumps({
+            "metric": "data_degraded_throughput_ratio",
+            "value": round(res["degraded_throughput_ratio"], 4),
+            "unit": "x (degraded / healthy records-per-sec)",
+            "records_per_sec_healthy": round(
+                res["healthy_records_per_sec"], 1),
+            "records_per_sec_degraded": round(
+                res["degraded_records_per_sec"], 1),
+            "records_lost": res["records_lost"],
+        }))
+        return
 
     if args.zero_only:
         # dedicated branch: the ZeRO ladder runs on an 8-device virtual

@@ -79,6 +79,8 @@ from . import install_check  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import resilience  # noqa: F401
 from .resilience import ResilientTrainer  # noqa: F401
+from . import data_plane  # noqa: F401  (fault-tolerant streaming ingestion)
+from .data_plane import DatasetCursor  # noqa: F401
 from .reader import batch  # noqa: F401  (top-level paddle.batch parity)
 
 

@@ -50,10 +50,15 @@ class DatasetBase:
     def set_use_var(self, var_list):
         self._use_var = list(var_list)
 
-    def _file_samples(self, path):
+    def _file_samples(self, path, shard_index=0):
         """Parse ONE shard file into its sample list — the unit of work a
         Hogwild-style reader thread owns (device_worker.h:135: each
-        worker consumes its own DataFeed shard)."""
+        worker consumes its own DataFeed shard). Recordio shards read
+        through the fault-tolerant data plane (docs/DATA_PLANE.md):
+        CRC/framing/truncation damage routes through
+        `PTPU_DATA_ANOMALY_POLICY` instead of raising mid-epoch, and
+        `shard_index` keys the `data_corrupt_shard`/`data_stall_shard`
+        chaos sites. Healthy shards yield the bitwise-legacy stream."""
         if self._feed_desc is not None:
             from .core import native
 
@@ -88,42 +93,46 @@ class DatasetBase:
                 return records  # all slots used verbatim: no rebuild
             return [tuple(fold(rec[i], m) for i, m in zip(used, mods))
                     for rec in records]
-        reader = recordio_writer.recordio_reader_creator([path])
+        from . import data_plane
+
+        reader = data_plane.resilient_sample_reader(
+            [path], shard_indices=[shard_index])
         return list(reader())
 
     def _sample_reader(self):
         def reader():
-            for path in self._filelist:
-                yield from self._file_samples(path)
+            for i, path in enumerate(self._filelist):
+                yield from self._file_samples(path, shard_index=i)
 
         return reader
 
-    def _pool_map(self, fn):
-        """Thread-pool over file shards (C15 Hogwild parity, TPU-native
-        reading: worker threads parse on the host while the single jitted
-        step owns the device). Submission is WINDOWED — at most
-        n_workers+2 shards outstanding — so a streaming dataset never
-        buffers the whole filelist in RAM. FLAGS_cpu_deterministic keeps
-        emission in filelist order so losses reproduce the serial run
-        exactly; off = completion order for max overlap."""
+    def _pool_map_items(self, fn, items, ordered):
+        """The ONE windowed thread-pool shape every shard-parse path
+        shares (C15 Hogwild parity, TPU-native reading: worker threads
+        parse on the host while the single jitted step owns the
+        device). Submission is WINDOWED — at most n_workers+2 items
+        outstanding — so a streaming dataset never buffers the whole
+        filelist in RAM. `ordered` emits results in item order (bitwise
+        the serial run); off = completion order for max overlap."""
         from concurrent.futures import (FIRST_COMPLETED,
                                         ThreadPoolExecutor, wait)
 
-        from .flags import flag
-
-        n = max(1, min(self._thread, len(self._filelist)))
+        n = max(1, min(self._thread, len(items)))
+        if n == 1:
+            for item in items:
+                yield fn(item)
+            return
         window = n + 2
-        deterministic = flag("cpu_deterministic")
         with ThreadPoolExecutor(max_workers=n) as ex:
-            it = iter(self._filelist)
+            it = iter(items)
             pending = []
-            for path in it:
-                pending.append(ex.submit(fn, path))
+            for item in it:
+                pending.append(ex.submit(fn, item))
                 if len(pending) >= window:
                     break
             while pending:
-                if deterministic:
-                    done = pending.pop(0)  # filelist order
+                if ordered:
+                    done = pending.pop(0)  # item order
                 else:
                     wait(pending, return_when=FIRST_COMPLETED)
                     done = next(f for f in pending if f.done())
@@ -134,14 +143,30 @@ class DatasetBase:
                     pending.append(ex.submit(fn, nxt))
                 yield result
 
+    def _pool_map(self, fn):
+        """Thread-pool over file shards. FLAGS_cpu_deterministic keeps
+        emission in filelist order so losses reproduce the serial run
+        exactly; off = completion order for max overlap."""
+        from .flags import flag
+
+        yield from self._pool_map_items(
+            lambda item: fn(item[1], item[0]),
+            list(enumerate(self._filelist)),
+            ordered=flag("cpu_deterministic"))
+
     def _iter_samples_threaded(self):
         for samples in self._pool_map(self._file_samples):
             yield from samples
 
-    def _file_columns(self, path):
+    def _file_columns(self, path, _shard_index=0):
         """Columnar parse of one shard: ((vals, offs) per USED slot,
         n_rec) with set_hash_mod folds applied vectorized over the whole
-        value column — no per-record python objects anywhere."""
+        value column — no per-record python objects anywhere. The
+        MultiSlot text format has no CRC framing, so the recordio
+        containment policy and the `data_corrupt_shard`/
+        `data_stall_shard` chaos sites do NOT cover this path
+        (docs/DATA_PLANE.md) — `_shard_index` exists only to fit the
+        shared `_pool_map` item shape."""
         from .core import native
         from .parallel.host_embedding import fold_ids
 
@@ -224,12 +249,17 @@ class DatasetBase:
         if acc is not None and acc[1]:
             yield self._emit_columnar(acc[0], 0, acc[1], feed_names, pads)
 
-    def _batches_prefetched(self, depth=4):
+    def _batches_prefetched(self, depth=4, source=None):
         """Producer-thread batch prefetch: host parsing/batching overlaps
-        the device step (the BufferedReader/double-buffer shape, C17)."""
+        the device step (the BufferedReader/double-buffer shape, C17).
+        `source` overrides the generator being prefetched (the resumable
+        path prefetches `(batch, cursor-state)` PAIRS through the same
+        queue so cursor application stays on the consumer side)."""
         import queue
         import threading
 
+        if source is None:
+            source = self._batches()
         q = queue.Queue(maxsize=depth)
         sentinel = object()
         stop = threading.Event()
@@ -237,7 +267,7 @@ class DatasetBase:
 
         def produce():
             try:
-                for b in self._batches():
+                for b in source:
                     # bounded put that notices an abandoned consumer, so
                     # a mid-epoch exception in the training loop doesn't
                     # leave this thread blocked forever holding batches
@@ -262,7 +292,8 @@ class DatasetBase:
                     except queue.Full:
                         continue
 
-        t = threading.Thread(target=produce, daemon=True)
+        t = threading.Thread(target=produce, daemon=True,
+                             name="ptpu-dataset-prefetch")
         t.start()
         try:
             while True:
@@ -330,6 +361,107 @@ class DatasetBase:
 
     def _iter_samples(self):
         raise NotImplementedError
+
+    # -- mid-epoch resumable ingestion (docs/DATA_PLANE.md) ---------------
+    def _shard_samples_seq(self, order, start_si):
+        """Yield `(si, samples)` for `order[start_si:]` IN ORDER; with
+        `set_thread(N)` the shard parses overlap on the shared
+        `_pool_map_items` window, FORCE-ordered — the resumable
+        stream's order is part of the cursor contract, so results are
+        consumed strictly in shard order and the output is bitwise the
+        serial parse's."""
+        def parse(si):
+            real = order[si]
+            return si, self._file_samples(self._filelist[real],
+                                          shard_index=real)
+
+        yield from self._pool_map_items(parse,
+                                        range(start_si, len(order)),
+                                        ordered=True)
+
+    def _resumable_pairs(self, start, epochs):
+        """Producer for the resumable stream: yields
+        `(feed_dict, (epoch, shard_idx, record_offset))` where the
+        position names the first record NOT in any batch yielded so
+        far. Shard order per epoch comes from the cursor's seed
+        (`data_plane.shard_order`); within an epoch batches cross
+        shard boundaries exactly like the legacy `_batches` stream, so
+        a fresh cursor with no seed reproduces it bitwise — but a
+        partial tail batch FLUSHES at each epoch end (matching legacy
+        per-epoch iteration); batches never span epochs."""
+        feed_names = [v.name for v in self._use_var]
+        pads = self._pad_values()
+        bs = self._batch_size
+        epoch = start.epoch
+        shard_idx = start.shard_idx
+        offset = start.record_offset
+        while epochs is None or epoch < epochs:
+            order = start.shard_order(len(self._filelist), epoch=epoch)
+            batch = []
+            for si, samples in self._shard_samples_seq(order, shard_idx):
+                consumed = offset
+                for sample in samples[offset:]:
+                    batch.append(sample)
+                    consumed += 1
+                    if len(batch) == bs:
+                        # normalize a batch ending exactly on the
+                        # epoch's last record to the next epoch's start
+                        pos = ((epoch + 1, 0, 0)
+                               if (si == len(order) - 1
+                                   and consumed == len(samples))
+                               else (epoch, si, consumed))
+                        yield (self._to_feed(feed_names, batch, pads),
+                               pos)
+                        batch = []
+                offset = 0
+            if batch:
+                # epoch tail (the legacy partial batch): the next
+                # position is the following epoch's first record
+                yield (self._to_feed(feed_names, batch, pads),
+                       (epoch + 1, 0, 0))
+            epoch += 1
+            shard_idx = 0
+
+    def _resumable_stream(self, cursor, epochs, prefetch):
+        """The raw `(feed, position)` pair stream behind
+        `resumable_batches` (host prefetch applied, cursor NOT yet
+        attached) — for consumers like `Executor.train_from_dataset`
+        whose device-side lookahead pulls batches ahead of their steps:
+        they must apply each pair's position at the true consumption
+        point themselves, or the mirrored cursor runs a batch ahead."""
+        from .observability import metrics as obs_metrics
+
+        if cursor.position() != (0, 0, 0):
+            obs_metrics.counter("data/cursor_resumes").inc()
+        if prefetch is None:
+            prefetch = self._thread > 1
+        pairs = self._resumable_pairs(cursor.clone(), epochs)
+        if prefetch:
+            pairs = self._batches_prefetched(source=pairs)
+        return pairs
+
+    def resumable_batches(self, cursor, epochs=None, scope=None,
+                          prefetch=None):
+        """The checkpoint-resumable batch stream (docs/DATA_PLANE.md):
+        starts at `cursor`'s position and ADVANCES the cursor as each
+        batch is consumed — never as it is prefetched — so a scope
+        snapshot/checkpoint taken between batches names exactly the
+        first unconsumed record, and a restored run resumes the
+        byte-identical stream. `scope` mirrors the cursor into
+        ``__data_cursor__`` on every consumption (this is how the
+        cursor rides the PR-4 checkpoint manifest with no format
+        change). `epochs` is the ABSOLUTE epoch bound of the stream;
+        default = one pass from the cursor's CURRENT epoch, so a
+        restored epoch-k cursor resumes the rest of epoch k instead of
+        silently yielding nothing against a stale absolute bound. A
+        fresh cursor (seed None) yields bitwise the legacy
+        `_batches()` stream."""
+        from . import data_plane
+
+        if epochs is None:
+            epochs = cursor.epoch + 1
+        pairs = self._resumable_stream(cursor, epochs, prefetch)
+        return data_plane.apply_cursor(pairs, cursor, scope)
 
 
 class QueueDataset(DatasetBase):
@@ -427,6 +559,22 @@ class InMemoryDataset(DatasetBase):
     def _iter_samples(self):
         assert self._samples is not None, "call load_into_memory first"
         return iter(self._samples)
+
+    def _resumable_stream(self, cursor, epochs, prefetch):
+        """Not supported: the `DatasetCursor` names a position in the
+        deterministic ON-DISK shard order, but an InMemoryDataset
+        trains from its loaded — usually shuffled or globally
+        redistributed — sample list. Re-reading the files here would
+        silently resume a DIFFERENT stream than the one trained on, so
+        this raises instead (covering both `resumable_batches` and
+        `Executor.train_from_dataset(cursor=)`, which drive the same
+        stream). Use a QueueDataset for mid-epoch resumable ingestion
+        (docs/DATA_PLANE.md)."""
+        raise NotImplementedError(
+            "InMemoryDataset does not support resumable batch streams: "
+            "a DatasetCursor positions the on-disk shard stream, not a "
+            "shuffled/redistributed in-memory sample list. Use a "
+            "QueueDataset for resumable ingestion (docs/DATA_PLANE.md).")
 
 
 class DatasetFactory:

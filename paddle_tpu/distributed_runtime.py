@@ -690,7 +690,8 @@ def run_pserver(program, scope, endpoint, executor_place=None):
 MSG_SAMPLES = 10
 
 
-def exchange_samples(endpoints, rank, outgoing, timeout=300.0):
+def exchange_samples(endpoints, rank, outgoing, timeout=None,
+                     strict=None, retry_budget=None, peer_timeout=None):
     """All-to-all redistribution of serialized sample records over the
     framed-TCP protocol: worker w ends up with every record of every
     worker's ``outgoing[w]``. Each worker listens on endpoints[rank] and
@@ -700,21 +701,65 @@ def exchange_samples(endpoints, rank, outgoing, timeout=300.0):
     (source rank, position), so callers get a deterministic base order
     to seed their local shuffle from.
 
+    Peer-loss degradation (docs/DATA_PLANE.md "Degradation contract")
+    runs on two clocks, because the two failure shapes carry different
+    evidence. A peer we could NEVER CONNECT to may simply still be
+    loading — startup skew is not death evidence — so connection
+    establishment retries (exponential backoff, metered in
+    `data/peer_retries`) until the FULL exchange deadline ``timeout``
+    ($PTPU_DATA_EXCHANGE_TIMEOUT, default 300 s), the legacy tolerance.
+    A peer that ACCEPTED a connection but failed the frame (wedged
+    before acking, torn frame) is provably up and misbehaving: those
+    failures burn a bounded budget of ``retry_budget``
+    ($PTPU_DATA_RETRY_BUDGET) + 1 attempts of ``peer_timeout``
+    ($PTPU_DATA_PEER_TIMEOUT) seconds each. A peer past its clock is
+    CONFIRMED DEAD: by default the exchange degrades — each survivor
+    keeps the bucket it owed the dead peer in its own result set (every
+    record stays placed exactly once, by its loader, and the dead
+    peer's share spreads ~1/world per survivor), and
+    `data/peer_failovers` / `data/peer_retries` meter the event. The
+    dead peer's OWN loaded samples are the only loss — exactly the
+    records a crashed machine takes with it. A peer that ACKED our
+    sends but never delivered its own frame is different: it provably
+    holds the bucket we sent, so re-keeping that bucket would duplicate
+    records — such a silent peer gets the FULL exchange deadline, and
+    if it stays silent only its own records are lost (metered and
+    warned, nothing re-kept). ``strict=True`` (or $PTPU_DATA_STRICT=1)
+    aborts with `resilience.RetryBudgetExceededError` (send side) /
+    `TimeoutError` (silent side) instead, for jobs where a short epoch
+    is worse than no epoch.
+
     Trust model: same as the pserver runtime (private training network;
     the framed protocol carries no code, only length-prefixed bytes)."""
     import socket
     import struct as _struct
     import threading
     import time as _time
+    import warnings as _warnings
 
+    from .flags import env as _env
+    from .observability import metrics as _metrics
+    from .resilience import (RetryBudgetExceededError, is_transient_error,
+                             maybe_inject_peer_death)
+
+    maybe_inject_peer_death(rank)
     world = len(endpoints)
     if world == 1:
         return list(outgoing[0])
+    if strict is None:
+        strict = bool(_env("PTPU_DATA_STRICT"))
+    if retry_budget is None:
+        retry_budget = int(_env("PTPU_DATA_RETRY_BUDGET"))
+    if peer_timeout is None:
+        peer_timeout = float(_env("PTPU_DATA_PEER_TIMEOUT"))
+    if timeout is None:
+        timeout = float(_env("PTPU_DATA_EXCHANGE_TIMEOUT"))
     from .analysis.concurrency import make_lock
 
     received = {}
     recv_lock = make_lock("dist.shuffle.recv")
     all_in = threading.Event()
+    closing = threading.Event()
 
     def _pack(records):
         return b"".join(_struct.pack("<I", len(r)) + r for r in records)
@@ -733,21 +778,46 @@ def exchange_samples(endpoints, rank, outgoing, timeout=300.0):
     srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     srv.bind((host, int(port)))
     srv.listen(world)
+    # a finite accept timeout lets the serve loop notice all_in/close:
+    # a thread parked in accept() does NOT reliably wake when another
+    # thread closes the listener, and a stuck acceptor holds a stale fd
+    # across the close (observed: poisoned a later bind on this port)
+    srv.settimeout(0.1)
 
     def _serve():
-        pending = world - 1
-        while pending:
-            conn, _ = srv.accept()
+        # accept until the owner closes the exchange — NOT merely until
+        # every peer has delivered: a peer whose ack was lost on the
+        # wire retries its frame, and if nobody accepts that retry the
+        # peer falsely declares THIS rank dead and re-keeps a bucket we
+        # already placed (fleet-wide duplication). The keyed overwrite
+        # below makes the re-delivery idempotent. A peer dying
+        # MID-FRAME must not kill the serve loop either — the remaining
+        # peers still need their acks.
+        while not closing.is_set():
             try:
-                mtype, meta, payload = _read_msg(conn)
-                if mtype != MSG_SAMPLES:
-                    raise ConnectionError("unexpected msg %d" % mtype)
-                with recv_lock:
-                    received[int(meta["src"])] = _unpack(payload)
-                    if len(received) == world - 1:
-                        all_in.set()
-                _write_msg(conn, MSG_OK, {})
-                pending -= 1
+                conn, _ = srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed by the owner
+            # accepted sockets inherit the listener's 0.1s poll timeout;
+            # give frame reads a real bound instead (a sender that stops
+            # mid-frame for this long is dead — drop it, it will retry)
+            conn.settimeout(max(1.0, peer_timeout))
+            try:
+                try:
+                    mtype, meta, payload = _read_msg(conn)
+                    if mtype != MSG_SAMPLES:
+                        continue
+                    with recv_lock:
+                        # keyed overwrite: a retried frame after a lost
+                        # ack re-delivers the identical records
+                        received[int(meta["src"])] = _unpack(payload)
+                        if len(received) == world - 1:
+                            all_in.set()
+                    _write_msg(conn, MSG_OK, {})
+                except (ConnectionError, OSError):
+                    pass  # torn frame: the sender retries or dies
             finally:
                 conn.close()
 
@@ -756,41 +826,198 @@ def exchange_samples(endpoints, rank, outgoing, timeout=300.0):
     server.start()
 
     deadline = _time.monotonic() + timeout
-    try:
-        for dst in range(world):
-            if dst == rank:
-                continue
-            payload = _pack(outgoing[dst])
-            dhost, dport = endpoints[dst].rsplit(":", 1)
-            while True:  # the peer's listener may not be up yet
-                try:
-                    s = socket.create_connection((dhost, int(dport)),
-                                                 timeout=10.0)
-                    break
-                except OSError:
-                    if _time.monotonic() > deadline:
-                        raise TimeoutError(
-                            "global_shuffle: worker %d unreachable at %s"
-                            % (dst, endpoints[dst]))
-                    _time.sleep(0.2)
+
+    def _send_to_peer(dst, payload):
+        """One peer's delivery: returns True on ack, False once the
+        peer is confirmed dead. Two clocks (see the function docstring):
+        connection-establishment failures — the listener isn't up —
+        retry until the FULL exchange deadline, because a slow-loading
+        but healthy peer refused here would silently skew the epoch's
+        sample distribution; frame failures after a successful connect
+        (wedged before acking, torn frame) prove the peer is up and
+        burn the bounded retry budget, so one wedged peer cannot starve
+        every later peer's window. Transient failures (socket-level, or
+        anything `is_transient_error` classifies) back off
+        exponentially between attempts."""
+        dhost, dport = endpoints[dst].rsplit(":", 1)
+        frame_budget = max(1, retry_budget + 1)
+        frame_failures = 0
+        attempt = 0
+        while True:
+            if attempt:
+                _metrics.counter("data/peer_retries").inc()
+                _time.sleep(min(0.2 * (2.0 ** min(attempt - 1, 4)), 2.0,
+                                max(0.0,
+                                    deadline - _time.monotonic())))
+            attempt += 1
+            s = None
             try:
+                try:
+                    s = socket.create_connection(
+                        (dhost, int(dport)),
+                        timeout=max(0.05, min(
+                            peer_timeout,
+                            deadline - _time.monotonic())))
+                except OSError:
+                    if _time.monotonic() >= deadline:
+                        return False
+                    continue
+                # frame I/O is bounded by ONE attempt's budget, not the
+                # whole exchange deadline — a peer that accepts but
+                # wedges before acking must cost one attempt, not starve
+                # every later peer's attempts into false death verdicts
+                s.settimeout(max(0.05, min(
+                    peer_timeout, deadline - _time.monotonic())))
                 _write_msg(s, MSG_SAMPLES,
-                           {"src": rank, "nbytes": len(payload)}, payload)
+                           {"src": rank, "nbytes": len(payload)},
+                           payload)
+                # past this point delivery is AMBIGUOUS: the receiver
+                # stores the bucket BEFORE acking, so a lost/late ack
+                # can mean the peer already placed these records
+                maybe_delivered.add(dst)
                 mtype, _, _ = _read_msg(s)
                 if mtype != MSG_OK:
                     raise ConnectionError("exchange not acked")
+                return True
+            except Exception as e:  # noqa: BLE001 — classified below
+                if not (isinstance(e, (ConnectionError, OSError,
+                                       TimeoutError, socket.timeout))
+                        or is_transient_error(e)):
+                    raise
+                frame_failures += 1
+                if (frame_failures >= frame_budget
+                        or _time.monotonic() >= deadline):
+                    return False
             finally:
-                s.close()
-        if not all_in.wait(max(0.0, deadline - _time.monotonic())):
-            missing = sorted(set(range(world)) - {rank}
-                             - set(received))
+                if s is not None:
+                    s.close()
+
+    dead = set()
+    try:
+        # parallel delivery: every peer shares the SAME wall-clock
+        # deadline CONCURRENTLY. A sequential loop here let one
+        # never-connecting peer burn the whole exchange deadline and
+        # hand every later healthy peer a ~0s window — false death
+        # verdicts for a healthy fleet (and in strict mode, an abort
+        # naming the wrong worker)
+        send_ok = {}
+        send_exc = {}
+        # dsts whose frame was fully written at least once (each dst is
+        # touched by exactly one sender thread; read only after join)
+        maybe_delivered = set()
+
+        def _send_worker(dst):
+            try:
+                send_ok[dst] = _send_to_peer(dst, _pack(outgoing[dst]))
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                send_exc[dst] = e
+
+        senders = []
+        for dst in range(world):
+            if dst == rank:
+                continue
+            t = threading.Thread(target=_send_worker, args=(dst,),
+                                 name="ptpu-shuffle-send-%d" % dst,
+                                 daemon=True)
+            t.start()
+            senders.append(t)
+        for t in senders:
+            t.join(timeout=max(5.0, deadline - _time.monotonic()
+                               + peer_timeout + 5.0))
+        for dst in sorted(send_exc):  # non-transient: deterministic raise
+            raise send_exc[dst]
+        for dst in range(world):
+            if dst == rank:
+                continue
+            if not send_ok.get(dst, False):
+                if strict:
+                    raise RetryBudgetExceededError(
+                        "global_shuffle: worker %d at %s confirmed "
+                        "dead (no ack within the %.0fs exchange "
+                        "deadline / %d-attempt frame budget; "
+                        "PTPU_DATA_STRICT aborts on peer loss)"
+                        % (dst, endpoints[dst], timeout,
+                           max(1, retry_budget + 1)))
+                dead.add(dst)
+                _metrics.counter("data/peer_failovers").inc()
+                if dst in maybe_delivered:
+                    # the frame was fully written on some attempt and
+                    # only the ack is missing — the peer may have
+                    # ALREADY placed the bucket (it stores before
+                    # acking), so re-keeping it risks fleet-wide
+                    # duplication. Degraded mode prefers a metered loss
+                    # over a silent skew: the bucket is NOT re-kept,
+                    # mirroring the silent-after-ack verdict below
+                    _warnings.warn(
+                        "global_shuffle: worker %d at %s confirmed dead "
+                        "after our frame was delivered but not acked — "
+                        "its %d-record bucket may already be placed "
+                        "there, NOT re-keeping it (duplication risk), "
+                        "continuing degraded"
+                        % (dst, endpoints[dst], len(outgoing[dst])),
+                        RuntimeWarning)
+                else:
+                    _warnings.warn(
+                        "global_shuffle: worker %d at %s confirmed dead "
+                        "(no ack within the %.0fs exchange deadline / "
+                        "%d-attempt frame budget) — keeping its "
+                        "%d-record bucket locally and continuing "
+                        "degraded"
+                        % (dst, endpoints[dst], timeout,
+                           max(1, retry_budget + 1),
+                           len(outgoing[dst])), RuntimeWarning)
+        # receive: a peer that ACKED our sends is alive — its frame
+        # deserves the full exchange deadline (declaring a slow loader
+        # dead here would DUPLICATE the bucket it already received from
+        # us: it would place those records AND we would re-keep them).
+        # Send-confirmed-dead peers never connect, so their frames get
+        # only a bounded grace (a straggler frame sent before death).
+        def _wait_frames(targets, until):
+            while targets:
+                with recv_lock:
+                    if targets <= set(received):
+                        return
+                if all_in.is_set() or _time.monotonic() >= until:
+                    return
+                _time.sleep(0.02)
+
+        expected = set(range(world)) - {rank} - dead
+        _wait_frames(expected, deadline)
+        if dead:
+            grace = min(max(0.0, deadline - _time.monotonic()),
+                        peer_timeout * max(1, retry_budget + 1))
+            _wait_frames(set(dead), _time.monotonic() + grace)
+        with recv_lock:
+            silent = sorted(expected - set(received))
+        if silent and strict:
             raise TimeoutError(
-                "global_shuffle: no samples received from workers %s"
-                % missing)
+                "global_shuffle: no samples received from workers "
+                "%s" % silent)
+        for src in silent:
+            _metrics.counter("data/peer_failovers").inc()
+            _warnings.warn(
+                "global_shuffle: worker %d acked our samples but went "
+                "silent — its own records are lost for this epoch; the "
+                "bucket we delivered to it is NOT re-kept (the peer "
+                "holds it), continuing degraded" % src, RuntimeWarning)
     finally:
+        closing.set()  # unblock the serve loop's accept-exit check
         srv.close()
+        # a thread inside accept()/recv() pins the listener fd past
+        # close() — wait it out so the port is genuinely released
+        # before the caller (or a retry) binds it again
+        server.join(timeout=max(2.0, peer_timeout + 1.0))
     out = []
     for src in range(world):
         out.extend(outgoing[rank] if src == rank
                    else received.get(src, []))
+    # deterministic re-partition: the buckets owed to dead peers stay
+    # with their loader, appended in (dead rank, position) order so the
+    # caller's seeded shuffle sees one reproducible base stream.
+    # Ambiguously-delivered buckets (frame written, ack lost) are NOT
+    # re-kept — the peer may hold them already, and at-most-once beats
+    # a silent sample-distribution skew (warned above)
+    for dst in sorted(dead):
+        if dst not in maybe_delivered:
+            out.extend(outgoing[dst])
     return out

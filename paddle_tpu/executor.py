@@ -538,13 +538,25 @@ class Executor:
 
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
-                           fetch_info=None, print_period=100):
+                           fetch_info=None, print_period=100,
+                           cursor=None, epochs=None):
         """Drive a whole Dataset through the program (parity: executor.py:851
         → C++ MultiTrainer/HogwildWorker trainer.h:71/C15). The reference's
         thread-per-core Hogwild becomes a reader thread pool over file
         shards (thread= here or dataset.set_thread) parsing on the host
         while the single jitted step owns the device;
-        FLAGS_cpu_deterministic serializes emission to filelist order."""
+        FLAGS_cpu_deterministic serializes emission to filelist order.
+
+        `cursor` (a `data_plane.DatasetCursor`) switches to the
+        checkpoint-resumable stream (docs/DATA_PLANE.md): batches start
+        at the cursor's position, and the cursor — mirrored into the
+        run scope's ``__data_cursor__`` as each batch is consumed — is
+        what a later restore resumes the byte-identical stream from.
+        `epochs` is the ABSOLUTE epoch bound of that stream (the
+        `resumable_batches` contract); default = one pass from the
+        cursor's current epoch, so a restored epoch-k cursor trains the
+        rest of epoch k rather than silently yielding nothing.
+        No cursor = the exact legacy path."""
         if dataset is None:
             raise ValueError("train_from_dataset needs a dataset")
         if thread:
@@ -555,15 +567,45 @@ class Executor:
                        for v in fetch_list]
         step = 0
         last = None
-        batches = (dataset._batches_prefetched()
-                   if getattr(dataset, "_thread", 1) > 1
-                   else dataset._batches())
+        cursor_states = None
+        if cursor is not None:
+            from collections import deque
+
+            from .core.scope import global_scope
+
+            cursor_scope = scope if scope is not None else global_scope()
+            if epochs is None:
+                epochs = cursor.epoch + 1
+            pair_stream = dataset._resumable_stream(cursor, epochs, None)
+            cursor_states = deque()
+
+            def _feeds():
+                for feed, state in pair_stream:
+                    cursor_states.append(state)
+                    yield feed
+
+            batches = _feeds()
+        elif epochs is not None:
+            raise ValueError("epochs= only applies to the cursor path; "
+                             "re-run train_from_dataset per epoch on "
+                             "the legacy stream")
+        else:
+            batches = (dataset._batches_prefetched()
+                       if getattr(dataset, "_thread", 1) > 1
+                       else dataset._batches())
         # H2D lookahead: while the device runs batch k, a background
         # thread device_puts batch k+1 (same contract as PyReader's
         # double buffer, here for the Dataset path)
         device_feeder = FeedPrefetcher(sharding_fn=self._feed_sharding)
         try:
             for feed in prefetch_iter(batches, device_feeder):
+                if cursor_states is not None:
+                    # consumption point: the lookahead above has already
+                    # PULLED batch k+1, but the mirrored cursor may only
+                    # advance as batch k is taken for its step — else a
+                    # checkpoint would name a position one batch ahead
+                    cursor.advance_to(*cursor_states.popleft())
+                    cursor.write_to(cursor_scope)
                 last = self.run(program, feed=feed, fetch_list=fetch_list,
                                 scope=scope)
                 step += 1

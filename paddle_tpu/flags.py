@@ -221,6 +221,27 @@ _declare("PTPU_RETRY_BUDGET", "int", 8,
          "rollback-and-retry attempts per training run")
 _declare("PTPU_RETRY_BACKOFF", "float", 0.05,
          "base seconds of exponential backoff between transient retries")
+# -- streaming data plane (docs/DATA_PLANE.md) ------------------------------
+_declare("PTPU_DATA_ANOMALY_POLICY", "str", None,
+         "corrupt-input containment policy for recordio shard readers "
+         "(abort|skip_record|quarantine_shard; unset = skip_record)")
+_declare("PTPU_DATA_STRICT", "bool", False,
+         "abort the sample exchange on a confirmed-dead shuffle peer "
+         "instead of re-partitioning across the survivors")
+_declare("PTPU_DATA_RETRY_BUDGET", "int", 2,
+         "frame retries per CONNECTED shuffle peer (wedged before ack, "
+         "torn frame) before it is confirmed dead; never-connected "
+         "peers are governed by PTPU_DATA_EXCHANGE_TIMEOUT instead")
+_declare("PTPU_DATA_PEER_TIMEOUT", "float", 10.0,
+         "seconds one shuffle-peer connection attempt / frame "
+         "send+ack may take; also sizes the bounded straggler grace "
+         "for SEND-CONFIRMED-DEAD peers' frames (acked-but-silent "
+         "peers get the full PTPU_DATA_EXCHANGE_TIMEOUT — a slow "
+         "loader holding our bucket is not a dead one)")
+_declare("PTPU_DATA_EXCHANGE_TIMEOUT", "float", 300.0,
+         "full sample-exchange deadline; a never-connected peer "
+         "(listener not up — startup skew or a crashed machine) is "
+         "only confirmed dead at this deadline, the legacy tolerance")
 # -- serving (docs/SERVING.md) ----------------------------------------------
 _declare("PTPU_SERVE_ASYNC_STEPS", "int", 4,
          "decode steps kept in flight ahead of EOS/stream materialization")

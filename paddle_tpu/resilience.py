@@ -61,6 +61,8 @@ __all__ = [
     "POLICIES", "anomaly_policy", "AnomalyDetector", "AnomalousStepError",
     "RetryBudgetExceededError", "InjectedTransientError",
     "InjectedReplicaDeathError", "maybe_inject_serve_fault",
+    "InjectedPeerDeathError", "maybe_inject_peer_death",
+    "maybe_inject_shard_fault",
     "is_transient_error", "FaultInjector", "global_injector",
     "set_global_injector", "PreemptionGuard", "ScopeSnapshot",
     "snapshot_scope", "restore_scope_snapshot", "TrainResult",
@@ -246,11 +248,26 @@ class FaultInjector:
                                 raising, until the replica is aborted
                                 or closed — the watchdog failure mode
                                 exceptions cannot model
+
+    Data-plane sites (docs/DATA_PLANE.md): shard sites key on the
+    shard's index in the dataset filelist, the peer site on the
+    exchanging worker's rank:
+
+      data_corrupt_shard:N      shard N's chunks all fail CRC
+                                verification (containment policy path)
+      data_stall_shard:N        opening shard N stalls briefly without
+                                failing (slow-reader path — the
+                                prefetch window must absorb it)
+      data_peer_die_at_exchange:K
+                                the rank-K worker dies at the top of
+                                `exchange_samples` — survivors must
+                                confirm the loss and re-partition
     """
 
     STEP_SITES = ("nan_at_step", "sigterm_at_step", "transient_at_step",
                   "serve_die_at_step", "serve_transient_at_step",
-                  "serve_stall_at_step")
+                  "serve_stall_at_step", "data_corrupt_shard",
+                  "data_stall_shard", "data_peer_die_at_exchange")
     OCCURRENCE_SITES = ("transient_compile", "ckpt_torn_write")
 
     def __init__(self, spec=None):
@@ -383,6 +400,40 @@ def maybe_inject_serve_fault(step):
             "step %d (PTPU_FAULT_INJECT serve_transient_at_step)"
             % int(step))
     if inj.fire_at_step("serve_stall_at_step", step):
+        return "stall"
+    return None
+
+
+class InjectedPeerDeathError(RuntimeError):
+    """What the `data_peer_die_at_exchange` site raises in the armed
+    rank's `exchange_samples` — that worker drops out before binding
+    its listener, so its peers observe exactly what a crashed machine
+    looks like: refused connections and a missing sample frame."""
+
+
+def maybe_inject_peer_death(rank):
+    """`exchange_samples` entry hook (docs/DATA_PLANE.md): the armed
+    rank dies before it binds its listener or sends a byte."""
+    inj = global_injector()
+    if inj.active() and inj.fire_at_step("data_peer_die_at_exchange",
+                                         rank):
+        raise InjectedPeerDeathError(
+            "injected shuffle-peer death at rank %d (PTPU_FAULT_INJECT "
+            "data_peer_die_at_exchange)" % int(rank))
+
+
+def maybe_inject_shard_fault(shard_index):
+    """Shard-reader open hook (docs/DATA_PLANE.md): ``"corrupt"`` when
+    `data_corrupt_shard` fires for this shard index (every chunk then
+    fails CRC verification, exercising the containment policy on intact
+    bytes), ``"stall"`` when `data_stall_shard` fires (the reader naps
+    briefly — the prefetch window's job to absorb), else None."""
+    inj = global_injector()
+    if not inj.active():
+        return None
+    if inj.fire_at_step("data_corrupt_shard", shard_index):
+        return "corrupt"
+    if inj.fire_at_step("data_stall_shard", shard_index):
         return "stall"
     return None
 
@@ -701,10 +752,23 @@ class ResilientTrainer:
     def _rollback(self, result):
         """Restore the newest snapshot into the scope. The executor's
         in-flight window is already quiesced by the materialization that
-        preceded every rollback decision."""
+        preceded every rollback decision.
+
+        The data-plane cursor (``__data_cursor__``) is exempt: it
+        tracks the PULL frontier of the record stream, and a rollback
+        replays the window from the in-memory feed buffer — it never
+        re-reads the stream — so the frontier must survive the restore.
+        Rewinding it with the weights would leave the next boundary's
+        checkpoint one window behind the state it describes, and a
+        resume would double-train that window."""
         snap = self._snapshots[-1]
         with _tracing.span("resilience/rollback", step=snap.step):
+            from .data_plane import DatasetCursor
+
+            cursor_val = self.scope.get(DatasetCursor.SCOPE_KEY)
             restore_scope_snapshot(snap, self.scope)
+            if cursor_val is not None:
+                self.scope.set(DatasetCursor.SCOPE_KEY, cursor_val)
         if snap.aux is not None:
             # rewind the spike-EMA baseline too: the replay re-checks
             # the same healthy losses, which must not fold in twice

@@ -211,6 +211,73 @@ def test_ptpu_lint_flag_undocumented_fires():
     assert "flag-undocumented" in ptpu_lint.RULES
 
 
+def test_ptpu_lint_fault_site_literal_fires(tmp_path):
+    """ISSUE 15 satellite: fault-injection site literals must parse
+    under the registered injector grammar — a typo'd site passed to
+    `fire_at_step`/`fire_occurrence` silently never fires, and a
+    malformed PTPU_FAULT_INJECT spec literal never arms anything."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import ptpu_lint
+    finally:
+        sys.path.pop(0)
+    step, occ = ptpu_lint.injector_sites()
+    # the grammar is loaded from resilience.py by AST, not by import
+    assert "nan_at_step" in step and "data_corrupt_shard" in step
+    assert "ckpt_torn_write" in occ and "transient_compile" in occ
+    fixture = tmp_path / "fixture.py"
+    fixture.write_text(
+        "import os\n"
+        "def t(inj, monkeypatch):\n"
+        "    inj.fire_at_step('nan_at_stepp', 3)\n"       # typo
+        "    inj.fire_at_step('transient_compile', 1)\n"  # wrong kind
+        "    inj.fire_occurrence('ckpt_torn_write')\n"    # clean
+        "    inj.fire_at_step('data_corrupt_shard', 0)\n"  # clean
+        "    monkeypatch.setenv('PTPU_FAULT_INJECT', 'nan_at_step:x')\n"
+        "    os.environ['PTPU_FAULT_INJECT'] = 'bogus_site:1'\n"
+        "    a = {'PTPU_FAULT_INJECT': 'serve_die_at_step:2'}\n"
+        "    b = dict(os.environ, PTPU_FAULT_INJECT='nan-at-step:4')\n"
+        "    inj.fire_at_step(site='data_corupt_shard', step=1)\n"  # kw
+        "    inj.fire_occurrence(site='sigterm_at_step')\n"  # kw+kind
+        "    return a, b\n")
+    findings = ptpu_lint.lint_file(str(fixture),
+                                   ptpu_lint.declared_flag_names(), "")
+    hits = [f for f in findings if f.rule == "fault-site-literal"]
+    assert len(hits) == 6, findings
+    assert {f.line for f in hits} == {3, 4, 7, 8, 11, 12}
+    # FaultInjector(...) ctor literals are exempt (it validates loudly
+    # itself, and tests hand it garbage on purpose)
+    ctor = tmp_path / "ctor.py"
+    ctor.write_text("def t(resilience):\n"
+                    "    resilience.FaultInjector('explode_at_step:1')\n")
+    assert [f for f in ptpu_lint.lint_file(
+        str(ctor), ptpu_lint.declared_flag_names(), "")
+        if f.rule == "fault-site-literal"] == []
+    assert "fault-site-literal" in ptpu_lint.RULES
+
+
+def test_ptpu_lint_fault_site_literal_zero_repo_wide():
+    """The satellite's gate: zero fault-site-literal findings across
+    the WHOLE repo — source, tests, tools, bench and scripts-adjacent
+    python (the CI lint stage covers paddle_tpu/; site literals live
+    mostly in tests, so the repo-wide sweep is pinned here)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import ptpu_lint
+    finally:
+        sys.path.pop(0)
+    flags = ptpu_lint.declared_flag_names()
+    doc = ptpu_lint.documented_metric_names()
+    roots = [os.path.join(REPO, p)
+             for p in ("paddle_tpu", "tests", "tools", "bench.py",
+                       "examples", "benchmark")]
+    bad = []
+    for path in ptpu_lint.iter_py_files(roots):
+        bad.extend(f for f in ptpu_lint.lint_file(path, flags, doc)
+                   if f.rule == "fault-site-literal")
+    assert bad == [], "\n".join(str(f) for f in bad)
+
+
 def test_ptpu_lint_concurrency_rules_fire(tmp_path):
     """ISSUE 12: each of the four concurrency lint rules fires on a
     fixture, and the safe idioms (with-block, while-wait, wait_for,

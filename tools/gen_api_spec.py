@@ -27,6 +27,7 @@ NAMESPACES = [
     ("paddle_tpu.nets", None),
     ("paddle_tpu.observability", None),
     ("paddle_tpu.resilience", None),
+    ("paddle_tpu.data_plane", None),
     ("paddle_tpu.checkpoint", None),
     ("paddle_tpu.ir", None),
     ("paddle_tpu.amp", None),

@@ -33,6 +33,20 @@ Rules:
                can audit; the registry docstring alone is not
                documentation (mirrors metric-undocumented, but checked
                registry-side rather than call-site)
+  fault-site-literal
+               fault-injection site literals must parse under the
+               registered injector grammar (FaultInjector's
+               STEP_SITES/OCCURRENCE_SITES, loaded from resilience.py
+               BY AST): a site name passed to `fire_at_step`/
+               `fire_occurrence` must be registered in the matching
+               category (a typo'd site there silently never fires —
+               the hook just finds nothing armed), and any spec string
+               bound to the `PTPU_FAULT_INJECT` env key (setenv /
+               os.environ assignment / env-dict literal or keyword)
+               must parse as comma-separated `site:N` pairs.
+               `FaultInjector(...)` constructor literals are exempt:
+               the constructor validates its spec loudly itself (and
+               tests deliberately hand it garbage to pin that)
 
 Concurrency rules (docs/STATIC_ANALYSIS.md "Concurrency analysis" —
 receivers are judged by NAME: `lock`/`mu`/`mutex` and `*_lock`-style
@@ -83,6 +97,7 @@ import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FLAGS_PATH = os.path.join(REPO_ROOT, "paddle_tpu", "flags.py")
+RESILIENCE_PATH = os.path.join(REPO_ROOT, "paddle_tpu", "resilience.py")
 OBS_DOC_PATH = os.path.join(REPO_ROOT, "docs", "OBSERVABILITY.md")
 STATIC_DOC_PATH = os.path.join(REPO_ROOT, "docs", "STATIC_ANALYSIS.md")
 
@@ -97,6 +112,9 @@ RULES = {
                            "docs/OBSERVABILITY.md",
     "flag-undocumented": "every registry-declared PTPU_* flag must "
                          "appear in docs/ (or the README)",
+    "fault-site-literal": "fault-injection site literals must parse "
+                          "under the registered injector grammar "
+                          "(a typo'd site silently never fires)",
     "lock-with": "lock-like receivers are acquired via `with` (or "
                  "try/finally-released); no orphanable bare .acquire()",
     "cond-wait-loop": "condition-like .wait() must sit in a `while` "
@@ -163,6 +181,68 @@ def declared_flag_names():
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return set(mod.declared_flags())
+
+
+_SITES_CACHE = {}
+
+
+def injector_sites(path=RESILIENCE_PATH):
+    """(step_sites, occurrence_sites) of the registered FaultInjector
+    grammar, read from resilience.py BY AST — the module imports jax-
+    heavy packages, and the linter must never import the tree it
+    lints. Returns frozensets; empty when the class cannot be found
+    (the rule then reports nothing rather than everything)."""
+    if path in _SITES_CACHE:
+        return _SITES_CACHE[path]
+    try:
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return frozenset(), frozenset()
+    step, occ = frozenset(), frozenset()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.ClassDef)
+                and node.name == "FaultInjector"):
+            continue
+        for stmt in node.body:
+            if not isinstance(stmt, ast.Assign):
+                continue
+            names = {t.id for t in stmt.targets
+                     if isinstance(t, ast.Name)}
+            if not isinstance(stmt.value, ast.Tuple):
+                continue
+            vals = frozenset(
+                e.value for e in stmt.value.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, str))
+            if "STEP_SITES" in names:
+                step = vals
+            elif "OCCURRENCE_SITES" in names:
+                occ = vals
+    _SITES_CACHE[path] = (step, occ)
+    return step, occ
+
+
+def fault_spec_problems(spec, step_sites, occurrence_sites):
+    """Problems with one PTPU_FAULT_INJECT-style spec literal under the
+    registered grammar (comma/semicolon-separated `site:N`, dashes
+    normalized like FaultInjector does). Empty list = parses clean."""
+    known = step_sites | occurrence_sites
+    problems = []
+    for part in (spec or "").replace(";", ",").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        site, _, num = part.partition(":")
+        site = site.strip().replace("-", "_")
+        if site not in known:
+            problems.append("unknown site %r" % site)
+            continue
+        try:
+            int(num)
+        except ValueError:
+            problems.append("%r wants site:N" % part)
+    return problems
 
 
 def documented_metric_names():
@@ -255,12 +335,14 @@ def _const_str(node):
 
 class _Linter(ast.NodeVisitor):
     def __init__(self, path, flag_names, doc_text, is_flags_module,
-                 builder_scope):
+                 builder_scope, sites=None):
         self.path = path
         self.flag_names = flag_names
         self.doc_text = doc_text
         self.is_flags_module = is_flags_module
         self.builder_scope = builder_scope
+        self.step_sites, self.occurrence_sites = (
+            sites if sites is not None else injector_sites())
         self.findings = []
         self._func_stack = []
 
@@ -286,6 +368,54 @@ class _Linter(ast.NodeVisitor):
             if s is not None and s.startswith("PTPU_"):
                 return s
         return None
+
+    def _check_fault_spec(self, node, spec):
+        """A spec literal bound to the PTPU_FAULT_INJECT env key must
+        parse under the registered grammar."""
+        if spec is None or not (self.step_sites
+                                or self.occurrence_sites):
+            return
+        for problem in fault_spec_problems(spec, self.step_sites,
+                                           self.occurrence_sites):
+            self._add(node, "fault-site-literal",
+                      "PTPU_FAULT_INJECT spec %r: %s — registered "
+                      "sites: %s" % (spec, problem, ", ".join(
+                          sorted(self.step_sites
+                                 | self.occurrence_sites))))
+
+    def _check_fire_site(self, node, kind):
+        """`fire_at_step("site", ...)` / `fire_occurrence("site")`:
+        an unregistered literal silently never fires (the hook finds
+        nothing armed) — exactly the bug class this rule exists for.
+        The keyword spelling (`fire_at_step(site="...", ...)`) is
+        checked too."""
+        if not (self.step_sites or self.occurrence_sites):
+            return
+        site_arg = node.args[0] if node.args else next(
+            (kw.value for kw in node.keywords if kw.arg == "site"),
+            None)
+        site = _const_str(site_arg) if site_arg is not None else None
+        if site is None:
+            return
+        want = (self.step_sites if kind == "fire_at_step"
+                else self.occurrence_sites)
+        other = (self.occurrence_sites if kind == "fire_at_step"
+                 else self.step_sites)
+        if site in want:
+            return
+        if site in other:
+            self._add(node, "fault-site-literal",
+                      "site %r is registered for %s, not %s — this "
+                      "call can never fire" % (
+                          site,
+                          "occurrence keying" if kind == "fire_at_step"
+                          else "step keying", kind))
+        else:
+            self._add(node, "fault-site-literal",
+                      "site %r is not registered in FaultInjector's "
+                      "grammar — %s silently never fires (registered: "
+                      "%s)" % (site, kind,
+                               ", ".join(sorted(want))))
 
     # -- visitors ------------------------------------------------------
     def visit_FunctionDef(self, node):
@@ -318,6 +448,21 @@ class _Linter(ast.NodeVisitor):
                           "os.environ" % (key, key))
         self.generic_visit(node)
 
+    def visit_Assign(self, node):
+        # os.environ["PTPU_FAULT_INJECT"] = "<spec>"
+        for t in node.targets:
+            if isinstance(t, ast.Subscript) and _is_environ(t.value) \
+                    and _const_str(t.slice) == "PTPU_FAULT_INJECT":
+                self._check_fault_spec(node, _const_str(node.value))
+        self.generic_visit(node)
+
+    def visit_Dict(self, node):
+        # {"PTPU_FAULT_INJECT": "<spec>", ...} (subprocess env dicts)
+        for k, v in zip(node.keys, node.values):
+            if k is not None and _const_str(k) == "PTPU_FAULT_INJECT":
+                self._check_fault_spec(node, _const_str(v))
+        self.generic_visit(node)
+
     def visit_Call(self, node):
         func = node.func
         # os.environ.get("PTPU_...") / os.getenv("PTPU_...")
@@ -340,6 +485,11 @@ class _Linter(ast.NodeVisitor):
                               "os.getenv" % (key, key))
             elif func.attr in _ENV_CALL_NAMES:
                 self._check_env_name_arg(node)
+            elif func.attr in ("fire_at_step", "fire_occurrence"):
+                self._check_fire_site(node, func.attr)
+            elif func.attr == "setenv" and len(node.args) >= 2 \
+                    and _const_str(node.args[0]) == "PTPU_FAULT_INJECT":
+                self._check_fault_spec(node, _const_str(node.args[1]))
             # metric name literals: counter/gauge/histogram("a/b")
             if func.attr in ("counter", "gauge", "histogram") \
                     and node.args:
@@ -363,6 +513,10 @@ class _Linter(ast.NodeVisitor):
         elif isinstance(func, ast.Name):
             if func.id in _ENV_CALL_NAMES:
                 self._check_env_name_arg(node)
+        # PTPU_FAULT_INJECT="<spec>" keyword (dict(...)-built env maps)
+        for kw in node.keywords:
+            if kw.arg == "PTPU_FAULT_INJECT":
+                self._check_fault_spec(node, _const_str(kw.value))
         self.generic_visit(node)
 
 
@@ -566,7 +720,7 @@ def _concurrency_findings(tree, path):
     return findings
 
 
-def lint_file(path, flag_names, doc_text):
+def lint_file(path, flag_names, doc_text, sites=None):
     with open(path) as f:
         src = f.read()
     try:
@@ -577,7 +731,8 @@ def lint_file(path, flag_names, doc_text):
     is_flags = os.path.abspath(path) == FLAGS_PATH
     builder = any(("/%s/" % d.replace(os.sep, "/")) in norm
                   for d in _BUILDER_DIRS)
-    linter = _Linter(path, flag_names, doc_text, is_flags, builder)
+    linter = _Linter(path, flag_names, doc_text, is_flags, builder,
+                     sites=sites)
     linter.visit(tree)
     return linter.findings + _concurrency_findings(tree, path)
 
