@@ -412,7 +412,8 @@ def bench_serving(n_requests=32, max_new_tokens=24, rate=100000.0,
     deterministic — batching may never change what a request gets).
 
     Returns (batched_tps, serial_tps, outputs_match, p50_s, p99_s,
-    total_tokens)."""
+    total_tokens, batched_steps_per_sec, batched_step_flops) — the last
+    two feed the MFU receipt (step_flops is None with metrics off)."""
     from paddle_tpu import serving
 
     cfg = serving.GenerationConfig(
@@ -437,8 +438,13 @@ def bench_serving(n_requests=32, max_new_tokens=24, rate=100000.0,
     batched_outs = [r.wait(600) for r in accepted]
     dt_batched = time.perf_counter() - t0
     lats = sorted(r.latency for r in accepted)
+    steps_batched = sum(s["steps"] for s in eng.stats().values())
     eng.close()
     total_tokens = sum(len(o) for o in batched_outs)
+    # snapshot the batched engine's compiled-step flops BEFORE the
+    # serial engine compiles (the exec/step_flops gauge is
+    # last-writer-wins)
+    batched_step_flops = _current_step_flops()
 
     # serial leg: the identical stream, one request at a time (no
     # arrival sleeps — this measures pure serial decode capacity)
@@ -467,7 +473,35 @@ def bench_serving(n_requests=32, max_new_tokens=24, rate=100000.0,
     return (total_tokens / dt_batched,
             sum(len(o) for o in serial_outs) / dt_serial,
             batched_outs == serial_outs, pct(0.5), pct(0.99),
-            total_tokens)
+            total_tokens, steps_batched / dt_batched,
+            batched_step_flops)
+
+
+def _current_step_flops():
+    """The most recently compiled program's per-step flops
+    (``exec/step_flops``, published at compile time when metrics are
+    on; None with metrics off — the cost-analysis read never runs)."""
+    from paddle_tpu.observability import metrics as obs_metrics
+
+    if not obs_metrics.enabled():
+        return None
+    return obs_metrics.registry().to_dict().get(
+        "gauges", {}).get("exec/step_flops")
+
+
+def _mfu_extra(step_flops, steps_per_sec):
+    """MFU receipt for one leg: compiled-step flops against the
+    per-platform peak-FLOPs table (observability.cost). Returns the
+    --legs-out fields and publishes ``bench/mfu_pct``; {} when metrics
+    are off or the leg has no step cadence."""
+    if not step_flops or not steps_per_sec:
+        return {}
+    from paddle_tpu.observability import cost as obs_cost
+    from paddle_tpu.observability import metrics as obs_metrics
+
+    pct = obs_cost.mfu_pct(step_flops, steps_per_sec)
+    obs_metrics.registry().gauge("bench/mfu_pct").set(pct)
+    return {"step_flops": step_flops, "mfu_pct": round(pct, 4)}
 
 
 def bench_serving_fastpath(n_requests=10, max_new_tokens=8,
@@ -1188,11 +1222,15 @@ def main(argv=None):
         if not args.sync_only:
             async_tps, last_loss, async_step, _ = bench_transformer_fluid(
                 async_exec=True, **kw)
-            _leg("async", async_tps, async_step, last_loss)
+            _leg("async", async_tps, async_step, last_loss,
+                 **_mfu_extra(_current_step_flops(),
+                              1.0 / async_step if async_step else 0))
         hlo0 = _stablehlo_bytes()
         sync_tps, last_loss_sync, sync_step, compile_opt = \
             bench_transformer_fluid(async_exec=False, **kw)
-        _leg("sync", sync_tps, sync_step, last_loss_sync)
+        _leg("sync", sync_tps, sync_step, last_loss_sync,
+             **_mfu_extra(_current_step_flops(),
+                          1.0 / sync_step if sync_step else 0))
         hlo1 = _stablehlo_bytes()
         # the PTPU_NO_PROGRAM_OPT=1 leg: identical program through the
         # exact pre-pass-pipeline lowering path — its compile time, module
@@ -1224,11 +1262,15 @@ def main(argv=None):
                              or args.fleet_only):
         fp32_tps, fp32_loss, fp32_step, _ = bench_transformer_fluid(
             async_exec=False, dtype="float32", amp=False, **kw)
-        _leg("fp32", fp32_tps, fp32_step, fp32_loss)
+        _leg("fp32", fp32_tps, fp32_step, fp32_loss,
+             **_mfu_extra(_current_step_flops(),
+                          1.0 / fp32_step if fp32_step else 0))
         amp_tps, amp_loss, amp_step, _ = bench_transformer_fluid(
             async_exec=False, dtype="float32", amp=True, **kw)
         _leg("amp", amp_tps, amp_step, amp_loss,
-             speedup_vs_fp32=round(amp_tps / fp32_tps, 4))
+             speedup_vs_fp32=round(amp_tps / fp32_tps, 4),
+             **_mfu_extra(_current_step_flops(),
+                          1.0 / amp_step if amp_step else 0))
 
     # continuous-batching serving receipt (docs/SERVING.md): batched vs
     # serial aggregate tokens/s on the same Poisson stream + identity
@@ -1238,11 +1280,13 @@ def main(argv=None):
                                  or args.quant_only or args.spec_only
                                  or args.fleet_only):
         (serve_batched, serve_serial, serve_match, serve_p50,
-         serve_p99, serve_tokens) = bench_serving()
+         serve_p99, serve_tokens, serve_sps,
+         serve_flops) = bench_serving()
         _leg("serving_batched", serve_batched, 0.0,
              p50_latency_s=round(serve_p50, 4),
              p99_latency_s=round(serve_p99, 4),
-             outputs_match=bool(serve_match))
+             outputs_match=bool(serve_match),
+             **_mfu_extra(serve_flops, serve_sps))
         _leg("serving_serial", serve_serial, 0.0,
              speedup_batched_vs_serial=round(
                  serve_batched / serve_serial, 4))

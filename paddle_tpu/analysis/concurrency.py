@@ -738,6 +738,19 @@ def assert_clean():
     """Raise :class:`LockCheckError` if any violation accumulated."""
     vs = violations()
     if vs:
+        # passive flight-recorder hook: concurrency must stay importable
+        # without observability, and must never fail a clean process by
+        # failing to dump a dirty one
+        blackbox = sys.modules.get(
+            "paddle_tpu.observability.flight_recorder")
+        if blackbox is not None:
+            try:
+                blackbox.record_event("lock_check_failed",
+                                      violations=len(vs),
+                                      first=str(vs[0]))
+                blackbox.dump("lock_check_failed")
+            except Exception:
+                pass
         raise LockCheckError(vs)
 
 

@@ -55,8 +55,59 @@ AXIS_INDEX_SAFE_UNDER_PARTIAL_AUTO = _JAXLIB_VERSION >= (0, 5)
 MULTIPROCESS_CPU_COLLECTIVES = _JAXLIB_VERSION >= (0, 5)
 
 __all__ = ["shard_map", "optimization_barrier", "axis_index",
+           "compiled_cost_analysis", "compiled_memory_analysis",
            "AXIS_INDEX_SAFE_UNDER_PARTIAL_AUTO",
            "MULTIPROCESS_CPU_COLLECTIVES"]
+
+
+def compiled_cost_analysis(compiled):
+    """Normalized ``{metric: float}`` from an XLA executable's
+    ``cost_analysis()`` ('flops', 'bytes accessed', ...). jaxlib 0.4
+    returns a per-device LIST of dicts, newer releases a plain dict, and
+    some backends expose nothing — version drift is a data gap here
+    (return None), never an error, so instrumentation can call this
+    unconditionally on every compile-cache miss."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    out = {}
+    for k, v in ca.items():
+        try:
+            out[str(k)] = float(v)
+        except (TypeError, ValueError):
+            continue
+    return out or None
+
+
+def compiled_memory_analysis(compiled):
+    """Normalized buffer-footprint dict from ``memory_analysis()``
+    (CompiledMemoryStats fields, in bytes), or None where the backend/
+    jaxlib doesn't expose it. Same data-gap contract as
+    :func:`compiled_cost_analysis`."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    out = {}
+    for field in ("argument_size_in_bytes", "output_size_in_bytes",
+                  "temp_size_in_bytes", "alias_size_in_bytes",
+                  "generated_code_size_in_bytes"):
+        v = ma.get(field) if isinstance(ma, dict) else \
+            getattr(ma, field, None)
+        if v is None:
+            continue
+        try:
+            out[field] = float(v)
+        except (TypeError, ValueError):
+            continue
+    return out or None
 
 
 def _make_optimization_barrier():

@@ -52,6 +52,7 @@ import numpy as np
 
 from .analysis.concurrency import make_lock
 from .flags import env as _env
+from .observability import flight_recorder as _blackbox
 from .observability import metrics as _metrics
 from .recordio_writer import RecordFormatError, deserialize_sample
 
@@ -143,6 +144,7 @@ def _quarantine(path):
         _QUARANTINED.add(path)
     if new:
         _metrics.counter("data/shards_quarantined").inc()
+        _blackbox.record_event("shard_quarantined", shard=str(path))
     return new
 
 

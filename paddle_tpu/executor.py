@@ -272,6 +272,12 @@ class _CompiledStep:
             t2 = time.perf_counter()
         _metrics.histogram("compile_cache/trace_time").observe(t1 - t0)
         _metrics.histogram("compile_cache/compile_time").observe(t2 - t1)
+        if _metrics.enabled():
+            # per-step FLOPs/bytes from XLA's own cost model — the MFU
+            # receipts bench.py reports (docs/OBSERVABILITY.md)
+            from .observability import cost as _cost
+
+            _cost.publish(compiled)
         if _metrics.enabled():  # serialization is real work, not a no-op
             try:
                 # bytecode serialization, NOT as_text(): the pretty text

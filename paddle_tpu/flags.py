@@ -166,6 +166,14 @@ _declare("PTPU_TRACE", "bool", False,
          "enable tracing-span recording")
 _declare("PTPU_TRACE_DIR", "path", None,
          "enable spans and write <dir>/ptpu_trace.json at process exit")
+_declare("PTPU_METRICS_PORT", "int", None,
+         "serve live /metrics, /healthz and /varz on this loopback port "
+         "(0 = pick an ephemeral port; unset = no endpoint thread)")
+_declare("PTPU_BLACKBOX_DIR", "path", None,
+         "enable the flight recorder and write its crash dumps "
+         "(ptpu_blackbox_*.json) into this directory")
+_declare("PTPU_BLACKBOX_EVENTS", "int", None,
+         "flight-recorder ring capacity in events (default 4096)")
 # -- executor / async engine (docs/ASYNC_EXECUTION.md) ----------------------
 _declare("PTPU_ASYNC_STEPS", "int", 12,
          "async in-flight window depth before dispatch backpressures")

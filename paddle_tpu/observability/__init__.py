@@ -25,15 +25,15 @@ import atexit
 import os
 import time
 
-from . import metrics, tracing
+from . import flight_recorder, metrics, tracing
 from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
                       MetricsRegistry, counter, gauge, histogram, registry)
 from .tracing import span  # noqa: F401
 
-__all__ = ["metrics", "tracing", "span", "counter", "gauge", "histogram",
-           "registry", "enabled", "enable", "disable", "dump_metrics",
-           "dump_chrome_trace", "Counter", "Gauge", "Histogram",
-           "MetricsRegistry"]
+__all__ = ["metrics", "tracing", "flight_recorder", "span", "counter",
+           "gauge", "histogram", "registry", "enabled", "enable",
+           "disable", "dump_metrics", "dump_chrome_trace", "Counter",
+           "Gauge", "Histogram", "MetricsRegistry"]
 
 
 def enabled():
@@ -120,3 +120,10 @@ from .. import flags as _flags  # noqa: E402  (stdlib-only, cycle-free)
 
 if _flags.env("PTPU_METRICS_OUT") or _flags.env("PTPU_TRACE_DIR"):
     atexit.register(_exit_dumps)
+
+if _flags.env("PTPU_METRICS_PORT") is not None:
+    # live scrape surface, same conditional-startup pattern as the exit
+    # dumps: no flag, no import, no thread
+    from . import endpoint as _endpoint  # noqa: E402
+
+    _endpoint.start()

@@ -1,0 +1,94 @@
+"""Compiled-step cost accounting and MFU (model-FLOPs utilization).
+
+On every compile-cache miss the executor's AOT path and the serving
+model's step builders hand their freshly compiled executable here; XLA's
+per-executable ``cost_analysis()``/``memory_analysis()`` (read through
+the version-guarded ``core.jax_compat`` shims — absent APIs are a data
+gap, not an error) become gauges:
+
+  exec/step_flops           FLOPs of one compiled step
+  exec/step_bytes_accessed  bytes read+written per step (memory traffic)
+  exec/peak_hbm_bytes       argument+output+temp buffer footprint
+
+``mfu_pct`` is the Chinchilla/PaLM-era utilization headline:
+``step_flops * steps_per_sec / peak_flops``. The peak table is a
+NOMINAL per-platform figure (one chip, dense bf16 for accelerators; a
+token host figure for CPU so CI math stays finite and comparable run to
+run) — MFU here is for tracking regressions against yourself, not for
+cross-vendor marketing comparisons. bench.py publishes the
+``bench/mfu_pct`` gauge and per-leg receipts from these numbers.
+"""
+
+from ..core import jax_compat as _jax_compat
+
+__all__ = ["publish", "analyze", "peak_flops", "mfu_pct",
+           "PLATFORM_PEAK_FLOPS"]
+
+# nominal peak FLOPs per chip (dense bf16 class figures; CPU is a token
+# reference point, not a measured host capability)
+PLATFORM_PEAK_FLOPS = {
+    "tpu": 275e12,
+    "gpu": 312e12,
+    "cpu": 1e11,
+}
+
+
+def peak_flops(platform=None):
+    """The table entry for `platform` (default: the first jax device's
+    platform; unknown platforms fall back to the CPU figure)."""
+    if platform is None:
+        try:
+            import jax
+
+            platform = jax.devices()[0].platform
+        except Exception:
+            platform = "cpu"
+    return PLATFORM_PEAK_FLOPS.get(platform, PLATFORM_PEAK_FLOPS["cpu"])
+
+
+def analyze(compiled):
+    """{step_flops, step_bytes_accessed, peak_hbm_bytes} for one
+    compiled executable — only the keys the backend actually reports."""
+    out = {}
+    ca = _jax_compat.compiled_cost_analysis(compiled)
+    if ca:
+        if "flops" in ca:
+            out["step_flops"] = ca["flops"]
+        if "bytes accessed" in ca:
+            out["step_bytes_accessed"] = ca["bytes accessed"]
+    ma = _jax_compat.compiled_memory_analysis(compiled)
+    if ma:
+        out["peak_hbm_bytes"] = (
+            ma.get("argument_size_in_bytes", 0.0)
+            + ma.get("output_size_in_bytes", 0.0)
+            + ma.get("temp_size_in_bytes", 0.0))
+    return out
+
+
+def publish(compiled):
+    """Publish the exec/* gauges for `compiled` into the process-wide
+    registry (last compile wins — on a steady-state engine that is THE
+    step) and return the analysis dict. Callers gate on
+    metrics.enabled(); a backend reporting nothing publishes nothing."""
+    vals = analyze(compiled)
+    if not vals:
+        return vals
+    from . import metrics as _metrics
+
+    reg = _metrics.registry()
+    if "step_flops" in vals:
+        reg.gauge("exec/step_flops").set(vals["step_flops"])
+    if "step_bytes_accessed" in vals:
+        reg.gauge("exec/step_bytes_accessed").set(
+            vals["step_bytes_accessed"])
+    if "peak_hbm_bytes" in vals:
+        reg.gauge("exec/peak_hbm_bytes").set(vals["peak_hbm_bytes"])
+    return vals
+
+
+def mfu_pct(step_flops, steps_per_sec, platform=None):
+    """Model-FLOPs utilization percent against the platform peak."""
+    peak = peak_flops(platform)
+    if not step_flops or not steps_per_sec or peak <= 0:
+        return 0.0
+    return 100.0 * float(step_flops) * float(steps_per_sec) / peak

@@ -1,0 +1,177 @@
+"""Crash-safe flight recorder: the fleet's black box.
+
+A bounded ring of structured events fed by the failure-handling layers
+(`resilience.py`, `serving/engine.py`, `serving/router.py`,
+`data_plane.py`): step outcomes, replica health transitions
+healthy→suspect→dead, rollbacks, fault-injector firings, anomaly
+verdicts, KV-pool invariant results. On a fatal condition — uncaught
+worker death, `LockCheckError`/invariant violation, SIGTERM drain,
+`RetryBudgetExceededError` — the ring is dumped atomically (the
+checkpoint tmp+rename pattern) into `PTPU_BLACKBOX_DIR`, so every
+chaos-CI failure ships its own post-mortem artifact even when the
+process dies before atexit telemetry runs.
+
+Enablement contract (docs/OBSERVABILITY.md): OFF unless
+`PTPU_BLACKBOX_DIR` is set (or `enable()` is called) — when off,
+`record_event()` is a single bool check and the ring is never
+allocated, so the defaults-off hot path is identical to a build without
+this module. Event-type literals passed to `record_event()` are linted
+against the docs (`event-undocumented`, tools/ptpu_lint.py) exactly
+like metric names.
+
+Locking: one leaf lock guards the ring (created through
+`analysis.concurrency.make_lock` when the tracker is importable, so
+`PTPU_LOCK_CHECK=1` orders it). Callers hold scheduler/router locks
+while recording; the recorder itself takes nothing else, so every edge
+points INTO this lock and no cycle is possible. `dump()` must stay
+safe to call from exception handlers and the concurrency tracker's own
+failure path — it touches only the ring lock and the filesystem.
+"""
+
+import atexit
+import itertools
+import json
+import os
+import sys
+import threading
+import time
+
+__all__ = ["enabled", "enable", "disable", "record_event", "events",
+           "dump", "dropped", "reset"]
+
+_TMP_PREFIX = ".ptpu_tmp_"  # checkpoint.py's atomic-rename prefix
+
+DEFAULT_CAPACITY = 4096
+
+
+def _make_lock(name):
+    """Tracked when the concurrency tracker is loaded; STRICTLY passive
+    about the import (metrics.py's bootstrap rationale applies: this
+    module is importable before `paddle_tpu.analysis` exists)."""
+    conc = sys.modules.get("paddle_tpu.analysis.concurrency")
+    if conc is None:
+        return threading.Lock()
+    return conc.make_lock(name)
+
+
+_ENABLED = False
+_DIR = None
+_events = None  # deque, allocated on first enable
+_dropped = 0
+_lock = threading.Lock()  # replaced by a tracked lock on enable
+_dump_seq = itertools.count(1)
+
+
+def enabled():
+    return _ENABLED
+
+
+def enable(directory=None, capacity=None):
+    """Turn the recorder on (programmatic alternative to
+    PTPU_BLACKBOX_DIR). `directory` is where dumps land; None keeps the
+    previous/flag-derived one (events still ring-buffer without a
+    directory, dump() just returns None)."""
+    import collections
+
+    global _ENABLED, _DIR, _events, _lock
+    if capacity is None:
+        capacity = _events.maxlen if _events is not None else \
+            DEFAULT_CAPACITY
+    if directory is not None:
+        _DIR = directory
+    if _events is None or _events.maxlen != capacity:
+        _events = collections.deque(maxlen=capacity)
+        _lock = _make_lock("obs.blackbox")
+    _ENABLED = True
+
+
+def disable():
+    global _ENABLED
+    _ENABLED = False
+
+
+def record_event(etype, **fields):
+    """Append one structured event to the ring; a single bool check when
+    the recorder is off. `etype` is a documented literal (see the
+    flight-recorder schema table in docs/OBSERVABILITY.md)."""
+    if not _ENABLED:
+        return
+    ev = dict(fields)
+    ev["ts"] = time.time()
+    ev["type"] = etype
+    ev["thread"] = threading.current_thread().name
+    global _dropped
+    with _lock:
+        if len(_events) == _events.maxlen:
+            _dropped += 1  # deque evicts the oldest on append
+        _events.append(ev)
+
+
+def events():
+    """Snapshot of the ring (oldest first)."""
+    if _events is None:
+        return []
+    with _lock:
+        return list(_events)
+
+
+def dropped():
+    return _dropped
+
+
+def reset():
+    global _dropped
+    if _events is not None:
+        with _lock:
+            _events.clear()
+            _dropped = 0
+
+
+def dump(reason):
+    """Atomically write the ring to PTPU_BLACKBOX_DIR as
+    ptpu_blackbox_<pid>_<seq>_<reason>.json (tmp file + os.rename, the
+    PR-4 checkpoint pattern — a crash mid-write leaves only a .ptpu_tmp_
+    file, never a torn dump). Returns the path, or None when disabled /
+    no directory / the write fails (dump runs on failure paths and must
+    never mask the original error)."""
+    if not _ENABLED or not _DIR:
+        return None
+    with _lock:
+        evs = list(_events)
+        n_dropped = _dropped
+    doc = {"reason": reason, "pid": os.getpid(), "time": time.time(),
+           "dropped_events": n_dropped, "events": evs}
+    name = "ptpu_blackbox_%d_%03d_%s.json" % (
+        os.getpid(), next(_dump_seq), reason)
+    tmp = os.path.join(_DIR, _TMP_PREFIX + name)
+    try:
+        os.makedirs(_DIR, exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.flush()
+            os.fsync(f.fileno())
+        final = os.path.join(_DIR, name)
+        os.rename(tmp, final)
+    except (OSError, TypeError, ValueError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+    return final
+
+
+def _flag_init():
+    from .. import flags as _flags
+
+    bdir = _flags.env("PTPU_BLACKBOX_DIR")
+    if bdir:
+        cap = _flags.env("PTPU_BLACKBOX_EVENTS")
+        enable(str(bdir), int(cap) if cap else None)
+        # a final dump at clean exit so the artifact exists even when no
+        # fatal trigger fired (the fleet CI leg reads this one: it holds
+        # both the replica_dead and the later readmit events)
+        atexit.register(dump, "exit")
+
+
+_flag_init()

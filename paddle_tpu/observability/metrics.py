@@ -150,11 +150,38 @@ class Histogram:
     def avg(self):
         return self.sum / self.count if self.count else 0.0
 
+    def quantile(self, q):
+        """Bucket-interpolated quantile estimate (Prometheus
+        histogram_quantile semantics: linear interpolation inside the
+        bucket holding the q-th observation), clamped to the observed
+        min/max so a wide first/last bucket cannot report a value outside
+        the real range. The one shared percentile implementation — the
+        serving engine's p50/p99 gauges read this, replacing its retired
+        ad-hoc deque(1024) windows."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError("quantile %r outside [0, 1]" % (q,))
+        if not self.count:
+            return 0.0
+        target = q * self.count
+        cum = 0
+        lo = 0.0
+        for i, le in enumerate(self.buckets):
+            n = self.bucket_counts[i]
+            if n and cum + n >= target:
+                v = lo + (le - lo) * (max(target - cum, 0.0) / n)
+                return min(max(v, self.min), self.max)
+            cum += n
+            lo = le
+        return self.max  # mass in the +Inf tail: best estimate is max
+
     def to_dict(self):
         d = {"count": self.count, "sum": self.sum, "avg": self.avg}
         if self.count:
             d["min"] = self.min
             d["max"] = self.max
+            d["p50"] = self.quantile(0.50)
+            d["p95"] = self.quantile(0.95)
+            d["p99"] = self.quantile(0.99)
         return d | {"buckets": {
             ("+Inf" if i == len(self.buckets) else repr(self.buckets[i])): n
             for i, n in enumerate(self.bucket_counts)}}
@@ -250,8 +277,17 @@ class MetricsRegistry:
     def to_prometheus(self, prefix="ptpu_"):
         """Prometheus text exposition format 0.0.4."""
         lines = []
+        seen = {}  # mangled family name -> original metric name
         for name, m in sorted(self.metrics().items()):
             pn = prefix + _prom_name(name)
+            other = seen.setdefault(pn, name)
+            if other != name:
+                # 'a/b' and 'a_b' both mangle to ptpu_a_b — merging them
+                # silently would corrupt both series; fail like the
+                # registry's kind-conflict check does
+                raise ValueError(
+                    "prometheus name collision: metrics %r and %r both "
+                    "expose as %r" % (other, name, pn))
             if isinstance(m, Counter):
                 lines.append("# TYPE %s_total counter" % pn)
                 lines.append("%s_total %s" % (pn, _prom_num(m.value)))
@@ -277,6 +313,9 @@ def _prom_name(name):
 
 def _prom_num(v):
     if isinstance(v, float):
+        if math.isnan(v):
+            return "NaN"  # int(nan) raises — a poisoned gauge must not
+            # crash the scrape; ptpu_stats' NaN-hardened asserts catch it
         if math.isinf(v):
             return "+Inf" if v > 0 else "-Inf"
         if v == int(v) and abs(v) < 1e15:

@@ -19,13 +19,15 @@ spans win, and the dump carries a `ptpuDroppedSpans` eviction count.
 """
 
 import collections
+import itertools
 import json
 import os
 import threading
 import time
 
-__all__ = ["span", "enabled", "enable", "disable", "events",
-           "dump_chrome_trace", "reset", "MAX_EVENTS"]
+__all__ = ["span", "complete", "instant", "new_trace_id", "enabled",
+           "enable", "disable", "events", "dump_chrome_trace", "reset",
+           "MAX_EVENTS"]
 
 MAX_EVENTS = 200000
 
@@ -40,6 +42,18 @@ _dropped = 0
 # observe
 _lock = threading.Lock()
 _pid = os.getpid()
+
+# request-scoped tracing identity: trace ids are minted once per request
+# (ServingEngine.submit / RouterRequest) and survive failover re-dispatch;
+# span ids are minted per recorded span. itertools.count is a single
+# C-level op, safe to share across threads without the ring lock.
+_trace_seq = itertools.count(1)
+_span_seq = itertools.count(1)
+
+
+def new_trace_id():
+    """Process-unique request trace id ("<pid>.<seq>" hex)."""
+    return "%x.%x" % (_pid, next(_trace_seq))
 
 _jax_profiler = None  # resolved lazily; False = unavailable
 
@@ -78,11 +92,17 @@ NULL_SPAN = _NullSpan()
 
 
 class Span:
-    __slots__ = ("name", "args", "_t0", "_ann")
+    __slots__ = ("name", "args", "trace_id", "span_id", "parent_id",
+                 "_t0", "_ann")
 
-    def __init__(self, name, args=None):
+    def __init__(self, name, args=None, trace_id=None, parent_id=None):
         self.name = name
         self.args = args
+        self.trace_id = trace_id
+        self.parent_id = parent_id
+        # span ids only exist on request-scoped spans: anonymous spans
+        # keep the exact pre-trace_id event shape (defaults-off identity)
+        self.span_id = next(_span_seq) if trace_id is not None else None
         self._t0 = 0
         self._ann = None
 
@@ -111,15 +131,33 @@ class Span:
         ev = {"name": self.name, "ph": "X", "pid": _pid,
               "tid": threading.get_ident() % 100000, "ts": ts, "dur": dur,
               "cat": "host"}
-        if self.args:
-            ev["args"] = self.args
-        global _dropped
-        with _lock:
-            if len(_events) == MAX_EVENTS:
-                _dropped += 1  # deque evicts the oldest on append
-            _events.append(ev)
+        args = self.args
+        if self.trace_id is not None:
+            args = dict(args) if args else {}
+            args["trace_id"] = self.trace_id
+            args["span_id"] = self.span_id
+            if self.parent_id is not None:
+                args["parent_id"] = self.parent_id
+        if args:
+            ev["args"] = args
+        _record(ev)
         _forward_native(self.name, ts, ts + dur)
         return False
+
+
+def _record(ev):
+    global _dropped
+    evicted = False
+    with _lock:
+        if len(_events) == MAX_EVENTS:
+            _dropped += 1  # deque evicts the oldest on append
+            evicted = True
+        _events.append(ev)
+    if evicted:
+        # promoted to a first-class counter so CI can gate on trace loss
+        # without parsing the chrome dump; incremented OUTSIDE the plain
+        # ring lock — the metric's tracked lock must not nest under it
+        _metrics.counter("trace/dropped_spans").inc()
 
 
 def _forward_native(name, us_start, us_end):
@@ -135,6 +173,7 @@ def _forward_native(name, us_start, us_end):
         pass
 
 
+from . import metrics as _metrics
 from .metrics import _env_on  # central flags-registry check
 
 _ENABLED = _env_on("PTPU_TRACE") or _env_on("PTPU_TRACE_DIR")
@@ -154,12 +193,47 @@ def disable():
     _ENABLED = False
 
 
-def span(name, **args):
+def span(name, trace_id=None, parent_id=None, **args):
     """A context manager timing one named region; nested spans nest in
-    the exported trace. No-op singleton (zero allocation) when disabled."""
+    the exported trace. No-op singleton (zero allocation) when disabled.
+    Pass `trace_id` (from `new_trace_id()`) to stamp the span with a
+    request identity — it gets a span id, and `trace_id`/`span_id`/
+    `parent_id` land in the event's args pane."""
     if not _ENABLED:
         return NULL_SPAN
-    return Span(name, args or None)
+    return Span(name, args or None, trace_id, parent_id)
+
+
+def complete(name, t0_ns, t1_ns, trace_id=None, parent_id=None, **args):
+    """Record an already-timed region as one complete event with explicit
+    `perf_counter_ns` bounds — for retroactive request-scoped spans such
+    as queue_wait, whose start predates the emit site. Returns the span
+    id (None when tracing is off or no trace_id was given)."""
+    if not _ENABLED:
+        return None
+    span_id = next(_span_seq) if trace_id is not None else None
+    ts = t0_ns // 1000
+    dur = max(0, (t1_ns - t0_ns) // 1000)
+    ev = {"name": name, "ph": "X", "pid": _pid,
+          "tid": threading.get_ident() % 100000, "ts": ts, "dur": dur,
+          "cat": "host"}
+    if trace_id is not None:
+        args["trace_id"] = trace_id
+        args["span_id"] = span_id
+        if parent_id is not None:
+            args["parent_id"] = parent_id
+    if args:
+        ev["args"] = args
+    _record(ev)
+    _forward_native(name, ts, ts + dur)
+    return span_id
+
+
+def instant(name, trace_id=None, parent_id=None, **args):
+    """Zero-duration marker event at now (readmit, deadline_expired)."""
+    t = time.perf_counter_ns()
+    return complete(name, t, t, trace_id=trace_id, parent_id=parent_id,
+                    **args)
 
 
 def events():

@@ -53,6 +53,7 @@ import warnings
 import numpy as np
 
 from .flags import env as _env
+from .observability import flight_recorder as _blackbox
 from .observability import metrics as _metrics
 from .observability import tracing as _tracing
 
@@ -307,6 +308,7 @@ class FaultInjector:
 
     def _fired(self, site):
         _metrics.counter("resilience/faults_injected").inc()
+        _blackbox.record_event("fault_injected", site=site)
         warnings.warn("PTPU_FAULT_INJECT: firing %r" % site,
                       RuntimeWarning)
 
@@ -725,6 +727,10 @@ class ResilientTrainer:
 
     def _consume_retry(self, what):
         if self._retries_left <= 0:
+            _blackbox.record_event("retry_budget_exhausted",
+                                   budget=self.retry_budget,
+                                   error=repr(what))
+            _blackbox.dump("retry_budget_exceeded")
             raise RetryBudgetExceededError(
                 "retry budget (%d) exhausted while handling %s"
                 % (self.retry_budget, what))
@@ -775,6 +781,7 @@ class ResilientTrainer:
             self.detector.restore(snap.aux)
         result.rollbacks += 1
         _metrics.counter("resilience/rollbacks").inc()
+        _blackbox.record_event("rollback", step=snap.step)
         return snap.step
 
     def _replay(self, records, result):
@@ -826,6 +833,9 @@ class ResilientTrainer:
                 bad = pending[bad_index]
                 result.anomalies += 1
                 _metrics.counter("resilience/anomalies").inc()
+                _blackbox.record_event("anomaly", step=bad.gstep,
+                                       kind=bad_kind,
+                                       policy=self.policy)
                 if self.policy == POLICY_ABORT:
                     raise AnomalousStepError(bad.gstep, bad_kind,
                                              values[bad_index])
@@ -920,6 +930,8 @@ class ResilientTrainer:
         hand control back to the caller."""
         result.preempted = True
         _metrics.counter("resilience/preemptions").inc()
+        _blackbox.record_event("preemption_drain", signum=signum,
+                               in_flight=len(pending))
         with _tracing.span("resilience/preemption_drain"):
             self._validate(pending, result)
             self.exe.sync()
@@ -928,6 +940,7 @@ class ResilientTrainer:
                         else snapshot_scope(self.scope))
                 self._save_checkpoint(snap, result, blocking=True)
                 self._manager.wait()
+        _blackbox.dump("sigterm_drain")
         warnings.warn(
             "preemption signal %d: drained %d in-flight steps, state "
             "checkpointed at step %d" % (signum, len(pending),

@@ -70,6 +70,7 @@ import numpy as np
 from .. import resilience as _resil
 from ..analysis import concurrency as _conc
 from ..core.scope import Scope
+from ..observability import flight_recorder as _blackbox
 from ..observability import metrics as _metrics
 from ..observability import tracing as _tracing
 from .kv_cache import KVBlockPool, blocks_needed
@@ -78,14 +79,6 @@ from .scheduler import (AdmissionError, GenerationRequest, RequestQueue,
                         StepScheduler)
 
 __all__ = ["ServingEngine"]
-
-
-def _percentile(sorted_vals, q):
-    if not sorted_vals:
-        return 0.0
-    idx = min(len(sorted_vals) - 1,
-              max(0, int(round(q * (len(sorted_vals) - 1)))))
-    return sorted_vals[idx]
 
 
 class _ModelWorker:
@@ -200,14 +193,6 @@ class _ModelWorker:
         self._steps_dispatched = 0  # host-side (live with metrics off)
         self._t_first_step = None
         self._t_last_step = None
-        # bounded window for the p50/p99 gauges: a long-lived engine
-        # completes millions of requests, so an ever-growing list
-        # (re-sorted per completion) would be an O(n^2 log n) leak; the
-        # full-fidelity distribution lives in the
-        # serving/request_latency histogram
-        from collections import deque
-        self._latencies = deque(maxlen=1024)
-        self._ttfts = deque(maxlen=1024)
         self._thread = threading.Thread(
             target=self._run, name="ptpu-serve-%s" % name, daemon=True)
         self._thread.start()
@@ -290,6 +275,9 @@ class _ModelWorker:
                         self._transient_retries += 1
                         _metrics.counter(
                             "serving/step_transient_retries").inc()
+                        _blackbox.record_event(
+                            "step_transient_retry", model=self.name,
+                            step=self._steps_dispatched, error=repr(e))
                         continue
                     raise
         except BaseException as e:  # deliver, don't vanish: EVERYTHING
@@ -312,6 +300,13 @@ class _ModelWorker:
                     break
                 req._finish(e)
                 _metrics.counter("serving/requests_failed").inc()
+        # black box: the uncaught-worker-death dump trigger — recorded
+        # AFTER the cv region (dump does file I/O; the ring lock is the
+        # only lock it takes)
+        _blackbox.record_event("worker_dead", model=self.name,
+                               error=repr(e),
+                               steps=self._steps_dispatched)
+        _blackbox.dump("worker_dead")
 
     def _stall(self):
         """Injected step stall (`serve_stall_at_step`): stop making
@@ -381,15 +376,21 @@ class _ModelWorker:
         gate `concurrency/violations == 0`."""
         import re as _re
 
+        pool_dirty = False
         for msg in self.pool.check_invariants():
             # detail = the digit-stripped problem class per model, so
             # two DIFFERENT corruption kinds on one pool both report
             # while a recurring one (counts evolving per tick) doesn't
             # spam a violation per step
+            pool_dirty = True
             _conc.record_violation(
                 "pool-invariant", "KVBlockPool[%s]: %s" % (self.name, msg),
                 locks=("serving.kv_pool",),
                 detail=(self.name, _re.sub(r"\d+", "N", msg)))
+            _blackbox.record_event("pool_invariant_violation",
+                                   model=self.name, message=msg)
+        if pool_dirty:
+            _blackbox.dump("invariant_violation")
         if len(self._inflight) > self.async_depth:
             _conc.record_violation(
                 "engine-invariant",
@@ -428,6 +429,8 @@ class _ModelWorker:
     def _dispatch(self, plan, chunked=False):
         sched = self.scheduler
         occupancy = int(sched.active.sum())
+        traced = _tracing.enabled()
+        t0 = time.perf_counter_ns() if traced else 0
         with _tracing.span("serving_step", model=self.name,
                            occupancy=occupancy, chunked=chunked):
             weights = {n: self.scope.get(n) for n in self._weight_names}
@@ -444,6 +447,21 @@ class _ModelWorker:
                     sched.prompt_feed.copy(), sched.use_prompt.copy(),
                     self._prev_tokens, sched.positions.copy(),
                     sched.block_tables.copy(), sched.active.copy())
+        if traced:
+            # request-scoped view of the same step: one window event per
+            # traced request riding this dispatch, so a request's trace
+            # shows ITS prefill/decode activity, not just engine steps
+            t1 = time.perf_counter_ns()
+            for seq, gen_idx in plan:
+                tid = seq.request.trace_id
+                if tid is None:
+                    continue
+                prefill = (bool(sched.use_prompt[seq.slot]) if chunked
+                           else gen_idx is None)
+                _tracing.complete(
+                    "prefill_chunk" if prefill else "decode_window",
+                    t0, t1, trace_id=tid, request=seq.request.id,
+                    model=self.name)
         self._prev_tokens = next_tokens
         self._inflight.append((next_tokens, plan))
         _metrics.gauge("serving/inflight_steps").set(len(self._inflight))
@@ -482,6 +500,8 @@ class _ModelWorker:
 
         sched = self.scheduler
         occupancy = int(sched.active.sum())
+        traced = _tracing.enabled()
+        t0 = time.perf_counter_ns() if traced else 0
         with _tracing.span("serving_spec_step", model=self.name,
                            occupancy=occupancy):
             weights = {n: self.scope.get(n) for n in self._weight_names}
@@ -492,6 +512,15 @@ class _ModelWorker:
                 sched.spec_lens.copy(), sched.block_tables.copy(),
                 sched.active.copy())
         outs = np.asarray(out)  # materialize NOW (the sync contract)
+        if traced:
+            t1 = time.perf_counter_ns()
+            for seq, window in plan:
+                tid = seq.request.trace_id
+                if tid is not None:
+                    _tracing.complete(
+                        "spec_window", t0, t1, trace_id=tid,
+                        request=seq.request.id, model=self.name,
+                        window=len(window))
         self._steps_dispatched += 1
         now = time.perf_counter()
         if self._t_first_step is None:
@@ -553,15 +582,19 @@ class _ModelWorker:
     def _note_first_token(self, request):
         """TTFT telemetry: submit-to-first-generated-token. The
         end-to-end request_latency can't see the prefill stall the
-        chunked/prefix fast paths remove — this row can."""
+        chunked/prefix fast paths remove — this row can. Percentiles
+        come from the histogram's own bucket-interpolated quantile()
+        (one shared implementation; the old per-engine deque(1024)
+        windows are retired), so the gauges cover the request's whole
+        lifetime distribution."""
         ttft = request.ttft
         if ttft is None or not _metrics.enabled():
             return
-        _metrics.histogram("serving/ttft").observe(ttft)
-        self._ttfts.append(ttft)
-        ts = sorted(self._ttfts)
-        _metrics.gauge("serving/ttft_p50").set(_percentile(ts, 0.50))
-        _metrics.gauge("serving/ttft_p99").set(_percentile(ts, 0.99))
+        reg = _metrics.registry()
+        h = reg.histogram("serving/ttft")
+        h.observe(ttft)
+        reg.gauge("serving/ttft_p50").set(h.quantile(0.50))
+        reg.gauge("serving/ttft_p99").set(h.quantile(0.99))
 
     def _note_completion(self, request):
         _metrics.counter("serving/requests_completed").inc()
@@ -569,13 +602,11 @@ class _ModelWorker:
         if lat is None:
             return
         if _metrics.enabled():
-            _metrics.histogram("serving/request_latency").observe(lat)
-            self._latencies.append(lat)
-            lats = sorted(self._latencies)
-            _metrics.gauge("serving/request_latency_p50").set(
-                _percentile(lats, 0.50))
-            _metrics.gauge("serving/request_latency_p99").set(
-                _percentile(lats, 0.99))
+            reg = _metrics.registry()
+            h = reg.histogram("serving/request_latency")
+            h.observe(lat)
+            reg.gauge("serving/request_latency_p50").set(h.quantile(0.50))
+            reg.gauge("serving/request_latency_p99").set(h.quantile(0.99))
 
     # -- shutdown -------------------------------------------------------
     def close(self, timeout=30.0):
@@ -634,6 +665,16 @@ class ServingEngine:
                 transient_tolerance=transient_tolerance)
         self._default = next(iter(self._workers))
         self._closed = False
+        # /healthz surface: registered only while the endpoint is
+        # enabled, so a flag-off engine never lands in the provider dict
+        # (and is never pinned live by it)
+        self._health_key = None
+        from ..observability import endpoint as _endpoint
+
+        if _endpoint.enabled():
+            self._health_key = "engine-%x" % id(self)
+            _endpoint.register_health_provider(self._health_key,
+                                               self._health_json)
 
     # -- public API -----------------------------------------------------
     @property
@@ -655,10 +696,15 @@ class ServingEngine:
         boundary once the wall-clock budget is spent."""
         if deadline_s is None:
             deadline_s = self._deadline_s
+        # request identity is minted HERE (or by RouterRequest, which
+        # passes one id through every failover attempt); with tracing
+        # off the field stays None and no span carries it
+        trace_id = _tracing.new_trace_id() if _tracing.enabled() else None
         request = GenerationRequest(prompt, max_new_tokens=max_new_tokens,
                                     eos_id=eos_id, stream=stream,
                                     model=model or self._default,
-                                    deadline_s=deadline_s)
+                                    deadline_s=deadline_s,
+                                    trace_id=trace_id)
         # model-name validation lives in submit_request (one copy)
         return self.submit_request(request)
 
@@ -711,6 +757,17 @@ class ServingEngine:
                 "transient_retries": w._transient_retries,
             }
         return out
+
+    def _health_json(self):
+        """`health()` with the latched error stringified — the /healthz
+        JSON body (exception objects don't serialize)."""
+        models = {}
+        for name, snap in self.health().items():
+            snap = dict(snap)
+            snap["error"] = (repr(snap["error"])
+                             if snap["error"] is not None else None)
+            models[name] = snap
+        return {"models": models, "load": self.load()}
 
     def kill(self, error=None):
         """Put the whole engine down as a dead replica would go down:
@@ -766,6 +823,11 @@ class ServingEngine:
         if self._closed:
             return
         self._closed = True
+        if self._health_key is not None:
+            from ..observability import endpoint as _endpoint
+
+            _endpoint.unregister_health_provider(self._health_key)
+            self._health_key = None
         for w in self._workers.values():
             w.close(timeout)
 

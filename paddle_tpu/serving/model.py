@@ -52,6 +52,7 @@ reusing :class:`GenerationModel`).
 """
 
 import math
+import time
 
 import numpy as np
 
@@ -522,9 +523,42 @@ class GenerationModel:
                 return kv_k, kv_v, next_tokens, logits
             return kv_k, kv_v, next_tokens
 
-        jitted = jax.jit(step, donate_argnums=(1, 2))
+        jitted = self._instrument_step("decode", jax.jit(
+            step, donate_argnums=(1, 2)))
         self._steps[key] = jitted
         return jitted
+
+    def _instrument_step(self, kind, jitted):
+        """With metrics enabled, wrap a jitted step so its first call
+        compiles ahead of time (the executor's `_compile_instrumented`
+        pattern) and the executable's XLA cost analysis lands in the
+        exec/* gauges — serving cache misses get the same FLOPs/bytes
+        receipts training steps do. Identity when metrics are off: the
+        raw jitted function is returned and cached, zero wrapper frames
+        on the default hot path."""
+        from ..observability import metrics as _metrics
+
+        if not _metrics.enabled():
+            return jitted
+
+        from ..observability import cost as _cost
+        from ..observability import tracing as _tracing
+
+        aot = []
+
+        def step(*args):
+            if not aot:
+                with _tracing.span("serving_compile", kind=kind):
+                    t0 = time.perf_counter()
+                    compiled = jitted.lower(*args).compile()
+                    _metrics.histogram(
+                        "serving/step_compile_time").observe(
+                        time.perf_counter() - t0)
+                _cost.publish(compiled)
+                aot.append(compiled)
+            return aot[0](*args)
+
+        return step
 
     def _forward_chunk(self, jnp, weights, x, pos2d, lengths,
                        block_tables, active, kv_k, kv_v,
@@ -673,7 +707,8 @@ class GenerationModel:
                 return kv_k, kv_v, next_tokens, logits
             return kv_k, kv_v, next_tokens
 
-        jitted = jax.jit(step, donate_argnums=(1, 2))
+        jitted = self._instrument_step(kind, jax.jit(
+            step, donate_argnums=(1, 2)))
         self._steps[key] = jitted
         return jitted
 

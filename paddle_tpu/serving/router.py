@@ -71,7 +71,9 @@ from collections import deque
 from .. import resilience as _resil
 from ..analysis import concurrency as _conc
 from ..flags import env as _env
+from ..observability import flight_recorder as _blackbox
 from ..observability import metrics as _metrics
+from ..observability import tracing as _tracing
 from .engine import ServingEngine
 from .scheduler import AdmissionError, DeadlineExceededError, \
     GenerationRequest, check_request_args
@@ -126,6 +128,13 @@ class RouterRequest:
                  model, deadline_s):
         prompt = check_request_args(prompt, max_new_tokens, deadline_s)
         self.id = next(_router_req_ids)
+        # ONE trace id for the request's whole fleet-level life: every
+        # engine-side attempt (including failover re-dispatches onto a
+        # survivor) carries it, so the Perfetto dump renders the full
+        # story — queue_wait on the dying replica through readmit and
+        # the survivor's decode windows — as a single trace
+        self.trace_id = _tracing.new_trace_id() if _tracing.enabled() \
+            else None
         self.prompt = prompt
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = eos_id
@@ -301,10 +310,32 @@ class ServingRouter:
         self._closed = False
         self._stopping = False
         _metrics.gauge("router/replicas_healthy").set(replicas)
+        self._health_key = None
+        from ..observability import endpoint as _endpoint
+        if _endpoint.enabled():
+            self._health_key = "router-%x" % id(self)
+            _endpoint.register_health_provider(self._health_key,
+                                               self._health_json)
         self._monitor = threading.Thread(
             target=self._monitor_loop, name="ptpu-serve-router",
             daemon=True)
         self._monitor.start()
+
+    def _health_json(self):
+        """Fleet-level health for the live ``/healthz`` endpoint: per-
+        replica state + load plus the ledger counters that matter when
+        paging (failovers, sheds)."""
+        with self._lock:
+            failovers, shed = self._failovers, self._shed
+        return {
+            "replicas": [{"idx": r.idx, "state": r.state,
+                          "load": r.engine.load()}
+                         for r in self._replicas],
+            "replicas_healthy": sum(1 for r in self._replicas
+                                    if r.state == HEALTHY),
+            "failovers": failovers,
+            "shed_requests": shed,
+        }
 
     # -- public API -----------------------------------------------------
     @property
@@ -411,6 +442,10 @@ class ServingRouter:
         if self._closed and self._stopping:
             return
         self._closed = True
+        if self._health_key is not None:
+            from ..observability import endpoint as _endpoint
+            _endpoint.unregister_health_provider(self._health_key)
+            self._health_key = None
         for rep in self._replicas:
             rep.engine.close(timeout)
         self._stopping = True
@@ -448,7 +483,8 @@ class ServingRouter:
             rreq.prompt + committed,
             max_new_tokens=rreq.max_new_tokens - len(committed),
             eos_id=rreq.eos_id, stream=rreq._on_token,
-            model=rreq.model, on_finish=rreq._on_finish)
+            model=rreq.model, on_finish=rreq._on_finish,
+            trace_id=rreq.trace_id)
         # carry the ABSOLUTE deadline across attempts (perf_counter
         # clock, same as GenerationRequest.submit_time)
         attempt.deadline = rreq.deadline
@@ -545,11 +581,22 @@ class ServingRouter:
                     % (rep.idx, stalled_for, self._stall_timeout_s)))
             elif (stalled_for >= self._stall_timeout_s / 2.0
                     or consec >= self._suspect_after):
-                rep.state = SUSPECT
+                self._set_state(rep, SUSPECT)
             else:
-                rep.state = HEALTHY
+                self._set_state(rep, HEALTHY)
         _metrics.gauge("router/replicas_healthy").set(
             sum(1 for r in self._replicas if r.state == HEALTHY))
+
+    @staticmethod
+    def _set_state(rep, new):
+        """State write with flight-recorder breadcrumb on CHANGE only —
+        the steady-state healthy->healthy poll must not flood the
+        ring."""
+        old = rep.state
+        if old != new:
+            rep.state = new
+            _blackbox.record_event("health_transition", replica=rep.idx,
+                                   previous=old, state=new)
 
     def _declare_dead(self, rep, error):
         """healthy/suspect -> dead: put the replica down (fail_all
@@ -558,10 +605,13 @@ class ServingRouter:
         request a truly wedged worker could never deliver."""
         if rep.state == DEAD:
             return
-        rep.state = DEAD
+        self._set_state(rep, DEAD)
         rep.error = error
         self._failovers += 1
         _metrics.counter("router/failovers").inc()
+        _blackbox.record_event("replica_dead", replica=rep.idx,
+                               error=repr(error))
+        _blackbox.dump("replica_dead")
         rep.engine.kill(error)
         with self._lock:
             # sentinel-held requests already have a parked retry in the
@@ -655,6 +705,11 @@ class ServingRouter:
             return
         if not budget_spent:
             if rreq.retries >= self._retry_budget:
+                _blackbox.record_event("retry_budget_exhausted",
+                                       request=rreq.id,
+                                       retries=rreq.retries,
+                                       error=repr(error))
+                _blackbox.dump("retry_budget_exceeded")
                 rreq._finalize(_resil.RetryBudgetExceededError(
                     "router re-admission budget (%d) exhausted for "
                     "request %d; last error: %r"
@@ -700,6 +755,13 @@ class ServingRouter:
             self._readmitted += 1
             rreq.readmissions += 1
             _metrics.counter("router/readmitted").inc()
+            _blackbox.record_event("readmit", request=rreq.id,
+                                   replica=cand.idx,
+                                   committed=committed)
+            if rreq.trace_id is not None:
+                _tracing.instant("readmit", trace_id=rreq.trace_id,
+                                 request=rreq.id, replica=cand.idx,
+                                 committed=committed)
             return
         if any(r.state != DEAD for r in self._replicas):
             # nowhere to land right now (saturated survivors): spend

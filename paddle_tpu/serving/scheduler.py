@@ -66,7 +66,9 @@ import threading
 import time
 from collections import deque
 
+from ..observability import flight_recorder as _blackbox
 from ..observability import metrics as _metrics
+from ..observability import tracing as _tracing
 from .kv_cache import blocks_needed, prefix_chain_keys
 
 __all__ = ["AdmissionError", "DeadlineExceededError", "GenerationRequest",
@@ -112,10 +114,15 @@ class GenerationRequest:
 
     def __init__(self, prompt, max_new_tokens=32, eos_id=None,
                  stream=None, model=None, deadline_s=None,
-                 on_finish=None):
+                 on_finish=None, trace_id=None):
         prompt = check_request_args(prompt, max_new_tokens, deadline_s)
         self.id = next(_req_ids)
         self.model = model
+        # request-scoped tracing identity (docs/OBSERVABILITY.md):
+        # minted at the submit surface when tracing is on, None
+        # otherwise — the router passes ONE id through every failover
+        # attempt so a re-admitted request renders as a single trace
+        self.trace_id = trace_id
         self.prompt = prompt
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = eos_id
@@ -351,6 +358,15 @@ class StepScheduler:
                 break  # KV gate: head doesn't fit — keep queue order
             queue.pop()
             request.start_time = time.perf_counter()
+            if request.trace_id is not None and _tracing.enabled():
+                # retroactive queue_wait span (submit -> admission) plus
+                # an admit marker carrying the slot the request landed in
+                _tracing.complete(
+                    "queue_wait", int(request.submit_time * 1e9),
+                    int(request.start_time * 1e9),
+                    trace_id=request.trace_id, request=request.id)
+                _tracing.instant("admit", trace_id=request.trace_id,
+                                 request=request.id, slot=slot)
             self.slots[slot] = seq
             self.block_tables[slot, :] = self.pool.NULL_BLOCK
             seq.prefix_keys = tuple(keys)
@@ -677,6 +693,7 @@ class StepScheduler:
                 "request %d exceeded its deadline while queued "
                 "(waited %.3fs)" % (request.id,
                                     now - request.submit_time)))
+            self._note_expired(request, "queued")
             expired += 1
         for seq in self.slots:
             if seq is None or seq.finished:
@@ -691,12 +708,23 @@ class StepScheduler:
                 "(%d/%d tokens emitted)"
                 % (seq.request.id, len(seq.request.tokens),
                    seq.request.max_new_tokens)))
+            self._note_expired(seq.request, "mid_generation")
             expired += 1
         if expired:
             self.deadline_expired += expired
             _metrics.counter("serving/requests_failed").inc(expired)
             _metrics.counter("serving/deadline_expired").inc(expired)
         return expired
+
+    @staticmethod
+    def _note_expired(request, where):
+        """Trace marker + flight-recorder event for one expired request
+        (both no-ops on the defaults-off path)."""
+        if request.trace_id is not None and _tracing.enabled():
+            _tracing.instant("deadline_expired", trace_id=request.trace_id,
+                             request=request.id, where=where)
+        _blackbox.record_event("deadline_expired", request=request.id,
+                               where=where)
 
     def reap(self):
         """Retire slots whose sequence is complete AND fully drained

@@ -173,6 +173,43 @@ assert doc["counters"]["compile_cache/hit"] >= 1, doc
 assert doc["counters"]["compile_cache/miss"] >= 1, doc
 print("observability smoke ok")
 PYEOF
+  # live-endpoint receipt: a /metrics scrape must be byte-identical to
+  # registry().to_prometheus(), /varz must round-trip through the stats
+  # CLI with exact metric names, and /healthz must flip 200 -> 503 when
+  # a provider degrades (docs/OBSERVABILITY.md "Live endpoint")
+  JAX_PLATFORMS=cpu python - <<'PYEOF'
+import json
+import urllib.request
+
+from paddle_tpu.observability import endpoint, metrics
+
+metrics.enable()
+reg = metrics.registry()
+reg.counter("ci/obs_probe").inc(3)
+reg.gauge("ci/obs_gauge").set(1.5)
+reg.histogram("ci/obs_hist").observe(0.25)
+endpoint.start(0)
+try:
+    scrape = urllib.request.urlopen(endpoint.url("/metrics")).read().decode()
+    assert scrape == reg.to_prometheus(), "scrape != registry export"
+    varz = json.loads(urllib.request.urlopen(endpoint.url("/varz")).read())
+    assert varz["counters"]["ci/obs_probe"] == 3, varz
+    hz = urllib.request.urlopen(endpoint.url("/healthz"))
+    assert hz.status == 200, hz.status
+    assert json.loads(hz.read())["status"] == "ok"
+    endpoint.register_health_provider(
+        "ci-degraded", lambda: (_ for _ in ()).throw(RuntimeError("down")))
+    try:
+        urllib.request.urlopen(endpoint.url("/healthz"))
+    except urllib.error.HTTPError as e:
+        assert e.code == 503, e.code
+        assert json.loads(e.read())["status"] == "degraded"
+    else:
+        raise AssertionError("degraded /healthz did not return 503")
+finally:
+    endpoint.stop()
+print("endpoint scrape parity ok")
+PYEOF
 }
 
 do_stress() {
@@ -927,9 +964,12 @@ do_fleet() {
   # drained, and the whole path runs under PTPU_LOCK_CHECK=1 with
   # switch-interval jitter gating concurrency/violations == 0.
   local dump=/tmp/ptpu_fleet_metrics.json legs=/tmp/ptpu_fleet_legs.json
+  local blackbox=/tmp/ptpu_fleet_blackbox
   rm -f "$dump"
+  rm -rf "$blackbox" && mkdir -p "$blackbox"
   JAX_PLATFORMS=cpu PTPU_METRICS=1 PTPU_METRICS_OUT="$dump" \
     PTPU_LOCK_CHECK=1 PTPU_RETRY_BACKOFF=0 \
+    PTPU_TRACE=1 PTPU_BLACKBOX_DIR="$blackbox" \
     PTPU_FAULT_INJECT="serve_die_at_step:6" \
     python - <<'PYEOF'
 import sys
@@ -980,9 +1020,55 @@ for i, p in enumerate(prompts):
 assert st["failovers"] >= 1 and st["readmitted"] >= 1, st
 concurrency.assert_clean()
 concurrency.publish_metrics()
+# fleet-tracing receipt: a re-admitted request's whole life — spans on
+# the replica that died AND spans after re-admission — must share ONE
+# trace_id, with the readmit marker in between (docs/OBSERVABILITY.md
+# "Per-request trace ids")
+from paddle_tpu.observability import tracing
+evs = tracing.events()
+readmits = [e for e in evs if e["name"] == "readmit"
+            and "trace_id" in e.get("args", {})]
+assert readmits, "no readmit trace event recorded"
+ok = False
+for rm in readmits:
+    tid = rm["args"]["trace_id"]
+    mine = [e for e in evs if e.get("args", {}).get("trace_id") == tid]
+    pre = [e for e in mine
+           if e["name"] in ("admit", "prefill_chunk", "decode_window")
+           and e["ts"] < rm["ts"]]
+    post = [e for e in mine
+            if e["name"] in ("admit", "prefill_chunk", "decode_window")
+            and e["ts"] > rm["ts"]]
+    if pre and post:
+        ok = True
+        break
+assert ok, "no single-trace_id span set straddles a readmit"
 print("fleet kill leg ok:", {k: st[k] for k in
       ("failovers", "readmitted", "retries", "replicas_healthy")},
-      concurrency.stats())
+      concurrency.stats(), "traced requests straddling failover:",
+      sum(1 for _ in readmits))
+PYEOF
+  # flight-recorder receipt: the run must have left at least one
+  # atomically-renamed dump whose event list holds BOTH the replica
+  # death and a subsequent re-admission (the atexit "exit" dump always
+  # qualifies), and no torn tmp files
+  python - "$blackbox" <<'PYEOF'
+import glob, json, os, sys
+bdir = sys.argv[1]
+tmps = glob.glob(os.path.join(bdir, ".ptpu_tmp_*"))
+assert not tmps, "torn flight-recorder tmp files: %r" % tmps
+dumps = sorted(glob.glob(os.path.join(bdir, "ptpu_blackbox_*.json")))
+assert dumps, "no flight-recorder dumps in %s" % bdir
+ok = None
+for path in dumps:
+    doc = json.load(open(path))
+    types = [e["type"] for e in doc["events"]]
+    if "replica_dead" in types and "readmit" in types:
+        ok = (path, doc["reason"])
+        break
+assert ok, "no dump holds both replica_dead and readmit: %r" % dumps
+print("flight recorder ok: %d dump(s), %s (reason=%s)"
+      % (len(dumps), os.path.basename(ok[0]), ok[1]))
 PYEOF
   python tools/ptpu_stats.py "$dump" \
     --assert-min router/failovers=1 router/readmitted=1 \

@@ -26,6 +26,11 @@ Rules:
                histogram()` must appear in docs/OBSERVABILITY.md — the
                registry's exposition tables are the contract dashboards
                are built against
+  event-undocumented
+               a flight-recorder event-type literal passed to
+               `record_event()` must appear in docs/OBSERVABILITY.md —
+               the crash-dump schema is the contract post-mortem
+               tooling greps against (mirrors metric-undocumented)
   flag-undocumented
                every `PTPU_*` flag declared in the paddle_tpu.flags
                registry must appear somewhere under docs/ (or the
@@ -110,6 +115,8 @@ RULES = {
                      "program-build time",
     "metric-undocumented": "metric name literals must appear in "
                            "docs/OBSERVABILITY.md",
+    "event-undocumented": "flight-recorder event-type literals must "
+                          "appear in docs/OBSERVABILITY.md",
     "flag-undocumented": "every registry-declared PTPU_* flag must "
                          "appear in docs/ (or the README)",
     "fault-site-literal": "fault-injection site literals must parse "
@@ -498,6 +505,16 @@ class _Linter(ast.NodeVisitor):
                     self._add(node, "metric-undocumented",
                               "metric %r is not documented in "
                               "docs/OBSERVABILITY.md" % name)
+            # flight-recorder event-type literals: record_event("etype")
+            # — the crash-dump schema is the contract post-mortem
+            # tooling greps against, same deal as the metric tables
+            if func.attr == "record_event" and node.args:
+                etype = _const_str(node.args[0])
+                if etype and etype not in self.doc_text:
+                    self._add(node, "event-undocumented",
+                              "flight-recorder event %r is not "
+                              "documented in docs/OBSERVABILITY.md"
+                              % etype)
             # builder-scope jnp/jax calls
             root = func
             while isinstance(root, ast.Attribute):

@@ -16,6 +16,12 @@ Usage:
   python tools/ptpu_stats.py dump.json \
       --assert-has exec/inflight_steps \
       --assert-min exec/inflight_steps=2   # CI gating on metric presence
+  python tools/ptpu_stats.py --diff before.json after.json  # activity delta
+  python tools/ptpu_stats.py --url http://127.0.0.1:9100/varz  # live scrape
+
+--url accepts both endpoint schemas: /varz (JSON registry dump — exact
+metric names, preferred) and /metrics (Prometheus text, parsed back
+best-effort under the mangled ptpu_* names).
 """
 
 import argparse
@@ -34,8 +40,10 @@ def _fmt(v):
     return str(v)
 
 
-def render(doc, out=sys.stdout):
+def render(doc, out=None):
     """Render one parsed metrics document as aligned tables."""
+    out = out if out is not None else sys.stdout  # late-bound: respects
+    # a caller's redirected stdout (an import-time default would not)
     wrote = False
     if "stats" in doc:  # native profiler.cc schema
         doc = {"histograms": {
@@ -143,6 +151,105 @@ def _selftest():
     return 0
 
 
+def _parse_prometheus(text):
+    """Best-effort inverse of the exposition format: counters/gauges by
+    their ``# TYPE`` lines, histograms from ``_count``/``_sum`` suffix
+    samples (bucket lines are cumulative and lossy — skipped). Names
+    come back in their mangled ``ptpu_*`` form; point ``--url`` at
+    ``/varz`` when the exact registry names matter."""
+    counters, gauges, hists = {}, {}, {}
+    types = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) == 4:
+                types[parts[2]] = parts[3]
+            continue
+        if not line or line.startswith("#"):
+            continue
+        name, _, value = line.rpartition(" ")
+        try:
+            val = float(value)
+        except ValueError:
+            continue
+        base = name.partition("{")[0]
+        if base.endswith("_bucket"):
+            continue
+        for suffix, field in (("_count", "count"), ("_sum", "sum")):
+            if base.endswith(suffix) \
+                    and types.get(base[:-len(suffix)]) == "histogram":
+                h = hists.setdefault(base[:-len(suffix)], {})
+                h[field] = int(val) if field == "count" else val
+                break
+        else:
+            if types.get(base) == "counter":
+                counters[base] = val
+            else:
+                gauges[base] = val
+    doc = {}
+    if counters:
+        doc["counters"] = counters
+    if gauges:
+        doc["gauges"] = gauges
+    if hists:
+        doc["histograms"] = hists
+    return doc
+
+
+def _fetch_doc(url):
+    """Scrape a live endpoint: JSON (``/varz``) parses as a registry
+    dump verbatim; anything else is treated as Prometheus text."""
+    from urllib.request import urlopen
+
+    with urlopen(url, timeout=10) as resp:
+        body = resp.read().decode("utf-8")
+    try:
+        return json.loads(body)
+    except ValueError:
+        return _parse_prometheus(body)
+
+
+def render_diff(a, b, out=None):
+    """Activity between two dumps of the same process: counters and
+    histogram observation counts are monotone, so ``B - A`` is what
+    happened in between; gauges are instantaneous levels and render
+    side-by-side instead of as a (meaningless) delta."""
+    out = out if out is not None else sys.stdout
+    wrote = False
+    ca, cb = a.get("counters", {}), b.get("counters", {})
+    if ca or cb:
+        out.write("%-44s %12s %12s %12s\n"
+                  % ("Counter", "Before", "After", "Delta"))
+        for name in sorted(set(ca) | set(cb)):
+            va, vb = ca.get(name, 0), cb.get(name, 0)
+            out.write("%-44s %12s %12s %12s\n"
+                      % (name, _fmt(va), _fmt(vb), _fmt(vb - va)))
+        wrote = True
+    ga, gb = a.get("gauges", {}), b.get("gauges", {})
+    if ga or gb:
+        if wrote:
+            out.write("\n")
+        out.write("%-44s %12s %12s\n" % ("Gauge", "Before", "After"))
+        for name in sorted(set(ga) | set(gb)):
+            out.write("%-44s %12s %12s\n"
+                      % (name, _fmt(ga.get(name)), _fmt(gb.get(name))))
+        wrote = True
+    ha, hb = a.get("histograms", {}), b.get("histograms", {})
+    if ha or hb:
+        if wrote:
+            out.write("\n")
+        out.write("%-44s %12s %12s %12s\n"
+                  % ("Histogram", "Count A", "Count B", "Delta"))
+        for name in sorted(set(ha) | set(hb)):
+            na = int(ha.get(name, {}).get("count", 0))
+            nb = int(hb.get(name, {}).get("count", 0))
+            out.write("%-44s %12d %12d %12d\n" % (name, na, nb, nb - na))
+        wrote = True
+    if not wrote:
+        out.write("(no metrics)\n")
+
+
 def _lookup(doc, name):
     """(found, numeric value-or-None) for a metric of any kind."""
     for kind in ("counters", "gauges"):
@@ -218,17 +325,49 @@ def main(argv=None):
                     metavar="NAME=VALUE",
                     help="fail unless metric <= value (the chaos stage "
                          "gates final loss this way)")
+    ap.add_argument("--diff", action="store_true",
+                    help="render the activity delta between exactly two "
+                         "sources (counters/histogram counts subtract; "
+                         "gauges show side-by-side)")
+    ap.add_argument("--url", action="append", default=[],
+                    metavar="URL",
+                    help="scrape a live endpoint as a source: /varz "
+                         "(JSON, exact names) or /metrics (Prometheus "
+                         "text, mangled ptpu_* names)")
     args = ap.parse_args(argv)
     if args.selftest:
         return _selftest()
-    if not args.files:
-        ap.error("no metrics files given (or use --selftest)")
+    sources = [(p, "file") for p in args.files] \
+        + [(u, "url") for u in args.url]
+    if not sources:
+        ap.error("no metrics files or --url given (or use --selftest)")
+    docs = []
+    for src, kind in sources:
+        if kind == "url":
+            docs.append((src, _fetch_doc(src)))
+        else:
+            with open(src) as f:
+                docs.append((src, json.load(f)))
+    if args.diff:
+        if len(docs) != 2:
+            ap.error("--diff wants exactly two sources, got %d"
+                     % len(docs))
+        render_diff(docs[0][1], docs[1][1])
+        # assertions gate the AFTER document — the state being shipped
+        docs = docs[1:]
+        rc = 0
+        for src, doc in docs:
+            failures = check_assertions(doc, args.assert_has,
+                                        args.assert_min, args.assert_max)
+            for msg in failures:
+                sys.stderr.write("%s: %s\n" % (src, msg))
+            if failures:
+                rc = 1
+        return rc
     rc = 0
-    for i, path in enumerate(args.files):
-        with open(path) as f:
-            doc = json.load(f)
-        if len(args.files) > 1:
-            sys.stdout.write("%s== %s ==\n" % ("\n" if i else "", path))
+    for i, (src, doc) in enumerate(docs):
+        if len(docs) > 1:
+            sys.stdout.write("%s== %s ==\n" % ("\n" if i else "", src))
         if args.prometheus:
             sys.stdout.write(_to_prometheus(doc))
         else:
@@ -236,7 +375,7 @@ def main(argv=None):
         failures = check_assertions(doc, args.assert_has, args.assert_min,
                                     args.assert_max)
         for msg in failures:
-            sys.stderr.write("%s: %s\n" % (path, msg))
+            sys.stderr.write("%s: %s\n" % (src, msg))
         if failures:
             rc = 1
     return rc
