@@ -1002,6 +1002,76 @@ def bench_serving_quant(n_requests=16, max_new_tokens=16, max_batch=8,
             sum(len(o) for o in int8_outs))
 
 
+def bench_kernels(repeats=30, warmup=3):
+    """Per-kernel dispatch receipts (docs/KERNELS.md): each Pallas
+    kernel timed against its own lax fallback on the SAME inputs —
+    paged flash-decode vs the contiguous block-table gather, the spec
+    verify window (C=4) vs the same gathered reference, and the fused
+    int8 matmul vs the unfused quantize->dot->dequantize chain. On the
+    CPU mesh the kernels run in interpret mode, so the speedup numbers
+    are floor gates only (positive, parity-checked) — the real margins
+    are TPU receipts, exactly the amp/int8 CPU-floor precedent.
+
+    Returns {kernel: {pallas_s, lax_s, speedup, max_err}}."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.RandomState(0)
+
+    def timed(fn, *args):
+        out = jax.block_until_ready(fn(*args))  # compile + result
+        for _ in range(warmup):
+            jax.block_until_ready(fn(*args))
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            jax.block_until_ready(fn(*args))
+        return (time.perf_counter() - t0) / repeats, out
+
+    results = {}
+
+    # paged attention: decode window (C=1) and spec verify window (C=4)
+    NB, bs, H, Dh, B, Mb = 64, 16, 4, 64, 8, 8
+    k_pages = jnp.asarray(rng.randn(NB + 1, bs, H, Dh)
+                          .astype(np.float32))
+    v_pages = jnp.asarray(rng.randn(NB + 1, bs, H, Dh)
+                          .astype(np.float32))
+    tables = jnp.asarray(
+        rng.permutation(NB)[:B * Mb].reshape(B, Mb).astype(np.int32) + 1)
+    pallas_fn = jax.jit(pk.paged_attention)
+    lax_fn = jax.jit(pk.paged_attention_reference)
+    for name, C in (("paged_decode", 1), ("spec_window", 4)):
+        q = jnp.asarray(rng.randn(B, C, H, Dh).astype(np.float32))
+        pos = jnp.asarray(
+            np.tile(np.arange(Mb * bs - C, Mb * bs, dtype=np.int32),
+                    (B, 1)))
+        t_pallas, got = timed(pallas_fn, k_pages, v_pages, q, tables,
+                              pos)
+        t_lax, want = timed(lax_fn, k_pages, v_pages, q, tables, pos)
+        results[name] = {
+            "pallas_s": t_pallas, "lax_s": t_lax,
+            "speedup": t_lax / max(t_pallas, 1e-12),
+            "max_err": float(jnp.max(jnp.abs(got - want)))}
+
+    # fused int8 matmul vs the unfused chain (bitwise-identical)
+    M, K, N = 256, 512, 512
+    x = jnp.asarray(rng.randn(M, K).astype(np.float32))
+    w = jnp.asarray(rng.randint(-128, 128, (K, N)).astype(np.int8))
+    dq = jnp.asarray((rng.rand(N).astype(np.float32) + 0.1) / 127.0)
+    act = float(127.0 / 3.0)
+    t_pallas, got = timed(
+        jax.jit(pk.int8_matmul, static_argnums=3), x, w, dq, act)
+    t_lax, want = timed(
+        jax.jit(pk.int8_matmul_reference, static_argnums=3),
+        x, w, dq, act)
+    results["int8_matmul"] = {
+        "pallas_s": t_pallas, "lax_s": t_lax,
+        "speedup": t_lax / max(t_pallas, 1e-12),
+        "max_err": float(jnp.max(jnp.abs(got - want)))}
+    return results
+
+
 def _fusion_receipt():
     """One forward-only fc+relu program through CompiledProgram with
     fuse_elewise_add_act_ops on: the bias add + relu collapse into a
@@ -1074,10 +1144,45 @@ def main(argv=None):
                     help="run only the streaming-ingestion leg pair "
                          "(healthy vs one-quarantined-shard records/s "
                          "— the CI data-chaos stage configuration)")
+    ap.add_argument("--kernels-only", action="store_true",
+                    help="run only the Pallas kernel receipts — each "
+                         "kernel vs its own lax fallback (paged "
+                         "decode, spec verify window, fused int8 "
+                         "matmul; CPU floor gates, TPU real margins)")
     ap.add_argument("--resilience", action="store_true",
                     help="also measure guarded vs unguarded step time "
                          "(always on under --tiny)")
     args = ap.parse_args(argv)
+
+    if args.kernels_only:
+        res = bench_kernels()
+        if args.metrics_out:
+            from paddle_tpu.observability import metrics as obs_metrics
+
+            reg = obs_metrics.registry()
+            for name, r in res.items():
+                reg.gauge("bench/kernel_%s_speedup" % name).set(
+                    r["speedup"])
+            reg.dump_json(args.metrics_out)
+        if args.legs_out:
+            with open(args.legs_out, "w") as f:
+                json.dump([
+                    {"leg": "kernel_" + name,
+                     "pallas_s": round(r["pallas_s"], 6),
+                     "lax_s": round(r["lax_s"], 6),
+                     "kernel_%s_speedup" % name: round(r["speedup"], 4),
+                     "max_err": r["max_err"]}
+                    for name, r in res.items()
+                ], f, indent=2)
+        print(json.dumps({
+            "metric": "kernel_speedups",
+            "unit": "x (lax fallback time / pallas kernel time; "
+                    "interpret-mode floor off-TPU)",
+            "value": {name: round(r["speedup"], 4)
+                      for name, r in res.items()},
+            "max_err": {name: r["max_err"] for name, r in res.items()},
+        }))
+        return
 
     if args.data_only:
         res = bench_data_ingestion()

@@ -304,9 +304,42 @@ def _fake_dequant(op, in_metas):
     return {"Out": [(xs, dt)]}
 
 
+def _fused_int8_matmul(op, in_metas):
+    """quant_rewrite's fused dense layer: fp32 activation × int8 weight
+    with in-kernel quantize/dequantize — output is fp32 at the shape of
+    the dot it replaced (matmul's (M, N), or mul's flatten-and-restore
+    shape when x_num_col_dims rides the attrs). The dtype round-trip
+    stays INSIDE the op — the one declared-space difference from the
+    3-op chain."""
+    xs, _ = _in0(in_metas, "X")
+    ys, _ = _in0(in_metas, "Y")
+    shape = None
+    xn = op.attrs.get("x_num_col_dims")
+    if xs is not None and ys is not None:
+        if xn is not None:
+            yn = int(op.attrs.get("y_num_col_dims", 1))
+            kx = [d for d in xs[int(xn):]]
+            ky = [d for d in ys[:yn]]
+            if None not in kx and None not in ky and \
+                    int(np.prod(kx or [1])) != int(np.prod(ky or [1])):
+                raise ValueError(
+                    "contraction dims %r x %r do not agree" % (kx, ky))
+            shape = tuple(xs[:int(xn)]) + tuple(ys[yn:])
+        elif len(xs) == 2 and len(ys) == 2:
+            if xs[1] is not None and ys[0] is not None \
+                    and xs[1] != ys[0]:
+                raise ValueError(
+                    "contraction dims %r and %r do not agree"
+                    % (xs[1], ys[0]))
+            shape = (xs[0], ys[1])
+    return {"Out": [(shape, "float32")]}
+
+
 def _register_quant_metas():
     declare("quantize", ins=("Input",), outs=("Output",),
             infer=_quantize_out)
+    declare("fused_int8_matmul", ins=("X", "Y", "Scale"), outs=("Out",),
+            attrs=("act_scale",), infer=_fused_int8_matmul)
     declare("dequantize", ins=("Input",), outs=("Output",),
             infer=_dequantize_out)
     declare("dequantize_linear", ins=("Input", "Scale"),

@@ -285,6 +285,16 @@ _declare("PTPU_LOCK_HOLD_MS", "float", None,
          "with PTPU_LOCK_CHECK=1, report a long-hold violation when a "
          "tracked lock is held longer than this many milliseconds "
          "(unset = off)")
+# -- Pallas kernel dispatch (docs/KERNELS.md) -------------------------------
+_declare("PTPU_KERNELS", "bool", None,
+         "Pallas kernel dispatch mode: 1 forces every registered kernel "
+         "on (interpret mode off-TPU — the CI/test spelling), 0 forces "
+         "the lax fallbacks bitwise, unset keeps each kernel's default "
+         "platform policy")
+_declare("PTPU_KERNELS_DISABLE", "str", None,
+         "comma-separated kernel names pinned to their lax fallback "
+         "regardless of PTPU_KERNELS (names: docs/KERNELS.md "
+         "qualification table)")
 # -- tests / CI -------------------------------------------------------------
 _declare("PTPU_PARITY_TIMEOUT", "float", 45.0,
          "seconds the TPU-backend parity test waits on its subprocess "

@@ -204,6 +204,15 @@ def pipeline_key(build_strategy=None, program=None, infer_opt=False):
         # compiled step — flipping it must not reuse a stale entry
         key += ("inplace:%d" % int(getattr(build_strategy,
                                            "enable_inplace", True)),)
+    from .ops.kernel_registry import cache_key as _kernel_cache_key
+
+    kk = _kernel_cache_key()
+    if kk != "auto":
+        # PTPU_KERNELS selects both quant_rewrite's fused-op emission
+        # and every trace-time kernel dispatch — a step compiled under
+        # one mode must not serve another. The default (auto) state adds
+        # nothing, keeping pre-kernel cache keys bitwise identical.
+        key += ("kernels:" + kk,)
     return key
 
 

@@ -549,8 +549,15 @@ def _flash_attention_op(ctx, ins, attrs):
             "materialize per chip" % (sp, form, T),
             RuntimeWarning)
 
-    use_pallas = (T % 128 == 0 and Dh >= 64 and q.shape == k.shape)
-    if use_pallas:
+    # registry-dispatched: the tuned kernel when the shape qualifies
+    # (the old ad-hoc gate here required q.shape == k.shape, silently
+    # dropping the tuned path for cross-attention — the registry's
+    # qualification allows non-causal Tq != Tk and logs any
+    # disqualification once), lax softmax attention otherwise
+    from .kernel_registry import choose as _choose_kernel
+
+    if _choose_kernel("flash_attention", T=T, Tk=k.shape[t_axis],
+                      head_dim=Dh, causal=causal):
         if layout == "bthd":
             q, k, v = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
         out = flash_attention(q, k, v, causal, scale)

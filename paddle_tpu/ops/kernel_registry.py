@@ -1,0 +1,197 @@
+"""Kernel dispatch registry — the ONE decision point between a tuned
+Pallas kernel and its lax fallback (docs/KERNELS.md).
+
+The reference framework's performance story is a hand-tuned CUDA kernel
+per hot op behind op-level `use_cudnn`-style switches; the TPU-native
+analogue here is a *registry*: each kernel declares its qualification
+predicate (the shape/platform conditions under which its tiling is
+profitable and correct) and its default platform policy, and every
+dispatch site asks :func:`choose` instead of carrying an ad-hoc shape
+check (the `use_pallas` gate `compat_ops.py` used to hard-code — which
+silently dropped the tuned path for cross-attention shapes and never
+told anyone why).
+
+Dispatch contract (trace time — decisions are static per compiled step):
+
+  ``PTPU_KERNELS`` unset   each kernel's own default policy decides:
+                           `flash_attention` runs everywhere (interpret
+                           mode off-TPU, its historical behavior); the
+                           serving/quant kernels (`paged_decode`,
+                           `spec_window`, `int8_matmul`) engage on TPU
+                           only, so non-TPU platforms reproduce pre-
+                           kernel numerics bitwise.
+  ``PTPU_KERNELS=1``       every registered kernel forced on (interpret
+                           mode off-TPU) — the CI/test spelling.
+  ``PTPU_KERNELS=0``       every dispatch takes its lax fallback,
+                           bitwise.
+  ``PTPU_KERNELS_DISABLE`` comma-separated kernel names pinned to their
+                           fallback regardless of the mode.
+
+A dispatch that qualifies increments ``kernels/dispatches`` and
+``kernels/kernel:<name>``; one that falls back (mode off, platform
+policy, disabled, or shape disqualified) increments
+``kernels/fallbacks``. A *shape* disqualification additionally warns
+once per (kernel, reason) — the DeferredWarns discipline: the first
+trace that loses the tuned path says why, steady state stays silent.
+
+Flipping the mode must never reuse a step compiled under the other
+policy: :func:`cache_key` rides the compile-cache pipeline key and the
+serving step caches.
+"""
+
+import warnings
+
+from .. import flags as _flags
+from ..observability import metrics as _metrics
+
+__all__ = ["KernelSpec", "register_kernel", "get_kernel",
+           "registered_kernels", "choose", "dispatch", "enabled_for",
+           "kernels_mode", "cache_key"]
+
+
+class KernelSpec:
+    """One registered kernel: the tuned Pallas implementation, its lax
+    fallback, the shape-qualification predicate, and the default
+    platform policy used when ``PTPU_KERNELS`` is unset.
+
+    ``qualify(...)`` receives the same arguments the implementations
+    take (or the cheap shape proxies a site passes to :func:`choose`)
+    and returns ``(ok, reason)`` — `reason` is the human-readable
+    disqualification (warned once per kernel+reason) or None.
+    ``default_on()`` returns whether the kernel engages under the unset
+    (auto) mode on the current platform."""
+
+    __slots__ = ("name", "pallas", "fallback", "_qualify", "_default_on",
+                 "doc")
+
+    def __init__(self, name, pallas, fallback, qualify, default_on, doc):
+        self.name = name
+        self.pallas = pallas
+        self.fallback = fallback
+        self._qualify = qualify
+        self._default_on = default_on
+        self.doc = doc
+
+    def qualify(self, *args, **kw):
+        if self._qualify is None:
+            return True, None
+        return self._qualify(*args, **kw)
+
+    def default_on(self):
+        if self._default_on is None:
+            return True
+        return bool(self._default_on())
+
+
+_REGISTRY = {}
+# (kernel name, reason) pairs already warned about — qualification
+# failures report once per distinct cause, not once per trace
+_WARNED = set()
+
+
+def register_kernel(name, pallas, fallback, qualify=None, default_on=None,
+                    doc=""):
+    """Register (or replace) one kernel spec. Returns the spec."""
+    spec = KernelSpec(str(name), pallas, fallback, qualify, default_on,
+                      doc)
+    _REGISTRY[spec.name] = spec
+    return spec
+
+
+def get_kernel(name):
+    spec = _REGISTRY.get(name)
+    if spec is None:
+        # the kernel library registers on import; dispatch sites that
+        # reach the registry first (serving, compile passes) trigger it
+        from . import pallas_kernels  # noqa: F401  (registers kernels)
+
+        spec = _REGISTRY.get(name)
+    if spec is None:
+        raise KeyError(
+            "unknown kernel %r — registered: %s"
+            % (name, sorted(_REGISTRY)))
+    return spec
+
+
+def registered_kernels():
+    """{name: KernelSpec} snapshot (docs/KERNELS.md's source of truth)."""
+    return dict(_REGISTRY)
+
+
+def kernels_mode():
+    """'force' | 'off' | 'auto' from PTPU_KERNELS (tri-state bool)."""
+    val = _flags.env("PTPU_KERNELS")
+    if val is True:
+        return "force"
+    if val is False:
+        return "off"
+    return "auto"
+
+
+def _disabled():
+    raw = _flags.env("PTPU_KERNELS_DISABLE")
+    if not raw:
+        return frozenset()
+    return frozenset(s.strip() for s in raw.split(",") if s.strip())
+
+
+def cache_key():
+    """Compile-cache key component covering the dispatch policy: steps
+    compiled under one kernel mode must not serve another. The default
+    state stringifies to 'auto' (callers omit it then, keeping pre-
+    kernel cache keys bitwise)."""
+    mode = kernels_mode()
+    dis = _disabled()
+    return mode if not dis else mode + ":-" + ",".join(sorted(dis))
+
+
+def enabled_for(name):
+    """Mode+platform decision WITHOUT shape qualification — for compile
+    passes that must decide what to *emit* before trace-time shapes
+    exist (quant_rewrite's fused-matmul emission). No telemetry: the
+    trace-time :func:`choose` on the emitted op is the counted event."""
+    spec = get_kernel(name)
+    mode = kernels_mode()
+    if mode == "off" or name in _disabled():
+        return False
+    if mode == "force":
+        return True
+    return spec.default_on()
+
+
+def choose(name, *args, **kwargs):
+    """The dispatch decision for one kernel launch site (trace time):
+    True -> call the Pallas kernel, False -> the lax fallback. The
+    arguments feed the spec's qualification predicate. Counts
+    ``kernels/{dispatches,fallbacks}`` (+ the per-kernel counter) and
+    warns once per (kernel, reason) when a *shape* disqualifies."""
+    spec = get_kernel(name)
+    if not enabled_for(name):
+        _metrics.counter("kernels/fallbacks").inc()
+        return False
+    ok, reason = spec.qualify(*args, **kwargs)
+    if not ok:
+        _metrics.counter("kernels/fallbacks").inc()
+        key = (name, reason)
+        if key not in _WARNED:
+            _WARNED.add(key)
+            warnings.warn(
+                "kernel %r disqualified (%s): taking the lax fallback "
+                "for this shape (docs/KERNELS.md)" % (name, reason),
+                RuntimeWarning)
+        return False
+    _metrics.counter("kernels/dispatches").inc()
+    _metrics.counter("kernels/kernel:" + name).inc()
+    return True
+
+
+def dispatch(name, *args, **kwargs):
+    """choose() + call: runs the Pallas kernel when the site qualifies
+    (passing the SAME arguments to the qualification predicate), the
+    lax fallback otherwise. Sites whose qualification wants cheap shape
+    proxies instead of full operands call :func:`choose` themselves and
+    invoke the chosen implementation directly."""
+    spec = get_kernel(name)
+    if choose(name, *args, **kwargs):
+        return spec.pallas(*args, **kwargs)
+    return spec.fallback(*args, **kwargs)

@@ -190,6 +190,36 @@ def _matmul(ctx, ins, attrs):
     return {"Out": [out]}
 
 
+@register("fused_int8_matmul", differentiable=False)
+def _fused_int8_matmul(ctx, ins, attrs):
+    """quant_rewrite's fused full-int8 dense layer (one op instead of
+    the quantize -> int8 matmul -> dequantize_linear chain): X [M, K]
+    fp32 activation, Y [K, N] int8 weight, Scale [N] combined
+    per-output-channel dequantize vector, attr `act_scale` the
+    activation quantize scale. Dispatches the Pallas kernel through the
+    registry (in-kernel activation quantize + int32 MXU accumulation +
+    in-kernel dequant); the lax fallback is bitwise the unfused op
+    chain, so flipping PTPU_KERNELS never moves inference numerics."""
+    from .kernel_registry import dispatch as _dispatch_kernel
+
+    x, y = ins["X"][0], ins["Y"][0]
+    dq = ins["Scale"][0]
+    act_scale = float(attrs["act_scale"])
+    xn = attrs.get("x_num_col_dims")
+    if xn is None:
+        # plain 2-D matmul
+        out = _dispatch_kernel("int8_matmul", x, y, dq, act_scale)
+        return {"Out": [out]}
+    # mul semantics: flatten exactly the way the mul op does, dot,
+    # reshape back (quantize commutes with reshape — bitwise the chain)
+    yn = int(attrs.get("y_num_col_dims", 1))
+    xs, ys = x.shape, y.shape
+    x2 = x.reshape((int(np.prod(xs[:xn])), int(np.prod(xs[xn:]))))
+    y2 = y.reshape((int(np.prod(ys[:yn])), int(np.prod(ys[yn:]))))
+    out = _dispatch_kernel("int8_matmul", x2, y2, dq, act_scale)
+    return {"Out": [out.reshape(xs[:xn] + ys[yn:])]}
+
+
 @register("mul")
 def _mul(ctx, ins, attrs):
     """Fluid `mul`: flatten x to 2-D at x_num_col_dims, y at y_num_col_dims,

@@ -1,6 +1,10 @@
-"""Pallas TPU kernels for the hot ops XLA doesn't fuse optimally
+"""Pallas TPU kernel library — the hot ops XLA doesn't fuse optimally
 (SURVEY §7 design mapping: "hand-written Pallas kernels only where XLA
-underperforms — attention/softmax fusions, top-k/DGC").
+underperforms — attention/softmax fusions, top-k/DGC"; the reference
+framework's per-op CUDA kernel corpus, re-grown TPU-native).
+
+The kernels (each registered in ops/kernel_registry with its lax
+fallback, shape qualification and platform policy — docs/KERNELS.md):
 
 flash_attention: blocked causal attention with online softmax — the
   O(T) -memory replacement for the naive [T, T] score matrix. Forward is a
@@ -8,8 +12,23 @@ flash_attention: blocked causal attention with online softmax — the
   accumulators carried across the innermost kv dimension); backward is the
   standard recompute formulation via jax.custom_vjp, left to XLA fusion.
 
-Kernels run under interpret=True off-TPU so the CPU test mesh exercises the
-same code path (tests/test_pallas.py).
+paged_attention: decode-side attention that reads the serving
+  ``KVBlockPool`` pages THROUGH the block table (the block-sparse gather
+  happens inside the kernel via scalar-prefetch BlockSpec index maps, the
+  PagedAttention formulation) — the per-step contiguous
+  ``kv[block_tables].reshape(...)`` gather the XLA path materializes
+  disappears. One kernel serves both the one-token decode window (C=1,
+  ``kernel 'paged_decode'``) and the speculative verify window (C=k+1,
+  ``kernel 'spec_window'``).
+
+int8_matmul: fused int8×int8→int32 matmul for the full-int8 quant path —
+  the activation quantizes IN-KERNEL (per-tensor scale), the dot
+  accumulates int32 on the MXU int8 path, and the per-output-channel
+  dequantize applies on the final K block, so the separate
+  quantize/dequantize_linear HLOs around each rewritten matmul vanish.
+
+Kernels run under interpret=True off-TPU so the CPU test mesh exercises
+the same code path (tests/test_pallas.py).
 """
 
 import functools
@@ -26,6 +45,11 @@ except Exception:  # pragma: no cover
     pltpu = None
     _VMEM = None
 
+
+__all__ = ["flash_attention", "flash_attention_portable",
+           "attention_reference", "paged_attention",
+           "paged_attention_reference", "int8_matmul",
+           "int8_matmul_reference"]
 
 _NEG_INF = -1e30
 
@@ -100,7 +124,10 @@ def flash_attention(q, k, v, causal=True, sm_scale=None, block_q=128,
     faster in-model with seq-wide blocks than the 128 defaults); everywhere
     else (CPU mesh, interpret mode) it runs the portable in-repo kernel
     below, whose backward recomputes attention through XLA."""
-    if jax.default_backend() == "tpu":
+    # library path only for the self-attention shape it was profiled on;
+    # cross-attention (Tk != Tq) runs the portable kernel, whose kv_len
+    # masking handles ragged kv blocks
+    if jax.default_backend() == "tpu" and q.shape == k.shape:
         T = q.shape[2]
         blk = next((b for b in (512, 256, 128) if T % b == 0 and b <= T),
                    None)
@@ -205,3 +232,318 @@ def _flash_bwd_rule(causal, sm_scale, block_q, block_k, res, g):
 
 
 flash_attention_portable.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def attention_reference(q, k, v, causal=True, sm_scale=None):
+    """The unfused lax reference for flash_attention (q, k, v:
+    [B, H, T, D]) — the registry fallback and the numerics oracle the
+    kernel tests pin against."""
+    D = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else D ** -0.5
+    f32 = jnp.float32
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(f32), k.astype(f32)) * scale
+    if causal:
+        Tq, Tk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((Tq, Tk), bool), Tk - Tq)
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(f32)).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# paged attention: decode / speculative verify windows over KVBlockPool
+# pages, block tables resolved INSIDE the kernel (scalar-prefetch index
+# maps — the PagedAttention formulation)
+# ---------------------------------------------------------------------------
+
+
+def _paged_attn_kernel(tables_ref, lastpos_ref, q_ref, k_ref, v_ref,
+                       pos_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                       sm_scale, block_size):
+    """Grid (B, H, Mb); j (the block-table slot) is innermost, carrying
+    the online-softmax state across one row's pages. The k/v BlockSpec
+    index maps already resolved table slot j to its PHYSICAL page (null
+    pages land here too — harmless, their logical positions are masked
+    or the whole block is skipped)."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+    C = q_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # pages wholly past the row's LAST query position hold nothing any
+    # window slot may attend to — skip their compute (their table
+    # entries are the null page anyway)
+    @pl.when(j * block_size <= lastpos_ref[b])
+    def _body():
+        q = q_ref[0, :, 0, :].astype(jnp.float32)       # [C, Dh]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)       # [bs, Dh]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [C, bs]
+        # logical positions covered by table slot j vs each window
+        # slot's own position (causal within the window)
+        t_pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (C, block_size), 1)
+        mask = t_pos <= pos_ref[0]                      # pos: [C, 1]
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_scr[:, :1] * alpha + p.sum(axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        o_ref[0, :, 0, :] = (acc_scr[:]
+                             / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def paged_attention(k_pages, v_pages, q, block_tables, positions,
+                    sm_scale=None):
+    """Attention over a paged KV cache, block tables resolved in-kernel.
+
+    k_pages/v_pages: ``[num_blocks+1, block_size, H, Dh]`` — ONE layer of
+    the ``KVBlockPool`` device arrays (page 0 is the null page).
+    q: ``[B, C, H, Dh]`` query window (C=1 for plain decode, C=k+1 for
+    the speculative verify window). block_tables: ``[B, Mb]`` int32 —
+    table slot j holds the physical page covering logical positions
+    ``[j*bs, (j+1)*bs)``; unallocated slots hold the null page.
+    positions: ``[B, C]`` int32 — window slot c attends to logical
+    positions ``t <= positions[b, c]`` (the row's k/v for the whole
+    window are written before the call, exactly like the XLA path).
+
+    Returns the ``[B, C, H, Dh]`` fp32 context. Numerics: online softmax
+    (flash formulation) — token-identical to the gathered reference, not
+    bitwise (docs/KERNELS.md)."""
+    if pltpu is None:  # pragma: no cover - guarded by registry qualify
+        raise RuntimeError("paged_attention needs pallas TPU support "
+                           "(scalar-prefetch grid specs)")
+    B, C, H, Dh = q.shape
+    bs = k_pages.shape[1]
+    Mb = block_tables.shape[1]
+    if sm_scale is None:
+        sm_scale = Dh ** -0.5
+    interpret = jax.default_backend() != "tpu"
+
+    tables = block_tables.astype(jnp.int32)
+    pos = jnp.maximum(positions, 0).astype(jnp.int32)    # [B, C]
+    last_pos = pos[:, C - 1]                             # [B]
+    pos3 = pos[:, :, None]                               # [B, C, 1]
+
+    grid = (B, H, Mb)
+    kernel = functools.partial(_paged_attn_kernel, sm_scale=sm_scale,
+                               block_size=bs)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, C, 1, Dh),
+                         lambda b, h, j, tables, lp: (b, 0, h, 0)),
+            pl.BlockSpec((1, bs, 1, Dh),
+                         lambda b, h, j, tables, lp: (tables[b, j],
+                                                      0, h, 0)),
+            pl.BlockSpec((1, bs, 1, Dh),
+                         lambda b, h, j, tables, lp: (tables[b, j],
+                                                      0, h, 0)),
+            pl.BlockSpec((1, C, 1),
+                         lambda b, h, j, tables, lp: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, C, 1, Dh),
+                               lambda b, h, j, tables, lp: (b, 0, h, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((C, 128), jnp.float32),
+            pltpu.VMEM((C, 128), jnp.float32),
+            pltpu.VMEM((C, Dh), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, C, H, Dh), jnp.float32),
+        interpret=interpret,
+    )(tables, last_pos, q, k_pages, v_pages, pos3)
+
+
+def paged_attention_reference(k_pages, v_pages, q, block_tables,
+                              positions, sm_scale=None):
+    """The unfused lax fallback: contiguous gather through the block
+    table, then masked softmax attention — element-for-element the
+    serving model's historical XLA decode-attention path."""
+    B, C, H, Dh = q.shape
+    bs = k_pages.shape[1]
+    max_ctx = block_tables.shape[1] * bs
+    if sm_scale is None:
+        sm_scale = Dh ** -0.5
+    k_ctx = k_pages[block_tables].reshape(B, max_ctx, H, Dh)
+    v_ctx = v_pages[block_tables].reshape(B, max_ctx, H, Dh)
+    scores = jnp.einsum("bchd,bthd->bcht", q, k_ctx) * sm_scale
+    t_ids = jnp.arange(max_ctx)[None, None, :]
+    valid = t_ids <= positions[:, :, None]
+    scores = jnp.where(valid[:, :, None, :], scores, -jnp.inf)
+    w = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
+    w = w / jnp.sum(w, axis=-1, keepdims=True)
+    return jnp.einsum("bcht,bthd->bchd", w, v_ctx)
+
+
+# ---------------------------------------------------------------------------
+# fused int8 matmul: in-kernel activation quantize, int8×int8→int32 MXU
+# dot, per-output-channel dequantize on the last K block
+# ---------------------------------------------------------------------------
+
+
+def _int8_mm_kernel(x_ref, w_ref, s_ref, o_ref, acc_scr, *, act_scale):
+    kk = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kk == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # the quantize op's exact grid: round-half-even, clip, int8 (zero
+    # padding quantizes to zero and contributes nothing to the dot)
+    qa = jnp.clip(jnp.round(x_ref[:] * act_scale), -128, 127) \
+        .astype(jnp.int8)
+    acc_scr[:] += jax.lax.dot_general(
+        qa, w_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+
+    @pl.when(kk == nk - 1)
+    def _finish():
+        o_ref[:] = acc_scr[:].astype(jnp.float32) * s_ref[:]
+
+
+def int8_matmul(x, w_int8, dq_scale, act_scale, block_m=32, block_k=128,
+                block_n=128):
+    """Fused full-int8 matmul: ``dequant(quant(x) @ w_int8)`` in one
+    kernel. x: ``[M, K]`` fp32 activation; w_int8: ``[K, N]`` int8
+    weight; dq_scale: ``[N]`` fp32 combined per-output-channel
+    dequantize scale (``(w_scales/127) * (s_act/127)``); act_scale: the
+    activation quantize scale (``127/s_act``). Returns ``[M, N]`` fp32.
+
+    int32 accumulation is exact over any K split, so the result matches
+    the unfused quantize→dot→dequantize_linear path bitwise up to the
+    final fp32 scale multiply (docs/KERNELS.md numerics policy)."""
+    M, K = x.shape
+    N = w_int8.shape[1]
+    interpret = jax.default_backend() != "tpu"
+
+    xp = _pad_to(_pad_to(x, 0, block_m), 1, block_k)
+    wp = _pad_to(_pad_to(w_int8, 0, block_k), 1, block_n)
+    sp = _pad_to(jnp.asarray(dq_scale, jnp.float32).reshape(1, N), 1,
+                 block_n)
+    Mp, Kp = xp.shape
+    Np = wp.shape[1]
+    grid = (Mp // block_m, Np // block_n, Kp // block_k)
+
+    if pltpu is not None:
+        scratch = [pltpu.VMEM((block_m, block_n), jnp.int32)]
+    else:  # pragma: no cover - CPU-only install without the tpu module
+        scratch = [jax.ShapeDtypeStruct((block_m, block_n), jnp.int32)]
+
+    out = pl.pallas_call(
+        functools.partial(_int8_mm_kernel, act_scale=act_scale),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_m, block_k), lambda i, j, k: (i, k)),
+            pl.BlockSpec((block_k, block_n), lambda i, j, k: (k, j)),
+            pl.BlockSpec((1, block_n), lambda i, j, k: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((block_m, block_n),
+                               lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((Mp, Np), jnp.float32),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(xp, wp, sp)
+    return out[:M, :N]
+
+
+def int8_matmul_reference(x, w_int8, dq_scale, act_scale):
+    """The unfused lax fallback — bitwise the quantize →
+    int8-dot(int32) → dequantize_linear op chain the quant_rewrite pass
+    emits when the fused kernel is off."""
+    qa = jnp.clip(jnp.round(x * act_scale), -128, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(qa, w_int8, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+    return acc.astype(jnp.float32) * jnp.asarray(dq_scale, jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# registry entries (ops/kernel_registry — docs/KERNELS.md qualification
+# table; importing this module is what populates the registry)
+# ---------------------------------------------------------------------------
+
+
+def _on_tpu():
+    return jax.default_backend() == "tpu"
+
+
+def _flash_qualify(T=None, Tk=None, head_dim=None, causal=False):
+    """The compat_ops.py gate, promoted and FIXED: the historical check
+    required q.shape == k.shape, silently dropping the tuned path for
+    every cross-attention-shaped call — non-causal cross attention
+    (Tq != Tk) tiles fine (the kernel masks by kv length). Causal still
+    requires Tq == Tk: the blocked diagonal assumes aligned starts."""
+    Tk = T if Tk is None else Tk
+    if T is None or T % 128 or Tk % 128:
+        return False, "seq len not a multiple of 128"
+    if head_dim is None or head_dim < 64:
+        return False, "head_dim < 64"
+    if causal and Tk != T:
+        return False, "causal cross-attention (Tq != Tk)"
+    return True, None
+
+
+def _paged_qualify(head_dim=None, block_size=None, window=None):
+    if pltpu is None:
+        return False, "pallas TPU support (scalar prefetch) unavailable"
+    return True, None
+
+
+def _int8_qualify(x=None, w=None, *args, **kwargs):
+    xs = getattr(x, "shape", None)
+    ws = getattr(w, "shape", None)
+    if xs is None or ws is None or len(xs) != 2 or len(ws) != 2:
+        return False, "operands are not 2-D"
+    return True, None
+
+
+def _register_all():
+    from .kernel_registry import register_kernel
+
+    register_kernel(
+        "flash_attention", flash_attention, attention_reference,
+        qualify=_flash_qualify, default_on=None,
+        doc="blocked online-softmax attention ([B,H,T,D]); default: on "
+            "everywhere (interpret off-TPU, its historical dispatch)")
+    register_kernel(
+        "paged_decode", paged_attention, paged_attention_reference,
+        qualify=_paged_qualify, default_on=_on_tpu,
+        doc="one-token decode attention reading KVBlockPool pages "
+            "through the block table in-kernel; default: TPU only")
+    register_kernel(
+        "spec_window", paged_attention, paged_attention_reference,
+        qualify=_paged_qualify, default_on=_on_tpu,
+        doc="speculative verify-window (k+1 query positions) over the "
+            "paged cache in one kernel; default: TPU only")
+    register_kernel(
+        "int8_matmul", int8_matmul, int8_matmul_reference,
+        qualify=_int8_qualify, default_on=_on_tpu,
+        doc="fused quantize + int8 dot (int32 acc) + per-channel "
+            "dequantize for full-int8 programs; default: TPU only")
+
+
+_register_all()

@@ -89,6 +89,17 @@ _EPS = 1e-8
 MODES = ("weight_only", "full_int8")
 
 
+def _kernel_enabled(name):
+    """Emission-time dispatch policy for the fused Pallas kernels
+    (ops/kernel_registry.enabled_for): mode + platform only — shape
+    qualification happens at trace time inside the emitted op. The
+    kernel mode rides the pipeline cache key (ir_passes.pipeline_key),
+    so a program rewritten under one policy never serves another."""
+    from .ops.kernel_registry import enabled_for
+
+    return enabled_for(name)
+
+
 def _check_ops(ops):
     """Validate a user-supplied quantizable-op set against the known
     slot layouts — a typo'd op type fails here with the supported list,
@@ -620,7 +631,42 @@ class QuantRewritePass(Pass):
 
             qv, scales, sb, val = quantized_weight(op, w)
 
-            if full:
+            # full-int8 dense layers (mul / plain matmul) fuse the whole
+            # quantize -> int8 dot -> dequantize chain into ONE op when
+            # the Pallas int8 kernel's dispatch policy has it on
+            # (ops/kernel_registry.enabled_for — an emission-time mode+
+            # platform decision, so kernels-off programs are op-for-op
+            # the historical 3-op emission): the standalone
+            # quantize/dequantize_linear HLOs around the dot vanish from
+            # the lowered module
+            fuse = full and op.type in ("matmul", "mul") \
+                and not op.attrs.get("transpose_X", False) \
+                and not op.attrs.get("transpose_Y", False) \
+                and _kernel_enabled("int8_matmul")
+
+            if fuse:
+                s_a = float(table.act_scale(a.name))
+                out = outs[0]
+                # flat per-output-channel combined scale: the op impl
+                # flattens mul's operands to 2-D the same way the mul
+                # op does, so the kernel always sees an [N] vector
+                dq = (np.asarray(scales).reshape(-1) / _QMAX) \
+                    * (s_a / _QMAX)
+                dqv = bake_const(out.name + ".qdq",
+                                 np.asarray(dq, np.float32), "float32")
+                fattrs = {"act_scale": _QMAX / max(s_a, _EPS),
+                          "__quant__": True}
+                if op.type == "mul":
+                    fattrs["x_num_col_dims"] = int(
+                        op.attrs.get("x_num_col_dims", 1))
+                    fattrs["y_num_col_dims"] = int(
+                        op.attrs.get("y_num_col_dims", 1))
+                new_ops.append(Operator(
+                    block, "fused_int8_matmul",
+                    inputs={"X": [a], "Y": [qv], "Scale": [dqv]},
+                    outputs={"Out": [out]},
+                    attrs=fattrs))
+            elif full:
                 s_a = float(table.act_scale(a.name))
                 qa_key = (a.name, rdef(a.name, i))
                 qa = quant_cache.get(qa_key)

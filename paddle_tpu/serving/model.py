@@ -69,6 +69,17 @@ GENERATION_WEIGHTS = "__generation__.npz"
 GENERATION_META = "__generation_meta__.json"
 
 
+def _kernel_key_suffix():
+    """Step-cache key component for the Pallas kernel dispatch policy
+    (ops/kernel_registry): a step traced under one PTPU_KERNELS mode
+    must not serve another. Empty in the default (auto) state so
+    pre-kernel cache keys stay bitwise identical."""
+    from ..ops.kernel_registry import cache_key
+
+    key = cache_key()
+    return () if key == "auto" else ("kernels:" + key,)
+
+
 class GenerationConfig:
     """Decoder-only LM hyperparameters (transformer_fluid.build shape)."""
 
@@ -445,6 +456,17 @@ class GenerationModel:
                                 axis=1)[:, 0],
             0)
 
+        # one dispatch decision per forward (trace time), shared by all
+        # layers: the paged flash-decode kernel reads the pool pages
+        # through the block table in-kernel, so the contiguous
+        # kv[block_tables] gather below never materializes
+        from ..ops.kernel_registry import choose as _choose_kernel
+
+        use_paged = _choose_kernel("paged_decode", head_dim=Dh,
+                                   block_size=bs)
+        if use_paged:
+            from ..ops.pallas_kernels import paged_attention
+
         def ln(h, scale, bias):
             mu = jnp.mean(h, axis=-1, keepdims=True)
             var = jnp.mean((h - mu) ** 2, axis=-1, keepdims=True)
@@ -466,14 +488,22 @@ class GenerationModel:
             v_new = v_new.reshape(B, H, Dh)
             kv_k = kv_k.at[i, write_blk, slot_idx].set(k_new)
             kv_v = kv_v.at[i, write_blk, slot_idx].set(v_new)
-            # paged gather: [B, Mb, bs, H, Dh] -> [B, max_ctx, H, Dh]
-            k_ctx = kv_k[i][block_tables].reshape(B, max_ctx, H, Dh)
-            v_ctx = kv_v[i][block_tables].reshape(B, max_ctx, H, Dh)
-            scores = jnp.einsum("bhd,bthd->bht", q, k_ctx) * sm_scale
-            scores = jnp.where(valid[:, None, :], scores, -jnp.inf)
-            w = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
-            w = w / jnp.sum(w, axis=-1, keepdims=True)
-            ctx = jnp.einsum("bht,bthd->bhd", w, v_ctx).reshape(B, -1)
+            if use_paged:
+                ctx = paged_attention(
+                    kv_k[i], kv_v[i], q[:, None], block_tables,
+                    positions[:, None], sm_scale=sm_scale)
+                ctx = ctx[:, 0].reshape(B, -1)
+            else:
+                # paged gather: [B, Mb, bs, H, Dh] -> [B, max_ctx, H, Dh]
+                k_ctx = kv_k[i][block_tables].reshape(B, max_ctx, H, Dh)
+                v_ctx = kv_v[i][block_tables].reshape(B, max_ctx, H, Dh)
+                scores = jnp.einsum("bhd,bthd->bht", q, k_ctx) * sm_scale
+                scores = jnp.where(valid[:, None, :], scores, -jnp.inf)
+                w = jnp.exp(scores
+                            - jnp.max(scores, axis=-1, keepdims=True))
+                w = w / jnp.sum(w, axis=-1, keepdims=True)
+                ctx = jnp.einsum("bht,bthd->bhd", w, v_ctx) \
+                    .reshape(B, -1)
             x = x + ctx @ self._w(jnp, weights, p + "wproj") \
                 + weights[p + "bproj"]
             b2 = ln(x, weights[p + "ln2_scale"], weights[p + "ln2_bias"])
@@ -491,7 +521,7 @@ class GenerationModel:
         engine geometry. The KV arrays are donated — updates alias
         in-place in device memory."""
         key = (int(max_batch), int(max_blocks_per_seq),
-               bool(return_logits))
+               bool(return_logits)) + _kernel_key_suffix()
         if key in self._steps:
             return self._steps[key]
         import jax
@@ -600,6 +630,17 @@ class GenerationModel:
         t_ids = jnp.arange(max_ctx)[None, None, :]
         attn_valid = t_ids <= pos2d[:, :, None]          # [B, C, T]
 
+        # the speculative verify window (all_slots) dispatches the
+        # fused spec_window kernel — k+1 query positions against the
+        # paged cache in one launch, block table resolved in-kernel;
+        # one decision per forward, shared by all layers
+        from ..ops.kernel_registry import choose as _choose_kernel
+
+        use_paged = all_slots and _choose_kernel(
+            "spec_window", head_dim=Dh, block_size=bs, window=C)
+        if use_paged:
+            from ..ops.pallas_kernels import paged_attention
+
         for i in range(cfg.n_layers):
             p = "l%d/" % i
             a = ln(x, weights[p + "ln1_scale"], weights[p + "ln1_bias"])
@@ -611,16 +652,23 @@ class GenerationModel:
             v_new = v_new.reshape(B, C, H, Dh)
             kv_k = kv_k.at[i, write_blk, slot_idx].set(k_new)
             kv_v = kv_v.at[i, write_blk, slot_idx].set(v_new)
-            # paged gather: [B, Mb, bs, H, Dh] -> [B, max_ctx, H, Dh]
-            k_ctx = kv_k[i][block_tables].reshape(B, max_ctx, H, Dh)
-            v_ctx = kv_v[i][block_tables].reshape(B, max_ctx, H, Dh)
-            scores = jnp.einsum("bchd,bthd->bcht", q, k_ctx) * sm_scale
-            scores = jnp.where(attn_valid[:, :, None, :], scores,
-                               -jnp.inf)
-            w = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
-            w = w / jnp.sum(w, axis=-1, keepdims=True)
-            ctx = jnp.einsum("bcht,bthd->bchd", w, v_ctx) \
-                .reshape(B, C, -1)
+            if use_paged:
+                ctx = paged_attention(
+                    kv_k[i], kv_v[i], q, block_tables, pos2d,
+                    sm_scale=sm_scale).reshape(B, C, -1)
+            else:
+                # paged gather: [B, Mb, bs, H, Dh] -> [B, max_ctx, H, Dh]
+                k_ctx = kv_k[i][block_tables].reshape(B, max_ctx, H, Dh)
+                v_ctx = kv_v[i][block_tables].reshape(B, max_ctx, H, Dh)
+                scores = jnp.einsum("bchd,bthd->bcht", q, k_ctx) \
+                    * sm_scale
+                scores = jnp.where(attn_valid[:, :, None, :], scores,
+                                   -jnp.inf)
+                w = jnp.exp(scores
+                            - jnp.max(scores, axis=-1, keepdims=True))
+                w = w / jnp.sum(w, axis=-1, keepdims=True)
+                ctx = jnp.einsum("bcht,bthd->bchd", w, v_ctx) \
+                    .reshape(B, C, -1)
             x = x + ctx @ self._w(jnp, weights, p + "wproj") \
                 + weights[p + "bproj"]
             b2 = ln(x, weights[p + "ln2_scale"], weights[p + "ln2_bias"])
@@ -671,7 +719,7 @@ class GenerationModel:
         One body, so the token-splice/embedding/position plumbing can
         never diverge between the two shapes."""
         key = (kind, int(max_batch), int(max_blocks_per_seq),
-               int(window), bool(return_logits))
+               int(window), bool(return_logits)) + _kernel_key_suffix()
         if key in self._steps:
             return self._steps[key]
         import jax

@@ -953,6 +953,87 @@ print("quant stage ok:",
 PYEOF
 }
 
+do_kernels() {
+  # Pallas kernel dispatch receipt (docs/KERNELS.md). (a) under
+  # PTPU_KERNELS=1 the registry actually dispatches on the CPU
+  # interpreter legs (kernels/dispatches >= 1), and a full-int8
+  # program routed through the fused int8 matmul — one
+  # fused_int8_matmul op, no standalone quantize/dequantize ops —
+  # verifies clean under PTPU_VERIFY_PASSES=1 (verify/violations == 0)
+  # while matching the unfused chain bitwise. (b) the per-kernel bench
+  # receipts publish the three speedup gauges. CPU floor gates only:
+  # the kernels run in interpret mode off-TPU, so the gauges are
+  # parity-checked and positive, not > 1 — the real margins are TPU
+  # receipts (the amp/int8 CPU-floor precedent).
+  local dump=/tmp/ptpu_kernels_metrics.json
+  local legs=/tmp/ptpu_kernels_legs.json
+  rm -f "$dump"
+  JAX_PLATFORMS=cpu PTPU_METRICS=1 PTPU_METRICS_OUT="$dump" \
+    PTPU_VERIFY_PASSES=1 PTPU_KERNELS=1 \
+    python - <<'PYEOF'
+import os
+
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import quant
+
+prog, sprog = fluid.Program(), fluid.Program()
+with fluid.program_guard(prog, sprog):
+    x = fluid.layers.data(name="kx", shape=[48], dtype="float32")
+    h = fluid.layers.fc(input=x, size=56, act="relu")
+    out = fluid.layers.fc(input=h, size=24)
+exe = fluid.Executor(fluid.CPUPlace())
+exe.run(sprog)
+rng = np.random.RandomState(0)
+feeds = [{"kx": rng.uniform(-1, 1, (8, 48)).astype(np.float32)}
+         for _ in range(6)]
+table = quant.calibrate(prog, feeds)
+
+infer = prog.clone(for_test=True)
+quant.decorate(infer, mode="full_int8", table=table)
+# compile-pipeline rewrite emits ONE fused_int8_matmul per fc (the
+# kernels/kernel:int8_matmul counter asserted below is the dispatch
+# receipt; the no-standalone-quantize-HLO module-text pin is tier-1)
+fused, = exe.run(infer, feed=feeds[0], fetch_list=[out])
+
+# same decorated program with kernels pinned off: the unfused
+# quantize -> int8 dot -> dequantize chain, its own compile-cache key
+os.environ["PTPU_KERNELS"] = "0"
+unfused, = exe.run(infer, feed=feeds[0], fetch_list=[out])
+os.environ["PTPU_KERNELS"] = "1"
+exe.close()
+
+assert np.array_equal(np.asarray(fused), np.asarray(unfused)), (
+    float(np.abs(np.asarray(fused) - np.asarray(unfused)).max()))
+print("kernels ci: fused int8 matmul bitwise == unfused chain")
+PYEOF
+  python tools/ptpu_stats.py "$dump" \
+    --assert-min kernels/dispatches=1 "kernels/kernel:int8_matmul=1" \
+                 quant/ops_rewritten=1 verify/programs_checked=1 \
+    --assert-max verify/violations=0
+  # per-kernel bench receipts: gauges present and positive (floor),
+  # kernel-vs-fallback parity inside the documented bound per leg
+  rm -f "$dump" "$legs"
+  JAX_PLATFORMS=cpu PTPU_METRICS=1 \
+    python bench.py --kernels-only --metrics-out "$dump" \
+    --legs-out "$legs"
+  python tools/ptpu_stats.py "$dump" \
+    --assert-min bench/kernel_paged_decode_speedup=0.0001 \
+                 bench/kernel_int8_matmul_speedup=0.0001 \
+                 bench/kernel_spec_window_speedup=0.0001
+  python - "$legs" <<'PYEOF'
+import json, sys
+legs = {e["leg"]: e for e in json.load(open(sys.argv[1]))}
+for need in ("kernel_paged_decode", "kernel_spec_window",
+             "kernel_int8_matmul"):
+    assert need in legs, (need, sorted(legs))
+    assert legs[need]["max_err"] < 1e-4, legs[need]
+assert legs["kernel_int8_matmul"]["max_err"] == 0.0, legs
+print("kernels stage ok:",
+      {k: round(v[k + "_speedup"], 4) for k, v in legs.items()})
+PYEOF
+}
+
 do_fleet() {
   # fault-tolerant serving-fleet receipt (docs/SERVING.md "Fleet &
   # failover"). Leg A — replica death: a 2-replica router serving a
@@ -1259,8 +1340,9 @@ case "$stage" in
   race) do_race ;;
   verify) do_verify ;;
   quant) do_quant ;;
+  kernels) do_kernels ;;
   zero) do_zero ;;
   fleet) do_fleet ;;
-  all) do_build; do_lint; do_test; do_api_check; do_bench_smoke; do_chaos; do_data_chaos; do_amp; do_serve; do_fleet; do_race; do_verify; do_quant; do_zero; do_bench ;;
+  all) do_build; do_lint; do_test; do_api_check; do_bench_smoke; do_chaos; do_data_chaos; do_amp; do_serve; do_fleet; do_race; do_verify; do_quant; do_kernels; do_zero; do_bench ;;
   *) echo "unknown stage: $stage" >&2; exit 2 ;;
 esac

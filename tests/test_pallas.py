@@ -1,5 +1,17 @@
 """Pallas kernel tests — interpret mode on the CPU mesh (SURVEY §7:
-attention fusion kernels; numeric parity vs the naive XLA reference)."""
+attention fusion kernels; numeric parity vs the naive XLA reference).
+
+ISSUE 17 grows this into the kernel-library test bed: the
+kernel_registry dispatch contract (PTPU_KERNELS modes, per-kernel
+disable, qualification warn-once + fallback telemetry), the paged
+flash-decode / spec verify-window kernels against their gathered lax
+references (block-table edge matrix: null block, partial last block,
+post-truncate tables), the fused int8 matmul's bitwise identity with
+the unfused quantize->dot->dequantize chain, the serving token-identity
+and kernels-off bitwise pins, and the module-text receipt that the
+fused emission drops the standalone quantize/dequantize HLOs."""
+
+import warnings
 
 import numpy as np
 import pytest
@@ -7,7 +19,10 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from paddle_tpu.ops.pallas_kernels import flash_attention
+from paddle_tpu.ops import kernel_registry as kreg
+from paddle_tpu.ops.pallas_kernels import (
+    flash_attention, int8_matmul, int8_matmul_reference, paged_attention,
+    paged_attention_reference)
 
 
 def _naive(q, k, v, causal):
@@ -53,3 +68,412 @@ def test_flash_attention_grads_match_naive():
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-4, rtol=1e-4)
+
+# ---------------------------------------------------------------------------
+# kernel registry: dispatch modes, cache key, qualification telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_registry_modes_and_cache_key(monkeypatch):
+    monkeypatch.delenv("PTPU_KERNELS", raising=False)
+    monkeypatch.delenv("PTPU_KERNELS_DISABLE", raising=False)
+    assert kreg.kernels_mode() == "auto"
+    assert kreg.cache_key() == "auto"
+    monkeypatch.setenv("PTPU_KERNELS", "1")
+    assert kreg.kernels_mode() == "force"
+    assert kreg.enabled_for("paged_decode")
+    assert kreg.enabled_for("int8_matmul")
+    monkeypatch.setenv("PTPU_KERNELS", "0")
+    assert kreg.kernels_mode() == "off"
+    assert not kreg.enabled_for("flash_attention")
+    # per-kernel pin beats force mode; sorted names ride the cache key
+    monkeypatch.setenv("PTPU_KERNELS", "1")
+    monkeypatch.setenv("PTPU_KERNELS_DISABLE", "spec_window,int8_matmul")
+    assert not kreg.enabled_for("int8_matmul")
+    assert not kreg.enabled_for("spec_window")
+    assert kreg.enabled_for("paged_decode")
+    assert kreg.cache_key() == "force:-int8_matmul,spec_window"
+    # the repo boolean spelling contract: bad values raise by name
+    monkeypatch.setenv("PTPU_KERNELS", "maybe")
+    with pytest.raises(ValueError):
+        kreg.kernels_mode()
+
+
+def test_registry_auto_policy_is_platform_scoped(monkeypatch):
+    """Unset (auto) keeps each kernel's historical policy: flash runs
+    everywhere, the serving/quant kernels are TPU-only — so the CPU
+    mesh's default numerics are bitwise the pre-kernel paths."""
+    monkeypatch.delenv("PTPU_KERNELS", raising=False)
+    monkeypatch.delenv("PTPU_KERNELS_DISABLE", raising=False)
+    assert kreg.enabled_for("flash_attention")
+    on_tpu = jax.default_backend() == "tpu"
+    for name in ("paged_decode", "spec_window", "int8_matmul"):
+        assert kreg.enabled_for(name) == on_tpu
+
+
+def test_flash_qualification_fixes_cross_attention_gate():
+    """The compat_ops.py:552 latent gate, promoted and fixed: the old
+    `q.shape == k.shape` check dropped the tuned path for EVERY
+    cross-attention call; the registry predicate admits non-causal
+    Tq != Tk (the portable kernel masks by kv length) and names each
+    disqualification."""
+    spec = kreg.get_kernel("flash_attention")
+    assert spec.qualify(T=256, Tk=256, head_dim=64, causal=True)[0]
+    # the fix: non-causal cross-attention now qualifies
+    assert spec.qualify(T=256, Tk=128, head_dim=64, causal=False)[0]
+    ok, reason = spec.qualify(T=256, Tk=128, head_dim=64, causal=True)
+    assert not ok and "cross-attention" in reason
+    ok, reason = spec.qualify(T=100, Tk=100, head_dim=64, causal=True)
+    assert not ok and "128" in reason
+    ok, reason = spec.qualify(T=256, Tk=256, head_dim=32, causal=True)
+    assert not ok and "head_dim" in reason
+
+
+def test_disqualified_shape_counts_fallback_and_warns_once(monkeypatch):
+    from paddle_tpu.observability import metrics
+
+    monkeypatch.delenv("PTPU_KERNELS", raising=False)
+    monkeypatch.delenv("PTPU_KERNELS_DISABLE", raising=False)
+    was = metrics.enabled()
+    metrics.enable()
+    reg = metrics.registry()
+    fb0 = reg.counter("kernels/fallbacks").value
+    d0 = reg.counter("kernels/dispatches").value
+    kreg._WARNED.discard(("flash_attention",
+                          "seq len not a multiple of 128"))
+    try:
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            assert not kreg.choose("flash_attention", T=100, Tk=100,
+                                   head_dim=64, causal=True)
+            assert not kreg.choose("flash_attention", T=100, Tk=100,
+                                   head_dim=64, causal=True)
+        msgs = [w for w in rec
+                if "flash_attention" in str(w.message)]
+        assert len(msgs) == 1  # DeferredWarns discipline: once per cause
+        assert "lax fallback" in str(msgs[0].message)
+        assert reg.counter("kernels/fallbacks").value - fb0 == 2
+        # a qualifying shape counts a dispatch + the per-kernel counter
+        k0 = reg.counter("kernels/kernel:flash_attention").value
+        assert kreg.choose("flash_attention", T=256, Tk=256, head_dim=64,
+                           causal=True)
+        assert reg.counter("kernels/dispatches").value - d0 == 1
+        assert reg.counter(
+            "kernels/kernel:flash_attention").value - k0 == 1
+        # mode off counts a fallback too, silently
+        monkeypatch.setenv("PTPU_KERNELS", "0")
+        fb1 = reg.counter("kernels/fallbacks").value
+        assert not kreg.choose("flash_attention", T=256, Tk=256,
+                               head_dim=64, causal=True)
+        assert reg.counter("kernels/fallbacks").value - fb1 == 1
+    finally:
+        if not was:
+            metrics.disable()
+
+
+# ---------------------------------------------------------------------------
+# paged attention: decode (C=1) and the spec verify window (C=k+1)
+# ---------------------------------------------------------------------------
+
+
+def _paged_setup(seed=0, NB=8, bs=4, H=2, Dh=16, B=2, Mb=4):
+    rng = np.random.RandomState(seed)
+    k_pages = jnp.asarray(rng.randn(NB + 1, bs, H, Dh).astype(np.float32))
+    v_pages = jnp.asarray(rng.randn(NB + 1, bs, H, Dh).astype(np.float32))
+    return rng, k_pages, v_pages
+
+
+@pytest.mark.parametrize("table,positions", [
+    # full tables, scattered non-monotone physical pages
+    ([[5, 2, 7, 3], [1, 4, 6, 8]], [[15], [9]]),
+    # partially-filled last block (position mid-page)
+    ([[5, 2, 7, 0], [3, 0, 0, 0]], [[9], [2]]),
+    # unallocated tail slots hold the null block (id 0) — the kernel
+    # gathers page 0 there and the position mask hides every slot
+    ([[6, 0, 0, 0], [2, 8, 0, 0]], [[1], [4]]),
+])
+def test_paged_decode_matches_gathered_reference(table, positions):
+    rng, k_pages, v_pages = _paged_setup()
+    q = jnp.asarray(rng.randn(2, 1, 2, 16).astype(np.float32))
+    tables = jnp.asarray(np.array(table, np.int32))
+    pos = jnp.asarray(np.array(positions, np.int32))
+    got = paged_attention(k_pages, v_pages, q, tables, pos)
+    want = paged_attention_reference(k_pages, v_pages, q, tables, pos)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_spec_window_matches_gathered_reference():
+    """The verify-window shape: k+1 query positions per row, each
+    masked to its OWN causal prefix — exactly the serving chunk
+    attention's `t <= pos2d[b, c]` contract."""
+    rng, k_pages, v_pages = _paged_setup(seed=3)
+    C = 3
+    q = jnp.asarray(rng.randn(2, C, 2, 16).astype(np.float32))
+    tables = jnp.asarray(np.array([[5, 2, 7, 3], [4, 1, 0, 0]], np.int32))
+    pos = jnp.asarray(np.array([[7, 8, 9], [0, 1, 2]], np.int32))
+    got = paged_attention(k_pages, v_pages, q, tables, pos)
+    want = paged_attention_reference(k_pages, v_pages, q, tables, pos)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_paged_decode_post_truncate_tables():
+    """Block tables after the speculative KV rollback
+    (KVBlockPool.truncate_owner): dropped tail blocks leave the table,
+    the padded tail reverts to the null block, and attention over the
+    kept prefix matches the reference."""
+    from paddle_tpu.serving.kv_cache import KVBlockPool
+
+    pool = KVBlockPool(n_layers=1, n_heads=2, head_dim=16, block_size=4,
+                       num_blocks=8)
+    assert pool.reserve("s", 3)
+    for _ in range(3):
+        pool.alloc_block("s")
+    dropped = pool.truncate_owner("s", 1)
+    table_ids = pool.block_table("s")
+    assert len(table_ids) == 1 and len(dropped) == 2
+    Mb = 4
+    padded = np.full((1, Mb), KVBlockPool.NULL_BLOCK, np.int32)
+    padded[0, :len(table_ids)] = table_ids
+    rng, k_pages, v_pages = _paged_setup(seed=5)
+    q = jnp.asarray(rng.randn(1, 1, 2, 16).astype(np.float32))
+    pos = jnp.asarray(np.array([[3]], np.int32))  # last kept position
+    tables = jnp.asarray(padded)
+    got = paged_attention(k_pages, v_pages, q, tables, pos)
+    want = paged_attention_reference(k_pages, v_pages, q, tables, pos)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# fused int8 matmul
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("M,K,N", [(5, 96, 70), (32, 128, 128), (1, 7, 3)])
+def test_int8_matmul_bitwise_vs_unfused_chain(M, K, N):
+    """int32 accumulation is exact over any K split and the in-kernel
+    quantize is the quantize op's formula verbatim, so fused == unfused
+    BITWISE (docs/KERNELS.md numerics policy — stronger than the
+    documented int8-vs-fp32 tolerance)."""
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(M, K).astype(np.float32))
+    w = jnp.asarray(rng.randint(-128, 128, size=(K, N)).astype(np.int8))
+    dq = jnp.asarray((rng.rand(N).astype(np.float32) + 0.1) / 127.0)
+    act_scale = float(127.0 / 3.0)
+    fused = int8_matmul(x, w, dq, act_scale)
+    ref = int8_matmul_reference(x, w, dq, act_scale)
+    assert fused.dtype == jnp.float32
+    assert bool(jnp.all(fused == ref))
+
+
+# ---------------------------------------------------------------------------
+# serving wiring: token identity with kernels forced on, bitwise
+# identity with kernels off
+# ---------------------------------------------------------------------------
+
+
+def _spec_cfg():
+    from paddle_tpu.serving import GenerationConfig
+
+    return GenerationConfig(vocab_size=64, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_seq_len=64)
+
+
+def test_serving_decode_kernel_on_token_identical(monkeypatch):
+    """The acceptance pin: the paged flash-decode serving leg
+    (PTPU_KERNELS=1, interpret mode on CPU) is token-identical to the
+    unbatched unpaged numpy reference decoder."""
+    from paddle_tpu import serving
+    from paddle_tpu.serving import GenerationModel, reference_decode
+
+    monkeypatch.setenv("PTPU_KERNELS", "1")
+    model = GenerationModel.random(_spec_cfg(), seed=11, name="pk")
+    prompts = [[3, 7, 11, 2], [1, 2, 3], [40, 9, 22, 5, 8]]
+    with serving.ServingEngine(model, max_batch=2, max_seq_len=64,
+                               block_size=4) as eng:
+        reqs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+        got = [eng.result(r, timeout=120) for r in reqs]
+    assert got == [reference_decode(model, p, 8) for p in prompts]
+
+
+def test_spec_step_kernel_on_token_identical(monkeypatch):
+    """The verify-window kernel under the spec step returns the same
+    greedy token at EVERY window slot as the lax chunk attention."""
+    from paddle_tpu.serving import GenerationModel
+
+    model = GenerationModel.random(_spec_cfg(), seed=13, name="pw")
+    bs, mb, W = 4, 4, 3
+    nb = 8
+    cfg = model.config
+    kv_shape = (cfg.n_layers, nb + 1, bs, cfg.n_heads, cfg.head_dim)
+
+    def drive(env):
+        if env is None:
+            monkeypatch.delenv("PTPU_KERNELS", raising=False)
+        else:
+            monkeypatch.setenv("PTPU_KERNELS", env)
+        step = model.make_spec_step(1, mb, W, return_logits=True)
+        kv_k = jnp.zeros(kv_shape, jnp.float32)
+        kv_v = jnp.zeros(kv_shape, jnp.float32)
+        table = np.array([[5, 2, 7, 3]], np.int32)
+        outs = []
+        # window 1: prefill 3 prompt tokens; window 2: verify window
+        feeds = [(np.array([[9, 33, 2]], np.int32), True, 0),
+                 (np.array([[41, 17, 8]], np.int32), False, 3)]
+        prev = jnp.zeros((1,), jnp.int32)
+        for toks, use_prompt, pos in feeds:
+            kv_k, kv_v, nxt, logits = step(
+                model.weights, kv_k, kv_v, toks,
+                np.array([use_prompt]), prev,
+                np.array([pos], np.int32),
+                np.array([3], np.int32), table, np.array([True]))
+            prev = nxt[:, -1]
+            outs.append((np.asarray(nxt).copy(),
+                         np.asarray(logits).copy()))
+        return outs
+
+    ref = drive(None)      # lax chunk attention (CPU auto)
+    onk = drive("1")       # spec_window kernel, interpret mode
+    for (nt_ref, lg_ref), (nt_on, lg_on) in zip(ref, onk):
+        assert (nt_ref == nt_on).all()
+        np.testing.assert_allclose(lg_on, lg_ref, atol=2e-4, rtol=2e-4)
+
+
+def test_serving_decode_kernels_off_bitwise_identical(monkeypatch):
+    """PTPU_KERNELS=0 must reproduce the default CPU decode BITWISE
+    (the AMP-off/quant-off identity pattern): on the CPU mesh the
+    default (auto) policy already takes the lax paths, so forcing
+    fallbacks changes nothing — logits included."""
+    from paddle_tpu.serving import GenerationModel
+
+    model = GenerationModel.random(_spec_cfg(), seed=17, name="pz")
+    bs, mb = 4, 4
+    nb = 8
+    cfg = model.config
+    kv_shape = (cfg.n_layers, nb + 1, bs, cfg.n_heads, cfg.head_dim)
+    table = np.array([[5, 2, 7, 3]], np.int32)
+    tokens = [9, 33, 2, 41, 17]
+
+    def drive(env):
+        if env is None:
+            monkeypatch.delenv("PTPU_KERNELS", raising=False)
+        else:
+            monkeypatch.setenv("PTPU_KERNELS", env)
+        step = model.make_decode_step(1, mb, return_logits=True)
+        kv_k = jnp.zeros(kv_shape, jnp.float32)
+        kv_v = jnp.zeros(kv_shape, jnp.float32)
+        prev = jnp.zeros((1,), jnp.int32)
+        logits = []
+        for pos, tok in enumerate(tokens):
+            kv_k, kv_v, prev, lg = step(
+                model.weights, kv_k, kv_v,
+                np.array([tok], np.int32), np.array([True]), prev,
+                np.array([pos], np.int32), table, np.array([True]))
+            logits.append(np.asarray(lg).copy())
+        return logits
+
+    ref = drive(None)
+    off = drive("0")
+    for a, b in zip(ref, off):
+        assert (a == b).all()
+
+
+def test_step_cache_keys_split_by_kernel_mode(monkeypatch):
+    """A decode step traced under one PTPU_KERNELS mode must never
+    serve another: the mode rides the step-cache key (empty suffix in
+    the default state, so pre-kernel keys are unchanged)."""
+    from paddle_tpu.serving import GenerationModel
+
+    model = GenerationModel.random(_spec_cfg(), seed=19, name="ck")
+    monkeypatch.delenv("PTPU_KERNELS", raising=False)
+    model.make_decode_step(1, 4)
+    assert (1, 4, False) in model._steps
+    monkeypatch.setenv("PTPU_KERNELS", "1")
+    model.make_decode_step(1, 4)
+    assert (1, 4, False, "kernels:force") in model._steps
+    assert len(model._steps) == 2
+
+
+# ---------------------------------------------------------------------------
+# fused int8 emission: module-text receipt (the PR-3 DCE-vanishes
+# pattern) + bitwise program numerics
+# ---------------------------------------------------------------------------
+
+
+def _reset_build_state():
+    import paddle_tpu as fluid
+    from paddle_tpu import initializer, layer_helper, unique_name
+    from paddle_tpu.core import scope as scope_mod
+
+    fluid.framework.switch_main_program(fluid.Program())
+    fluid.framework.switch_startup_program(fluid.Program())
+    unique_name.switch()
+    initializer._global_seed_counter[0] = 0
+    layer_helper._op_seed_counter[0] = 0
+    scope_mod._scope_stack[:] = [scope_mod.Scope()]
+    return scope_mod.global_scope()
+
+
+def _quantized_exe(monkeypatch, env):
+    import paddle_tpu as fluid
+    from paddle_tpu import layers, quant
+
+    if env is None:
+        monkeypatch.delenv("PTPU_KERNELS", raising=False)
+    else:
+        monkeypatch.setenv("PTPU_KERNELS", env)
+    prog, sprog = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, sprog):
+        x = layers.data(name="pk_x", shape=[48], dtype="float32")
+        h = layers.fc(x, size=56, act="relu")
+        out = layers.fc(h, size=24)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(sprog)
+    rng = np.random.RandomState(0)
+    feeds = [{"pk_x": rng.uniform(-1, 1, (4, 48)).astype(np.float32)}
+             for _ in range(3)]
+    table = quant.calibrate(prog, feeds)
+    infer = prog.clone(for_test=True)
+    quant.decorate(infer, mode="full_int8", table=table)
+    got, = exe.run(infer, feed=feeds[0], fetch_list=[out])
+    (step,) = [s for s in exe._cache.values() if s.fetch_names]
+    return exe, step, feeds[0], np.asarray(got)
+
+
+def test_full_int8_fused_matmul_module_text(monkeypatch):
+    """The acceptance receipt: with the fused kernel on, the lowered
+    module has NO standalone quantize HLO around the rewritten dense
+    layers — pinned by the full-activation int8 tensor shapes
+    ('4x48xi8' / '4x56xi8', distinct from the kernel's 32x128 blocks)
+    vanishing from the StableHLO text, while the numerics stay bitwise
+    the unfused chain's."""
+    texts, outs = {}, {}
+    for env in (None, "1"):
+        scope = _reset_build_state()
+        exe, step, feed, got = _quantized_exe(monkeypatch, env)
+        mut = {n: scope.get(n) for n in step.mut_names}
+        const = {n: scope.get(n) for n in step.const_names}
+        texts[env] = step._jitted.lower(
+            mut, const, feed, np.uint32(0)).as_text()
+        outs[env] = got
+        exe.close()
+    # unfused: the quantize op materializes each full int8 activation
+    assert "4x48xi8" in texts[None] and "4x56xi8" in texts[None]
+    # fused: only the kernel's block-shaped int8 tiles remain
+    assert "4x48xi8" not in texts["1"] and "4x56xi8" not in texts["1"]
+    # and the answer is bit-for-bit the same
+    assert (outs[None] == outs["1"]).all()
+
+
+def test_fused_emission_respects_per_kernel_disable(monkeypatch):
+    """PTPU_KERNELS_DISABLE=int8_matmul pins the historical 3-op
+    emission even under force mode."""
+    from paddle_tpu import quant
+
+    monkeypatch.setenv("PTPU_KERNELS", "1")
+    monkeypatch.setenv("PTPU_KERNELS_DISABLE", "int8_matmul")
+    assert not quant._kernel_enabled("int8_matmul")
+    monkeypatch.delenv("PTPU_KERNELS_DISABLE", raising=False)
+    assert quant._kernel_enabled("int8_matmul")

@@ -36,6 +36,8 @@ NAMESPACES = [
     ("paddle_tpu.flags", None),
     ("paddle_tpu.parallel", None),
     ("paddle_tpu.serving", None),
+    ("paddle_tpu.ops.kernel_registry", None),
+    ("paddle_tpu.ops.pallas_kernels", None),
     ("paddle_tpu.profiler", None),
     ("paddle_tpu.unique_name", None),
     ("paddle_tpu.reader", None),
