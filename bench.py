@@ -600,8 +600,19 @@ def bench_serving_spec(n_requests=6, max_new_tokens=48, spec_k=6,
     stay token-identical to ``reference_decode`` (the functional gate)
     with a positive accept rate.
 
-    Returns a dict with per-leg tokens_per_sec/tokens_per_step/steps,
-    the spec accept rate, the tokens-per-step speedup and identity."""
+    The compounded legs (ISSUE 18) ride the same prompt set:
+    ``tree`` serves a width x ``spec_k`` token TREE verified in one
+    compiled step, drafted by the jitted on-device ``ModelDrafter``;
+    ``int8`` compounds the tree leg onto int8 weight stores for BOTH
+    drafter and target (gated token-identical to the dequantized
+    reference). Every leg's ``tokens_per_step`` counts compiled TARGET
+    steps only — draft-side dispatches are accounted separately as
+    ``draft_steps`` (and tree commit dispatches increment neither), so
+    the ratio stays the weights-streamed-once-per-window receipt.
+
+    Returns a dict with per-leg tokens_per_sec/tokens_per_step/steps/
+    draft_steps/accept_rate, the tokens-per-step speedups (spec vs
+    legacy, tree vs the linear-k leg) and identity."""
     from paddle_tpu import serving
 
     cfg = serving.GenerationConfig(
@@ -614,11 +625,12 @@ def bench_serving_spec(n_requests=6, max_new_tokens=48, spec_k=6,
     refs = [serving.reference_decode(model, p, max_new_tokens)
             for p in prompts]
 
-    def run_leg(k):
-        eng = serving.ServingEngine(model, max_batch=max_batch,
+    def run_leg(k, tree=None, mdl=model, rf=refs, drafter=None):
+        eng = serving.ServingEngine(mdl, max_batch=max_batch,
                                     max_seq_len=max_seq_len,
                                     block_size=block_size,
-                                    prefill_chunk=chunk, spec_k=k)
+                                    prefill_chunk=chunk, spec_k=k,
+                                    spec_tree=tree, drafter=drafter)
         # priming request: pays the one-time XLA compile for every
         # step shape this leg dispatches
         eng.generate(prompts[0][:3], max_new_tokens=2, timeout=600)
@@ -632,23 +644,40 @@ def bench_serving_spec(n_requests=6, max_new_tokens=48, spec_k=6,
         gen = st["generated_tokens"] - base["generated_tokens"]
         steps = st["steps"] - base["steps"]
         return {
-            "outputs_match": outs == refs,
+            "outputs_match": outs == rf,
             "tokens_per_sec": sum(len(o) for o in outs) / wall,
             "tokens_per_step": gen / max(1, steps),
             "steps": steps,
+            "draft_steps": (st["spec_draft_steps"]
+                            - base["spec_draft_steps"]),
             "accept_rate": st["spec_accept_rate"],
         }
 
     legacy = run_leg(0)
     spec = run_leg(spec_k)
+    tree_shape = "2x%d" % spec_k
+    tree = run_leg(0, tree=tree_shape,
+                   drafter=serving.ModelDrafter(model))
+    qmodel = model.quantized()
+    qrefs = [serving.reference_decode(qmodel, p, max_new_tokens)
+             for p in prompts]
+    int8 = run_leg(0, tree=tree_shape, mdl=qmodel, rf=qrefs,
+                   drafter=serving.ModelDrafter(qmodel))
     return {
         "legacy": legacy,
         "spec": spec,
+        "tree": tree,
+        "int8": int8,
+        "tree_shape": tree_shape,
         "tokens_per_step_speedup": (spec["tokens_per_step"]
                                     / legacy["tokens_per_step"]),
+        "tree_speedup_vs_linear": (tree["tokens_per_step"]
+                                   / spec["tokens_per_step"]),
         "accept_rate": spec["accept_rate"],
         "outputs_match": (legacy["outputs_match"]
-                          and spec["outputs_match"]),
+                          and spec["outputs_match"]
+                          and tree["outputs_match"]
+                          and int8["outputs_match"]),
     }
 
 
@@ -1434,6 +1463,23 @@ def main(argv=None):
                  spec_res["legacy"]["tokens_per_step"], 4),
              spec_tokens_per_step_speedup=round(
                  spec_res["tokens_per_step_speedup"], 4))
+        _leg("serving_spec_tree", spec_res["tree"]["tokens_per_sec"],
+             0.0,
+             tokens_per_step=round(
+                 spec_res["tree"]["tokens_per_step"], 4),
+             draft_steps=spec_res["tree"]["draft_steps"],
+             accept_rate=round(spec_res["tree"]["accept_rate"], 4),
+             tree_shape=spec_res["tree_shape"],
+             tree_speedup_vs_linear=round(
+                 spec_res["tree_speedup_vs_linear"], 4),
+             outputs_match=bool(spec_res["tree"]["outputs_match"]))
+        _leg("serving_spec_int8", spec_res["int8"]["tokens_per_sec"],
+             0.0,
+             tokens_per_step=round(
+                 spec_res["int8"]["tokens_per_step"], 4),
+             draft_steps=spec_res["int8"]["draft_steps"],
+             accept_rate=round(spec_res["int8"]["accept_rate"], 4),
+             outputs_match=bool(spec_res["int8"]["outputs_match"]))
 
     # int8 quantization receipt (docs/QUANTIZATION.md): fp32-vs-int8
     # predictor numerics + throughput + weight-store shrink, and the
@@ -1612,6 +1658,14 @@ def main(argv=None):
                 spec_res["spec"]["tokens_per_sec"])
             reg.gauge("bench/serving_spec_baseline_tokens_per_sec").set(
                 spec_res["legacy"]["tokens_per_sec"])
+            reg.gauge("bench/serving_spec_tree_tokens_per_step").set(
+                spec_res["tree"]["tokens_per_step"])
+            reg.gauge("bench/serving_spec_tree_speedup").set(
+                spec_res["tree_speedup_vs_linear"])
+            reg.gauge("bench/serving_spec_tree_accept_rate").set(
+                spec_res["tree"]["accept_rate"])
+            reg.gauge("bench/serving_spec_int8_outputs_match").set(
+                1.0 if spec_res["int8"]["outputs_match"] else 0.0)
         reg.dump_json(args.metrics_out)
     if args.legs_out:
         # machine-readable per-leg trajectory (ISSUE 5): BENCH_r*.json
@@ -1697,6 +1751,14 @@ def main(argv=None):
             spec_res["accept_rate"], 4)
         result["serving_spec_outputs_match"] = bool(
             spec_res["outputs_match"])
+        result["serving_spec_tree_tokens_per_step"] = round(
+            spec_res["tree"]["tokens_per_step"], 4)
+        result["serving_spec_tree_speedup"] = round(
+            spec_res["tree_speedup_vs_linear"], 4)
+        result["serving_spec_tree_draft_steps"] = int(
+            spec_res["tree"]["draft_steps"])
+        result["serving_spec_int8_outputs_match"] = bool(
+            spec_res["int8"]["outputs_match"])
     print(json.dumps(result))
 
 

@@ -264,6 +264,19 @@ _declare("PTPU_SERVE_SPEC_K", "int", 0,
          "speculative decoding: draft tokens proposed per serving "
          "decode step and verified in one batched target step "
          "(0 = legacy one-token decode)")
+_declare("PTPU_SERVE_SPEC_TREE", "str", None,
+         "tree speculation shape 'WxD' (width x depth, e.g. '2x3'): "
+         "verify a W-branch token tree of depth D per compiled step "
+         "via the in-window tree attention mask; unset/0/off = the "
+         "linear PTPU_SERVE_SPEC_K window, bitwise PR-12 behavior")
+_declare("PTPU_SERVE_DRAFT_MODEL", "path", None,
+         "generation-artifact directory holding the draft model for "
+         "speculative decoding: loads a jitted on-device ModelDrafter "
+         "per engine model (unset = n-gram prompt-lookup drafting)")
+_declare("PTPU_SERVE_DRAFT_CHUNK", "int", 16,
+         "prompt tokens per draft-side catch-up prefill chunk when the "
+         "jitted ModelDrafter brings a row's draft KV level with its "
+         "committed history")
 _declare("PTPU_SERVE_REPLICAS", "int", 1,
          "ServingRouter engine-replica count (least-loaded dispatch "
          "with health-checked failover across them)")

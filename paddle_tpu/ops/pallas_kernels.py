@@ -48,7 +48,8 @@ except Exception:  # pragma: no cover
 
 __all__ = ["flash_attention", "flash_attention_portable",
            "attention_reference", "paged_attention",
-           "paged_attention_reference", "int8_matmul",
+           "paged_attention_reference", "paged_attention_tree",
+           "paged_attention_tree_reference", "int8_matmul",
            "int8_matmul_reference"]
 
 _NEG_INF = -1e30
@@ -400,6 +401,174 @@ def paged_attention_reference(k_pages, v_pages, q, block_tables,
 
 
 # ---------------------------------------------------------------------------
+# tree-mask spec window: the paged verify window generalized to a token
+# TREE — visibility inside the window follows the ancestor matrix, not
+# the linear causal diagonal (committed prefix stays fully visible)
+# ---------------------------------------------------------------------------
+
+
+def _paged_attn_tree_kernel(tables_ref, lastpos_ref, q_ref, k_ref, v_ref,
+                            pos_ref, anc_ref, o_ref, m_scr, l_scr,
+                            acc_scr, *, sm_scale, block_size):
+    """Grid (B, H, Mb), j innermost — the linear spec-window kernel with
+    the in-window causal diagonal swapped for the ancestor mask. Window
+    slot c sits at CACHE position pos0+c; a key at logical position t is
+    visible to slot c iff t < pos0 (committed prefix, strict — slot 0's
+    own write is window-visible via anc[0, 0], never prefix-visible) or
+    t-pos0 is an ancestor of c in the tree. The ancestor lookup runs as
+    a one-hot matmul against the [C, C] float ancestor matrix — no
+    in-kernel gathers."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+    C = q_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(j * block_size <= lastpos_ref[b])
+    def _body():
+        q = q_ref[0, :, 0, :].astype(jnp.float32)       # [C, Dh]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)       # [bs, Dh]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [C, bs]
+
+        # logical positions covered by table slot j, relative to the
+        # window base (pos_ref holds the CACHE position of each window
+        # slot: pos0 + c)
+        t_pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (C, block_size), 1)
+        pos0 = pos_ref[0, 0]                            # pos: [C, 1]
+        rel = t_pos - pos0                              # row-constant
+        # anc[c, rel] via one-hot matmul: onehot[r, t] = (rel_t == r)
+        onehot = (jax.lax.broadcasted_iota(jnp.int32, (C, block_size), 0)
+                  == rel).astype(jnp.float32)
+        win_vis = jax.lax.dot_general(
+            anc_ref[:], onehot, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) > 0.0   # [C, bs]
+        mask = (rel < 0) | win_vis
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_scr[:, :1] * alpha + p.sum(axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        o_ref[0, :, 0, :] = (acc_scr[:]
+                             / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def paged_attention_tree(k_pages, v_pages, q, block_tables, positions,
+                         anc, sm_scale=None):
+    """Tree-mask verify window over the paged KV cache, one kernel.
+
+    Same contract as :func:`paged_attention` except the window is a
+    speculation TREE: positions: ``[B, C]`` int32, the CACHE position of
+    each window slot (``positions[b, c] = pos0_b + c`` — level-order slot
+    c writes cache position pos0+c regardless of its tree depth). anc:
+    ``[C, C]`` — ``anc[c, t]`` truthy iff window slot t is c or an
+    ancestor of c (passed as float so the kernel can resolve it as a
+    one-hot matmul). A key at logical position t is visible to slot c
+    iff ``t < pos0`` (committed prefix, STRICT) or ``anc[c, t-pos0]``.
+
+    With the linear-chain ancestor matrix (lower-triangular ones) this
+    is numerically identical to the linear spec window. Returns the
+    ``[B, C, H, Dh]`` fp32 context; online-softmax numerics, token-
+    identical (not bitwise) to the gathered reference."""
+    if pltpu is None:  # pragma: no cover - guarded by registry qualify
+        raise RuntimeError("paged_attention_tree needs pallas TPU "
+                           "support (scalar-prefetch grid specs)")
+    B, C, H, Dh = q.shape
+    bs = k_pages.shape[1]
+    Mb = block_tables.shape[1]
+    if sm_scale is None:
+        sm_scale = Dh ** -0.5
+    interpret = jax.default_backend() != "tpu"
+
+    tables = block_tables.astype(jnp.int32)
+    pos = jnp.maximum(positions, 0).astype(jnp.int32)    # [B, C]
+    last_pos = pos[:, C - 1]                             # [B] = pos0+C-1
+    pos3 = pos[:, :, None]                               # [B, C, 1]
+    anc_f = jnp.asarray(anc, jnp.float32)
+
+    grid = (B, H, Mb)
+    kernel = functools.partial(_paged_attn_tree_kernel, sm_scale=sm_scale,
+                               block_size=bs)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, C, 1, Dh),
+                         lambda b, h, j, tables, lp: (b, 0, h, 0)),
+            pl.BlockSpec((1, bs, 1, Dh),
+                         lambda b, h, j, tables, lp: (tables[b, j],
+                                                      0, h, 0)),
+            pl.BlockSpec((1, bs, 1, Dh),
+                         lambda b, h, j, tables, lp: (tables[b, j],
+                                                      0, h, 0)),
+            pl.BlockSpec((1, C, 1),
+                         lambda b, h, j, tables, lp: (b, 0, 0)),
+            pl.BlockSpec((C, C),
+                         lambda b, h, j, tables, lp: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, C, 1, Dh),
+                               lambda b, h, j, tables, lp: (b, 0, h, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((C, 128), jnp.float32),
+            pltpu.VMEM((C, 128), jnp.float32),
+            pltpu.VMEM((C, Dh), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, C, H, Dh), jnp.float32),
+        interpret=interpret,
+    )(tables, last_pos, q, k_pages, v_pages, pos3, anc_f)
+
+
+def paged_attention_tree_reference(k_pages, v_pages, q, block_tables,
+                                   positions, anc, sm_scale=None):
+    """The unfused lax fallback: contiguous gather through the block
+    table, tree-masked softmax — element-for-element the serving model's
+    XLA tree-window attention branch."""
+    B, C, H, Dh = q.shape
+    bs = k_pages.shape[1]
+    max_ctx = block_tables.shape[1] * bs
+    if sm_scale is None:
+        sm_scale = Dh ** -0.5
+    k_ctx = k_pages[block_tables].reshape(B, max_ctx, H, Dh)
+    v_ctx = v_pages[block_tables].reshape(B, max_ctx, H, Dh)
+    scores = jnp.einsum("bchd,bthd->bcht", q, k_ctx) * sm_scale
+    anc_b = jnp.asarray(anc) > 0
+    pos0 = positions[:, 0]                               # [B]
+    t_ids = jnp.arange(max_ctx)[None, None, :]           # [1, 1, T]
+    rel = t_ids - pos0[:, None, None]                    # [B, 1, T]
+    in_win = (rel >= 0) & (rel < C)
+    rel_c = jnp.clip(rel, 0, C - 1)
+    anc_t = anc_b[jnp.arange(C)[None, :, None], rel_c]   # [B, C, T]
+    valid = (rel < 0) | (in_win & anc_t)
+    scores = jnp.where(valid[:, :, None, :], scores, -jnp.inf)
+    w = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
+    w = w / jnp.sum(w, axis=-1, keepdims=True)
+    return jnp.einsum("bcht,bthd->bchd", w, v_ctx)
+
+
+# ---------------------------------------------------------------------------
 # fused int8 matmul: in-kernel activation quantize, int8×int8→int32 MXU
 # dot, per-output-channel dequantize on the last K block
 # ---------------------------------------------------------------------------
@@ -539,6 +708,13 @@ def _register_all():
         qualify=_paged_qualify, default_on=_on_tpu,
         doc="speculative verify-window (k+1 query positions) over the "
             "paged cache in one kernel; default: TPU only")
+    register_kernel(
+        "spec_window_tree", paged_attention_tree,
+        paged_attention_tree_reference,
+        qualify=_paged_qualify, default_on=_on_tpu,
+        doc="tree-mask verify window (width x depth token tree, one "
+            "kernel) over the paged cache — in-window visibility by "
+            "ancestor matrix via one-hot matmul; default: TPU only")
     register_kernel(
         "int8_matmul", int8_matmul, int8_matmul_reference,
         qualify=_int8_qualify, default_on=_on_tpu,

@@ -66,7 +66,7 @@ from .observability import metrics as _metrics
 __all__ = [
     "CalibrationTable", "QuantConfig", "calibrate", "decorate",
     "active_config", "quant_env_enabled", "weight_channel_scales",
-    "quantize_to_int8", "quantize_symmetric",
+    "quantize_to_int8", "quantize_symmetric", "weight_store_bytes",
     "quantize_predictor_program", "DEFAULT_QUANT_OPS",
 ]
 
@@ -186,6 +186,28 @@ def record_weight_store(n_weights, saved_bytes, fp32_bytes):
     _metrics.counter("quant/weights_quantized").inc(n_weights)
     _metrics.counter("quant/weight_bytes_saved").inc(saved_bytes)
     _metrics.counter("quant/weight_fp32_bytes").inc(fp32_bytes)
+
+
+def weight_store_bytes(weights):
+    """Byte accounting for a (possibly int8) weight dict: ``n_int8``
+    int8-stored entries, ``int8_bytes`` they occupy (int8 payload plus
+    their fp32 ``@qscale`` companions) and ``fp32_bytes`` the same
+    entries would occupy dequantized — the serving-stats receipt that a
+    model really is running off the int8 store. Shapes/dtypes only; no
+    device transfer."""
+    n_int8 = 0
+    int8_bytes = 0
+    fp32_bytes = 0
+    for key, v in weights.items():
+        size = int(getattr(v, "size", np.asarray(v).size))
+        if str(getattr(v, "dtype", "")) == "int8":
+            n_int8 += 1
+            int8_bytes += size
+            fp32_bytes += size * 4
+        elif key.endswith("@qscale"):
+            int8_bytes += size * 4
+    return {"n_int8": n_int8, "int8_bytes": int8_bytes,
+            "fp32_bytes": fp32_bytes}
 
 
 def quantize_to_int8(w, scale_broadcast, qmax=_QMAX):

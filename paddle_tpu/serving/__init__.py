@@ -29,19 +29,21 @@ from .loadgen import PoissonLoadGenerator  # noqa: F401
 from .model import (GenerationConfig, GenerationModel,  # noqa: F401
                     ModelDrafter, NGramDrafter,
                     extract_decoder_weights, load_generation_artifact,
-                    random_weights, reference_decode,
-                    save_generation_artifact)
+                    parse_tree_shape, random_weights, reference_decode,
+                    save_generation_artifact, tree_topology)
 from .router import RouterRequest, ServingRouter  # noqa: F401
 from .scheduler import (AdmissionError,  # noqa: F401
                         DeadlineExceededError, GenerationRequest,
-                        RequestQueue, StepScheduler)
+                        RequestQueue, StepScheduler,
+                        spec_tree_acceptance)
 
 __all__ = ["ServingEngine", "ServingRouter", "RouterRequest",
            "KVBlockPool", "blocks_needed", "prefix_chain_keys",
            "PoissonLoadGenerator", "GenerationConfig", "GenerationModel",
            "ModelDrafter", "NGramDrafter",
            "extract_decoder_weights", "load_generation_artifact",
-           "random_weights", "reference_decode",
-           "save_generation_artifact", "AdmissionError",
+           "parse_tree_shape", "random_weights", "reference_decode",
+           "save_generation_artifact", "tree_topology",
+           "spec_tree_acceptance", "AdmissionError",
            "DeadlineExceededError", "GenerationRequest", "RequestQueue",
            "StepScheduler"]

@@ -73,6 +73,7 @@ from ..core.scope import Scope
 from ..observability import flight_recorder as _blackbox
 from ..observability import metrics as _metrics
 from ..observability import tracing as _tracing
+from ..quant import weight_store_bytes as _weight_store_bytes
 from .kv_cache import KVBlockPool, blocks_needed
 from .model import GenerationModel, load_generation_artifact
 from .scheduler import (AdmissionError, GenerationRequest, RequestQueue,
@@ -89,8 +90,8 @@ class _ModelWorker:
                  num_blocks, max_queue, async_depth, engine,
                  prefill_chunk=0, prefix_cache=False,
                  prefill_token_budget=None, spec_k=0, drafter=None,
-                 transient_tolerance=2):
-        from .model import NGramDrafter
+                 spec_tree=None, transient_tolerance=2):
+        from .model import NGramDrafter, parse_tree_shape
 
         self.name = name
         self.model = model
@@ -114,8 +115,18 @@ class _ModelWorker:
         if self.prefill_chunk and prefill_token_budget is None:
             prefill_token_budget = 4 * self.prefill_chunk
         # speculative decoding: the verify window is a compiled shape,
-        # clamped so a full window always fits the context
-        self.spec_k = max(0, min(int(spec_k or 0), max_seq_len - 1))
+        # clamped so a full window always fits the context. A tree
+        # shape (PTPU_SERVE_SPEC_TREE) implies speculation — its depth
+        # plays spec_k's role and the verify window becomes the
+        # level-order token tree
+        self.spec_tree = parse_tree_shape(spec_tree)
+        if self.spec_tree:
+            width, depth = self.spec_tree
+            depth = max(1, min(depth, max_seq_len - 1))
+            self.spec_tree = (width, depth)
+            self.spec_k = depth
+        else:
+            self.spec_k = max(0, min(int(spec_k or 0), max_seq_len - 1))
         if self.spec_k and drafter is None:
             drafter = NGramDrafter()
         if drafter is not None and not callable(
@@ -124,6 +135,10 @@ class _ModelWorker:
                 "drafter %r has no propose(history, k) method"
                 % (type(drafter).__name__,))
         self.drafter = drafter if self.spec_k else None
+        if self.drafter is not None and hasattr(self.drafter, "bind"):
+            # jitted ModelDrafter: size its draft-side KV pool/batch
+            # geometry once, up front
+            self.drafter.bind(max_batch, self.spec_k)
         self.scheduler = StepScheduler(
             max_batch, self.pool, max_seq_len,
             prefill_chunk=self.prefill_chunk,
@@ -131,7 +146,7 @@ class _ModelWorker:
             prefill_token_budget=(prefill_token_budget
                                   if self.prefill_chunk else None),
             cache_namespace=name, spec_k=self.spec_k,
-            drafter=self.drafter)
+            drafter=self.drafter, spec_tree=self.spec_tree)
         self.queue = RequestQueue(max_queue)
         self.max_batch = int(max_batch)
         # bounded in-flight step lag (the PR-2 InflightWindow contract,
@@ -159,12 +174,25 @@ class _ModelWorker:
                                     self.prefill_chunk)
             if self.prefill_chunk else None)
         # the speculative verify window (third compiled shape; jit is
-        # lazy, so geometry that never speculates still traces nothing)
-        self._spec_step = (
-            model.make_spec_step(self.max_batch,
-                                 self.scheduler.max_blocks_per_seq,
-                                 self.spec_k + 1)
-            if self.spec_k else None)
+        # lazy, so geometry that never speculates still traces nothing).
+        # Tree mode swaps in the tree verify window plus the tiny
+        # post-acceptance KV compaction step.
+        if self.spec_tree:
+            width, depth = self.spec_tree
+            self._spec_step = model.make_spec_tree_step(
+                self.max_batch, self.scheduler.max_blocks_per_seq,
+                width, depth)
+            self._tree_commit = model.make_tree_commit_step(
+                self.max_batch, self.scheduler.max_blocks_per_seq,
+                1 + width * depth)
+        else:
+            self._spec_step = (
+                model.make_spec_step(self.max_batch,
+                                     self.scheduler.max_blocks_per_seq,
+                                     self.spec_k + 1)
+                if self.spec_k else None)
+            self._tree_commit = None
+        self.spec_tree_commits = 0  # host-side (live with metrics off)
         import jax.numpy as jnp
 
         self._prev_tokens = jnp.zeros((self.max_batch,), jnp.int32)
@@ -199,9 +227,10 @@ class _ModelWorker:
 
     # -- submission side -----------------------------------------------
     def submit(self, request):
-        worst = blocks_needed(
-            min(len(request.prompt) + request.max_new_tokens,
-                self.scheduler.max_seq_len), self.pool.block_size)
+        # the scheduler's own admission budget (incl. the tree-window
+        # overhang) — delegating keeps the two checks mirrored, so a
+        # submittable request can never deadlock the head of the queue
+        worst = self.scheduler._budget_for(request)
         if worst > self.pool.blocks_total:
             raise AdmissionError(
                 "request needs %d KV blocks but the pool holds %d — "
@@ -532,13 +561,56 @@ class _ModelWorker:
         # its last COMMITTED token (the [B, W] window output replaced
         # the [B] chain this vector used to carry)
         prev = np.asarray(self._prev_tokens).copy()
-        for seq, window in plan:
-            was_done = seq.request.finished
-            n_emitted += sched.record_spec(seq, window, outs[seq.slot])
-            if seq.request.tokens:
-                prev[seq.slot] = seq.request.tokens[-1]
-            if seq.request.finished and not was_done:
-                self._note_completion(seq.request)
+        if self.spec_tree:
+            from .scheduler import spec_tree_acceptance
+
+            width = self.spec_tree[0]
+            # host acceptance walk first; the accepted paths' KV must
+            # be compacted into the committed slot layout BEFORE
+            # record_spec_tree's truncate re-points the tail blocks
+            # (the sources live in blocks the rollback may drop)
+            acc = []
+            commit_rows = []
+            for seq, window in plan:
+                path, emitted = spec_tree_acceptance(
+                    window, outs[seq.slot], width)
+                acc.append((seq, window, path, emitted))
+                if path and any(s != j + 1 for j, s in enumerate(path)):
+                    commit_rows.append((seq.slot, path))
+            if commit_rows:
+                C = sched.spec_feed.shape[1]
+                src = np.zeros((self.max_batch, C), np.int32)
+                n_commit = np.zeros(self.max_batch, np.int32)
+                commit_active = np.zeros(self.max_batch, bool)
+                for slot, path in commit_rows:
+                    src[slot, 1:1 + len(path)] = path  # [0, path...]
+                    n_commit[slot] = 1 + len(path)
+                    commit_active[slot] = True
+                self.pool.k, self.pool.v = self._tree_commit(
+                    self.pool.k, self.pool.v,
+                    jnp.asarray(sched.positions.copy()),
+                    jnp.asarray(src), jnp.asarray(n_commit),
+                    jnp.asarray(sched.block_tables.copy()),
+                    jnp.asarray(commit_active))
+                self.spec_tree_commits += 1
+                _metrics.counter("serving/spec_tree_commits").inc()
+            for seq, window, path, emitted in acc:
+                was_done = seq.request.finished
+                n_emitted += sched.record_spec_tree(seq, window, path,
+                                                    emitted)
+                if seq.request.tokens:
+                    prev[seq.slot] = seq.request.tokens[-1]
+                if seq.request.finished and not was_done:
+                    self._note_completion(seq.request)
+        else:
+            for seq, window in plan:
+                was_done = seq.request.finished
+                n_emitted += sched.record_spec(seq, window,
+                                               outs[seq.slot])
+                if seq.request.tokens:
+                    prev[seq.slot] = seq.request.tokens[-1]
+                if seq.request.finished and not was_done:
+                    self._note_completion(seq.request)
         self._prev_tokens = jnp.asarray(prev)
         self._gen_tokens += n_emitted
         if (self._t_first_step is not None
@@ -628,7 +700,7 @@ class ServingEngine:
                  block_size=16, num_blocks=None, max_queue=64,
                  async_depth=None, prefill_chunk=None, prefix_cache=None,
                  prefill_token_budget=None, spec_k=None, drafter=None,
-                 deadline_s=None, transient_tolerance=2):
+                 spec_tree=None, deadline_s=None, transient_tolerance=2):
         from ..flags import env as _env
 
         if async_depth is None:
@@ -639,6 +711,9 @@ class ServingEngine:
             prefix_cache = bool(_env("PTPU_SERVE_PREFIX_CACHE"))
         if spec_k is None:
             spec_k = _env("PTPU_SERVE_SPEC_K")
+        if spec_tree is None:
+            spec_tree = _env("PTPU_SERVE_SPEC_TREE")
+        draft_model = _env("PTPU_SERVE_DRAFT_MODEL")
         if deadline_s is None:
             deadline_s = _env("PTPU_SERVE_DEADLINE_S")
         self._deadline_s = deadline_s
@@ -654,6 +729,15 @@ class ServingEngine:
                 raise TypeError(
                     "model %r must be a GenerationModel or an artifact "
                     "dir, got %r" % (name, type(model).__name__))
+            worker_drafter = drafter
+            if worker_drafter is None and draft_model:
+                # env-configured jitted draft model: one ModelDrafter
+                # per worker (drafter state — draft KV pool, per-seq
+                # slots — must never be shared across worker threads)
+                from .model import ModelDrafter
+
+                worker_drafter = ModelDrafter(load_generation_artifact(
+                    draft_model, name=name + ".draft"))
             self._workers[name] = _ModelWorker(
                 name, model, max_batch=max_batch,
                 max_seq_len=max_seq_len, block_size=block_size,
@@ -661,7 +745,8 @@ class ServingEngine:
                 async_depth=async_depth, engine=self,
                 prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
                 prefill_token_budget=prefill_token_budget,
-                spec_k=spec_k, drafter=drafter,
+                spec_k=spec_k, drafter=worker_drafter,
+                spec_tree=spec_tree,
                 transient_tolerance=transient_tolerance)
         self._default = next(iter(self._workers))
         self._closed = False
@@ -804,14 +889,22 @@ class ServingEngine:
                 "prefix_blocks_reused": sched.prefix_blocks_reused,
                 "prefix_tokens_skipped": sched.prefix_tokens_skipped,
                 "spec_k": w.spec_k,
+                "spec_tree": ("%dx%d" % w.spec_tree
+                              if w.spec_tree else None),
                 "spec_steps": sched.spec_steps,
                 "spec_proposed": sched.spec_proposed,
                 "spec_accepted": sched.spec_accepted,
                 "spec_emitted": sched.spec_emitted,
                 "spec_blocks_rolled_back":
                     sched.spec_blocks_rolled_back,
+                "spec_tree_slots": sched.spec_tree_slots,
+                "spec_tree_commits": w.spec_tree_commits,
                 "spec_accept_rate": (sched.spec_accepted
                                      / max(1, sched.spec_proposed)),
+                "spec_draft_steps": getattr(w.drafter, "draft_steps",
+                                            0) if w.drafter else 0,
+                "weight_only_int8": w.model.weight_only_int8,
+                "weight_store": _weight_store_bytes(w.model.weights),
                 "deadline_expired": sched.deadline_expired,
                 "transient_retries": w._transient_retries,
                 **w.pool.stats(),

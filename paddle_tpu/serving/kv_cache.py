@@ -157,6 +157,11 @@ class KVBlockPool:
         self._block_key = {}     # bid -> content key (sealed blocks)
         # refcount-0 sealed blocks, oldest-freed first (the LRU evictees)
         self._cached = OrderedDict()   # bid -> content key
+        # cumulative rollback accounting (speculative decoding's
+        # truncate path — surfaced in stats() so the drafter-pool and
+        # target-pool rollback volume is auditable per pool)
+        self.truncate_calls = 0
+        self.blocks_truncated = 0
 
     # -- accounting ----------------------------------------------------
     @property
@@ -200,6 +205,8 @@ class KVBlockPool:
             "blocks_reserved": reserved,
             "blocks_free": free + cached - reserved,
             "utilization": (owned + shared) / self.num_blocks,
+            "truncate_calls": self.truncate_calls,
+            "blocks_truncated": self.blocks_truncated,
         }
 
     # -- admission-side API --------------------------------------------
@@ -348,6 +355,8 @@ class KVBlockPool:
                 self._free.append(bid)
             self._reserved[owner] = (self._reserved.get(owner, 0)
                                      + len(dropped))
+            self.truncate_calls += 1
+            self.blocks_truncated += len(dropped)
             return list(dropped)
 
     # -- runtime invariants (docs/STATIC_ANALYSIS.md, PTPU_LOCK_CHECK) -

@@ -57,9 +57,68 @@ import time
 import numpy as np
 
 __all__ = ["GenerationConfig", "GenerationModel", "ModelDrafter",
-           "NGramDrafter", "extract_decoder_weights", "random_weights",
-           "reference_decode", "save_generation_artifact",
-           "load_generation_artifact"]
+           "NGramDrafter", "extract_decoder_weights",
+           "parse_tree_shape", "random_weights", "reference_decode",
+           "save_generation_artifact", "load_generation_artifact",
+           "tree_topology"]
+
+
+def parse_tree_shape(spec):
+    """Parse a ``PTPU_SERVE_SPEC_TREE`` value: ``"WxD"`` (e.g. ``"2x3"``
+    = width 2, depth 3) -> ``(width, depth)``; empty/None/off -> None
+    (tree speculation disabled, the PR-12 linear window)."""
+    if not spec:
+        return None
+    if isinstance(spec, (tuple, list)):
+        w, d = spec
+    else:
+        s = str(spec).strip().lower()
+        if s in ("", "0", "off", "false", "no"):
+            return None
+        if "x" not in s:
+            raise ValueError(
+                "spec tree shape must look like 'WxD' (width x depth, "
+                "e.g. '2x3'), got %r" % (spec,))
+        w, d = s.split("x", 1)
+    w, d = int(w), int(d)
+    if w < 1 or d < 1:
+        raise ValueError(
+            "spec tree width and depth must be >= 1, got %dx%d" % (w, d))
+    return w, d
+
+
+def tree_topology(width, depth):
+    """Static topology of the speculative token tree (docs/SERVING.md):
+    ``width`` root-anchored chains of ``depth`` draft slots in
+    LEVEL-ORDER layout, slot 0 the root (the row's last committed
+    token). Level ``l`` (1-based) of chain ``c`` is slot
+    ``1 + (l - 1) * width + c``; its parent is the same chain one level
+    up (the root at ``l == 1``). Level order means any slot-prefix of
+    the window is itself a valid (shallower) tree, so the per-row
+    budget clamp reuses the window-length masking.
+
+    Returns ``(parents, depths, anc)`` — int32 ``[C]``, int32 ``[C]``
+    and bool ``[C, C]`` for ``C = 1 + width * depth``, with
+    ``anc[j, t]`` true iff slot ``t`` is ``j`` or an ancestor of ``j``
+    (slot ``j``'s in-window attention visibility: exactly its own root
+    path, sibling branches mutually invisible)."""
+    width, depth = int(width), int(depth)
+    C = 1 + width * depth
+    parents = np.zeros(C, np.int32)
+    depths = np.zeros(C, np.int32)
+    for level in range(1, depth + 1):
+        for c in range(width):
+            s = 1 + (level - 1) * width + c
+            parents[s] = 0 if level == 1 else s - width
+            depths[s] = level
+    anc = np.zeros((C, C), bool)
+    for s in range(C):
+        anc[s, s] = True
+        j = s
+        while j:
+            j = int(parents[j])
+            anc[s, j] = True
+    return parents, depths, anc
 
 # serving-artifact file names (written by
 # inference.export_generation_model next to the one-shot
@@ -592,12 +651,21 @@ class GenerationModel:
 
     def _forward_chunk(self, jnp, weights, x, pos2d, lengths,
                        block_tables, active, kv_k, kv_v,
-                       all_slots=False):
+                       all_slots=False, tree_anc=None):
         """A ``[B, C]`` token window through all layers. x: [B, C, D];
         returns (kv_k, kv_v, logits[B, V]) — each row's logits at its
         LAST valid window slot (``lengths - 1``) — or, with
         ``all_slots=True`` (the speculative verify window), the logits
-        at EVERY window slot: (kv_k, kv_v, logits[B, C, V])."""
+        at EVERY window slot: (kv_k, kv_v, logits[B, C, V]).
+
+        ``tree_anc`` (bool ``[C, C]``, trace-time constant from
+        :func:`tree_topology`) switches the in-window causal mask to
+        TREE visibility: window slot ``j`` still writes its KV at cache
+        position ``pos2d[b, j]`` (= pos + j, the linear slot layout the
+        block tables already cover), but attends the committed prefix
+        (cache positions before the window) plus only its OWN root path
+        inside the window — sibling branches are mutually invisible, so
+        one step verifies every branch of the token tree."""
         import jax
 
         cfg = self.config
@@ -628,18 +696,38 @@ class GenerationModel:
         # in-chunk self-attention sees exactly the causal prefix; t=0 is
         # always visible, so no softmax row is fully masked.
         t_ids = jnp.arange(max_ctx)[None, None, :]
-        attn_valid = t_ids <= pos2d[:, :, None]          # [B, C, T]
+        if tree_anc is None:
+            attn_valid = t_ids <= pos2d[:, :, None]      # [B, C, T]
+        else:
+            # tree window: slot j's visibility is the committed prefix
+            # (strictly before the window's first position) plus the
+            # static ancestor mask over in-window cache positions. The
+            # root slot sees itself via anc[0, 0]; pos0 >= 1 past
+            # prefill, so no softmax row is ever fully masked.
+            pos0 = pos2d[:, 0]
+            rel = t_ids - pos0[:, None, None]            # [B, 1, T]
+            in_win = (rel >= 0) & (rel < C)
+            rel_c = jnp.clip(rel, 0, C - 1)
+            anc_t = tree_anc[jnp.arange(C)[None, :, None], rel_c]
+            attn_valid = (rel < 0) | (in_win & anc_t)    # [B, C, T]
 
         # the speculative verify window (all_slots) dispatches the
         # fused spec_window kernel — k+1 query positions against the
         # paged cache in one launch, block table resolved in-kernel;
-        # one decision per forward, shared by all layers
+        # one decision per forward, shared by all layers. The tree
+        # window dispatches the tree-mask variant, which takes the
+        # ancestor mask as an extra operand.
         from ..ops.kernel_registry import choose as _choose_kernel
 
         use_paged = all_slots and _choose_kernel(
-            "spec_window", head_dim=Dh, block_size=bs, window=C)
+            "spec_window" if tree_anc is None else "spec_window_tree",
+            head_dim=Dh, block_size=bs, window=C)
         if use_paged:
-            from ..ops.pallas_kernels import paged_attention
+            if tree_anc is None:
+                from ..ops.pallas_kernels import paged_attention
+            else:
+                from ..ops.pallas_kernels import paged_attention_tree
+                anc_f = tree_anc.astype(jnp.float32)
 
         for i in range(cfg.n_layers):
             p = "l%d/" % i
@@ -653,9 +741,14 @@ class GenerationModel:
             kv_k = kv_k.at[i, write_blk, slot_idx].set(k_new)
             kv_v = kv_v.at[i, write_blk, slot_idx].set(v_new)
             if use_paged:
-                ctx = paged_attention(
-                    kv_k[i], kv_v[i], q, block_tables, pos2d,
-                    sm_scale=sm_scale).reshape(B, C, -1)
+                if tree_anc is None:
+                    ctx = paged_attention(
+                        kv_k[i], kv_v[i], q, block_tables, pos2d,
+                        sm_scale=sm_scale).reshape(B, C, -1)
+                else:
+                    ctx = paged_attention_tree(
+                        kv_k[i], kv_v[i], q, block_tables, pos2d,
+                        anc_f, sm_scale=sm_scale).reshape(B, C, -1)
             else:
                 # paged gather: [B, Mb, bs, H, Dh] -> [B, max_ctx, H, Dh]
                 k_ctx = kv_k[i][block_tables].reshape(B, max_ctx, H, Dh)
@@ -711,15 +804,20 @@ class GenerationModel:
                                       return_logits=return_logits)
 
     def _make_window_step(self, kind, max_batch, max_blocks_per_seq,
-                          window, all_slots, return_logits):
+                          window, all_slots, return_logits, tree=None):
         """The shared ``[max_batch, window]`` jitted step builder behind
         :meth:`make_prefill_step` (``all_slots=False`` — logits at each
-        row's last valid slot) and :meth:`make_spec_step`
-        (``all_slots=True`` — the verify window, argmax at every slot).
-        One body, so the token-splice/embedding/position plumbing can
-        never diverge between the two shapes."""
+        row's last valid slot), :meth:`make_spec_step`
+        (``all_slots=True`` — the verify window, argmax at every slot)
+        and :meth:`make_spec_tree_step` (``tree=(width, depth)`` — the
+        tree verify window: tree attention mask, position encodings at
+        each slot's tree DEPTH rather than its window offset). One
+        body, so the token-splice/embedding/position plumbing can never
+        diverge between the shapes."""
         key = (kind, int(max_batch), int(max_blocks_per_seq),
                int(window), bool(return_logits)) + _kernel_key_suffix()
+        if tree is not None:
+            key = key + ("tree:%dx%d" % (int(tree[0]), int(tree[1])),)
         if key in self._steps:
             return self._steps[key]
         import jax
@@ -729,6 +827,12 @@ class GenerationModel:
         pe = jnp.asarray(_position_encoding_table(cfg))
         emb_scale = float(cfg.d_model) ** 0.5
         C = int(window)
+        if tree is None:
+            depths_j = anc_j = None
+        else:
+            _parents, depths_np, anc_np = tree_topology(*tree)
+            depths_j = jnp.asarray(depths_np)            # [C]
+            anc_j = jnp.asarray(anc_np)                  # [C, C] bool
 
         def step(weights, kv_k, kv_v, window_tokens, use_prompt,
                  prev_tokens, positions, lengths, block_tables, active):
@@ -744,12 +848,19 @@ class GenerationModel:
             es = weights.get("embedding@qscale")
             if es is not None:
                 emb = emb.astype(jnp.float32) * es
-            pe_idx = jnp.clip(pos2d, 0, cfg.max_seq_len - 1)
+            if tree is None:
+                pe_idx = jnp.clip(pos2d, 0, cfg.max_seq_len - 1)
+            else:
+                # a tree slot's LOGICAL position is root + its depth
+                # (siblings share a position; the cache slot stays
+                # pos + j)
+                pe_idx = jnp.clip(positions[:, None] + depths_j[None, :],
+                                  0, cfg.max_seq_len - 1)
             x = (emb * emb_scale * cfg.pe_alpha
                  + cfg.pe_beta * jnp.take(pe, pe_idx, axis=0))
             kv_k, kv_v, logits = self._forward_chunk(
                 jnp, weights, x, pos2d, lengths, block_tables, active,
-                kv_k, kv_v, all_slots=all_slots)
+                kv_k, kv_v, all_slots=all_slots, tree_anc=anc_j)
             next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             if return_logits:
                 return kv_k, kv_v, next_tokens, logits
@@ -789,6 +900,171 @@ class GenerationModel:
                                       all_slots=True,
                                       return_logits=return_logits)
 
+    def make_spec_tree_step(self, max_batch, max_blocks_per_seq, width,
+                            depth, return_logits=False):
+        """Build (and cache) the jitted TREE verify window
+        (docs/SERVING.md tree speculation): the :meth:`make_spec_step`
+        shape over a ``C = 1 + width * depth`` window holding a
+        level-order token tree (:func:`tree_topology` — slot 0 the
+        row's last committed token, ``width`` root-anchored chains of
+        ``depth`` slots), verified in ONE compiled step via the
+        in-window tree attention mask:
+
+            step(weights, kv_k, kv_v, window_tokens[B, C],
+                 use_prompt[B], prev_tokens[B], positions[B],
+                 lengths[B], block_tables[B, Mb], active[B])
+              -> (kv_k', kv_v', next_tokens[B, C])
+
+        ``next_tokens[b, j]`` is the target's greedy token after window
+        slot ``j``'s ROOT PATH (committed prefix + j's ancestors + j) —
+        the token sequential greedy decoding would emit after accepting
+        exactly that path. Acceptance (the host walk,
+        ``scheduler.spec_tree_acceptance``) is the deepest root path
+        whose every node matches the running argmax; the argmax at the
+        accepted frontier is the correction token, so every window
+        emits at least one greedy-identical token. Rows may feed any
+        level-order PREFIX of the full tree via ``lengths`` (shallower
+        trees near budget caps); slots at or past ``lengths[b]`` write
+        to the null block. At ``width == 1`` the mask, positions and
+        outputs are numerically the linear verify window. The KV arrays
+        are donated."""
+        width, depth = int(width), int(depth)
+        return self._make_window_step("spec_tree", max_batch,
+                                      max_blocks_per_seq,
+                                      1 + width * depth,
+                                      all_slots=True,
+                                      return_logits=return_logits,
+                                      tree=(width, depth))
+
+    def make_tree_commit_step(self, max_batch, max_blocks_per_seq,
+                              window):
+        """Build (and cache) the jitted post-acceptance KV
+        **compaction** step for tree speculation (docs/SERVING.md): the
+        verify window wrote every tree slot's KV at cache position
+        ``pos + slot``, but the committed layout needs the ACCEPTED
+        root path contiguous at ``pos + 1 ..``. One tiny gather/scatter
+        over the window span moves it:
+
+            commit(kv_k, kv_v, positions[B], src_slots[B, C],
+                   n_commit[B], block_tables[B, Mb], active[B])
+              -> (kv_k', kv_v')
+
+        Row ``b`` copies window slot ``src_slots[b, j]`` (cache
+        position ``positions[b] + src_slots[b, j]``) onto cache
+        position ``positions[b] + j`` for every ``j < n_commit[b]``
+        (the engine passes ``[0, path...]`` so ``j = 0`` is the root's
+        identity self-copy); rows needing no move pass ``n_commit = 0``
+        and their writes route to the null block. All sources are
+        gathered before any destination is written, and the engine
+        dispatches this BEFORE ``truncate_owner`` re-points the tail
+        blocks, so sources always live in still-owned blocks. Pure data
+        movement — no weights are read. The KV arrays are donated."""
+        key = ("tree_commit", int(max_batch), int(max_blocks_per_seq),
+               int(window)) + _kernel_key_suffix()
+        if key in self._steps:
+            return self._steps[key]
+        import jax
+        import jax.numpy as jnp
+
+        C = int(window)
+
+        def commit(kv_k, kv_v, positions, src_slots, n_commit,
+                   block_tables, active):
+            self.trace_count += 1
+            Mb = block_tables.shape[1]
+            bs = kv_k.shape[2]
+            src_pos = positions[:, None] + src_slots        # [B, C]
+            src_blk = jnp.take_along_axis(
+                block_tables, jnp.clip(src_pos // bs, 0, Mb - 1),
+                axis=1)
+            k_win = kv_k[:, src_blk, src_pos % bs]  # [L, B, C, H, Dh]
+            v_win = kv_v[:, src_blk, src_pos % bs]
+            dst_pos = (positions[:, None]
+                       + jnp.arange(C, dtype=jnp.int32)[None, :])
+            dst_ok = ((jnp.arange(C, dtype=jnp.int32)[None, :]
+                       < n_commit[:, None]) & active[:, None])
+            dst_blk = jnp.where(
+                dst_ok,
+                jnp.take_along_axis(block_tables,
+                                    jnp.clip(dst_pos // bs, 0, Mb - 1),
+                                    axis=1),
+                0)
+            kv_k = kv_k.at[:, dst_blk, dst_pos % bs].set(k_win)
+            kv_v = kv_v.at[:, dst_blk, dst_pos % bs].set(v_win)
+            return kv_k, kv_v
+
+        jitted = self._instrument_step("tree_commit", jax.jit(
+            commit, donate_argnums=(0, 1)))
+        self._steps[key] = jitted
+        return jitted
+
+    def make_draft_step(self, max_batch, max_blocks_per_seq, n_new):
+        """Build (and cache) the fused jitted DRAFT step
+        (docs/SERVING.md tree speculation): starting from
+        ``first_tokens`` (each row's first draft token, already argmaxed
+        by the catch-up chunk) at ``positions``, run ``n_new`` greedy
+        one-token micro-steps in ONE compiled call (a ``lax.scan`` over
+        the one-token forward), each writing its KV slot and chaining
+        its argmax into the next — this is what retires the per-row
+        host ``reference_decode`` loop of the PR-12 :class:`ModelDrafter`:
+
+            draft(weights, kv_k, kv_v, first_tokens[B], positions[B],
+                  block_tables[B, Mb], active[B])
+              -> (kv_k', kv_v', tokens[B, n_new])
+
+        ``tokens[b, i]`` is the greedy token after feeding the
+        ``i+1``-th chain token, i.e. chain tokens ``2 .. n_new + 1`` of
+        a draft whose first token is ``first_tokens[b]``. Active rows
+        MUST have ``positions + n_new <= max_seq_len`` (the caller
+        deactivates rows near the cap — inactive rows write to the null
+        block and their outputs are ignored). The KV arrays are
+        donated."""
+        key = ("draft", int(max_batch), int(max_blocks_per_seq),
+               int(n_new)) + _kernel_key_suffix()
+        if key in self._steps:
+            return self._steps[key]
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        pe = jnp.asarray(_position_encoding_table(cfg))
+        emb_scale = float(cfg.d_model) ** 0.5
+        n_new = int(n_new)
+
+        def embed(weights, tok, pos):
+            tok = jnp.clip(tok, 0, cfg.vocab_size - 1)
+            emb = jnp.take(weights["embedding"], tok, axis=0)
+            es = weights.get("embedding@qscale")
+            if es is not None:
+                emb = emb.astype(jnp.float32) * es
+            pe_idx = jnp.clip(pos, 0, cfg.max_seq_len - 1)
+            return (emb * emb_scale * cfg.pe_alpha
+                    + cfg.pe_beta * jnp.take(pe, pe_idx, axis=0))
+
+        def draft(weights, kv_k, kv_v, first_tokens, positions,
+                  block_tables, active):
+            self.trace_count += 1
+
+            def micro(carry, i):
+                kv_k, kv_v, tok = carry
+                pos = positions + i
+                x = embed(weights, tok, pos)
+                kv_k, kv_v, logits = self._forward_token(
+                    jnp, weights, x, pos, block_tables, active,
+                    kv_k, kv_v)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (kv_k, kv_v, nxt), nxt
+
+            (kv_k, kv_v, _last), toks = jax.lax.scan(
+                micro, (kv_k, kv_v, first_tokens),
+                jnp.arange(n_new, dtype=jnp.int32))
+            return kv_k, kv_v, jnp.transpose(toks)      # [B, n_new]
+
+        jitted = self._instrument_step("draft", jax.jit(
+            draft, donate_argnums=(1, 2)))
+        self._steps[key] = jitted
+        return jitted
+
 
 # ---------------------------------------------------------------------------
 # draft sources for speculative decoding (docs/SERVING.md)
@@ -807,7 +1083,18 @@ class NGramDrafter:
 
     ``propose(history, k)`` tries match lengths from ``max_ngram`` down
     to ``min_ngram`` and returns up to ``k`` continuation tokens (empty
-    when no n-gram recurs)."""
+    when no n-gram recurs).
+
+    With a ``seq_id`` (``propose_for`` — what the scheduler passes),
+    the drafter keeps an INCREMENTAL per-sequence suffix index instead
+    of rescanning the full history every window: each n-gram's start
+    positions are recorded once when the history first covers them
+    (committed history is append-only between windows; a shrunken or
+    diverged history rebuilds the index from scratch), so draft-side
+    host time per window is O(k + tokens newly committed), not O(L).
+    ``index_ops`` counts gram insertions + occurrence probes — the
+    unit-test pin that the rescan is really gone. ``release(seq_id)``
+    drops a retired sequence's index (the scheduler's reap calls it)."""
 
     def __init__(self, max_ngram=3, min_ngram=1):
         self.max_ngram = int(max_ngram)
@@ -816,13 +1103,50 @@ class NGramDrafter:
             raise ValueError("min_ngram must be >= 1")
         if self.max_ngram < self.min_ngram:
             raise ValueError("max_ngram must be >= min_ngram")
+        self._index = {}        # seq_id -> {len, last, grams{n: {...}}}
+        self.index_ops = 0
 
-    def propose(self, history, k):
+    def release(self, seq_id):
+        """Drop a retired sequence's memoized suffix index."""
+        self._index.pop(seq_id, None)
+
+    def _indexed(self, seq_id, hist):
+        """The per-sequence suffix index advanced to cover ``hist``:
+        ``grams[n]`` maps each n-gram tuple to its ASCENDING start
+        positions. Incremental — only grams starting in the newly
+        appended span are inserted; a history that shrank or whose
+        last cached token changed (external rollback/divergence)
+        rebuilds from scratch."""
+        L = len(hist)
+        ent = self._index.get(seq_id)
+        if (ent is None or ent["len"] > L
+                or (ent["len"] > 0 and hist[ent["len"] - 1] != ent["last"])):
+            ent = {"len": 0, "last": None,
+                   "grams": {n: {} for n in
+                             range(self.min_ngram, self.max_ngram + 1)}}
+            self._index[seq_id] = ent
+        L0 = ent["len"]
+        for n in range(self.min_ngram, self.max_ngram + 1):
+            grams = ent["grams"][n]
+            for j in range(max(L0 - n + 1, 0), L - n + 1):
+                grams.setdefault(tuple(hist[j:j + n]), []).append(j)
+                self.index_ops += 1
+        ent["len"] = L
+        ent["last"] = hist[L - 1] if L else None
+        return ent
+
+    def propose_for(self, seq_id, history, k):
+        """``propose`` through the incremental per-sequence index —
+        identical tokens, O(k)-per-window host cost."""
+        return self.propose(history, k, seq_id=seq_id)
+
+    def propose(self, history, k, seq_id=None):
         k = int(k)
         if k < 1 or len(history) < self.min_ngram + 1:
             return []
         hist = [int(t) for t in history]
         L = len(hist)
+        ent = self._indexed(seq_id, hist) if seq_id is not None else None
         for n in range(min(self.max_ngram, L - 1),
                        self.min_ngram - 1, -1):
             suffix = hist[L - n:]
@@ -834,36 +1158,130 @@ class NGramDrafter:
             # scan on for an earlier full-window one); the match must
             # end before the suffix starts so the continuation is real
             best = None
-            for j in range(L - n - 1, -1, -1):
-                if hist[j:j + n] != suffix:
-                    continue
-                avail = min(k, L - (j + n))
-                if best is None or avail > best[1]:
-                    best = (j, avail)
-                if avail >= k:
-                    break
+            if ent is not None:
+                # memoized path: same candidates in the same recency
+                # order, read straight off the occurrence list
+                occ = ent["grams"][n].get(tuple(suffix), ())
+                for j in reversed(occ):
+                    self.index_ops += 1
+                    if j >= L - n:      # the trailing suffix itself
+                        continue
+                    avail = min(k, L - (j + n))
+                    if best is None or avail > best[1]:
+                        best = (j, avail)
+                    if avail >= k:
+                        break
+            else:
+                for j in range(L - n - 1, -1, -1):
+                    if hist[j:j + n] != suffix:
+                        continue
+                    avail = min(k, L - (j + n))
+                    if best is None or avail > best[1]:
+                        best = (j, avail)
+                    if avail >= k:
+                        break
             if best is not None:
                 start = best[0] + n
                 return hist[start:start + k]
         return []
 
+    def propose_tree(self, history, width, depth, seq_id=None):
+        """Tree drafting (docs/SERVING.md): up to ``width``
+        root-anchored chains of up to ``depth`` tokens. Chain 0 is the
+        linear :meth:`propose` draft; alternate chains are the
+        continuations of OTHER occurrence sites of the same suffix
+        whose next token differs — exactly the traffic
+        (period-alternating repetition) where a single linear chain
+        keeps losing the verify window. Host work is bounded by a small
+        per-call probe budget, so tree drafting stays O(width * depth)
+        per window on the memoized path."""
+        width, depth = int(width), int(depth)
+        primary = self.propose(history, depth, seq_id=seq_id)
+        if width <= 1 or len(history) < self.min_ngram + 1:
+            return [primary] if primary else []
+        hist = [int(t) for t in history]
+        L = len(hist)
+        chains = [primary] if primary else []
+        seen = {primary[0]} if primary else set()
+        for n in range(min(self.max_ngram, L - 1),
+                       self.min_ngram - 1, -1):
+            if seq_id is not None:
+                occ = list(self._indexed(seq_id, hist)["grams"][n]
+                           .get(tuple(hist[L - n:]), ()))
+            else:
+                suffix = hist[L - n:]
+                occ = [j for j in range(L - n)
+                       if hist[j:j + n] == suffix]
+            budget = 8 * width + depth
+            for j in reversed(occ):
+                if len(chains) >= width or budget <= 0:
+                    break
+                budget -= 1
+                self.index_ops += 1
+                if j >= L - n:
+                    continue
+                cont = hist[j + n:j + n + depth]
+                if not cont or cont[0] in seen:
+                    continue
+                seen.add(cont[0])
+                chains.append(cont)
+            if occ:
+                # branches come from the longest recurring suffix only
+                break
+        return chains
+
+
+class _DraftSeq:
+    """Per-sequence drafter-side KV state: the drafter pool's owner
+    object (reservation/rollback accounting hangs off its identity)."""
+
+    __slots__ = ("slot", "n_cached")
+
+    def __init__(self, slot):
+        self.slot = int(slot)
+        self.n_cached = 0
+
 
 class ModelDrafter:
-    """The pluggable draft-model hook: greedy-decode up to ``k``
-    continuation tokens from a (smaller) :class:`GenerationModel` over
-    the sequence's committed history. This reference implementation
-    runs the unbatched ``reference_decode`` oracle — exact but
-    host-side, i.e. a correctness/integration hook for wiring a real
-    jitted small-model drafter, not a production fast path. Drafting
-    with the TARGET model itself yields perfect acceptance (every
-    window emits its full length), which is what the tests pin."""
+    """The pluggable draft-model hook: greedy-decode continuation
+    tokens from a (smaller) :class:`GenerationModel` over each
+    sequence's committed history.
 
-    def __init__(self, model):
+    ``propose(history, k)`` is the PR-12 host-side oracle path
+    (``reference_decode`` — exact, unbatched, the API the original
+    tests pin). The production fast path is ``propose_batch`` /
+    ``propose_tree_batch``: the draft model runs as its OWN tiny jitted
+    steps batched across all occupied rows — catch-up prefill chunks
+    (``make_prefill_step`` with ``return_logits``) bring each row's
+    draft KV level with its committed history, then ONE fused
+    ``make_draft_step`` scan drafts the whole chain on device. Draft KV
+    lives in the drafter's own :class:`~.kv_cache.KVBlockPool` slice
+    and every window ends with the same reservation-restoring
+    ``truncate_owner`` rollback the target cache uses, so speculative
+    draft state can never leak blocks (``pool.check_invariants`` is
+    clean at every window boundary). Drafting with the TARGET model
+    itself yields perfect acceptance, which is what the tests pin.
+
+    ``draft_steps`` counts jitted draft-side dispatches (catch-up
+    chunks + fused scans) — the bench's draft-cost accounting."""
+
+    def __init__(self, model, block_size=16, chunk=None):
         if not isinstance(model, GenerationModel):
             raise TypeError("ModelDrafter needs a GenerationModel, got "
                             "%r" % (type(model).__name__,))
         self.model = model
+        self.draft_steps = 0
+        self._block_size = int(block_size)
+        self._chunk = chunk
+        self._pool = None
+        self._tables = None
+        self._max_batch = 0
+        self._n_new = 0
+        self._mb = 0
+        self._states = {}       # seq_id -> _DraftSeq
+        self._free_slots = []
 
+    # -- PR-12 host oracle path (API-compatible) ----------------------------
     def propose(self, history, k):
         k = int(k)
         hist = [int(t) for t in history]
@@ -872,6 +1290,228 @@ class ModelDrafter:
         if len(hist) >= self.model.config.max_seq_len:
             return []
         return reference_decode(self.model, hist, k)
+
+    # -- jitted batched path ------------------------------------------------
+    def bind(self, max_batch, max_chain):
+        """Size the drafter-side geometry (the engine calls this once
+        at worker construction): ``max_batch`` rows, chains up to
+        ``max_chain`` tokens. Builds the drafter's own KV pool —
+        ``max_batch * blocks_needed(draft max_seq_len)`` blocks, so a
+        full reservation per row always succeeds and admission can
+        never deadlock on draft KV. Growing an existing binding resets
+        all per-sequence draft state (the next window re-prefills)."""
+        from .kv_cache import KVBlockPool, blocks_needed
+
+        max_batch = int(max_batch)
+        max_chain = max(int(max_chain), 1)
+        if (self._pool is not None and self._max_batch >= max_batch
+                and self._n_new == max_chain - 1):
+            return
+        cfg = self.model.config
+        if self._chunk is None:
+            from .. import flags as _flags
+            self._chunk = int(_flags.env("PTPU_SERVE_DRAFT_CHUNK"))
+        self._chunk = max(int(self._chunk), 1)
+        self._max_batch = max(max_batch, self._max_batch)
+        self._n_new = max_chain - 1
+        self._mb = blocks_needed(cfg.max_seq_len, self._block_size)
+        self._pool = KVBlockPool(
+            cfg.n_layers, cfg.n_heads, cfg.head_dim, self._block_size,
+            num_blocks=self._max_batch * self._mb)
+        self._tables = np.zeros((self._max_batch, self._mb), np.int32)
+        self._states = {}
+        self._free_slots = list(range(self._max_batch - 1, -1, -1))
+
+    def release(self, seq_id):
+        """Free a retired sequence's draft-side KV state (the
+        scheduler's reap calls this)."""
+        st = self._states.pop(seq_id, None)
+        if st is None:
+            return
+        self._pool.free_owner(st)
+        self._tables[st.slot, :] = 0
+        self._free_slots.append(st.slot)
+
+    def _state_for(self, seq_id):
+        st = self._states.get(seq_id)
+        if st is None:
+            st = _DraftSeq(self._free_slots.pop())
+            self._states[seq_id] = st
+            # full per-row reservation up front: the drafter pool is
+            # sized so this can never fail, and truncate_owner restores
+            # it after every window's rollback
+            self._pool.reserve(st, self._mb)
+        return st
+
+    def _alloc_span(self, st, start, stop):
+        """Own (and table-map) the draft blocks covering positions
+        [start, stop)."""
+        from .kv_cache import blocks_needed
+
+        have = blocks_needed(start, self._block_size)
+        need = blocks_needed(stop, self._block_size)
+        for b in range(have, need):
+            self._tables[st.slot, b] = self._pool.alloc_block(st)
+
+    def propose_batch(self, rows, k):
+        """Draft up to ``k`` greedy continuation tokens for MANY
+        sequences in a constant number of jitted draft-side steps.
+        ``rows`` is ``[(seq_id, history), ...]``; returns
+        ``{seq_id: [tokens...]}`` (missing/empty where a row cannot be
+        drafted — at the draft model's sequence cap)."""
+        got = self.propose_tree_batch(
+            [(sid, hist, k) for sid, hist in rows], width=1)
+        return {sid: (ch[0] if ch else []) for sid, ch in got.items()}
+
+    def propose_tree_batch(self, rows, width):
+        """Tree drafting for MANY sequences in a constant number of
+        jitted steps. ``rows`` is ``[(seq_id, history, depth), ...]``;
+        returns ``{seq_id: [chain0, chain1, ...]}`` — chain 0 the fused
+        greedy scan (up to ``depth`` tokens), chains 1.. the top
+        ``width - 1`` alternate FIRST tokens from the same catch-up
+        logits (depth-1 branches: the cheap high-value part of the
+        tree, no extra device steps)."""
+        import jax.numpy as jnp
+        from .kv_cache import blocks_needed
+
+        width = int(width)
+        out = {sid: [] for sid, _h, _d in rows}
+        cfg = self.model.config
+        work = []
+        for sid, hist, depth in rows:
+            hist = [int(t) for t in hist]
+            depth = int(depth)
+            if depth < 1 or not hist or len(hist) >= cfg.max_seq_len:
+                continue
+            work.append((sid, hist,
+                         min(depth, cfg.max_seq_len - len(hist))))
+        if not work:
+            return out
+        max_depth = max(d for _s, _h, d in work)
+        if self._pool is None:
+            self.bind(len(work), max_depth)
+        # grow the binding when a call outruns it (direct/unit-test use;
+        # the engine binds its full geometry up front so this is a
+        # no-op there) — growing resets draft state, the next window
+        # simply re-prefills
+        new_ids = sum(1 for sid, _h, _d in work
+                      if sid not in self._states)
+        if (new_ids > len(self._free_slots)
+                or max_depth - 1 > self._n_new):
+            self.bind(max(self._max_batch,
+                          len(self._states) + new_ids),
+                      max(max_depth, self._n_new + 1))
+        B, Mb, chunk = self._max_batch, self._mb, self._chunk
+        weights = self.model.weights
+        pool = self._pool
+
+        # -- catch-up: feed history[n_cached:] through prefill chunks;
+        # each row's FINAL chunk's logits give draft token 1 (argmax)
+        # and the alternate branch roots (top width-1 runners-up)
+        states = {}
+        for sid, hist, depth in work:
+            st = self._state_for(sid)
+            if st.n_cached > len(hist):
+                # diverged/rolled-back history: rebuild from scratch
+                pool.truncate_owner(st, 0)
+                self._tables[st.slot, :] = 0
+                st.n_cached = 0
+            states[sid] = st
+        pstep = self.model.make_prefill_step(B, Mb, chunk,
+                                             return_logits=True)
+        final_logits = {}
+        while True:
+            feed = np.zeros((B, chunk), np.int32)
+            lengths = np.zeros(B, np.int32)
+            positions = np.zeros(B, np.int32)
+            active = np.zeros(B, bool)
+            finishing = []
+            for sid, hist, depth in work:
+                st = states[sid]
+                rem = len(hist) - st.n_cached
+                if rem <= 0:
+                    continue
+                n = min(chunk, rem)
+                feed[st.slot, :n] = hist[st.n_cached:st.n_cached + n]
+                lengths[st.slot] = n
+                positions[st.slot] = st.n_cached
+                active[st.slot] = True
+                self._alloc_span(st, st.n_cached, st.n_cached + n)
+                st.n_cached += n
+                if st.n_cached == len(hist):
+                    finishing.append(sid)
+            if not active.any():
+                break
+            k_arr, v_arr, _nt, logits = pstep(
+                weights, pool.k, pool.v, jnp.asarray(feed),
+                jnp.asarray(active), jnp.zeros((B,), jnp.int32),
+                jnp.asarray(positions), jnp.asarray(lengths),
+                jnp.asarray(self._tables), jnp.asarray(active))
+            pool.k, pool.v = k_arr, v_arr
+            self.draft_steps += 1
+            if finishing:
+                lg = np.asarray(logits)
+                for sid in finishing:
+                    final_logits[sid] = lg[states[sid].slot]
+
+        # -- branch roots from the final-chunk logits (stable argsort:
+        # order[0] is exactly np.argmax, the chain-0 first token)
+        first_tok = {}
+        alt_tok = {}
+        for sid, hist, depth in work:
+            order = np.argsort(-final_logits[sid], kind="stable")
+            first_tok[sid] = int(order[0])
+            alt_tok[sid] = [int(t) for t in order[1:width]]
+
+        # -- fused scan: draft chain-0 tokens 2..depth in ONE step.
+        # Rows whose remaining draft span would cross the draft cache
+        # cap ride inactive (their chain stays [d1]).
+        scan_toks = None
+        if self._n_new > 0 and any(d > 1 for _s, _h, d in work):
+            first = np.zeros(B, np.int32)
+            positions = np.zeros(B, np.int32)
+            active = np.zeros(B, bool)
+            for sid, hist, depth in work:
+                st = states[sid]
+                H = len(hist)
+                if depth < 2 or H + self._n_new > cfg.max_seq_len:
+                    continue
+                first[st.slot] = first_tok[sid]
+                positions[st.slot] = H
+                active[st.slot] = True
+                self._alloc_span(st, H, H + self._n_new)
+            if active.any():
+                dstep = self.model.make_draft_step(B, Mb, self._n_new)
+                k_arr, v_arr, toks = dstep(
+                    weights, pool.k, pool.v, jnp.asarray(first),
+                    jnp.asarray(positions), jnp.asarray(self._tables),
+                    jnp.asarray(active))
+                pool.k, pool.v = k_arr, v_arr
+                self.draft_steps += 1
+                scan_toks = np.asarray(toks)
+                scan_active = active
+            else:
+                scan_active = np.zeros(B, bool)
+        else:
+            scan_active = np.zeros(B, bool)
+
+        # -- assemble chains + roll draft KV back to the committed
+        # history (same truncate_owner contract as the target cache:
+        # reservation restored, freed table entries re-point to null)
+        for sid, hist, depth in work:
+            st = states[sid]
+            chain0 = [first_tok[sid]]
+            if scan_active[st.slot] and scan_toks is not None:
+                chain0 += [int(t) for t in scan_toks[st.slot]]
+            chains = [chain0[:depth]]
+            chains += [[t] for t in alt_tok[sid]]
+            out[sid] = chains
+            keep = blocks_needed(len(hist), self._block_size)
+            dropped = pool.truncate_owner(st, keep)
+            if dropped:
+                self._tables[st.slot, keep:keep + len(dropped)] = 0
+            st.n_cached = len(hist)
+        return out
 
 
 # ---------------------------------------------------------------------------

@@ -72,9 +72,50 @@ from ..observability import tracing as _tracing
 from .kv_cache import blocks_needed, prefix_chain_keys
 
 __all__ = ["AdmissionError", "DeadlineExceededError", "GenerationRequest",
-           "RequestQueue", "StepScheduler", "check_request_args"]
+           "RequestQueue", "StepScheduler", "check_request_args",
+           "spec_tree_acceptance"]
 
 _req_ids = itertools.count()
+
+
+def spec_tree_acceptance(window, outs, width):
+    """The pure host acceptance walk over ONE materialized tree verify
+    window (docs/SERVING.md tree speculation). ``window`` is the
+    level-order token window ``[root, level-1 slots..., ...]`` the
+    scheduler planned (``width`` chains per level); ``outs[j]`` is the
+    target's greedy token after window slot ``j``'s root path.
+
+    Each chain is walked independently: level ``l``'s slot is accepted
+    iff its token equals the target argmax after the previously
+    accepted slot (the root for ``l == 1``). The DEEPEST accepted root
+    path wins; ties resolve to the lowest chain index (at width 1 this
+    is bitwise the linear prefix walk — duplicate sibling tokens
+    produce identical argmax contexts, so the tie-break can never
+    change the emitted tokens). Returns ``(path_slots, emitted)``:
+    the winning path's window slots and its tokens plus the correction
+    token (the argmax at the accepted frontier) — every window emits
+    at least one sequential-greedy-identical token."""
+    width = int(width)
+    L = len(window)
+    if L <= 1:
+        return [], [int(outs[0])]
+    levels = (L - 1) // width
+    best_path = None
+    for c in range(width):
+        cur = 0
+        path = []
+        for lev in range(levels):
+            s = 1 + lev * width + c
+            if s >= L or int(window[s]) != int(outs[cur]):
+                break
+            path.append(s)
+            cur = s
+        if best_path is None or len(path) > len(best_path):
+            best_path = path
+    frontier = best_path[-1] if best_path else 0
+    emitted = ([int(window[s]) for s in best_path]
+               + [int(outs[frontier])])
+    return best_path, emitted
 
 
 class AdmissionError(RuntimeError):
@@ -257,7 +298,8 @@ class StepScheduler:
 
     def __init__(self, max_batch, pool, max_seq_len, prefill_chunk=0,
                  prefix_cache=False, prefill_token_budget=None,
-                 cache_namespace="", spec_k=0, drafter=None):
+                 cache_namespace="", spec_k=0, drafter=None,
+                 spec_tree=None):
         import numpy as np
 
         self.max_batch = int(max_batch)
@@ -289,20 +331,32 @@ class StepScheduler:
                 (self.max_batch, self.prefill_chunk), np.int32)
             self.chunk_lens = np.zeros(self.max_batch, np.int32)
         # -- speculative decoding (docs/SERVING.md; OFF = exact legacy)
+        from .model import parse_tree_shape
+
+        self.spec_tree = parse_tree_shape(spec_tree)
         self.spec_k = max(0, int(spec_k or 0))
+        if self.spec_tree and not self.spec_k:
+            # tree shape implies speculation: depth plays spec_k's role
+            # in every `if self.spec_k` gate
+            self.spec_k = self.spec_tree[1]
         self.drafter = drafter
         # host-side spec telemetry (live even with metrics disabled —
-        # engine.stats()/bench read these)
+        # engine.stats()/bench read these). In tree mode spec_proposed/
+        # spec_accepted count PATH DEPTH (deepest branch fed / accepted
+        # path length) so accept_rate keeps its per-chain meaning;
+        # spec_tree_slots counts every draft slot verified.
         self.spec_steps = 0
         self.spec_proposed = 0
         self.spec_accepted = 0
         self.spec_emitted = 0
         self.spec_blocks_rolled_back = 0
+        self.spec_tree_slots = 0
         # host-side deadline telemetry (live even with metrics disabled)
         self.deadline_expired = 0
         if self.spec_k:
-            self.spec_feed = np.zeros(
-                (self.max_batch, self.spec_k + 1), np.int32)
+            width = (1 + self.spec_tree[0] * self.spec_tree[1]
+                     if self.spec_tree else self.spec_k + 1)
+            self.spec_feed = np.zeros((self.max_batch, width), np.int32)
             self.spec_lens = np.zeros(self.max_batch, np.int32)
 
     # -- occupancy ------------------------------------------------------
@@ -322,6 +376,16 @@ class StepScheduler:
     def _budget_for(self, request):
         total = min(len(request.prompt) + request.max_new_tokens,
                     self.max_seq_len)
+        if self.spec_tree:
+            # tree windows write KV up to C - 1 = W*D slots past the
+            # committed end (rejected sibling branches at higher window
+            # offsets than the linear clamp ever reaches), so the
+            # admission reservation carries that overhang — a
+            # mid-flight window can then never exhaust the pool. The
+            # per-row depth clamp keeps every write < max_seq_len, so
+            # the cap here matches it.
+            total = min(total + self.spec_tree[0] * self.spec_tree[1],
+                        self.max_seq_len)
         return blocks_needed(total, self.pool.block_size)
 
     def admit(self, queue):
@@ -533,7 +597,29 @@ class StepScheduler:
                 continue
             if seq.pending or (not seq.dispatch_done and seq.in_prefill):
                 return None
+        if self.spec_tree:
+            return self._plan_spec_tree()
         bs = self.pool.block_size
+        # batched drafting: a drafter with propose_batch (the jitted
+        # ModelDrafter) drafts every row in a constant number of device
+        # steps before the per-row window assembly below
+        batch_drafts = None
+        if (self.drafter is not None
+                and hasattr(self.drafter, "propose_batch")):
+            rows = []
+            for seq in self.slots:
+                if seq is None or seq.dispatch_done:
+                    continue
+                request = seq.request
+                limit = min(self.spec_k + 1,
+                            request.max_new_tokens - len(request.tokens),
+                            self.max_seq_len - seq.pos)
+                if limit > 1:
+                    rows.append((request.id,
+                                 request.prompt + request.tokens))
+            if rows:
+                batch_drafts = self.drafter.propose_batch(
+                    rows, self.spec_k)
         plan = []
         for slot, seq in enumerate(self.slots):
             if seq is None or seq.dispatch_done:
@@ -556,13 +642,105 @@ class StepScheduler:
                         self.max_seq_len - pos)
             drafts = []
             if limit > 1 and self.drafter is not None:
-                drafts = [int(t) for t in
-                          self.drafter.propose(history, limit - 1)]
-                drafts = drafts[:limit - 1]
+                if batch_drafts is not None:
+                    drafts = batch_drafts.get(request.id, [])
+                elif hasattr(self.drafter, "propose_for"):
+                    # memoized n-gram path: identical tokens, O(k) host
+                    # cost per window via the per-sequence suffix index
+                    drafts = self.drafter.propose_for(
+                        request.id, history, limit - 1)
+                else:
+                    drafts = self.drafter.propose(history, limit - 1)
+                drafts = [int(t) for t in drafts][:limit - 1]
             window = [history[-1]] + drafts
             # lazy block allocation for EVERY boundary the window
             # crosses (drawn from the admission-time reservation; the
             # window clamp above keeps it within the worst case)
+            for p in range(pos, pos + len(window)):
+                if p % bs == 0:
+                    bid = self.pool.alloc_block(seq)
+                    self.block_tables[slot, p // bs] = bid
+            self.spec_feed[slot, :len(window)] = window
+            self.spec_lens[slot] = len(window)
+            self.positions[slot] = pos
+            self.use_prompt[slot] = True
+            self.active[slot] = True
+            seq.pending += 1
+            plan.append((seq, window))
+        if plan:
+            self.spec_steps += 1
+            _metrics.counter("serving/spec_steps").inc()
+        return plan
+
+    def _plan_spec_tree(self):
+        """Tree verify-window planning (docs/SERVING.md tree
+        speculation): each dispatching row feeds a LEVEL-ORDER token
+        tree ``[root, level-1 slots..., level-2 slots...]`` of up to
+        ``width`` chains and a per-row depth clamped so the emitted
+        path can never overshoot ``max_new_tokens`` and no window slot
+        can ever write at or past the sequence cap. Chains shorter than
+        the row's depth pad their missing slots with token 0 — sound
+        under verify-based acceptance (a pad is just a draft that will
+        not match the target argmax). Rows whose drafter proposes
+        nothing (or whose clamp hits 0) ride as 1-slot windows — plain
+        decode through the tree step, so tree mode is never slower in
+        steps than legacy. Returns the spec plan
+        ``[(seq, window_tokens), ...]``."""
+        bs = self.pool.block_size
+        W, D = self.spec_tree
+        rows = []
+        for slot, seq in enumerate(self.slots):
+            if seq is None or seq.dispatch_done:
+                self.active[slot] = False
+                self.use_prompt[slot] = False
+                self.spec_lens[slot] = 0
+                continue
+            request = seq.request
+            history = request.prompt + request.tokens
+            if seq.pos != len(history) - 1:
+                raise RuntimeError(
+                    "spec window planned at pos %d but the committed "
+                    "history holds %d tokens — a step result was lost"
+                    % (seq.pos, len(history)))
+            # depth clamp: path emission (depth + correction) within
+            # the max_new budget, every window slot (pos + 1 .. pos +
+            # W*d) strictly below the sequence cap
+            d = min(D, request.max_new_tokens - len(request.tokens) - 1,
+                    (self.max_seq_len - seq.pos - 1) // W)
+            rows.append((slot, seq, history, max(d, 0)))
+        # draft pass — batched when the drafter supports it (the jitted
+        # ModelDrafter), per-row tree/linear proposals otherwise
+        chains_by_slot = {}
+        drafter = self.drafter
+        need = [r for r in rows if r[3] > 0] if drafter is not None \
+            else []
+        if need and hasattr(drafter, "propose_tree_batch"):
+            got = drafter.propose_tree_batch(
+                [(seq.request.id, h, d) for _s, seq, h, d in need], W)
+            for slot, seq, _h, _d in need:
+                chains_by_slot[slot] = got.get(seq.request.id, [])
+        elif need and hasattr(drafter, "propose_tree"):
+            for slot, seq, h, d in need:
+                chains_by_slot[slot] = drafter.propose_tree(
+                    h, W, d, seq_id=seq.request.id)
+        elif need:
+            for slot, seq, h, d in need:
+                chains_by_slot[slot] = [list(drafter.propose(h, d))]
+        plan = []
+        for slot, seq, history, d in rows:
+            chains = [[int(t) for t in ch][:d]
+                      for ch in chains_by_slot.get(slot, [])][:W]
+            chains = [ch for ch in chains if ch]
+            d_used = max((len(ch) for ch in chains), default=0)
+            window = [history[-1]]
+            for lev in range(d_used):
+                for c in range(W):
+                    ch = chains[c] if c < len(chains) else []
+                    window.append(ch[lev] if lev < len(ch) else 0)
+            pos = seq.pos
+            # lazy block allocation for EVERY boundary the window
+            # crosses (drawn from the admission-time reservation — the
+            # _budget_for tree overhang covers the worst case)
             for p in range(pos, pos + len(window)):
                 if p % bs == 0:
                     bid = self.pool.alloc_block(seq)
@@ -603,6 +781,46 @@ class StepScheduler:
         _metrics.counter("serving/spec_proposed").inc(len(drafts))
         _metrics.counter("serving/spec_accepted").inc(m)
         _metrics.counter("serving/spec_rejected").inc(len(drafts) - m)
+        return self._emit_spec(seq, emitted)
+
+    def record_spec_tree(self, seq, window, path_slots, emitted):
+        """Fold one materialized TREE verify window back into its
+        sequence: the engine has already run the host acceptance walk
+        (:func:`spec_tree_acceptance` -> ``path_slots``, ``emitted``)
+        and compacted the accepted path's KV into the committed slot
+        layout, so this is the bookkeeping half — emission with the
+        same EOS/``max_new``/sequence-cap finality as ``record_spec``,
+        position advance, and reservation-restoring KV rollback of
+        every rejected branch. ``spec_proposed``/``spec_accepted``
+        count path DEPTH (deepest branch fed / accepted path length) so
+        the accept-rate gauge keeps its per-chain meaning;
+        ``spec_tree_slots`` counts every draft slot verified. Returns
+        the number of tokens emitted."""
+        seq.pending -= 1
+        if seq.finished:
+            return 0
+        W = self.spec_tree[0]
+        n_slots = len(window) - 1
+        depth_fed = n_slots // W            # full levels by construction
+        m = len(path_slots)
+        self.spec_proposed += depth_fed
+        self.spec_accepted += m
+        self.spec_tree_slots += n_slots
+        _metrics.counter("serving/spec_proposed").inc(depth_fed)
+        _metrics.counter("serving/spec_accepted").inc(m)
+        _metrics.counter("serving/spec_rejected").inc(depth_fed - m)
+        _metrics.counter("serving/spec_tree_slots").inc(n_slots)
+        return self._emit_spec(seq, emitted)
+
+    def _emit_spec(self, seq, emitted):
+        """The shared emission half of ``record_spec`` /
+        ``record_spec_tree``: emit the accepted run + correction token
+        in order (>= 1 token per window, truncated at EOS /
+        ``max_new_tokens`` / the sequence cap — no post-EOS token is
+        ever emitted), advance the sequence to its first unverified
+        position, and return the over-allocated KV blocks through
+        ``KVBlockPool.truncate_owner`` (rollback)."""
+        request = seq.request
         pos = seq.pos
         n_emit = 0
         for tok in emitted:
@@ -740,10 +958,17 @@ class StepScheduler:
                 seq.request._finish()
             if seq.finished:
                 self.pool.free_owner(seq)
+                self._release_draft_state(seq)
                 self.slots[slot] = None
                 self.active[slot] = False
                 freed += 1
         return freed
+
+    def _release_draft_state(self, seq):
+        """Drop the drafter's per-sequence state (draft KV blocks /
+        memoized suffix index) when its sequence retires."""
+        if self.drafter is not None and hasattr(self.drafter, "release"):
+            self.drafter.release(seq.request.id)
 
     def fail_all(self, error):
         """Engine-fatal path: deliver `error` to every occupied slot and
@@ -752,6 +977,7 @@ class StepScheduler:
             if seq is None:
                 continue
             self.pool.free_owner(seq)
+            self._release_draft_state(seq)
             if not seq.request.finished:
                 seq.request._finish(error)
                 _metrics.counter("serving/requests_failed").inc()

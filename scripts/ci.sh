@@ -591,7 +591,13 @@ do_serve() {
   # retried like the TTFT gate. Wall-clock tokens/s for the spec pair
   # is recorded but not gated: the CPU box pays the verify window's
   # full FLOPs, while on TPU the decode step is memory-bandwidth-bound
-  # and the step-count ratio is the real win (docs/SERVING.md).
+  # and the step-count ratio is the real win (docs/SERVING.md). The
+  # compounded legs (ISSUE 18): the tree + jitted-drafter leg must be
+  # token-identical with draft_steps > 0 and tokens-per-target-step >=
+  # the linear-k leg on EVERY attempt (ratio > 1.1 retried like TTFT);
+  # the int8-compounded leg token-identical to its dequantized
+  # reference; the engine's serving/spec_accept_rate gauge finite
+  # (NaN fails both bounds).
   local dump=/tmp/ptpu_serve_metrics.json legs=/tmp/ptpu_serve_legs.json
   local attempt rc=1
   for attempt in 1 2 3; do
@@ -609,24 +615,32 @@ do_serve() {
                    bench/serving_ttft_legacy_s \
                    bench/serving_spec_tokens_per_step \
                    bench/serving_spec_speedup \
+                   bench/serving_spec_tree_tokens_per_step \
+                   bench/serving_spec_tree_speedup \
+                   serving/spec_accept_rate \
       --assert-min serving/peak_batch_occupancy=2 \
                    serving/requests_completed=1 \
                    serving/prefix_blocks_reused=1 \
                    serving/prefill_chunk_steps=1 \
                    serving/spec_steps=1 \
+                   serving/spec_accept_rate=0 \
                    bench/serving_outputs_match=1 \
                    bench/serving_fastpath_outputs_match=1 \
                    bench/serving_prefix_hit_rate=0.1 \
                    bench/serving_spec_outputs_match=1 \
+                   bench/serving_spec_int8_outputs_match=1 \
                    bench/serving_spec_accept_rate=0.01 \
                    bench/serving_spec_tokens_per_step=1.05 \
+                   bench/serving_spec_tree_speedup=1 \
       --assert-max serving/request_latency_p99=120 \
-                   bench/serving_p99_latency_s=120
+                   bench/serving_p99_latency_s=120 \
+                   serving/spec_accept_rate=1
     set +e
     python tools/ptpu_stats.py "$dump" \
       --assert-min bench/serving_speedup_vs_serial=2 \
                    bench/serving_chunked_speedup=1.05 \
-                   bench/serving_spec_speedup=1.1
+                   bench/serving_spec_speedup=1.1 \
+                   bench/serving_spec_tree_speedup=1.1
     rc=$?
     set -e
     [ "$rc" -eq 0 ] && break
@@ -646,6 +660,12 @@ assert "serving_spec" in legs and "serving_spec_baseline" in legs, legs
 assert legs["serving_spec"]["outputs_match"], legs
 assert legs["serving_spec"]["accept_rate"] > 0, legs
 assert legs["serving_spec"]["tokens_per_step"] > 1, legs
+assert "serving_spec_tree" in legs and "serving_spec_int8" in legs, legs
+assert legs["serving_spec_tree"]["outputs_match"], legs
+assert legs["serving_spec_int8"]["outputs_match"], legs
+assert legs["serving_spec_tree"]["draft_steps"] > 0, legs
+assert (legs["serving_spec_tree"]["tokens_per_step"]
+        >= legs["serving_spec"]["tokens_per_step"]), legs
 print("serve stage ok:",
       {k: v["tokens_per_sec"] for k, v in legs.items()},
       "ttft chunked/legacy:",
@@ -748,6 +768,36 @@ for i, p in enumerate(prompts):
 for pool in pools:
     assert pool.check_invariants() == [], pool.check_invariants()
 assert spec_steps > 0, "spec engine never dispatched a verify window"
+# the compounded leg (ISSUE 18): TREE verify windows on int8 weight
+# stores for drafter AND target — the tree acceptance/commit/rollback
+# path and the drafter's own KV pool under the same tracker/jitter
+results = {}
+qmodel = model.quantized()
+with serving.ServingEngine(qmodel, max_batch=4, max_seq_len=64,
+                           block_size=4, prefill_chunk=4,
+                           prefix_cache=True, spec_tree="2x2",
+                           drafter=serving.ModelDrafter(qmodel)) as eng:
+    def client(lo, hi):
+        for i in range(lo, hi):
+            results[i] = eng.generate(prompts[i], max_new_tokens=8,
+                                      timeout=300)
+    threads = [threading.Thread(target=client, args=(i * 3, i * 3 + 3),
+                                name="race-tree-client-%d" % i)
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    tree_stats = eng.stats()["default"]
+    pools = [w.pool for w in eng._workers.values()]
+    dpool = eng._workers["default"].drafter._pool
+for i, p in enumerate(prompts):
+    assert results[i] == reference_decode(qmodel, p, 8), (i, results[i])
+for pool in pools:
+    assert pool.check_invariants() == [], pool.check_invariants()
+assert dpool.check_invariants() == [], dpool.check_invariants()
+assert tree_stats["spec_tree_slots"] > 0, tree_stats
+assert tree_stats["weight_only_int8"], tree_stats
 concurrency.assert_clean()
 concurrency.publish_metrics()
 print("race serve leg ok:", concurrency.stats())
@@ -758,6 +808,7 @@ PYEOF
                  serving/prefill_chunk_steps=1 \
                  serving/prefix_blocks_reused=1 \
                  serving/spec_steps=1 \
+                 serving/spec_tree_slots=1 \
     --assert-max concurrency/violations=0
   # Leg 2: the async-executor chaos leg — ResilientTrainer with an
   # injected NaN step, rollback + async checkpointing (the background
