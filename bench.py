@@ -751,6 +751,120 @@ def bench_serving_fleet(n_requests=16, max_new_tokens=16, max_batch=4,
     }
 
 
+def bench_serving_online(n_requests=24, max_new_tokens=12, vocab=64,
+                         max_seq_len=32, max_batch=4, block_size=4):
+    """Online hot-swap receipt (docs/SERVING.md "Online updates"): one
+    deterministic request set through a 2-replica fleet twice — once
+    steady-state, once with an ``OnlineUpdater`` publishing and rolling
+    a new weight version across the fleet mid-stream (drain -> swap ->
+    undrain, one replica at a time). The rollout leg's throughput ratio
+    is the measured cost of a live weight push; the functional gates are
+    absolute: zero requests lost, and every output token-identical to
+    ``reference_decode`` under the weight version that actually served
+    it (the router latches ``weight_version`` at dispatch, so the
+    mid-stream swap may never mix versions inside one request).
+
+    Returns per-leg tokens/s, the rollout/steady ratio, the version
+    ledger receipts, and the identity/loss gates."""
+    import os
+    import shutil
+    import tempfile
+    import threading
+
+    import paddle_tpu as fluid
+    from paddle_tpu import checkpoint as _ckpt
+    from paddle_tpu import inference, serving
+    from paddle_tpu.models import transformer_fluid
+
+    base = tempfile.mkdtemp(prefix="ptpu_bench_online_")
+    try:
+        prog, sprog = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, sprog):
+            transformer_fluid.build(vocab_size=vocab, d_model=16,
+                                    n_heads=2, n_layers=1, d_ff=32,
+                                    seq_len=8, remat=False)
+        scope = fluid.Scope()
+        fluid.Executor(fluid.CPUPlace()).run(sprog, scope=scope)
+        v0_dir = os.path.join(base, "v0")
+        inference.export_generation_model(v0_dir, prog, scope,
+                                          max_seq_len=max_seq_len)
+        ckpt_dir = os.path.join(base, "ckpts")
+        pub_dir = os.path.join(base, "pub")
+        os.makedirs(ckpt_dir)
+        rng = np.random.RandomState(31)
+        prompts = [rng.randint(0, vocab,
+                               size=int(rng.randint(3, 8))).tolist()
+                   for _ in range(n_requests)]
+        state = {}
+        for name, value in scope.items():
+            v = np.asarray(value)
+            if np.issubdtype(v.dtype, np.floating):
+                v = v + rng.normal(0, 0.02, v.shape).astype(v.dtype)
+            state[name] = v
+        with serving.ServingRouter(v0_dir, replicas=2,
+                                   max_batch=max_batch,
+                                   max_seq_len=max_seq_len,
+                                   block_size=block_size,
+                                   backoff_base=0.0,
+                                   health_interval_s=0.02) as router:
+            # canary_pct=None: unconditional rollout — the canary gate
+            # has its own receipt in ci.sh's online stage; this leg
+            # measures the swap machinery's throughput cost
+            upd = serving.OnlineUpdater(router, ckpt_dir, pub_dir, prog,
+                                        max_seq_len=max_seq_len,
+                                        canary_pct=None)
+            # primers: one per replica, concurrently, so the one-time
+            # XLA compile lands outside both measured windows
+            for p in [router.submit([1, 2], max_new_tokens=2)
+                      for _ in range(2)]:
+                p.wait(600)
+
+            def run_leg(rollout_mid_stream):
+                t0 = time.perf_counter()
+                reqs = [router.submit(p, max_new_tokens=max_new_tokens)
+                        for p in prompts]
+                roll = None
+                if rollout_mid_stream:
+                    roll = threading.Thread(target=upd.poll_once,
+                                            name="bench-online-rollout")
+                    roll.start()
+                outs = [r.wait(600) for r in reqs]
+                wall = time.perf_counter() - t0
+                if roll is not None:
+                    roll.join()
+                return (outs, [r.weight_version for r in reqs], wall)
+
+            steady_outs, steady_vers, steady_wall = run_leg(False)
+            _ckpt.save_checkpoint(ckpt_dir, state, 1)
+            roll_outs, roll_vers, roll_wall = run_leg(True)
+            st = router.stats()
+        models = {0: inference.load_generation_model(v0_dir),
+                  1: inference.load_generation_model(
+                      os.path.join(pub_dir, "v1"))}
+        match = all(
+            o == serving.reference_decode(models[v], p, max_new_tokens)
+            for o, v, p in zip(steady_outs + roll_outs,
+                               steady_vers + roll_vers,
+                               prompts + prompts))
+        steady_tps = sum(len(o) for o in steady_outs) / steady_wall
+        roll_tps = sum(len(o) for o in roll_outs) / roll_wall
+        return {
+            "steady_tokens_per_sec": steady_tps,
+            "rollout_tokens_per_sec": roll_tps,
+            "rollout_throughput_ratio": roll_tps / steady_tps,
+            "outputs_match": match,
+            "requests_lost": (st["requests_submitted"]
+                              - st["requests_completed"]
+                              - st["requests_failed"]),
+            "versions_published": upd.versions_published,
+            "swaps": upd.swaps,
+            "final_versions": sorted(
+                r["weight_version"] for r in st["replicas"]),
+        }
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def bench_zero(steps=16, warmup=4, repeats=3, depth=4, width=256,
                batch=64, bucket_mb=0.5):
     """ZeRO ladder + comm/compute overlap receipt (docs/ZERO.md) on the
@@ -1160,6 +1274,11 @@ def main(argv=None):
                     help="run only the serving-fleet scaling pair "
                          "(1-replica vs 2-replica ServingRouter, the "
                          "CI fleet stage configuration)")
+    ap.add_argument("--online-only", action="store_true",
+                    help="run only the online weight-hot-swap leg pair "
+                         "(steady-state vs mid-stream rollout through "
+                         "an OnlineUpdater, the CI online stage "
+                         "configuration)")
     ap.add_argument("--zero-only", action="store_true",
                     help="run only the ZeRO/overlap ladder on the "
                          "8-device CPU mesh (the CI zero stage "
@@ -1251,6 +1370,57 @@ def main(argv=None):
             "records_per_sec_degraded": round(
                 res["degraded_records_per_sec"], 1),
             "records_lost": res["records_lost"],
+        }))
+        return
+
+    if args.online_only:
+        res = bench_serving_online()
+        if args.metrics_out:
+            from paddle_tpu.observability import metrics as obs_metrics
+
+            reg = obs_metrics.registry()
+            reg.gauge("bench/online_tokens_per_sec_steady").set(
+                res["steady_tokens_per_sec"])
+            reg.gauge("bench/online_tokens_per_sec_rollout").set(
+                res["rollout_tokens_per_sec"])
+            reg.gauge("bench/online_rollout_throughput_ratio").set(
+                res["rollout_throughput_ratio"])
+            reg.gauge("bench/online_outputs_match").set(
+                1.0 if res["outputs_match"] else 0.0)
+            reg.gauge("bench/online_requests_lost").set(
+                res["requests_lost"])
+            reg.gauge("bench/online_versions_published").set(
+                res["versions_published"])
+            reg.gauge("bench/online_swaps").set(res["swaps"])
+            reg.dump_json(args.metrics_out)
+        if args.legs_out:
+            with open(args.legs_out, "w") as f:
+                json.dump([
+                    {"leg": "online_steady",
+                     "tokens_per_sec": round(
+                         res["steady_tokens_per_sec"], 1),
+                     "outputs_match": bool(res["outputs_match"])},
+                    {"leg": "online_rollout",
+                     "tokens_per_sec": round(
+                         res["rollout_tokens_per_sec"], 1),
+                     "outputs_match": bool(res["outputs_match"]),
+                     "online_rollout_throughput_ratio": round(
+                         res["rollout_throughput_ratio"], 4),
+                     "requests_lost": res["requests_lost"],
+                     "swaps": res["swaps"],
+                     "final_versions": res["final_versions"]},
+                ], f, indent=2)
+        print(json.dumps({
+            "metric": "online_rollout_throughput_ratio",
+            "value": round(res["rollout_throughput_ratio"], 4),
+            "unit": "x (mid-rollout / steady-state serving tokens/s)",
+            "tokens_per_sec_steady": round(
+                res["steady_tokens_per_sec"], 1),
+            "tokens_per_sec_rollout": round(
+                res["rollout_tokens_per_sec"], 1),
+            "outputs_match": res["outputs_match"],
+            "requests_lost": res["requests_lost"],
+            "versions_published": res["versions_published"],
         }))
         return
 

@@ -39,7 +39,7 @@ from .observability import metrics as _metrics
 from .observability import tracing as _tracing
 
 __all__ = ["save_checkpoint", "restore_checkpoint", "latest_checkpoint",
-           "all_checkpoints", "CheckpointManager",
+           "all_checkpoints", "checkpoints_after", "CheckpointManager",
            "CheckpointCorruptionError", "MANIFEST_NAME"]
 
 MANIFEST_NAME = "ptpu_manifest.json"
@@ -294,6 +294,19 @@ def all_checkpoints(directory):
     """Intact (manifest present, file inventory passing) step numbers
     under directory, ascending."""
     return sorted(step for step, _ in _scan_steps(directory))
+
+
+def checkpoints_after(directory, step):
+    """Intact step numbers strictly newer than ``step`` (None = all),
+    ascending — the OnlineUpdater's poll primitive: a live trainer's
+    async saves become visible here only once their manifest landed, so
+    each step is an export candidate exactly once and a save still in
+    flight is never exported torn."""
+    steps = all_checkpoints(directory)
+    if step is None:
+        return steps
+    step = int(step)
+    return [s for s in steps if s > step]
 
 
 def latest_checkpoint(directory):

@@ -288,6 +288,15 @@ _declare("PTPU_SERVE_RETRY_BUDGET", "int", 3,
          "re-admission attempts the ServingRouter may spend per "
          "request when its replica fails over (exponential backoff; "
          "RetryBudgetExceededError when spent)")
+_declare("PTPU_SERVE_CANARY_PCT", "float", None,
+         "percentage of new requests the ServingRouter pins to the "
+         "canary replica while an OnlineUpdater rollout is in its "
+         "canary phase (docs/SERVING.md \"Online updates\"; unset = "
+         "no canary gate, router/engine stay bitwise-legacy)")
+_declare("PTPU_ONLINE_POLL_S", "float", 0.25,
+         "OnlineUpdater checkpoint-directory poll interval in seconds "
+         "(the cadence at which a live trainer's newly landed intact "
+         "checkpoints are discovered and exported)")
 # -- concurrency analysis (docs/STATIC_ANALYSIS.md) -------------------------
 _declare("PTPU_LOCK_CHECK", "bool", False,
          "route the runtime's named lock sites through tracked "

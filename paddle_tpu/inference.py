@@ -423,8 +423,14 @@ def export_generation_model(dirname, program, scope=None,
     """Export a program built by ``models.transformer_fluid.build``
     (remat=False, dropout_rate=0) as a generation-serving artifact:
     ``__generation__.npz`` (fp32 decoder weights in the serving layout)
-    plus ``__generation_meta__.json`` (the GenerationConfig). Serve it
-    with ``paddle_tpu.serving.ServingEngine(dirname)`` (or
+    plus ``__generation_meta__.json`` (the GenerationConfig) and
+    ``__generation_manifest__.json`` (per-weight sha256 digests). The
+    publish is ATOMIC (tmp + rename, manifest written last): a reader
+    sees either the complete artifact or the previous one, and a torn
+    write is detected by ``verify_generation_artifact`` — the
+    OnlineUpdater's publish leg (docs/SERVING.md "Online updates")
+    leans on exactly this. Serve it with
+    ``paddle_tpu.serving.ServingEngine(dirname)`` (or
     ``load_generation_model``). Returns the GenerationConfig."""
     from .core.scope import global_scope
     from .serving import model as _serving_model

@@ -63,7 +63,8 @@ __all__ = [
     "RetryBudgetExceededError", "InjectedTransientError",
     "InjectedReplicaDeathError", "maybe_inject_serve_fault",
     "InjectedPeerDeathError", "maybe_inject_peer_death",
-    "maybe_inject_shard_fault",
+    "maybe_inject_shard_fault", "maybe_inject_swap_death",
+    "maybe_inject_canary_anomaly",
     "is_transient_error", "FaultInjector", "global_injector",
     "set_global_injector", "PreemptionGuard", "ScopeSnapshot",
     "snapshot_scope", "restore_scope_snapshot", "TrainResult",
@@ -263,13 +264,33 @@ class FaultInjector:
                                 the rank-K worker dies at the top of
                                 `exchange_samples` — survivors must
                                 confirm the loss and re-partition
+
+    Online-update sites (docs/SERVING.md "Online updates"): the weight
+    hot-swap plane's chaos matrix. ``canary_anomaly_at_version`` keys
+    on the rollout's weight-version number; the other two are
+    occurrence-keyed:
+
+      ckpt_torn_export:K        corrupt the K-th published generation
+                                artifact after it lands (a torn export
+                                the artifact digest manifest must
+                                catch — the rollout skips it)
+      swap_die_mid_drain:K      kill the draining replica during the
+                                K-th rollout drain (survivors must
+                                re-admit its requests; the rollout
+                                resumes past the corpse)
+      canary_anomaly_at_version:N
+                                the canary gate reports an anomaly for
+                                weight version N — the structured-
+                                rollback path runs deterministically
     """
 
     STEP_SITES = ("nan_at_step", "sigterm_at_step", "transient_at_step",
                   "serve_die_at_step", "serve_transient_at_step",
                   "serve_stall_at_step", "data_corrupt_shard",
-                  "data_stall_shard", "data_peer_die_at_exchange")
-    OCCURRENCE_SITES = ("transient_compile", "ckpt_torn_write")
+                  "data_stall_shard", "data_peer_die_at_exchange",
+                  "canary_anomaly_at_version")
+    OCCURRENCE_SITES = ("transient_compile", "ckpt_torn_write",
+                        "ckpt_torn_export", "swap_die_mid_drain")
 
     def __init__(self, spec=None):
         from .analysis.concurrency import make_lock
@@ -404,6 +425,27 @@ def maybe_inject_serve_fault(step):
     if inj.fire_at_step("serve_stall_at_step", step):
         return "stall"
     return None
+
+
+def maybe_inject_swap_death():
+    """OnlineUpdater drain hook (docs/SERVING.md "Online updates"):
+    True when the `swap_die_mid_drain` site fires — the updater then
+    kills the draining replica instead of swapping it, modelling a
+    host lost mid-rollout (the router's watchdog must re-admit its
+    in-flight requests on survivors and the rollout must resume past
+    the corpse)."""
+    inj = global_injector()
+    return inj.active() and inj.fire_occurrence("swap_die_mid_drain")
+
+
+def maybe_inject_canary_anomaly(version):
+    """Canary-gate hook (docs/SERVING.md "Online updates"): True when
+    the `canary_anomaly_at_version` site is armed for this weight
+    version — the gate reports a (structured, injected) anomaly and
+    the updater's rollback path runs deterministically in CI."""
+    inj = global_injector()
+    return inj.active() and inj.fire_at_step("canary_anomaly_at_version",
+                                             version)
 
 
 class InjectedPeerDeathError(RuntimeError):

@@ -26,11 +26,14 @@ from .engine import ServingEngine  # noqa: F401
 from .kv_cache import (KVBlockPool, blocks_needed,  # noqa: F401
                        prefix_chain_keys)
 from .loadgen import PoissonLoadGenerator  # noqa: F401
-from .model import (GenerationConfig, GenerationModel,  # noqa: F401
+from .model import (GenerationArtifactError,  # noqa: F401
+                    GenerationConfig, GenerationModel,
                     ModelDrafter, NGramDrafter,
                     extract_decoder_weights, load_generation_artifact,
                     parse_tree_shape, random_weights, reference_decode,
-                    save_generation_artifact, tree_topology)
+                    save_generation_artifact, tree_topology,
+                    verify_generation_artifact)
+from .online import CanaryGate, OnlineUpdater  # noqa: F401
 from .router import RouterRequest, ServingRouter  # noqa: F401
 from .scheduler import (AdmissionError,  # noqa: F401
                         DeadlineExceededError, GenerationRequest,
@@ -40,10 +43,12 @@ from .scheduler import (AdmissionError,  # noqa: F401
 __all__ = ["ServingEngine", "ServingRouter", "RouterRequest",
            "KVBlockPool", "blocks_needed", "prefix_chain_keys",
            "PoissonLoadGenerator", "GenerationConfig", "GenerationModel",
-           "ModelDrafter", "NGramDrafter",
+           "GenerationArtifactError", "ModelDrafter", "NGramDrafter",
            "extract_decoder_weights", "load_generation_artifact",
            "parse_tree_shape", "random_weights", "reference_decode",
            "save_generation_artifact", "tree_topology",
+           "verify_generation_artifact",
+           "OnlineUpdater", "CanaryGate",
            "spec_tree_acceptance", "AdmissionError",
            "DeadlineExceededError", "GenerationRequest", "RequestQueue",
            "StepScheduler"]

@@ -37,8 +37,13 @@ bounded; **radix prefix caching** (``prefix_cache`` /
 ``$PTPU_SERVE_PREFIX_CACHE``) content-addresses the KV pool so requests
 sharing a prompt prefix skip its prefill compute and block allocations.
 Prefix reuse assumes the weights that computed the cached KV state:
-hot-swapping a model's scope should be followed by
-``pool.flush_prefix_cache()``.
+weight hot-swaps go through :meth:`ServingEngine.swap_weights`, the ONE
+atomic entry point — the worker pauses admission, drains its active
+batch to a clean step boundary, then installs the new weights and
+flushes the prefix cache in the same critical section under the worker
+cv, so stale-prefix tokens can never leak across a swap and every
+request's tokens come from exactly one weight version
+(docs/SERVING.md "Online updates").
 
 The third opt-in leg is **speculative decoding** (``spec_k`` /
 ``$PTPU_SERVE_SPEC_K``, 0 = off and bitwise-legacy): when every row is
@@ -204,6 +209,12 @@ class _ModelWorker:
         self._lock_check = _conc.tracking_enabled()
         self._closing = False
         self.error = None
+        # online-update surface (docs/SERVING.md "Online updates"): a
+        # pending swap pauses admission; the worker applies it at the
+        # first step boundary with no active or in-flight sequences,
+        # so no request's tokens ever span two weight versions
+        self.weight_version = 0
+        self._pending_swap = None  # [weights, version, event, result]
         # failover surface (docs/SERVING.md "Fleet & failover"): abort()
         # injects a fatal error at the next step boundary (or into an
         # injected stall) so a router-declared-dead replica drains its
@@ -273,6 +284,7 @@ class _ModelWorker:
                 with self._cv:
                     while (self._abort_error is None
                            and not self._closing
+                           and self._pending_swap is None
                            and not len(self.queue)
                            and not self.scheduler.has_work()
                            and not self._inflight):
@@ -282,9 +294,20 @@ class _ModelWorker:
                             and not len(self.queue)
                             and not self.scheduler.has_work()
                             and not self._inflight):
+                        self._fail_pending_swap(RuntimeError(
+                            "ServingEngine closed with a weight swap "
+                            "pending"))
                         return
                 if abort is not None:
                     raise abort
+                if (self._pending_swap is not None
+                        and not self.scheduler.has_work()
+                        and not self._inflight):
+                    # clean step boundary, batch drained: install the
+                    # new weights and flush the prefix cache in ONE
+                    # critical section, then resume admission
+                    self._apply_swap()
+                    continue
                 try:
                     self._tick()
                     self._consec_transient = 0
@@ -315,6 +338,42 @@ class _ModelWorker:
             # never feed a queue nobody will pop
             self._die(e)
 
+    def _fail_pending_swap(self, error):
+        """Deliver a never-applied swap's failure to its waiter (cv
+        held by the caller): death and close must not strand a
+        swap_weights() caller on its event forever."""
+        if self._pending_swap is None:
+            return
+        swap = self._pending_swap
+        self._pending_swap = None
+        swap[3]["error"] = error
+        swap[2].set()
+
+    def _apply_swap(self):
+        """Install a pending weight swap at a clean step boundary (no
+        active or in-flight sequences — _run checked): new weights and
+        the prefix-cache flush land in ONE cv critical section, so no
+        step can read swapped weights against a stale prefix index and
+        no token is ever computed by a half-installed weight set."""
+        import jax.numpy as jnp
+
+        with self._cv:
+            if self._pending_swap is None:
+                return
+            weights, version, done, result = self._pending_swap
+            self._pending_swap = None
+            for wname in self._weight_names:
+                self.scope.set(wname, jnp.asarray(weights[wname]))
+            flushed = self.pool.flush_prefix_cache()
+            self.weight_version = version
+            result["applied"] = True
+            result["flushed"] = flushed
+            done.set()
+        _metrics.counter("online/swaps").inc()
+        _blackbox.record_event("weight_swap", model=self.name,
+                               version=version, flushed=flushed,
+                               step=self._steps_dispatched)
+
     def _die(self, e):
         """Replica death: error latch + fail_all + queue drain run under
         the cv lock so they are atomic with submit()'s liveness check
@@ -322,6 +381,7 @@ class _ModelWorker:
         latch)."""
         with self._cv:
             self.error = e
+            self._fail_pending_swap(e)
             self.scheduler.fail_all(e)
             while True:
                 req = self.queue.pop()
@@ -366,7 +426,11 @@ class _ModelWorker:
         sched = self.scheduler
         if self._track_deadlines:
             sched.expire_deadlines(self.queue)
-        sched.admit(self.queue)
+        if self._pending_swap is None:
+            # a pending swap pauses admission so the active batch
+            # drains to the clean boundary the swap needs; queued
+            # requests wait and are served wholly on the new weights
+            sched.admit(self.queue)
         _metrics.gauge("serving/queue_depth").set(len(self.queue))
         self._tick_retryable = False
         spec_plan = sched.plan_spec() if self.spec_k else None
@@ -688,6 +752,47 @@ class _ModelWorker:
         self._thread.join(timeout)
 
 
+def _resolve_swap_weights(source, worker):
+    """Coerce a swap source (GenerationModel | Scope | dict | artifact
+    dir) into the worker's weight layout, validated name-by-name
+    against the served geometry — the compiled steps are weight-shape-
+    keyed, so a swap can never change geometry, only values. Artifact
+    dirs are digest-verified on load (a torn export never serves); an
+    fp32 source is re-quantized when the worker serves the int8
+    store."""
+    if isinstance(source, str):
+        source = load_generation_artifact(source, name=worker.name)
+    if isinstance(source, GenerationModel):
+        if worker.model.weight_only_int8 and not source.weight_only_int8:
+            source = source.quantized()
+        weights = dict(source.weights)
+    elif isinstance(source, Scope):
+        weights = {n: source.get(n) for n in worker._weight_names}
+    elif isinstance(source, dict):
+        weights = source
+    else:
+        raise TypeError(
+            "swap_weights wants a GenerationModel, Scope, weight dict "
+            "or artifact directory, got %r" % (type(source).__name__,))
+    out = {}
+    for n in worker._weight_names:
+        val = weights.get(n)
+        if val is None:
+            raise ValueError(
+                "swap_weights: source has no weight %r for model %r "
+                "(same-architecture weights required)"
+                % (n, worker.name))
+        cur = worker.scope.get(n)
+        if cur is not None and np.shape(val) != np.shape(cur):
+            raise ValueError(
+                "swap_weights: weight %r shape %s != served shape %s "
+                "for model %r — the compiled steps are weight-shape-"
+                "keyed, so a swap cannot change geometry"
+                % (n, np.shape(val), np.shape(cur), worker.name))
+        out[n] = val
+    return out
+
+
 class ServingEngine:
     """Multi-model generation service (see module docstring).
 
@@ -769,6 +874,86 @@ class ServingEngine:
     def model_scope(self, model=None):
         """The named model's isolated weight scope."""
         return self._workers[model or self._default].scope
+
+    def weight_version(self, model=None):
+        """The named model's current weight version: 0 for the weights
+        the engine was built with, bumped by every applied
+        :meth:`swap_weights` (or set to that call's explicit
+        ``version``). The version a request's tokens are attributable
+        to (docs/SERVING.md \"Online updates\")."""
+        return self._workers[model or self._default].weight_version
+
+    def export_weights(self, model=None):
+        """Host-side copy of the named model's CURRENTLY-served weights,
+        keyed by canonical weight name — what an
+        :class:`~paddle_tpu.serving.online.OnlineUpdater` captures as
+        the incumbent source so a canary rollback has something
+        concrete to swap back to. Taken under the worker cv so it can
+        never observe a half-applied swap."""
+        w = self._workers[model or self._default]
+        with w._cv:
+            return {n: np.asarray(w.scope.get(n)) for n in w._weight_names}
+
+    def swap_weights(self, scope_or_artifact, model=None, version=None,
+                     timeout=30.0):
+        """Atomically hot-swap the named model's served weights — the
+        ONE entry point replacing the old "hot-swap then call
+        flush_prefix_cache()" comment contract with enforced behavior.
+
+        ``scope_or_artifact`` is a :class:`GenerationModel`, a weight
+        :class:`~paddle_tpu.core.scope.Scope`, a ``{name: array}``
+        dict, or an exported artifact directory (digest-verified on
+        load — a torn export raises
+        :class:`~paddle_tpu.serving.GenerationArtifactError` and is
+        never served). The worker pauses admission, drains its active
+        batch to a clean step boundary, then installs the weights AND
+        flushes the prefix cache in one critical section under the
+        worker cv: stale-prefix tokens can never leak across the swap,
+        and no request's tokens span two weight versions (queued
+        requests wait and are served wholly on the new weights).
+
+        Returns the new weight version (``version`` or the old
+        version + 1). Raises ``TimeoutError`` if the batch does not
+        drain within ``timeout`` seconds (the swap is cancelled), and
+        ``RuntimeError`` if the worker dies first."""
+        if self._closed:
+            raise RuntimeError("ServingEngine is closed")
+        name = model or self._default
+        if name not in self._workers:
+            raise KeyError("unknown model %r (have %r)"
+                           % (name, list(self._workers)))
+        w = self._workers[name]
+        weights = _resolve_swap_weights(scope_or_artifact, w)
+        done = threading.Event()
+        result = {"applied": False, "error": None, "flushed": 0}
+        with w._cv:
+            if w.error is not None:
+                raise RuntimeError("serving worker %r died: %r"
+                                   % (name, w.error))
+            if w._pending_swap is not None:
+                raise RuntimeError(
+                    "model %r already has a weight swap pending" % name)
+            if version is None:
+                version = w.weight_version + 1
+            entry = [weights, int(version), done, result]
+            w._pending_swap = entry
+            w._cv.notify_all()
+        if not done.wait(timeout):
+            with w._cv:
+                if w._pending_swap is entry:
+                    w._pending_swap = None
+                    raise TimeoutError(
+                        "swap_weights for model %r not applied within "
+                        "%.1fs (active batch still draining) — swap "
+                        "cancelled" % (name, timeout))
+            # lost the race: the worker picked it up while we timed
+            # out — the event lands momentarily on either outcome
+            done.wait(timeout)
+        if not result["applied"]:
+            raise RuntimeError(
+                "serving worker %r failed before applying the swap: %r"
+                % (name, result["error"]))
+        return int(version)
 
     def submit(self, prompt, max_new_tokens=32, eos_id=None, stream=None,
                model=None, deadline_s=None):
@@ -903,6 +1088,7 @@ class ServingEngine:
                                      / max(1, sched.spec_proposed)),
                 "spec_draft_steps": getattr(w.drafter, "draft_steps",
                                             0) if w.drafter else 0,
+                "weight_version": w.weight_version,
                 "weight_only_int8": w.model.weight_only_int8,
                 "weight_store": _weight_store_bytes(w.model.weights),
                 "deadline_expired": sched.deadline_expired,

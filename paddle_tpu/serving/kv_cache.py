@@ -505,15 +505,21 @@ class KVBlockPool:
             return out
 
     def flush_prefix_cache(self):
-        """Drop the whole content index (e.g. after a weight hot-swap —
-        cached KV state is only valid for the weights that computed it).
-        Referenced blocks stay in their owners' tables but lose their
-        index entry; cached blocks return to the free list. Returns the
-        number of index entries dropped."""
+        """Drop the whole content index (after a weight hot-swap —
+        cached KV state is only valid for the weights that computed it;
+        ``ServingEngine.swap_weights`` calls this in the same critical
+        section that installs the new weights, so a stale prefix can
+        never serve a post-swap request). Referenced blocks stay in
+        their owners' tables but lose their index entry; cached blocks
+        return to the free list. Returns the number of index entries
+        dropped."""
+        from ..observability import metrics as _metrics
+
         with self._lock:
             dropped = len(self._sealed)
             self._free.extend(self._cached)
             self._cached.clear()
             self._sealed.clear()
             self._block_key.clear()
-            return dropped
+        _metrics.counter("serving/prefix_cache_flushes").inc()
+        return dropped

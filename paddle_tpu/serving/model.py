@@ -53,14 +53,16 @@ reusing :class:`GenerationModel`).
 
 import math
 import time
+from zipfile import BadZipFile as zipfile_BadZipFile
 
 import numpy as np
 
-__all__ = ["GenerationConfig", "GenerationModel", "ModelDrafter",
+__all__ = ["GenerationArtifactError", "GenerationConfig",
+           "GenerationModel", "ModelDrafter",
            "NGramDrafter", "extract_decoder_weights",
            "parse_tree_shape", "random_weights", "reference_decode",
            "save_generation_artifact", "load_generation_artifact",
-           "tree_topology"]
+           "verify_generation_artifact", "tree_topology"]
 
 
 def parse_tree_shape(spec):
@@ -123,9 +125,13 @@ def tree_topology(width, depth):
 # serving-artifact file names (written by
 # inference.export_generation_model next to the one-shot
 # __serving__/__serving_native__ artifacts so native_serve and the
-# continuous-batching engine deploy from ONE directory)
+# continuous-batching engine deploy from ONE directory). The manifest
+# (per-leaf sha256 digests + file-size inventory, written LAST) is the
+# publish marker the atomic tmp+rename export leaves behind — a torn
+# export is detected by the loader, never served.
 GENERATION_WEIGHTS = "__generation__.npz"
 GENERATION_META = "__generation_meta__.json"
+GENERATION_MANIFEST = "__generation_manifest__.json"
 
 
 def _kernel_key_suffix():
@@ -353,26 +359,183 @@ def extract_decoder_weights(program, scope, max_seq_len=None):
 # ---------------------------------------------------------------------------
 
 
+class GenerationArtifactError(RuntimeError):
+    """A generation artifact failed digest/inventory verification — a
+    torn export (crash mid-write, injected `ckpt_torn_export`). The
+    message names the artifact directory and the first mismatch, so
+    the rollout ledger and the operator see the same structured
+    story."""
+
+    def __init__(self, dirname, reason):
+        self.dirname = dirname
+        self.reason = reason
+        super().__init__(
+            "generation artifact %s is torn or corrupt: %s — "
+            "re-export it (inference.export_generation_model); it must "
+            "never be served" % (dirname, reason))
+
+
+def _weight_digest(arr):
+    """sha256 over dtype + shape + host bytes (the checkpoint.py leaf
+    digest, specialized to the flat fp32 serving layout)."""
+    import hashlib
+
+    arr = np.ascontiguousarray(arr)
+    h = hashlib.sha256()
+    h.update(str(arr.dtype).encode())
+    h.update(repr(arr.shape).encode())
+    h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def _fsync_file(path):
+    import os
+
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path):
+    import os
+
+    try:
+        _fsync_file(path)
+    except OSError:
+        pass  # fsync on a dir is best-effort (not all filesystems)
+
+
+def _maybe_tear_export(dirname):
+    """`ckpt_torn_export` fault injection: after a publish lands,
+    truncation-corrupt the weights payload in place — the torn export
+    the digest manifest exists to catch (the checkpoint.py
+    `ckpt_torn_write` pattern, at the serving-artifact layer)."""
+    import os
+
+    from ..resilience import global_injector
+
+    if not global_injector().fire_occurrence("ckpt_torn_export"):
+        return
+    path = os.path.join(dirname, GENERATION_WEIGHTS)
+    with open(path, "r+b") as f:
+        data = f.read()
+        if not data:
+            return
+        f.seek(0)
+        f.write(bytes(b ^ 0xFF for b in data[: max(1, len(data) // 2)]))
+        f.truncate(max(1, len(data) // 2))
+
+
 def save_generation_artifact(dirname, config, weights):
-    """Write the generation-serving artifact: one STORED npz of fp32
-    weights plus a json config. Returns the npz path."""
+    """Atomically publish the generation-serving artifact: one STORED
+    npz of fp32 weights, a json config, and a digest manifest
+    (per-weight sha256 + file-size inventory). Everything lands in a
+    temp dir first; a fresh ``dirname`` is published by ONE rename,
+    an existing one by per-file replaces with the manifest LAST (the
+    completeness marker a crash mid-export never writes). Returns the
+    npz path."""
+    import json
+    import os
+    import shutil
+
+    dirname = os.path.abspath(dirname)
+    parent = os.path.dirname(dirname) or "."
+    os.makedirs(parent, exist_ok=True)
+    tmp = os.path.join(parent,
+                       ".ptpu_tmp_" + os.path.basename(dirname))
+    shutil.rmtree(tmp, ignore_errors=True)
+    os.makedirs(tmp)
+    weights = {k: np.asarray(v, np.float32) for k, v in weights.items()}
+    np.savez(os.path.join(tmp, GENERATION_WEIGHTS), **weights)
+    with open(os.path.join(tmp, GENERATION_META), "w") as f:
+        json.dump(config.to_dict(), f, indent=2, sort_keys=True)
+    manifest = {
+        "format": 1,
+        "digests": {k: _weight_digest(v) for k, v in weights.items()},
+        "files": {n: os.path.getsize(os.path.join(tmp, n))
+                  for n in (GENERATION_WEIGHTS, GENERATION_META)},
+    }
+    with open(os.path.join(tmp, GENERATION_MANIFEST), "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    for n in (GENERATION_WEIGHTS, GENERATION_META):
+        _fsync_file(os.path.join(tmp, n))
+    if not os.path.exists(dirname):
+        os.rename(tmp, dirname)
+    else:
+        # the directory already holds other artifacts (__serving__,
+        # a prior generation export): replace per file, payloads
+        # before the manifest — a crash in between leaves a digest
+        # mismatch the loader reports, never a silently-torn read
+        stale = os.path.join(dirname, GENERATION_MANIFEST)
+        if os.path.exists(stale):
+            os.remove(stale)
+        for n in (GENERATION_WEIGHTS, GENERATION_META,
+                  GENERATION_MANIFEST):
+            os.replace(os.path.join(tmp, n), os.path.join(dirname, n))
+        shutil.rmtree(tmp, ignore_errors=True)
+    _fsync_dir(dirname)
+    _fsync_dir(parent)
+    _maybe_tear_export(dirname)
+    return os.path.join(dirname, GENERATION_WEIGHTS)
+
+
+def verify_generation_artifact(dirname):
+    """Verify an exported artifact against its digest manifest: file
+    inventory sizes plus per-weight sha256 over the loaded arrays.
+    Raises :class:`GenerationArtifactError` naming the artifact on any
+    mismatch. Returns True when verified, False for a legacy artifact
+    with no manifest (nothing to verify against)."""
     import json
     import os
 
-    os.makedirs(dirname, exist_ok=True)
-    path = os.path.join(dirname, GENERATION_WEIGHTS)
-    np.savez(path, **{k: np.asarray(v, np.float32)
-                      for k, v in weights.items()})
-    with open(os.path.join(dirname, GENERATION_META), "w") as f:
-        json.dump(config.to_dict(), f, indent=2, sort_keys=True)
-    return path
+    mpath = os.path.join(dirname, GENERATION_MANIFEST)
+    if not os.path.exists(mpath):
+        return False
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        raise GenerationArtifactError(dirname,
+                                      "unreadable manifest (%s)" % e)
+    for n, size in manifest.get("files", {}).items():
+        p = os.path.join(dirname, n)
+        if not os.path.exists(p):
+            raise GenerationArtifactError(dirname, "missing file %s" % n)
+        actual = os.path.getsize(p)
+        if actual != int(size):
+            raise GenerationArtifactError(
+                dirname, "file %s is %d bytes, manifest says %d"
+                % (n, actual, size))
+    digests = manifest.get("digests", {})
+    try:
+        with np.load(os.path.join(dirname, GENERATION_WEIGHTS)) as z:
+            names = set(z.files)
+            if names != set(digests):
+                raise GenerationArtifactError(
+                    dirname, "weight set mismatch (%d stored vs %d in "
+                    "manifest)" % (len(names), len(digests)))
+            for k in sorted(names):
+                if _weight_digest(z[k]) != digests[k]:
+                    raise GenerationArtifactError(
+                        dirname, "digest mismatch on weight %r" % k)
+    except (OSError, ValueError, zipfile_BadZipFile) as e:
+        raise GenerationArtifactError(dirname,
+                                      "unreadable weights (%s)" % e)
+    return True
 
 
-def load_generation_artifact(dirname, name=None, quantize=None):
+def load_generation_artifact(dirname, name=None, quantize=None,
+                             verify=True):
     """Load an exported generation artifact as a ready-to-serve
     :class:`GenerationModel`. ``quantize='weight_only'`` serves the SAME
     artifact with the int8 weight store (``GenerationModel.quantized``)
-    — no re-export needed."""
+    — no re-export needed. Artifacts carrying a digest manifest are
+    verified on load (``verify=False`` skips it); a torn export raises
+    :class:`GenerationArtifactError` naming the artifact."""
     import json
     import os
 
@@ -382,10 +545,16 @@ def load_generation_artifact(dirname, name=None, quantize=None):
             "%s has no %s — export with "
             "paddle_tpu.inference.export_generation_model"
             % (dirname, GENERATION_META))
+    if verify:
+        verify_generation_artifact(dirname)
     with open(meta_path) as f:
         config = GenerationConfig.from_dict(json.load(f))
-    with np.load(os.path.join(dirname, GENERATION_WEIGHTS)) as z:
-        weights = {k: z[k] for k in z.files}
+    try:
+        with np.load(os.path.join(dirname, GENERATION_WEIGHTS)) as z:
+            weights = {k: z[k] for k in z.files}
+    except (OSError, ValueError, zipfile_BadZipFile) as e:
+        raise GenerationArtifactError(dirname,
+                                      "unreadable weights (%s)" % e)
     model = GenerationModel(config, weights,
                             name=name or os.path.basename(dirname))
     if quantize:

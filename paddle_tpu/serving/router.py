@@ -57,10 +57,24 @@ held across a call into an engine (``submit``/``kill`` are always made
 lock-free), which keeps the lock-order graph acyclic under
 ``PTPU_LOCK_CHECK=1``.
 
-Telemetry: ``router/{replicas_healthy,failovers,readmitted,retries,
-deadline_expired,shed_requests}`` (docs/OBSERVABILITY.md), all mirrored
-by host-side counters in :meth:`ServingRouter.stats` that stay live
-with metrics off.
+The online-update surface (docs/SERVING.md "Online updates") adds a
+fifth responsibility: ``drain(i)``/``undrain(i)`` put one replica at a
+time into a ``draining`` state (dispatch skips it, the stall watchdog
+ignores it, death detection stays armed) so the OnlineUpdater can swap
+its weights at a quiesced boundary; ``set_canary(i, pct)`` pins ~pct%
+of new requests to the canary replica while a candidate version is on
+trial. Every dispatch latches the serving replica's weight version on
+the request, and re-admission is version-consistent: a survivor on the
+same version continues prompt+committed, and when only other-version
+survivors exist the request restarts from its prompt
+(``router/version_restarts``) — either way every request's tokens are
+wholly attributable to exactly one weight version.
+
+Telemetry: ``router/{replicas_healthy,draining,failovers,readmitted,
+retries,deadline_expired,shed_requests,version_restarts}`` and
+``online/canary_requests`` (docs/OBSERVABILITY.md), all mirrored by
+host-side counters in :meth:`ServingRouter.stats` that stay live with
+metrics off.
 """
 
 import itertools
@@ -79,10 +93,11 @@ from .scheduler import AdmissionError, DeadlineExceededError, \
     GenerationRequest, check_request_args
 
 __all__ = ["ServingRouter", "RouterRequest",
-           "HEALTHY", "SUSPECT", "DEAD"]
+           "HEALTHY", "SUSPECT", "DRAINING", "DEAD"]
 
 HEALTHY = "healthy"
 SUSPECT = "suspect"
+DRAINING = "draining"
 DEAD = "dead"
 
 _router_req_ids = itertools.count()
@@ -148,6 +163,12 @@ class RouterRequest:
         self.error = None
         self.retries = 0            # re-admission budget spent
         self.readmissions = 0       # successful re-admissions
+        # weight version the committed tokens are attributable to
+        # (latched at each dispatch; docs/SERVING.md "Online updates").
+        # version_restarts counts from-the-prompt restarts forced by a
+        # re-admission that could only land on a different version.
+        self.weight_version = None
+        self.version_restarts = 0
         self._done = threading.Event()
         # reentrant: _on_finish finalizes (which re-takes it) while
         # holding it to keep the attempt hand-off atomic
@@ -303,6 +324,21 @@ class ServingRouter:
         self._deadline_expired = 0
         self._completed = 0
         self._failed = 0
+        self._submitted = 0
+        self._version_restarts = 0
+        self._canary_requests = 0
+        # canary pinning (docs/SERVING.md "Online updates"): while an
+        # OnlineUpdater rollout is in its canary phase this holds
+        # (replica_idx, pct) — ~pct% of NEW requests are pinned to the
+        # canary replica, the rest stay on incumbents. None (the
+        # default, PTPU_SERVE_CANARY_PCT unset) leaves routing
+        # bitwise-legacy.
+        self._canary = None
+        # per-weight-version outcome cohorts, accrued only while a
+        # canary is pinned (the comparison window): version ->
+        # [completed, failed, latency_sum_s]. The CanaryGate reads
+        # these to judge the candidate against the incumbent.
+        self._version_ledger = {}
         self._lock = _conc.make_lock("serving.router")
         self._inflight = set()
         self._failures = deque()    # (RouterRequest, attempt, error)
@@ -350,6 +386,82 @@ class ServingRouter:
         """The idx-th replica's engine (testing/inspection surface)."""
         return self._replicas[idx].engine
 
+    # -- online-update surface (docs/SERVING.md "Online updates") -------
+    def drain(self, idx):
+        """Mark replica ``idx`` draining: dispatch skips it, the stall
+        watchdog ignores it (a draining replica legitimately idles),
+        and its in-flight requests run to completion — or re-admit on
+        survivors through the normal failover path if it dies
+        mid-drain. The quiesce half of a weight swap. Idempotent;
+        returns False when the replica is already dead."""
+        rep = self._replicas[idx]
+        if rep.state == DEAD:
+            return False
+        self._set_state(rep, DRAINING)
+        self._update_draining_gauge()
+        return True
+
+    def undrain(self, idx):
+        """Re-admit replica ``idx`` to dispatch after a drain (state
+        back to healthy, watchdog bookkeeping reset so the idle drain
+        period never reads as a stall). Returns False — never
+        resurrecting — when the replica is not draining (e.g. it died
+        mid-drain and the failover path already owns its requests)."""
+        rep = self._replicas[idx]
+        if rep.state != DRAINING:
+            return False
+        rep.progress.clear()
+        self._set_state(rep, HEALTHY)
+        self._update_draining_gauge()
+        return True
+
+    def wait_drained(self, idx, timeout=30.0):
+        """Block until draining replica ``idx`` holds no queued or
+        in-batch work (its in-flight requests finished on its current
+        weights). Returns True when drained, False when the replica
+        died first (its requests re-admit on survivors); raises
+        ``TimeoutError`` when the budget runs out."""
+        rep = self._replicas[idx]
+        deadline = time.monotonic() + float(timeout)
+        while True:
+            if rep.state == DEAD:
+                return False
+            if rep.engine.load() == 0:
+                return True
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    "replica %d still holds %d requests after %.1fs of "
+                    "draining" % (idx, rep.engine.load(), timeout))
+            time.sleep(0.005)
+
+    def set_canary(self, idx, pct):
+        """Pin ~``pct``% of NEW requests to replica ``idx`` (the canary
+        serving a candidate weight version); the rest stay on the
+        incumbent replicas as the control cohort. The OnlineUpdater
+        sets this for the canary phase of every rollout
+        (``$PTPU_SERVE_CANARY_PCT``) and clears it on promote or
+        rollback."""
+        with self._lock:
+            self._version_ledger = {}
+            self._canary = (int(idx), float(pct))
+
+    def clear_canary(self):
+        self._canary = None
+
+    def version_ledger(self):
+        """Per-weight-version request outcomes accrued while a canary
+        was pinned: ``{version: (completed, failed, latency_sum_s)}``.
+        The candidate cohort is the pinned traffic, the incumbent
+        cohort everything else over the same window — the CanaryGate's
+        raw signals."""
+        with self._lock:
+            return {v: tuple(led)
+                    for v, led in self._version_ledger.items()}
+
+    def _update_draining_gauge(self):
+        _metrics.gauge("router/draining").set(
+            sum(1 for r in self._replicas if r.state == DRAINING))
+
     def submit(self, prompt, max_new_tokens=32, eos_id=None, stream=None,
                model=None, deadline_s=None):
         """Route one request to the least-loaded live replica; returns
@@ -365,10 +477,32 @@ class ServingRouter:
                              stream, model, deadline_s)
         with self._lock:
             self._inflight.add(rreq)
+            self._submitted += 1
         errors = []
-        for rep in self._candidates():
+        cands = self._candidates()
+        canary = self._canary
+        canary_rep = None
+        if canary is not None:
+            # deterministic per-request pinning (a hash of the request
+            # id, not a coin flip — replayable): pinned requests try
+            # the canary first, the rest avoid it so the incumbent
+            # cohort stays a clean control group. Availability beats
+            # pinning: either cohort falls through to the other side
+            # rather than shedding.
+            idx, pct = canary
+            rep = self._replicas[idx]
+            if rep.state not in (DEAD, DRAINING):
+                canary_rep = rep
+                pinned = (rreq.id * 2654435761 % 100) < pct
+                rest = [c for c in cands if c is not rep]
+                cands = ([rep] + rest) if pinned else (rest + [rep])
+        for rep in cands:
             try:
                 self._dispatch(rreq, rep)
+                if rep is canary_rep:
+                    with self._lock:
+                        self._canary_requests += 1
+                    _metrics.counter("online/canary_requests").inc()
                 return rreq
             except (AdmissionError, RuntimeError, KeyError) as e:
                 errors.append(e)
@@ -418,18 +552,25 @@ class ServingRouter:
         return {
             "replicas": [{"idx": r.idx, "state": r.state,
                           "load": r.engine.load(),
+                          "weight_version":
+                              r.engine.weight_version(),
                           **{"model:%s" % k: v
                              for k, v in r.engine.stats().items()}}
                          for r in self._replicas],
             "replicas_healthy": sum(1 for r in self._replicas
                                     if r.state == HEALTHY),
+            "replicas_draining": sum(1 for r in self._replicas
+                                     if r.state == DRAINING),
             "failovers": self._failovers,
             "readmitted": self._readmitted,
             "retries": self._retries,
             "shed_requests": self._shed,
             "deadline_expired": self._deadline_expired,
+            "requests_submitted": self._submitted,
             "requests_completed": self._completed,
             "requests_failed": self._failed,
+            "canary_requests": self._canary_requests,
+            "version_restarts": self._version_restarts,
             "inflight": inflight,
         }
 
@@ -467,9 +608,13 @@ class ServingRouter:
 
     # -- dispatch -------------------------------------------------------
     def _candidates(self):
-        """Live replicas, healthy before suspect, least-loaded first,
-        index order on ties (deterministic routing)."""
-        live = [r for r in self._replicas if r.state != DEAD]
+        """Dispatchable replicas, healthy before suspect, least-loaded
+        first, index order on ties (deterministic routing). Draining
+        replicas are skipped — they finish what they hold but take no
+        new work (the rolling weight-swap contract) — as are dead
+        ones."""
+        live = [r for r in self._replicas
+                if r.state not in (DEAD, DRAINING)]
         return sorted(live, key=lambda r: (r.state != HEALTHY,
                                            r.engine.load(), r.idx))
 
@@ -491,6 +636,11 @@ class ServingRouter:
         with rreq._lock:
             rreq._attempt = attempt
             rreq._base_len = len(committed)
+            # latch the serving weight version: every token this
+            # attempt emits is attributable to it (swaps only apply to
+            # drained replicas, so the version cannot move under a
+            # dispatched attempt)
+            rreq.weight_version = rep.engine.weight_version(rreq.model)
         rep.engine.submit_request(attempt)
         # the replica binding lands only once the submit DID: a
         # never-submitted attempt must stay invisible to
@@ -523,6 +673,15 @@ class ServingRouter:
                 self._failed += 1
                 if isinstance(error, DeadlineExceededError):
                     self._deadline_expired += 1
+            if self._canary is not None and rreq.weight_version is not None:
+                led = self._version_ledger.setdefault(
+                    rreq.weight_version, [0, 0, 0.0])
+                if error is None:
+                    led[0] += 1
+                    if rreq.latency is not None:
+                        led[2] += rreq.latency
+                else:
+                    led[1] += 1
         if isinstance(error, DeadlineExceededError):
             _metrics.counter("router/deadline_expired").inc()
 
@@ -562,6 +721,13 @@ class ServingRouter:
                 self._declare_dead(rep, death or RuntimeError(
                     "replica %d worker thread died" % rep.idx))
                 continue
+            if rep.state == DRAINING:
+                # death detection above still applies (a replica killed
+                # mid-drain must fail over), but the stall watchdog and
+                # the healthy/suspect transitions stand down: a
+                # draining replica legitimately idles, and only
+                # undrain() may put it back in dispatch
+                continue
             # per-worker progress: a wedged worker must not be masked
             # by a progressing sibling model's step counter
             stalled_for = 0.0
@@ -586,6 +752,7 @@ class ServingRouter:
                 self._set_state(rep, HEALTHY)
         _metrics.gauge("router/replicas_healthy").set(
             sum(1 for r in self._replicas if r.state == HEALTHY))
+        self._update_draining_gauge()
 
     @staticmethod
     def _set_state(rep, new):
@@ -745,6 +912,32 @@ class ServingRouter:
         candidates = [r for r in self._candidates() if r is not rep]
         if not candidates and rep is not None and rep.state != DEAD:
             candidates = [rep]  # transient on a live replica: retry it
+        if committed and rreq.weight_version is not None and candidates:
+            # per-version token attribution (docs/SERVING.md "Online
+            # updates"): continuing prompt+committed on a survivor
+            # running DIFFERENT weights would split the stream across
+            # two versions. Prefer same-version survivors (the common
+            # case mid-rollout — a steady fleet is all one version, so
+            # this filter is an identity there); when none exist,
+            # restart from the prompt so the regenerated stream is
+            # wholly attributable to the version that serves it.
+            same = [r for r in candidates
+                    if r.engine.weight_version(rreq.model)
+                    == rreq.weight_version]
+            if same:
+                candidates = same
+            else:
+                with rreq._lock:
+                    del rreq.tokens[:]
+                rreq.version_restarts += 1
+                with self._lock:
+                    self._version_restarts += 1
+                _metrics.counter("router/version_restarts").inc()
+                _blackbox.record_event("version_restart",
+                                       request=rreq.id,
+                                       version=rreq.weight_version,
+                                       committed=committed)
+                committed = 0
         for cand in candidates:
             try:
                 self._dispatch(rreq, cand)

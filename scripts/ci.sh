@@ -66,6 +66,20 @@
 #                the dead replica, and concurrency/violations == 0 —
 #                then the 1->2 replica throughput-scaling bench
 #                (core-aware floor, retried like serve's ratios)
+#   online     - online-learning hot-swap receipt (docs/SERVING.md
+#                "Online updates"): a 2-replica fleet under
+#                PTPU_LOCK_CHECK=1 with live traffic survives the full
+#                chaos matrix — happy-path publish + rollout, a torn
+#                export (detected, never served, republished), an
+#                injected canary anomaly (structured rollback to the
+#                incumbent) and a replica killed mid-drain (rollout
+#                completes on the survivor) — gating per-version token
+#                identity vs reference_decode, the zero-lost-requests
+#                ledger, online/rollbacks >= 1, online/torn_exports
+#                >= 1 and concurrency/violations == 0; then the slow
+#                train-while-serving pytest leg and the bench
+#                steady-vs-rollout throughput pair (ratio floor
+#                retried like serve's; functional gates every attempt)
 #   zero       - ZeRO ladder + comm/compute overlap receipt
 #                (docs/ZERO.md): one tiny MLP through ZeRO-1 per-leaf /
 #                bucketed-no-overlap (the PR-5 path) / ZeRO-2 overlap /
@@ -73,7 +87,7 @@
 #                gating numerics per rung, losses decreasing, offload
 #                bytes moved, and the step-time overlap receipt
 #                (overlapped <= non-overlapped)
-# Usage: scripts/ci.sh [build|test|api_check|bench|bench-smoke|stress|obs|chaos|data-chaos|amp|serve|lint|race|verify|quant|zero|fleet|all]
+# Usage: scripts/ci.sh [build|test|api_check|bench|bench-smoke|stress|obs|chaos|data-chaos|amp|serve|lint|race|verify|quant|zero|fleet|online|all]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -1315,6 +1329,224 @@ print("fleet stage ok:",
 PYEOF
 }
 
+do_online() {
+  # online-learning hot-swap receipt (docs/SERVING.md "Online
+  # updates"). Leg A — the chaos matrix under live traffic: a
+  # 2-replica fleet serves a continuous request pump while an
+  # OnlineUpdater walks four chained scenarios — (1) happy-path
+  # publish + canary-gated rollout, (2) an injected torn export
+  # (detected by the digest manifest, never rolled out, version
+  # republished next interval), (3) an injected canary anomaly
+  # (structured rollback drains the canary back onto the incumbent
+  # weights, zero client errors), (4) a replica killed mid-drain (the
+  # rollout completes on the survivor). Every output must be
+  # token-identical to reference_decode under the weight version that
+  # served it, the router's request ledger must balance (nothing
+  # dropped), and the whole path runs under PTPU_LOCK_CHECK=1 with
+  # switch-interval jitter gating concurrency/violations == 0.
+  local dump=/tmp/ptpu_online_metrics.json
+  rm -f "$dump"
+  JAX_PLATFORMS=cpu PTPU_METRICS=1 PTPU_METRICS_OUT="$dump" \
+    PTPU_LOCK_CHECK=1 PTPU_RETRY_BACKOFF=0 \
+    python - <<'PYEOF'
+import os
+import sys
+import threading
+import time
+import warnings
+
+sys.setswitchinterval(1e-5)
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import checkpoint as ckpt
+from paddle_tpu import inference, resilience, serving
+from paddle_tpu.analysis import concurrency
+from paddle_tpu.serving import reference_decode
+
+warnings.simplefilter("ignore", RuntimeWarning)
+base = "/tmp/ptpu_online_stage"
+import shutil
+shutil.rmtree(base, ignore_errors=True)
+ckpt_dir, pub_dir = os.path.join(base, "ckpts"), os.path.join(base, "pub")
+v0_dir = os.path.join(base, "v0")
+os.makedirs(ckpt_dir)
+
+from paddle_tpu.models import transformer_fluid
+prog, sprog = fluid.Program(), fluid.Program()
+with fluid.program_guard(prog, sprog):
+    transformer_fluid.build(vocab_size=64, d_model=16, n_heads=2,
+                            n_layers=1, d_ff=32, seq_len=8, remat=False)
+scope = fluid.Scope()
+fluid.Executor(fluid.CPUPlace()).run(sprog, scope=scope)
+inference.export_generation_model(v0_dir, prog, scope, max_seq_len=32)
+
+
+def scope_state(seed):
+    rng = np.random.RandomState(seed)
+    state = {}
+    for name, value in scope.items():
+        v = np.asarray(value)
+        if np.issubdtype(v.dtype, np.floating):
+            v = v + rng.normal(0, 0.02, v.shape).astype(v.dtype)
+        state[name] = v
+    return state
+
+
+def vers():
+    return [router.replica_engine(i).weight_version()
+            for i in range(2) if router.replica_states()[i] != "dead"]
+
+
+router = serving.ServingRouter(v0_dir, replicas=2, max_batch=2,
+                               max_seq_len=32, block_size=4,
+                               health_interval_s=0.02,
+                               backoff_base=0.0, stall_timeout_s=30.0)
+try:
+    # latency_factor widened: the switch-interval jitter makes every
+    # request slow in bursts, and the happy-path canary (leg 1) must
+    # promote on real health, not flake on scheduler noise — the
+    # anomaly legs below inject their signal explicitly
+    upd = serving.OnlineUpdater(router, ckpt_dir, pub_dir, prog,
+                                max_seq_len=32, canary_pct=50.0,
+                                canary_window_s=0.4,
+                                gate=serving.CanaryGate(latency_factor=6.0))
+    # warm the jitted step on both replicas before the pump starts
+    for p in [router.submit([1, 2], max_new_tokens=2) for _ in range(2)]:
+        p.wait(300)
+    stop, errs = threading.Event(), []
+
+    def pump():
+        while not stop.is_set():
+            try:
+                router.submit([1, 2], max_new_tokens=4).wait(60)
+            except Exception as e:
+                errs.append(e)
+            time.sleep(0.005)
+
+    t = threading.Thread(target=pump, name="online-pump", daemon=True)
+    t.start()
+    try:
+        # (1) happy path: publish v1, canary window, promote fleet-wide
+        ckpt.save_checkpoint(ckpt_dir, scope_state(1), 1)
+        out = upd.poll_once()
+        assert out and out["published"] and out["promoted"], out
+        assert vers() == [1, 1], vers()
+        # (2) torn export: detected, never served, republished as v2
+        resilience.set_global_injector(
+            resilience.FaultInjector("ckpt_torn_export:1"))
+        ckpt.save_checkpoint(ckpt_dir, scope_state(2), 2)
+        out = upd.poll_once()
+        assert out and not out["published"] \
+            and out["reason"] == "torn_export", out
+        assert vers() == [1, 1], vers()  # no rollout of the torn dir
+        ckpt.save_checkpoint(ckpt_dir, scope_state(3), 3)
+        out = upd.poll_once()
+        assert out and out["published"] and out["version"] == 2, out
+        assert vers() == [2, 2], vers()
+        # (3) canary anomaly: structured rollback, fleet on incumbent
+        resilience.set_global_injector(
+            resilience.FaultInjector("canary_anomaly_at_version:3"))
+        ckpt.save_checkpoint(ckpt_dir, scope_state(4), 4)
+        out = upd.poll_once()
+        assert out and out["published"] and not out["promoted"], out
+        assert upd.rollbacks == 1, upd.stats()
+        assert vers() == [2, 2], vers()
+    finally:
+        stop.set()
+        t.join()
+    # single-fault rollouts (swap, torn export, rollback) never
+    # surfaced a client error — the pump stops before leg 4 because a
+    # replica CRASHING while its peer drains is a double fault: for
+    # one health-poll interval the fleet genuinely has nowhere to
+    # dispatch, and clients see the same error a crash-only outage
+    # would produce
+    assert not errs, errs[:3]
+    try:
+        # (4) replica killed mid-drain: rollout completes on survivor
+        resilience.set_global_injector(
+            resilience.FaultInjector("swap_die_mid_drain:1"))
+        ckpt.save_checkpoint(ckpt_dir, scope_state(5), 5)
+        out = upd.poll_once()
+        assert out and out["published"] and out["promoted"], out
+        assert router.replica_states().count("dead") == 1, \
+            router.replica_states()
+        assert vers() == [4], vers()
+    finally:
+        resilience.set_global_injector(None)
+    # per-version token identity: the promoted artifact is what serves
+    m4 = inference.load_generation_model(os.path.join(pub_dir, "v4"))
+    got = router.submit([9, 3], max_new_tokens=5).wait(60)
+    assert got == reference_decode(m4, [9, 3], 5), got
+    st = router.stats()
+    assert st["requests_submitted"] == \
+        st["requests_completed"] + st["requests_failed"], st
+finally:
+    router.close()
+concurrency.assert_clean()
+concurrency.publish_metrics()
+print("online chaos matrix ok:", upd.stats(),
+      {k: st[k] for k in ("requests_submitted", "requests_completed",
+                          "requests_failed", "canary_requests")},
+      concurrency.stats())
+PYEOF
+  python tools/ptpu_stats.py "$dump" \
+    --assert-min online/versions_published=3 online/swaps=5 \
+                 online/rollbacks=1 online/torn_exports=1 \
+                 serving/prefix_cache_flushes=1 \
+                 resilience/faults_injected=3 \
+                 concurrency/locks_tracked=6 concurrency/acquisitions=1 \
+    --assert-max concurrency/violations=0
+  # Leg B — the real thing end to end: a live ResilientTrainer
+  # streaming checkpoints while the fleet serves under load, >= 2
+  # versions published and rolled out, every output attributed to the
+  # exact weight version that produced it (the slow pytest leg, also
+  # under the lock checker)
+  JAX_PLATFORMS=cpu PTPU_RETRY_BACKOFF=0 PTPU_LOCK_CHECK=1 \
+    python -m pytest tests/test_online.py -q -m slow \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+  # Leg C — steady-state vs mid-rollout serving throughput. Functional
+  # gates (token identity per version, zero requests lost, both
+  # replicas promoted) hold on every attempt; the rollout throughput
+  # ratio is a timing measurement retried like serve's ratios — the
+  # floor says a live weight push may not stall the fleet, not that
+  # it is free (each replica drains in turn).
+  local legs=/tmp/ptpu_online_legs.json attempt rc=1
+  for attempt in 1 2 3; do
+    rm -f "$dump" "$legs"
+    JAX_PLATFORMS=cpu PTPU_METRICS=1 \
+      python bench.py --online-only --metrics-out "$dump" \
+      --legs-out "$legs"
+    python tools/ptpu_stats.py "$dump" \
+      --assert-has bench/online_tokens_per_sec_steady \
+                   bench/online_tokens_per_sec_rollout \
+      --assert-min bench/online_outputs_match=1 \
+                   bench/online_versions_published=1 \
+                   bench/online_swaps=2 \
+      --assert-max bench/online_requests_lost=0
+    set +e
+    python tools/ptpu_stats.py "$dump" \
+      --assert-min bench/online_rollout_throughput_ratio=0.3
+    rc=$?
+    set -e
+    [ "$rc" -eq 0 ] && break
+    echo "online rollout ratio below 0.3x (loaded box?) — retry $attempt/2" >&2
+  done
+  [ "$rc" -eq 0 ]
+  python - "$legs" <<'PYEOF'
+import json, sys
+legs = {e["leg"]: e for e in json.load(open(sys.argv[1]))}
+assert "online_steady" in legs and "online_rollout" in legs, legs
+assert legs["online_steady"]["outputs_match"], legs
+assert legs["online_rollout"]["outputs_match"], legs
+assert legs["online_rollout"]["requests_lost"] == 0, legs
+assert legs["online_rollout"]["final_versions"] == [1, 1], legs
+print("online stage ok:",
+      {k: v["tokens_per_sec"] for k, v in legs.items()},
+      "ratio:", legs["online_rollout"]["online_rollout_throughput_ratio"])
+PYEOF
+}
+
 do_zero() {
   # ZeRO/overlap receipt (docs/ZERO.md). Functional gates hold on every
   # attempt: every rung's trained params close to the bucketed anchor
@@ -1394,6 +1626,7 @@ case "$stage" in
   kernels) do_kernels ;;
   zero) do_zero ;;
   fleet) do_fleet ;;
-  all) do_build; do_lint; do_test; do_api_check; do_bench_smoke; do_chaos; do_data_chaos; do_amp; do_serve; do_fleet; do_race; do_verify; do_quant; do_kernels; do_zero; do_bench ;;
+  online) do_online ;;
+  all) do_build; do_lint; do_test; do_api_check; do_bench_smoke; do_chaos; do_data_chaos; do_amp; do_serve; do_fleet; do_online; do_race; do_verify; do_quant; do_kernels; do_zero; do_bench ;;
   *) echo "unknown stage: $stage" >&2; exit 2 ;;
 esac

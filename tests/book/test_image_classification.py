@@ -2,6 +2,7 @@
 cifar batches (parity: tests/book/test_image_classification.py)."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu.models import resnet, vgg
@@ -43,6 +44,9 @@ def test_vgg_builds_and_steps():
     assert np.isfinite(losses).all(), losses
 
 
+@pytest.mark.slow  # ~85s alone — the suite brushes the 870s tier-1
+# budget, and the ROADMAP wall-clock note says to move slow legs
+# behind -m slow rather than trim coverage; ci.sh `test` still runs it
 def test_se_resnext_trains():
     """SE-ResNeXt-50 (dist_se_resnext.py parity model) trains with
     decreasing loss on tiny synthetic images."""

@@ -23,6 +23,14 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running end-to-end legs, excluded from the tier-1 "
+        "run (-m 'not slow'); scripts/ci.sh online/bench stages run "
+        "them explicitly")
+
+
 @pytest.fixture(autouse=True)
 def fresh_programs():
     """Each test gets fresh default programs, scope and name counters."""
