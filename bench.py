@@ -398,6 +398,158 @@ def bench_data_ingestion(n_shards=8, records_per_shard=2048, width=32,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_recommender(n_shards=4, records_per_shard=320, batch_size=32,
+                      epochs=3, vocab=512, fields=6, embed_dim=16,
+                      cache_rows=128):
+    """Recommender fast-path receipt (docs/RECOMMENDER.md): the SAME
+    recordio CTR stream and the SAME parameter init through three legs
+    of a host-table DeepFM —
+
+      sync           legacy in-step `pure_callback` embedding pull
+      overlap        PTPU_EMBED_PREFETCH=1: batch t+1's unique rows
+                     gathered on a host worker while the device runs t
+      overlap_cache  + PTPU_EMBED_CACHE_ROWS: frequency-admitted hot
+                     rows served from a device-resident cache
+
+    The receipt is honest only because the three legs are REQUIRED to
+    be bitwise identical (per-epoch losses and final table shards +
+    accumulators) — the fast path may only move work, never change
+    numerics. Throughput excludes epoch 0 (compile). Returns a result
+    dict; `rec_bitwise_identical` gates the CI rec stage."""
+    import hashlib
+    import shutil
+    import tempfile
+
+    import paddle_tpu as fluid
+    from paddle_tpu import framework
+    from paddle_tpu import initializer as _init
+    from paddle_tpu import unique_name
+    from paddle_tpu.core import scope as scope_mod
+    from paddle_tpu.models import deepfm
+    from paddle_tpu.observability import metrics as obs_metrics
+    from paddle_tpu.parallel import host_embedding
+    from paddle_tpu.parallel.host_embedding import HostEmbeddingTable
+
+    obs_metrics.enable()
+    tmp = tempfile.mkdtemp(prefix="ptpu_bench_rec_")
+
+    class _Var:
+        def __init__(self, name):
+            self.name = name
+
+    def write_shards():
+        paths = []
+        for s in range(n_shards):
+            p = "%s/ctr%02d.rec" % (tmp, s)
+            rng = np.random.RandomState(7000 + s)
+
+            def gen(rng=rng):
+                for _ in range(records_per_shard):
+                    # Zipf-ish skew: half the lookups land in a 32-row
+                    # hot set so frequency admission has a signal
+                    hot = rng.rand(fields) < 0.5
+                    ids = np.where(hot, rng.randint(0, 32, fields),
+                                   rng.randint(0, vocab, fields))
+                    yield (ids.astype(np.int64),
+                           np.array([rng.randint(0, 2)], np.float32))
+
+            fluid.convert_reader_to_recordio_file(p, gen)
+            paths.append(p)
+        return paths
+
+    def fresh():
+        framework.switch_main_program(framework.Program())
+        framework.switch_startup_program(framework.Program())
+        unique_name.switch()
+        scope_mod._scope_stack[:] = [scope_mod.Scope()]
+        HostEmbeddingTable.reset_registry()
+        _init._global_seed_counter[0] = 0
+        np.random.seed(42)
+
+    def table_digest():
+        h = hashlib.sha256()
+        state = host_embedding.tables_state_dict()
+        for tab in sorted(state):
+            for key in sorted(state[tab]):
+                h.update(np.ascontiguousarray(state[tab][key]).tobytes())
+        return h.hexdigest()
+
+    knobs = ("PTPU_EMBED_PREFETCH", "PTPU_EMBED_CACHE_ROWS",
+             "PTPU_EMBED_CACHE_ADMIT")
+
+    def run_leg(env):
+        import os as _os
+
+        for k in knobs:
+            _os.environ.pop(k, None)
+        _os.environ.update(env)
+        fresh()
+        ds = fluid.DatasetFactory().create_dataset("QueueDataset")
+        ds.set_batch_size(batch_size)
+        ds.set_filelist(paths)
+        main_p, startup = framework.Program(), framework.Program()
+        with framework.program_guard(main_p, startup):
+            (ids, label), _pred, avg_cost = deepfm.build_distributed(
+                vocab_size=vocab, num_fields=fields, embed_dim=embed_dim,
+                mlp_dims=(32, 16), num_shards=2, learning_rate=0.05)
+            fluid.optimizer.SGD(learning_rate=0.05).minimize(avg_cost)
+        ds.set_use_var([_Var("ids"), _Var("label")])
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        reg = obs_metrics.registry()
+        c0 = {m: reg.counter("embed/" + m).value
+              for m in ("cache_hits", "prefetch_hits", "pull_rows")}
+        losses, times = [], []
+        try:
+            for _ in range(epochs):
+                t0 = time.perf_counter()
+                out = exe.train_from_dataset(program=main_p, dataset=ds,
+                                             fetch_list=[avg_cost])
+                times.append(time.perf_counter() - t0)
+                losses.append(np.asarray(out[0]).copy())
+        finally:
+            for k in knobs:
+                _os.environ.pop(k, None)
+        counters = {m: reg.counter("embed/" + m).value - c0[m]
+                    for m in c0}
+        timed = sum(times[1:]) if epochs > 1 else times[0]
+        n_examples = n_shards * records_per_shard * max(epochs - 1, 1)
+        return {"examples_per_sec": n_examples / max(timed, 1e-9),
+                "losses": losses, "digest": table_digest(),
+                "counters": counters}
+
+    try:
+        paths = write_shards()
+        sync = run_leg({})
+        overlap = run_leg({"PTPU_EMBED_PREFETCH": "1"})
+        cached = run_leg({"PTPU_EMBED_PREFETCH": "1",
+                          "PTPU_EMBED_CACHE_ROWS": str(cache_rows),
+                          "PTPU_EMBED_CACHE_ADMIT": "2"})
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    bitwise = (sync["digest"] == overlap["digest"] == cached["digest"]
+               and all(a.tobytes() == b.tobytes() == c.tobytes()
+                       for a, b, c in zip(sync["losses"],
+                                          overlap["losses"],
+                                          cached["losses"])))
+    hits = cached["counters"]["cache_hits"]
+    served = hits + cached["counters"]["pull_rows"]
+    return {
+        "sync_examples_per_sec": sync["examples_per_sec"],
+        "overlap_examples_per_sec": overlap["examples_per_sec"],
+        "cache_examples_per_sec": cached["examples_per_sec"],
+        "overlap_speedup": (overlap["examples_per_sec"]
+                            / sync["examples_per_sec"]),
+        "cache_hit_rate": hits / served if served else 0.0,
+        "prefetch_hits": overlap["counters"]["prefetch_hits"],
+        "cache_hits": hits,
+        "bitwise_identical": bitwise,
+        "final_loss": float(np.asarray(sync["losses"][-1]).ravel()[0]),
+        "table_digest": sync["digest"],
+    }
+
+
 def bench_serving(n_requests=32, max_new_tokens=24, rate=100000.0,
                   max_batch=16, vocab=256, d_model=64, n_heads=2,
                   n_layers=2, d_ff=128, max_seq_len=128):
@@ -1292,6 +1444,12 @@ def main(argv=None):
                     help="run only the streaming-ingestion leg pair "
                          "(healthy vs one-quarantined-shard records/s "
                          "— the CI data-chaos stage configuration)")
+    ap.add_argument("--rec-only", action="store_true",
+                    help="run only the recommender fast-path legs "
+                         "(sync vs overlapped prefetch vs prefetch + "
+                         "hot-row cache on a host-table DeepFM, gated "
+                         "bitwise-identical — the CI rec stage "
+                         "configuration)")
     ap.add_argument("--kernels-only", action="store_true",
                     help="run only the Pallas kernel receipts — each "
                          "kernel vs its own lax fallback (paged "
@@ -1370,6 +1528,63 @@ def main(argv=None):
             "records_per_sec_degraded": round(
                 res["degraded_records_per_sec"], 1),
             "records_lost": res["records_lost"],
+        }))
+        return
+
+    if args.rec_only:
+        res = bench_recommender()
+        if args.metrics_out:
+            from paddle_tpu.observability import metrics as obs_metrics
+
+            reg = obs_metrics.registry()
+            reg.gauge("bench/rec_examples_per_sec_sync").set(
+                res["sync_examples_per_sec"])
+            reg.gauge("bench/rec_examples_per_sec_overlap").set(
+                res["overlap_examples_per_sec"])
+            reg.gauge("bench/rec_examples_per_sec_cache").set(
+                res["cache_examples_per_sec"])
+            reg.gauge("bench/rec_overlap_speedup").set(
+                res["overlap_speedup"])
+            reg.gauge("bench/rec_cache_hit_rate").set(
+                res["cache_hit_rate"])
+            reg.gauge("bench/rec_bitwise_identical").set(
+                1.0 if res["bitwise_identical"] else 0.0)
+            reg.dump_json(args.metrics_out)
+        if args.legs_out:
+            with open(args.legs_out, "w") as f:
+                json.dump([
+                    {"leg": "rec_sync",
+                     "examples_per_sec": round(
+                         res["sync_examples_per_sec"], 1)},
+                    {"leg": "rec_overlap",
+                     "examples_per_sec": round(
+                         res["overlap_examples_per_sec"], 1),
+                     "rec_overlap_speedup": round(
+                         res["overlap_speedup"], 4),
+                     "prefetch_hits": res["prefetch_hits"]},
+                    {"leg": "rec_overlap_cache",
+                     "examples_per_sec": round(
+                         res["cache_examples_per_sec"], 1),
+                     "rec_cache_hit_rate": round(
+                         res["cache_hit_rate"], 4),
+                     "cache_hits": res["cache_hits"],
+                     "bitwise_identical": bool(
+                         res["bitwise_identical"])},
+                ], f, indent=2)
+        print(json.dumps({
+            "metric": "rec_overlap_speedup",
+            "value": round(res["overlap_speedup"], 4),
+            "unit": "x (overlapped-prefetch / synchronous examples-"
+                    "per-sec, bitwise-identical numerics)",
+            "examples_per_sec_sync": round(
+                res["sync_examples_per_sec"], 1),
+            "examples_per_sec_overlap": round(
+                res["overlap_examples_per_sec"], 1),
+            "examples_per_sec_cache": round(
+                res["cache_examples_per_sec"], 1),
+            "cache_hit_rate": round(res["cache_hit_rate"], 4),
+            "bitwise_identical": res["bitwise_identical"],
+            "final_loss": res["final_loss"],
         }))
         return
 

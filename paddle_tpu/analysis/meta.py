@@ -335,6 +335,26 @@ def _fused_int8_matmul(op, in_metas):
     return {"Out": [(shape, "float32")]}
 
 
+def _lookup_table_host(op, in_metas):
+    """Host-embedding lookups (sync and prefetched variants): Out is the
+    Ids shape (trailing 1 squeezed, the kernel's convention) extended by
+    the table's embedding dim. The dim lives on the live table registry,
+    not the graph — verification without the table yields no verdict on
+    the shape."""
+    ids_s, _ = _in0(in_metas, "Ids")
+    shape = None
+    if ids_s is not None:
+        s = tuple(ids_s)
+        if len(s) > 1 and s[-1] == 1:
+            s = s[:-1]
+        from ..parallel.host_embedding import _TABLES
+
+        table = _TABLES.get(op.attrs.get("table_name"))
+        if table is not None:
+            shape = s + (table.dim,)
+    return {"Out": [(shape, "float32")]}
+
+
 def _register_quant_metas():
     declare("quantize", ins=("Input",), outs=("Output",),
             infer=_quantize_out)
@@ -394,6 +414,11 @@ def _register_builtin_metas():
     declare("fill_constant_batch_size_like", ins=("Input",), outs=("Out",),
             attrs=("shape",))
     declare("lookup_table", ins=("Ids", "W"), outs=("Out",))
+    declare("lookup_table_host", ins=("Ids", "Anchor"), outs=("Out",),
+            attrs=("table_name",), infer=_lookup_table_host)
+    declare("lookup_table_prefetched",
+            ins=("Ids", "Anchor", "Rows", "Inv"), outs=("Out",),
+            attrs=("table_name",), infer=_lookup_table_host)
     declare("concat", ins=("X",), outs=("Out",))
     declare("reshape", ins=("X",), outs=("Out",))
     declare("transpose", ins=("X",), outs=("Out",), attrs=("axis",))

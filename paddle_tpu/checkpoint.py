@@ -655,3 +655,23 @@ class CheckpointManager:
                 if newer_intact < self.max_to_keep:
                     continue
             shutil.rmtree(path, ignore_errors=True)
+
+
+def host_embedding_state():
+    """The sparse half of a recommender checkpoint: every registered
+    host embedding table's shards + optimizer accumulators
+    (docs/RECOMMENDER.md), as one nested numpy tree that rides the
+    manifest unchanged. Flush any running Communicator first so queued
+    pushes are in the snapshot."""
+    from .parallel.host_embedding import tables_state_dict
+
+    return tables_state_dict()
+
+
+def load_host_embedding_state(state):
+    """Restore host_embedding_state() output into the live table
+    registry — tables must already exist (model build creates them) and
+    match geometry, else EmbeddingStateError names the mismatch."""
+    from .parallel.host_embedding import load_tables_state_dict
+
+    load_tables_state_dict(state)

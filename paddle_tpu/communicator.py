@@ -18,15 +18,26 @@ import threading
 
 import numpy as np
 
+from .observability import metrics as _metrics
+
 __all__ = ["Communicator"]
 
 
 class _AsyncPusher:
     """SendThread parity: bounded queue + one drain thread per table.
     Consecutive queued (ids, grads) pairs are merged before applying —
-    the reference's merge-before-send (communicator.cc MergeVars)."""
+    the reference's merge-before-send (communicator.cc MergeVars). The
+    queue bound (PTPU_EMBED_PUSH_QUEUE) is backpressure, mirroring the
+    PR-6 RequestQueue contract: when the drain thread falls behind, the
+    training thread blocks on enqueue instead of growing an unbounded
+    push backlog; depth is exported as the embed/push_queue_depth
+    gauge."""
 
-    def __init__(self, table, max_queue=64, merge_size=4):
+    def __init__(self, table, max_queue=None, merge_size=4):
+        if max_queue is None:
+            from .flags import env as _env
+
+            max_queue = int(_env("PTPU_EMBED_PUSH_QUEUE"))
         self._table = table
         self._q = queue.Queue(maxsize=max_queue)
         self._merge_size = merge_size
@@ -39,10 +50,23 @@ class _AsyncPusher:
             daemon=True)
         self._thread.start()
 
+    def _record_depth(self):
+        if _metrics.enabled():
+            _metrics.gauge("embed/push_queue_depth").set(self._q.qsize())
+
     def enqueue(self, ids, grads):
         self._raise_if_failed()
         self._idle.clear()
+        if self._q.full():
+            from .analysis.concurrency import check_blocking
+
+            # declared blocking region: a full queue stalls the caller
+            # until the drain thread catches up (block-on-full
+            # backpressure) — doing that while holding a tracked lock
+            # would park the lock behind the push backlog
+            check_blocking("queue.put", "communicator.enqueue")
         self._q.put((ids, grads))
+        self._record_depth()
 
     def _raise_if_failed(self):
         if self._error is not None:
@@ -70,8 +94,12 @@ class _AsyncPusher:
                 batch_i = [i.reshape(-1) for i, _ in batch]
                 batch_g = [np.asarray(g).reshape(i.size, -1)
                            for i, g in batch]
+                # n_pushes: each queued pair is one logical step-push —
+                # the prefetcher's coherence barrier counts applications
+                # per step, so a merged apply must report its multiplicity
                 self._table._apply_push(np.concatenate(batch_i),
-                                        np.concatenate(batch_g))
+                                        np.concatenate(batch_g),
+                                        n_pushes=len(batch))
             except BaseException as e:  # surface on the training thread:
                 # a dead thread with items stuck on the queue would
                 # deadlock flush()/push() with no error ever shown
@@ -80,6 +108,7 @@ class _AsyncPusher:
             finally:
                 for _ in batch:
                     self._q.task_done()
+            self._record_depth()
             if self._q.empty():
                 self._idle.set()
 

@@ -599,12 +599,27 @@ class Executor:
             batches = (dataset._batches_prefetched()
                        if getattr(dataset, "_thread", 1) > 1
                        else dataset._batches())
+        # sparse-embedding fast path (docs/RECOMMENDER.md): with
+        # PTPU_EMBED_PREFETCH=1 and host-embedding lookups in the
+        # program, batch t+1's ids are announced to a background gather
+        # worker as the lookahead pulls them, and each step receives the
+        # staged row buffer as ordinary feeds instead of paying the
+        # in-step pure_callback pull. None = the exact legacy path.
+        from .parallel.embedding_pipeline import maybe_pipeline
+
+        embed_pipeline = maybe_pipeline(program)
+        if embed_pipeline is not None:
+            batches = embed_pipeline.announce_iter(batches)
         # H2D lookahead: while the device runs batch k, a background
         # thread device_puts batch k+1 (same contract as PyReader's
         # double buffer, here for the Dataset path)
         device_feeder = FeedPrefetcher(sharding_fn=self._feed_sharding)
         try:
             for feed in prefetch_iter(batches, device_feeder):
+                if embed_pipeline is not None:
+                    # coherence point: barrier on the prior steps'
+                    # pushes, repair dirtied rows, merge staged arrays
+                    feed = embed_pipeline.finalize_into(feed)
                 if cursor_states is not None:
                     # consumption point: the lookahead above has already
                     # PULLED batch k+1, but the mirrored cursor may only
@@ -622,6 +637,10 @@ class Executor:
                         for k, v in zip(info, last)}))
         finally:
             device_feeder.close()
+            if embed_pipeline is not None:
+                # detaches the program decoration too, so a later direct
+                # exe.run compiles the legacy synchronous lookup again
+                embed_pipeline.close()
         return last
 
     infer_from_dataset = train_from_dataset

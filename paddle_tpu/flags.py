@@ -317,6 +317,25 @@ _declare("PTPU_KERNELS_DISABLE", "str", None,
          "comma-separated kernel names pinned to their lax fallback "
          "regardless of PTPU_KERNELS (names: docs/KERNELS.md "
          "qualification table)")
+# -- recommender embedding fast path (docs/RECOMMENDER.md) ------------------
+_declare("PTPU_EMBED_PREFETCH", "bool", False,
+         "stage host-embedding rows one step ahead: train_from_dataset "
+         "announces batch t+1's ids to a background gather worker and "
+         "the compiled step reads the deduped row buffer as an ordinary "
+         "device feed instead of a blocking in-step pure_callback pull "
+         "(unset = the exact legacy synchronous lookup)")
+_declare("PTPU_EMBED_CACHE_ROWS", "int", 0,
+         "with PTPU_EMBED_PREFETCH=1, keep this many hot embedding rows "
+         "resident in a device-side cache with frequency admission + LRU "
+         "eviction; 0 = no cache (prefetch buffer only)")
+_declare("PTPU_EMBED_CACHE_ADMIT", "int", 2,
+         "admission threshold for the hot-row cache: a row enters the "
+         "cache once it has been touched by this many distinct batches")
+_declare("PTPU_EMBED_PUSH_QUEUE", "int", 64,
+         "Communicator async-push queue bound per table; a full queue "
+         "blocks the enqueueing (training) thread until the drain "
+         "thread catches up (backpressure, embed/push_queue_depth "
+         "gauge)")
 # -- tests / CI -------------------------------------------------------------
 _declare("PTPU_PARITY_TIMEOUT", "float", 45.0,
          "seconds the TPU-backend parity test waits on its subprocess "

@@ -609,6 +609,11 @@ class Program:
         if getattr(self, "_quant_config", None) is not None:
             # quantization decoration travels the same way (quant.py)
             p._quant_config = self._quant_config
+        if getattr(self, "_embed_config", None) is not None:
+            # embedding-prefetch decoration too: the compile clone is
+            # what the embed_prefetch_rewrite pass sees
+            # (parallel/embedding_pipeline.py)
+            p._embed_config = self._embed_config
         p.current_block_idx = 0
         return p
 

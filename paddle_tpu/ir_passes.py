@@ -142,8 +142,22 @@ def _quant_cfg(build_strategy=None, program=None):
     return quant.active_config(program, build_strategy)
 
 
+def _embed_cfg(program=None):
+    """The embedding-prefetch config in effect for one compile (None =
+    inactive — the exact legacy host-lookup pipeline and cache keys).
+    Decoration-only (a live HostEmbeddingPrefetcher, never a bare env
+    flag); the lazy import registers the embed_prefetch_rewrite pass
+    (docs/RECOMMENDER.md)."""
+    if program is None or getattr(program, "_embed_config", None) is None:
+        return None
+    from .parallel import embedding_pipeline
+
+    return embedding_pipeline.active_config(program)
+
+
 def build_pipeline(build_strategy=None, is_test=False, infer_opt=False,
-                   single_block=True, amp=False, quant=False):
+                   single_block=True, amp=False, quant=False,
+                   embed=False):
     """Ordered pass-name list for one compile. `infer_opt` is the
     explicit inference-optimize request (with_inference_optimize /
     AnalysisConfig ir_optim) and adds the numerics-adjusting conv folds;
@@ -161,6 +175,11 @@ def build_pipeline(build_strategy=None, is_test=False, infer_opt=False,
     if infer_opt:
         names.append("conv_bn_fold_baked")
         names.append("conv_elementwise_add_fuse")
+    if embed:
+        # before amp/quant: the prefetch rewrite only rewires a lookup's
+        # inputs (same f32 semantics), and the later passes then see the
+        # final op type like any other gray op
+        names.append("embed_prefetch_rewrite")
     if amp:
         names.append("amp_rewrite")
     if quant:
@@ -188,9 +207,15 @@ def pipeline_key(build_strategy=None, program=None, infer_opt=False):
     single = program is None or program.num_blocks == 1
     amp_cfg = _amp_cfg(build_strategy, program)
     quant_cfg = _quant_cfg(build_strategy, program)
+    embed_cfg = _embed_cfg(program)
     key = tuple(build_pipeline(build_strategy, is_test, infer_opt, single,
                                amp=amp_cfg is not None,
-                               quant=quant_cfg is not None))
+                               quant=quant_cfg is not None,
+                               embed=embed_cfg is not None))
+    if embed_cfg is not None:
+        # attaching/detaching a HostEmbeddingPrefetcher (or changing its
+        # cache geometry) must not reuse a step compiled the other way
+        key += ("embed:" + embed_cfg.cache_key(),)
     if amp_cfg is not None:
         # flipping PTPU_AMP (or re-decorating with different lists) must
         # not reuse a compiled step rewritten under the other policy
@@ -225,10 +250,12 @@ def optimize_for_execution(program, fetch_names, scope=None,
         return program
     amp_cfg = _amp_cfg(build_strategy, program)
     quant_cfg = _quant_cfg(build_strategy, program)
+    embed_cfg = _embed_cfg(program)
     names = build_pipeline(build_strategy, program_is_inference(program),
                            infer_opt, program.num_blocks == 1,
                            amp=amp_cfg is not None,
-                           quant=quant_cfg is not None)
+                           quant=quant_cfg is not None,
+                           embed=embed_cfg is not None)
     from .ir import get_pass
 
     clone = program.clone()

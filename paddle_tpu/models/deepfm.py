@@ -11,6 +11,55 @@ FM first-order + second-order + deep MLP tower, sigmoid CTR output.
 from .. import layers
 
 
+def build_distributed(vocab_size=int(1e4), num_fields=8, embed_dim=8,
+                      mlp_dims=(32, 16), num_shards=2, learning_rate=0.1,
+                      table_prefix="deepfm"):
+    """DeepFM over HOST-RAM sharded embedding tables — the recommender
+    fast-path shape (docs/RECOMMENDER.md): both the first-order dim-1
+    table and the second-order dim-K table are `distributed_embedding`
+    lookups on the SAME ids variable, so with PTPU_EMBED_PREFETCH=1 the
+    prefetch pipeline stages both tables' rows one step ahead and the
+    compiled step never pays an in-step host callback.
+
+    Feeds: `ids` [B, F] int64 (pre-folded below vocab_size), `label`
+    [B, 1] float32. Returns ((ids, label), predict, avg_cost)."""
+    ids = layers.data(name="ids", shape=[num_fields], dtype="int64",
+                      append_batch_size=False)
+    label = layers.data(name="label", shape=[1], dtype="float32")
+
+    # first-order: per-id scalar weight from a dim-1 host table
+    w1 = layers.distributed_embedding(
+        ids, table_name=table_prefix + "_w1", size=[vocab_size, 1],
+        num_shards=num_shards, learning_rate=learning_rate)  # [B, F, 1]
+    first_order = layers.reduce_sum(
+        layers.reshape(w1, [-1, num_fields]), dim=[1], keep_dim=True)
+
+    # second-order FM over the dim-K host table: 0.5*((sum v)^2 - sum v^2)
+    emb = layers.distributed_embedding(
+        ids, table_name=table_prefix + "_emb",
+        size=[vocab_size, embed_dim], num_shards=num_shards,
+        learning_rate=learning_rate)  # [B, F, K]
+    sum_emb = layers.reduce_sum(emb, dim=[1])
+    sq_sum = layers.reduce_sum(layers.square(emb), dim=[1])
+    second_order = layers.scale(
+        layers.reduce_sum(
+            layers.elementwise_sub(layers.square(sum_emb), sq_sum),
+            dim=[1], keep_dim=True), scale=0.5)
+
+    # deep tower over the flattened embeddings
+    h = layers.reshape(emb, [-1, num_fields * embed_dim])
+    for dim in mlp_dims:
+        h = layers.fc(input=h, size=dim, act="relu")
+    deep_out = layers.fc(input=h, size=1, act=None)
+
+    logit = layers.elementwise_add(
+        layers.elementwise_add(first_order, second_order), deep_out)
+    predict = layers.sigmoid(logit)
+    cost = layers.log_loss(predict, label, epsilon=1e-6)
+    avg_cost = layers.mean(cost)
+    return (ids, label), predict, avg_cost
+
+
 def build(sparse_feature_dim=int(1e5), num_fields=26, dense_dim=13,
           embed_dim=16, mlp_dims=(400, 400, 400), is_sparse=True):
     sparse_ids = layers.data(name="sparse_ids", shape=[num_fields],

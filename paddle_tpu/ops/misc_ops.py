@@ -704,6 +704,33 @@ def _lookup_table_host(ctx, ins, attrs):
     return {"Out": [out]}
 
 
+@register("lookup_table_prefetched",
+          nondiff_inputs=("Ids", "Rows", "Inv", "Hit", "Slot", "Cache"))
+def _lookup_table_prefetched(ctx, ins, attrs):
+    """Prefetch fast path of lookup_table_host (docs/RECOMMENDER.md):
+    the embed_prefetch_rewrite pass rewires the lookup to read the
+    [n, dim] unique-row buffer + inverse indices the
+    HostEmbeddingPrefetcher staged a step ahead (and, with the hot-row
+    cache armed, the Hit/Slot/Cache feeds) — no host callback in the
+    forward. The backward still pushes through the table's optimizer,
+    so post-push state is bitwise the synchronous op's. Only Anchor is
+    differentiable: the staged buffers are constants for one step."""
+    from ..parallel.host_embedding import prefetched_embedding_lookup
+
+    ids = ins["Ids"][0]
+    if ids.ndim > 1 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    anchor = ins["Anchor"][0].reshape(())
+    rows = ins["Rows"][0]
+    inv = ins["Inv"][0]
+    hit = ins["Hit"][0] if ins.get("Hit") else None
+    slot = ins["Slot"][0] if ins.get("Slot") else None
+    cache = ins["Cache"][0] if ins.get("Cache") else None
+    out = prefetched_embedding_lookup(attrs["table_name"], ids, anchor,
+                                      rows, inv, hit, slot, cache)
+    return {"Out": [out]}
+
+
 @register("switch_moe", nondiff_inputs=())
 def _switch_moe(ctx, ins, attrs):
     """Top-1 switch mixture-of-experts FFN (beyond-reference, SURVEY §5.7

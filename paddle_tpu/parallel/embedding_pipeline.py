@@ -1,0 +1,632 @@
+"""Async host-embedding prefetch + hot-row device cache (docs/
+RECOMMENDER.md; Monolith, arXiv:2209.07663 — overlap the sparse
+parameter exchange with compute and keep hot rows near the accelerator).
+
+The legacy `host_embedding_lookup` pays a synchronous host round-trip
+inside every compiled step: the forward is a blocking `jax.pure_callback`
+gather under the table lock. This module removes it from the hot path:
+
+  1. `HostEmbeddingPrefetcher.announce_iter` rides the train_from_dataset
+     batch stream — as batch t+1 is pulled for H2D staging (the PR-2
+     FeedPrefetcher lookahead), its ids are handed to a background worker
+     that dedups them (`np.unique`), gathers the unique rows from the
+     host table OFF the critical path, and pads them into a
+     `[n_flat_ids, dim]` buffer.
+  2. The `embed_prefetch_rewrite` pass rewires `lookup_table_host` ops on
+     the compile clone to `lookup_table_prefetched`, which reads that
+     buffer (+ inverse indices) as ordinary prefetched device feeds — no
+     in-step callback. The legacy op remains the fallback for any run
+     without a staged pipeline (direct exe.run, flag unset).
+  3. A frequency/LRU-admission `HotRowCache` keeps hot rows resident in a
+     device-side `[cache_rows, dim]` array; unique rows found in the
+     cache skip the host gather entirely, and pushes write through
+     (refresh-on-dirty) so the cached path stays bitwise-agreed with
+     `pull(raw_ids)`.
+
+Bitwise coherence contract: the step for batch t must observe the table
+exactly as the synchronous path would — i.e. after the pushes of steps
+0..t-1 and nothing else. `finalize_into` therefore (a) barriers on the
+applied-push count (each table reports optimizer applications through
+its push observers, including merged Communicator batches), and (b)
+re-pulls any staged/cached row dirtied since its gather. The pinned
+identity tests in tests/test_embedding_pipeline.py enforce this.
+"""
+
+import hashlib
+import queue
+import threading
+import time
+from collections import OrderedDict, deque
+
+import numpy as np
+
+from ..analysis.concurrency import check_blocking
+from ..ir import Pass, register_pass
+from ..observability import metrics as _metrics
+
+__all__ = ["EmbedPrefetchConfig", "HotRowCache", "HostEmbeddingPrefetcher",
+           "active_config", "maybe_pipeline", "feed_names"]
+
+# how long the coherence barrier waits for the previous steps' pushes
+# before declaring the stream wedged (a dead pusher thread, a step that
+# never ran its backward)
+_BARRIER_TIMEOUT_S = 120.0
+
+
+def feed_names(table_name):
+    """The reserved feed-var names the rewrite pass and the pipeline
+    agree on for one table (all is_data, never user-visible)."""
+    return {
+        "rows": "__embed_rows__%s" % table_name,
+        "inv": "__embed_inv__%s" % table_name,
+        "hit": "__embed_hit__%s" % table_name,
+        "slot": "__embed_slot__%s" % table_name,
+        "cache": "__embed_cache__%s" % table_name,
+    }
+
+
+class EmbedPrefetchConfig:
+    """Resolved prefetch policy pinned on a program as `_embed_config`
+    (the amp.AmpConfig decoration pattern). Presence of the decoration —
+    set only by an active HostEmbeddingPrefetcher — is what arms the
+    rewrite pass; a bare PTPU_EMBED_PREFETCH env without a pipeline never
+    rewrites (the compiled step would expect feeds nobody stages)."""
+
+    def __init__(self, tables, cache_rows=0, cache_admit=2):
+        self.tables = tuple(sorted(tables))
+        self.cache_rows = int(cache_rows)
+        self.cache_admit = max(1, int(cache_admit))
+
+    def cache_key(self):
+        """Short stable digest for the compile-cache pipeline key."""
+        h = hashlib.sha1()
+        h.update(repr((self.tables, self.cache_rows,
+                       self.cache_admit)).encode())
+        return "%d:%d:%s" % (self.cache_rows, self.cache_admit,
+                             h.hexdigest()[:8])
+
+
+def active_config(program=None):
+    """The prefetch config in effect for one compile, or None. Unlike
+    AMP there is no env/BuildStrategy leg: only the pipeline decoration
+    counts (see EmbedPrefetchConfig docstring)."""
+    return getattr(program, "_embed_config", None) \
+        if program is not None else None
+
+
+def _inspect_program(program):
+    """(lookup sites, push sites) for every host table in `program`.
+
+    sites: {table_name: (ids var, n_lookup_ops)} — only tables with
+    exactly ONE lookup whose Ids input is a data feed are prefetchable
+    (one staged buffer per table per step).
+    push_sites: {table_name: n_grad_ops} — how many sparse pushes one
+    executed step emits per table; the coherence barrier's unit."""
+    sites = {}
+    push_sites = {}
+    for blk in program.blocks:
+        for op in blk.ops:
+            if op.type in ("lookup_table_host", "lookup_table_prefetched"):
+                tab = op.attrs["table_name"]
+                ids_v, n = sites.get(tab, (None, 0))
+                sites[tab] = (ids_v or op.inputs["Ids"][0], n + 1)
+            if "__fwd_op__" in op.attrs:
+                f = op.attrs["__fwd_op__"]
+                while "__fwd_op__" in f.attrs:
+                    f = f.attrs["__fwd_op__"]
+                if f.type in ("lookup_table_host",
+                              "lookup_table_prefetched"):
+                    tab = f.attrs["table_name"]
+                    push_sites[tab] = push_sites.get(tab, 0) + 1
+    return sites, push_sites
+
+
+def maybe_pipeline(program):
+    """Build the prefetcher train_from_dataset attaches when
+    PTPU_EMBED_PREFETCH=1 and `program` has prefetchable host-embedding
+    lookups; None otherwise (the exact legacy path)."""
+    from ..flags import env as _env
+
+    if not _env("PTPU_EMBED_PREFETCH"):
+        return None
+    sites, _ = _inspect_program(program)
+    eligible = [tab for tab, (ids_v, n) in sites.items()
+                if n == 1 and getattr(ids_v, "is_data", False)]
+    if not eligible:
+        return None
+    cfg = EmbedPrefetchConfig(
+        eligible,
+        cache_rows=_env("PTPU_EMBED_CACHE_ROWS"),
+        cache_admit=_env("PTPU_EMBED_CACHE_ADMIT"))
+    return HostEmbeddingPrefetcher(program, cfg)
+
+
+# ---------------------------------------------------------------------------
+# hot-row device cache
+# ---------------------------------------------------------------------------
+
+
+class HotRowCache:
+    """Frequency-admitted, LRU-evicted device-resident row cache for one
+    table: a `[cache_rows, dim]` jax array plus a host-side id→slot map.
+    A row is admitted once `admit` distinct batches have touched it;
+    pushes write through (the pipeline re-pulls dirtied cached rows and
+    scatters the fresh values) so a cache hit is always bitwise the
+    value `table.pull` would return. All mutation happens under the
+    pipeline's finalize lock — this class is not itself thread-safe."""
+
+    def __init__(self, table, rows, admit):
+        import jax.numpy as jnp
+
+        self.table = table
+        self.rows = int(rows)
+        self.admit = int(admit)
+        self.arr = jnp.zeros((self.rows, table.dim), jnp.float32)
+        self.slot_of = {}                # row id -> slot
+        self._free = list(range(self.rows - 1, -1, -1))
+        self._lru = OrderedDict()        # row id -> None, oldest first
+        self._freq = {}                  # row id -> distinct-batch count
+
+    def touch(self, row):
+        """Mark a cached row used this step (LRU recency)."""
+        self._lru.move_to_end(row)
+
+    def note_use(self, row):
+        """Count one distinct-batch touch toward admission; True once
+        the row has earned a slot."""
+        n = self._freq.get(row, 0) + 1
+        self._freq[row] = n
+        return n >= self.admit
+
+    def _take_slot(self, protect=frozenset()):
+        if self._free:
+            return self._free.pop()
+        for victim in self._lru:          # oldest first
+            if victim in protect:
+                continue
+            del self._lru[victim]
+            slot = self.slot_of.pop(victim)
+            if _metrics.enabled():
+                _metrics.counter("embed/cache_evictions").inc()
+            return slot
+        return None
+
+    def admit_rows(self, rows_vals, protect=frozenset()):
+        """Install [(row, value)] pairs, evicting LRU victims as needed.
+        Rows in `protect` (this step's hits — their slots are already
+        baked into the staged Slot feed) are never victims. Returns the
+        number admitted."""
+        updates = []
+        for row, val in rows_vals:
+            if row in self.slot_of:
+                continue
+            slot = self._take_slot(protect)
+            if slot is None:
+                break
+            self.slot_of[row] = slot
+            self._lru[row] = None
+            updates.append((slot, val))
+        if updates:
+            self._scatter(updates)
+        return len(updates)
+
+    def refresh(self, rows, vals):
+        """Write-through: overwrite already-cached rows with fresh table
+        values (the push-dirty protocol)."""
+        self._scatter([(self.slot_of[r], v) for r, v in zip(rows, vals)])
+
+    def _scatter(self, slot_vals):
+        import jax.numpy as jnp
+
+        idx = np.array([s for s, _ in slot_vals], np.int32)
+        vals = np.stack([v for _, v in slot_vals]).astype(np.float32)
+        self.arr = self.arr.at[idx].set(jnp.asarray(vals))
+
+
+# ---------------------------------------------------------------------------
+# the prefetcher
+# ---------------------------------------------------------------------------
+
+
+class _TableState:
+    """Per-table pipeline bookkeeping (see HostEmbeddingPrefetcher)."""
+
+    __slots__ = ("table", "ids_name", "push_sites", "cache", "applied",
+                 "dirty_log", "dirty_base", "cache_clean", "names")
+
+    def __init__(self, table, ids_name, push_sites, cache):
+        self.table = table
+        self.ids_name = ids_name
+        self.push_sites = push_sites
+        self.cache = cache
+        self.applied = 0          # optimizer applications observed
+        self.dirty_log = []       # list of np row arrays, per application
+        self.dirty_base = 0       # absolute index of dirty_log[0]
+        self.cache_clean = 0      # abs dirty index the cache is synced to
+        self.names = feed_names(table.name)
+
+    def dirty_end(self):
+        return self.dirty_base + len(self.dirty_log)
+
+    def dirty_since(self, abs_idx):
+        ents = self.dirty_log[max(0, abs_idx - self.dirty_base):]
+        if not ents:
+            return None
+        return np.unique(np.concatenate(ents))
+
+    def trim_dirty(self, keep_from):
+        drop = min(max(0, keep_from - self.dirty_base),
+                   len(self.dirty_log))
+        if drop:
+            del self.dirty_log[:drop]
+            self.dirty_base += drop
+
+
+class _TableTicket:
+    __slots__ = ("ids", "u_rows", "inv", "buf", "pulled", "log_idx")
+
+    def __init__(self, ids):
+        self.ids = ids
+        self.log_idx = None
+
+
+class _Ticket:
+    __slots__ = ("per_table", "done", "error")
+
+    def __init__(self):
+        self.per_table = {}
+        self.done = threading.Event()
+        self.error = None
+
+
+class HostEmbeddingPrefetcher:
+    """Stages each batch's embedding rows one step ahead of the device.
+
+    Wiring (train_from_dataset):
+
+        pipeline = maybe_pipeline(program)          # decorates program
+        batches = pipeline.announce_iter(batches)   # taps the id stream
+        for feed in prefetch_iter(batches, device_feeder):
+            feed = pipeline.finalize_into(feed)     # merge staged arrays
+            exe.run(program, feed=feed, ...)
+
+    `announce_iter` sees batch t+1 while the device still owns batch t
+    (the FeedPrefetcher lookahead pulls ahead of consumption), so the
+    dedup + host gather run on this object's worker thread concurrently
+    with the compiled step. `finalize_into` then settles coherence for
+    the batch actually about to run: barrier on prior steps' pushes,
+    re-pull rows dirtied since the gather, serve hot rows from the
+    device cache, and hand the step its staged feeds."""
+
+    def __init__(self, program, cfg):
+        from .host_embedding import HostEmbeddingTable
+
+        self.program = program
+        self.cfg = cfg
+        sites, push_sites = _inspect_program(program)
+        self._tables = {}
+        for tab in cfg.tables:
+            ids_v, n = sites[tab]
+            table = HostEmbeddingTable.get(tab)
+            cache = (HotRowCache(table, cfg.cache_rows, cfg.cache_admit)
+                     if cfg.cache_rows > 0 else None)
+            self._tables[tab] = _TableState(
+                table, ids_v.name, push_sites.get(tab, 0), cache)
+        # finalize/observer rendezvous: applied-push counts, dirty logs
+        # and caches all mutate under this condition's lock
+        self._cv = threading.Condition()
+        self._steps_finalized = 0
+        self._pending = deque()
+        self._observers = []
+        for tab, ts in self._tables.items():
+            fn = self._make_observer(ts)
+            ts.table.add_push_observer(fn)
+            self._observers.append((ts.table, fn))
+        self._work = queue.Queue()
+        self._closed = False
+        self._worker = threading.Thread(
+            target=self._worker_run, name="embed-prefetch", daemon=True)
+        self._worker.start()
+        # arm the rewrite pass: the decoration travels into the compile
+        # clone (Program.clone) and flips the pipeline cache key
+        program._embed_config = cfg
+
+    # -- push observation -------------------------------------------------
+
+    def _make_observer(self, ts):
+        def on_push(rows_global, n_pushes):
+            with self._cv:
+                ts.applied += n_pushes
+                ts.dirty_log.append(np.asarray(rows_global))
+                self._cv.notify_all()
+        return on_push
+
+    # -- the announce leg (background gather) -----------------------------
+
+    def announce(self, feed):
+        """Snapshot batch ids and enqueue the background gather; returns
+        the ticket finalize_into will settle (FIFO)."""
+        ticket = _Ticket()
+        for tab, ts in self._tables.items():
+            if ts.ids_name not in feed:
+                raise KeyError(
+                    "embed prefetch: batch feed has no %r (the Ids input "
+                    "of table %r); feeds present: %s"
+                    % (ts.ids_name, tab, sorted(feed)))
+            ids = np.asarray(feed[ts.ids_name]).copy()
+            ticket.per_table[tab] = _TableTicket(ids)
+        self._pending.append(ticket)
+        self._work.put(ticket)
+        return ticket
+
+    def announce_iter(self, batches):
+        """Tap a batch-feed stream: announce each batch as the H2D
+        lookahead pulls it, pass the feed through unchanged."""
+        for feed in batches:
+            self.announce(feed)
+            yield feed
+
+    def _worker_run(self):
+        while True:
+            ticket = self._work.get()
+            if ticket is None:
+                return
+            try:
+                for tab, ts in self._tables.items():
+                    self._gather_one(ts, ticket.per_table[tab])
+            except BaseException as e:  # re-raised on the training thread
+                ticket.error = e
+            finally:
+                ticket.done.set()
+
+    def _gather_one(self, ts, tt):
+        rows_glob = ts.table.global_rows(tt.ids)
+        u_rows, inv = np.unique(rows_glob, return_inverse=True)
+        with self._cv:
+            # everything pushed from here on is "dirty": it may or may
+            # not be visible to the pull below, so finalize re-pulls it
+            tt.log_idx = ts.dirty_end()
+            cached = (np.array([r in ts.cache.slot_of for r in u_rows],
+                               bool)
+                      if ts.cache is not None
+                      else np.zeros(u_rows.size, bool))
+        to_pull = u_rows[~cached]
+        t0 = time.perf_counter()
+        vals = (ts.table.pull(to_pull) if to_pull.size
+                else np.zeros((0, ts.table.dim), np.float32))
+        if _metrics.enabled():
+            _metrics.histogram("embed/gather_ms").observe(
+                (time.perf_counter() - t0) * 1e3)
+        # pad the unique rows into a buffer of STATIC length n_flat_ids:
+        # n_unique varies batch to batch and would retrace the jitted
+        # step; the tail rows stay zero and are never indexed
+        buf = np.zeros((rows_glob.size, ts.table.dim), np.float32)
+        buf[np.flatnonzero(~cached)] = vals
+        tt.u_rows, tt.inv = u_rows, inv.astype(np.int32)
+        tt.buf, tt.pulled = buf, ~cached
+
+    # -- the finalize leg (coherence + merge) -----------------------------
+
+    def _wait_prior_pushes(self):
+        """Barrier: every push the already-consumed steps owe must be
+        APPLIED before this step's values are settled — the synchronous
+        path's implicit ordering, restated as a count. Each executed
+        step owes `push_sites` applications per table (the Communicator
+        reports merged batches with their multiplicity)."""
+        t = self._steps_finalized
+        need = {tab: t * ts.push_sites for tab, ts in self._tables.items()
+                if ts.push_sites}
+        if not need:
+            return
+        check_blocking("cond.wait", "embed_pipeline.finalize")
+        deadline = time.monotonic() + _BARRIER_TIMEOUT_S
+        with self._cv:
+            while any(self._tables[tab].applied < n
+                      for tab, n in need.items()):
+                left = deadline - time.monotonic()
+                if left <= 0 or not self._cv.wait(timeout=min(left, 1.0)):
+                    if time.monotonic() >= deadline:
+                        got = {tab: self._tables[tab].applied
+                               for tab in need}
+                        raise RuntimeError(
+                            "embed prefetch coherence barrier timed out "
+                            "after %.0fs: step %d needs applied pushes "
+                            "%r but observed %r — is the Communicator "
+                            "send thread alive?"
+                            % (_BARRIER_TIMEOUT_S, t, need, got))
+
+    def finalize_into(self, feed):
+        """Settle the oldest announced batch and return `feed` merged
+        with its staged embedding arrays (the feeds the rewritten step
+        consumes). Must be called exactly once per announced batch, in
+        order, immediately before the step runs."""
+        if not self._pending:
+            raise RuntimeError("finalize_into with no announced batch")
+        ticket = self._pending.popleft()
+        self._wait_prior_pushes()
+        if not ticket.done.is_set():
+            check_blocking("event.wait", "embed_pipeline.finalize")
+            ticket.done.wait()
+        if ticket.error is not None:
+            raise RuntimeError("embed prefetch gather worker died") \
+                from ticket.error
+        merged = dict(feed)
+        rec = _metrics.enabled()
+        with self._cv:
+            for tab, ts in self._tables.items():
+                tt = ticket.per_table[tab]
+                self._settle_table(ts, tt, merged, rec)
+            for tab, ts in self._tables.items():
+                # entries older than every outstanding gather's snapshot
+                # can never be asked for again; a not-yet-processed
+                # ticket will snapshot at >= the current end
+                idxs = [t.per_table[tab].log_idx
+                        if t.per_table[tab].log_idx is not None
+                        else ts.dirty_end()
+                        for t in self._pending]
+                ts.trim_dirty(min(idxs) if idxs else ts.dirty_end())
+            self._steps_finalized += 1
+        return merged
+
+    def _settle_table(self, ts, tt, merged, rec):
+        u_rows, cache = tt.u_rows, ts.cache
+        dirty = ts.dirty_since(tt.log_idx)
+        dirty_set = set(dirty.tolist()) if dirty is not None else ()
+        hit = None
+        if cache is not None:
+            # write-through refresh: cached rows dirtied by pushes take
+            # their fresh table values BEFORE this step reads the cache
+            # — pull(raw_ids) and the cached path agree. The window is
+            # the cache's own watermark, NOT the gather snapshot: a late
+            # gather may snapshot AFTER pushes the cache never saw.
+            cache_dirty = ts.dirty_since(ts.cache_clean)
+            if cache_dirty is not None:
+                stale = [r for r in cache_dirty.tolist()
+                         if r in cache.slot_of]
+                if stale:
+                    cache.refresh(stale, ts.table.pull(
+                        np.asarray(stale, np.int64)))
+            ts.cache_clean = ts.dirty_end()
+            hit = np.array([r in cache.slot_of for r in u_rows], bool)
+            for r in u_rows[hit].tolist():
+                cache.touch(r)
+        # staged-buffer fixup: a buffer row is served only when not a
+        # cache hit; it must be re-pulled when the gather skipped it
+        # (cached then, evicted since) or a push dirtied it after the
+        # gather snapshot
+        serve_buf = ~hit if hit is not None else np.ones(u_rows.size, bool)
+        need = serve_buf & (~tt.pulled
+                            | np.array([r in dirty_set
+                                        for r in u_rows.tolist()], bool))
+        n_fix = int(np.count_nonzero(need))
+        if n_fix:
+            tt.buf[np.flatnonzero(need)] = ts.table.pull(u_rows[need])
+        if rec:
+            n_hit = int(hit.sum()) if hit is not None else 0
+            _metrics.counter("embed/cache_hits").inc(n_hit)
+            # unique rows served straight from the background gather —
+            # neither a cache hit nor an in-finalize repair
+            _metrics.counter("embed/prefetch_hits").inc(
+                int(u_rows.size) - n_hit - n_fix)
+        if cache is not None:
+            # frequency admission: rows touched by `admit` distinct
+            # batches earn a slot, seeded with this step's fresh value
+            admit = [(r, tt.buf[k])
+                     for k, r in enumerate(u_rows.tolist())
+                     if cache.note_use(r) and not (hit is not None
+                                                   and hit[k])]
+            if admit:
+                # this step's hits keep their slots: the Slot feed below
+                # bakes them in, so evicting one would point the step at
+                # a reused slot holding some other row's value
+                cache.admit_rows(admit, protect=set(
+                    u_rows[hit].tolist()) if hit is not None else ())
+        merged[ts.names["rows"]] = tt.buf
+        merged[ts.names["inv"]] = tt.inv
+        if cache is not None:
+            # padded to the buffer's static n_flat length like the rows
+            # themselves (the tail is never indexed by inv)
+            n = tt.buf.shape[0]
+            hit_f = np.zeros(n, np.int32)
+            hit_f[:u_rows.size] = hit.astype(np.int32)
+            slot_f = np.zeros(n, np.int32)
+            slot_f[:u_rows.size] = [cache.slot_of.get(r, 0)
+                                    for r in u_rows.tolist()]
+            merged[ts.names["hit"]] = hit_f
+            merged[ts.names["slot"]] = slot_f
+            merged[ts.names["cache"]] = cache.arr
+
+    # -- lifecycle --------------------------------------------------------
+
+    def close(self):
+        """Detach: stop the worker, unregister observers and remove the
+        program decoration so later direct exe.run calls compile the
+        legacy synchronous lookup again (the no-pipeline fallback)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._work.put(None)
+        self._worker.join(timeout=10)
+        for table, fn in self._observers:
+            table.remove_push_observer(fn)
+        self._observers = []
+        if getattr(self.program, "_embed_config", None) is self.cfg:
+            del self.program._embed_config
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# ---------------------------------------------------------------------------
+# the rewrite pass
+# ---------------------------------------------------------------------------
+
+
+@register_pass("embed_prefetch_rewrite")
+class EmbedPrefetchRewritePass(Pass):
+    """Rewire `lookup_table_host` ops to `lookup_table_prefetched` on the
+    compile clone (the amp_rewrite in-place decoration pattern).
+    Soundness:
+
+      - fires only under an active pipeline decoration (`_embed_config`,
+        set by HostEmbeddingPrefetcher) — a bare env flag never rewrites;
+      - the staged vars are created `is_data` (fed every step by
+        finalize_into), so the verifier's use-before-def anchor holds;
+      - every grad op whose `__fwd_op__` is a rewritten lookup gains the
+        new input slots: `_gather_grad_ins` iterates the GRAD op's own
+        slots, so without them the generic vjp kernel would miss Rows/
+        Inv at apply time. No `__grad_in_map__` entries are needed — the
+        new slots are nondiff (zero/float0 cotangents, never named);
+      - the backward push is the kernel's own io_callback, byte-
+        identical to the legacy op's, so table updates are unchanged.
+    """
+
+    def apply(self, program, scope=None):
+        cfg = active_config(program)
+        if cfg is None:
+            return program
+        from .host_embedding import HostEmbeddingTable
+
+        grad_ops = {}
+        for blk in program.blocks:
+            for op in blk.ops:
+                fwd = op.attrs.get("__fwd_op__")
+                if fwd is not None:
+                    grad_ops.setdefault(id(fwd), []).append(op)
+        block = program.global_block()
+        for op in list(block.ops):
+            if op.type != "lookup_table_host":
+                continue
+            tab = op.attrs["table_name"]
+            if tab not in cfg.tables:
+                continue
+            dim = HostEmbeddingTable.get(tab).dim
+            names = feed_names(tab)
+            new_ins = {
+                "Rows": block.create_var(
+                    name=names["rows"], shape=[-1, dim], dtype="float32",
+                    is_data=True, stop_gradient=True),
+                "Inv": block.create_var(
+                    name=names["inv"], shape=[-1], dtype="int32",
+                    is_data=True, stop_gradient=True),
+            }
+            if cfg.cache_rows > 0:
+                new_ins["Hit"] = block.create_var(
+                    name=names["hit"], shape=[-1], dtype="int32",
+                    is_data=True, stop_gradient=True)
+                new_ins["Slot"] = block.create_var(
+                    name=names["slot"], shape=[-1], dtype="int32",
+                    is_data=True, stop_gradient=True)
+                new_ins["Cache"] = block.create_var(
+                    name=names["cache"], shape=[cfg.cache_rows, dim],
+                    dtype="float32", is_data=True, stop_gradient=True)
+            op.type = "lookup_table_prefetched"
+            for slot, v in new_ins.items():
+                op.inputs[slot] = [v]
+            for gop in grad_ops.get(id(op), ()):
+                for slot, v in new_ins.items():
+                    gop.inputs[slot] = [v]
+        return program

@@ -9,14 +9,29 @@ device-side op gathers only the rows a step touches via jax.pure_callback
 (a few KB over PCIe instead of the whole table in HBM), and the backward
 pass pushes sparse row gradients back with jax.experimental.io_callback —
 the TPU analogue of PullSparseVarsSync/PushSparseVarsWithLabelAsync
-(framework/fleet/fleet_wrapper.h:62/:95)."""
+(framework/fleet/fleet_wrapper.h:62/:95).
+
+The prefetched fast path (docs/RECOMMENDER.md) replaces the in-step
+pure_callback gather: the HostEmbeddingPrefetcher announces batch t+1's
+ids a step ahead and the compiled step reads the staged [n, dim] buffer
+through `prefetched_embedding_lookup` instead."""
 
 
 import numpy as np
 
-__all__ = ["HostEmbeddingTable", "host_embedding_lookup"]
+from ..observability import metrics as _metrics
+
+__all__ = ["HostEmbeddingTable", "host_embedding_lookup",
+           "prefetched_embedding_lookup", "EmbeddingStateError",
+           "tables_state_dict", "load_tables_state_dict"]
 
 _TABLES = {}
+
+
+class EmbeddingStateError(ValueError):
+    """A table state_dict does not match the table's geometry (shard
+    count, row split or embedding dim). Raised by load_state_dict instead
+    of numpy's cryptic broadcast error (or, worse, a silent broadcast)."""
 
 
 def fold_ids(ids, mod):
@@ -65,6 +80,10 @@ class HostEmbeddingTable:
         from ..analysis.concurrency import make_lock
 
         self._lock = make_lock("parallel.host_table")
+        # applied-push observers (HostEmbeddingPrefetcher coherence): each
+        # fn(global_rows, n_pushes) fires AFTER an optimizer application,
+        # outside the table lock, on whichever thread applied it
+        self._push_observers = []
         _TABLES[name] = self
 
     # -- shard addressing -------------------------------------------------
@@ -87,6 +106,27 @@ class HostEmbeddingTable:
         local = ids // self.num_shards
         return shard, local
 
+    def global_rows(self, ids):
+        """Fold raw ids into canonical table row indices ([N] int64 in
+        [0, num_rows)). The prefetcher keys its dedup/cache maps on these
+        so training-time folds and pull(raw_ids) agree by construction."""
+        shard, local = self._locate(ids)
+        return local * self.num_shards + shard
+
+    @staticmethod
+    def _shard_groups(shard):
+        """Group flat positions by owning shard with ONE stable argsort
+        instead of num_shards full boolean-mask passes (the old
+        O(num_shards·N) loop made 64-shard tables pay 64 scans per
+        step). Stable order keeps each group's positions in original
+        request order, so duplicate-id gradient accumulation is bitwise
+        the masked loop's. Yields (shard_idx, positions)."""
+        order = np.argsort(shard, kind="stable")
+        uniq, starts = np.unique(shard[order], return_index=True)
+        bounds = np.append(starts, order.size)
+        for k in range(uniq.size):
+            yield int(uniq[k]), order[bounds[k]:bounds[k + 1]]
+
     # -- pull / push (the RPC surface of the reference) -------------------
 
     def pull(self, ids):
@@ -94,10 +134,13 @@ class HostEmbeddingTable:
         shard, local = self._locate(ids)
         out = np.empty((len(shard), self.dim), self._shards[0].dtype)
         with self._lock:
-            for s in range(self.num_shards):
-                m = shard == s
-                if m.any():
-                    out[m] = self._shards[s][local[m]]
+            if self.num_shards == 1:
+                out[...] = self._shards[0][local]
+            else:
+                for s, sel in self._shard_groups(shard):
+                    out[sel] = self._shards[s][local[sel]]
+        if _metrics.enabled():
+            _metrics.counter("embed/pull_rows").inc(len(shard))
         return out
 
     def push(self, ids, grads):
@@ -111,23 +154,30 @@ class HostEmbeddingTable:
             return
         self._apply_push(ids, grads)
 
-    def _apply_push(self, ids, grads):
+    def _apply_push(self, ids, grads, n_pushes=1):
         """O(touched rows) work and memory: grads for duplicate ids are
         segment-summed into a [n_touched, dim] buffer — never a dense
-        full-shard array (the 1e8-row use case this module exists for)."""
+        full-shard array (the 1e8-row use case this module exists for).
+        `n_pushes` is how many logical step-pushes this application
+        carries (the Communicator merges before applying)."""
         shard, local = self._locate(ids)
         grads = np.asarray(grads).reshape(len(shard), self.dim)
         lr = self.learning_rate
+        touched_total = 0
         with self._lock:
-            for s in range(self.num_shards):
-                m = shard == s
-                if not m.any():
-                    continue
-                rows = local[m]
+            if self.num_shards == 1:
+                groups = [(0, None)]
+            else:
+                groups = self._shard_groups(shard)
+            for s, sel in groups:
+                if sel is None:
+                    rows, g_in = local, grads
+                else:
+                    rows, g_in = local[sel], grads[sel]
                 touched, inv = np.unique(rows, return_inverse=True)
                 g = np.zeros((len(touched), self.dim),
                              self._shards[s].dtype)
-                np.add.at(g, inv, grads[m])  # duplicate ids accumulate
+                np.add.at(g, inv, g_in)  # duplicate ids accumulate
                 if self.optimizer == "adagrad":
                     acc = self._accum[s][touched] + g * g
                     self._accum[s][touched] = acc
@@ -135,6 +185,24 @@ class HostEmbeddingTable:
                                                           + 1e-6)
                 else:  # sgd
                     self._shards[s][touched] -= lr * g
+                touched_total += len(touched)
+        if _metrics.enabled():
+            _metrics.counter("embed/push_rows").inc(touched_total)
+        if self._push_observers:
+            rows_global = local * self.num_shards + shard
+            for fn in list(self._push_observers):
+                fn(rows_global, n_pushes)
+
+    # -- push observation (prefetcher coherence) --------------------------
+
+    def add_push_observer(self, fn):
+        self._push_observers.append(fn)
+
+    def remove_push_observer(self, fn):
+        try:
+            self._push_observers.remove(fn)
+        except ValueError:
+            pass
 
     # -- whole-table io (checkpoint parity io.py:280) ---------------------
 
@@ -145,18 +213,77 @@ class HostEmbeddingTable:
         return d
 
     def load_state_dict(self, d):
+        """Restore shard (and adagrad accumulator) arrays, validating
+        every entry against the table geometry first — a state saved
+        from a table with a different shard count, row count or dim
+        raises EmbeddingStateError naming the mismatch instead of numpy
+        broadcasting (or crashing) row-splits together."""
+        extra = sorted(k for k in d
+                       if k.startswith(("shard_", "accum_"))
+                       and int(k.split("_")[1]) >= self.num_shards)
+        if extra:
+            raise EmbeddingStateError(
+                "table %r has %d shards but the state carries %s — it "
+                "was saved from a table with a different num_shards"
+                % (self.name, self.num_shards, extra))
+        staged = []
         for s in range(self.num_shards):
-            self._shards[s][...] = d["shard_%d" % s]
+            key = "shard_%d" % s
+            if key not in d:
+                raise EmbeddingStateError(
+                    "table %r: state is missing %r (table has %d shards; "
+                    "state keys: %s)"
+                    % (self.name, key, self.num_shards, sorted(d)))
+            arr = np.asarray(d[key])
+            if arr.shape != self._shards[s].shape:
+                raise EmbeddingStateError(
+                    "table %r shard %d: state has shape %s but the table "
+                    "(num_rows=%d, dim=%d, num_shards=%d) holds %s — "
+                    "geometry must match exactly"
+                    % (self.name, s, arr.shape, self.num_rows, self.dim,
+                       self.num_shards, self._shards[s].shape))
+            staged.append((self._shards[s], arr))
             if self.optimizer == "adagrad" and ("accum_%d" % s) in d:
-                self._accum[s][...] = d["accum_%d" % s]
+                acc = np.asarray(d["accum_%d" % s])
+                if acc.shape != self._accum[s].shape:
+                    raise EmbeddingStateError(
+                        "table %r accum_%d: state has shape %s but the "
+                        "table holds %s"
+                        % (self.name, s, acc.shape, self._accum[s].shape))
+                staged.append((self._accum[s], acc))
+        # validate-then-commit: a mid-load raise must not leave the table
+        # half old state, half new
+        with self._lock:
+            for dst, src in staged:
+                dst[...] = src
 
     @staticmethod
     def get(name):
-        return _TABLES[name]
+        try:
+            return _TABLES[name]
+        except KeyError:
+            raise KeyError(
+                "no host embedding table named %r; existing tables: %s"
+                % (name, sorted(_TABLES) or "(none)")) from None
 
     @staticmethod
     def reset_registry():
         _TABLES.clear()
+
+
+def tables_state_dict():
+    """{table_name: state_dict} for every registered table — the sparse
+    half of a training checkpoint (flush the Communicator first; see
+    checkpoint.host_embedding_state)."""
+    return {name: t.state_dict() for name, t in _TABLES.items()}
+
+
+def load_tables_state_dict(state):
+    """Restore tables_state_dict() output into the live registry. Every
+    named table must already exist (tables are created by model build,
+    not by restore) and match geometry."""
+    for name, d in state.items():
+        HostEmbeddingTable.get(name).load_state_dict(d)
 
 
 def host_embedding_lookup(table_name, ids, anchor=None):
@@ -204,3 +331,62 @@ def host_embedding_lookup(table_name, ids, anchor=None):
 
     lookup.defvjp(fwd, bwd)
     return lookup(anchor, ids)
+
+
+def _zero_cotangent(x):
+    import jax
+    import jax.numpy as jnp
+
+    if jnp.issubdtype(jnp.result_type(x), jnp.inexact):
+        return jnp.zeros(jnp.shape(x), jnp.result_type(x))
+    return np.zeros(np.shape(x), jax.dtypes.float0)
+
+
+def prefetched_embedding_lookup(table_name, ids, anchor, rows, inv,
+                                hit=None, slot=None, cache=None):
+    """The prefetch fast path of host_embedding_lookup (docs/
+    RECOMMENDER.md): no host callback in the forward. `rows` is the
+    [n, dim] unique-row buffer the HostEmbeddingPrefetcher gathered a
+    step ahead, `inv` the [n_flat_ids] inverse indices into it. With the
+    hot-row cache on, `hit`/`slot` mark unique rows served from the
+    device-resident `cache` array instead of the staged buffer.
+
+    The backward is EXACTLY the legacy one — an ordered io_callback push
+    of (flat ids, row grads) — so post-push table state is bitwise the
+    synchronous path's on the same id/grad stream."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import io_callback
+
+    dim = _TABLES[table_name].dim
+    has_cache = cache is not None
+    extras = (hit, slot, cache) if has_cache else ()
+
+    @jax.custom_vjp
+    def lookup(anchor_, ids_, rows_, inv_, extras_):
+        if extras_:
+            hit_, slot_, cache_ = extras_
+            uniq = jnp.where((hit_ != 0)[:, None],
+                             cache_[slot_], rows_)
+        else:
+            uniq = rows_
+        out = uniq[inv_]
+        return out.reshape(ids_.shape + (dim,))
+
+    def fwd(anchor_, ids_, rows_, inv_, extras_):
+        return lookup(anchor_, ids_, rows_, inv_, extras_), \
+            (anchor_, ids_, rows_, inv_, extras_)
+
+    def bwd(res, ct):
+        anchor_, ids_, rows_, inv_, extras_ = res
+        flat = ids_.reshape((-1,))
+        g = ct.reshape((-1, dim))
+        io_callback(
+            lambda i, gg: _TABLES[table_name].push(i, gg),
+            None, flat, g, ordered=True)
+        return (jnp.zeros_like(anchor_), _zero_cotangent(ids_),
+                _zero_cotangent(rows_), _zero_cotangent(inv_),
+                tuple(_zero_cotangent(x) for x in extras_))
+
+    lookup.defvjp(fwd, bwd)
+    return lookup(anchor, ids, rows, inv, extras)

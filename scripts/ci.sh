@@ -80,6 +80,19 @@
 #                train-while-serving pytest leg and the bench
 #                steady-vs-rollout throughput pair (ratio floor
 #                retried like serve's; functional gates every attempt)
+#   rec        - recommender fast-path receipt (docs/RECOMMENDER.md):
+#                a host-table DeepFM CTR run twice — legacy sync
+#                lookups vs async prefetch + hot-row device cache —
+#                under PTPU_VERIFY_PASSES=1 + PTPU_LOCK_CHECK=1 with
+#                switch-interval jitter, gating bitwise-identical
+#                losses and table state across modes,
+#                embed/prefetch_hits >= 1, embed/cache_hits >= 1,
+#                verify/violations == 0 and concurrency/violations
+#                == 0; then the bench three-leg receipt (sync /
+#                overlap / overlap+cache) gating
+#                bench/rec_bitwise_identical == 1 every attempt and
+#                the overlapped-vs-sync throughput floor retried like
+#                serve's ratios (shared-box timing)
 #   zero       - ZeRO ladder + comm/compute overlap receipt
 #                (docs/ZERO.md): one tiny MLP through ZeRO-1 per-leaf /
 #                bucketed-no-overlap (the PR-5 path) / ZeRO-2 overlap /
@@ -87,7 +100,7 @@
 #                gating numerics per rung, losses decreasing, offload
 #                bytes moved, and the step-time overlap receipt
 #                (overlapped <= non-overlapped)
-# Usage: scripts/ci.sh [build|test|api_check|bench|bench-smoke|stress|obs|chaos|data-chaos|amp|serve|lint|race|verify|quant|zero|fleet|online|all]
+# Usage: scripts/ci.sh [build|test|api_check|bench|bench-smoke|stress|obs|chaos|data-chaos|amp|serve|lint|race|verify|quant|rec|zero|fleet|online|all]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -1018,6 +1031,145 @@ print("quant stage ok:",
 PYEOF
 }
 
+do_rec() {
+  # Recommender fast-path receipt (docs/RECOMMENDER.md). (a) the
+  # cached/prefetched CTR run must be BITWISE the legacy synchronous
+  # run — same per-step losses, same final table shards + optimizer
+  # accumulators — while the IR verifier checks every rewritten
+  # program and the lock tracker (plus switch-interval jitter) watches
+  # the gather worker, the push queue and the coherence barrier race
+  # against the training loop. Gates: identity asserts in-leg,
+  # embed/prefetch_hits >= 1, embed/cache_hits >= 1,
+  # verify/violations == 0, concurrency/violations == 0.
+  local dump=/tmp/ptpu_rec_metrics.json legs=/tmp/ptpu_rec_legs.json
+  rm -f "$dump"
+  JAX_PLATFORMS=cpu PTPU_METRICS=1 PTPU_METRICS_OUT="$dump" \
+    PTPU_VERIFY_PASSES=1 PTPU_LOCK_CHECK=1 \
+    python - <<'PYEOF'
+import os
+import sys
+
+sys.setswitchinterval(1e-5)  # flush thread interleavings
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import framework, initializer, unique_name
+from paddle_tpu.core import scope as scope_mod
+from paddle_tpu.models import deepfm
+from paddle_tpu.parallel import host_embedding
+from paddle_tpu.parallel.host_embedding import HostEmbeddingTable
+from paddle_tpu.recordio_writer import convert_reader_to_recordio_file
+
+paths = []
+for s in range(2):
+    p = "/tmp/ptpu_rec_ci_%d.rec" % s
+    rng = np.random.RandomState(100 + s)
+
+    def gen(rng=rng):
+        for _ in range(96):
+            hot = rng.rand(4) < 0.5
+            ids = np.where(hot, rng.randint(0, 16, 4),
+                           rng.randint(0, 256, 4))
+            yield (ids.astype(np.int64),
+                   np.array([rng.randint(0, 2)], np.float32))
+
+    convert_reader_to_recordio_file(p, gen)
+    paths.append(p)
+
+
+class V:
+    def __init__(self, name):
+        self.name = name
+
+
+def run_leg(env):
+    for k in ("PTPU_EMBED_PREFETCH", "PTPU_EMBED_CACHE_ROWS"):
+        os.environ.pop(k, None)
+    os.environ.update(env)
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    unique_name.switch()
+    scope_mod._scope_stack[:] = [scope_mod.Scope()]
+    HostEmbeddingTable.reset_registry()
+    initializer._global_seed_counter[0] = 0
+    np.random.seed(42)
+    ds = fluid.DatasetFactory().create_dataset("QueueDataset")
+    ds.set_batch_size(16)
+    ds.set_filelist(paths)
+    main_p, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main_p, startup):
+        _feeds, _pred, avg_cost = deepfm.build_distributed(
+            vocab_size=256, num_fields=4, embed_dim=8, mlp_dims=(16,),
+            num_shards=2, learning_rate=0.05)
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(avg_cost)
+    ds.set_use_var([V("ids"), V("label")])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for _epoch in range(2):
+        out = exe.train_from_dataset(program=main_p, dataset=ds,
+                                     fetch_list=[avg_cost])
+        losses.append(np.asarray(out[0]).copy())
+    return losses, host_embedding.tables_state_dict()
+
+
+sync_l, sync_s = run_leg({})
+fast_l, fast_s = run_leg({"PTPU_EMBED_PREFETCH": "1",
+                          "PTPU_EMBED_CACHE_ROWS": "64"})
+for a, b in zip(sync_l, fast_l):
+    assert a.tobytes() == b.tobytes(), ("loss diverged", a, b)
+for tab in sync_s:
+    for key in sync_s[tab]:
+        assert (np.asarray(sync_s[tab][key]).tobytes()
+                == np.asarray(fast_s[tab][key]).tobytes()), \
+            ("table state diverged", tab, key)
+print("rec ci: cached+prefetched run bitwise-identical to sync, "
+      "final loss", float(sync_l[-1].ravel()[0]))
+PYEOF
+  python tools/ptpu_stats.py "$dump" \
+    --assert-min embed/prefetch_hits=1 embed/cache_hits=1 \
+                 embed/pull_rows=1 embed/push_rows=1 \
+                 verify/programs_checked=1 concurrency/locks_tracked=1 \
+    --assert-max verify/violations=0 concurrency/violations=0
+  # (b) the bench three-leg receipt. Bitwise identity and a nonzero
+  # cache hit rate are functional gates that hold on EVERY attempt;
+  # the overlapped-vs-sync examples/s floor is a timing measurement on
+  # a shared box, so it retries up to twice (the serve stage's ratio
+  # pattern). The floor is 0.8: on CPU the host gather is nearly free
+  # so overlap can only tie — the gauge records the real win on TPU,
+  # the gate only proves the fast path never collapses throughput.
+  local attempt rc=1
+  for attempt in 1 2 3; do
+    rm -f "$dump" "$legs"
+    JAX_PLATFORMS=cpu PTPU_METRICS=1 \
+      python bench.py --rec-only --metrics-out "$dump" \
+      --legs-out "$legs"
+    python tools/ptpu_stats.py "$dump" \
+      --assert-has bench/rec_examples_per_sec_sync \
+                   bench/rec_examples_per_sec_overlap \
+                   bench/rec_examples_per_sec_cache \
+                   bench/rec_cache_hit_rate \
+      --assert-min bench/rec_bitwise_identical=1 \
+                   embed/cache_hits=1 embed/prefetch_hits=1
+    set +e
+    python tools/ptpu_stats.py "$dump" \
+      --assert-min bench/rec_overlap_speedup=0.8
+    rc=$?
+    set -e
+    [ "$rc" -eq 0 ] && break
+    echo "rec overlap throughput below floor (loaded box?) — retry $attempt/2" >&2
+  done
+  [ "$rc" -eq 0 ]
+  python - "$legs" <<'PYEOF'
+import json, sys
+legs = {e["leg"]: e for e in json.load(open(sys.argv[1]))}
+for need in ("rec_sync", "rec_overlap", "rec_overlap_cache"):
+    assert need in legs, (need, sorted(legs))
+assert legs["rec_overlap_cache"]["bitwise_identical"], legs
+print("rec stage ok:",
+      {k: legs[k]["examples_per_sec"] for k in sorted(legs)})
+PYEOF
+}
+
 do_kernels() {
   # Pallas kernel dispatch receipt (docs/KERNELS.md). (a) under
   # PTPU_KERNELS=1 the registry actually dispatches on the CPU
@@ -1623,10 +1775,11 @@ case "$stage" in
   race) do_race ;;
   verify) do_verify ;;
   quant) do_quant ;;
+  rec) do_rec ;;
   kernels) do_kernels ;;
   zero) do_zero ;;
   fleet) do_fleet ;;
   online) do_online ;;
-  all) do_build; do_lint; do_test; do_api_check; do_bench_smoke; do_chaos; do_data_chaos; do_amp; do_serve; do_fleet; do_online; do_race; do_verify; do_quant; do_kernels; do_zero; do_bench ;;
+  all) do_build; do_lint; do_test; do_api_check; do_bench_smoke; do_chaos; do_data_chaos; do_amp; do_serve; do_fleet; do_online; do_race; do_verify; do_quant; do_rec; do_kernels; do_zero; do_bench ;;
   *) echo "unknown stage: $stage" >&2; exit 2 ;;
 esac

@@ -1,0 +1,384 @@
+"""The recommender fast path's pinned contracts (docs/RECOMMENDER.md):
+
+- flags unset => byte-for-byte the legacy synchronous lookup path
+  (no pipeline, no rewrite, no staged feeds);
+- PTPU_EMBED_PREFETCH / PTPU_EMBED_CACHE_ROWS on => bitwise-identical
+  per-step losses AND post-push table state (shards + optimizer
+  accumulators) to the synchronous path on a fixed id stream;
+- every cached row is bitwise the value `pull` returns (write-through);
+- a killed-and-resumed CTR run (DatasetCursor + checkpoint manifest)
+  replays the byte-identical record stream and table state;
+- the rewritten program is clean under the IR verifier.
+
+The long recordio CTR leg is `-m slow` (tier-1 budget); scripts/ci.sh's
+`rec` stage runs the same identity end-to-end with verifier + lock
+tracker armed.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import framework, initializer, unique_name
+from paddle_tpu.core import scope as scope_mod
+from paddle_tpu.core.scope import global_scope
+from paddle_tpu.models import deepfm
+from paddle_tpu.observability import metrics as obs_metrics
+from paddle_tpu.parallel import host_embedding
+from paddle_tpu.parallel.embedding_pipeline import (active_config,
+                                                    maybe_pipeline)
+from paddle_tpu.parallel.host_embedding import HostEmbeddingTable
+
+VOCAB = 64
+FIELDS = 4
+_ENV_KEYS = ("PTPU_EMBED_PREFETCH", "PTPU_EMBED_CACHE_ROWS",
+             "PTPU_EMBED_CACHE_ADMIT", "PTPU_EMBED_PUSH_QUEUE")
+
+
+@pytest.fixture(autouse=True)
+def _clean_embed_env():
+    saved = {k: os.environ.get(k) for k in _ENV_KEYS}
+    for k in _ENV_KEYS:
+        os.environ.pop(k, None)
+    HostEmbeddingTable.reset_registry()
+    yield
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    HostEmbeddingTable.reset_registry()
+
+
+def _fresh():
+    """Multi-leg reset: every leg must draw the same dense inits (the
+    default-seed counter!) and build from empty registries."""
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    unique_name.switch()
+    scope_mod._scope_stack[:] = [scope_mod.Scope()]
+    HostEmbeddingTable.reset_registry()
+    initializer._global_seed_counter[0] = 0
+    np.random.seed(42)
+
+
+def _build():
+    main_p, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main_p, startup):
+        _feeds, _pred, avg_cost = deepfm.build_distributed(
+            vocab_size=VOCAB, num_fields=FIELDS, embed_dim=4,
+            mlp_dims=(8,), num_shards=2, learning_rate=0.05)
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(avg_cost)
+    return main_p, startup, avg_cost
+
+
+def _id_stream(n_steps, batch=8, seed=0):
+    """Fixed skewed id stream: half the lookups land in a hot head of 8
+    rows so the frequency-admitted cache has something to keep."""
+    rng = np.random.RandomState(seed)
+    feeds = []
+    for _ in range(n_steps):
+        hot = rng.rand(batch, FIELDS) < 0.5
+        ids = np.where(hot, rng.randint(0, 8, (batch, FIELDS)),
+                       rng.randint(0, VOCAB, (batch, FIELDS)))
+        feeds.append({"ids": ids.astype(np.int64),
+                      "label": rng.randint(
+                          0, 2, (batch, 1)).astype(np.float32)})
+    return feeds
+
+
+def _assert_cache_coherent(pipeline):
+    """Every cached row must be bitwise the bytes `pull` returns — the
+    write-through contract, checked right after a finalize (all prior
+    pushes applied and dirty cached rows refreshed)."""
+    for _tab, ts in pipeline._tables.items():
+        cache = ts.cache
+        if cache is None or not cache.slot_of:
+            continue
+        rows = np.array(sorted(cache.slot_of), np.int64)
+        slots = np.array([cache.slot_of[r] for r in rows.tolist()],
+                         np.int32)
+        cached = np.asarray(cache.arr)[slots]
+        assert cached.tobytes() == ts.table.pull(rows).tobytes(), \
+            "cached rows diverged from pull() (write-through broken)"
+
+
+def _run_leg(env, feeds, check_cache=False):
+    """One training leg over a fixed feed stream; returns (per-step loss
+    arrays, final tables state). Mirrors the train_from_dataset wiring
+    (announce stream tap + per-batch finalize) in a manual loop."""
+    for k in _ENV_KEYS:
+        os.environ.pop(k, None)
+    os.environ.update(env)
+    _fresh()
+    main_p, startup, avg_cost = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    pipeline = maybe_pipeline(main_p)
+    losses = []
+    batches = iter([dict(f) for f in feeds])
+    if pipeline is not None:
+        batches = pipeline.announce_iter(batches)
+    try:
+        for i, feed in enumerate(batches):
+            if pipeline is not None:
+                feed = pipeline.finalize_into(feed)
+                if check_cache and i == len(feeds) - 1:
+                    _assert_cache_coherent(pipeline)
+            out = exe.run(main_p, feed=feed, fetch_list=[avg_cost])
+            losses.append(np.asarray(out[0]).copy())
+    finally:
+        if pipeline is not None:
+            pipeline.close()
+    return losses, host_embedding.tables_state_dict()
+
+
+def _assert_bitwise(ref, got, what):
+    ref_l, ref_s = ref
+    got_l, got_s = got
+    assert len(ref_l) == len(got_l)
+    for i, (a, b) in enumerate(zip(ref_l, got_l)):
+        assert a.tobytes() == b.tobytes(), \
+            ("%s: loss diverged at step %d" % (what, i), a, b)
+    assert sorted(ref_s) == sorted(got_s)
+    for tab in ref_s:
+        assert sorted(ref_s[tab]) == sorted(got_s[tab])
+        for key in ref_s[tab]:
+            assert (np.asarray(ref_s[tab][key]).tobytes()
+                    == np.asarray(got_s[tab][key]).tobytes()), \
+                ("%s: table state diverged" % what, tab, key)
+
+
+def test_flags_unset_is_exact_legacy_path():
+    """No flags: no pipeline attaches, no decoration exists, and the
+    program keeps the legacy synchronous lookup op — plain exe.run needs
+    no staged feeds."""
+    _fresh()
+    main_p, startup, avg_cost = _build()
+    assert maybe_pipeline(main_p) is None
+    assert active_config(main_p) is None
+    types = [op.type for blk in main_p.blocks for op in blk.ops]
+    assert "lookup_table_host" in types
+    assert "lookup_table_prefetched" not in types
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = _id_stream(1)[0]
+    out = exe.run(main_p, feed=feed, fetch_list=[avg_cost])
+    assert np.isfinite(np.asarray(out[0])).all()
+
+
+def test_prefetch_and_cache_bitwise_identical_to_sync():
+    """The tentpole pin: sync, prefetch, and prefetch+cache legs over
+    one fixed id stream agree bitwise on every per-step loss and on the
+    final table shards + optimizer accumulators."""
+    feeds = _id_stream(8)
+    sync = _run_leg({}, feeds)
+    overlap = _run_leg({"PTPU_EMBED_PREFETCH": "1"}, feeds)
+    cached = _run_leg({"PTPU_EMBED_PREFETCH": "1",
+                       "PTPU_EMBED_CACHE_ROWS": "16",
+                       "PTPU_EMBED_CACHE_ADMIT": "2"},
+                      feeds, check_cache=True)
+    _assert_bitwise(sync, overlap, "prefetch vs sync")
+    _assert_bitwise(sync, cached, "prefetch+cache vs sync")
+
+
+def test_rewrite_touches_only_the_compile_clone():
+    """The user's program is never mutated: after a prefetch leg runs
+    (and its pipeline closes), the source program still holds the legacy
+    op and the decoration is gone."""
+    feeds = _id_stream(3)
+    os.environ["PTPU_EMBED_PREFETCH"] = "1"
+    _fresh()
+    main_p, startup, avg_cost = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    pipeline = maybe_pipeline(main_p)
+    assert pipeline is not None
+    assert active_config(main_p) is pipeline.cfg
+    try:
+        for feed in pipeline.announce_iter(iter(feeds)):
+            feed = pipeline.finalize_into(feed)
+            exe.run(main_p, feed=feed, fetch_list=[avg_cost])
+    finally:
+        pipeline.close()
+    types = [op.type for blk in main_p.blocks for op in blk.ops]
+    assert "lookup_table_host" in types
+    assert "lookup_table_prefetched" not in types
+    assert active_config(main_p) is None
+
+
+def test_rewritten_program_is_verifier_clean_and_counts_hits(monkeypatch):
+    """PTPU_VERIFY_PASSES=1 over the rewritten step: the staged is_data
+    vars satisfy use-before-def, and the telemetry proves both fast
+    paths actually served rows."""
+    monkeypatch.setenv("PTPU_VERIFY_PASSES", "1")
+    obs_metrics.enable()
+    try:
+        _run_leg({"PTPU_EMBED_PREFETCH": "1",
+                  "PTPU_EMBED_CACHE_ROWS": "16",
+                  "PTPU_EMBED_CACHE_ADMIT": "1"},
+                 _id_stream(6), check_cache=True)
+        reg = obs_metrics.registry()
+        assert reg.counter("verify/programs_checked").value >= 1
+        assert reg.counter("verify/violations").value == 0
+        assert reg.counter("embed/prefetch_hits").value >= 1
+        assert reg.counter("embed/cache_hits").value >= 1
+        assert reg.counter("embed/pull_rows").value >= 1
+        assert reg.counter("embed/push_rows").value >= 1
+    finally:
+        obs_metrics.disable()
+
+
+# ---------------------------------------------------------------------------
+# CTR kill/resume over recordio + DatasetCursor + the checkpoint manifest
+# ---------------------------------------------------------------------------
+
+
+class _V:
+    def __init__(self, name):
+        self.name = name
+
+
+def _write_ctr_shards(data_dir, n_shards=2, records=48):
+    from paddle_tpu.recordio_writer import convert_reader_to_recordio_file
+
+    os.makedirs(str(data_dir), exist_ok=True)
+    paths = []
+    for s in range(n_shards):
+        p = os.path.join(str(data_dir), "ctr-%02d.recordio" % s)
+        rng = np.random.RandomState(100 + s)
+
+        def gen(rng=rng):
+            for _ in range(records):
+                hot = rng.rand(FIELDS) < 0.5
+                ids = np.where(hot, rng.randint(0, 8, FIELDS),
+                               rng.randint(0, VOCAB, FIELDS))
+                yield (ids.astype(np.int64),
+                       np.array([rng.randint(0, 2)], np.float32))
+
+        convert_reader_to_recordio_file(p, gen)
+        paths.append(p)
+    return paths
+
+
+def _make_dataset(paths, batch):
+    ds = fluid.DatasetFactory().create_dataset("QueueDataset")
+    ds.set_batch_size(batch)
+    ds.set_filelist(paths)
+    ds.set_use_var([_V("ids"), _V("label")])
+    return ds
+
+
+def _ctr_leg(paths, stop_after=None, resume_from=None):
+    """One CTR leg over the recordio stream on the full fast path
+    (prefetch + cache). `stop_after=N` is the killed run (returns the
+    checkpoint state at step N); `resume_from=state` restores params,
+    tables and cursor first. Returns (losses, state, final tables)."""
+    from paddle_tpu.checkpoint import (host_embedding_state,
+                                       load_host_embedding_state)
+    from paddle_tpu.data_plane import DatasetCursor
+    from paddle_tpu.io import get_program_persistable_vars
+
+    os.environ["PTPU_EMBED_PREFETCH"] = "1"
+    os.environ["PTPU_EMBED_CACHE_ROWS"] = "16"
+    _fresh()
+    main_p, startup, avg_cost = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    scope = global_scope()
+    cursor = DatasetCursor()
+    if resume_from is not None:
+        for name, arr in resume_from["params"].items():
+            scope.set(name, np.asarray(arr))
+        load_host_embedding_state(resume_from["embed"])
+        cursor = DatasetCursor.from_array(resume_from["cursor"])
+    ds = _make_dataset(paths, batch=12)
+    pipeline = maybe_pipeline(main_p)
+    batches = ds.resumable_batches(cursor, epochs=1, scope=scope)
+    if pipeline is not None:
+        batches = pipeline.announce_iter(batches)
+    losses, state = [], None
+    try:
+        for feed in batches:
+            if pipeline is not None:
+                feed = pipeline.finalize_into(feed)
+            out = exe.run(main_p, feed=feed, fetch_list=[avg_cost])
+            losses.append(np.asarray(out[0]).copy())
+            if stop_after is not None and len(losses) >= stop_after:
+                state = {
+                    "params": {
+                        v.name: np.asarray(scope.get(v.name)).copy()
+                        for v in get_program_persistable_vars(main_p)
+                        if scope.get(v.name) is not None},
+                    "embed": host_embedding_state(),
+                    "cursor": cursor.to_array(),
+                }
+                break
+    finally:
+        if pipeline is not None:
+            pipeline.close()
+    return losses, state, host_embedding.tables_state_dict()
+
+
+def test_killed_and_resumed_ctr_run_bitwise(tmp_path):
+    """Kill after 3 steps, publish the manifest (dense params + table
+    shards/accumulators + DatasetCursor), restore in a fresh process
+    image: the resumed run replays the byte-identical record stream and
+    lands on the byte-identical table state as one uninterrupted run."""
+    from paddle_tpu.checkpoint import (latest_checkpoint,
+                                       restore_checkpoint,
+                                       save_checkpoint)
+
+    paths = _write_ctr_shards(tmp_path / "data")
+    full_losses, _, full_tabs = _ctr_leg(paths)
+    assert len(full_losses) == 8  # 2 shards * 48 records / batch 12
+
+    killed_losses, state, _ = _ctr_leg(paths, stop_after=3)
+    assert len(killed_losses) == 3
+    ckpt_dir = str(tmp_path / "ckpt")
+    save_checkpoint(ckpt_dir, state, 3)
+    restored = restore_checkpoint(latest_checkpoint(ckpt_dir))
+
+    resumed_losses, _, resumed_tabs = _ctr_leg(paths,
+                                               resume_from=restored)
+    stitched = killed_losses + resumed_losses
+    assert len(stitched) == len(full_losses)
+    for i, (a, b) in enumerate(zip(full_losses, stitched)):
+        assert a.tobytes() == b.tobytes(), \
+            ("resumed stream diverged at step %d" % i, a, b)
+    _assert_bitwise((full_losses, full_tabs), (stitched, resumed_tabs),
+                    "killed+resumed vs uninterrupted")
+
+
+@pytest.mark.slow
+def test_ctr_recordio_three_mode_bitwise_slow(tmp_path):
+    """The full train_from_dataset CTR identity (the ci.sh rec stage's
+    in-process twin): sync vs prefetch vs prefetch+cache over recordio
+    shards, two epochs each, bitwise losses and table state."""
+
+    paths = _write_ctr_shards(tmp_path / "data", n_shards=2, records=96)
+
+    def run_leg(env):
+        for k in _ENV_KEYS:
+            os.environ.pop(k, None)
+        os.environ.update(env)
+        _fresh()
+        main_p, startup, avg_cost = _build()
+        ds = _make_dataset(paths, batch=16)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        losses = []
+        for _epoch in range(2):
+            out = exe.train_from_dataset(program=main_p, dataset=ds,
+                                         fetch_list=[avg_cost])
+            losses.append(np.asarray(out[0]).copy())
+        return losses, host_embedding.tables_state_dict()
+
+    sync = run_leg({})
+    overlap = run_leg({"PTPU_EMBED_PREFETCH": "1"})
+    cached = run_leg({"PTPU_EMBED_PREFETCH": "1",
+                      "PTPU_EMBED_CACHE_ROWS": "32"})
+    _assert_bitwise(sync, overlap, "ctr prefetch vs sync")
+    _assert_bitwise(sync, cached, "ctr prefetch+cache vs sync")

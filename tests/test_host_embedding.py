@@ -295,3 +295,185 @@ def test_communicator_surfaces_send_thread_errors():
     with pytest.raises(RuntimeError, match="send thread"):
         comm.flush()
     comm.stop()
+
+
+# -- PR 20 satellites: grouped scatter/gather, state validation, -----------
+# -- fold agreement, bounded pusher ----------------------------------------
+
+
+def _naive_pull(table, ids):
+    """The old per-shard boolean-mask gather, kept as the bitwise oracle
+    for the argsort-grouped fast path."""
+    shard, local = table._locate(ids)
+    out = np.empty((len(shard), table.dim), np.float32)
+    for s in range(table.num_shards):
+        m = shard == s
+        out[m] = table._shards[s][local[m]]
+    return out
+
+
+def _naive_push(table, ids, grads, lr):
+    """Reference update with the old masked loop + identical optimizer
+    math, applied to detached copies; returns the would-be shards."""
+    shard, local = table._locate(ids)
+    grads = np.asarray(grads).reshape(len(shard), table.dim)
+    shards = [sh.copy() for sh in table._shards]
+    accum = ([a.copy() for a in table._accum]
+             if table.optimizer == "adagrad" else None)
+    for s in range(table.num_shards):
+        m = shard == s
+        rows, g_in = local[m], grads[m]
+        touched, inv = np.unique(rows, return_inverse=True)
+        g = np.zeros((len(touched), table.dim), np.float32)
+        np.add.at(g, inv, g_in)
+        if table.optimizer == "adagrad":
+            acc = accum[s][touched] + g * g
+            accum[s][touched] = acc
+            shards[s][touched] -= lr * g / (np.sqrt(acc) + 1e-6)
+        else:
+            shards[s][touched] -= lr * g
+    return shards, accum
+
+
+@pytest.mark.parametrize("num_shards", [1, 2, 5])
+@pytest.mark.parametrize("optimizer", ["sgd", "adagrad"])
+def test_grouped_pull_push_bitwise_matches_masked_loop(num_shards,
+                                                       optimizer):
+    """The single-argsort grouped scatter/gather must be BITWISE the old
+    O(num_shards*N) masked loop — stable sort keeps in-shard request
+    order, so duplicate-id accumulation order is unchanged."""
+    t = HostEmbeddingTable("grp_%d_%s" % (num_shards, optimizer),
+                           num_rows=64, dim=4, num_shards=num_shards,
+                           optimizer=optimizer, learning_rate=0.3,
+                           init_scale=0.1, seed=11)
+    rng = np.random.RandomState(5)
+    ids = rng.randint(0, 64, size=40).astype(np.int64)  # with duplicates
+    assert t.pull(ids).tobytes() == _naive_pull(t, ids).tobytes()
+
+    grads = rng.randn(40, 4).astype(np.float32)
+    want_shards, want_accum = _naive_push(t, ids, grads, lr=0.3)
+    t.push(ids, grads)
+    for s in range(num_shards):
+        assert t._shards[s].tobytes() == want_shards[s].tobytes()
+        if optimizer == "adagrad":
+            assert t._accum[s].tobytes() == want_accum[s].tobytes()
+
+
+@pytest.mark.parametrize("num_shards", [1, 3])
+def test_state_dict_roundtrip_across_shard_counts(num_shards):
+    t = HostEmbeddingTable("rt_%d" % num_shards, num_rows=30, dim=3,
+                           num_shards=num_shards, optimizer="adagrad",
+                           learning_rate=0.5, init_scale=0.2, seed=2)
+    t.push(np.arange(30, dtype=np.int64),
+           np.ones((30, 3), np.float32))
+    state = {k: v.copy() for k, v in t.state_dict().items()}
+    assert sum(k.startswith("shard_") for k in state) == num_shards
+    assert sum(k.startswith("accum_") for k in state) == num_shards
+    before = t.pull(np.arange(30)).copy()
+    t.push(np.arange(30, dtype=np.int64), np.ones((30, 3), np.float32))
+    t.load_state_dict(state)
+    assert t.pull(np.arange(30)).tobytes() == before.tobytes()
+
+
+def test_load_state_dict_names_geometry_mismatches():
+    from paddle_tpu.parallel.host_embedding import EmbeddingStateError
+
+    t = HostEmbeddingTable("geom", num_rows=12, dim=2, num_shards=2,
+                           init_scale=0.1, seed=4)
+    good = {k: v.copy() for k, v in t.state_dict().items()}
+    orig = t.pull(np.arange(12)).copy()
+
+    # state from a 3-shard save: the extra shard key is named
+    with pytest.raises(EmbeddingStateError, match="num_shards"):
+        t.load_state_dict(dict(good, shard_2=good["shard_0"]))
+    # missing shard
+    with pytest.raises(EmbeddingStateError, match="missing 'shard_1'"):
+        t.load_state_dict({"shard_0": good["shard_0"]})
+    # wrong shape names the table geometry, and validate-then-commit
+    # leaves the table untouched
+    with pytest.raises(EmbeddingStateError, match="geometry"):
+        t.load_state_dict({"shard_0": good["shard_0"],
+                           "shard_1": good["shard_1"][:-1]})
+    assert t.pull(np.arange(12)).tobytes() == orig.tobytes()
+
+
+def test_get_missing_table_lists_existing():
+    HostEmbeddingTable("exists_a", num_rows=4, dim=2)
+    HostEmbeddingTable("exists_b", num_rows=4, dim=2)
+    with pytest.raises(KeyError, match="exists_a.*exists_b"):
+        HostEmbeddingTable.get("nope")
+
+
+def test_fold_ids_uint64_above_2_63_train_serve_agreement():
+    """fold_ids on raw uint64 hashes ABOVE 2^63 (negative as int64) must
+    agree with exact python-int modulo, and a push through the raw hash
+    must land on the row a serving-time pull(raw) reads back."""
+    from paddle_tpu.parallel.host_embedding import fold_ids
+
+    raw = np.array([2**63 + 11, 2**64 - 1, 2**63, 12345], np.uint64)
+    mod = 997
+    want = np.array([int(v) % mod for v in raw.tolist()], np.int64)
+    np.testing.assert_array_equal(fold_ids(raw, mod), want)
+    # int64 reinterpretation of the same bits (what a feed pipeline
+    # without the uint64 slot type would produce) folds identically
+    as_i64 = raw.view(np.int64)
+    np.testing.assert_array_equal(fold_ids(as_i64, mod), want)
+
+    t = HostEmbeddingTable("u64", num_rows=mod, dim=2, num_shards=3,
+                           learning_rate=1.0, init_scale=0.0,
+                           hash_ids=True)
+    t.push(raw, np.ones((4, 2), np.float32))
+    assert t.pull(raw).tobytes() == t.pull(want).tobytes()
+    np.testing.assert_allclose(t.pull(raw), -1.0)
+
+
+def test_async_pusher_bounded_queue_backpressure():
+    """The Communicator pusher queue is bounded (PTPU_EMBED_PUSH_QUEUE):
+    a slow consumer makes enqueue BLOCK instead of buffering without
+    bound, and embed/push_queue_depth reports occupancy."""
+    import threading
+    import time
+
+    from paddle_tpu.communicator import _AsyncPusher
+    from paddle_tpu.observability import metrics
+
+    t = HostEmbeddingTable("bp", num_rows=16, dim=2, num_shards=1,
+                           learning_rate=0.1, init_scale=0.0)
+    real_apply = t._apply_push
+    gate = threading.Event()
+
+    def slow_apply(ids, grads, n_pushes=1):
+        gate.wait(5.0)
+        real_apply(ids, grads, n_pushes=n_pushes)
+
+    t._apply_push = slow_apply
+    was = metrics.enabled()
+    metrics.enable()
+    try:
+        pusher = _AsyncPusher(t, max_queue=2, merge_size=1)
+        ids = np.array([1], np.int64)
+        g = np.ones((1, 2), np.float32)
+        done = threading.Event()
+
+        def produce():
+            for _ in range(6):
+                pusher.enqueue(ids.copy(), g.copy())
+            done.set()
+
+        producer = threading.Thread(target=produce, daemon=True)
+        producer.start()
+        # consumer is gated: the producer must hit the bound and stall
+        assert not done.wait(0.3), "enqueue never blocked on full queue"
+        assert pusher._q.qsize() <= 2
+        depth = metrics.registry().gauge("embed/push_queue_depth").value
+        assert depth >= 1, depth
+        gate.set()
+        assert done.wait(5.0)
+        pusher.flush()
+        pusher.stop()
+        np.testing.assert_allclose(t.pull(np.array([1], np.int64)),
+                                   -0.1 * 6)
+    finally:
+        t._apply_push = real_apply
+        if not was:
+            metrics.disable()
